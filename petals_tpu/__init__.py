@@ -1,0 +1,36 @@
+"""petals_tpu: a TPU-native framework for collaborative inference and fine-tuning of
+large language models over a decentralized swarm.
+
+Re-designed from scratch for TPU hardware (JAX/XLA/Pallas/pjit for compute,
+asyncio + a Kademlia DHT for the swarm control plane), with the capability
+surface of the Petals reference (see SURVEY.md):
+
+- A *server* hosts a contiguous span of transformer blocks of one model on its
+  TPU slice (sharded over the ICI mesh with ``shard_map``/``pjit``).
+- A *client* runs embeddings + LM head locally and routes hidden states through
+  a chain of servers covering all blocks.
+- Coordination happens through a DHT directory: servers announce which blocks
+  they serve; clients build min-latency (inference) or max-throughput
+  (training) chains, with bans/backoff and mid-generation failover.
+"""
+
+__version__ = "0.1.0"
+
+from petals_tpu.data_structures import (
+    ModuleUID,
+    RemoteModuleInfo,
+    RemoteSpanInfo,
+    ServerInfo,
+    ServerState,
+    parse_uid,
+)
+
+__all__ = [
+    "ModuleUID",
+    "RemoteModuleInfo",
+    "RemoteSpanInfo",
+    "ServerInfo",
+    "ServerState",
+    "parse_uid",
+    "__version__",
+]

@@ -1,0 +1,26 @@
+"""Shared constants (counterpart of reference src/petals/constants.py:1-18)."""
+
+import jax.numpy as jnp
+
+# Multiaddr-style bootstrap peers for a public swarm. The TPU build targets
+# private swarms by default, so this is empty unless configured.
+PUBLIC_INITIAL_PEERS: list = []
+
+# Reserved for a health-monitor endpoint (reference constants.py:16); the TPU
+# build exposes the same information via DHT records + `rpc_info`.
+REACHABILITY_API_URL = None
+
+# String names <-> jnp dtypes used on the wire and in configs.
+DTYPE_MAP = {
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "int8": jnp.int8,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "bool": jnp.bool_,
+    "auto": "auto",
+}
+
+DTYPE_NAMES = {v: k for k, v in DTYPE_MAP.items() if k != "auto"}

@@ -1,0 +1,216 @@
+"""Shared data model for the swarm (counterpart of reference
+src/petals/data_structures.py:1-117).
+
+These records travel over two channels:
+- the DHT directory (ServerInfo tuples keyed by ModuleUID, subkeyed by peer id), and
+- per-request RPC metadata (InferenceMetadata).
+
+Everything here is msgpack-serializable via ``to_wire()`` / ``from_wire()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import secrets
+from enum import IntEnum
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+# --------------------------------------------------------------------------------------
+# Module UIDs (reference data_structures.py:9-17)
+# --------------------------------------------------------------------------------------
+
+ModuleUID = str
+UID_DELIMITER = "."  # e.g. "llama-hf.3" is the 4th block of model prefix "llama-hf"
+CHAIN_DELIMITER = " "  # e.g. "llama-hf.3 llama-hf.4" addresses a chain of blocks
+
+
+def parse_uid(uid: ModuleUID) -> Tuple[str, int]:
+    assert CHAIN_DELIMITER not in uid, "parse_uid() does not support chained UIDs"
+    dht_prefix, index = uid.rsplit(UID_DELIMITER, 1)
+    return dht_prefix, int(index)
+
+
+def make_uid(dht_prefix: str, block_index: int) -> ModuleUID:
+    return f"{dht_prefix}{UID_DELIMITER}{block_index}"
+
+
+def join_uids(uids: Sequence[ModuleUID]) -> str:
+    return CHAIN_DELIMITER.join(uids)
+
+
+def split_chain(chain: str) -> Tuple[ModuleUID, ...]:
+    return tuple(chain.split(CHAIN_DELIMITER))
+
+
+# --------------------------------------------------------------------------------------
+# Peer identity
+# --------------------------------------------------------------------------------------
+
+
+class PeerID:
+    """Stable identity of a swarm participant (stand-in for libp2p PeerID).
+
+    Wraps 32 raw bytes; the canonical textual form is hex. Deterministic ids can
+    be derived from an identity seed file so test swarms have fixed multiaddrs
+    (reference tests/bootstrap.id pattern).
+    """
+
+    __slots__ = ("_bytes",)
+
+    def __init__(self, raw: bytes):
+        if not isinstance(raw, bytes) or len(raw) != 32:
+            raise ValueError("PeerID must wrap exactly 32 bytes")
+        self._bytes = raw
+
+    @classmethod
+    def generate(cls) -> "PeerID":
+        return cls(secrets.token_bytes(32))
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "PeerID":
+        return cls(hashlib.sha256(seed).digest())
+
+    @classmethod
+    def from_string(cls, s: str) -> "PeerID":
+        return cls(bytes.fromhex(s))
+
+    def to_string(self) -> str:
+        return self._bytes.hex()
+
+    def to_bytes(self) -> bytes:
+        return self._bytes
+
+    def __bytes__(self) -> bytes:
+        return self._bytes
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, PeerID) and other._bytes == self._bytes
+
+    def __hash__(self) -> int:
+        return hash(self._bytes)
+
+    def __lt__(self, other: "PeerID") -> bool:
+        return self._bytes < other._bytes
+
+    def __repr__(self) -> str:
+        s = self.to_string()
+        return f"PeerID({s[:8]}…{s[-4:]})"
+
+
+# --------------------------------------------------------------------------------------
+# Server records (reference data_structures.py:33-104)
+# --------------------------------------------------------------------------------------
+
+
+class ServerState(IntEnum):
+    OFFLINE = 0
+    JOINING = 1
+    ONLINE = 2
+
+
+RPS = float
+
+
+@dataclasses.dataclass
+class ServerInfo:
+    """Everything a server publishes about itself to the DHT directory."""
+
+    state: ServerState
+    throughput: RPS
+
+    start_block: Optional[int] = None
+    end_block: Optional[int] = None
+
+    public_name: Optional[str] = None
+    version: Optional[str] = None
+
+    network_rps: Optional[RPS] = None
+    forward_rps: Optional[RPS] = None
+    inference_rps: Optional[RPS] = None
+
+    adapters: Sequence[str] = ()
+    compute_dtype: Optional[str] = None
+    quant_type: Optional[str] = None
+    using_relay: Optional[bool] = None
+    cache_tokens_left: Optional[int] = None
+    next_pings: Optional[Dict[str, float]] = None  # peer id hex -> RTT seconds
+
+    def to_tuple(self) -> Tuple[int, float, dict]:
+        extra_info = dataclasses.asdict(self)
+        del extra_info["state"], extra_info["throughput"]
+        extra_info["adapters"] = list(self.adapters)
+        return (int(self.state), float(self.throughput), extra_info)
+
+    @classmethod
+    def from_tuple(cls, source: tuple) -> "ServerInfo":
+        if not isinstance(source, (tuple, list)) or len(source) < 2:
+            raise ValueError(f"Expected a tuple of (state, throughput, [extra]), got {source!r}")
+        state, throughput = source[:2]
+        extra_info = dict(source[2]) if len(source) > 2 and isinstance(source[2], dict) else {}
+        # Forward compatibility: ignore unknown fields (reference data_structures.py:57-59)
+        known = {f.name for f in dataclasses.fields(cls)}
+        extra_info = {k: v for k, v in extra_info.items() if k in known}
+        extra_info["adapters"] = tuple(extra_info.get("adapters") or ())
+        return cls(state=ServerState(int(state)), throughput=float(throughput), **extra_info)
+
+
+@dataclasses.dataclass
+class RemoteModuleInfo:
+    """A remote module (one block UID) served by one or more peers."""
+
+    uid: ModuleUID
+    servers: Dict[PeerID, ServerInfo] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class RemoteSpanInfo:
+    """A chain of blocks [start, end) served by one peer."""
+
+    peer_id: PeerID
+    start: int
+    end: int
+    server_info: ServerInfo
+
+    @property
+    def length(self) -> int:
+        return self.end - self.start
+
+    @property
+    def state(self) -> ServerState:
+        return self.server_info.state
+
+    @property
+    def throughput(self) -> float:
+        return self.server_info.throughput
+
+
+RemoteSpanPath = Sequence[RemoteSpanInfo]
+
+
+# --------------------------------------------------------------------------------------
+# Inference bookkeeping (reference data_structures.py:109-117)
+# --------------------------------------------------------------------------------------
+
+Handle = int  # KV-cache handle issued by the server MemoryCache
+
+
+@dataclasses.dataclass(frozen=True)
+class InferenceMetadata:
+    uid: ModuleUID
+    prefix_length: int
+    cache_handles: Tuple[Handle, ...]
+    active_adapter: Optional[str] = None
+
+
+# --------------------------------------------------------------------------------------
+# Wire helpers
+# --------------------------------------------------------------------------------------
+
+
+def server_info_to_wire(info: ServerInfo) -> Any:
+    return list(info.to_tuple())
+
+
+def server_info_from_wire(obj: Any) -> ServerInfo:
+    return ServerInfo.from_tuple(tuple(obj))

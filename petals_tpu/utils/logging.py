@@ -1,0 +1,36 @@
+"""Logging setup (counterpart of reference src/petals/utils/logging.py).
+
+Env vars:
+- ``PETALS_TPU_LOGGING`` — root level for petals_tpu loggers (default INFO).
+"""
+
+import logging
+import os
+
+_initialized = False
+
+
+def initialize_logs() -> None:
+    global _initialized
+    if _initialized:
+        return
+    level = os.environ.get("PETALS_TPU_LOGGING", "INFO").upper()
+    handler = logging.StreamHandler()
+    handler.setFormatter(
+        logging.Formatter(
+            fmt="%(asctime)s.%(msecs)03d [%(levelname)s] [%(name)s:%(lineno)d] %(message)s",
+            datefmt="%b %d %H:%M:%S",
+        )
+    )
+    root = logging.getLogger("petals_tpu")
+    root.setLevel(level)
+    root.addHandler(handler)
+    root.propagate = False
+    _initialized = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    initialize_logs()
+    if not name.startswith("petals_tpu"):
+        name = f"petals_tpu.{name}"
+    return logging.getLogger(name)

@@ -1,0 +1,41 @@
+"""Small shared helpers (counterpart of reference src/petals/utils/misc.py:3-21)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# A dummy array is a placeholder for "no tensor here" inside fixed-arity RPC payloads
+# (e.g. "no prompts for this chain"). Mirrors reference misc.py:6-10.
+DUMMY = np.empty(0, dtype=np.float32)
+DUMMY_INT64 = np.empty(0, dtype=np.int64)
+
+
+def is_dummy(array) -> bool:
+    return getattr(array, "ndim", None) == 1 and array.shape[0] == 0
+
+
+DTYPE_BYTES = {
+    jnp.float64: 8,
+    jnp.int64: 8,
+    jnp.float32: 4,
+    jnp.int32: 4,
+    jnp.bfloat16: 2,
+    jnp.float16: 2,
+    jnp.int16: 2,
+    jnp.int8: 1,
+    jnp.uint8: 1,
+    jnp.bool_: 1,
+}
+
+
+def get_size_in_bytes(dtype) -> int:
+    """Bytes per element for a jnp/np dtype."""
+    return np.dtype(dtype).itemsize if not hasattr(dtype, "dtype") else np.dtype(dtype.dtype).itemsize
+
+
+def dtype_bytes(dtype) -> int:
+    try:
+        return np.dtype(dtype).itemsize
+    except TypeError:
+        return np.dtype(jnp.dtype(dtype)).itemsize

@@ -1,0 +1,76 @@
+"""Unit tests for the shared data model (reference: src/petals/data_structures.py)."""
+
+import pytest
+
+from petals_tpu.data_structures import (
+    PeerID,
+    RemoteSpanInfo,
+    ServerInfo,
+    ServerState,
+    join_uids,
+    make_uid,
+    parse_uid,
+    split_chain,
+)
+
+
+def test_uid_roundtrip():
+    uid = make_uid("llama-hf", 17)
+    assert uid == "llama-hf.17"
+    prefix, index = parse_uid(uid)
+    assert prefix == "llama-hf" and index == 17
+
+    chain = join_uids([make_uid("m", i) for i in range(3)])
+    assert split_chain(chain) == ("m.0", "m.1", "m.2")
+
+
+def test_parse_uid_rejects_chain():
+    with pytest.raises(AssertionError):
+        parse_uid("m.0 m.1")
+
+
+def test_peer_id():
+    a = PeerID.generate()
+    b = PeerID.from_string(a.to_string())
+    assert a == b and hash(a) == hash(b)
+    c = PeerID.from_seed(b"fixed-seed")
+    d = PeerID.from_seed(b"fixed-seed")
+    assert c == d
+    assert c != a
+    with pytest.raises(ValueError):
+        PeerID(b"short")
+
+
+def test_server_info_wire_roundtrip():
+    info = ServerInfo(
+        state=ServerState.ONLINE,
+        throughput=123.4,
+        start_block=3,
+        end_block=7,
+        adapters=("a", "b"),
+        cache_tokens_left=4096,
+        next_pings={"ab" * 32: 0.05},
+    )
+    restored = ServerInfo.from_tuple(info.to_tuple())
+    assert restored.state == ServerState.ONLINE
+    assert restored.throughput == pytest.approx(123.4)
+    assert restored.start_block == 3 and restored.end_block == 7
+    assert restored.adapters == ("a", "b")
+    assert restored.cache_tokens_left == 4096
+    assert restored.next_pings == {"ab" * 32: 0.05}
+
+
+def test_server_info_ignores_unknown_fields():
+    state, throughput, extra = ServerInfo(ServerState.JOINING, 1.0).to_tuple()
+    extra["bright_new_field"] = "ignored"
+    restored = ServerInfo.from_tuple((state, throughput, extra))
+    assert restored.state == ServerState.JOINING
+
+
+def test_remote_span_info():
+    span = RemoteSpanInfo(
+        peer_id=PeerID.generate(), start=2, end=10, server_info=ServerInfo(ServerState.ONLINE, 5.0)
+    )
+    assert span.length == 8
+    assert span.state == ServerState.ONLINE
+    assert span.throughput == 5.0

@@ -1,0 +1,51 @@
+"""Tests for wire pytree packing (reference: tests/test_aux_functions.py:148-170)."""
+
+import numpy as np
+import pytest
+
+from petals_tpu.utils.misc import DUMMY, is_dummy
+from petals_tpu.utils.packaging import pack_args_kwargs, unpack_args_kwargs
+
+
+def test_pack_unpack_roundtrip():
+    x = np.random.randn(3, 4).astype(np.float32)
+    y = np.arange(5, dtype=np.int64)
+    args = (x, "static", 42, [y, None], {"nested": (x, 1.5)})
+    kwargs = {"flag": True, "tensor": y}
+
+    arrays, structure = pack_args_kwargs(*args, **kwargs)
+    assert len(arrays) == 4  # x, y, nested x, kwargs y (duplicates are sent twice)
+
+    args2, kwargs2 = unpack_args_kwargs(arrays, structure)
+    np.testing.assert_array_equal(args2[0], x)
+    assert args2[1] == "static" and args2[2] == 42
+    np.testing.assert_array_equal(args2[3][0], y)
+    assert args2[3][1] is None
+    np.testing.assert_array_equal(args2[4]["nested"][0], x)
+    assert args2[4]["nested"][1] == 1.5
+    assert kwargs2["flag"] is True
+    np.testing.assert_array_equal(kwargs2["tensor"], y)
+
+
+def test_pack_preserves_tuple_vs_list():
+    arrays, structure = pack_args_kwargs((1, 2), [3, 4])
+    args, _ = unpack_args_kwargs(arrays, structure)
+    assert args[0] == (1, 2) and isinstance(args[0], tuple)
+    assert args[1] == [3, 4] and isinstance(args[1], list)
+
+
+def test_pack_rejects_unsupported():
+    with pytest.raises(TypeError):
+        pack_args_kwargs(object())
+
+
+def test_array_count_mismatch():
+    arrays, structure = pack_args_kwargs(np.zeros(3))
+    with pytest.raises(ValueError):
+        unpack_args_kwargs([], structure)
+
+
+def test_dummy():
+    assert is_dummy(DUMMY)
+    assert not is_dummy(np.zeros((1,)))
+    assert not is_dummy(np.zeros((0, 2)))
