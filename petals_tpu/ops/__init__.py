@@ -1,0 +1,5 @@
+from petals_tpu.ops.attention import attend
+from petals_tpu.ops.alibi import build_alibi_slopes
+from petals_tpu.ops.rotary import apply_rotary, rotary_tables
+
+__all__ = ["attend", "build_alibi_slopes", "apply_rotary", "rotary_tables"]

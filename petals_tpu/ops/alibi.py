@@ -1,0 +1,30 @@
+"""ALiBi positional bias (BLOOM family).
+
+Parity target: HF transformers' ``build_alibi_tensor`` (used by the reference's
+WrappedBloomBlock, /root/reference/src/petals/models/bloom/block.py:15-45).
+Instead of materializing a [batch*heads, 1, seq] tensor the way torch does, we
+return per-head slopes and let the attention op fuse the bias arithmetic —
+cheaper on HBM bandwidth and fusible by XLA.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+def build_alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """Per-head ALiBi slopes [num_heads], float32. Matches HF's slope schedule."""
+    closest_power_of_2 = 2 ** math.floor(math.log2(num_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest_power_of_2) - 3)))
+    powers = jnp.arange(1, 1 + closest_power_of_2, dtype=jnp.float32)
+    slopes = base**powers
+
+    if closest_power_of_2 != num_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest_power_of_2) - 3)))
+        num_remaining = num_heads - closest_power_of_2
+        extra_powers = jnp.arange(1, 1 + 2 * num_remaining, 2, dtype=jnp.float32)
+        slopes = jnp.concatenate([slopes, extra_base**extra_powers], axis=0)
+
+    return slopes

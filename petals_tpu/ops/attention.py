@@ -1,0 +1,134 @@
+"""Attention with prefix KV cache — the hot op of the server.
+
+Canonical layouts (TPU-friendly, head_dim last so lanes stay 128-aligned):
+- q:    [batch, q_len, num_q_heads, head_dim]
+- k, v: [batch, kv_len, num_kv_heads, head_dim]  (GQA: num_q_heads % num_kv_heads == 0)
+
+Semantics: query position i has absolute position ``q_offset + i`` and may attend
+to kv positions ``j`` with ``j <= q_offset + i`` and ``j < kv_length`` (the valid
+prefix of a preallocated cache buffer). This one op covers prefill (q_len == kv
+written so far), chunked prefill (q_offset > 0), and decode (q_len == 1).
+
+Replaces the reference's torch SDPA / CUDA-graph paths
+(/root/reference/src/petals/models/falcon/block.py:233-244,
+ /root/reference/src/petals/models/llama/block.py:92-95). A Pallas
+flash-attention kernel (petals_tpu/ops/flash_attention.py) is used on TPU for
+long sequences; this XLA einsum path is the numerics reference and the
+small-shape fallback (XLA already fuses it well at decode shapes).
+
+ALiBi follows BLOOM's definition: bias[h, j] = slopes[h] * j (a function of the
+absolute kv position only — matches HF ``build_alibi_tensor`` with a full mask).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def attend(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    q_offset: jnp.ndarray | int = 0,
+    kv_length: Optional[jnp.ndarray | int] = None,
+    alibi_slopes: Optional[jnp.ndarray] = None,
+    sliding_window: Optional[int] = None,
+    scale: Optional[float] = None,
+    causal: bool = True,
+    use_flash: bool = False,
+) -> jnp.ndarray:
+    """Multi-head attention with causal masking over a prefix-valid KV buffer.
+
+    Args:
+      q: [b, sq, hq, d]; k/v: [b, skv, hkv, d] — skv is the *buffer* length.
+      q_offset: absolute position of q[:, 0] (scalar, may be traced).
+      kv_length: number of valid kv positions (defaults to skv).
+      alibi_slopes: [hq] BLOOM-style slopes, or None.
+      sliding_window: if set, queries attend only to the last `sliding_window`
+        positions (Mixtral). Applied on absolute positions.
+      scale: softmax scale; default 1/sqrt(d).
+      causal: apply causal mask (True for all served models).
+      use_flash: route to the Pallas flash kernel when shapes allow.
+    """
+    if use_flash and causal:
+        from petals_tpu.ops.flash_attention import flash_attend, flash_supported
+
+        if flash_supported(q, k, v, sliding_window=sliding_window):
+            return flash_attend(
+                q,
+                k,
+                v,
+                q_offset=q_offset,
+                kv_length=kv_length,
+                alibi_slopes=alibi_slopes,
+                scale=scale,
+            )
+    return attend_reference(
+        q,
+        k,
+        v,
+        q_offset=q_offset,
+        kv_length=kv_length,
+        alibi_slopes=alibi_slopes,
+        sliding_window=sliding_window,
+        scale=scale,
+        causal=causal,
+    )
+
+
+def attend_reference(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    q_offset: jnp.ndarray | int = 0,
+    kv_length: Optional[jnp.ndarray | int] = None,
+    alibi_slopes: Optional[jnp.ndarray] = None,
+    sliding_window: Optional[int] = None,
+    scale: Optional[float] = None,
+    causal: bool = True,
+) -> jnp.ndarray:
+    batch, q_len, num_q_heads, head_dim = q.shape
+    _, kv_buf_len, num_kv_heads, _ = k.shape
+    assert num_q_heads % num_kv_heads == 0, (num_q_heads, num_kv_heads)
+    group = num_q_heads // num_kv_heads
+    if scale is None:
+        scale = head_dim**-0.5
+    if kv_length is None:
+        kv_length = kv_buf_len
+
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    # [b, hq, sq, skv] logits via GQA grouping: fold q heads as (hkv, group)
+    qg = qf.reshape(batch, q_len, num_kv_heads, group, head_dim)
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", qg, kf) * scale
+    logits = logits.reshape(batch, num_q_heads, q_len, kv_buf_len)
+
+    kv_pos = jnp.arange(kv_buf_len, dtype=jnp.int32)
+    if alibi_slopes is not None:
+        bias = alibi_slopes[:, None, None] * kv_pos.astype(jnp.float32)[None, None, :]
+        logits = logits + bias[None]
+
+    q_pos = jnp.asarray(q_offset, jnp.int32) + jnp.arange(q_len, dtype=jnp.int32)
+    mask = kv_pos[None, :] < jnp.asarray(kv_length, jnp.int32)
+    mask = jnp.broadcast_to(mask, (q_len, kv_buf_len))
+    if causal:
+        mask = mask & (kv_pos[None, :] <= q_pos[:, None])
+    if sliding_window is not None:
+        mask = mask & (kv_pos[None, :] > q_pos[:, None] - sliding_window)
+
+    logits = jnp.where(mask[None, None], logits, DEFAULT_MASK_VALUE)
+    weights = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    weights = weights * mask[None, None]
+    weights = weights / jnp.maximum(weights.sum(axis=-1, keepdims=True), 1e-30)
+
+    wg = weights.reshape(batch, num_kv_heads, group, q_len, kv_buf_len)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", wg, vf)
+    return out.reshape(batch, q_len, num_q_heads, head_dim).astype(q.dtype)

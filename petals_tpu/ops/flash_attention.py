@@ -1,0 +1,236 @@
+"""Pallas TPU flash attention with prefix KV cache support.
+
+Online-softmax tiled attention over a preallocated KV buffer of which only the
+first ``kv_length`` positions are valid. Supports GQA (kv heads shared by query
+head groups) and BLOOM-style ALiBi bias. Used for prefill / chunked prefill
+(q_len >= 8, i.e. anything above decode shapes); the XLA reference path in
+petals_tpu/ops/attention.py covers decode (q_len < 8), where the op is
+bandwidth-bound and XLA fusion is already optimal. Causal masking is always
+applied — non-causal requests must use the XLA path (attend() enforces this).
+
+Replaces the reference's torch SDPA path
+(/root/reference/src/petals/models/falcon/block.py:233-244) with a TPU-first
+kernel: blocks of Q stay resident in VMEM while KV blocks stream through,
+skipping fully-masked tiles (beyond the causal frontier or past kv_length).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _kernel(
+    # scalar prefetch
+    q_offset_ref,  # int32[1]
+    kv_length_ref,  # int32[1]
+    slopes_ref,  # float32[num_q_heads]
+    # inputs (layout [batch, heads, seq, head_dim] inside the kernel)
+    q_ref,  # [1, 1, block_q, head_dim]
+    k_ref,  # [1, 1, block_kv, head_dim]
+    v_ref,  # [1, 1, block_kv, head_dim]
+    # outputs
+    o_ref,  # [1, 1, block_q, head_dim]
+    # scratch
+    m_scratch,  # [block_q, LANES] f32
+    l_scratch,  # [block_q, LANES] f32
+    acc_scratch,  # [block_q, head_dim] f32
+    *,
+    scale: float,
+    block_q: int,
+    block_kv: int,
+    num_kv_blocks: int,
+    use_alibi: bool,
+):
+    h = pl.program_id(1)
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+
+    q_offset = q_offset_ref[0]
+    kv_length = kv_length_ref[0]
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scratch[...] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[...] = jnp.zeros_like(l_scratch)
+        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    q_block_start = q_offset + qi * block_q
+    kv_block_start = kj * block_kv
+    # Any work in this tile? (causal frontier: last q row is q_block_start + block_q - 1)
+    block_needed = (kv_block_start <= q_block_start + block_q - 1) & (kv_block_start < kv_length)
+
+    @pl.when(block_needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bkv, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bkv]
+        s = s * scale
+
+        kv_pos = kv_block_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+        if use_alibi:
+            s = s + slopes_ref[h] * kv_pos.astype(jnp.float32)
+
+        q_pos = q_block_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+        mask = (kv_pos <= q_pos) & (kv_pos < kv_length)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scratch[...]  # [bq, LANES] (all lanes equal)
+        l_prev = l_scratch[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))  # [bq, LANES]
+
+        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])  # [bq, 1]
+        p = jnp.exp(s - m_new[:, :1])  # [bq, bkv]
+        p = jnp.where(mask, p, 0.0)
+
+        l_new = alpha * l_prev[:, :1] + jnp.sum(p, axis=1, keepdims=True)  # [bq, 1]
+
+        acc = acc_scratch[...]
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        acc_scratch[...] = acc * alpha + pv
+
+        m_scratch[...] = m_new
+        l_scratch[...] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    @pl.when(kj == num_kv_blocks - 1)
+    def _finalize():
+        l = l_scratch[:, :1]
+        out = acc_scratch[...] / jnp.maximum(l, 1e-30)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def flash_supported(q, k, v, *, sliding_window: Optional[int] = None) -> bool:
+    """Cheap static check whether the Pallas kernel handles these shapes."""
+    if sliding_window is not None:
+        return False
+    batch, q_len, num_q_heads, head_dim = q.shape
+    _, kv_buf_len, num_kv_heads, _ = k.shape
+    if q_len < 8:  # decode path: XLA fusion is better
+        return False
+    if kv_buf_len % LANES != 0:
+        return False
+    return True
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_q", "block_kv", "interpret")
+)
+def flash_attend(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    q_offset: jnp.ndarray | int = 0,
+    kv_length: Optional[jnp.ndarray | int] = None,
+    alibi_slopes: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_kv: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    batch, q_len, num_q_heads, head_dim = q.shape
+    _, kv_buf_len, num_kv_heads, _ = k.shape
+    assert num_q_heads % num_kv_heads == 0
+    group = num_q_heads // num_kv_heads
+    if scale is None:
+        scale = head_dim**-0.5
+    if kv_length is None:
+        kv_length = kv_buf_len
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    block_q = min(block_q, _round_up(q_len, 8))
+    block_kv = min(block_kv, kv_buf_len)
+    while kv_buf_len % block_kv != 0:  # kv_buf_len is a multiple of 128 (flash_supported)
+        block_kv //= 2
+
+    # Pad q to a multiple of block_q; padded rows are sliced away afterwards.
+    q_pad = _round_up(q_len, block_q) - q_len
+    if q_pad:
+        q = jnp.pad(q, ((0, 0), (0, q_pad), (0, 0), (0, 0)))
+    padded_q_len = q.shape[1]
+
+    # Kernel layout: [batch, heads, seq, head_dim] so the blocked axes are the
+    # trailing (seq, head_dim) pair — TPU requires whole-dim blocks elsewhere.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    num_q_blocks = padded_q_len // block_q
+    num_kv_blocks = kv_buf_len // block_kv
+
+    q_offset_arr = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    kv_length_arr = jnp.asarray(kv_length, jnp.int32).reshape(1)
+    if alibi_slopes is None:
+        slopes = jnp.zeros((num_q_heads,), jnp.float32)
+        use_alibi = False
+    else:
+        slopes = alibi_slopes.astype(jnp.float32)
+        use_alibi = True
+
+    grid = (batch, num_q_heads, num_q_blocks, num_kv_blocks)
+
+    kernel = functools.partial(
+        _kernel,
+        scale=scale,
+        block_q=block_q,
+        block_kv=block_kv,
+        num_kv_blocks=num_kv_blocks,
+        use_alibi=use_alibi,
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, head_dim), lambda b, h, qi, kj, *prefetch: (b, h, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_kv, head_dim), lambda b, h, qi, kj, *prefetch: (b, h // group, kj, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_kv, head_dim), lambda b, h, qi, kj, *prefetch: (b, h // group, kj, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, head_dim), lambda b, h, qi, kj, *prefetch: (b, h, qi, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q_offset_arr, kv_length_arr, slopes, qt, kt, vt)
+
+    out = out.transpose(0, 2, 1, 3)
+    if q_pad:
+        out = out[:, :q_len]
+    return out
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m

@@ -1,0 +1,82 @@
+"""Rotary position embeddings (RoPE), TPU-native.
+
+Equivalent capability to the reference's CUDA-graphed rotary for 1-token decode
+(/root/reference/src/petals/models/llama/block.py:37-93) — under ``jax.jit`` the
+whole decode step is one fused XLA program, so no graph-capture machinery is
+needed.
+
+Convention matches HF Llama ("rotate_half"): the head dim is split into two
+halves [x1, x2]; rotated = [x1*cos - x2*sin, x2*cos + x1*sin].
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def rotary_tables(
+    positions: jnp.ndarray,  # [batch, seq] absolute positions (int32)
+    head_dim: int,
+    theta: float = 10000.0,
+    scaling_factor: Optional[float] = None,
+    rope_scaling: Optional[dict] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Compute cos/sin tables [batch, seq, head_dim] for the given positions.
+
+    ``rope_scaling`` supports HF-style dicts with rope_type "linear" or
+    "llama3" (others raise NotImplementedError). Computation is float32
+    throughout for parity with HF.
+    """
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+
+    if rope_scaling is not None:
+        rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+        if rope_type == "linear":
+            inv_freq = inv_freq / rope_scaling["factor"]
+        elif rope_type == "llama3":
+            inv_freq = _llama3_scale_inv_freq(inv_freq, rope_scaling)
+        elif rope_type in ("default", None):
+            pass
+        else:
+            raise NotImplementedError(f"rope_type={rope_type!r} is not supported yet")
+    elif scaling_factor is not None:
+        inv_freq = inv_freq / scaling_factor
+
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq[None, None, :]  # [b, s, d/2]
+    emb = jnp.concatenate([angles, angles], axis=-1)  # [b, s, d]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _llama3_scale_inv_freq(inv_freq: jnp.ndarray, cfg: dict) -> jnp.ndarray:
+    """Llama-3.1 NTK-by-parts frequency scaling (mirrors HF's _compute_llama3_parameters)."""
+    factor = cfg["factor"]
+    low_freq_factor = cfg["low_freq_factor"]
+    high_freq_factor = cfg["high_freq_factor"]
+    old_context_len = cfg["original_max_position_embeddings"]
+
+    low_freq_wavelen = old_context_len / low_freq_factor
+    high_freq_wavelen = old_context_len / high_freq_factor
+
+    wavelen = 2 * jnp.pi / inv_freq
+    smooth = (old_context_len / wavelen - low_freq_factor) / (high_freq_factor - low_freq_factor)
+    smoothed = (1 - smooth) / factor * inv_freq + smooth * inv_freq
+    scaled = jnp.where(wavelen > low_freq_wavelen, inv_freq / factor, inv_freq)
+    is_medium = (wavelen <= low_freq_wavelen) & (wavelen >= high_freq_wavelen)
+    return jnp.where(is_medium, smoothed, scaled)
+
+
+def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Apply rotary embedding.
+
+    x: [batch, seq, heads, head_dim]; cos/sin: [batch, seq, head_dim].
+    Rotation happens in float32; result is cast back to x.dtype.
+    """
+    xf = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    x1, x2 = xf[..., :half], xf[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    return (xf * cos + rotated * sin).astype(x.dtype)

@@ -1,25 +1,26 @@
 """Test configuration: force JAX onto a virtual 8-device CPU platform so
 sharding/mesh tests run without TPU hardware (multi-chip is emulated; see
-repo guidelines). Must run before jax is imported anywhere."""
+repo guidelines).
+
+Note: the environment ships an `axon` plugin (PYTHONPATH site) that forcibly
+sets jax_platforms="axon,cpu" at jax import time to tunnel to one real TPU
+chip. Tests must run on CPU, so we re-override the config *after* importing
+jax but before any backend is initialized.
+"""
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import asyncio  # noqa: E402
+import jax  # noqa: E402
 
-import pytest  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()
 
-
-@pytest.fixture
-def event_loop():
-    """Fresh event loop per test (mirrors reference tests/conftest.py:14-27)."""
-    loop = asyncio.new_event_loop()
-    yield loop
-    loop.close()
+# NOTE: pytest-asyncio is not installed; async tests must drive their own loop
+# via asyncio.run(...) inside a sync test function.
