@@ -1,0 +1,4 @@
+from petals_tpu.models.bloom.block import FAMILY as _FAMILY  # noqa: F401
+from petals_tpu.models.bloom.config import BloomBlockConfig
+
+__all__ = ["BloomBlockConfig"]

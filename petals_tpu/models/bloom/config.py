@@ -1,0 +1,35 @@
+"""BLOOM family block config (parity target: reference
+src/petals/models/bloom/config.py:16-35)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class BloomBlockConfig:
+    hidden_size: int
+    num_attention_heads: int
+    num_hidden_layers: int
+    layer_norm_epsilon: float
+    apply_residual_connection_post_layernorm: bool = False
+    vocab_size: int = 250880
+    tie_word_embeddings: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def from_hf_config(cls, hf_config) -> "BloomBlockConfig":
+        return cls(
+            hidden_size=hf_config.hidden_size,
+            num_attention_heads=hf_config.n_head,
+            num_hidden_layers=hf_config.n_layer,
+            layer_norm_epsilon=hf_config.layer_norm_epsilon,
+            apply_residual_connection_post_layernorm=getattr(
+                hf_config, "apply_residual_connection_post_layernorm", False
+            ),
+            vocab_size=hf_config.vocab_size,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+        )

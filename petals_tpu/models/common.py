@@ -1,0 +1,71 @@
+"""Shared numerics for all model families (norms, activations, KV-cache plumbing).
+
+All normalizations run in float32 and cast back, matching HF torch semantics
+closely enough for the 1e-4 (f32) / 1e-3 (bf16) exactness bars used by the
+reference test suite (reference tests/test_block_exact_match.py:78-108).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+KVCache = Tuple[jnp.ndarray, jnp.ndarray]  # (k, v): [batch, max_len, kv_heads, head_dim]
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps)
+    return (out * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (out * weight.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def gelu_tanh(x: jnp.ndarray) -> jnp.ndarray:
+    """BLOOM/Falcon GeLU (tanh approximation, matches HF BloomGelu)."""
+    xf = x.astype(jnp.float32)
+    out = 0.5 * xf * (1.0 + jnp.tanh(0.79788456 * xf * (1.0 + 0.044715 * xf * xf)))
+    return out.astype(x.dtype)
+
+
+def silu(x: jnp.ndarray) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    return (xf * jax.nn.sigmoid(xf)).astype(x.dtype)
+
+
+ACTIVATIONS = {"silu": silu, "gelu_tanh": gelu_tanh, "gelu": jax.nn.gelu}
+
+
+def update_kv_cache(
+    kv: Optional[KVCache], k_new: jnp.ndarray, v_new: jnp.ndarray, position
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Write k_new/v_new ([b, s, hkv, d]) into the cache at ``position``.
+
+    Returns (k_all, v_all, kv_length) to attend over. With kv=None (training
+    forward without a cache) the freshly computed k/v are used directly.
+    """
+    seq = k_new.shape[1]
+    if kv is None:
+        return k_new, v_new, jnp.asarray(seq, jnp.int32)
+    k_buf, v_buf = kv
+    if isinstance(position, int) and position + seq > k_buf.shape[1]:
+        # Traced positions can't be validated here (dynamic_update_slice would
+        # clamp and silently corrupt the cache) — the server handler enforces
+        # prefix_length + seq <= max_length before a step is ever submitted.
+        raise ValueError(
+            f"KV cache overflow: position {position} + {seq} new tokens > "
+            f"buffer length {k_buf.shape[1]}"
+        )
+    pos = jnp.asarray(position, jnp.int32)
+    k_buf = jax.lax.dynamic_update_slice(k_buf, k_new.astype(k_buf.dtype), (0, pos, 0, 0))
+    v_buf = jax.lax.dynamic_update_slice(v_buf, v_new.astype(v_buf.dtype), (0, pos, 0, 0))
+    return k_buf, v_buf, pos + seq

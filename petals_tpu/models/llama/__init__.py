@@ -1,0 +1,4 @@
+from petals_tpu.models.llama.block import FAMILY as _FAMILY  # noqa: F401
+from petals_tpu.models.llama.config import LlamaBlockConfig
+
+__all__ = ["LlamaBlockConfig"]

@@ -1,0 +1,161 @@
+"""Llama decoder block as a pure jitted JAX function.
+
+Capability parity with the reference's WrappedLlamaBlock
+(/root/reference/src/petals/models/llama/block.py:225-300): uniform block
+contract over a KV cache with GQA and RoPE. The reference's CUDA-graph rotary
+and its bloom<->llama cache permutes are unnecessary here — the whole step is
+one XLA program and the framework has a single canonical KV layout
+[batch, seq, kv_heads, head_dim].
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from petals_tpu.models.common import KVCache, rms_norm, silu, update_kv_cache
+from petals_tpu.models.llama.config import LlamaBlockConfig
+from petals_tpu.models.registry import ModelFamily, register_family
+from petals_tpu.ops.attention import attend
+from petals_tpu.ops.rotary import apply_rotary, rotary_tables
+
+
+def block_apply(
+    params: dict,
+    hidden_states: jnp.ndarray,  # [batch, seq, hidden]
+    kv: Optional[KVCache],
+    position,  # int32 scalar: tokens already in the cache
+    cfg: LlamaBlockConfig,
+    *,
+    use_flash: bool = False,
+) -> Tuple[jnp.ndarray, Optional[KVCache]]:
+    batch, seq, _ = hidden_states.shape
+    hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+
+    residual = hidden_states
+    x = rms_norm(hidden_states, params["ln1"], cfg.rms_norm_eps)
+
+    q = x @ params["wq"]
+    k = x @ params["wk"]
+    v = x @ params["wv"]
+    if cfg.attention_bias:
+        q = q + params["bq"]
+        k = k + params["bk"]
+        v = v + params["bv"]
+    q = q.reshape(batch, seq, hq, d)
+    k = k.reshape(batch, seq, hkv, d)
+    v = v.reshape(batch, seq, hkv, d)
+
+    positions = jnp.asarray(position, jnp.int32) + jnp.arange(seq, dtype=jnp.int32)
+    positions = jnp.broadcast_to(positions[None, :], (batch, seq))
+    cos, sin = rotary_tables(positions, d, theta=cfg.rope_theta, rope_scaling=cfg.rope_scaling_dict)
+    q = apply_rotary(q, cos, sin)
+    k = apply_rotary(k, cos, sin)
+
+    k_all, v_all, kv_length = update_kv_cache(kv, k, v, position)
+    attn = attend(
+        q, k_all, v_all, q_offset=position, kv_length=kv_length, use_flash=use_flash
+    )
+    attn = attn.reshape(batch, seq, hq * d) @ params["wo"]
+    if cfg.attention_bias:
+        attn = attn + params["bo"]
+    hidden_states = residual + attn
+
+    residual = hidden_states
+    x = rms_norm(hidden_states, params["ln2"], cfg.rms_norm_eps)
+    gate = x @ params["wg"]
+    up = x @ params["wu"]
+    if cfg.mlp_bias:
+        gate = gate + params["bg"]
+        up = up + params["bu"]
+    mlp = (silu(gate) * up) @ params["wd"]
+    if cfg.mlp_bias:
+        mlp = mlp + params["bd"]
+    hidden_states = residual + mlp
+
+    new_kv = (k_all, v_all) if kv is not None else None
+    return hidden_states, new_kv
+
+
+# ----------------------------------------------------------------------------------
+# HF checkpoint mapping (weights stored torch-style [out, in]; we keep [in, out])
+# ----------------------------------------------------------------------------------
+
+_HF_BLOCK_PREFIXES = ("model.layers.{i}.",)
+
+
+def hf_to_block_params(tensors: dict, cfg: LlamaBlockConfig) -> dict:
+    """Map one block's HF tensors (names relative to the block prefix) to our tree."""
+
+    def t(name):
+        return np.ascontiguousarray(np.asarray(tensors[name]).T)
+
+    params = {
+        "ln1": np.asarray(tensors["input_layernorm.weight"]),
+        "wq": t("self_attn.q_proj.weight"),
+        "wk": t("self_attn.k_proj.weight"),
+        "wv": t("self_attn.v_proj.weight"),
+        "wo": t("self_attn.o_proj.weight"),
+        "ln2": np.asarray(tensors["post_attention_layernorm.weight"]),
+        "wg": t("mlp.gate_proj.weight"),
+        "wu": t("mlp.up_proj.weight"),
+        "wd": t("mlp.down_proj.weight"),
+    }
+    if cfg.attention_bias:
+        params["bq"] = np.asarray(tensors["self_attn.q_proj.bias"])
+        params["bk"] = np.asarray(tensors["self_attn.k_proj.bias"])
+        params["bv"] = np.asarray(tensors["self_attn.v_proj.bias"])
+        params["bo"] = np.asarray(tensors["self_attn.o_proj.bias"])
+    if cfg.mlp_bias:
+        params["bg"] = np.asarray(tensors["mlp.gate_proj.bias"])
+        params["bu"] = np.asarray(tensors["mlp.up_proj.bias"])
+        params["bd"] = np.asarray(tensors["mlp.down_proj.bias"])
+    return params
+
+
+def block_param_shapes(cfg: LlamaBlockConfig, dtype=jnp.bfloat16) -> dict:
+    import jax
+
+    h, hq, hkv, d, m = (
+        cfg.hidden_size,
+        cfg.num_attention_heads,
+        cfg.num_key_value_heads,
+        cfg.head_dim,
+        cfg.intermediate_size,
+    )
+    S = jax.ShapeDtypeStruct
+    shapes = {
+        "ln1": S((h,), dtype),
+        "wq": S((h, hq * d), dtype),
+        "wk": S((h, hkv * d), dtype),
+        "wv": S((h, hkv * d), dtype),
+        "wo": S((hq * d, h), dtype),
+        "ln2": S((h,), dtype),
+        "wg": S((h, m), dtype),
+        "wu": S((h, m), dtype),
+        "wd": S((m, h), dtype),
+    }
+    if cfg.attention_bias:
+        shapes["bq"] = S((hq * d,), dtype)
+        shapes["bk"] = S((hkv * d,), dtype)
+        shapes["bv"] = S((hkv * d,), dtype)
+        shapes["bo"] = S((h,), dtype)
+    if cfg.mlp_bias:
+        shapes["bg"] = S((m,), dtype)
+        shapes["bu"] = S((m,), dtype)
+        shapes["bd"] = S((h,), dtype)
+    return shapes
+
+
+FAMILY = register_family(
+    ModelFamily(
+        name="llama",
+        config_from_hf=LlamaBlockConfig.from_hf_config,
+        block_apply=block_apply,
+        hf_block_prefixes=_HF_BLOCK_PREFIXES,
+        hf_to_block_params=hf_to_block_params,
+        block_param_shapes=block_param_shapes,
+    )
+)

@@ -1,0 +1,46 @@
+"""Model-family registry (counterpart of reference src/petals/utils/auto_config.py:22-52,
+which dispatches on HF ``config.model_type``).
+
+Each family registers a ``ModelFamily`` describing how to build block configs,
+apply a block, and map HF checkpoint tensors to our parameter trees.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+_FAMILIES: Dict[str, "ModelFamily"] = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelFamily:
+    """Everything the framework needs to serve/consume one model family."""
+
+    name: str  # HF model_type, e.g. "llama"
+    config_from_hf: Callable[[Any], Any]  # HF PretrainedConfig -> BlockConfig
+    block_apply: Callable  # (params, hidden, kv, position, cfg, ...) -> (hidden, kv)
+    hf_block_prefixes: tuple  # checkpoint prefixes of block i, with {i} placeholder
+    hf_to_block_params: Callable  # (dict[str, np.ndarray], cfg) -> params pytree
+    block_param_shapes: Optional[Callable] = None  # cfg -> pytree of jax.ShapeDtypeStruct
+    # Client-side (embeddings + head) loading, filled in by model.py modules:
+    hf_client_prefixes: tuple = ()
+    hf_to_client_params: Optional[Callable] = None
+    client_forward: Optional[Callable] = None
+
+
+def register_family(family: ModelFamily) -> ModelFamily:
+    _FAMILIES[family.name] = family
+    return family
+
+
+def get_family(model_type: str) -> ModelFamily:
+    if model_type not in _FAMILIES:
+        raise KeyError(
+            f"Unsupported model family {model_type!r}; known: {sorted(_FAMILIES)}"
+        )
+    return _FAMILIES[model_type]
+
+
+def known_families() -> tuple:
+    return tuple(sorted(_FAMILIES))

@@ -1,0 +1,151 @@
+"""Load exactly one transformer block's weights from an HF checkpoint
+(counterpart of reference src/petals/server/from_pretrained.py:35-224).
+
+The reference streams single-block shards from the HF Hub with retries and LRU
+disk eviction; this build reads local checkpoint directories (safetensors
+preferred, torch .bin fallback) and selects only the tensors belonging to the
+requested block — the same "load one block, not the model" capability. Hub
+download plumbing can be layered on via huggingface_hub when egress exists.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from petals_tpu.models.registry import ModelFamily, get_family
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+SAFE_INDEX = "model.safetensors.index.json"
+SAFE_SINGLE = "model.safetensors"
+BIN_INDEX = "pytorch_model.bin.index.json"
+BIN_SINGLE = "pytorch_model.bin"
+
+
+def resolve_model_path(model_name_or_path: str) -> str:
+    """Local directory only (zero-egress build); extend with hub download later."""
+    if os.path.isdir(model_name_or_path):
+        return model_name_or_path
+    raise FileNotFoundError(
+        f"{model_name_or_path!r} is not a local directory; hub downloads are not "
+        f"enabled in this environment"
+    )
+
+
+def load_hf_config(model_name_or_path: str):
+    from transformers import AutoConfig
+
+    return AutoConfig.from_pretrained(resolve_model_path(model_name_or_path))
+
+
+def get_block_config(model_name_or_path: str) -> Tuple[ModelFamily, object]:
+    hf_config = load_hf_config(model_name_or_path)
+    family = get_family(hf_config.model_type)
+    return family, family.config_from_hf(hf_config)
+
+
+def _index_weight_files(path: str) -> Dict[str, str]:
+    """Return {tensor_name: filename} for the checkpoint at ``path``."""
+    index_file = os.path.join(path, SAFE_INDEX)
+    if os.path.exists(index_file):
+        with open(index_file) as f:
+            return json.load(f)["weight_map"]
+    index_file = os.path.join(path, BIN_INDEX)
+    if os.path.exists(index_file):
+        with open(index_file) as f:
+            return json.load(f)["weight_map"]
+    for single in (SAFE_SINGLE, BIN_SINGLE):
+        fpath = os.path.join(path, single)
+        if os.path.exists(fpath):
+            return {"*": single}
+    raise FileNotFoundError(f"No weight files found in {path}")
+
+
+def _load_tensors_with_prefixes(path: str, prefixes: tuple) -> Dict[str, np.ndarray]:
+    """Read only tensors whose name starts with one of ``prefixes`` (names
+    returned relative to the matching prefix). All candidate prefixes are
+    checked in a single pass so each weight file is opened at most once
+    (safetensors lazily; .bin state dicts deserialized exactly once —
+    reference from_pretrained.py:81-128 semantics)."""
+    weight_map = _index_weight_files(path)
+
+    def match(name: str) -> Optional[str]:
+        for prefix in prefixes:
+            if name.startswith(prefix):
+                return name[len(prefix):]
+        return None
+
+    if "*" in weight_map:
+        files = {weight_map["*"]}
+    else:
+        files = {fname for name, fname in weight_map.items() if match(name) is not None}
+
+    out: Dict[str, np.ndarray] = {}
+    for fname in sorted(files):
+        fpath = os.path.join(path, fname)
+        if fname.endswith(".safetensors"):
+            from safetensors import safe_open
+
+            with safe_open(fpath, framework="pt") as f:
+                for name in f.keys():
+                    rel = match(name)
+                    if rel is not None:
+                        out[rel] = _torch_to_numpy(f.get_tensor(name))
+        else:
+            import torch
+
+            state = torch.load(fpath, map_location="cpu", weights_only=True)
+            for name, tensor in state.items():
+                rel = match(name)
+                if rel is not None:
+                    out[rel] = _torch_to_numpy(tensor)
+    return out
+
+
+def _torch_to_numpy(tensor) -> np.ndarray:
+    """torch -> numpy, keeping bf16 bit-exact via ml_dtypes (numpy itself has
+    no bfloat16; a float32 round-trip would be lossless but 2x the memory)."""
+    import torch
+
+    if tensor.dtype == torch.bfloat16:
+        import ml_dtypes
+
+        return tensor.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return tensor.numpy()
+
+
+def load_block_params(
+    model_name_or_path: str,
+    block_index: int,
+    *,
+    dtype=jnp.bfloat16,
+    device: Optional[jax.Device] = None,
+    family: Optional[ModelFamily] = None,
+    cfg=None,
+) -> dict:
+    """Load block ``block_index`` and return our parameter pytree on device."""
+    path = resolve_model_path(model_name_or_path)
+    if family is None or cfg is None:
+        family, cfg = get_block_config(path)
+
+    prefixes = tuple(tpl.format(i=block_index) for tpl in family.hf_block_prefixes)
+    tensors = _load_tensors_with_prefixes(path, prefixes)
+    if not tensors:
+        raise KeyError(
+            f"Block {block_index} not found in {path} under prefixes "
+            f"{[p.format(i=block_index) for p in family.hf_block_prefixes]}"
+        )
+
+    params = family.hf_to_block_params(tensors, cfg)
+    cast = lambda x: jnp.asarray(x, dtype) if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x)
+    params = jax.tree_util.tree_map(cast, params)
+    if device is not None:
+        params = jax.device_put(params, device)
+    return params

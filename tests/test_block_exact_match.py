@@ -1,0 +1,121 @@
+"""Block-level exactness vs the HF torch reference (port of reference
+tests/test_block_exact_match.py:78-108 — forward and incremental inference
+must match a local HF model within tight tolerances)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from petals_tpu.server.from_pretrained import get_block_config, load_block_params
+from tests.utils import make_tiny_bloom, make_tiny_llama
+
+ATOL_FORWARD = 1e-4
+ATOL_INFERENCE = 1e-4
+
+
+@pytest.fixture(scope="module")
+def tiny_llama(tmp_path_factory):
+    return make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+
+
+@pytest.fixture(scope="module")
+def tiny_bloom(tmp_path_factory):
+    return make_tiny_bloom(str(tmp_path_factory.mktemp("models")))
+
+
+@pytest.fixture(scope="module")
+def tiny_llama_biased(tmp_path_factory):
+    return make_tiny_llama(str(tmp_path_factory.mktemp("models")), n_layers=2, biased=True)
+
+
+def _hf_hidden_states(model_path, input_ids):
+    """Run the HF model, returning the hidden states entering/leaving each block.
+
+    Uses forward hooks on the decoder layers: HF's ``output_hidden_states``
+    applies the final norm to the last entry, which would poison the last-block
+    comparison."""
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(model_path, dtype=torch.float32).eval()
+    decoder = model.model if hasattr(model, "model") else model.transformer
+    layers = decoder.layers if hasattr(decoder, "layers") else decoder.h
+    captured = []
+
+    def hook(_module, _inputs, output):
+        captured.append((output[0] if isinstance(output, tuple) else output).detach().numpy())
+
+    handles = [layer.register_forward_hook(hook) for layer in layers]
+    try:
+        with torch.no_grad():
+            out = model(input_ids, output_hidden_states=True)
+    finally:
+        for h in handles:
+            h.remove()
+    embeddings = out.hidden_states[0].numpy()
+    return [embeddings] + captured
+
+
+@pytest.mark.parametrize("model_fixture", ["tiny_llama", "tiny_bloom", "tiny_llama_biased"])
+def test_block_forward_exact_match(model_fixture, request):
+    model_path = request.getfixturevalue(model_fixture)
+    family, cfg = get_block_config(model_path)
+
+    torch.manual_seed(42)
+    input_ids = torch.randint(0, 100, (2, 16))
+    hiddens = _hf_hidden_states(model_path, input_ids)
+
+    for block_index in range(cfg.num_hidden_layers):
+        params = load_block_params(model_path, block_index, dtype=jnp.float32)
+        ours, _ = family.block_apply(
+            params, jnp.asarray(hiddens[block_index]), None, 0, cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(ours),
+            hiddens[block_index + 1],
+            atol=ATOL_FORWARD,
+            rtol=0,
+            err_msg=f"{model_fixture} block {block_index} diverged from HF",
+        )
+
+
+@pytest.mark.parametrize("model_fixture", ["tiny_llama", "tiny_bloom"])
+def test_block_inference_with_cache_matches_forward(model_fixture, request):
+    """Chunked prefill + token-by-token decode through the KV cache must equal
+    one full forward (reference test_block_exact_match.py inference path)."""
+    model_path = request.getfixturevalue(model_fixture)
+    family, cfg = get_block_config(model_path)
+    params = load_block_params(model_path, 0, dtype=jnp.float32)
+
+    rng = np.random.RandomState(0)
+    batch, total = 2, 12
+    hidden = jnp.asarray(rng.randn(batch, total, cfg.hidden_size), jnp.float32)
+
+    full, _ = family.block_apply(params, hidden, None, 0, cfg)
+
+    max_len = 16
+    hkv = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
+    kv = (
+        jnp.zeros((batch, max_len, hkv, cfg.head_dim), jnp.float32),
+        jnp.zeros((batch, max_len, hkv, cfg.head_dim), jnp.float32),
+    )
+
+    outputs = []
+    position = 0
+    for chunk in (hidden[:, :5], hidden[:, 5:6], hidden[:, 6:7], hidden[:, 7:]):
+        out, kv = family.block_apply(params, chunk, kv, position, cfg)
+        outputs.append(np.asarray(out))
+        position += chunk.shape[1]
+
+    stitched = np.concatenate(outputs, axis=1)
+    np.testing.assert_allclose(stitched, np.asarray(full), atol=ATOL_INFERENCE, rtol=0)
+
+
+def test_block_loader_rejects_missing_block(tiny_llama):
+    with pytest.raises(KeyError):
+        load_block_params(tiny_llama, 99)
+
+
+def test_bf16_load(tiny_llama):
+    params = load_block_params(tiny_llama, 0, dtype=jnp.bfloat16)
+    assert params["wq"].dtype == jnp.bfloat16

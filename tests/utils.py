@@ -1,0 +1,56 @@
+"""Tiny random HF checkpoints saved to disk — the test swarm's "models"
+(zero-egress stand-in for the reference CI's bloom-560m / TinyLlama downloads,
+reference .github/workflows/run-tests.yaml:10-20)."""
+
+import os
+
+import torch
+
+
+def make_tiny_llama(
+    tmpdir: str, *, n_layers: int = 4, vocab: int = 128, biased: bool = False
+) -> str:
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=n_layers,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        attention_bias=biased,
+        mlp_bias=biased,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(cfg).eval()
+    if biased:  # random biases (default init is zeros, which would hide bugs)
+        with torch.no_grad():
+            for name, p in model.named_parameters():
+                if name.endswith(".bias"):
+                    p.normal_(0, 0.1)
+    path = os.path.join(tmpdir, "tiny-llama-biased" if biased else "tiny-llama")
+    model.save_pretrained(path, safe_serialization=True)
+    return path
+
+
+def make_tiny_bloom(tmpdir: str, *, n_layers: int = 3, vocab: int = 128) -> str:
+    from transformers import BloomConfig, BloomForCausalLM
+
+    cfg = BloomConfig(
+        vocab_size=vocab,
+        hidden_size=64,
+        n_head=4,
+        n_layer=n_layers,
+        layer_norm_epsilon=1e-5,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(1)
+    model = BloomForCausalLM(cfg).eval()
+    path = os.path.join(tmpdir, "tiny-bloom")
+    model.save_pretrained(path, safe_serialization=True)
+    return path
