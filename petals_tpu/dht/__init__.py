@@ -1,0 +1,4 @@
+from petals_tpu.dht.node import DHTNode
+from petals_tpu.dht.routing import PeerAddr
+
+__all__ = ["DHTNode", "PeerAddr"]

@@ -1,0 +1,102 @@
+"""Kademlia routing table: XOR metric over 256-bit peer ids, k-buckets.
+
+This build replaces hivemind's libp2p/Go-daemon DHT (reference SURVEY.md §2.3,
+L0) with an in-framework Kademlia over the asyncio RPC transport. The directory
+semantics the reference builds on top (store_many with subkeys + expirations,
+reference src/petals/utils/dht.py:28-131) are implemented in dht/storage.py and
+dht/node.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+from petals_tpu.data_structures import PeerID
+
+KEY_BITS = 256
+DEFAULT_BUCKET_SIZE = 20
+
+
+@dataclasses.dataclass(frozen=True)
+class PeerAddr:
+    """Contact info of a DHT peer. Textual form: host:port/peer_id_hex
+    (the framework's "multiaddr")."""
+
+    host: str
+    port: int
+    peer_id: PeerID
+
+    def to_string(self) -> str:
+        return f"{self.host}:{self.port}/{self.peer_id.to_string()}"
+
+    @classmethod
+    def from_string(cls, s: str) -> "PeerAddr":
+        hostport, peer_hex = s.rsplit("/", 1)
+        host, port = hostport.rsplit(":", 1)
+        return cls(host=host, port=int(port), peer_id=PeerID.from_string(peer_hex))
+
+    def to_wire(self) -> list:
+        return [self.host, self.port, self.peer_id.to_string()]
+
+    @classmethod
+    def from_wire(cls, obj) -> "PeerAddr":
+        return cls(host=obj[0], port=int(obj[1]), peer_id=PeerID.from_string(obj[2]))
+
+
+def xor_distance(a: PeerID, b: PeerID) -> int:
+    return int.from_bytes(a.to_bytes(), "big") ^ int.from_bytes(b.to_bytes(), "big")
+
+
+def bucket_index(own: PeerID, other: PeerID) -> int:
+    """Index = position of the highest differing bit (0 if ids are equal)."""
+    dist = xor_distance(own, other)
+    return dist.bit_length() - 1 if dist > 0 else 0
+
+
+@dataclasses.dataclass
+class _Contact:
+    addr: PeerAddr
+    last_seen: float
+
+
+class RoutingTable:
+    def __init__(self, own_id: PeerID, bucket_size: int = DEFAULT_BUCKET_SIZE):
+        self.own_id = own_id
+        self.bucket_size = bucket_size
+        self._buckets: Dict[int, Dict[PeerID, _Contact]] = {}
+
+    def add(self, addr: PeerAddr) -> None:
+        if addr.peer_id == self.own_id:
+            return
+        idx = bucket_index(self.own_id, addr.peer_id)
+        bucket = self._buckets.setdefault(idx, {})
+        if addr.peer_id in bucket or len(bucket) < self.bucket_size:
+            bucket[addr.peer_id] = _Contact(addr, time.monotonic())
+        else:
+            # Full bucket: replace the stalest contact (simplified eviction;
+            # classic Kademlia pings it first — failures also evict via remove()).
+            stalest = min(bucket, key=lambda pid: bucket[pid].last_seen)
+            del bucket[stalest]
+            bucket[addr.peer_id] = _Contact(addr, time.monotonic())
+
+    def remove(self, peer_id: PeerID) -> None:
+        idx = bucket_index(self.own_id, peer_id)
+        self._buckets.get(idx, {}).pop(peer_id, None)
+
+    def get(self, peer_id: PeerID) -> Optional[PeerAddr]:
+        idx = bucket_index(self.own_id, peer_id)
+        contact = self._buckets.get(idx, {}).get(peer_id)
+        return contact.addr if contact else None
+
+    def nearest(self, target: PeerID, k: int) -> List[PeerAddr]:
+        contacts = [c.addr for bucket in self._buckets.values() for c in bucket.values()]
+        contacts.sort(key=lambda a: xor_distance(a.peer_id, target))
+        return contacts[:k]
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self._buckets.values())
+
+    def all_peers(self) -> List[PeerAddr]:
+        return [c.addr for bucket in self._buckets.values() for c in bucket.values()]

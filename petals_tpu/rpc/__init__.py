@@ -1,0 +1,16 @@
+from petals_tpu.rpc.client import RpcClient
+from petals_tpu.rpc.serialization import (
+    CompressionType,
+    deserialize_array,
+    serialize_array,
+)
+from petals_tpu.rpc.server import RpcServer, RpcError
+
+__all__ = [
+    "RpcClient",
+    "RpcServer",
+    "RpcError",
+    "CompressionType",
+    "serialize_array",
+    "deserialize_array",
+]

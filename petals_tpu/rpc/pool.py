@@ -1,0 +1,56 @@
+"""Connection pool: one multiplexed RpcClient per remote address, created on
+demand and discarded on failure (the swarm equivalent of hivemind's cached
+p2p stubs)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional, Tuple
+
+from petals_tpu.data_structures import PeerID
+from petals_tpu.rpc.client import RpcClient
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ConnectionPool:
+    def __init__(self, own_peer_id: Optional[PeerID] = None, connect_timeout: float = 10.0):
+        self.own_peer_id = own_peer_id
+        self.connect_timeout = connect_timeout
+        self._clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._locks: Dict[Tuple[str, int], asyncio.Lock] = {}
+
+    async def get(self, host: str, port: int) -> RpcClient:
+        key = (host, port)
+        lock = self._locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            client = self._clients.get(key)
+            if client is not None and not client._closed:
+                return client
+            client = await RpcClient.connect(
+                host, port, peer_id=self.own_peer_id, timeout=self.connect_timeout
+            )
+            self._clients[key] = client
+            return client
+
+    def invalidate(self, host: str, port: int) -> None:
+        client = self._clients.pop((host, port), None)
+        if client is not None:
+            # close in the background: invalidate() is called from sync contexts
+            asyncio.ensure_future(self._close_quietly(client))
+
+    @staticmethod
+    async def _close_quietly(client: RpcClient) -> None:
+        try:
+            await client.close()
+        except Exception:
+            pass
+
+    async def close(self) -> None:
+        clients, self._clients = list(self._clients.values()), {}
+        for client in clients:
+            try:
+                await client.close()
+            except Exception:
+                pass

@@ -1,0 +1,51 @@
+"""Wire protocol: length-prefixed msgpack frames over asyncio TCP streams.
+
+This is the swarm's inter-host data plane (the role libp2p streams play in the
+reference — SURVEY.md §5.8). One TCP connection multiplexes many concurrent
+calls; each call has a connection-local id. Message kinds:
+
+  {"t": "hello", "peer_id": hex}                      — sent once by each side
+  {"t": "req",  "id", "method", "payload"}            — unary request
+  {"t": "resp", "id", "ok", "payload"|"error"}        — unary response / stream abort
+  {"t": "sopen", "id", "method"}                      — open bidirectional stream
+  {"t": "sitem", "id", "payload"}                     — stream item (either way)
+  {"t": "send",  "id"}                                — half-close (either way)
+  {"t": "cancel", "id"}                               — cancel in-flight call
+
+Frames: 4-byte big-endian length + msgpack body. Payload tensors ride as
+msgpack bin (see rpc/serialization.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any
+
+import msgpack
+
+MAX_FRAME_BYTES = 1 << 30  # 1 GiB hard cap; large tensors stream in chunks far below this
+DEFAULT_CHUNK_BYTES = 4 << 20  # split tensors into ~4 MiB stream items
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(4)
+    (length,) = struct.unpack(">I", header)
+    if length > MAX_FRAME_BYTES:
+        raise ValueError(f"Frame of {length} bytes exceeds the {MAX_FRAME_BYTES} byte cap")
+    body = await reader.readexactly(length)
+    return msgpack.unpackb(body, raw=False, strict_map_key=False)
+
+
+def encode_frame(message: Any) -> bytes:
+    body = msgpack.packb(message, use_bin_type=True)
+    if len(body) > MAX_FRAME_BYTES:
+        raise ValueError(f"Frame of {len(body)} bytes exceeds the {MAX_FRAME_BYTES} byte cap")
+    return struct.pack(">I", len(body)) + body
+
+
+async def write_frame(writer: asyncio.StreamWriter, message: Any, lock: asyncio.Lock) -> None:
+    frame = encode_frame(message)
+    async with lock:  # interleaving-safe: one frame at a time per connection
+        writer.write(frame)
+        await writer.drain()

@@ -1,0 +1,195 @@
+"""Asyncio RPC server: unary + bidirectional-streaming methods over the framed
+msgpack protocol (the role of hivemind's ServicerBase/ConnectionHandler RPC
+surface in the reference — src/petals/server/handler.py:55 serves 7 such
+methods; this server hosts them all in one process).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import traceback
+from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional
+
+from petals_tpu.data_structures import PeerID
+from petals_tpu.rpc.protocol import read_frame, write_frame
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_END = object()
+
+
+class RpcError(Exception):
+    """Error raised on the caller when the remote handler failed."""
+
+
+@dataclasses.dataclass
+class RpcContext:
+    local_peer_id: Optional[PeerID]
+    remote_peer_id: Optional[PeerID]
+    remote_addr: tuple
+
+
+UnaryHandler = Callable[[Any, RpcContext], Awaitable[Any]]
+StreamHandler = Callable[[AsyncIterator[Any], RpcContext], AsyncIterator[Any]]
+
+
+class RpcServer:
+    def __init__(self, peer_id: Optional[PeerID] = None, host: str = "127.0.0.1", port: int = 0):
+        self.peer_id = peer_id
+        self.host, self._requested_port = host, port
+        self._unary: Dict[str, UnaryHandler] = {}
+        self._stream: Dict[str, StreamHandler] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conn_tasks: set = set()
+
+    def add_unary_handler(self, method: str, fn: UnaryHandler) -> None:
+        self._unary[method] = fn
+
+    def add_stream_handler(self, method: str, fn: StreamHandler) -> None:
+        self._stream[method] = fn
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_connection, self.host, self._requested_port)
+        logger.debug(f"RpcServer listening on {self.listen_addr}")
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None, "server not started"
+        return self._server.sockets[0].getsockname()[1]
+
+    @property
+    def listen_addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        # Cancel live connections BEFORE wait_closed(): since py3.12 wait_closed
+        # also waits for active connection handlers to finish.
+        for task in list(self._conn_tasks):
+            task.cancel()
+        if self._conn_tasks:
+            await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+        if self._server is not None:
+            await self._server.wait_closed()
+
+    # ------------------------------------------------------------------ connection
+
+    async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        task = asyncio.current_task()
+        self._conn_tasks.add(task)
+        write_lock = asyncio.Lock()
+        call_tasks: Dict[int, asyncio.Task] = {}
+        inbound_queues: Dict[int, asyncio.Queue] = {}
+        ctx = RpcContext(
+            local_peer_id=self.peer_id,
+            remote_peer_id=None,
+            remote_addr=writer.get_extra_info("peername") or ("?", 0),
+        )
+        try:
+            await write_frame(
+                writer,
+                {"t": "hello", "peer_id": self.peer_id.to_string() if self.peer_id else None},
+                write_lock,
+            )
+            while True:
+                msg = await read_frame(reader)
+                kind = msg.get("t")
+                if kind == "hello":
+                    if msg.get("peer_id"):
+                        ctx.remote_peer_id = PeerID.from_string(msg["peer_id"])
+                elif kind == "req":
+                    call_tasks[msg["id"]] = asyncio.create_task(
+                        self._run_unary(msg, ctx, writer, write_lock, call_tasks)
+                    )
+                elif kind == "sopen":
+                    queue: asyncio.Queue = asyncio.Queue()
+                    inbound_queues[msg["id"]] = queue
+                    call_tasks[msg["id"]] = asyncio.create_task(
+                        self._run_stream(msg, queue, ctx, writer, write_lock, call_tasks, inbound_queues)
+                    )
+                elif kind == "sitem":
+                    queue = inbound_queues.get(msg["id"])
+                    if queue is not None:
+                        queue.put_nowait(msg.get("payload"))
+                elif kind == "send":
+                    queue = inbound_queues.get(msg["id"])
+                    if queue is not None:
+                        queue.put_nowait(_END)
+                elif kind == "cancel":
+                    task_to_cancel = call_tasks.get(msg["id"])
+                    if task_to_cancel is not None:
+                        task_to_cancel.cancel()
+                else:
+                    logger.warning(f"Unknown frame kind {kind!r} from {ctx.remote_addr}")
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
+            pass  # remote disconnected
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception(f"Connection loop failed for {ctx.remote_addr}")
+        finally:
+            for call_task in call_tasks.values():
+                call_task.cancel()
+            if call_tasks:
+                await asyncio.gather(*call_tasks.values(), return_exceptions=True)
+            writer.close()
+            self._conn_tasks.discard(task)
+
+    async def _run_unary(self, msg, ctx, writer, write_lock, call_tasks):
+        call_id = msg["id"]
+        try:
+            handler = self._unary.get(msg.get("method"))
+            if handler is None:
+                raise RpcError(f"Unknown unary method {msg.get('method')!r}")
+            result = await handler(msg.get("payload"), ctx)
+            await write_frame(writer, {"t": "resp", "id": call_id, "ok": True, "payload": result}, write_lock)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.debug(f"Unary {msg.get('method')} failed: {e}\n{traceback.format_exc()}")
+            try:
+                await write_frame(
+                    writer, {"t": "resp", "id": call_id, "ok": False, "error": _format_error(e)}, write_lock
+                )
+            except (ConnectionError, RuntimeError):
+                pass
+        finally:
+            call_tasks.pop(call_id, None)
+
+    async def _run_stream(self, msg, queue, ctx, writer, write_lock, call_tasks, inbound_queues):
+        call_id = msg["id"]
+
+        async def request_iter():
+            while True:
+                item = await queue.get()
+                if item is _END:
+                    return
+                yield item
+
+        try:
+            handler = self._stream.get(msg.get("method"))
+            if handler is None:
+                raise RpcError(f"Unknown stream method {msg.get('method')!r}")
+            async for item in handler(request_iter(), ctx):
+                await write_frame(writer, {"t": "sitem", "id": call_id, "payload": item}, write_lock)
+            await write_frame(writer, {"t": "send", "id": call_id}, write_lock)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.debug(f"Stream {msg.get('method')} failed: {e}\n{traceback.format_exc()}")
+            try:
+                await write_frame(
+                    writer, {"t": "resp", "id": call_id, "ok": False, "error": _format_error(e)}, write_lock
+                )
+            except (ConnectionError, RuntimeError):
+                pass
+        finally:
+            call_tasks.pop(call_id, None)
+            inbound_queues.pop(call_id, None)
+
+
+def _format_error(e: Exception) -> str:
+    return f"{type(e).__name__}: {e}"

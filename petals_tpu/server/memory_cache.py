@@ -1,0 +1,186 @@
+"""Server-wide KV-cache budget allocator, HBM edition
+(counterpart of reference src/petals/server/memory_cache.py:26-225).
+
+The reference spreads this across processes (shared-memory counters, mp.Pipe
+handler->runtime protocol) because torch servers fork one process per
+connection handler. A JAX/TPU server is one process that owns the device, so
+the same contract collapses to asyncio:
+
+- ``allocate_cache(*descriptors, timeout=...)`` — async context manager that
+  reserves budget and yields integer handles; oversubscribed requests QUEUE
+  (FIFO) until space frees or the timeout elapses (AllocationFailed).
+- ``use_cache(*handles)`` — context manager for the compute side yielding the
+  device buffers; buffers are created lazily (zeros in HBM) on first use and
+  replaced functionally after each step via ``update_cache`` (XLA donation
+  makes this in-place at the buffer level).
+
+Handles survive across RPC calls so an inference session touches its KV by
+integer id only — exactly the reference's cross-process contract, minus the
+processes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import dataclasses
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from petals_tpu.data_structures import Handle
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class AllocationFailed(Exception):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorDescriptor:
+    shape: Tuple[int, ...]
+    dtype: jnp.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape)) * jnp.dtype(self.dtype).itemsize
+
+    def make_zeros(self, device: Optional[jax.Device] = None) -> jax.Array:
+        arr = jnp.zeros(self.shape, self.dtype)
+        return jax.device_put(arr, device) if device is not None else arr
+
+
+class MemoryCache:
+    """Budgeted handle-based allocator for session KV buffers in HBM."""
+
+    def __init__(self, max_size_bytes: Optional[int], max_alloc_timeout: Optional[float] = None):
+        self.max_size_bytes = max_size_bytes if max_size_bytes is not None else 2**64
+        self.max_alloc_timeout = max_alloc_timeout
+        self._current_size_bytes = 0
+        self._handle_counter = 0
+        self._allocated: Dict[Handle, TensorDescriptor] = {}
+        self._buffers: Dict[Handle, Optional[jax.Array]] = {}
+        self._lock = asyncio.Lock()
+        self._freed_event = asyncio.Event()
+        self._waiter_queue: list = []  # FIFO fairness for oversubscribed allocs
+
+    @property
+    def current_size_bytes(self) -> int:
+        return self._current_size_bytes
+
+    @property
+    def bytes_left(self) -> int:
+        return self.max_size_bytes - self._current_size_bytes
+
+    @property
+    def num_allocated(self) -> int:
+        return len(self._allocated)
+
+    @contextlib.asynccontextmanager
+    async def allocate_cache(self, *descriptors: TensorDescriptor, timeout: Optional[float] = None):
+        """Reserve budget for ``descriptors``; yield one handle per descriptor."""
+        if self.max_alloc_timeout is not None:
+            timeout = self.max_alloc_timeout if timeout is None else min(timeout, self.max_alloc_timeout)
+        alloc_size = sum(d.nbytes for d in descriptors)
+        if alloc_size > self.max_size_bytes:
+            raise AllocationFailed(
+                f"Cannot allocate {alloc_size} bytes: exceeds total cache size "
+                f"{self.max_size_bytes} bytes"
+            )
+
+        alloc_task = asyncio.create_task(self._wait_and_reserve(descriptors, alloc_size, timeout))
+        try:
+            handles = await alloc_task
+            yield handles
+        finally:
+            # Cancellation while *waiting* aborts cleanly (nothing reserved yet);
+            # if the reservation raced to completion anyway, free it here.
+            if not alloc_task.done():
+                alloc_task.cancel()
+                with contextlib.suppress(asyncio.CancelledError, AllocationFailed):
+                    await alloc_task
+            if alloc_task.done() and not alloc_task.cancelled() and alloc_task.exception() is None:
+                self._free(alloc_task.result())
+
+    async def _wait_and_reserve(
+        self, descriptors: Sequence[TensorDescriptor], alloc_size: int, timeout: Optional[float]
+    ) -> Tuple[Handle, ...]:
+        start = time.monotonic()
+        my_turn = asyncio.Event()
+        self._waiter_queue.append(my_turn)
+        if len(self._waiter_queue) == 1:
+            my_turn.set()
+        try:
+            while True:
+                if self._waiter_queue and self._waiter_queue[0] is my_turn:
+                    my_turn.set()
+                if my_turn.is_set():
+                    async with self._lock:
+                        # re-check under the lock: acquiring it may have yielded
+                        if alloc_size <= self.bytes_left:
+                            return self._reserve(descriptors, alloc_size)
+                remaining = None if timeout is None else timeout - (time.monotonic() - start)
+                if remaining is not None and remaining <= 0:
+                    raise AllocationFailed(
+                        f"Could not allocate {alloc_size} bytes within {timeout} s "
+                        f"({self.bytes_left} of {self.max_size_bytes} bytes free, "
+                        f"{len(self._waiter_queue) - 1} waiters ahead)"
+                    )
+                self._freed_event.clear()
+                try:
+                    await asyncio.wait_for(self._freed_event.wait(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    pass  # loop once more to produce the AllocationFailed message
+        finally:
+            self._waiter_queue.remove(my_turn)
+            self._freed_event.set()  # let the next waiter re-check its turn
+
+    def _reserve(self, descriptors: Sequence[TensorDescriptor], alloc_size: int) -> Tuple[Handle, ...]:
+        handles = []
+        for descr in descriptors:
+            handle = self._handle_counter
+            self._handle_counter += 1
+            self._allocated[handle] = descr
+            self._buffers[handle] = None  # lazily materialized by use_cache
+            handles.append(handle)
+        self._current_size_bytes += alloc_size
+        logger.debug(f"Allocated {alloc_size} bytes, handles={handles}; left={self.bytes_left}")
+        return tuple(handles)
+
+    def _free(self, handles: Sequence[Handle]) -> None:
+        freed = 0
+        for handle in handles:
+            descr = self._allocated.pop(handle, None)
+            if descr is not None:
+                freed += descr.nbytes
+            self._buffers.pop(handle, None)  # drops the HBM buffer reference
+        self._current_size_bytes -= freed
+        self._freed_event.set()
+        logger.debug(f"Freed {freed} bytes, handles={list(handles)}; left={self.bytes_left}")
+
+    @contextlib.contextmanager
+    def use_cache(self, *handles: Handle, device: Optional[jax.Device] = None):
+        """Compute-side access: yields the list of device buffers for ``handles``,
+        materializing zeros on first touch."""
+        buffers = []
+        for handle in handles:
+            if handle not in self._allocated:
+                raise KeyError(f"Handle {handle} was not allocated (or already freed)")
+            if self._buffers[handle] is None:
+                self._buffers[handle] = self._allocated[handle].make_zeros(device)
+            buffers.append(self._buffers[handle])
+        yield buffers
+
+    def update_cache(self, handle: Handle, new_buffer: jax.Array) -> None:
+        """Store the post-step buffer for ``handle`` (functional update; pair with
+        XLA donation so the HBM allocation is reused)."""
+        if handle not in self._allocated:
+            raise KeyError(f"Handle {handle} was not allocated (or already freed)")
+        descr = self._allocated[handle]
+        assert tuple(new_buffer.shape) == tuple(descr.shape), (new_buffer.shape, descr.shape)
+        self._buffers[handle] = new_buffer

@@ -1,0 +1,187 @@
+"""MemoryCache semantics (port of reference tests/test_cache.py: alloc/free
+accounting, timeout, FIFO queueing, oversized rejection)."""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from petals_tpu.server.memory_cache import AllocationFailed, MemoryCache, TensorDescriptor
+
+KB = TensorDescriptor((256,), jnp.float32)  # 1 KiB
+assert KB.nbytes == 1024
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_basic_alloc_free_accounting():
+    async def main():
+        cache = MemoryCache(max_size_bytes=4096)
+        async with cache.allocate_cache(KB, KB) as handles:
+            assert len(handles) == 2
+            assert cache.current_size_bytes == 2048
+            assert cache.bytes_left == 2048
+        assert cache.current_size_bytes == 0
+        assert cache.num_allocated == 0
+
+    run(main())
+
+
+def test_oversized_allocation_rejected_immediately():
+    async def main():
+        cache = MemoryCache(max_size_bytes=1024)
+        with pytest.raises(AllocationFailed, match="exceeds total cache size"):
+            async with cache.allocate_cache(KB, KB):
+                pass
+
+    run(main())
+
+
+def test_allocation_timeout():
+    async def main():
+        cache = MemoryCache(max_size_bytes=1024)
+        async with cache.allocate_cache(KB):
+            with pytest.raises(AllocationFailed, match="Could not allocate"):
+                async with cache.allocate_cache(KB, timeout=0.1):
+                    pass
+
+    run(main())
+
+
+def test_max_alloc_timeout_caps_requested_timeout():
+    async def main():
+        cache = MemoryCache(max_size_bytes=1024, max_alloc_timeout=0.1)
+        async with cache.allocate_cache(KB):
+            start = asyncio.get_event_loop().time()
+            with pytest.raises(AllocationFailed):
+                async with cache.allocate_cache(KB, timeout=30.0):
+                    pass
+            assert asyncio.get_event_loop().time() - start < 5.0
+
+    run(main())
+
+
+def test_queued_allocation_proceeds_when_freed():
+    async def main():
+        cache = MemoryCache(max_size_bytes=1024)
+        order = []
+
+        async def holder():
+            async with cache.allocate_cache(KB):
+                order.append("held")
+                await asyncio.sleep(0.2)
+            order.append("released")
+
+        async def waiter():
+            await asyncio.sleep(0.05)  # ensure holder goes first
+            async with cache.allocate_cache(KB, timeout=5.0):
+                order.append("acquired")
+
+        await asyncio.gather(holder(), waiter())
+        assert order == ["held", "released", "acquired"]
+
+    run(main())
+
+
+def test_fifo_fairness():
+    """A large request queued first must not be starved by later small ones."""
+
+    async def main():
+        cache = MemoryCache(max_size_bytes=2048)
+        order = []
+
+        async def holder():
+            async with cache.allocate_cache(KB, KB):
+                await asyncio.sleep(0.2)
+
+        async def big_then_small():
+            await asyncio.sleep(0.05)
+
+            async def big():
+                async with cache.allocate_cache(KB, KB, timeout=5.0):
+                    order.append("big")
+                    await asyncio.sleep(0.1)
+
+            async def small():
+                await asyncio.sleep(0.05)  # joins the queue after `big`
+                async with cache.allocate_cache(KB, timeout=5.0):
+                    order.append("small")
+
+            await asyncio.gather(big(), small())
+
+        await asyncio.gather(holder(), big_then_small())
+        assert order == ["big", "small"]
+
+    run(main())
+
+
+def test_use_cache_and_update():
+    async def main():
+        cache = MemoryCache(max_size_bytes=65536)
+        descr = TensorDescriptor((4, 8), jnp.float32)
+        async with cache.allocate_cache(descr) as (handle,):
+            with cache.use_cache(handle) as (buf,):
+                assert buf.shape == (4, 8)
+                np.testing.assert_array_equal(np.asarray(buf), 0.0)
+            cache.update_cache(handle, jnp.ones((4, 8), jnp.float32))
+            with cache.use_cache(handle) as (buf,):
+                np.testing.assert_array_equal(np.asarray(buf), 1.0)
+        with pytest.raises(KeyError):
+            with cache.use_cache(handle):
+                pass
+
+    run(main())
+
+
+def test_use_cache_rejects_stale_handle():
+    async def main():
+        cache = MemoryCache(max_size_bytes=65536)
+        with pytest.raises(KeyError):
+            with cache.use_cache(123):
+                pass
+
+    run(main())
+
+
+def test_cancelled_allocation_does_not_leak():
+    async def main():
+        cache = MemoryCache(max_size_bytes=1024)
+
+        async def try_alloc():
+            async with cache.allocate_cache(KB, timeout=10.0):
+                pass
+
+        async with cache.allocate_cache(KB):
+            task = asyncio.create_task(try_alloc())
+            await asyncio.sleep(0.05)
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        # after everything is freed the full budget is available again
+        async with cache.allocate_cache(KB):
+            assert cache.current_size_bytes == 1024
+
+    run(main())
+
+
+def test_many_concurrent_allocations():
+    async def main():
+        cache = MemoryCache(max_size_bytes=4 * 1024)
+        done = 0
+
+        async def worker(i):
+            nonlocal done
+            async with cache.allocate_cache(KB, timeout=10.0):
+                await asyncio.sleep(0.01)
+            done += 1
+
+        await asyncio.gather(*(worker(i) for i in range(32)))
+        assert done == 32
+        assert cache.current_size_bytes == 0
+
+    run(main())
