@@ -28,6 +28,7 @@ def block_apply(
     cfg: BloomBlockConfig,
     *,
     use_flash: bool = False,
+    n_valid=None,  # dynamic count of real (non-padding) tokens in this chunk
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     batch, seq, _ = hidden_states.shape
     h, d = cfg.num_attention_heads, cfg.head_dim
@@ -39,7 +40,7 @@ def block_apply(
     k = (ln1 @ params["wk"] + params["bk"]).reshape(batch, seq, h, d)
     v = (ln1 @ params["wv"] + params["bv"]).reshape(batch, seq, h, d)
 
-    k_all, v_all, kv_length = update_kv_cache(kv, k, v, position)
+    k_all, v_all, kv_length = update_kv_cache(kv, k, v, position, n_valid)
     slopes = build_alibi_slopes(h)
     attn = attend(
         q,

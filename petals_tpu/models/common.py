@@ -46,26 +46,44 @@ ACTIVATIONS = {"silu": silu, "gelu_tanh": gelu_tanh, "gelu": jax.nn.gelu}
 
 
 def update_kv_cache(
-    kv: Optional[KVCache], k_new: jnp.ndarray, v_new: jnp.ndarray, position
+    kv: Optional[KVCache], k_new: jnp.ndarray, v_new: jnp.ndarray, position, n_valid=None
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Write k_new/v_new ([b, s, hkv, d]) into the cache at ``position``.
 
     Returns (k_all, v_all, kv_length) to attend over. With kv=None (training
     forward without a cache) the freshly computed k/v are used directly.
+
+    ``n_valid`` (dynamic scalar) marks how many of the ``s`` new tokens are
+    real — the tail may be padding from shape bucketing. Padding IS written
+    into the buffer past the valid region, but kv_length masks it out of
+    attention and the next chunk overwrites it.
     """
     seq = k_new.shape[1]
     if kv is None:
-        return k_new, v_new, jnp.asarray(seq, jnp.int32)
+        n = seq if n_valid is None else n_valid
+        return k_new, v_new, jnp.asarray(n, jnp.int32)
     k_buf, v_buf = kv
-    if isinstance(position, int) and position + seq > k_buf.shape[1]:
-        # Traced positions can't be validated here (dynamic_update_slice would
-        # clamp and silently corrupt the cache) — the server handler enforces
-        # prefix_length + seq <= max_length before a step is ever submitted.
-        raise ValueError(
-            f"KV cache overflow: position {position} + {seq} new tokens > "
-            f"buffer length {k_buf.shape[1]}"
-        )
     pos = jnp.asarray(position, jnp.int32)
-    k_buf = jax.lax.dynamic_update_slice(k_buf, k_new.astype(k_buf.dtype), (0, pos, 0, 0))
-    v_buf = jax.lax.dynamic_update_slice(v_buf, v_new.astype(v_buf.dtype), (0, pos, 0, 0))
-    return k_buf, v_buf, pos + seq
+
+    if n_valid is None:
+        # Unpadded write: the caller guarantees position + seq <= buffer length
+        # (validated at the handler; a concrete int is also checked here because
+        # a clamped dynamic_update_slice would silently corrupt the cache).
+        if isinstance(position, int) and position + seq > k_buf.shape[1]:
+            raise ValueError(
+                f"KV cache overflow: position {position} + {seq} new tokens > "
+                f"buffer length {k_buf.shape[1]}"
+            )
+        k_buf = jax.lax.dynamic_update_slice(k_buf, k_new.astype(k_buf.dtype), (0, pos, 0, 0))
+        v_buf = jax.lax.dynamic_update_slice(v_buf, v_new.astype(v_buf.dtype), (0, pos, 0, 0))
+        return k_buf, v_buf, pos + seq
+
+    # Bucket-padded write: dynamic_update_slice would CLAMP the start index if
+    # position + padded_len overran the buffer (corrupting the prefix), so the
+    # padded tail is routed out-of-bounds and dropped by a scatter instead.
+    n = jnp.asarray(n_valid, jnp.int32)
+    offsets = jnp.arange(seq, dtype=jnp.int32)
+    idx = jnp.where(offsets < n, pos + offsets, k_buf.shape[1])  # OOB => dropped
+    k_buf = k_buf.at[:, idx].set(k_new.astype(k_buf.dtype), mode="drop")
+    v_buf = v_buf.at[:, idx].set(v_new.astype(v_buf.dtype), mode="drop")
+    return k_buf, v_buf, pos + n

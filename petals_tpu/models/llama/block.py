@@ -30,6 +30,7 @@ def block_apply(
     cfg: LlamaBlockConfig,
     *,
     use_flash: bool = False,
+    n_valid=None,  # dynamic count of real (non-padding) tokens in this chunk
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     batch, seq, _ = hidden_states.shape
     hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -54,7 +55,7 @@ def block_apply(
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)
 
-    k_all, v_all, kv_length = update_kv_cache(kv, k, v, position)
+    k_all, v_all, kv_length = update_kv_cache(kv, k, v, position, n_valid)
     attn = attend(
         q, k_all, v_all, q_offset=position, kv_length=kv_length, use_flash=use_flash
     )

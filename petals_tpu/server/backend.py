@@ -1,0 +1,318 @@
+"""TransformerBackend: the server's compute engine for a span of blocks
+(counterpart of reference src/petals/server/backend.py:24-235).
+
+TPU-first redesign:
+
+- The reference wraps each block in a torch module and merges per-block task
+  pools so a chain runs in one Runtime call (backend.py:201-235). Here a span's
+  parameters are STACKED along a leading layer axis and the whole chain is one
+  jitted ``lax.scan`` — one XLA program per step, no per-block dispatch, MXU
+  stays hot. (This is also why no CUDA-graph analogue is needed.)
+- KV caches are stacked too: [n_blocks, batch, max_len, kv_heads, head_dim]
+  buffers live in HBM via MemoryCache handles; decode steps donate them to XLA
+  so updates happen in place.
+- Variable shapes are bucketed (decode=1 exact; prefill padded to powers of
+  two) with the true token count passed as a dynamic scalar — each bucket
+  compiles once, then every step is a cached executable
+  (reference's recompile-free decode requirement, SURVEY.md §7 hard part 1).
+- Beam-search cache reorder (reference backend.py:154-158) is a batch gather
+  on the stacked cache, fused into the same step.
+- Chunked prefill (reference backend.py:126-152): long inputs are split into
+  chunks whose attention-weight footprint fits max_chunk_size_bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from petals_tpu.models.registry import ModelFamily
+from petals_tpu.server.memory_cache import MemoryCache, TensorDescriptor
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+PREFILL_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+def bucket_length(n: int) -> int:
+    for b in PREFILL_BUCKETS:
+        if n <= b:
+            return b
+    return -(-n // PREFILL_BUCKETS[-1]) * PREFILL_BUCKETS[-1]
+
+
+@dataclasses.dataclass
+class SpanDtypes:
+    compute: jnp.dtype = jnp.bfloat16
+    cache: jnp.dtype = jnp.bfloat16
+
+
+class TransformerBackend:
+    """Serves blocks [first_block, first_block + n_blocks) of one model."""
+
+    def __init__(
+        self,
+        family: ModelFamily,
+        cfg,
+        stacked_params,  # pytree with leading n_blocks axis on every leaf
+        *,
+        first_block: int,
+        n_blocks: int,
+        memory_cache: MemoryCache,
+        compute_dtype=jnp.bfloat16,
+        cache_dtype=None,
+        max_chunk_size_bytes: int = 256 * 1024 * 1024,
+        use_flash: Optional[bool] = None,
+    ):
+        self.family = family
+        self.cfg = cfg
+        self.params = stacked_params
+        self.first_block = first_block
+        self.n_blocks = n_blocks
+        self.memory_cache = memory_cache
+        self.compute_dtype = compute_dtype
+        self.cache_dtype = cache_dtype or compute_dtype
+        self.max_chunk_size_bytes = max_chunk_size_bytes
+        if use_flash is None:
+            use_flash = jax.default_backend() == "tpu"
+        self.use_flash = use_flash
+
+        self.num_kv_heads = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
+        self.head_dim = cfg.head_dim
+        self.hidden_size = cfg.hidden_size
+
+    # ------------------------------------------------------------- cache descriptors
+
+    def cache_descriptors(self, batch_size: int, max_length: int, start: int, end: int):
+        """(k, v) descriptors for blocks [start, end) of this span
+        (reference backend.py:88-99)."""
+        n = end - start
+        shape = (n, batch_size, max_length, self.num_kv_heads, self.head_dim)
+        return (
+            TensorDescriptor(shape, self.cache_dtype),
+            TensorDescriptor(shape, self.cache_dtype),
+        )
+
+    def cache_bytes_per_token(self) -> int:
+        return (
+            2
+            * self.n_blocks
+            * self.num_kv_heads
+            * self.head_dim
+            * jnp.dtype(self.cache_dtype).itemsize
+        )
+
+    # ------------------------------------------------------------- jitted programs
+
+    def _slice_params(self, start: int, end: int):
+        if start == 0 and end == self.n_blocks:
+            return self.params
+        return jax.tree_util.tree_map(lambda x: x[start:end], self.params)
+
+    @functools.cached_property
+    def _inference_step_fn(self):
+        family, cfg, use_flash = self.family, self.cfg, self.use_flash
+
+        @functools.partial(
+            jax.jit,
+            static_argnames=("with_prompts", "with_hypo", "padded"),
+            donate_argnums=(1, 2),
+        )
+        def step(params, k_stack, v_stack, hidden, position, n_valid, prompts, hypo_ids,
+                 *, with_prompts: bool, with_hypo: bool, padded: bool):
+            hidden = hidden.astype(k_stack.dtype)
+            if with_hypo:
+                # beam search: reorder per-sequence cache lanes in place
+                k_stack = jnp.take(k_stack, hypo_ids, axis=1)
+                v_stack = jnp.take(v_stack, hypo_ids, axis=1)
+
+            if with_prompts:
+                # deep prompts cover absolute positions [0, pre_seq): add the
+                # overlap with this chunk [position, position + seq)
+                pre_seq = prompts.shape[2]
+                seq = hidden.shape[1]
+                pos_in_chunk = position + jnp.arange(seq, dtype=jnp.int32)
+                prompt_mask = (pos_in_chunk < pre_seq)[None, :, None]
+
+            def body(h, xs):
+                p_block, k_block, v_block, prompt = xs
+                if with_prompts:
+                    seq = h.shape[1]
+                    pre = prompt.shape[1]
+                    # gather the prompt rows aligned with this chunk's positions
+                    idx = jnp.clip(position + jnp.arange(seq, dtype=jnp.int32), 0, pre - 1)
+                    aligned = jnp.take(prompt, idx, axis=1)
+                    h = h + jnp.where(prompt_mask, aligned, 0).astype(h.dtype)
+                out, (k_new, v_new) = family.block_apply(
+                    p_block, h, (k_block, v_block), position, cfg,
+                    use_flash=use_flash, n_valid=n_valid if padded else None,
+                )
+                return out, (k_new, v_new)
+
+            hidden, (k_stack, v_stack) = jax.lax.scan(
+                body, hidden, (params, k_stack, v_stack, prompts)
+            )
+            return hidden, k_stack, v_stack
+
+        return step
+
+    @functools.cached_property
+    def _forward_fn(self):
+        family, cfg, use_flash = self.family, self.cfg, self.use_flash
+
+        @functools.partial(jax.jit, static_argnames=("with_prompts",))
+        def fwd(params, hidden, prompts, *, with_prompts: bool):
+            def body(h, xs):
+                p_block, prompt = xs
+                if with_prompts:
+                    pre = prompt.shape[1]
+                    h = h.at[:, :pre].add(prompt.astype(h.dtype))
+                out, _ = family.block_apply(p_block, h, None, 0, cfg, use_flash=use_flash)
+                return out, None
+
+            hidden, _ = jax.lax.scan(body, hidden, (params, prompts))
+            return hidden
+
+        return fwd
+
+    @functools.cached_property
+    def _backward_fn(self):
+        fwd_raw = self._forward_fn.__wrapped__  # un-jitted closure for vjp
+
+        @functools.partial(jax.jit, static_argnames=("with_prompts",))
+        def bwd(params, hidden, prompts, grad_out, *, with_prompts: bool):
+            def f(h, p):
+                return fwd_raw(params, h, p, with_prompts=with_prompts)
+
+            _, vjp = jax.vjp(f, hidden, prompts)
+            grad_hidden, grad_prompts = vjp(grad_out.astype(hidden.dtype))
+            return grad_hidden, grad_prompts
+
+        return bwd
+
+    # ------------------------------------------------------------- public API
+
+    def inference_step(
+        self,
+        hidden: np.ndarray,  # [batch, seq, hidden] (real tokens, unpadded)
+        kv: Tuple[jax.Array, jax.Array],
+        position: int,
+        *,
+        prompts: Optional[np.ndarray] = None,  # [n_blocks, batch, pre_seq, hidden]
+        hypo_ids: Optional[np.ndarray] = None,  # [batch]
+    ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+        """One (chunked-as-needed) inference step over the whole span chain."""
+        k_stack, v_stack = kv
+        max_length = k_stack.shape[2]
+        batch, total_seq, _ = hidden.shape
+        if position + total_seq > max_length:
+            raise ValueError(
+                f"Step of {total_seq} tokens at position {position} overflows the "
+                f"allocated cache ({max_length} tokens)"
+            )
+
+        hidden = jnp.asarray(hidden, self.compute_dtype)
+        outputs = []
+        offset = 0
+        for chunk_len in self._chunk_plan(batch, total_seq):
+            chunk = hidden[:, offset : offset + chunk_len]
+            out, k_stack, v_stack = self._step_once(
+                chunk, k_stack, v_stack, position + offset, prompts, hypo_ids if offset == 0 else None
+            )
+            outputs.append(out)
+            offset += chunk_len
+
+        result = outputs[0] if len(outputs) == 1 else jnp.concatenate(outputs, axis=1)
+        return result, (k_stack, v_stack)
+
+    def _step_once(self, chunk, k_stack, v_stack, position, prompts, hypo_ids):
+        batch, seq, _ = chunk.shape
+        n_valid = seq
+        if seq == 1:
+            padded, is_padded = chunk, False
+        else:
+            bucket = bucket_length(seq)
+            if bucket != seq:
+                padded = jnp.pad(chunk, ((0, 0), (0, bucket - seq), (0, 0)))
+                is_padded = True
+            else:
+                padded, is_padded = chunk, False
+
+        with_prompts = prompts is not None
+        with_hypo = hypo_ids is not None
+        if prompts is None:
+            prompts_arr = jnp.zeros((self.n_blocks, batch, 0, self.hidden_size), self.compute_dtype)
+        else:
+            prompts_arr = jnp.asarray(prompts, self.compute_dtype)
+        hypo_arr = (
+            jnp.asarray(hypo_ids, jnp.int32) if hypo_ids is not None else jnp.zeros((batch,), jnp.int32)
+        )
+
+        out, k_stack, v_stack = self._inference_step_fn(
+            self.params,
+            k_stack,
+            v_stack,
+            padded,
+            jnp.asarray(position, jnp.int32),
+            jnp.asarray(n_valid, jnp.int32),
+            prompts_arr,
+            hypo_arr,
+            with_prompts=with_prompts,
+            with_hypo=with_hypo,
+            padded=is_padded,
+        )
+        if out.shape[1] != seq:
+            out = out[:, :seq]
+        return out, k_stack, v_stack
+
+    def _chunk_plan(self, batch: int, total_seq: int) -> Sequence[int]:
+        """Split a long prefill so each chunk's attention-logit footprint stays
+        under max_chunk_size_bytes (reference backend.py:126-152 semantics)."""
+        if total_seq <= 1:
+            return [total_seq]
+        # attention logits per chunk ≈ batch * heads * chunk * total_seq * 4 bytes
+        heads = self.cfg.num_attention_heads
+        denom = max(batch * heads * total_seq * 4, 1)
+        max_chunk = max(self.max_chunk_size_bytes // denom, 1)
+        chunks = []
+        remaining = total_seq
+        while remaining > 0:
+            step = min(max_chunk, remaining)
+            chunks.append(step)
+            remaining -= step
+        return chunks
+
+    def forward(self, hidden: np.ndarray, prompts: Optional[np.ndarray] = None) -> jax.Array:
+        """Training-style forward over the span (no KV cache)."""
+        hidden = jnp.asarray(hidden, self.compute_dtype)
+        with_prompts = prompts is not None
+        prompts_arr = (
+            jnp.asarray(prompts, self.compute_dtype)
+            if prompts is not None
+            else jnp.zeros((self.n_blocks, hidden.shape[0], 0, self.hidden_size), self.compute_dtype)
+        )
+        return self._forward_fn(self.params, hidden, prompts_arr, with_prompts=with_prompts)
+
+    def backward(
+        self, hidden: np.ndarray, grad_out: np.ndarray, prompts: Optional[np.ndarray] = None
+    ) -> Tuple[jax.Array, Optional[jax.Array]]:
+        """Grads wrt inputs (and deep prompts if given) — recomputes the chain
+        forward like the reference (run_rpc_backward, block_functions.py:84-141)."""
+        hidden = jnp.asarray(hidden, self.compute_dtype)
+        grad_out = jnp.asarray(grad_out, self.compute_dtype)
+        with_prompts = prompts is not None
+        prompts_arr = (
+            jnp.asarray(prompts, self.compute_dtype)
+            if prompts is not None
+            else jnp.zeros((self.n_blocks, hidden.shape[0], 0, self.hidden_size), self.compute_dtype)
+        )
+        grad_hidden, grad_prompts = self._backward_fn(
+            self.params, hidden, prompts_arr, grad_out, with_prompts=with_prompts
+        )
+        return grad_hidden, (grad_prompts if with_prompts else None)

@@ -1,0 +1,257 @@
+"""The server's RPC surface (counterpart of reference
+src/petals/server/handler.py:55-592 — rpc_inference / rpc_forward /
+rpc_backward / rpc_info; streaming variants are subsumed by the framed
+transport, which chunks large frames at the protocol level).
+
+One handler instance serves one span of blocks. Sessions (multi-step inference
+with server-held KV) are plain dicts in this process — the reference's
+cross-process session registry (handler.py:197-245) is unnecessary in a
+single-process JAX server.
+
+Wire payloads (msgpack):
+- inference open:  {uids, max_length, batch_size, active_adapter?, session_id?}
+- inference step:  {tensors: {hidden, prompts?, hypo_ids?}, start_from_position?, step_id?}
+- inference reply: {tensors: {hidden}, position}
+- forward:         {uids, tensors: {hidden, prompts?}, active_adapter?}
+- backward:        {uids, tensors: {hidden, grad_out, prompts?}, active_adapter?}
+- info:            {} -> ServerInfo dict + cache stats
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from petals_tpu.data_structures import CHAIN_DELIMITER, ModuleUID, parse_uid
+from petals_tpu.rpc.serialization import deserialize_array, serialize_array, CompressionType
+from petals_tpu.rpc.server import RpcContext, RpcServer
+from petals_tpu.server.backend import TransformerBackend
+from petals_tpu.server.memory_cache import MemoryCache
+from petals_tpu.server.task_queue import (
+    PRIORITY_INFERENCE,
+    PRIORITY_TRAINING,
+    PriorityTaskQueue,
+)
+from petals_tpu.utils.logging import get_logger
+from petals_tpu.utils.misc import is_dummy
+
+logger = get_logger(__name__)
+
+
+class TransformerHandler:
+    def __init__(
+        self,
+        backend: TransformerBackend,
+        *,
+        dht_prefix: str,
+        memory_cache: MemoryCache,
+        server_info_fn=None,
+        request_timeout: float = 3 * 60,
+        session_timeout: float = 30 * 60,
+        step_timeout: float = 5 * 60,
+        compression: CompressionType = CompressionType.NONE,
+    ):
+        self.backend = backend
+        self.dht_prefix = dht_prefix
+        self.memory_cache = memory_cache
+        self.server_info_fn = server_info_fn
+        self.request_timeout = request_timeout
+        self.session_timeout = session_timeout
+        self.step_timeout = step_timeout
+        self.compression = compression
+        self.queue = PriorityTaskQueue()
+        self.queue.start()
+        self._sub_backends: Dict[Tuple[int, int], TransformerBackend] = {}
+
+    def register(self, server: RpcServer) -> None:
+        server.add_unary_handler("ptu.forward", self.rpc_forward)
+        server.add_unary_handler("ptu.backward", self.rpc_backward)
+        server.add_unary_handler("ptu.info", self.rpc_info)
+        server.add_stream_handler("ptu.inference", self.rpc_inference)
+
+    def shutdown(self) -> None:
+        self.queue.shutdown()
+
+    # ------------------------------------------------------------------ helpers
+
+    def _parse_chain(self, uids: str) -> Tuple[int, int]:
+        """Validate a chain of UIDs against our span; return (start, end) relative
+        to the backend's first block."""
+        parts = uids.split(CHAIN_DELIMITER) if isinstance(uids, str) else list(uids)
+        if not parts:
+            raise ValueError("Empty uid chain")
+        indices = []
+        for uid in parts:
+            prefix, idx = parse_uid(uid)
+            if prefix != self.dht_prefix:
+                raise ValueError(f"UID {uid!r} does not match served prefix {self.dht_prefix!r}")
+            indices.append(idx)
+        lo, hi = indices[0], indices[-1] + 1
+        if indices != list(range(lo, hi)):
+            raise ValueError(f"UID chain must be contiguous, got {indices}")
+        first, last = self.backend.first_block, self.backend.first_block + self.backend.n_blocks
+        if lo < first or hi > last:
+            raise ValueError(
+                f"Requested blocks [{lo}, {hi}) outside served span [{first}, {last})"
+            )
+        return lo - first, hi - first
+
+    def _get_tensor(self, payload: dict, name: str) -> Optional[np.ndarray]:
+        wire = (payload.get("tensors") or {}).get(name)
+        if wire is None:
+            return None
+        arr = deserialize_array(wire)
+        return None if is_dummy(arr) else arr
+
+    # ------------------------------------------------------------------ rpc methods
+
+    async def rpc_forward(self, payload, ctx: RpcContext):
+        start, end = self._parse_chain(payload["uids"])
+        hidden = self._get_tensor(payload, "hidden")
+        prompts = self._get_tensor(payload, "prompts")
+        if hidden is None or hidden.ndim != 3:
+            raise ValueError("rpc_forward expects a [batch, seq, hidden] tensor")
+        backend = self._sub_backend(start, end)
+        out = await asyncio.wait_for(
+            self.queue.submit(
+                lambda: np.asarray(backend.forward(hidden, prompts=prompts)),
+                priority=PRIORITY_TRAINING,
+                size=hidden.shape[0] * hidden.shape[1],
+            ),
+            self.request_timeout,
+        )
+        return {"tensors": {"hidden": serialize_array(out, self.compression)}}
+
+    async def rpc_backward(self, payload, ctx: RpcContext):
+        start, end = self._parse_chain(payload["uids"])
+        hidden = self._get_tensor(payload, "hidden")
+        grad_out = self._get_tensor(payload, "grad_out")
+        prompts = self._get_tensor(payload, "prompts")
+        if hidden is None or grad_out is None:
+            raise ValueError("rpc_backward expects hidden and grad_out tensors")
+        backend = self._sub_backend(start, end)
+
+        def run():
+            grad_hidden, grad_prompts = backend.backward(hidden, grad_out, prompts=prompts)
+            return np.asarray(grad_hidden), (
+                np.asarray(grad_prompts) if grad_prompts is not None else None
+            )
+
+        grad_hidden, grad_prompts = await asyncio.wait_for(
+            self.queue.submit(
+                run, priority=PRIORITY_TRAINING, size=hidden.shape[0] * hidden.shape[1]
+            ),
+            self.request_timeout,
+        )
+        tensors = {"grad_hidden": serialize_array(grad_hidden, self.compression)}
+        if grad_prompts is not None:
+            tensors["grad_prompts"] = serialize_array(grad_prompts, self.compression)
+        return {"tensors": tensors}
+
+    async def rpc_info(self, payload, ctx: RpcContext):
+        info = dict(self.server_info_fn()) if self.server_info_fn else {}
+        info.update(
+            cache_tokens_available=max(
+                self.memory_cache.bytes_left // max(self.backend.cache_bytes_per_token(), 1), 0
+            ),
+            first_block=self.backend.first_block,
+            n_blocks=self.backend.n_blocks,
+            dht_prefix=self.dht_prefix,
+        )
+        return info
+
+    async def rpc_inference(self, requests, ctx: RpcContext):
+        """Bidirectional inference stream: open -> step* (reference
+        handler.py:132-195 + block_functions.iterate_rpc_inference)."""
+        open_msg = await asyncio.wait_for(anext(requests), self.step_timeout)
+        start, end = self._parse_chain(open_msg["uids"])
+        max_length = int(open_msg["max_length"])
+        batch_size = int(open_msg.get("batch_size", 1))
+        backend = self._sub_backend(start, end)
+
+        descriptors = backend.cache_descriptors(batch_size, max_length, 0, end - start)
+        async with self.memory_cache.allocate_cache(
+            *descriptors, timeout=open_msg.get("alloc_timeout")
+        ) as handles:
+            with self.memory_cache.use_cache(*handles) as (k_buf, v_buf):
+                kv = (k_buf, v_buf)
+            position = 0
+            yield {"session_open": True, "position": 0, "max_length": max_length}
+
+            while True:
+                try:
+                    step = await asyncio.wait_for(anext(requests), self.session_timeout)
+                except StopAsyncIteration:
+                    break
+                if step is None:
+                    break
+
+                start_from = step.get("start_from_position")
+                if start_from is not None:
+                    if start_from > position:
+                        raise ValueError(
+                            f"start_from_position {start_from} is ahead of cache ({position})"
+                        )
+                    position = int(start_from)  # rollback (speculative decoding)
+
+                hidden = self._get_tensor(step, "hidden")
+                prompts = self._get_tensor(step, "prompts")
+                hypo_ids = self._get_tensor(step, "hypo_ids")
+                seq = 0 if hidden is None else hidden.shape[1]
+                if hidden is not None and position + seq > max_length:
+                    raise ValueError(
+                        f"Step of {seq} tokens at position {position} exceeds max_length {max_length}"
+                    )
+
+                if hidden is None or seq == 0:
+                    # cache probe step (reference block_functions.py:209-211)
+                    yield {"tensors": {}, "position": position}
+                    continue
+
+                pos = position
+
+                def run_step():
+                    out, new_kv = backend.inference_step(
+                        hidden, kv, pos, prompts=prompts, hypo_ids=hypo_ids
+                    )
+                    return np.asarray(out), new_kv
+
+                out, kv = await asyncio.wait_for(
+                    self.queue.submit(
+                        run_step, priority=PRIORITY_INFERENCE, size=batch_size * seq
+                    ),
+                    self.step_timeout,
+                )
+                # keep the allocator's view coherent (old buffers were donated)
+                self.memory_cache.update_cache(handles[0], kv[0])
+                self.memory_cache.update_cache(handles[1], kv[1])
+                position += seq
+                yield {
+                    "tensors": {"hidden": serialize_array(out, self.compression)},
+                    "position": position,
+                }
+
+    def _sub_backend(self, start: int, end: int) -> TransformerBackend:
+        if start == 0 and end == self.backend.n_blocks:
+            return self.backend
+        # Partial chains get their own backend over a sliced param stack —
+        # cached so each (start, end) compiles its programs exactly once.
+        key = (start, end)
+        if key not in self._sub_backends:
+            sliced = self.backend._slice_params(start, end)
+            self._sub_backends[key] = TransformerBackend(
+                self.backend.family,
+                self.backend.cfg,
+                sliced,
+                first_block=self.backend.first_block + start,
+                n_blocks=end - start,
+                memory_cache=self.memory_cache,
+                compute_dtype=self.backend.compute_dtype,
+                cache_dtype=self.backend.cache_dtype,
+                max_chunk_size_bytes=self.backend.max_chunk_size_bytes,
+                use_flash=self.backend.use_flash,
+            )
+        return self._sub_backends[key]
+

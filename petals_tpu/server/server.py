@@ -1,0 +1,216 @@
+"""Server orchestrator: load a span of blocks, serve it, announce it
+(counterpart of reference src/petals/server/server.py:46-775 — Server +
+ModuleContainer + ModuleAnnouncerThread, collapsed into one asyncio process
+since a JAX server has no per-connection forked handlers or separate runtime
+process).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import re
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+import petals_tpu
+from petals_tpu.data_structures import ServerInfo, ServerState, make_uid, PeerID
+from petals_tpu.dht.node import DHTNode, dht_time
+from petals_tpu.rpc.server import RpcServer
+from petals_tpu.server.backend import TransformerBackend
+from petals_tpu.server.from_pretrained import get_block_config, load_block_params
+from petals_tpu.server.handler import TransformerHandler
+from petals_tpu.server.memory_cache import MemoryCache
+from petals_tpu.utils.dht_utils import declare_active_modules
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_UPDATE_PERIOD = 30.0
+
+
+def default_dht_prefix(model_name: str) -> str:
+    """Derive the swarm namespace from the model name (reference
+    models/*/config.py dht_prefix logic: name minus org, '-hf' suffix)."""
+    name = model_name.rstrip("/").split("/")[-1]
+    name = re.sub(r"[^\w.-]", "-", name)
+    return f"{name}-hf"
+
+
+class Server:
+    """Hosts blocks [first_block, first_block + num_blocks) of one model."""
+
+    def __init__(
+        self,
+        model_path: str,
+        *,
+        first_block: int = 0,
+        num_blocks: Optional[int] = None,
+        dht_prefix: Optional[str] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        initial_peers: Sequence = (),
+        identity_seed: Optional[bytes] = None,
+        compute_dtype=jnp.bfloat16,
+        attn_cache_bytes: Optional[int] = None,
+        max_chunk_size_bytes: int = 256 * 1024 * 1024,
+        throughput: float = 1.0,
+        public_name: Optional[str] = None,
+        update_period: float = DEFAULT_UPDATE_PERIOD,
+        use_flash: Optional[bool] = None,
+        max_alloc_timeout: float = 600.0,
+    ):
+        self.model_path = model_path
+        self.family, self.cfg = get_block_config(model_path)
+        total = self.cfg.num_hidden_layers
+        self.first_block = first_block
+        self.num_blocks = num_blocks if num_blocks is not None else total - first_block
+        assert 0 <= first_block < first_block + self.num_blocks <= total
+        self.dht_prefix = dht_prefix or default_dht_prefix(model_path)
+        self.host, self.port = host, port
+        self.initial_peers = list(initial_peers)
+        self.identity_seed = identity_seed
+        self.compute_dtype = compute_dtype
+        self.attn_cache_bytes = attn_cache_bytes
+        self.max_chunk_size_bytes = max_chunk_size_bytes
+        self.throughput = throughput
+        self.public_name = public_name
+        self.update_period = update_period
+        self.use_flash = use_flash
+        self.max_alloc_timeout = max_alloc_timeout
+
+        self.module_uids = [
+            make_uid(self.dht_prefix, i)
+            for i in range(self.first_block, self.first_block + self.num_blocks)
+        ]
+        self.rpc_server: Optional[RpcServer] = None
+        self.dht: Optional[DHTNode] = None
+        self.handler: Optional[TransformerHandler] = None
+        self.backend: Optional[TransformerBackend] = None
+        self.memory_cache: Optional[MemoryCache] = None
+        self._announcer_task: Optional[asyncio.Task] = None
+        self._ready = asyncio.Event()
+
+    # ------------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        peer_id = (
+            PeerID.from_seed(self.identity_seed) if self.identity_seed else PeerID.generate()
+        )
+        self.rpc_server = RpcServer(peer_id=peer_id, host=self.host, port=self.port)
+        # Start listening BEFORE the DHT bootstraps: the node advertises its
+        # own (host, port) to peers during bootstrap.
+        await self.rpc_server.start()
+        self.dht = await DHTNode.create(
+            peer_id=peer_id,
+            rpc_server=self.rpc_server,
+            initial_peers=self.initial_peers,
+        )
+
+        # max_alloc_timeout caps client-requested allocation waits so one
+        # unsatisfiable session can't park at the head of the FIFO forever
+        self.memory_cache = MemoryCache(self.attn_cache_bytes, max_alloc_timeout=self.max_alloc_timeout)
+
+        # announce JOINING while blocks load (reference server.py:468-481)
+        await self._announce(ServerState.JOINING)
+
+        logger.info(
+            f"Loading blocks [{self.first_block}, {self.first_block + self.num_blocks}) "
+            f"of {self.model_path}"
+        )
+        t0 = time.perf_counter()
+
+        def load_all():
+            per_block = [
+                load_block_params(
+                    self.model_path, i, dtype=self.compute_dtype, family=self.family, cfg=self.cfg
+                )
+                for i in range(self.first_block, self.first_block + self.num_blocks)
+            ]
+            return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
+
+        # load off the event loop: the DHT node is already answering peers and
+        # must not go dark for the (potentially minutes-long) weight load
+        stacked = await asyncio.get_running_loop().run_in_executor(None, load_all)
+        logger.info(f"Blocks loaded in {time.perf_counter() - t0:.1f}s")
+
+        self.backend = TransformerBackend(
+            self.family,
+            self.cfg,
+            stacked,
+            first_block=self.first_block,
+            n_blocks=self.num_blocks,
+            memory_cache=self.memory_cache,
+            compute_dtype=self.compute_dtype,
+            max_chunk_size_bytes=self.max_chunk_size_bytes,
+            use_flash=self.use_flash,
+        )
+        self.handler = TransformerHandler(
+            self.backend,
+            dht_prefix=self.dht_prefix,
+            memory_cache=self.memory_cache,
+            server_info_fn=lambda: dataclasses.asdict(self._server_info(ServerState.ONLINE)),
+        )
+        self.handler.register(self.rpc_server)
+
+        await self._announce(ServerState.ONLINE)
+        self._announcer_task = asyncio.create_task(self._announce_loop())
+        self._ready.set()
+        logger.info(f"Server ready: {self.dht.own_addr.to_string()} serving {self.module_uids}")
+
+    async def wait_ready(self) -> None:
+        await self._ready.wait()
+
+    async def shutdown(self) -> None:
+        if self._announcer_task is not None:
+            self._announcer_task.cancel()
+            try:
+                await self._announcer_task
+            except asyncio.CancelledError:
+                pass
+        try:
+            await self._announce(ServerState.OFFLINE, expiration=dht_time() + 60)
+        except Exception:
+            pass
+        if self.handler is not None:
+            self.handler.shutdown()
+        if self.dht is not None:
+            await self.dht.shutdown()
+        if self.rpc_server is not None:
+            await self.rpc_server.stop()
+
+    # ------------------------------------------------------------------ announcing
+
+    def _server_info(self, state: ServerState) -> ServerInfo:
+        cache_tokens_left = None
+        if self.memory_cache is not None and self.backend is not None:
+            cache_tokens_left = int(
+                self.memory_cache.bytes_left // max(self.backend.cache_bytes_per_token(), 1)
+            )
+        return ServerInfo(
+            state=state,
+            throughput=self.throughput,
+            start_block=self.first_block,
+            end_block=self.first_block + self.num_blocks,
+            public_name=self.public_name,
+            version=petals_tpu.__version__,
+            compute_dtype=str(jnp.dtype(self.compute_dtype).name),
+            cache_tokens_left=cache_tokens_left,
+        )
+
+    async def _announce(self, state: ServerState, expiration: Optional[float] = None) -> None:
+        expiration = expiration or (dht_time() + max(2 * self.update_period, 60.0))
+        await declare_active_modules(
+            self.dht, self.module_uids, self._server_info(state), expiration
+        )
+
+    async def _announce_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.update_period)
+            try:
+                await self._announce(ServerState.ONLINE)
+            except Exception as e:
+                logger.warning(f"Announce failed: {e}")

@@ -1,0 +1,131 @@
+"""DHT directory service: which peer serves which blocks
+(counterpart of reference src/petals/utils/dht.py:28-153).
+
+Records: key = module UID (e.g. "llama-hf.3"), subkey = announcing peer id hex,
+value = ServerInfo.to_tuple() + the peer's contact address, each with its own
+expiration. Readers merge all live announcements per block.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Sequence
+
+from petals_tpu.data_structures import (
+    ModuleUID,
+    PeerID,
+    RemoteModuleInfo,
+    RemoteSpanInfo,
+    ServerInfo,
+    ServerState,
+)
+from petals_tpu.dht.node import DHTNode, dht_time
+from petals_tpu.dht.routing import PeerAddr
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+async def declare_active_modules(
+    dht: DHTNode,
+    uids: Sequence[ModuleUID],
+    server_info: ServerInfo,
+    expiration_time: float,
+    contact_addr: Optional[PeerAddr] = None,
+) -> int:
+    """Announce that this peer serves ``uids``; returns how many records stored."""
+    contact = (contact_addr or dht.own_addr).to_wire() if (contact_addr or dht.own_addr) else None
+    value = {"info": list(server_info.to_tuple()), "addr": contact}
+    subkey = dht.peer_id.to_string()
+    results = await asyncio.gather(
+        *(dht.store(uid, value, expiration_time, subkey=subkey) for uid in uids)
+    )
+    return sum(bool(r) for r in results)
+
+
+async def get_remote_module_infos(
+    dht: DHTNode,
+    uids: Sequence[ModuleUID],
+    *,
+    active_adapter: Optional[str] = None,
+) -> tuple:
+    """Fetch the server map for each UID (None where nobody serves the block).
+
+    Returns (infos, addr_book): infos[i] is a RemoteModuleInfo or None;
+    addr_book maps peer ids to their announced contact addresses."""
+    records = await asyncio.gather(*(dht.get(uid) for uid in uids))
+    out: List[Optional[RemoteModuleInfo]] = []
+    addr_book: Dict[PeerID, PeerAddr] = {}
+    for uid, record in zip(uids, records):
+        if record is None or not isinstance(record[0], dict):
+            out.append(None)
+            continue
+        servers: Dict[PeerID, ServerInfo] = {}
+        for subkey, (value, _expiration) in record[0].items():
+            try:
+                peer_id = PeerID.from_string(subkey)
+                info = ServerInfo.from_tuple(tuple(value["info"]))
+                if active_adapter and active_adapter not in (info.adapters or ()):
+                    logger.debug(f"Skipping {peer_id}: no adapter {active_adapter}")
+                    continue
+                servers[peer_id] = info
+                if value.get("addr"):
+                    addr_book[peer_id] = PeerAddr.from_wire(value["addr"])
+            except (ValueError, KeyError, TypeError) as e:
+                logger.debug(f"Incorrect DHT entry for {uid} subkey {subkey!r}: {e}")
+        out.append(RemoteModuleInfo(uid=uid, servers=servers) if servers else None)
+    return out, addr_book
+
+
+class ModuleDirectory:
+    """Stateful fetch helper keeping the peer-id -> contact-address book."""
+
+    def __init__(self, dht: DHTNode):
+        self.dht = dht
+        self.addr_book: Dict[PeerID, PeerAddr] = {}
+
+    async def declare(self, uids, server_info, expiration_time, contact_addr=None) -> int:
+        return await declare_active_modules(self.dht, uids, server_info, expiration_time, contact_addr)
+
+    async def fetch(self, uids, active_adapter=None) -> List[Optional[RemoteModuleInfo]]:
+        infos, addr_book = await get_remote_module_infos(self.dht, uids, active_adapter=active_adapter)
+        self.addr_book.update(addr_book)
+        return infos
+
+    def addr_of(self, peer_id: PeerID) -> Optional[PeerAddr]:
+        return self.addr_book.get(peer_id)
+
+
+def compute_spans(
+    module_infos: Sequence[Optional[RemoteModuleInfo]],
+    *,
+    min_state: ServerState = ServerState.ONLINE,
+) -> Dict[PeerID, RemoteSpanInfo]:
+    """Aggregate per-block announcements into contiguous per-peer spans
+    (reference utils/dht.py:134-153)."""
+    spans: Dict[PeerID, RemoteSpanInfo] = {}
+    for block_idx, info in enumerate(module_infos):
+        if info is None:
+            continue
+        for peer_id, server_info in info.servers.items():
+            if server_info.state.value < min_state.value:
+                continue
+            if peer_id in spans and spans[peer_id].end == block_idx:
+                spans[peer_id].end = block_idx + 1
+                spans[peer_id].server_info = server_info
+            else:
+                # a peer restarting on a new range keeps only its newest span
+                spans[peer_id] = RemoteSpanInfo(
+                    peer_id=peer_id, start=block_idx, end=block_idx + 1, server_info=server_info
+                )
+    return spans
+
+
+def module_uids(dht_prefix: str, block_indices: range) -> List[ModuleUID]:
+    from petals_tpu.data_structures import make_uid
+
+    return [make_uid(dht_prefix, i) for i in block_indices]
+
+
+def default_expiration(update_period: float) -> float:
+    return dht_time() + max(2 * update_period, 60.0)

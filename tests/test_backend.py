@@ -1,0 +1,189 @@
+"""TransformerBackend tests: stacked-span scan vs per-block application,
+cache decode, chunked prefill, beam reorder, training forward/backward
+(reference tests/test_chained_calls.py + backend semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from petals_tpu.server.backend import TransformerBackend, bucket_length
+from petals_tpu.server.from_pretrained import get_block_config, load_block_params
+from petals_tpu.server.memory_cache import MemoryCache
+from tests.utils import make_tiny_llama
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    path = make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+    family, cfg = get_block_config(path)
+    per_block = [load_block_params(path, i, dtype=jnp.float32) for i in range(cfg.num_hidden_layers)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
+    backend = TransformerBackend(
+        family,
+        cfg,
+        stacked,
+        first_block=0,
+        n_blocks=cfg.num_hidden_layers,
+        memory_cache=MemoryCache(None),
+        compute_dtype=jnp.float32,
+        use_flash=False,
+    )
+    return path, family, cfg, per_block, backend
+
+
+def _alloc_kv(backend, batch, max_len):
+    kd, vd = backend.cache_descriptors(batch, max_len, 0, backend.n_blocks)
+    return kd.make_zeros(), vd.make_zeros()
+
+
+def test_span_forward_matches_per_block(setup):
+    path, family, cfg, per_block, backend = setup
+    rng = np.random.RandomState(0)
+    hidden = rng.randn(2, 10, cfg.hidden_size).astype(np.float32)
+
+    expected = jnp.asarray(hidden)
+    for params in per_block:
+        expected, _ = family.block_apply(params, expected, None, 0, cfg)
+
+    ours = backend.forward(hidden)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(expected), atol=2e-5, rtol=0)
+
+
+def test_inference_prefill_then_decode_matches_forward(setup):
+    path, family, cfg, per_block, backend = setup
+    rng = np.random.RandomState(1)
+    total = 9
+    hidden = rng.randn(1, total, cfg.hidden_size).astype(np.float32)
+
+    full = np.asarray(backend.forward(hidden))
+
+    kv = _alloc_kv(backend, 1, 16)
+    out_prefill, kv = backend.inference_step(hidden[:, :5], kv, 0)
+    outs = [np.asarray(out_prefill)]
+    for t in range(5, total):
+        out, kv = backend.inference_step(hidden[:, t : t + 1], kv, t)
+        outs.append(np.asarray(out))
+    stitched = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(stitched, full, atol=3e-5, rtol=0)
+
+
+def test_prefill_bucketing_padding_is_invisible(setup):
+    """A 9-token prefill runs in a 16-bucket; results must equal unpadded math."""
+    path, family, cfg, per_block, backend = setup
+    rng = np.random.RandomState(2)
+    hidden = rng.randn(2, 9, cfg.hidden_size).astype(np.float32)
+    full = np.asarray(backend.forward(hidden))
+    kv = _alloc_kv(backend, 2, 32)
+    out, kv = backend.inference_step(hidden, kv, 0)
+    assert out.shape == (2, 9, cfg.hidden_size)
+    np.testing.assert_allclose(np.asarray(out), full, atol=3e-5, rtol=0)
+    # and decode continues correctly after a padded prefill
+    nxt = rng.randn(2, 1, cfg.hidden_size).astype(np.float32)
+    out2, kv = backend.inference_step(nxt, kv, 9)
+    full2 = np.asarray(backend.forward(np.concatenate([hidden, nxt], axis=1)))[:, -1:]
+    np.testing.assert_allclose(np.asarray(out2), full2, atol=5e-5, rtol=0)
+
+
+def test_chunked_prefill_matches_single_shot(setup):
+    path, family, cfg, per_block, backend = setup
+    rng = np.random.RandomState(3)
+    hidden = rng.randn(1, 12, cfg.hidden_size).astype(np.float32)
+    full = np.asarray(backend.forward(hidden))
+
+    small = TransformerBackend(
+        family,
+        cfg,
+        backend.params,
+        first_block=0,
+        n_blocks=backend.n_blocks,
+        memory_cache=MemoryCache(None),
+        compute_dtype=jnp.float32,
+        use_flash=False,
+        max_chunk_size_bytes=4 * cfg.num_attention_heads * 12 * 4,  # forces 4-token chunks
+    )
+    assert len(small._chunk_plan(1, 12)) > 1
+    kv = _alloc_kv(small, 1, 16)
+    out, kv = small.inference_step(hidden, kv, 0)
+    np.testing.assert_allclose(np.asarray(out), full, atol=3e-5, rtol=0)
+
+
+def test_beam_hypo_reorder(setup):
+    path, family, cfg, per_block, backend = setup
+    rng = np.random.RandomState(4)
+    prefix = rng.randn(2, 4, cfg.hidden_size).astype(np.float32)
+    kv = _alloc_kv(backend, 2, 8)
+    _, kv = backend.inference_step(prefix, kv, 0)
+
+    # swap the two hypotheses, then decode: lane 0 must see lane 1's history
+    nxt = rng.randn(2, 1, cfg.hidden_size).astype(np.float32)
+    out_swapped, _ = backend.inference_step(nxt, kv, 4, hypo_ids=np.array([1, 0]))
+
+    swapped_prefix = prefix[::-1].copy()
+    kv2 = _alloc_kv(backend, 2, 8)
+    _, kv2 = backend.inference_step(swapped_prefix, kv2, 0)
+    expected, _ = backend.inference_step(nxt, kv2, 4)
+    np.testing.assert_allclose(np.asarray(out_swapped), np.asarray(expected), atol=3e-5, rtol=0)
+
+
+def test_deep_prompts_affect_output(setup):
+    path, family, cfg, per_block, backend = setup
+    rng = np.random.RandomState(5)
+    hidden = rng.randn(1, 6, cfg.hidden_size).astype(np.float32)
+    prompts = rng.randn(backend.n_blocks, 1, 2, cfg.hidden_size).astype(np.float32)
+
+    plain = backend.forward(hidden)
+    prompted = backend.forward(hidden, prompts=prompts)
+    assert not np.allclose(np.asarray(plain), np.asarray(prompted))
+
+    # inference path agrees with forward path
+    kv = _alloc_kv(backend, 1, 8)
+    out, _ = backend.inference_step(hidden, kv, 0, prompts=prompts)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(prompted), atol=3e-5, rtol=0)
+
+
+def test_backward_grads_match_autodiff_of_per_block(setup):
+    path, family, cfg, per_block, backend = setup
+    rng = np.random.RandomState(6)
+    hidden = rng.randn(1, 5, cfg.hidden_size).astype(np.float32)
+    grad_out = rng.randn(1, 5, cfg.hidden_size).astype(np.float32)
+
+    def chain(h):
+        for params in per_block:
+            h, _ = family.block_apply(params, h, None, 0, cfg)
+        return h
+
+    _, vjp = jax.vjp(chain, jnp.asarray(hidden))
+    (expected_grad,) = vjp(jnp.asarray(grad_out))
+
+    grad_hidden, grad_prompts = backend.backward(hidden, grad_out)
+    assert grad_prompts is None
+    np.testing.assert_allclose(np.asarray(grad_hidden), np.asarray(expected_grad), atol=3e-5, rtol=0)
+
+
+def test_backward_deep_prompt_grads(setup):
+    path, family, cfg, per_block, backend = setup
+    rng = np.random.RandomState(7)
+    hidden = rng.randn(1, 5, cfg.hidden_size).astype(np.float32)
+    grad_out = rng.randn(1, 5, cfg.hidden_size).astype(np.float32)
+    prompts = rng.randn(backend.n_blocks, 1, 2, cfg.hidden_size).astype(np.float32)
+
+    grad_hidden, grad_prompts = backend.backward(hidden, grad_out, prompts=prompts)
+    assert grad_prompts.shape == prompts.shape
+    assert np.abs(np.asarray(grad_prompts)).sum() > 0
+
+
+def test_cache_overflow_rejected(setup):
+    path, family, cfg, per_block, backend = setup
+    kv = _alloc_kv(backend, 1, 4)
+    hidden = np.random.randn(1, 6, cfg.hidden_size).astype(np.float32)
+    with pytest.raises(ValueError, match="overflows"):
+        backend.inference_step(hidden, kv, 0)
+
+
+def test_bucket_length():
+    assert bucket_length(1) == 8
+    assert bucket_length(8) == 8
+    assert bucket_length(9) == 16
+    assert bucket_length(4096) == 4096
+    assert bucket_length(5000) == 8192

@@ -1,0 +1,89 @@
+"""Directory-layer tests: declare/fetch module infos + span computation over a
+real localhost DHT swarm (reference utils/dht.py semantics)."""
+
+import asyncio
+import time
+
+from petals_tpu.data_structures import PeerID, ServerInfo, ServerState, make_uid
+from petals_tpu.dht import DHTNode
+from petals_tpu.utils.dht_utils import (
+    ModuleDirectory,
+    compute_spans,
+    declare_active_modules,
+    get_remote_module_infos,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_declare_and_fetch_across_swarm():
+    async def main():
+        boot = await DHTNode.create(maintenance_period=1000)
+        server_a = await DHTNode.create(initial_peers=[boot.own_addr], maintenance_period=1000)
+        server_b = await DHTNode.create(initial_peers=[boot.own_addr], maintenance_period=1000)
+        client = await DHTNode.create(
+            initial_peers=[boot.own_addr], client_mode=True, maintenance_period=1000
+        )
+        try:
+            uids = [make_uid("m", i) for i in range(6)]
+            exp = time.time() + 60
+            # A serves blocks 0..3, B serves 2..5
+            await declare_active_modules(
+                server_a, uids[0:4], ServerInfo(ServerState.ONLINE, 100.0, start_block=0, end_block=4), exp
+            )
+            await declare_active_modules(
+                server_b, uids[2:6], ServerInfo(ServerState.JOINING, 50.0, start_block=2, end_block=6), exp
+            )
+
+            directory = ModuleDirectory(client)
+            infos = await directory.fetch(uids)
+            assert all(info is not None for info in infos[:4])
+            assert server_a.peer_id in infos[0].servers
+            assert infos[0].servers[server_a.peer_id].throughput == 100.0
+            assert server_b.peer_id in infos[2].servers and server_a.peer_id in infos[2].servers
+            assert server_b.peer_id in infos[5].servers
+
+            # contact addresses learned from announcements
+            assert directory.addr_of(server_a.peer_id) == server_a.own_addr
+            assert directory.addr_of(server_b.peer_id) == server_b.own_addr
+
+            # spans: min_state=ONLINE hides the JOINING server
+            spans = compute_spans(infos, min_state=ServerState.ONLINE)
+            assert set(spans) == {server_a.peer_id}
+            assert (spans[server_a.peer_id].start, spans[server_a.peer_id].end) == (0, 4)
+
+            spans = compute_spans(infos, min_state=ServerState.JOINING)
+            assert (spans[server_b.peer_id].start, spans[server_b.peer_id].end) == (2, 6)
+        finally:
+            await asyncio.gather(*(n.shutdown() for n in (boot, server_a, server_b, client)))
+
+    run(main())
+
+
+def test_unserved_blocks_are_none():
+    async def main():
+        boot = await DHTNode.create(maintenance_period=1000)
+        try:
+            infos, _ = await get_remote_module_infos(boot, [make_uid("m", 0), make_uid("m", 1)])
+            assert infos == [None, None]
+        finally:
+            await boot.shutdown()
+
+    run(main())
+
+
+def test_compute_spans_non_contiguous_keeps_latest():
+    pid = PeerID.generate()
+    info = ServerInfo(ServerState.ONLINE, 1.0)
+    from petals_tpu.data_structures import RemoteModuleInfo
+
+    module_infos = [
+        RemoteModuleInfo("m.0", {pid: info}),
+        None,
+        RemoteModuleInfo("m.2", {pid: info}),
+        RemoteModuleInfo("m.3", {pid: info}),
+    ]
+    spans = compute_spans(module_infos)
+    assert (spans[pid].start, spans[pid].end) == (2, 4)

@@ -1,0 +1,183 @@
+"""Server runtime tests: a real Server process (in-loop) serving a tiny model,
+driven through raw RPC (reference handler semantics: rpc_info / rpc_forward /
+rpc_backward / rpc_inference session)."""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
+from petals_tpu.rpc import RpcClient, RpcError
+from petals_tpu.rpc.serialization import deserialize_array, serialize_array
+from petals_tpu.server.server import Server, default_dht_prefix
+from tests.utils import make_tiny_llama
+
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    return make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _start_server(model_path, **kwargs):
+    server = Server(model_path, compute_dtype=jnp.float32, use_flash=False, **kwargs)
+    await server.start()
+    client = await RpcClient.connect(server.rpc_server.host, server.rpc_server.port)
+    return server, client
+
+
+def test_info_forward_backward(model_path):
+    async def main():
+        server, client = await _start_server(model_path)
+        try:
+            prefix = default_dht_prefix(model_path)
+            info = await client.call("ptu.info", {}, timeout=10)
+            assert info["first_block"] == 0 and info["n_blocks"] == server.cfg.num_hidden_layers
+            assert info["cache_tokens_available"] > 0
+
+            uids = CHAIN_DELIMITER.join(make_uid(prefix, i) for i in range(server.cfg.num_hidden_layers))
+            rng = np.random.RandomState(0)
+            hidden = rng.randn(1, 7, server.cfg.hidden_size).astype(np.float32)
+
+            result = await client.call(
+                "ptu.forward",
+                {"uids": uids, "tensors": {"hidden": serialize_array(hidden)}},
+                timeout=60,
+            )
+            out = deserialize_array(result["tensors"]["hidden"])
+            expected = np.asarray(server.backend.forward(hidden))
+            np.testing.assert_allclose(out, expected, atol=1e-5, rtol=0)
+
+            grad_out = rng.randn(*hidden.shape).astype(np.float32)
+            result = await client.call(
+                "ptu.backward",
+                {
+                    "uids": uids,
+                    "tensors": {
+                        "hidden": serialize_array(hidden),
+                        "grad_out": serialize_array(grad_out),
+                    },
+                },
+                timeout=60,
+            )
+            grad = deserialize_array(result["tensors"]["grad_hidden"])
+            assert grad.shape == hidden.shape and np.abs(grad).sum() > 0
+
+            # partial chain (single mid-block) also works
+            result = await client.call(
+                "ptu.forward",
+                {"uids": make_uid(prefix, 1), "tensors": {"hidden": serialize_array(hidden)}},
+                timeout=60,
+            )
+            assert deserialize_array(result["tensors"]["hidden"]).shape == hidden.shape
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(main())
+
+
+def test_inference_session_stream(model_path):
+    async def main():
+        server, client = await _start_server(model_path)
+        try:
+            prefix = default_dht_prefix(model_path)
+            n = server.cfg.num_hidden_layers
+            uids = CHAIN_DELIMITER.join(make_uid(prefix, i) for i in range(n))
+            rng = np.random.RandomState(1)
+            total = 6
+            hidden = rng.randn(1, total, server.cfg.hidden_size).astype(np.float32)
+            expected = np.asarray(server.backend.forward(hidden))
+
+            stream = await client.open_stream("ptu.inference")
+            await stream.send({"uids": uids, "max_length": 16, "batch_size": 1})
+            ack = await stream.recv(timeout=30)
+            assert ack.get("session_open") and ack["max_length"] == 16
+
+            # prefill 3 tokens, then decode one at a time
+            await stream.send({"tensors": {"hidden": serialize_array(hidden[:, :3])}})
+            out = await stream.recv(timeout=60)
+            assert out["position"] == 3
+            parts = [deserialize_array(out["tensors"]["hidden"])]
+            for t in range(3, total):
+                await stream.send({"tensors": {"hidden": serialize_array(hidden[:, t : t + 1])}})
+                out = await stream.recv(timeout=60)
+                parts.append(deserialize_array(out["tensors"]["hidden"]))
+            stitched = np.concatenate(parts, axis=1)
+            np.testing.assert_allclose(stitched, expected, atol=1e-5, rtol=0)
+
+            # rollback (speculative decoding support): rewind to position 3 and redo
+            await stream.send(
+                {"tensors": {"hidden": serialize_array(hidden[:, 3:4])}, "start_from_position": 3}
+            )
+            out = await stream.recv(timeout=60)
+            assert out["position"] == 4
+            np.testing.assert_allclose(
+                deserialize_array(out["tensors"]["hidden"]), expected[:, 3:4], atol=1e-5, rtol=0
+            )
+            await stream.end()
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(main())
+
+
+def test_inference_rejects_overflow_and_bad_chain(model_path):
+    async def main():
+        server, client = await _start_server(model_path)
+        try:
+            prefix = default_dht_prefix(model_path)
+            uids = make_uid(prefix, 0)
+            stream = await client.open_stream("ptu.inference")
+            await stream.send({"uids": uids, "max_length": 4, "batch_size": 1})
+            await stream.recv(timeout=30)
+            big = np.zeros((1, 6, server.cfg.hidden_size), np.float32)
+            await stream.send({"tensors": {"hidden": serialize_array(big)}})
+            with pytest.raises(RpcError, match="exceeds max_length"):
+                await stream.recv(timeout=30)
+
+            with pytest.raises(RpcError, match="does not match served prefix"):
+                await client.call(
+                    "ptu.forward",
+                    {"uids": "wrong.0", "tensors": {"hidden": serialize_array(big)}},
+                    timeout=30,
+                )
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(main())
+
+
+def test_server_announces_to_dht(model_path):
+    async def main():
+        from petals_tpu.dht import DHTNode
+        from petals_tpu.utils.dht_utils import ModuleDirectory, compute_spans
+
+        boot = await DHTNode.create(maintenance_period=1000)
+        server, client = await _start_server(model_path, initial_peers=[boot.own_addr])
+        try:
+            reader = await DHTNode.create(
+                initial_peers=[boot.own_addr], client_mode=True, maintenance_period=1000
+            )
+            directory = ModuleDirectory(reader)
+            infos = await directory.fetch(server.module_uids)
+            assert all(info is not None for info in infos)
+            spans = compute_spans(infos)
+            assert server.dht.peer_id in spans
+            span = spans[server.dht.peer_id]
+            assert (span.start, span.end) == (0, server.cfg.num_hidden_layers)
+            assert directory.addr_of(server.dht.peer_id) == server.dht.own_addr
+            await reader.shutdown()
+        finally:
+            await client.close()
+            await server.shutdown()
+            await boot.shutdown()
+
+    run(main())
