@@ -1,0 +1,5 @@
+from petals_tpu.client.config import ClientConfig
+from petals_tpu.client.inference_session import InferenceSession
+from petals_tpu.client.remote_sequential import RemoteSequential
+
+__all__ = ["ClientConfig", "InferenceSession", "RemoteSequential"]

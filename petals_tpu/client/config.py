@@ -1,0 +1,37 @@
+"""Client configuration (counterpart of reference src/petals/client/config.py:13-35)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class ClientConfig:
+    initial_peers: Sequence[str] = ()  # PeerAddr strings "host:port/peer_id"
+    dht_prefix: Optional[str] = None
+
+    show_route: bool = False  # print the chosen chain on (re)builds
+    allowed_servers: Optional[Sequence[str]] = None  # peer id hex allowlist
+    blocked_servers: Optional[Sequence[str]] = None  # peer id hex blocklist
+
+    request_timeout: float = 3 * 60.0
+    session_timeout: float = 30 * 60.0
+    connect_timeout: float = 5.0
+    update_period: float = 60.0
+
+    max_retries: Optional[int] = None  # None = retry forever (PETALS_TPU_MAX_RETRIES overrides)
+    min_backoff: float = 1.0
+    max_backoff: float = 60.0
+    ban_timeout: float = 15.0
+
+    max_pinged: int = 3  # servers pinged per routing update
+    active_adapter: Optional[str] = None
+
+    use_server_to_server: bool = True  # direct server->server activation push
+
+    def __post_init__(self):
+        if self.max_retries is None:
+            env = os.environ.get("PETALS_TPU_MAX_RETRIES")
+            self.max_retries = int(env) if env else None

@@ -1,0 +1,30 @@
+"""Client-side weight loading: embeddings + norms + head only
+(counterpart of reference src/petals/client/from_pretrained.py:19-84, which
+skips downloading `model.layers.*` shards — here we read only the client-held
+tensors from the local checkpoint)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from petals_tpu.models.registry import ModelFamily
+from petals_tpu.server.from_pretrained import (
+    _load_tensors_with_prefixes,
+    get_block_config,
+    resolve_model_path,
+)
+
+
+def load_client_params(model_name_or_path: str, *, dtype=jnp.float32, family=None, cfg=None) -> dict:
+    path = resolve_model_path(model_name_or_path)
+    if family is None or cfg is None:
+        family, cfg = get_block_config(path)
+    assert family.hf_to_client_params is not None, f"{family.name} has no client mapping"
+    # single pass over the checkpoint; client mappings match absolute names
+    tensors = _load_tensors_with_prefixes(path, family.hf_client_prefixes, keep_full_names=True)
+    params = family.hf_to_client_params(tensors, cfg)
+    cast = lambda x: (
+        jnp.asarray(x, dtype) if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x)
+    )
+    return jax.tree_util.tree_map(cast, params)

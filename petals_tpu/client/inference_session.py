@@ -1,0 +1,306 @@
+"""Client-side autoregressive inference over a chain of servers
+(counterpart of reference src/petals/client/inference_session.py:26-414).
+
+- ``_ServerInferenceSession`` drives one server's bidirectional inference
+  stream: open with (uids, max_length), then step (hidden, prompts, hypo_ids,
+  start_from_position). It records the ``history`` of inputs it has sent so a
+  replacement server's KV cache can be rebuilt after a failure.
+- ``InferenceSession`` chains per-span sessions across the whole model. On a
+  step failure it bans the peer, rebuilds the chain suffix starting at the
+  failed span's START block, and replays the recorded history through the new
+  suffix so every replacement server re-prefills its KV cache — generation
+  continues without the caller noticing (reference :284-391).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from petals_tpu.client.routing.sequence_manager import RemoteSequenceManager
+from petals_tpu.data_structures import CHAIN_DELIMITER, RemoteSpanInfo
+from petals_tpu.rpc.client import RpcClient, StreamCall
+from petals_tpu.rpc.serialization import CompressionType, deserialize_array, serialize_array
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class _ServerInferenceSession:
+    def __init__(
+        self,
+        span: RemoteSpanInfo,
+        uids: Sequence[str],
+        stream: StreamCall,
+        *,
+        max_length: int,
+        step_timeout: float,
+    ):
+        self.span = span
+        self.uids = list(uids)
+        self.stream = stream
+        self.max_length = max_length
+        self.step_timeout = step_timeout
+        self.position = 0
+        self.history: List[np.ndarray] = []  # inputs sent so far (for failover replay)
+        self.closed = False
+
+    @classmethod
+    async def create(
+        cls,
+        seq_manager: RemoteSequenceManager,
+        span: RemoteSpanInfo,
+        uids: Sequence[str],
+        *,
+        max_length: int,
+        batch_size: int = 1,
+        step_timeout: float = 5 * 60,
+    ) -> "_ServerInferenceSession":
+        stub: RpcClient = await seq_manager.get_stub(span.peer_id)
+        stream = await stub.open_stream("ptu.inference")
+        await stream.send(
+            {
+                "uids": CHAIN_DELIMITER.join(uids),
+                "max_length": max_length,
+                "batch_size": batch_size,
+                "active_adapter": seq_manager.config.active_adapter,
+            }
+        )
+        ack = await stream.recv(timeout=step_timeout)
+        assert ack.get("session_open"), f"Unexpected open reply: {ack}"
+        return cls(span, uids, stream, max_length=max_length, step_timeout=step_timeout)
+
+    async def step(
+        self,
+        hidden: np.ndarray,
+        *,
+        prompts: Optional[np.ndarray] = None,
+        hypo_ids: Optional[np.ndarray] = None,
+        start_from_position: Optional[int] = None,
+    ) -> np.ndarray:
+        if start_from_position is not None:
+            self._rollback_history(start_from_position)
+
+        msg = {"tensors": {"hidden": serialize_array(hidden, CompressionType.NONE)}}
+        if prompts is not None:
+            msg["tensors"]["prompts"] = serialize_array(prompts)
+        if hypo_ids is not None:
+            msg["tensors"]["hypo_ids"] = serialize_array(np.asarray(hypo_ids, np.int64))
+        if start_from_position is not None:
+            msg["start_from_position"] = int(start_from_position)
+        await self.stream.send(msg)
+        reply = await self.stream.recv(timeout=self.step_timeout)
+        out = deserialize_array(reply["tensors"]["hidden"])
+        self.position = reply["position"]
+        self.history.append(np.asarray(hidden))
+        return out
+
+    def _rollback_history(self, new_position: int) -> None:
+        self.position = new_position
+        kept, total = [], 0
+        for h in self.history:
+            if total >= new_position:
+                break
+            take = min(h.shape[1], new_position - total)
+            kept.append(h[:, :take] if take < h.shape[1] else h)
+            total += take
+        self.history = kept
+
+    def full_history(self) -> Optional[np.ndarray]:
+        if not self.history:
+            return None
+        return np.concatenate(self.history, axis=1)
+
+    async def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            try:
+                await self.stream.end()
+            except Exception:
+                pass
+            await self.stream.cancel()
+
+
+class InferenceSession:
+    """Whole-model autoregressive session with mid-generation failover."""
+
+    def __init__(self, seq_manager: RemoteSequenceManager, max_length: int, batch_size: int = 1):
+        self.seq_manager = seq_manager
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self._sessions: List[_ServerInferenceSession] = []
+        self._position = 0
+        self._closed = False
+        self._max_retries = seq_manager.config.max_retries
+        self._last_prompts: Optional[np.ndarray] = None
+
+    @property
+    def position(self) -> int:
+        return self._position
+
+    @position.setter
+    def position(self, new_position: int) -> None:
+        """Roll every server's cache back (speculative-decoding support;
+        reference inference_session.py:242-247)."""
+        assert new_position <= self._position, "can only roll back"
+        self._position = new_position
+        # servers are told via start_from_position on the next step (step()
+        # notices session.position > self._position)
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.seq_manager.block_uids)
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.close()
+
+    async def step(
+        self,
+        hidden: np.ndarray,
+        *,
+        prompts: Optional[np.ndarray] = None,  # [num_blocks, batch, pre_seq, hidden_size]
+        hypo_ids: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Run ``hidden`` through all remote blocks, updating every server's cache."""
+        assert not self._closed
+        if prompts is not None:
+            self._last_prompts = prompts
+
+        n_input_tokens = hidden.shape[1]
+        if self._position + n_input_tokens > self.max_length:
+            raise ValueError(
+                f"Maximum length exceeded: prefix {self._position} + current {n_input_tokens}"
+                f" exceeds pre-allocated maximum {self.max_length}"
+            )
+
+        if not self._sessions:
+            chain = await self.seq_manager.make_sequence(
+                0, self.num_blocks, mode="min_latency",
+                cache_tokens_needed=self.batch_size * self.max_length,
+            )
+            self._sessions = await self._enter_server_sessions(chain)
+
+        attempt = 0
+        block_idx = 0
+        inputs = np.asarray(hidden)
+        while block_idx < self.num_blocks:
+            server_idx = self._find_session_index(block_idx)
+            session = None
+            try:
+                if server_idx is None:
+                    raise RuntimeError(f"No active session covers block {block_idx}")
+                session = self._sessions[server_idx]
+                span = session.span
+                server_prompts = prompts[span.start : span.end] if prompts is not None else None
+                rollback = self._position if session.position > self._position else None
+
+                outputs = await session.step(
+                    inputs,
+                    prompts=server_prompts,
+                    hypo_ids=hypo_ids,
+                    start_from_position=rollback,
+                )
+                assert outputs.shape == inputs.shape, f"{outputs.shape} != {inputs.shape}"
+                inputs = outputs
+                block_idx = span.end
+                self.seq_manager.on_request_success(span.peer_id)
+            except Exception as e:
+                attempt += 1
+                peer = session.span.peer_id if session is not None else None
+                self.seq_manager.on_request_failure(peer)
+                if self._max_retries is not None and attempt > self._max_retries:
+                    raise
+                delay = min(
+                    self.seq_manager.config.min_backoff * (2 ** (attempt - 1)),
+                    self.seq_manager.config.max_backoff,
+                )
+                logger.warning(
+                    f"Caught exception from block {block_idx} "
+                    f"(peer {peer.to_string()[:8] if peer else '?'}), retrying in {delay:.1f}s: {e}"
+                )
+                await asyncio.sleep(delay)
+                block_idx = await self._repair_chain(block_idx)
+
+        self._position += n_input_tokens
+        return inputs
+
+    def _find_session_index(self, block_idx: int) -> Optional[int]:
+        for i, session in enumerate(self._sessions):
+            if session.span.start == block_idx and not session.closed:
+                return i
+        return None
+
+    async def _enter_server_sessions(self, chain: List[RemoteSpanInfo]) -> List[_ServerInferenceSession]:
+        sessions = []
+        try:
+            for span in chain:
+                uids = self.seq_manager.block_uids[span.start : span.end]
+                session = await _ServerInferenceSession.create(
+                    self.seq_manager,
+                    span,
+                    uids,
+                    max_length=self.max_length,
+                    batch_size=self.batch_size,
+                )
+                sessions.append(session)
+            return sessions
+        except Exception:
+            for session in sessions:
+                await session.close()
+            raise
+
+    async def _repair_chain(self, failed_block: int) -> int:
+        """Rebuild the chain suffix from the failed span's START, replaying
+        recorded history into the fresh servers (reference _update_sequence).
+        Returns the block index from which the caller must resume."""
+        # resume point: start of the span that covered failed_block (its inputs
+        # are recorded in that session's history)
+        resume = 0
+        replay: Optional[np.ndarray] = None
+        keep: List[_ServerInferenceSession] = []
+        drop: List[_ServerInferenceSession] = []
+        for session in self._sessions:
+            if session.span.start <= failed_block < session.span.end:
+                resume = session.span.start
+        for session in self._sessions:
+            if session.span.end <= resume and not session.closed:
+                keep.append(session)
+            else:
+                if session.span.start == resume and replay is None:
+                    replay = session.full_history()
+                drop.append(session)
+        for session in drop:
+            await session.close()
+
+        await self.seq_manager.update()
+        new_chain = await self.seq_manager.make_sequence(
+            resume, self.num_blocks, mode="min_latency",
+            cache_tokens_needed=self.batch_size * self.max_length,
+        )
+        new_sessions = await self._enter_server_sessions(new_chain)
+        self._sessions = keep + new_sessions
+
+        if replay is not None and replay.shape[1] > 0:
+            # re-prefill the whole new suffix with everything sent before this step
+            chunk = replay
+            for session in new_sessions:
+                span = session.span
+                server_prompts = (
+                    self._last_prompts[span.start : span.end]
+                    if self._last_prompts is not None
+                    else None
+                )
+                chunk = await session.step(chunk, prompts=server_prompts)
+        return resume
+
+    async def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            for session in self._sessions:
+                await session.close()
+            self._sessions = []

@@ -1,0 +1,100 @@
+"""Distributed causal-LM running embeddings + LM head locally and all
+transformer blocks through the swarm (counterpart of reference
+Distributed*ForCausalLM in src/petals/models/*/model.py, unified across
+families via the registry's client hooks).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from petals_tpu.client.config import ClientConfig
+from petals_tpu.client.from_pretrained import load_client_params
+from petals_tpu.client.ptune import PTuneConfig, PTuneMixin
+from petals_tpu.client.remote_generation import RemoteGenerationMixin
+from petals_tpu.client.remote_sequential import RemoteSequential
+from petals_tpu.data_structures import make_uid
+from petals_tpu.server.from_pretrained import get_block_config
+from petals_tpu.server.server import default_dht_prefix
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class DistributedModelForCausalLM(RemoteGenerationMixin, PTuneMixin):
+    """Embeddings/norm/head local (JAX), blocks remote (the swarm)."""
+
+    def __init__(
+        self,
+        family,
+        cfg,
+        client_params: dict,
+        remote: RemoteSequential,
+        *,
+        ptune: Optional[PTuneConfig] = None,
+    ):
+        self.family = family
+        self.cfg = cfg
+        self.client_params = client_params
+        self.remote = remote
+        self._embed_jit = jax.jit(lambda p, ids: family.client_embed(p, ids, cfg))
+        self._head_jit = jax.jit(lambda p, h: family.client_head(p, h, cfg))
+        self.init_ptune(ptune)
+
+    # ------------------------------------------------------------------ construction
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        model_name_or_path: str,
+        *,
+        initial_peers: Sequence[str],
+        config: Optional[ClientConfig] = None,
+        dht_prefix: Optional[str] = None,
+        dtype=jnp.float32,
+        ptune: Optional[PTuneConfig] = None,
+        **config_overrides,
+    ) -> "DistributedModelForCausalLM":
+        family, cfg = get_block_config(model_name_or_path)
+        if config is None:
+            config = ClientConfig(initial_peers=list(initial_peers), **config_overrides)
+        prefix = dht_prefix or config.dht_prefix or default_dht_prefix(model_name_or_path)
+        block_uids = [make_uid(prefix, i) for i in range(cfg.num_hidden_layers)]
+        client_params = load_client_params(model_name_or_path, dtype=dtype, family=family, cfg=cfg)
+        remote = RemoteSequential(config, block_uids)
+        return cls(family, cfg, client_params, remote, ptune=ptune)
+
+    # ------------------------------------------------------------------ local compute
+
+    def embed(self, input_ids, *, with_prompts: bool = True) -> jnp.ndarray:
+        hidden = self._embed_jit(self.client_params, np.asarray(input_ids))
+        return self.apply_shallow_prompts(hidden) if with_prompts else hidden
+
+    def lm_logits(self, hidden) -> jnp.ndarray:
+        return self._head_jit(self.client_params, jnp.asarray(hidden))
+
+    # ------------------------------------------------------------------ full forward
+
+    def forward(self, input_ids) -> jnp.ndarray:
+        """Logits for a whole sequence via stateless swarm forward."""
+        hidden = self.embed(input_ids)
+        hidden = self.remote.forward(np.asarray(hidden), prompts=self.deep_prompts_for_batch(hidden.shape[0]))
+        logits = self.lm_logits(hidden)
+        return self.strip_shallow_prompt_logits(logits)
+
+    __call__ = forward
+
+    def close(self) -> None:
+        self.remote.close()
+
+
+class AutoDistributedModelForCausalLM:
+    """Dispatch on checkpoint model_type (reference utils/auto_config.py:82-99)."""
+
+    @classmethod
+    def from_pretrained(cls, model_name_or_path: str, **kwargs) -> DistributedModelForCausalLM:
+        return DistributedModelForCausalLM.from_pretrained(model_name_or_path, **kwargs)

@@ -1,0 +1,67 @@
+"""Unary forward/backward RPC calls to one server
+(counterpart of reference src/petals/client/remote_forward_backward.py:67-149;
+the reference's unary-vs-stream switch and manual chunking are handled by the
+framed transport, which carries large tensors in one call up to the frame cap).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from petals_tpu.client.routing.sequence_manager import RemoteSequenceManager
+from petals_tpu.data_structures import CHAIN_DELIMITER, RemoteSpanInfo
+from petals_tpu.rpc.serialization import CompressionType, deserialize_array, serialize_array
+
+
+async def run_remote_forward(
+    seq_manager: RemoteSequenceManager,
+    span: RemoteSpanInfo,
+    hidden: np.ndarray,
+    prompts: Optional[np.ndarray] = None,
+    *,
+    timeout: Optional[float] = None,
+) -> np.ndarray:
+    stub = await seq_manager.get_stub(span.peer_id)
+    uids = CHAIN_DELIMITER.join(seq_manager.block_uids[span.start : span.end])
+    tensors = {"hidden": serialize_array(hidden, CompressionType.NONE)}
+    if prompts is not None:
+        tensors["prompts"] = serialize_array(prompts)
+    payload = {"uids": uids, "tensors": tensors}
+    if seq_manager.config.active_adapter:
+        payload["active_adapter"] = seq_manager.config.active_adapter
+    result = await stub.call(
+        "ptu.forward", payload, timeout=timeout or seq_manager.config.request_timeout
+    )
+    return deserialize_array(result["tensors"]["hidden"])
+
+
+async def run_remote_backward(
+    seq_manager: RemoteSequenceManager,
+    span: RemoteSpanInfo,
+    hidden: np.ndarray,
+    grad_out: np.ndarray,
+    prompts: Optional[np.ndarray] = None,
+    *,
+    timeout: Optional[float] = None,
+) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    stub = await seq_manager.get_stub(span.peer_id)
+    uids = CHAIN_DELIMITER.join(seq_manager.block_uids[span.start : span.end])
+    tensors = {
+        "hidden": serialize_array(hidden, CompressionType.NONE),
+        "grad_out": serialize_array(grad_out, CompressionType.NONE),
+    }
+    if prompts is not None:
+        tensors["prompts"] = serialize_array(prompts)
+    payload = {"uids": uids, "tensors": tensors}
+    if seq_manager.config.active_adapter:
+        payload["active_adapter"] = seq_manager.config.active_adapter
+    result = await stub.call(
+        "ptu.backward", payload, timeout=timeout or seq_manager.config.request_timeout
+    )
+    grad_hidden = deserialize_array(result["tensors"]["grad_hidden"])
+    grad_prompts = None
+    if "grad_prompts" in result["tensors"]:
+        grad_prompts = deserialize_array(result["tensors"]["grad_prompts"])
+    return grad_hidden, grad_prompts

@@ -1,0 +1,149 @@
+"""Autoregressive generation against remote KV caches
+(counterpart of reference src/petals/client/remote_generation.py:84-164, which
+adapts HF GenerationMixin; this build implements the decoding loops natively —
+greedy, temperature/top-k/top-p sampling — over the swarm session, with
+multi-call chat-style reuse of one session and token-skip resume).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def sample_next_token(
+    logits: np.ndarray,  # [batch, vocab] float32
+    *,
+    do_sample: bool = False,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    rng: Optional[np.random.RandomState] = None,
+) -> np.ndarray:
+    if not do_sample or temperature == 0.0:  # temperature->0 is greedy by convention
+        return logits.argmax(axis=-1)
+
+    rng = rng or np.random
+    logits = logits.astype(np.float64)
+    if temperature != 1.0:
+        logits = logits / temperature
+    if top_k is not None and top_k > 0:
+        kth = np.partition(logits, -top_k, axis=-1)[:, -top_k][:, None]
+        logits = np.where(logits < kth, -np.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_idx = np.argsort(-logits, axis=-1)
+        sorted_logits = np.take_along_axis(logits, sorted_idx, axis=-1)
+        probs = _softmax(sorted_logits)
+        cumulative = probs.cumsum(axis=-1)
+        cutoff = cumulative - probs > top_p  # keep first token above the nucleus
+        sorted_logits[cutoff] = -np.inf
+        restored = np.full_like(logits, -np.inf)
+        np.put_along_axis(restored, sorted_idx, sorted_logits, axis=-1)
+        logits = restored
+    probs = _softmax(logits)
+    out = np.empty(logits.shape[0], dtype=np.int64)
+    for i in range(logits.shape[0]):
+        out[i] = rng.choice(probs.shape[-1], p=probs[i])
+    return out
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    x = x - x.max(axis=-1, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+class RemoteGenerationMixin:
+    """Requires: self.embed(ids)->hidden, self.lm_logits(hidden)->logits,
+    self.remote (RemoteSequential), self.active_session management."""
+
+    _active_session = None
+
+    def generate(
+        self,
+        input_ids: np.ndarray,  # [batch, seq] int
+        *,
+        max_new_tokens: int = 20,
+        max_length: Optional[int] = None,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_token_id: Optional[int] = None,
+        session=None,
+        seed: Optional[int] = None,
+        prompts: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        input_ids = np.asarray(input_ids)
+        batch, prompt_len = input_ids.shape
+        rng = np.random.RandomState(seed) if seed is not None else np.random.RandomState()
+
+        ptune = getattr(self, "ptune", None)
+        pre_seq = ptune.pre_seq_len if (ptune and ptune.tuning_mode) else 0
+
+        own_session = False
+        if session is None:
+            session = self._active_session
+        if session is None:
+            total = max_length if max_length is not None else pre_seq + prompt_len + max_new_tokens
+            session = self.remote.inference_session(max_length=total, batch_size=batch)
+            own_session = True
+        elif max_length is None:
+            # cache must hold prompts + all tokens except the final sampled one
+            max_new_tokens = min(max_new_tokens, session.max_length - pre_seq - prompt_len + 1)
+
+        try:
+            generated = input_ids
+            if prompts is None and hasattr(self, "deep_prompts_for_batch"):
+                prompts = self.deep_prompts_for_batch(batch)
+            # resume support: only feed tokens the session hasn't seen yet
+            # (session.position counts virtual prompt tokens too)
+            seen_tokens = max(session.position - pre_seq, 0) if session.position else 0
+            new_tokens = input_ids[:, seen_tokens:]
+            if new_tokens.shape[1] == 0:
+                raise ValueError(
+                    f"All {prompt_len} input tokens are already in the session "
+                    f"(position {session.position}); pass the sequence returned by the "
+                    f"previous generate() call, which includes the pending last token"
+                )
+            hidden = np.asarray(self.embed(new_tokens, with_prompts=session.position == 0))
+            out_hidden = session.step(hidden, prompts=prompts)
+            logits = np.asarray(self.lm_logits(out_hidden[:, -1:]))[:, 0]
+
+            finished = np.zeros(batch, dtype=bool)
+            for i in range(max_new_tokens):
+                next_token = sample_next_token(
+                    logits,
+                    do_sample=do_sample,
+                    temperature=temperature,
+                    top_k=top_k,
+                    top_p=top_p,
+                    rng=rng,
+                )
+                if eos_token_id is not None:
+                    next_token = np.where(finished, eos_token_id, next_token)
+                    finished |= next_token == eos_token_id
+                generated = np.concatenate([generated, next_token[:, None]], axis=1)
+                if eos_token_id is not None and finished.all():
+                    break
+                if i + 1 == max_new_tokens:
+                    # the final token is deliberately NOT fed to the servers: a
+                    # follow-up generate() on the same session sends it as part
+                    # of its unseen-suffix prefill (reference _skipped_tokens)
+                    break
+                if session.position + 1 > session.max_length:
+                    logger.warning("Session max_length reached; stopping generation")
+                    break
+                hidden = np.asarray(self.embed(next_token[:, None], with_prompts=False))
+                out_hidden = session.step(hidden, prompts=prompts)
+                logits = np.asarray(self.lm_logits(out_hidden[:, -1:]))[:, 0]
+            return generated
+        finally:
+            if own_session:
+                session.close()

@@ -1,0 +1,111 @@
+"""RemoteSequential: the chain of remote blocks as one callable module
+(counterpart of reference src/petals/client/remote_sequential.py:20-58)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from petals_tpu.client.config import ClientConfig
+from petals_tpu.client.inference_session import InferenceSession
+from petals_tpu.client.routing.sequence_manager import RemoteSequenceManager
+from petals_tpu.client.runtime import SwarmRuntime
+from petals_tpu.client.sequential_autograd import (
+    sequential_backward_batched,
+    sequential_forward_batched,
+)
+from petals_tpu.data_structures import ModuleUID
+
+
+class RemoteSequential:
+    """Synchronous facade over the async swarm stack."""
+
+    def __init__(
+        self,
+        config: ClientConfig,
+        block_uids: Sequence[ModuleUID],
+        *,
+        runtime: Optional[SwarmRuntime] = None,
+    ):
+        self.config = config
+        self.block_uids = tuple(block_uids)
+        self._owns_runtime = runtime is None
+        self.runtime = runtime or SwarmRuntime()
+        self.sequence_manager: RemoteSequenceManager = self.runtime.run(
+            RemoteSequenceManager.create(config, self.block_uids)
+        )
+
+    def __len__(self) -> int:
+        return len(self.block_uids)
+
+    def forward(self, hidden: np.ndarray, prompts: Optional[np.ndarray] = None) -> np.ndarray:
+        """Training-style forward (no server-side state); fault-tolerant."""
+        out, _, _ = self.runtime.run(
+            sequential_forward_batched(self.sequence_manager, np.asarray(hidden), prompts)
+        )
+        return out
+
+    __call__ = forward
+
+    def forward_with_state(self, hidden: np.ndarray, prompts: Optional[np.ndarray] = None):
+        """Forward returning (output, histories, spans) for a later backward."""
+        return self.runtime.run(
+            sequential_forward_batched(self.sequence_manager, np.asarray(hidden), prompts)
+        )
+
+    def backward(
+        self,
+        grad_out: np.ndarray,
+        histories: List,
+        spans: List,
+        prompts: Optional[np.ndarray] = None,
+    ):
+        return self.runtime.run(
+            sequential_backward_batched(self.sequence_manager, np.asarray(grad_out), histories, spans, prompts)
+        )
+
+    def inference_session(self, max_length: int, batch_size: int = 1) -> "SyncInferenceSession":
+        return SyncInferenceSession(
+            InferenceSession(self.sequence_manager, max_length, batch_size), self.runtime
+        )
+
+    def update_routing(self) -> None:
+        self.runtime.run(self.sequence_manager.update())
+
+    def close(self) -> None:
+        self.runtime.run(self.sequence_manager.shutdown())
+        if self._owns_runtime:
+            self.runtime.shutdown()
+
+
+class SyncInferenceSession:
+    """Blocking wrapper around the async InferenceSession."""
+
+    def __init__(self, session: InferenceSession, runtime: SwarmRuntime):
+        self._session = session
+        self._runtime = runtime
+
+    def step(self, hidden: np.ndarray, **kwargs) -> np.ndarray:
+        return self._runtime.run(self._session.step(np.asarray(hidden), **kwargs))
+
+    @property
+    def position(self) -> int:
+        return self._session.position
+
+    @position.setter
+    def position(self, value: int) -> None:
+        self._session.position = value
+
+    @property
+    def max_length(self) -> int:
+        return self._session.max_length
+
+    def close(self) -> None:
+        self._runtime.run(self._session.close())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

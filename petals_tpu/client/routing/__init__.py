@@ -1,0 +1,4 @@
+from petals_tpu.client.routing.sequence_manager import RemoteSequenceManager, MissingBlocksError
+from petals_tpu.client.routing.sequence_info import RemoteSequenceInfo
+
+__all__ = ["RemoteSequenceManager", "RemoteSequenceInfo", "MissingBlocksError"]

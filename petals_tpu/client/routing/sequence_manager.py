@@ -1,0 +1,294 @@
+"""The client's router (counterpart of reference
+src/petals/client/routing/sequence_manager.py:45-528).
+
+Keeps a DHT-refreshed view of the swarm and builds server chains:
+
+- ``mode="min_latency"`` (inference): Dijkstra over a graph whose nodes are
+  (block_index, serving peer) and whose edge costs combine peer-to-peer RTT,
+  per-block decode cost (1/inference throughput), and a penalty for servers
+  whose KV cache can't fit the session (reference sequence_manager.py:177-300).
+  RTTs come from a pluggable ``rtt_fn`` (wired to the ping aggregator).
+- ``mode="max_throughput"`` (training): per-span weighted random choice so load
+  spreads across the swarm (reference :302-324).
+
+Failures ban a peer with a streak-scaled timeout; successes reset the streak
+(reference :388-405 + hivemind Blacklist).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import random
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from petals_tpu.client.config import ClientConfig
+from petals_tpu.client.routing.sequence_info import RemoteSequenceInfo
+from petals_tpu.data_structures import ModuleUID, PeerID, RemoteSpanInfo
+from petals_tpu.dht.node import DHTNode
+from petals_tpu.dht.routing import PeerAddr
+from petals_tpu.rpc.client import RpcClient
+from petals_tpu.rpc.pool import ConnectionPool
+from petals_tpu.utils.dht_utils import ModuleDirectory
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+CACHE_MISS_PENALTY = 10.0  # seconds added when a server's KV cache can't fit us
+DEFAULT_RTT = 0.01
+
+
+class MissingBlocksError(RuntimeError):
+    def __init__(self, blocks):
+        super().__init__(
+            f"No servers are currently hosting blocks {blocks} (swarm may still be starting up)"
+        )
+
+
+class RemoteSequenceManager:
+    def __init__(self):
+        raise RuntimeError("Use `await RemoteSequenceManager.create(...)`")
+
+    @classmethod
+    async def create(
+        cls,
+        config: ClientConfig,
+        block_uids: Sequence[ModuleUID],
+        *,
+        dht: Optional[DHTNode] = None,
+        rtt_fn: Optional[Callable[[Optional[PeerID], PeerID], float]] = None,
+    ) -> "RemoteSequenceManager":
+        self = object.__new__(cls)
+        self.config = config
+        self.block_uids = tuple(block_uids)
+        self._owns_dht = dht is None
+        if dht is None:
+            dht = await DHTNode.create(initial_peers=config.initial_peers, client_mode=True)
+        self.dht = dht
+        self.directory = ModuleDirectory(dht)
+        self.state = RemoteSequenceInfo.make_empty(self.block_uids)
+        self.pool = ConnectionPool(own_peer_id=dht.peer_id, connect_timeout=config.connect_timeout)
+        self.rtt_fn = rtt_fn or (lambda src, dst: DEFAULT_RTT)
+        self._banned: Dict[PeerID, Tuple[float, int]] = {}  # peer -> (banned_until, streak)
+        self._update_lock = asyncio.Lock()
+        self._update_task = asyncio.create_task(self._update_loop())
+        return self
+
+    # ------------------------------------------------------------------ state upkeep
+
+    async def update(self) -> None:
+        async with self._update_lock:
+            infos = await self.directory.fetch(self.block_uids, active_adapter=self.config.active_adapter)
+            infos = self._apply_allow_block_lists(infos)
+            self.state.update_(infos)
+
+    def _apply_allow_block_lists(self, infos):
+        allowed = set(self.config.allowed_servers or [])
+        blocked = set(self.config.blocked_servers or [])
+        if not allowed and not blocked:
+            return infos
+        out = []
+        for info in infos:
+            if info is None:
+                out.append(None)
+                continue
+            servers = {
+                pid: si
+                for pid, si in info.servers.items()
+                if (not allowed or pid.to_string() in allowed) and pid.to_string() not in blocked
+            }
+            info.servers = servers
+            out.append(info if servers else None)
+        return out
+
+    async def _update_loop(self) -> None:
+        while True:
+            try:
+                await self.update()
+            except Exception as e:
+                logger.warning(f"Routing update failed: {e}")
+            await asyncio.sleep(self.config.update_period)
+
+    async def ensure_ready(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while self.state.last_updated_time is None or not self.state.spans_by_priority:
+            await self.update()
+            if self.state.spans_by_priority:
+                return
+            if time.monotonic() > deadline:
+                raise MissingBlocksError(list(range(len(self.block_uids))))
+            await asyncio.sleep(1.0)
+
+    # ------------------------------------------------------------------ bans
+
+    def on_request_failure(self, peer_id: Optional[PeerID]) -> None:
+        if peer_id is None:
+            return
+        _, streak = self._banned.get(peer_id, (0.0, 0))
+        duration = min(self.config.ban_timeout * (2**streak), 300.0)
+        self._banned[peer_id] = (time.monotonic() + duration, streak + 1)
+        logger.debug(f"Banned {peer_id} for {duration:.1f}s (streak {streak + 1})")
+
+    def on_request_success(self, peer_id: PeerID) -> None:
+        self._banned.pop(peer_id, None)
+
+    def _is_banned(self, peer_id: PeerID) -> bool:
+        entry = self._banned.get(peer_id)
+        if entry is None:
+            return False
+        until, streak = entry
+        if time.monotonic() >= until:
+            # ban expired; keep the streak so repeat offenders get longer bans
+            return False
+        return True
+
+    # ------------------------------------------------------------------ sequences
+
+    async def make_sequence(
+        self,
+        start_index: int = 0,
+        end_index: Optional[int] = None,
+        *,
+        mode: str = "min_latency",
+        cache_tokens_needed: Optional[int] = None,
+    ) -> List[RemoteSpanInfo]:
+        end_index = end_index if end_index is not None else len(self.block_uids)
+        if self.state.last_updated_time is None:
+            await self.ensure_ready()
+
+        if mode == "min_latency":
+            sequence = self._make_sequence_min_latency(start_index, end_index, cache_tokens_needed)
+        elif mode == "max_throughput":
+            sequence = self._make_sequence_max_throughput(start_index, end_index)
+        else:
+            raise ValueError(f"Unknown routing mode {mode!r}")
+
+        if not sequence:
+            # one forced refresh before giving up
+            await self.update()
+            sequence = (
+                self._make_sequence_min_latency(start_index, end_index, cache_tokens_needed)
+                if mode == "min_latency"
+                else self._make_sequence_max_throughput(start_index, end_index)
+            )
+        if not sequence:
+            missing = [
+                i
+                for i in range(start_index, end_index)
+                if not self._usable_spans_for_block(i)
+            ]
+            raise MissingBlocksError(missing)
+
+        if self.config.show_route:
+            route = " => ".join(
+                f"{s.peer_id.to_string()[:8]} [{s.start}:{s.end}] ({s.throughput:.1f} rps)"
+                for s in sequence
+            )
+            logger.info(f"Route found: {route}")
+        return sequence
+
+    def _usable_spans_for_block(self, block_idx: int) -> List[RemoteSpanInfo]:
+        return [
+            s for s in self.state.spans_containing_block[block_idx] if not self._is_banned(s.peer_id)
+        ]
+
+    def _make_sequence_max_throughput(self, start: int, end: int) -> List[RemoteSpanInfo]:
+        """Per-hop weighted random span choice (training load-spreading)."""
+        sequence: List[RemoteSpanInfo] = []
+        current = start
+        while current < end:
+            candidates = self._usable_spans_for_block(current)
+            if not candidates:
+                return []
+            weights = [max(s.throughput, 1e-3) for s in candidates]
+            chosen = random.choices(candidates, weights=weights, k=1)[0]
+            chosen = RemoteSpanInfo(
+                peer_id=chosen.peer_id,
+                start=current,
+                end=min(chosen.end, end),
+                server_info=chosen.server_info,
+            )
+            sequence.append(chosen)
+            current = chosen.end
+        return sequence
+
+    def _make_sequence_min_latency(
+        self, start: int, end: int, cache_tokens_needed: Optional[int]
+    ) -> List[RemoteSpanInfo]:
+        """Dijkstra over (block, peer) states; edge = RTT + per-block decode cost
+        (+ cache-miss penalty), mirroring reference :177-300."""
+        import itertools
+
+        tiebreak = itertools.count()  # heap entries: (cost, counter, block, peer)
+        heap: List[Tuple] = [(0.0, next(tiebreak), start, None)]
+        best: Dict[Tuple[int, Optional[PeerID]], float] = {(start, None): 0.0}
+        parents: Dict[Tuple[int, Optional[PeerID]], Tuple] = {}
+
+        result_key = None
+        while heap:
+            cost, _, block, peer = heapq.heappop(heap)
+            key = (block, peer)
+            if cost > best.get(key, float("inf")):
+                continue
+            if block >= end:
+                result_key = key
+                break
+            for span in self._usable_spans_for_block(block):
+                info = span.server_info
+                next_block = min(span.end, end)
+                n_blocks = next_block - block
+                rps = info.inference_rps or info.throughput or 1.0
+                edge = self.rtt_fn(peer, span.peer_id) + n_blocks / max(rps, 1e-3)
+                if (
+                    cache_tokens_needed is not None
+                    and info.cache_tokens_left is not None
+                    and info.cache_tokens_left < cache_tokens_needed
+                ):
+                    edge += CACHE_MISS_PENALTY
+                nkey = (next_block, span.peer_id)
+                ncost = cost + edge
+                if ncost < best.get(nkey, float("inf")):
+                    best[nkey] = ncost
+                    parents[nkey] = (key, span, next_block)
+                    heapq.heappush(heap, (ncost, next(tiebreak), next_block, span.peer_id))
+
+        if result_key is None:
+            return []
+        # reconstruct
+        sequence: List[RemoteSpanInfo] = []
+        key = result_key
+        while key in parents:
+            prev_key, span, next_block = parents[key]
+            sequence.append(
+                RemoteSpanInfo(
+                    peer_id=span.peer_id,
+                    start=prev_key[0],
+                    end=next_block,
+                    server_info=span.server_info,
+                )
+            )
+            key = prev_key
+        sequence.reverse()
+        return sequence
+
+    # ------------------------------------------------------------------ stubs
+
+    def addr_of(self, peer_id: PeerID) -> Optional[PeerAddr]:
+        return self.directory.addr_of(peer_id)
+
+    async def get_stub(self, peer_id: PeerID) -> RpcClient:
+        addr = self.addr_of(peer_id)
+        if addr is None:
+            raise KeyError(f"No known contact address for {peer_id}")
+        return await self.pool.get(addr.host, addr.port)
+
+    async def shutdown(self) -> None:
+        self._update_task.cancel()
+        try:
+            await self._update_task
+        except asyncio.CancelledError:
+            pass
+        await self.pool.close()
+        if self._owns_dht:
+            await self.dht.shutdown()

@@ -1,0 +1,16 @@
+"""Swarm incentive points interface — intentionally a stub, matching the
+reference (src/petals/client/routing/spending_policy.py:1-17: "the intent is to
+let users limit the request rate and/or express priority, not implemented")."""
+
+from abc import ABC, abstractmethod
+
+
+class SpendingPolicyBase(ABC):
+    @abstractmethod
+    def get_points(self, method: str, *args, **kwargs) -> float:
+        ...
+
+
+class NoSpendingPolicy(SpendingPolicyBase):
+    def get_points(self, method: str, *args, **kwargs) -> float:
+        return 0.0

@@ -1,0 +1,219 @@
+"""Fault-tolerant pipelined forward/backward through remote blocks
+(counterpart of reference src/petals/client/sequential_autograd.py:26-277).
+
+``sequential_forward`` routes [start, end) through a max-throughput chain,
+retrying failed sub-chains on fresh servers; it returns every span's input
+activation so the backward pass can run server-side recomputation.
+``sequential_backward`` walks the chain in reverse; if a span's server died it
+re-runs forward over just that span on a new server to rebuild the lost
+activation (reference :139-153).
+
+Big batches are split into <= MAX_TOKENS_IN_BATCH-token sub-batches executed
+concurrently — microbatch pipelining over the swarm (reference :199-250).
+
+The JAX training entry point is ``remote_sequential_apply`` — a
+``jax.custom_vjp`` function whose forward/backward call into the swarm via
+``io_callback``, so a client loss can be differentiated straight through remote
+servers while prompts/heads stay local and jittable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from petals_tpu.client.remote_forward_backward import run_remote_backward, run_remote_forward
+from petals_tpu.client.routing.sequence_manager import RemoteSequenceManager
+from petals_tpu.data_structures import RemoteSpanInfo
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+MAX_TOKENS_IN_BATCH = 1024
+
+
+async def sequential_forward(
+    seq_manager: RemoteSequenceManager,
+    hidden: np.ndarray,
+    prompts: Optional[np.ndarray] = None,
+    start_index: int = 0,
+    end_index: Optional[int] = None,
+) -> Tuple[np.ndarray, List[np.ndarray], List[RemoteSpanInfo]]:
+    """Returns (output, per-span input activations, spans used)."""
+    end_index = end_index if end_index is not None else len(seq_manager.block_uids)
+    assert hidden.ndim == 3
+
+    inputs_history: List[np.ndarray] = []
+    spans_used: List[RemoteSpanInfo] = []
+    block_idx = start_index
+    attempt = 0
+    chain: List[RemoteSpanInfo] = []
+
+    while block_idx < end_index:
+        if not chain:
+            chain = await seq_manager.make_sequence(block_idx, end_index, mode="max_throughput")
+        span = chain.pop(0)
+        try:
+            span_prompts = prompts[span.start : span.end] if prompts is not None else None
+            outputs = await run_remote_forward(seq_manager, span, hidden, span_prompts)
+            assert outputs.shape == hidden.shape
+            inputs_history.append(hidden)
+            spans_used.append(span)
+            hidden = outputs
+            block_idx = span.end
+            seq_manager.on_request_success(span.peer_id)
+            attempt = 0
+        except Exception as e:
+            attempt += 1
+            seq_manager.on_request_failure(span.peer_id)
+            if seq_manager.config.max_retries is not None and attempt > seq_manager.config.max_retries:
+                raise
+            delay = min(seq_manager.config.min_backoff * (2 ** (attempt - 1)), seq_manager.config.max_backoff)
+            logger.warning(f"Forward failed at blocks [{span.start}:{span.end}], retrying in {delay:.1f}s: {e}")
+            await asyncio.sleep(delay)
+            await seq_manager.update()
+            chain = []  # re-route from the current block
+    return hidden, inputs_history, spans_used
+
+
+async def sequential_backward(
+    seq_manager: RemoteSequenceManager,
+    grad_out: np.ndarray,
+    inputs_history: List[np.ndarray],
+    spans_used: List[RemoteSpanInfo],
+    prompts: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Walk spans in reverse; returns (grad wrt inputs, grad wrt prompts or None)."""
+    inputs_history = list(inputs_history)
+    spans_used = list(spans_used)
+    grad_prompts_parts: List[Tuple[int, int, np.ndarray]] = []
+
+    while spans_used:
+        span = spans_used.pop()
+        span_inputs = inputs_history.pop()
+        span_prompts = prompts[span.start : span.end] if prompts is not None else None
+        attempt = 0
+        while True:
+            try:
+                grad_out, grad_prompts = await run_remote_backward(
+                    seq_manager, span, span_inputs, grad_out, span_prompts
+                )
+                seq_manager.on_request_success(span.peer_id)
+                if grad_prompts is not None:
+                    grad_prompts_parts.append((span.start, span.end, grad_prompts))
+                break
+            except Exception as e:
+                attempt += 1
+                seq_manager.on_request_failure(span.peer_id)
+                if seq_manager.config.max_retries is not None and attempt > seq_manager.config.max_retries:
+                    raise
+                delay = min(
+                    seq_manager.config.min_backoff * (2 ** (attempt - 1)), seq_manager.config.max_backoff
+                )
+                logger.warning(
+                    f"Backward failed at blocks [{span.start}:{span.end}], retrying in {delay:.1f}s: {e}"
+                )
+                await asyncio.sleep(delay)
+                await seq_manager.update()
+                # find a fresh server hosting this span (forward state is intact:
+                # we still hold span_inputs, servers recompute internally)
+                new_chain = await seq_manager.make_sequence(span.start, span.end, mode="max_throughput")
+                if len(new_chain) == 1:
+                    span = new_chain[0]
+                else:
+                    # span got fragmented: recompute forward over the fragment chain
+                    # to regain per-fragment inputs, then push them back for backward
+                    _, frag_inputs, frag_spans = await sequential_forward(
+                        seq_manager, span_inputs, prompts, span.start, span.end
+                    )
+                    spans_used.extend(frag_spans)
+                    inputs_history.extend(frag_inputs)
+                    span = spans_used.pop()
+                    span_inputs = inputs_history.pop()
+                    span_prompts = prompts[span.start : span.end] if prompts is not None else None
+
+    grad_prompts = None
+    if prompts is not None and grad_prompts_parts:
+        grad_prompts = np.zeros_like(prompts)
+        for start, end, part in grad_prompts_parts:
+            grad_prompts[start:end] += part
+    return grad_out, grad_prompts
+
+
+async def sequential_forward_batched(
+    seq_manager: RemoteSequenceManager,
+    hidden: np.ndarray,
+    prompts: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, List, List]:
+    """Split big batches into <=1024-token sub-batches, run them concurrently
+    over (possibly) different chains — swarm microbatching."""
+    splits = _split_batch(hidden)
+    if len(splits) == 1:
+        return await sequential_forward(seq_manager, hidden, prompts)
+    prompt_splits = _split_prompts(prompts, splits)
+    results = await asyncio.gather(
+        *(
+            sequential_forward(seq_manager, part, p_part)
+            for part, p_part in zip(splits, prompt_splits)
+        )
+    )
+    outputs = np.concatenate([r[0] for r in results], axis=0)
+    return outputs, [r[1] for r in results], [r[2] for r in results]
+
+
+async def sequential_backward_batched(
+    seq_manager: RemoteSequenceManager,
+    grad_out: np.ndarray,
+    histories: List,
+    spans: List,
+    prompts: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    if spans and isinstance(spans[0], RemoteSpanInfo):
+        return await sequential_backward(seq_manager, grad_out, histories, spans, prompts)
+    splits = _split_batch_like(grad_out, histories)
+    prompt_splits = _split_prompts(prompts, splits)
+    results = await asyncio.gather(
+        *(
+            sequential_backward(seq_manager, g, h, s, p)
+            for g, h, s, p in zip(splits, histories, spans, prompt_splits)
+        )
+    )
+    grad_in = np.concatenate([r[0] for r in results], axis=0)
+    grad_prompts = None
+    if prompts is not None:
+        # keep batch alignment: a microbatch that returned no prompt grads
+        # contributes zeros of its own batch width
+        parts = [
+            r[1] if r[1] is not None else np.zeros_like(p)
+            for r, p in zip(results, prompt_splits)
+        ]
+        if any(r[1] is not None for r in results):
+            grad_prompts = np.concatenate(parts, axis=1)  # batch axis of prompts
+    return grad_in, grad_prompts
+
+
+def _split_batch(hidden: np.ndarray) -> List[np.ndarray]:
+    batch, seq = hidden.shape[:2]
+    max_rows = max(MAX_TOKENS_IN_BATCH // max(seq, 1), 1)
+    return [hidden[i : i + max_rows] for i in range(0, batch, max_rows)]
+
+
+def _split_batch_like(grad: np.ndarray, histories: List) -> List[np.ndarray]:
+    sizes = [h[0].shape[0] for h in histories]
+    out, offset = [], 0
+    for size in sizes:
+        out.append(grad[offset : offset + size])
+        offset += size
+    return out
+
+
+def _split_prompts(prompts: Optional[np.ndarray], splits: List[np.ndarray]):
+    if prompts is None:
+        return [None] * len(splits)
+    out, offset = [], 0
+    for part in splits:
+        out.append(prompts[:, offset : offset + part.shape[0]])
+        offset += part.shape[0]
+    return out

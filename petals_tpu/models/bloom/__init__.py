@@ -1,4 +1,5 @@
-from petals_tpu.models.bloom.block import FAMILY as _FAMILY  # noqa: F401
+from petals_tpu.models.bloom.block import FAMILY as _BLOCK_FAMILY  # noqa: F401
+from petals_tpu.models.bloom.model import FAMILY as _FAMILY  # noqa: F401
 from petals_tpu.models.bloom.config import BloomBlockConfig
 
 __all__ = ["BloomBlockConfig"]

@@ -1,0 +1,72 @@
+"""Client-side BLOOM pieces: word embeddings (+ their layernorm), final norm,
+tied LM head (counterpart of reference src/petals/models/bloom/model.py:21-183)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+import petals_tpu.models.bloom.block as block_mod
+from petals_tpu.models.bloom.config import BloomBlockConfig
+from petals_tpu.models.common import layer_norm
+from petals_tpu.models.registry import register_family
+
+CLIENT_PREFIXES = (
+    "transformer.word_embeddings.",
+    "transformer.word_embeddings_layernorm.",
+    "transformer.ln_f.",
+    "word_embeddings.",
+    "word_embeddings_layernorm.",
+    "ln_f.",
+    "lm_head.",
+)
+
+
+def hf_to_client_params(tensors: dict, cfg: BloomBlockConfig) -> dict:
+    def pick(*names):
+        for name in names:
+            if name in tensors:
+                return np.asarray(tensors[name])
+        raise KeyError(f"None of {names} found in checkpoint")
+
+    embed = pick("transformer.word_embeddings.weight", "word_embeddings.weight")
+    return {
+        "embed": embed,  # [vocab, hidden]
+        "emb_ln_w": pick(
+            "transformer.word_embeddings_layernorm.weight", "word_embeddings_layernorm.weight"
+        ),
+        "emb_ln_b": pick(
+            "transformer.word_embeddings_layernorm.bias", "word_embeddings_layernorm.bias"
+        ),
+        "ln_f_w": pick("transformer.ln_f.weight", "ln_f.weight"),
+        "ln_f_b": pick("transformer.ln_f.bias", "ln_f.bias"),
+        # BLOOM ties the LM head to the embeddings
+        "head": np.ascontiguousarray(embed.T),
+    }
+
+
+def client_embed(params: dict, input_ids, cfg: BloomBlockConfig):
+    hidden = jnp.take(params["embed"], jnp.asarray(input_ids), axis=0)
+    return layer_norm(hidden, params["emb_ln_w"], params["emb_ln_b"], cfg.layer_norm_epsilon)
+
+
+def client_head(params: dict, hidden, cfg: BloomBlockConfig):
+    normed = layer_norm(jnp.asarray(hidden), params["ln_f_w"], params["ln_f_b"], cfg.layer_norm_epsilon)
+    return jnp.dot(
+        normed.astype(jnp.float32),
+        params["head"].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+
+
+FAMILY = register_family(
+    dataclasses.replace(
+        block_mod.FAMILY,
+        hf_client_prefixes=CLIENT_PREFIXES,
+        hf_to_client_params=hf_to_client_params,
+        client_embed=client_embed,
+        client_head=client_head,
+    )
+)

@@ -1,4 +1,5 @@
-from petals_tpu.models.llama.block import FAMILY as _FAMILY  # noqa: F401
+from petals_tpu.models.llama.block import FAMILY as _BLOCK_FAMILY  # noqa: F401
+from petals_tpu.models.llama.model import FAMILY as _FAMILY  # noqa: F401
 from petals_tpu.models.llama.config import LlamaBlockConfig
 
 __all__ = ["LlamaBlockConfig"]

@@ -1,0 +1,54 @@
+"""Client-side Llama pieces: embeddings, final norm, LM head
+(counterpart of reference src/petals/models/llama/model.py:20-174 — the parts
+of DistributedLlamaForCausalLM that run locally on the client)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+import petals_tpu.models.llama.block as block_mod
+from petals_tpu.models.common import rms_norm
+from petals_tpu.models.llama.config import LlamaBlockConfig
+from petals_tpu.models.registry import register_family
+
+CLIENT_PREFIXES = ("model.embed_tokens.", "model.norm.", "lm_head.")
+
+
+def hf_to_client_params(tensors: dict, cfg: LlamaBlockConfig) -> dict:
+    embed = np.asarray(tensors["model.embed_tokens.weight"])  # [vocab, hidden]
+    if cfg.tie_word_embeddings or "lm_head.weight" not in tensors:
+        head = np.ascontiguousarray(embed.T)
+    else:
+        head = np.ascontiguousarray(np.asarray(tensors["lm_head.weight"]).T)  # [hidden, vocab]
+    return {
+        "embed": embed,
+        "norm": np.asarray(tensors["model.norm.weight"]),
+        "head": head,
+    }
+
+
+def client_embed(params: dict, input_ids, cfg: LlamaBlockConfig):
+    return jnp.take(params["embed"], jnp.asarray(input_ids), axis=0)
+
+
+def client_head(params: dict, hidden, cfg: LlamaBlockConfig):
+    normed = rms_norm(jnp.asarray(hidden), params["norm"], cfg.rms_norm_eps)
+    return jnp.dot(
+        normed.astype(jnp.float32),
+        params["head"].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+
+
+FAMILY = register_family(
+    dataclasses.replace(
+        block_mod.FAMILY,
+        hf_client_prefixes=CLIENT_PREFIXES,
+        hf_to_client_params=hf_to_client_params,
+        client_embed=client_embed,
+        client_head=client_head,
+    )
+)

@@ -23,10 +23,11 @@ class ModelFamily:
     hf_block_prefixes: tuple  # checkpoint prefixes of block i, with {i} placeholder
     hf_to_block_params: Callable  # (dict[str, np.ndarray], cfg) -> params pytree
     block_param_shapes: Optional[Callable] = None  # cfg -> pytree of jax.ShapeDtypeStruct
-    # Client-side (embeddings + head) loading, filled in by model.py modules:
-    hf_client_prefixes: tuple = ()
-    hf_to_client_params: Optional[Callable] = None
-    client_forward: Optional[Callable] = None
+    # Client-side (embeddings + final norm + LM head), filled by model.py modules:
+    hf_client_prefixes: tuple = ()  # checkpoint prefixes of client-held tensors
+    hf_to_client_params: Optional[Callable] = None  # (dict, cfg) -> params pytree
+    client_embed: Optional[Callable] = None  # (params, input_ids, cfg) -> hidden
+    client_head: Optional[Callable] = None  # (params, hidden, cfg) -> logits (f32)
 
 
 def register_family(family: ModelFamily) -> ModelFamily:

@@ -68,18 +68,21 @@ def _index_weight_files(path: str) -> Dict[str, str]:
     raise FileNotFoundError(f"No weight files found in {path}")
 
 
-def _load_tensors_with_prefixes(path: str, prefixes: tuple) -> Dict[str, np.ndarray]:
+def _load_tensors_with_prefixes(
+    path: str, prefixes: tuple, *, keep_full_names: bool = False
+) -> Dict[str, np.ndarray]:
     """Read only tensors whose name starts with one of ``prefixes`` (names
-    returned relative to the matching prefix). All candidate prefixes are
-    checked in a single pass so each weight file is opened at most once
-    (safetensors lazily; .bin state dicts deserialized exactly once —
+    returned relative to the matching prefix, or absolute with
+    ``keep_full_names`` — use that when prefixes could collide). All candidate
+    prefixes are checked in a single pass so each weight file is opened at most
+    once (safetensors lazily; .bin state dicts deserialized exactly once —
     reference from_pretrained.py:81-128 semantics)."""
     weight_map = _index_weight_files(path)
 
     def match(name: str) -> Optional[str]:
         for prefix in prefixes:
             if name.startswith(prefix):
-                return name[len(prefix):]
+                return name if keep_full_names else name[len(prefix):]
         return None
 
     if "*" in weight_map:

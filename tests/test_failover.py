@@ -1,0 +1,57 @@
+"""Elastic recovery: a server dies mid-generation and the session replays its
+history into a replacement, continuing token-identically (reference
+inference_session failover, SURVEY.md §3.5)."""
+
+import numpy as np
+import pytest
+
+from petals_tpu.client.model import AutoDistributedModelForCausalLM
+from tests.test_full_model import SwarmHarness, _hf_greedy
+from tests.utils import make_tiny_llama
+
+
+@pytest.fixture(scope="module")
+def redundant_swarm(tmp_path_factory):
+    path = make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+    harness = SwarmHarness(
+        path,
+        [
+            dict(first_block=0, num_blocks=4, throughput=1000.0),  # preferred
+            dict(first_block=0, num_blocks=4, throughput=1.0),  # understudy
+        ],
+    ).start()
+    yield path, harness
+    harness.stop()
+
+
+def test_mid_generation_failover(redundant_swarm):
+    path, harness = redundant_swarm
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers, min_backoff=0.1
+    )
+    try:
+        rng = np.random.RandomState(0)
+        input_ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+        expected = _hf_greedy(path, input_ids, 6)
+
+        with model.remote.inference_session(max_length=16, batch_size=1) as session:
+            first = model.generate(input_ids, max_new_tokens=3, session=session)
+            np.testing.assert_array_equal(first, expected[:, : input_ids.shape[1] + 3])
+
+            # kill the preferred server mid-session
+            fast = harness.servers[0]
+            assert session._session._sessions[0].span.peer_id == fast.dht.peer_id, (
+                "test setup: expected the high-throughput server to be chosen"
+            )
+            harness.run(fast.shutdown())
+
+            # continue: the session must fail over and replay history
+            final = model.generate(first, max_new_tokens=3, session=session)
+        np.testing.assert_array_equal(final, expected)
+
+        survivor = harness.servers[1]
+        assert session._session._sessions == [] or (
+            session._session._sessions[0].span.peer_id == survivor.dht.peer_id
+        )
+    finally:
+        model.close()

@@ -1,0 +1,151 @@
+"""Routing tests (port of reference tests/test_sequence_manager.py:16-56 +
+routing-mode semantics): valid contiguous chains, ban handling, both modes."""
+
+import asyncio
+import time
+
+import pytest
+
+from petals_tpu.client.config import ClientConfig
+from petals_tpu.client.routing.sequence_manager import MissingBlocksError, RemoteSequenceManager
+from petals_tpu.data_structures import PeerID, ServerInfo, ServerState, make_uid
+from petals_tpu.dht import DHTNode
+from petals_tpu.utils.dht_utils import declare_active_modules
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _swarm_with_servers(n_blocks, server_specs):
+    """server_specs: list of (start, end, throughput). Returns (boot, nodes, uids)."""
+    boot = await DHTNode.create(maintenance_period=1000)
+    uids = [make_uid("m", i) for i in range(n_blocks)]
+    nodes = []
+    for start, end, throughput in server_specs:
+        node = await DHTNode.create(initial_peers=[boot.own_addr], maintenance_period=1000)
+        info = ServerInfo(
+            ServerState.ONLINE, throughput, start_block=start, end_block=end,
+            inference_rps=throughput,
+        )
+        await declare_active_modules(node, uids[start:end], info, time.time() + 60)
+        nodes.append(node)
+    return boot, nodes, uids
+
+
+def _chain_is_valid(chain, start, end):
+    assert chain[0].start == start and chain[-1].end == end
+    for a, b in zip(chain, chain[1:]):
+        assert a.end == b.start
+    return True
+
+
+def test_make_sequence_both_modes():
+    async def main():
+        boot, nodes, uids = await _swarm_with_servers(
+            6, [(0, 3, 10.0), (3, 6, 10.0), (0, 6, 5.0)]
+        )
+        manager = await RemoteSequenceManager.create(
+            ClientConfig(initial_peers=[boot.own_addr.to_string()], update_period=1000), uids
+        )
+        try:
+            await manager.ensure_ready()
+            for mode in ("min_latency", "max_throughput"):
+                chain = await manager.make_sequence(mode=mode)
+                _chain_is_valid(chain, 0, 6)
+            partial = await manager.make_sequence(2, 5, mode="max_throughput")
+            _chain_is_valid(partial, 2, 5)
+        finally:
+            await manager.shutdown()
+            for n in nodes + [boot]:
+                await n.shutdown()
+
+    run(main())
+
+
+def test_min_latency_prefers_fast_servers_and_fewer_hops():
+    async def main():
+        boot, nodes, uids = await _swarm_with_servers(
+            4, [(0, 4, 100.0), (0, 2, 1.0), (2, 4, 1.0)]
+        )
+        manager = await RemoteSequenceManager.create(
+            ClientConfig(initial_peers=[boot.own_addr.to_string()], update_period=1000), uids
+        )
+        try:
+            await manager.ensure_ready()
+            chain = await manager.make_sequence(mode="min_latency")
+            assert len(chain) == 1 and chain[0].throughput == 100.0
+        finally:
+            await manager.shutdown()
+            for n in nodes + [boot]:
+                await n.shutdown()
+
+    run(main())
+
+
+def test_banned_server_is_routed_around_and_unbanned():
+    async def main():
+        boot, nodes, uids = await _swarm_with_servers(2, [(0, 2, 100.0), (0, 2, 1.0)])
+        config = ClientConfig(
+            initial_peers=[boot.own_addr.to_string()], update_period=1000, ban_timeout=0.3
+        )
+        manager = await RemoteSequenceManager.create(config, uids)
+        try:
+            await manager.ensure_ready()
+            chain = await manager.make_sequence(mode="min_latency")
+            fast_peer = chain[0].peer_id
+            manager.on_request_failure(fast_peer)
+            chain = await manager.make_sequence(mode="min_latency")
+            assert chain[0].peer_id != fast_peer, "banned server must be avoided"
+            await asyncio.sleep(0.4)  # ban expires
+            chain = await manager.make_sequence(mode="min_latency")
+            assert chain[0].peer_id == fast_peer
+            manager.on_request_success(fast_peer)
+            assert fast_peer not in manager._banned
+        finally:
+            await manager.shutdown()
+            for n in nodes + [boot]:
+                await n.shutdown()
+
+    run(main())
+
+
+def test_missing_blocks_raise():
+    async def main():
+        boot, nodes, uids = await _swarm_with_servers(4, [(0, 2, 1.0)])  # blocks 2,3 unserved
+        manager = await RemoteSequenceManager.create(
+            ClientConfig(initial_peers=[boot.own_addr.to_string()], update_period=1000), uids
+        )
+        try:
+            with pytest.raises(MissingBlocksError):
+                await asyncio.wait_for(manager.make_sequence(mode="max_throughput"), 10)
+        finally:
+            await manager.shutdown()
+            for n in nodes + [boot]:
+                await n.shutdown()
+
+    run(main())
+
+
+def test_allowed_servers_pin():
+    async def main():
+        boot, nodes, uids = await _swarm_with_servers(2, [(0, 2, 100.0), (0, 2, 1.0)])
+        slow_peer = nodes[1].peer_id
+        manager = await RemoteSequenceManager.create(
+            ClientConfig(
+                initial_peers=[boot.own_addr.to_string()],
+                update_period=1000,
+                allowed_servers=[slow_peer.to_string()],
+            ),
+            uids,
+        )
+        try:
+            await manager.ensure_ready()
+            chain = await manager.make_sequence(mode="min_latency")
+            assert all(span.peer_id == slow_peer for span in chain)
+        finally:
+            await manager.shutdown()
+            for n in nodes + [boot]:
+                await n.shutdown()
+
+    run(main())
