@@ -1,0 +1,178 @@
+"""Benchmark: single-stream autoregressive decode through the FULL stack
+(client -> RPC -> handler -> priority queue -> stacked-span scan on TPU ->
+KV cache in HBM -> back), on one real chip.
+
+Mirrors the reference harness (benchmarks/benchmark_inference.py:44-68 — tok/s,
+1 token per step, real session) on a Llama-2-7B-shaped span: as many 7B-shaped
+blocks as fit one v5e chip alongside the KV budget. The reference baseline is
+6 tok/s single-stream for Llama-2-70B over an Internet swarm of consumer GPUs
+(README.md:86); vs_baseline reports our measured tok/s against that number.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import asyncio
+import json
+import sys
+import time
+
+import numpy as np
+
+N_BLOCKS = 8  # 7B-shaped blocks resident in HBM (~3.2 GB bf16) + KV budget
+WARMUP_STEPS = 5
+MEASURE_STEPS = 30
+PREFILL_TOKENS = 128
+MAX_LENGTH = 256
+BASELINE_TOK_S = 6.0  # reference: Llama-2-70B, Internet swarm (README.md:86)
+
+
+def llama7b_cfg():
+    from petals_tpu.models.llama.config import LlamaBlockConfig
+
+    return LlamaBlockConfig(
+        hidden_size=4096,
+        num_attention_heads=32,
+        num_key_value_heads=32,
+        head_dim=128,
+        intermediate_size=11008,
+        num_hidden_layers=N_BLOCKS,
+        rms_norm_eps=1e-5,
+        vocab_size=32000,
+    )
+
+
+def random_params(cfg, n_blocks, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    from petals_tpu.models.llama.block import block_param_shapes
+
+    shapes = block_param_shapes(cfg, dtype)
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def init(key):
+        params = {}
+        for name, sds in sorted(shapes.items()):
+            key, sub = jax.random.split(key)
+            params[name] = jax.random.normal(sub, (n_blocks, *sds.shape), dtype) * 0.02
+        return params
+
+    return init(key)
+
+
+async def run_bench():
+    import jax
+    import jax.numpy as jnp
+
+    from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
+    from petals_tpu.models.registry import get_family
+    from petals_tpu.rpc import RpcClient
+    from petals_tpu.rpc.serialization import deserialize_array, serialize_array
+    from petals_tpu.rpc.server import RpcServer
+    from petals_tpu.server.backend import TransformerBackend
+    from petals_tpu.server.handler import TransformerHandler
+    from petals_tpu.server.memory_cache import MemoryCache
+
+    cfg = llama7b_cfg()
+    family = get_family("llama")
+    dtype = jnp.bfloat16
+
+    t0 = time.perf_counter()
+    params = random_params(cfg, N_BLOCKS, dtype)
+    jax.block_until_ready(params)
+    load_s = time.perf_counter() - t0
+
+    memory_cache = MemoryCache(2 << 30)
+    backend = TransformerBackend(
+        family, cfg, params,
+        first_block=0, n_blocks=N_BLOCKS,
+        memory_cache=memory_cache, compute_dtype=dtype,
+    )
+    handler = TransformerHandler(backend, dht_prefix="bench", memory_cache=memory_cache)
+    server = RpcServer()
+    handler.register(server)
+    await server.start()
+
+    client = await RpcClient.connect("127.0.0.1", server.port)
+    uids = CHAIN_DELIMITER.join(make_uid("bench", i) for i in range(N_BLOCKS))
+
+    rng = np.random.RandomState(0)
+    hidden_prefill = rng.randn(1, PREFILL_TOKENS, cfg.hidden_size).astype(np.float32) * 0.02
+    step_hidden = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.02
+
+    stream = await client.open_stream("ptu.inference")
+    await stream.send({"uids": uids, "max_length": MAX_LENGTH, "batch_size": 1})
+    await stream.recv(timeout=120)
+
+    t0 = time.perf_counter()
+    await stream.send({"tensors": {"hidden": serialize_array(hidden_prefill)}})
+    await stream.recv(timeout=600)
+    prefill_s = time.perf_counter() - t0
+
+    async def one_step():
+        await stream.send({"tensors": {"hidden": serialize_array(step_hidden)}})
+        reply = await stream.recv(timeout=600)
+        return deserialize_array(reply["tensors"]["hidden"])
+
+    for _ in range(WARMUP_STEPS):
+        await one_step()
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        await one_step()
+    elapsed = time.perf_counter() - t0
+    await stream.end()
+    await client.close()
+    await server.stop()
+    handler.shutdown()
+
+    step_latency = elapsed / MEASURE_STEPS
+    tok_s_span = 1.0 / step_latency
+
+    # Server-side compute rate without the per-step device->host sync (the
+    # environment tunnels to a remote TPU, so each sync costs a WAN round trip
+    # that a co-located production server would not pay).
+    kd, vd = backend.cache_descriptors(1, MAX_LENGTH, 0, N_BLOCKS)
+    kv = (kd.make_zeros(), vd.make_zeros())
+    _, kv = backend.inference_step(hidden_prefill, kv, 0)
+    import jax
+
+    out = None
+    for i in range(3):
+        out, kv = backend.inference_step(step_hidden, kv, PREFILL_TOKENS + i)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(MEASURE_STEPS):
+        out, kv = backend.inference_step(step_hidden, kv, PREFILL_TOKENS + 3 + i)
+    jax.block_until_ready(out)
+    device_step = (time.perf_counter() - t0) / MEASURE_STEPS
+
+    return {
+        "tok_s": tok_s_span,
+        "step_ms": step_latency * 1e3,
+        "device_step_ms": device_step * 1e3,
+        "prefill_s": prefill_s,
+        "param_init_s": load_s,
+    }
+
+
+def main():
+    result = asyncio.run(run_bench())
+    out = {
+        "metric": f"single_stream_decode_tok_s_{N_BLOCKS}xllama7b_blocks_e2e",
+        "value": round(result["tok_s"], 2),
+        "unit": "tok/s",
+        "vs_baseline": round(result["tok_s"] / BASELINE_TOK_S, 2),
+    }
+    print(json.dumps(out))
+    print(
+        f"# e2e_step={result['step_ms']:.1f}ms device_step={result['device_step_ms']:.1f}ms "
+        f"(tunnel sync overhead = difference) prefill({PREFILL_TOKENS}tok)={result['prefill_s']:.2f}s "
+        f"param_init={result['param_init_s']:.1f}s",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

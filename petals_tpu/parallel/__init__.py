@@ -1,0 +1,4 @@
+from petals_tpu.parallel.mesh import make_mesh
+from petals_tpu.parallel.tp import kv_cache_pspec, span_param_pspecs
+
+__all__ = ["make_mesh", "span_param_pspecs", "kv_cache_pspec"]

@@ -1,0 +1,36 @@
+"""Device-mesh helpers: the ICI-native replacement for the reference's
+intra-server `tensor_parallel` package (SURVEY.md §2.2 — torch TP over NCCL
+becomes jax.sharding over a Mesh; XLA inserts the collectives).
+
+Serving meshes are 1-D ("tp",) over the chips of one server's slice. Training
+dry-runs use richer meshes (dp/tp/sp) — see __graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    axis_sizes: Sequence[int],
+    axis_names: Sequence[str],
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = int(np.prod(axis_sizes))
+    if n > len(devices):
+        raise ValueError(f"Mesh of {axis_sizes} needs {n} devices, have {len(devices)}")
+    grid = np.asarray(devices[:n]).reshape(tuple(axis_sizes))
+    return Mesh(grid, tuple(axis_names))
+
+
+def tp_mesh(num_devices: Optional[int] = None) -> Mesh:
+    """1-D tensor-parallel mesh over this host's chips (the intra-server mesh)."""
+    devices = jax.devices()
+    num_devices = num_devices or len(devices)
+    return make_mesh((num_devices,), ("tp",), devices=devices)

@@ -1,0 +1,100 @@
+"""Tensor-parallel shardings for stacked span parameters
+(counterpart of the reference's per-block TP configs,
+src/petals/utils/convert_block.py:118-135 + backend.py:88-99, re-expressed as
+jax.sharding PartitionSpecs — Megatron-style: attention/MLP input projections
+split on the output (head) axis, output projections split on the input axis,
+norms replicated; XLA then inserts the psums over ICI).
+
+All leaf shapes have a leading layer axis (the span stack), so weight specs
+are (None, <in>, <out>).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+COL = "tp"  # axis name used for head/ffn splits
+
+
+def span_param_pspecs(family_name: str, cfg) -> Dict[str, P]:
+    """PartitionSpecs for one family's stacked block params."""
+    if family_name == "llama":
+        specs = {
+            "ln1": P(),
+            "wq": P(None, None, COL),
+            "wk": P(None, None, COL),
+            "wv": P(None, None, COL),
+            "wo": P(None, COL, None),
+            "ln2": P(),
+            "wg": P(None, None, COL),
+            "wu": P(None, None, COL),
+            "wd": P(None, COL, None),
+        }
+        if getattr(cfg, "attention_bias", False):
+            specs.update(bq=P(None, COL), bk=P(None, COL), bv=P(None, COL), bo=P())
+        if getattr(cfg, "mlp_bias", False):
+            specs.update(bg=P(None, COL), bu=P(None, COL), bd=P())
+        return specs
+    if family_name == "bloom":
+        return {
+            "ln1_w": P(),
+            "ln1_b": P(),
+            "wq": P(None, None, COL),
+            "bq": P(None, COL),
+            "wk": P(None, None, COL),
+            "bk": P(None, COL),
+            "wv": P(None, None, COL),
+            "bv": P(None, COL),
+            "wo": P(None, COL, None),
+            "bo": P(),
+            "ln2_w": P(),
+            "ln2_b": P(),
+            "w_up": P(None, None, COL),
+            "b_up": P(None, COL),
+            "w_down": P(None, COL, None),
+            "b_down": P(),
+        }
+    raise KeyError(f"No TP spec for family {family_name!r}")
+
+
+def kv_cache_pspec() -> P:
+    """KV stacks [n_blocks, batch, max_len, kv_heads, head_dim]: shard heads."""
+    return P(None, None, None, COL, None)
+
+
+def validate_tp_divisibility(params, mesh, specs, *, num_kv_heads: int = None) -> None:
+    """Fail fast with a clear message instead of an opaque GSPMD error at
+    session-open time."""
+    tp_size = mesh.shape.get(COL, 1)
+    if tp_size == 1:
+        return
+    if num_kv_heads is not None and num_kv_heads % tp_size != 0:
+        raise ValueError(
+            f"num_key_value_heads={num_kv_heads} is not divisible by the tensor-"
+            f"parallel axis size {tp_size}; use a smaller tp mesh for this model"
+        )
+    for name, leaf in params.items():
+        spec = specs[name]
+        for dim, axis in enumerate(tuple(spec)):
+            if axis == COL and leaf.shape[dim] % tp_size != 0:
+                raise ValueError(
+                    f"Parameter {name!r} dim {dim} (size {leaf.shape[dim]}) is not "
+                    f"divisible by the tensor-parallel axis size {tp_size}"
+                )
+
+
+def shard_span_params(params, mesh, family_name: str, cfg):
+    """device_put the stacked params with TP shardings over ``mesh``."""
+    import jax
+
+    specs = span_param_pspecs(family_name, cfg)
+    validate_tp_divisibility(
+        params, mesh, specs,
+        num_kv_heads=getattr(cfg, "num_key_value_heads", cfg.num_attention_heads),
+    )
+    return {
+        name: jax.device_put(leaf, NamedSharding(mesh, specs[name]))
+        for name, leaf in params.items()
+    }

@@ -69,6 +69,7 @@ class TransformerBackend:
         cache_dtype=None,
         max_chunk_size_bytes: int = 256 * 1024 * 1024,
         use_flash: Optional[bool] = None,
+        mesh=None,  # jax.sharding.Mesh with a "tp" axis: intra-server tensor parallelism
     ):
         self.family = family
         self.cfg = cfg
@@ -81,6 +82,15 @@ class TransformerBackend:
         self.max_chunk_size_bytes = max_chunk_size_bytes
         if use_flash is None:
             use_flash = jax.default_backend() == "tpu"
+        self.mesh = mesh
+        if mesh is not None:
+            from petals_tpu.parallel.tp import shard_span_params
+
+            self.params = shard_span_params(self.params, mesh, family.name, cfg)
+            # the Pallas kernel is written per-device; under GSPMD sharding we
+            # rely on XLA's fused attention instead (ring/shard_map kernels are
+            # the sequence-parallel path, see petals_tpu/ops/ring_attention.py)
+            use_flash = False
         self.use_flash = use_flash
 
         self.num_kv_heads = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
@@ -90,13 +100,21 @@ class TransformerBackend:
     # ------------------------------------------------------------- cache descriptors
 
     def cache_descriptors(self, batch_size: int, max_length: int, start: int, end: int):
-        """(k, v) descriptors for blocks [start, end) of this span
-        (reference backend.py:88-99)."""
+        """(k, v) descriptors for blocks [start, end) of this span; under TP the
+        kv-head axis is sharded over the mesh (reference backend.py:88-99's
+        per-shard descriptors, expressed as one NamedSharding)."""
         n = end - start
         shape = (n, batch_size, max_length, self.num_kv_heads, self.head_dim)
+        sharding = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from petals_tpu.parallel.tp import kv_cache_pspec
+
+            sharding = NamedSharding(self.mesh, kv_cache_pspec())
         return (
-            TensorDescriptor(shape, self.cache_dtype),
-            TensorDescriptor(shape, self.cache_dtype),
+            TensorDescriptor(shape, self.cache_dtype, sharding),
+            TensorDescriptor(shape, self.cache_dtype, sharding),
         )
 
     def cache_bytes_per_token(self) -> int:

@@ -252,6 +252,7 @@ class TransformerHandler:
                 cache_dtype=self.backend.cache_dtype,
                 max_chunk_size_bytes=self.backend.max_chunk_size_bytes,
                 use_flash=self.backend.use_flash,
+                mesh=self.backend.mesh,
             )
         return self._sub_backends[key]
 

@@ -45,6 +45,7 @@ class AllocationFailed(Exception):
 class TensorDescriptor:
     shape: Tuple[int, ...]
     dtype: jnp.dtype
+    sharding: object = None  # optional jax.sharding.Sharding (TP: heads split)
 
     @property
     def nbytes(self) -> int:
@@ -52,6 +53,8 @@ class TensorDescriptor:
 
     def make_zeros(self, device: Optional[jax.Device] = None) -> jax.Array:
         arr = jnp.zeros(self.shape, self.dtype)
+        if self.sharding is not None:
+            return jax.device_put(arr, self.sharding)
         return jax.device_put(arr, device) if device is not None else arr
 
 

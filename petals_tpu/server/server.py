@@ -62,6 +62,7 @@ class Server:
         update_period: float = DEFAULT_UPDATE_PERIOD,
         use_flash: Optional[bool] = None,
         max_alloc_timeout: float = 600.0,
+        num_tp_devices: Optional[int] = None,  # >1: shard the span over this host's chips
     ):
         self.model_path = model_path
         self.family, self.cfg = get_block_config(model_path)
@@ -81,6 +82,7 @@ class Server:
         self.update_period = update_period
         self.use_flash = use_flash
         self.max_alloc_timeout = max_alloc_timeout
+        self.num_tp_devices = num_tp_devices
 
         self.module_uids = [
             make_uid(self.dht_prefix, i)
@@ -137,6 +139,11 @@ class Server:
         stacked = await asyncio.get_running_loop().run_in_executor(None, load_all)
         logger.info(f"Blocks loaded in {time.perf_counter() - t0:.1f}s")
 
+        mesh = None
+        if self.num_tp_devices is not None and self.num_tp_devices > 1:
+            from petals_tpu.parallel.mesh import tp_mesh
+
+            mesh = tp_mesh(self.num_tp_devices)
         self.backend = TransformerBackend(
             self.family,
             self.cfg,
@@ -147,6 +154,7 @@ class Server:
             compute_dtype=self.compute_dtype,
             max_chunk_size_bytes=self.max_chunk_size_bytes,
             use_flash=self.use_flash,
+            mesh=mesh,
         )
         self.handler = TransformerHandler(
             self.backend,
