@@ -3,5 +3,7 @@ from petals_tpu.models.registry import get_family, register_family
 # Importing a family module registers it.
 import petals_tpu.models.bloom  # noqa: F401
 import petals_tpu.models.llama  # noqa: F401
+import petals_tpu.models.falcon  # noqa: F401
+import petals_tpu.models.mixtral  # noqa: F401
 
 __all__ = ["get_family", "register_family"]

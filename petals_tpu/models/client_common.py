@@ -1,0 +1,34 @@
+"""Shared client-side (embed/norm/head) helpers for llama-layout families
+(llama, mixtral — both use model.embed_tokens / model.norm / lm_head with
+RMSNorm and optional weight tying)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from petals_tpu.models.common import rms_norm
+
+LLAMA_STYLE_CLIENT_PREFIXES = ("model.embed_tokens.", "model.norm.", "lm_head.")
+
+
+def llama_style_hf_to_client_params(tensors: dict, cfg) -> dict:
+    embed = np.asarray(tensors["model.embed_tokens.weight"])  # [vocab, hidden]
+    if cfg.tie_word_embeddings or "lm_head.weight" not in tensors:
+        head = np.ascontiguousarray(embed.T)
+    else:
+        head = np.ascontiguousarray(np.asarray(tensors["lm_head.weight"]).T)  # [hidden, vocab]
+    return {"embed": embed, "norm": np.asarray(tensors["model.norm.weight"]), "head": head}
+
+
+def llama_style_client_embed(params: dict, input_ids, cfg):
+    return jnp.take(params["embed"], jnp.asarray(input_ids), axis=0)
+
+
+def llama_style_client_head(params: dict, hidden, cfg):
+    normed = rms_norm(jnp.asarray(hidden), params["norm"], cfg.rms_norm_eps)
+    return jnp.dot(
+        normed.astype(jnp.float32),
+        params["head"].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )

@@ -1,0 +1,5 @@
+from petals_tpu.models.falcon.block import FAMILY as _BLOCK_FAMILY  # noqa: F401
+from petals_tpu.models.falcon.model import FAMILY as _FAMILY  # noqa: F401
+from petals_tpu.models.falcon.config import FalconBlockConfig
+
+__all__ = ["FalconBlockConfig"]

@@ -1,0 +1,69 @@
+"""Falcon family block config (parity target: reference
+src/petals/models/falcon/config.py:53-84). Covers all three generations:
+falcon-rw (MHA+alibi, serial attn), falcon-7b (MQA, parallel attn),
+falcon-40b/180b (new decoder architecture, GQA, dual layernorms)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class FalconBlockConfig:
+    hidden_size: int
+    num_attention_heads: int
+    num_kv_heads: int  # effective kv heads after arch rules
+    num_hidden_layers: int
+    layer_norm_epsilon: float
+    ffn_hidden_size: int
+    new_decoder_architecture: bool = False
+    parallel_attn: bool = True
+    num_ln_in_parallel_attn: int = 2
+    multi_query: bool = True
+    alibi: bool = False
+    bias: bool = False
+    rope_theta: float = 10000.0
+    activation: str = "gelu"
+    vocab_size: int = 65024
+    tie_word_embeddings: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def num_key_value_heads(self) -> int:
+        return self.num_kv_heads
+
+    @classmethod
+    def from_hf_config(cls, hf_config) -> "FalconBlockConfig":
+        new_arch = getattr(hf_config, "new_decoder_architecture", False)
+        multi_query = getattr(hf_config, "multi_query", True)
+        if new_arch:
+            num_kv = hf_config.num_kv_heads
+        elif multi_query:
+            num_kv = 1
+        else:
+            num_kv = hf_config.num_attention_heads
+        num_ln = getattr(hf_config, "num_ln_in_parallel_attn", None)
+        if num_ln is None:
+            num_ln = 2 if new_arch else 1
+        ffn = getattr(hf_config, "ffn_hidden_size", None) or 4 * hf_config.hidden_size
+        return cls(
+            hidden_size=hf_config.hidden_size,
+            num_attention_heads=hf_config.num_attention_heads,
+            num_kv_heads=num_kv,
+            num_hidden_layers=hf_config.num_hidden_layers,
+            layer_norm_epsilon=hf_config.layer_norm_epsilon,
+            ffn_hidden_size=ffn,
+            new_decoder_architecture=new_arch,
+            parallel_attn=getattr(hf_config, "parallel_attn", True),
+            num_ln_in_parallel_attn=num_ln,
+            multi_query=multi_query,
+            alibi=getattr(hf_config, "alibi", False),
+            bias=getattr(hf_config, "bias", False),
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            activation=getattr(hf_config, "activation", "gelu"),
+            vocab_size=hf_config.vocab_size,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+        )

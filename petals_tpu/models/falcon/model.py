@@ -1,0 +1,66 @@
+"""Client-side Falcon pieces: word embeddings, final norm, tied LM head
+(counterpart of reference src/petals/models/falcon/model.py:26-146)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+import petals_tpu.models.falcon.block as block_mod
+from petals_tpu.models.common import layer_norm
+from petals_tpu.models.falcon.config import FalconBlockConfig
+from petals_tpu.models.registry import register_family
+
+CLIENT_PREFIXES = (
+    "transformer.word_embeddings.",
+    "transformer.ln_f.",
+    "word_embeddings.",
+    "ln_f.",
+    "lm_head.",
+)
+
+
+def hf_to_client_params(tensors: dict, cfg: FalconBlockConfig) -> dict:
+    def pick(*names):
+        for name in names:
+            if name in tensors:
+                return np.asarray(tensors[name])
+        raise KeyError(f"None of {names} found in checkpoint")
+
+    embed = pick("transformer.word_embeddings.weight", "word_embeddings.weight")
+    if not cfg.tie_word_embeddings and "lm_head.weight" in tensors:
+        head = np.ascontiguousarray(np.asarray(tensors["lm_head.weight"]).T)
+    else:
+        head = np.ascontiguousarray(embed.T)
+    return {
+        "embed": embed,
+        "ln_f_w": pick("transformer.ln_f.weight", "ln_f.weight"),
+        "ln_f_b": pick("transformer.ln_f.bias", "ln_f.bias"),
+        "head": head,
+    }
+
+
+def client_embed(params: dict, input_ids, cfg: FalconBlockConfig):
+    return jnp.take(params["embed"], jnp.asarray(input_ids), axis=0)
+
+
+def client_head(params: dict, hidden, cfg: FalconBlockConfig):
+    normed = layer_norm(jnp.asarray(hidden), params["ln_f_w"], params["ln_f_b"], cfg.layer_norm_epsilon)
+    return jnp.dot(
+        normed.astype(jnp.float32),
+        params["head"].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+
+
+FAMILY = register_family(
+    dataclasses.replace(
+        block_mod.FAMILY,
+        hf_client_prefixes=CLIENT_PREFIXES,
+        hf_to_client_params=hf_to_client_params,
+        client_embed=client_embed,
+        client_head=client_head,
+    )
+)

@@ -1,0 +1,5 @@
+from petals_tpu.models.mixtral.block import FAMILY as _BLOCK_FAMILY  # noqa: F401
+from petals_tpu.models.mixtral.model import FAMILY as _FAMILY  # noqa: F401
+from petals_tpu.models.mixtral.config import MixtralBlockConfig
+
+__all__ = ["MixtralBlockConfig"]

@@ -1,0 +1,43 @@
+"""Mixtral (MoE) family block config (parity target: reference
+src/petals/models/mixtral/config.py:16-36)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralBlockConfig:
+    hidden_size: int
+    num_attention_heads: int
+    num_key_value_heads: int
+    head_dim: int
+    intermediate_size: int
+    num_hidden_layers: int
+    num_local_experts: int
+    num_experts_per_tok: int
+    rms_norm_eps: float
+    rope_theta: float = 1e6
+    sliding_window: Optional[int] = None
+    vocab_size: int = 32000
+    tie_word_embeddings: bool = False
+
+    @classmethod
+    def from_hf_config(cls, hf_config) -> "MixtralBlockConfig":
+        return cls(
+            hidden_size=hf_config.hidden_size,
+            num_attention_heads=hf_config.num_attention_heads,
+            num_key_value_heads=hf_config.num_key_value_heads,
+            head_dim=getattr(hf_config, "head_dim", None)
+            or hf_config.hidden_size // hf_config.num_attention_heads,
+            intermediate_size=hf_config.intermediate_size,
+            num_hidden_layers=hf_config.num_hidden_layers,
+            num_local_experts=hf_config.num_local_experts,
+            num_experts_per_tok=hf_config.num_experts_per_tok,
+            rms_norm_eps=hf_config.rms_norm_eps,
+            rope_theta=getattr(hf_config, "rope_theta", 1e6),
+            sliding_window=getattr(hf_config, "sliding_window", None),
+            vocab_size=hf_config.vocab_size,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        )

@@ -1,13 +1,12 @@
-"""Client-side Llama pieces: embeddings, final norm, LM head
-(counterpart of reference src/petals/models/llama/model.py:20-174 — the parts
-of DistributedLlamaForCausalLM that run locally on the client; shared helpers
-in models/client_common.py)."""
+"""Client-side Mixtral pieces (counterpart of reference
+src/petals/models/mixtral/model.py:26-175) — same embed/norm/head layout as
+Llama, shared via models/client_common.py."""
 
 from __future__ import annotations
 
 import dataclasses
 
-import petals_tpu.models.llama.block as block_mod
+import petals_tpu.models.mixtral.block as block_mod
 from petals_tpu.models.client_common import (
     LLAMA_STYLE_CLIENT_PREFIXES,
     llama_style_client_embed,

@@ -56,6 +56,42 @@ def span_param_pspecs(family_name: str, cfg) -> Dict[str, P]:
             "w_down": P(None, COL, None),
             "b_down": P(),
         }
+    if family_name == "falcon":
+        specs = {
+            "wq": P(None, None, COL),
+            "wk": P(None, None, COL),
+            "wv": P(None, None, COL),
+            "wo": P(None, COL, None),
+            "w_up": P(None, None, COL),
+            "w_down": P(None, COL, None),
+        }
+        if cfg.new_decoder_architecture and cfg.num_ln_in_parallel_attn == 2:
+            specs.update(ln_attn_w=P(), ln_attn_b=P(), ln_mlp_w=P(), ln_mlp_b=P())
+        else:
+            specs.update(ln1_w=P(), ln1_b=P())
+            if not cfg.parallel_attn and not cfg.new_decoder_architecture:
+                specs.update(ln2_w=P(), ln2_b=P())
+        if cfg.bias:
+            specs.update(
+                bq=P(None, COL), bk=P(None, COL), bv=P(None, COL),
+                bo=P(), b_up=P(None, COL), b_down=P(),
+            )
+        return specs
+    if family_name == "mixtral":
+        return {
+            "ln1": P(),
+            "wq": P(None, None, COL),
+            "wk": P(None, None, COL),
+            "wv": P(None, None, COL),
+            "wo": P(None, COL, None),
+            "ln2": P(),
+            "gate": P(),
+            # experts: shard the expert axis — expert parallelism over the mesh
+            # (goes beyond the reference, which keeps experts unsharded)
+            "w1": P(None, COL, None, None),
+            "w2": P(None, COL, None, None),
+            "w3": P(None, COL, None, None),
+        }
     raise KeyError(f"No TP spec for family {family_name!r}")
 
 

@@ -177,3 +177,45 @@ def test_bloom_full_model(tmp_path_factory):
             model.close()
     finally:
         harness.stop()
+
+
+def test_mixtral_full_model(tmp_path_factory):
+    from tests.utils import make_tiny_mixtral
+
+    path = make_tiny_mixtral(str(tmp_path_factory.mktemp("models")))
+    harness = SwarmHarness(path, [dict(first_block=0, num_blocks=2)]).start()
+    try:
+        model = AutoDistributedModelForCausalLM.from_pretrained(
+            path, initial_peers=harness.initial_peers
+        )
+        try:
+            rng = np.random.RandomState(6)
+            input_ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+            ours = model.generate(input_ids, max_new_tokens=5)
+            expected = _hf_greedy(path, input_ids, 5)
+            np.testing.assert_array_equal(ours, expected)
+        finally:
+            model.close()
+    finally:
+        harness.stop()
+
+
+def test_falcon_full_model(tmp_path_factory):
+    from tests.utils import make_tiny_falcon
+
+    path = make_tiny_falcon(str(tmp_path_factory.mktemp("models")), variant="new")
+    harness = SwarmHarness(path, [dict(first_block=0, num_blocks=3)]).start()
+    try:
+        model = AutoDistributedModelForCausalLM.from_pretrained(
+            path, initial_peers=harness.initial_peers
+        )
+        try:
+            rng = np.random.RandomState(7)
+            input_ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+            ours = model.generate(input_ids, max_new_tokens=5)
+            expected = _hf_greedy(path, input_ids, 5)
+            np.testing.assert_array_equal(ours, expected)
+        finally:
+            model.close()
+    finally:
+        harness.stop()

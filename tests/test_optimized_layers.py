@@ -1,0 +1,100 @@
+"""Falcon + Mixtral exactness tests (port of reference
+tests/test_optimized_layers.py:189-224 — these families have no CI swarm model
+in the reference either; they are validated at block level against HF,
+including cache equality across multi-token and 1-token steps)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from petals_tpu.server.from_pretrained import get_block_config, load_block_params
+from tests.test_block_exact_match import _hf_hidden_states
+from tests.utils import make_tiny_falcon, make_tiny_mixtral
+
+ATOL = 2e-4
+
+
+@pytest.mark.parametrize("variant", ["new", "7b", "rw"])
+def test_falcon_block_exact_match(variant, tmp_path):
+    import torch
+
+    path = make_tiny_falcon(str(tmp_path), variant=variant)
+    family, cfg = get_block_config(path)
+    assert family.name == "falcon"
+
+    torch.manual_seed(0)
+    input_ids = torch.randint(0, 100, (2, 12))
+    hiddens = _hf_hidden_states(path, input_ids)
+
+    for i in range(cfg.num_hidden_layers):
+        params = load_block_params(path, i, dtype=jnp.float32)
+        ours, _ = family.block_apply(params, jnp.asarray(hiddens[i]), None, 0, cfg)
+        np.testing.assert_allclose(
+            np.asarray(ours), hiddens[i + 1], atol=ATOL, rtol=0,
+            err_msg=f"falcon-{variant} block {i} diverged",
+        )
+
+
+@pytest.mark.parametrize("variant", ["new", "7b"])
+def test_falcon_cache_decode(variant, tmp_path):
+    path = make_tiny_falcon(str(tmp_path), variant=variant)
+    family, cfg = get_block_config(path)
+    params = load_block_params(path, 0, dtype=jnp.float32)
+
+    rng = np.random.RandomState(0)
+    total = 10
+    hidden = jnp.asarray(rng.randn(1, total, cfg.hidden_size), jnp.float32)
+    full, _ = family.block_apply(params, hidden, None, 0, cfg)
+
+    kv = (
+        jnp.zeros((1, 16, cfg.num_kv_heads, cfg.head_dim), jnp.float32),
+        jnp.zeros((1, 16, cfg.num_kv_heads, cfg.head_dim), jnp.float32),
+    )
+    outs, position = [], 0
+    for chunk in (hidden[:, :6], hidden[:, 6:7], hidden[:, 7:]):
+        out, kv = family.block_apply(params, chunk, kv, position, cfg)
+        outs.append(np.asarray(out))
+        position += chunk.shape[1]
+    np.testing.assert_allclose(np.concatenate(outs, axis=1), np.asarray(full), atol=ATOL, rtol=0)
+
+
+def test_mixtral_block_exact_match(tmp_path):
+    import torch
+
+    path = make_tiny_mixtral(str(tmp_path))
+    family, cfg = get_block_config(path)
+    assert family.name == "mixtral"
+    assert cfg.num_local_experts == 4 and cfg.num_experts_per_tok == 2
+
+    torch.manual_seed(1)
+    input_ids = torch.randint(0, 100, (2, 10))
+    hiddens = _hf_hidden_states(path, input_ids)
+
+    for i in range(cfg.num_hidden_layers):
+        params = load_block_params(path, i, dtype=jnp.float32)
+        ours, _ = family.block_apply(params, jnp.asarray(hiddens[i]), None, 0, cfg)
+        np.testing.assert_allclose(
+            np.asarray(ours), hiddens[i + 1], atol=ATOL, rtol=0,
+            err_msg=f"mixtral block {i} diverged",
+        )
+
+
+def test_mixtral_cache_decode(tmp_path):
+    path = make_tiny_mixtral(str(tmp_path))
+    family, cfg = get_block_config(path)
+    params = load_block_params(path, 0, dtype=jnp.float32)
+    rng = np.random.RandomState(2)
+    total = 8
+    hidden = jnp.asarray(rng.randn(1, total, cfg.hidden_size), jnp.float32)
+    full, _ = family.block_apply(params, hidden, None, 0, cfg)
+
+    kv = (
+        jnp.zeros((1, 8, cfg.num_key_value_heads, cfg.head_dim), jnp.float32),
+        jnp.zeros((1, 8, cfg.num_key_value_heads, cfg.head_dim), jnp.float32),
+    )
+    outs, position = [], 0
+    for chunk in (hidden[:, :5], hidden[:, 5:6], hidden[:, 6:]):
+        out, kv = family.block_apply(params, chunk, kv, position, cfg)
+        outs.append(np.asarray(out))
+        position += chunk.shape[1]
+    np.testing.assert_allclose(np.concatenate(outs, axis=1), np.asarray(full), atol=ATOL, rtol=0)

@@ -54,3 +54,60 @@ def make_tiny_bloom(tmpdir: str, *, n_layers: int = 3, vocab: int = 128) -> str:
     path = os.path.join(tmpdir, "tiny-bloom")
     model.save_pretrained(path, safe_serialization=True)
     return path
+
+
+def make_tiny_falcon(tmpdir: str, *, variant: str = "new", n_layers: int = 3, vocab: int = 128) -> str:
+    """variant: "new" (40b-style GQA dual-LN), "7b" (MQA parallel), "rw" (MHA alibi serial)."""
+    from transformers import FalconConfig, FalconForCausalLM
+
+    common = dict(
+        vocab_size=vocab,
+        hidden_size=64,
+        num_hidden_layers=n_layers,
+        num_attention_heads=4,
+        layer_norm_epsilon=1e-5,
+    )
+    if variant == "new":
+        cfg = FalconConfig(
+            **common, new_decoder_architecture=True, num_kv_heads=2, multi_query=False,
+            parallel_attn=True, bias=False, alibi=False,
+        )
+    elif variant == "7b":
+        cfg = FalconConfig(
+            **common, new_decoder_architecture=False, multi_query=True,
+            parallel_attn=True, bias=False, alibi=False,
+        )
+    elif variant == "rw":
+        cfg = FalconConfig(
+            **common, new_decoder_architecture=False, multi_query=False,
+            parallel_attn=False, bias=True, alibi=True,
+        )
+    else:
+        raise ValueError(variant)
+    torch.manual_seed(3)
+    model = FalconForCausalLM(cfg).eval()
+    path = os.path.join(tmpdir, f"tiny-falcon-{variant}")
+    model.save_pretrained(path, safe_serialization=True)
+    return path
+
+
+def make_tiny_mixtral(tmpdir: str, *, n_layers: int = 2, vocab: int = 128) -> str:
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    cfg = MixtralConfig(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=n_layers,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        rms_norm_eps=1e-6,
+        sliding_window=None,
+    )
+    torch.manual_seed(4)
+    model = MixtralForCausalLM(cfg).eval()
+    path = os.path.join(tmpdir, "tiny-mixtral")
+    model.save_pretrained(path, safe_serialization=True)
+    return path
