@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from petals_tpu.models.bloom.config import BloomBlockConfig
-from petals_tpu.models.common import KVCache, gelu_tanh, layer_norm, update_kv_cache
+from petals_tpu.models.common import KVCache, gelu_tanh, layer_norm, mm, update_kv_cache
 from petals_tpu.models.registry import ModelFamily, register_family
 from petals_tpu.ops.alibi import build_alibi_slopes
 from petals_tpu.ops.attention import attend
@@ -36,9 +36,9 @@ def block_apply(
     ln1 = layer_norm(hidden_states, params["ln1_w"], params["ln1_b"], cfg.layer_norm_epsilon)
     residual = ln1 if cfg.apply_residual_connection_post_layernorm else hidden_states
 
-    q = (ln1 @ params["wq"] + params["bq"]).reshape(batch, seq, h, d)
-    k = (ln1 @ params["wk"] + params["bk"]).reshape(batch, seq, h, d)
-    v = (ln1 @ params["wv"] + params["bv"]).reshape(batch, seq, h, d)
+    q = (mm(ln1, params["wq"]) + params["bq"]).reshape(batch, seq, h, d)
+    k = (mm(ln1, params["wk"]) + params["bk"]).reshape(batch, seq, h, d)
+    v = (mm(ln1, params["wv"]) + params["bv"]).reshape(batch, seq, h, d)
 
     k_all, v_all, kv_length = update_kv_cache(kv, k, v, position, n_valid)
     slopes = build_alibi_slopes(h)
@@ -51,12 +51,12 @@ def block_apply(
         alibi_slopes=slopes,
         use_flash=use_flash,
     )
-    attn = attn.reshape(batch, seq, h * d) @ params["wo"] + params["bo"]
+    attn = mm(attn.reshape(batch, seq, h * d), params["wo"]) + params["bo"]
     hidden_states = attn + residual
 
     ln2 = layer_norm(hidden_states, params["ln2_w"], params["ln2_b"], cfg.layer_norm_epsilon)
     residual = ln2 if cfg.apply_residual_connection_post_layernorm else hidden_states
-    mlp = gelu_tanh(ln2 @ params["w_up"] + params["b_up"]) @ params["w_down"] + params["b_down"]
+    mlp = mm(gelu_tanh(mm(ln2, params["w_up"]) + params["b_up"]), params["w_down"]) + params["b_down"]
     hidden_states = mlp + residual
 
     new_kv = (k_all, v_all) if kv is not None else None

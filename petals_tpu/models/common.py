@@ -45,6 +45,15 @@ def silu(x: jnp.ndarray) -> jnp.ndarray:
 ACTIVATIONS = {"silu": silu, "gelu_tanh": gelu_tanh, "gelu": jax.nn.gelu}
 
 
+def mm(x: jnp.ndarray, w) -> jnp.ndarray:
+    """Matmul that dispatches on dense vs quantized weights (ops/quant.py)."""
+    from petals_tpu.ops.quant import QuantizedLinear, quant_matmul
+
+    if isinstance(w, QuantizedLinear):
+        return quant_matmul(x, w)
+    return x @ w
+
+
 def update_kv_cache(
     kv: Optional[KVCache], k_new: jnp.ndarray, v_new: jnp.ndarray, position, n_valid=None
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:

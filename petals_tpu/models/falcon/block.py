@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from petals_tpu.models.common import KVCache, layer_norm, update_kv_cache
+from petals_tpu.models.common import KVCache, layer_norm, mm, update_kv_cache
 from petals_tpu.models.falcon.config import FalconBlockConfig
 from petals_tpu.models.registry import ModelFamily, register_family
 from petals_tpu.ops.alibi import build_alibi_slopes
@@ -58,9 +58,9 @@ def block_apply(
         attn_ln = layer_norm(hidden_states, params["ln1_w"], params["ln1_b"], cfg.layer_norm_epsilon)
         mlp_ln = attn_ln  # parallel single-LN case; serial case overwritten below
 
-    q = attn_ln @ params["wq"]
-    k = attn_ln @ params["wk"]
-    v = attn_ln @ params["wv"]
+    q = mm(attn_ln, params["wq"])
+    k = mm(attn_ln, params["wk"])
+    v = mm(attn_ln, params["wv"])
     if cfg.bias:
         q = q + params["bq"]
         k = k + params["bk"]
@@ -91,7 +91,7 @@ def block_apply(
         alibi_slopes=alibi_slopes,
         use_flash=use_flash,
     )
-    attn = attn.reshape(batch, seq, hq * d) @ params["wo"]
+    attn = mm(attn.reshape(batch, seq, hq * d), params["wo"])
     if cfg.bias:
         attn = attn + params["bo"]
 
@@ -102,11 +102,11 @@ def block_apply(
         mlp_ln = layer_norm(residual, params["ln2_w"], params["ln2_b"], cfg.layer_norm_epsilon)
 
     # HF FalconMLP: dense_h_to_4h -> ACT2FN[config.activation] -> dense_4h_to_h
-    mlp = mlp_ln @ params["w_up"]
+    mlp = mm(mlp_ln, params["w_up"])
     if cfg.bias:
         mlp = mlp + params["b_up"]
     mlp = _activation(mlp, cfg.activation)
-    mlp = mlp @ params["w_down"]
+    mlp = mm(mlp, params["w_down"])
     if cfg.bias:
         mlp = mlp + params["b_down"]
 

@@ -15,7 +15,7 @@ from typing import Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from petals_tpu.models.common import KVCache, rms_norm, silu, update_kv_cache
+from petals_tpu.models.common import KVCache, mm, rms_norm, silu, update_kv_cache
 from petals_tpu.models.llama.config import LlamaBlockConfig
 from petals_tpu.models.registry import ModelFamily, register_family
 from petals_tpu.ops.attention import attend
@@ -38,9 +38,9 @@ def block_apply(
     residual = hidden_states
     x = rms_norm(hidden_states, params["ln1"], cfg.rms_norm_eps)
 
-    q = x @ params["wq"]
-    k = x @ params["wk"]
-    v = x @ params["wv"]
+    q = mm(x, params["wq"])
+    k = mm(x, params["wk"])
+    v = mm(x, params["wv"])
     if cfg.attention_bias:
         q = q + params["bq"]
         k = k + params["bk"]
@@ -59,19 +59,19 @@ def block_apply(
     attn = attend(
         q, k_all, v_all, q_offset=position, kv_length=kv_length, use_flash=use_flash
     )
-    attn = attn.reshape(batch, seq, hq * d) @ params["wo"]
+    attn = mm(attn.reshape(batch, seq, hq * d), params["wo"])
     if cfg.attention_bias:
         attn = attn + params["bo"]
     hidden_states = residual + attn
 
     residual = hidden_states
     x = rms_norm(hidden_states, params["ln2"], cfg.rms_norm_eps)
-    gate = x @ params["wg"]
-    up = x @ params["wu"]
+    gate = mm(x, params["wg"])
+    up = mm(x, params["wu"])
     if cfg.mlp_bias:
         gate = gate + params["bg"]
         up = up + params["bu"]
-    mlp = (silu(gate) * up) @ params["wd"]
+    mlp = mm(silu(gate) * up, params["wd"])
     if cfg.mlp_bias:
         mlp = mlp + params["bd"]
     hidden_states = residual + mlp

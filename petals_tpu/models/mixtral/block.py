@@ -21,11 +21,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from petals_tpu.models.common import KVCache, rms_norm, silu, update_kv_cache
+from petals_tpu.models.common import KVCache, mm, rms_norm, silu, update_kv_cache
 from petals_tpu.models.mixtral.config import MixtralBlockConfig
 from petals_tpu.models.registry import ModelFamily, register_family
 from petals_tpu.ops.attention import attend
 from petals_tpu.ops.rotary import apply_rotary, rotary_tables
+
+
+def _expert_weights(leaf, dtype):
+    """Dense [E, in, out] expert weights, dequantizing stacked NF4/INT8 leaves."""
+    from petals_tpu.ops.quant import QuantizedLinear, dequantize
+
+    if isinstance(leaf, QuantizedLinear):
+        return dequantize(leaf, dtype)
+    return leaf
 
 
 def moe_apply(params: dict, x: jnp.ndarray, cfg: MixtralBlockConfig) -> jnp.ndarray:
@@ -40,9 +49,12 @@ def moe_apply(params: dict, x: jnp.ndarray, cfg: MixtralBlockConfig) -> jnp.ndar
     combine = (one_hot * top_probs[..., None]).sum(axis=2).astype(x.dtype)
 
     # dense expert compute on stacked weights: w1/w3 [E, h, m], w2 [E, m, h]
-    gate_out = jnp.einsum("bsh,ehm->ebsm", x, params["w1"])
-    up = jnp.einsum("bsh,ehm->ebsm", x, params["w3"])
-    expert_out = jnp.einsum("ebsm,emh->ebsh", silu(gate_out) * up, params["w2"])
+    w1 = _expert_weights(params["w1"], x.dtype)
+    w2 = _expert_weights(params["w2"], x.dtype)
+    w3 = _expert_weights(params["w3"], x.dtype)
+    gate_out = jnp.einsum("bsh,ehm->ebsm", x, w1)
+    up = jnp.einsum("bsh,ehm->ebsm", x, w3)
+    expert_out = jnp.einsum("ebsm,emh->ebsh", silu(gate_out) * up, w2)
     return jnp.einsum("ebsh,bse->bsh", expert_out, combine)
 
 
@@ -61,9 +73,9 @@ def block_apply(
 
     residual = hidden_states
     x = rms_norm(hidden_states, params["ln1"], cfg.rms_norm_eps)
-    q = (x @ params["wq"]).reshape(batch, seq, hq, d)
-    k = (x @ params["wk"]).reshape(batch, seq, hkv, d)
-    v = (x @ params["wv"]).reshape(batch, seq, hkv, d)
+    q = mm(x, params["wq"]).reshape(batch, seq, hq, d)
+    k = mm(x, params["wk"]).reshape(batch, seq, hkv, d)
+    v = mm(x, params["wv"]).reshape(batch, seq, hkv, d)
 
     positions = jnp.asarray(position, jnp.int32) + jnp.arange(seq, dtype=jnp.int32)
     positions = jnp.broadcast_to(positions[None, :], (batch, seq))
@@ -81,7 +93,7 @@ def block_apply(
         sliding_window=cfg.sliding_window,
         use_flash=use_flash,
     )
-    hidden_states = residual + (attn.reshape(batch, seq, hq * d) @ params["wo"])
+    hidden_states = residual + mm(attn.reshape(batch, seq, hq * d), params["wo"])
 
     residual = hidden_states
     x = rms_norm(hidden_states, params["ln2"], cfg.rms_norm_eps)

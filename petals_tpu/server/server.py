@@ -24,6 +24,7 @@ from petals_tpu.server.backend import TransformerBackend
 from petals_tpu.server.from_pretrained import get_block_config, load_block_params
 from petals_tpu.server.handler import TransformerHandler
 from petals_tpu.server.memory_cache import MemoryCache
+from petals_tpu.utils.convert_block import QuantType, block_size_bytes, convert_block_params
 from petals_tpu.utils.dht_utils import declare_active_modules
 from petals_tpu.utils.logging import get_logger
 
@@ -63,6 +64,7 @@ class Server:
         use_flash: Optional[bool] = None,
         max_alloc_timeout: float = 600.0,
         num_tp_devices: Optional[int] = None,  # >1: shard the span over this host's chips
+        quant_type: str = "none",  # "none" | "int8" | "nf4" (ops/quant.py)
     ):
         self.model_path = model_path
         self.family, self.cfg = get_block_config(model_path)
@@ -83,6 +85,12 @@ class Server:
         self.use_flash = use_flash
         self.max_alloc_timeout = max_alloc_timeout
         self.num_tp_devices = num_tp_devices
+        self.quant_type = quant_type
+        if QuantType(quant_type) != QuantType.NONE and (num_tp_devices or 1) > 1:
+            raise ValueError(
+                "quant_type and num_tp_devices>1 cannot be combined yet: "
+                "quantized leaves have no tensor-parallel PartitionSpecs"
+            )
 
         self.module_uids = [
             make_uid(self.dht_prefix, i)
@@ -127,8 +135,12 @@ class Server:
 
         def load_all():
             per_block = [
-                load_block_params(
-                    self.model_path, i, dtype=self.compute_dtype, family=self.family, cfg=self.cfg
+                convert_block_params(
+                    load_block_params(
+                        self.model_path, i, dtype=self.compute_dtype, family=self.family, cfg=self.cfg
+                    ),
+                    self.family.name,
+                    self.quant_type,
                 )
                 for i in range(self.first_block, self.first_block + self.num_blocks)
             ]
@@ -137,7 +149,11 @@ class Server:
         # load off the event loop: the DHT node is already answering peers and
         # must not go dark for the (potentially minutes-long) weight load
         stacked = await asyncio.get_running_loop().run_in_executor(None, load_all)
-        logger.info(f"Blocks loaded in {time.perf_counter() - t0:.1f}s")
+        span_bytes = block_size_bytes(stacked)
+        logger.info(
+            f"Blocks loaded in {time.perf_counter() - t0:.1f}s "
+            f"({span_bytes / 2**20:.0f} MiB for {self.num_blocks} blocks, quant={self.quant_type})"
+        )
 
         mesh = None
         if self.num_tp_devices is not None and self.num_tp_devices > 1:
@@ -206,6 +222,7 @@ class Server:
             public_name=self.public_name,
             version=petals_tpu.__version__,
             compute_dtype=str(jnp.dtype(self.compute_dtype).name),
+            quant_type=self.quant_type,
             cache_tokens_left=cache_tokens_left,
         )
 

@@ -1,0 +1,65 @@
+"""Turn vanilla block params into the served artifact: quantization (+ LoRA
+adapters are installed by the peft module)
+(counterpart of reference src/petals/utils/convert_block.py:25-115 — the
+freeze/TP-wrap steps are implicit here: JAX params are immutable and TP is a
+sharding applied at backend construction).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, Set
+
+import jax
+import jax.numpy as jnp
+
+from petals_tpu.ops.quant import QuantizedLinear, quantize
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class QuantType(str, enum.Enum):
+    NONE = "none"
+    INT8 = "int8"  # LLM.int8-class weight-only quantization
+    NF4 = "nf4"  # QLoRA-style 4-bit normal float
+
+
+# The big matmul weights of each family (norms/biases/router stay dense).
+QUANTIZABLE_LEAVES: Dict[str, Set[str]] = {
+    "llama": {"wq", "wk", "wv", "wo", "wg", "wu", "wd"},
+    "bloom": {"wq", "wk", "wv", "wo", "w_up", "w_down"},
+    "falcon": {"wq", "wk", "wv", "wo", "w_up", "w_down"},
+    # expert stacks (w1/w2/w3) carry >90% of Mixtral's params — quantized
+    # per-expert (3-D leaves), unlike the reference which also quantizes them
+    "mixtral": {"wq", "wk", "wv", "wo", "w1", "w2", "w3"},
+}
+
+
+def convert_block_params(params: dict, family_name: str, quant_type: QuantType) -> dict:
+    """Quantize one (unstacked) block's matmul weights in place of dense leaves."""
+    quant_type = QuantType(quant_type)
+    if quant_type == QuantType.NONE:
+        return params
+    quantizable = QUANTIZABLE_LEAVES.get(family_name, set())
+    out = {}
+    for name, leaf in params.items():
+        ndim = getattr(leaf, "ndim", 0)
+        if name in quantizable and ndim == 2:
+            out[name] = quantize(jnp.asarray(leaf), quant_type.value)
+        elif name in quantizable and ndim == 3:  # expert stacks [E, in, out]
+            per_expert = [quantize(jnp.asarray(leaf[e]), quant_type.value) for e in range(leaf.shape[0])]
+            out[name] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_expert)
+        else:
+            out[name] = leaf
+    return out
+
+
+def block_size_bytes(params: dict) -> int:
+    total = 0
+    for leaf in params.values():
+        if isinstance(leaf, QuantizedLinear):
+            total += leaf.nbytes
+        else:
+            total += leaf.size * leaf.dtype.itemsize
+    return total

@@ -1,0 +1,122 @@
+"""Quantization tests: INT8/NF4 formats, Pallas dequant-matmul vs XLA
+reference, quantized block error bounds, quantized server e2e
+(the TPU-native replacement for bitsandbytes — SURVEY.md §2.3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from petals_tpu.ops.quant import (
+    NF4_BLOCK,
+    dequantize,
+    nf4_matmul_pallas,
+    quant_matmul,
+    quantize_int8,
+    quantize_nf4,
+    quantized_bytes,
+)
+from petals_tpu.utils.convert_block import QuantType, convert_block_params
+
+
+def test_int8_roundtrip_error():
+    rng = np.random.RandomState(0)
+    w = rng.randn(128, 256).astype(np.float32)
+    q = quantize_int8(w)
+    assert q.data.dtype == jnp.int8 and q.data.shape == (128, 256)
+    deq = np.asarray(dequantize(q, jnp.float32))
+    # symmetric per-channel int8: error bounded by scale/2 per channel
+    scale = np.abs(w).max(axis=0) / 127
+    assert (np.abs(deq - w) <= scale[None, :] * 0.5 + 1e-6).all()
+
+
+def test_nf4_roundtrip_error():
+    rng = np.random.RandomState(1)
+    w = (rng.randn(256, 128) * 0.05).astype(np.float32)
+    q = quantize_nf4(w)
+    assert q.data.dtype == jnp.uint8
+    stored = q.data.shape[0] * 2  # input axis padded to the Pallas k-tile
+    assert stored >= 256 and q.data.shape[1] == 128
+    assert q.scales.shape == (stored // NF4_BLOCK, 128)
+    deq = np.asarray(dequantize(q, jnp.float32))
+    # blockwise absmax: worst-case error is half the largest codebook gap * absmax
+    blocks = w.reshape(-1, NF4_BLOCK, 128)
+    absmax = np.abs(blocks).max(axis=1)
+    max_gap = 0.18  # largest NF4 inter-code distance
+    bound = np.repeat(absmax, NF4_BLOCK, axis=0) * max_gap
+    assert (np.abs(deq - w) <= bound + 1e-6).all()
+    # genuine 4.25-bit format over the STORED (k-tile padded) size; padding
+    # overhead only matters for toy matrices like this one
+    assert q.nbytes <= quantized_bytes(stored * 128, "nf4") + 1024
+
+
+def test_nf4_pallas_matches_xla():
+    rng = np.random.RandomState(2)
+    w = (rng.randn(512, 256) * 0.05).astype(np.float32)
+    x = rng.randn(16, 512).astype(np.float32)
+    q = quantize_nf4(w)
+    expected = x @ np.asarray(dequantize(q, jnp.float32))
+    got = np.asarray(nf4_matmul_pallas(jnp.asarray(x), q))
+    np.testing.assert_allclose(got, expected, atol=2e-2, rtol=1e-2)
+
+
+def test_quant_matmul_grad_flows_to_x():
+    rng = np.random.RandomState(3)
+    w = (rng.randn(256, 256) * 0.05).astype(np.float32)
+    q = quantize_nf4(w)
+    x = jnp.asarray(rng.randn(1, 4, 256), jnp.float32)
+
+    def loss(x):
+        return quant_matmul(x, q).sum()
+
+    g = jax.grad(loss)(x)
+    expected = np.asarray(dequantize(q, jnp.float32)).sum(axis=1)
+    np.testing.assert_allclose(
+        np.asarray(g[0, 0], np.float32), expected, atol=0.3, rtol=0.05
+    )
+
+
+@pytest.mark.parametrize("quant", [QuantType.INT8, QuantType.NF4])
+def test_quantized_block_close_to_dense(quant, tmp_path):
+    from petals_tpu.server.from_pretrained import get_block_config, load_block_params
+    from tests.utils import make_tiny_llama
+
+    path = make_tiny_llama(str(tmp_path))
+    family, cfg = get_block_config(path)
+    params = load_block_params(path, 0, dtype=jnp.float32)
+    qparams = convert_block_params(params, "llama", quant)
+
+    rng = np.random.RandomState(4)
+    hidden = jnp.asarray(rng.randn(1, 8, cfg.hidden_size) * 0.5, jnp.float32)
+    dense_out, _ = family.block_apply(params, hidden, None, 0, cfg)
+    quant_out, _ = family.block_apply(qparams, hidden, None, 0, cfg)
+    err = np.abs(np.asarray(quant_out) - np.asarray(dense_out)).max()
+    assert err < (0.2 if quant == QuantType.NF4 else 0.05), f"{quant}: err {err}"
+
+
+def test_quantized_server_generates(tmp_path):
+    """NF4 server serves a session end-to-end (reference CI quantized-server
+    coverage); greedy tokens may differ from f32 HF — assert mechanics."""
+    from petals_tpu.client.model import AutoDistributedModelForCausalLM
+    from tests.test_full_model import SwarmHarness
+    from tests.utils import make_tiny_llama
+
+    path = make_tiny_llama(str(tmp_path))
+    harness = SwarmHarness(path, [dict(first_block=0, num_blocks=4, quant_type="nf4")]).start()
+    try:
+        model = AutoDistributedModelForCausalLM.from_pretrained(
+            path, initial_peers=harness.initial_peers
+        )
+        try:
+            rng = np.random.RandomState(5)
+            ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+            out = model.generate(ids, max_new_tokens=4)
+            assert out.shape == (1, 9)
+            assert (out >= 0).all() and (out < model.cfg.vocab_size).all()
+            # training path through a quantized server too
+            logits = np.asarray(model.forward(ids))
+            assert np.isfinite(logits).all()
+        finally:
+            model.close()
+    finally:
+        harness.stop()
