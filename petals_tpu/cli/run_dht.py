@@ -1,0 +1,60 @@
+"""Run a standalone DHT bootstrap node:
+``python -m petals_tpu.cli.run_dht [--host H] [--port P] [--identity_seed S]``
+(counterpart of reference src/petals/cli/run_dht.py:37-106).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+
+from petals_tpu.dht.node import DHTNode
+from petals_tpu.server.reachability import ReachabilityProtocol
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="Bootstrap/relay node for a petals_tpu swarm")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--initial_peers", nargs="*", default=[])
+    parser.add_argument("--identity_seed", default=None,
+                        help="Seed string for a deterministic peer id (stable multiaddr)")
+    parser.add_argument("--refresh_period", type=float, default=30.0,
+                        help="Period of the liveness self-check (reference run_dht.py:24-34)")
+    args = parser.parse_args(argv)
+
+    async def run():
+        node = await DHTNode.create(
+            host=args.host,
+            port=args.port,
+            initial_peers=args.initial_peers,
+            identity_seed=args.identity_seed.encode() if args.identity_seed else None,
+        )
+        ReachabilityProtocol().register(node.server)
+        logger.info(f"DHT bootstrap running at {node.own_addr.to_string()}")
+        print(node.own_addr.to_string(), flush=True)  # scripts consume this line
+
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+
+        async def heartbeat():
+            while True:
+                await asyncio.sleep(args.refresh_period)
+                logger.debug(f"Alive; routing table size: {len(node.table)}")
+
+        task = asyncio.create_task(heartbeat())
+        await stop.wait()
+        task.cancel()
+        await node.shutdown()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+"""Run a petals_tpu server: ``python -m petals_tpu.cli.run_server <model_path> [...]``
+(counterpart of reference src/petals/cli/run_server.py:19-235).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+
+import jax.numpy as jnp
+
+from petals_tpu.constants import DTYPE_MAP
+from petals_tpu.server.server import Server
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="Host a span of transformer blocks on this TPU host")
+    parser.add_argument("model", help="Local path of the HF checkpoint to serve")
+    parser.add_argument("--host", default="0.0.0.0", help="Listen address")
+    parser.add_argument("--port", type=int, default=0, help="Listen port (0 = ephemeral)")
+    parser.add_argument("--initial_peers", nargs="*", default=[],
+                        help="Bootstrap peers as host:port/peer_id strings")
+    parser.add_argument("--identity_seed", default=None,
+                        help="Seed string for a deterministic peer id (test swarms)")
+    parser.add_argument("--dht_prefix", default=None, help="Swarm namespace (default: derived from model name)")
+    parser.add_argument("--first_block", type=int, default=None,
+                        help="First block to serve (default: auto-placement from swarm state)")
+    parser.add_argument("--num_blocks", type=int, default=None,
+                        help="How many blocks to serve (default: auto-size to device memory)")
+    parser.add_argument("--block_indices", default=None,
+                        help="Alternative to first/num: a range like 0:16")
+    parser.add_argument("--torch_dtype", "--dtype", dest="dtype", default="bfloat16",
+                        choices=[k for k in DTYPE_MAP if k != "auto"], help="Compute dtype")
+    parser.add_argument("--quant_type", default="none", choices=["none", "int8", "nf4"],
+                        help="Weight quantization (ops/quant.py)")
+    parser.add_argument("--attn_cache_tokens", type=int, default=8192,
+                        help="KV-cache budget in tokens (converted to bytes for the allocator)")
+    parser.add_argument("--max_chunk_size_bytes", type=int, default=256 * 1024 * 1024,
+                        help="Prefill chunking bound (attention logits bytes)")
+    parser.add_argument("--throughput", default="auto",
+                        help='"auto" to self-measure, or a number')
+    parser.add_argument("--update_period", type=float, default=30.0, help="DHT announce period, seconds")
+    parser.add_argument("--mean_balance_check_period", type=float, default=0.0,
+                        help=">0: periodically consider moving to under-served blocks")
+    parser.add_argument("--num_tp_devices", type=int, default=None,
+                        help="Tensor-parallel over this many local chips")
+    parser.add_argument("--public_name", default=None, help="Display name announced to the swarm")
+    parser.add_argument("--max_alloc_timeout", type=float, default=600.0)
+    return parser
+
+
+def parse_block_range(args) -> tuple:
+    if args.block_indices:
+        first, last = args.block_indices.split(":")
+        return int(first), int(last) - int(first)
+    return args.first_block, args.num_blocks
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    first_block, num_blocks = parse_block_range(args)
+
+    try:
+        throughput = float(args.throughput)
+    except ValueError:
+        throughput = args.throughput
+
+    # token budget -> bytes happens inside Server once the config is known
+    from petals_tpu.server.from_pretrained import get_block_config
+
+    family, cfg = get_block_config(args.model)
+    hkv = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
+    dtype = DTYPE_MAP[args.dtype]
+    attn_cache_bytes = (
+        2 * args.attn_cache_tokens * hkv * cfg.head_dim * jnp.dtype(dtype).itemsize
+        * (num_blocks or cfg.num_hidden_layers)
+    )
+
+    server = Server(
+        args.model,
+        first_block=first_block,
+        num_blocks=num_blocks,
+        dht_prefix=args.dht_prefix,
+        host=args.host,
+        port=args.port,
+        initial_peers=args.initial_peers,
+        identity_seed=args.identity_seed.encode() if args.identity_seed else None,
+        compute_dtype=dtype,
+        attn_cache_bytes=attn_cache_bytes,
+        max_chunk_size_bytes=args.max_chunk_size_bytes,
+        throughput=throughput,
+        public_name=args.public_name,
+        update_period=args.update_period,
+        mean_balance_check_period=args.mean_balance_check_period,
+        max_alloc_timeout=args.max_alloc_timeout,
+        num_tp_devices=args.num_tp_devices,
+        quant_type=args.quant_type,
+    )
+
+    async def run():
+        await server.start()
+        logger.info(f"Serving; announce address: {server.dht.own_addr.to_string()}")
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        logger.info("Shutting down")
+        await server.shutdown()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

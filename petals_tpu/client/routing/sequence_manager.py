@@ -69,7 +69,14 @@ class RemoteSequenceManager:
         self.directory = ModuleDirectory(dht)
         self.state = RemoteSequenceInfo.make_empty(self.block_uids)
         self.pool = ConnectionPool(own_peer_id=dht.peer_id, connect_timeout=config.connect_timeout)
-        self.rtt_fn = rtt_fn or (lambda src, dst: DEFAULT_RTT)
+        if rtt_fn is None:
+            from petals_tpu.utils.ping import PingAggregator
+
+            self.ping_aggregator = PingAggregator(self.pool)
+            rtt_fn = lambda src, dst: self.ping_aggregator.rtt(dst, DEFAULT_RTT)  # noqa: E731
+        else:
+            self.ping_aggregator = None
+        self.rtt_fn = rtt_fn
         self._banned: Dict[PeerID, Tuple[float, int]] = {}  # peer -> (banned_until, streak)
         self._update_lock = asyncio.Lock()
         self._update_task = asyncio.create_task(self._update_loop())
@@ -82,6 +89,26 @@ class RemoteSequenceManager:
             infos = await self.directory.fetch(self.block_uids, active_adapter=self.config.active_adapter)
             infos = self._apply_allow_block_lists(infos)
             self.state.update_(infos)
+            await self._ping_candidates()
+
+    async def _ping_candidates(self) -> None:
+        """Measure RTT to a sample of chain-head candidates so min_latency
+        routing has real edge costs (reference sequence_manager.py:340-386)."""
+        if self.ping_aggregator is None or not self.state.spans_by_priority:
+            return
+        from petals_tpu.utils.random_utils import sample_up_to
+
+        candidates = []
+        for span in self.state.spans_by_priority:
+            addr = self.directory.addr_of(span.peer_id)
+            if addr is not None:
+                candidates.append(addr)
+        candidates = sample_up_to(candidates, self.config.max_pinged)
+        if candidates:
+            try:
+                await asyncio.wait_for(self.ping_aggregator.ping(candidates), 10.0)
+            except Exception as e:
+                logger.debug(f"Ping round failed: {e}")
 
     def _apply_allow_block_lists(self, infos):
         allowed = set(self.config.allowed_servers or [])

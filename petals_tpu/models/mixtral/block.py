@@ -28,17 +28,10 @@ from petals_tpu.ops.attention import attend
 from petals_tpu.ops.rotary import apply_rotary, rotary_tables
 
 
-def _expert_weights(leaf, dtype):
-    """Dense [E, in, out] expert weights, dequantizing stacked NF4/INT8 leaves."""
-    from petals_tpu.ops.quant import QuantizedLinear, dequantize
-
-    if isinstance(leaf, QuantizedLinear):
-        return dequantize(leaf, dtype)
-    return leaf
-
-
 def moe_apply(params: dict, x: jnp.ndarray, cfg: MixtralBlockConfig) -> jnp.ndarray:
     """x: [batch, seq, hidden] -> mixture of top-k experts, HF-exact routing."""
+    from petals_tpu.ops.quant import QuantizedLinear, quant_matmul
+
     router_logits = x @ params["gate"]  # [b, s, E]
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
     top_probs, top_idx = jax.lax.top_k(probs, cfg.num_experts_per_tok)  # [b, s, k]
@@ -48,13 +41,24 @@ def moe_apply(params: dict, x: jnp.ndarray, cfg: MixtralBlockConfig) -> jnp.ndar
     one_hot = jax.nn.one_hot(top_idx, cfg.num_local_experts, dtype=top_probs.dtype)
     combine = (one_hot * top_probs[..., None]).sum(axis=2).astype(x.dtype)
 
-    # dense expert compute on stacked weights: w1/w3 [E, h, m], w2 [E, m, h]
-    w1 = _expert_weights(params["w1"], x.dtype)
-    w2 = _expert_weights(params["w2"], x.dtype)
-    w3 = _expert_weights(params["w3"], x.dtype)
-    gate_out = jnp.einsum("bsh,ehm->ebsm", x, w1)
-    up = jnp.einsum("bsh,ehm->ebsm", x, w3)
-    expert_out = jnp.einsum("ebsm,emh->ebsh", silu(gate_out) * up, w2)
+    w1, w2, w3 = params["w1"], params["w2"], params["w3"]
+    if isinstance(w1, QuantizedLinear):
+        # Quantized experts: run each expert through quant_matmul (the fused
+        # NF4 kernel on TPU) — dense expert weights are never materialized, so
+        # the 4-bit memory budget that sized this span holds at runtime.
+        def expert(e):
+            def slice_q(q):
+                return QuantizedLinear(q.kind, q.data[e], q.scales[e], q.in_features, q.out_features)
+
+            g = silu(quant_matmul(x, slice_q(w1))) * quant_matmul(x, slice_q(w3))
+            return quant_matmul(g, slice_q(w2))
+
+        expert_out = jnp.stack([expert(e) for e in range(cfg.num_local_experts)])  # [E, b, s, h]
+    else:
+        # dense expert compute on stacked weights: w1/w3 [E, h, m], w2 [E, m, h]
+        gate_out = jnp.einsum("bsh,ehm->ebsm", x, w1)
+        up = jnp.einsum("bsh,ehm->ebsm", x, w3)
+        expert_out = jnp.einsum("ebsm,emh->ebsh", silu(gate_out) * up, w2)
     return jnp.einsum("ebsh,bse->bsh", expert_out, combine)
 
 

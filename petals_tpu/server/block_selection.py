@@ -1,0 +1,82 @@
+"""Decentralized load balancing: which span should this server host?
+(counterpart of reference src/petals/server/block_selection.py:12-95 — the
+algorithm is hardware-agnostic numpy and keeps the same semantics: maximize the
+swarm's bottleneck throughput, move only when it meaningfully helps).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from petals_tpu.data_structures import PeerID, RemoteModuleInfo, ServerState
+
+BALANCE_QUALITY = 0.75  # rebalance iff actual/optimal throughput drops below this
+
+
+def compute_throughputs(
+    module_infos: Sequence[Optional[RemoteModuleInfo]],
+    *,
+    exclude_peer: Optional[PeerID] = None,
+) -> np.ndarray:
+    """Per-block aggregate swarm throughput (JOINING servers count: they will
+    arrive soon — reference block_selection.py:12-20)."""
+    throughputs = np.zeros(len(module_infos))
+    for block_idx, info in enumerate(module_infos):
+        if info is None:
+            continue
+        for peer_id, server in info.servers.items():
+            if peer_id == exclude_peer:
+                continue
+            if server.state.value >= ServerState.JOINING.value:
+                throughputs[block_idx] += server.throughput
+    return throughputs
+
+
+def choose_best_start(throughputs: np.ndarray, num_blocks: int) -> int:
+    """Start index whose span covers the weakest blocks (reference :23-33)."""
+    options = [
+        (throughputs[i : i + num_blocks].min(), throughputs[i : i + num_blocks].sum(), i)
+        for i in range(0, len(throughputs) - num_blocks + 1)
+    ]
+    # host the span with the lowest bottleneck; break ties toward the span
+    # that is weakest overall (then leftmost)
+    best = min(options)
+    return best[2]
+
+
+def should_choose_other_blocks(
+    local_peer: PeerID,
+    module_infos: Sequence[Optional[RemoteModuleInfo]],
+    num_blocks: int,
+    *,
+    balance_quality: float = BALANCE_QUALITY,
+) -> bool:
+    """Would the swarm's bottleneck improve enough if this server moved?
+    Simulates our move plus greedy follow-up moves by others (reference :40-95)."""
+    throughputs_with_us = compute_throughputs(module_infos)
+    local_throughput = _local_throughput(local_peer, module_infos)
+    if local_throughput == 0:
+        return False
+
+    throughputs = compute_throughputs(module_infos, exclude_peer=local_peer)
+    actual_quality = throughputs_with_us.min() / max(throughputs_with_us.mean(), 1e-9)
+    if actual_quality >= balance_quality:
+        return False  # already well balanced
+
+    # simulate: we move to the best start given everyone else stays
+    new_start = choose_best_start(throughputs, num_blocks)
+    moved = throughputs.copy()
+    moved[new_start : new_start + num_blocks] += local_throughput
+
+    # if the bottleneck after our move is no better than now, don't thrash
+    eps = 1e-3
+    return moved.min() > throughputs_with_us.min() + eps
+
+
+def _local_throughput(local_peer, module_infos) -> float:
+    for info in module_infos:
+        if info is not None and local_peer in info.servers:
+            return info.servers[local_peer].throughput
+    return 0.0

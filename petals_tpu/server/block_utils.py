@@ -1,0 +1,67 @@
+"""Block sizing + auto num_blocks choice
+(counterpart of reference src/petals/server/block_utils.py:12-65 +
+server.py:275-326 `_choose_num_blocks`)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from petals_tpu.ops.quant import BITS_PER_PARAM
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+AUTOGRAD_RESERVE_FRACTION = 0.15  # headroom for activations/backward buffers
+
+
+def block_params_count(family, cfg) -> int:
+    shapes = family.block_param_shapes(cfg, jnp.bfloat16)
+    return int(sum(np.prod(s.shape) for s in shapes.values()))
+
+
+def estimated_block_size_bytes(family, cfg, quant_type: str = "none") -> int:
+    """Bytes of one served block at the given quantization
+    (reference block_utils.py:22-53; NF4 = 4.25 bits/param)."""
+    return int(block_params_count(family, cfg) * BITS_PER_PARAM[quant_type] / 8)
+
+
+def device_memory_bytes() -> Optional[int]:
+    """Total memory of the local accelerator, if the backend reports it."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    if jax.default_backend() == "tpu":
+        return 16 * 2**30  # v5e per-chip HBM as a fallback
+    return None
+
+
+def choose_num_blocks(
+    family,
+    cfg,
+    *,
+    quant_type: str = "none",
+    attn_cache_bytes: int = 0,
+    memory_limit_bytes: Optional[int] = None,
+) -> int:
+    """How many blocks fit this chip alongside the KV budget + autograd reserve
+    (reference server.py:275-326)."""
+    memory = memory_limit_bytes or device_memory_bytes()
+    if memory is None:
+        logger.warning("Unknown device memory; defaulting to serving all blocks")
+        return cfg.num_hidden_layers
+    usable = memory * (1 - AUTOGRAD_RESERVE_FRACTION) - attn_cache_bytes
+    per_block = estimated_block_size_bytes(family, cfg, quant_type)
+    n = max(int(usable // per_block), 1)
+    n = min(n, cfg.num_hidden_layers)
+    logger.info(
+        f"Auto-selected {n} blocks ({per_block / 2**20:.0f} MiB each, "
+        f"{memory / 2**30:.1f} GiB device memory, quant={quant_type})"
+    )
+    return n

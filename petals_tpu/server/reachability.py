@@ -1,0 +1,69 @@
+"""Reachability checking: can other peers dial us back?
+(counterpart of reference src/petals/server/reachability.py:86-164 — the P2P
+``rpc_check`` protocol where peers probe each other; the reference's
+centralized health-API check (:22-52) has no private-swarm analogue, so the
+peer-probe path is the implementation).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Sequence
+
+from petals_tpu.dht.node import DHTNode
+from petals_tpu.dht.routing import PeerAddr
+from petals_tpu.rpc.client import RpcClient
+from petals_tpu.rpc.server import RpcContext, RpcServer
+from petals_tpu.utils.logging import get_logger
+from petals_tpu.utils.random_utils import sample_up_to
+
+logger = get_logger(__name__)
+
+
+class ReachabilityProtocol:
+    """Registers ``reach.check`` on a node's RPC server: the callee dials the
+    requested address back and reports success."""
+
+    def __init__(self, *, probe_timeout: float = 5.0):
+        self.probe_timeout = probe_timeout
+
+    def register(self, server: RpcServer) -> None:
+        server.add_unary_handler("reach.check", self.rpc_check)
+
+    async def rpc_check(self, payload, ctx: RpcContext):
+        addr = PeerAddr.from_string(payload["addr"])
+        try:
+            client = await asyncio.wait_for(
+                RpcClient.connect(addr.host, addr.port), self.probe_timeout
+            )
+            ok = client.remote_peer_id == addr.peer_id or client.remote_peer_id is None
+            await client.close()
+            return {"reachable": bool(ok)}
+        except Exception as e:
+            return {"reachable": False, "reason": f"{type(e).__name__}: {e}"}
+
+
+async def check_direct_reachability(
+    dht: DHTNode, *, max_peers: int = 3, threshold: float = 0.5
+) -> Optional[bool]:
+    """Ask a few peers to dial us back (reference server.py:137-150 decides
+    client-mode/relay from this). None = inconclusive (nobody to ask)."""
+    own = dht.own_addr
+    if own is None:
+        return None
+    peers: Sequence[PeerAddr] = sample_up_to(dht.table.all_peers(), max_peers)
+    if not peers:
+        return None
+    results = []
+    for peer in peers:
+        try:
+            client = await dht.pool.get(peer.host, peer.port)
+            reply = await asyncio.wait_for(
+                client.call("reach.check", {"addr": own.to_string()}), 10.0
+            )
+            results.append(bool(reply.get("reachable")))
+        except Exception:
+            continue
+    if not results:
+        return None
+    return sum(results) / len(results) >= threshold

@@ -48,8 +48,8 @@ class Server:
         self,
         model_path: str,
         *,
-        first_block: int = 0,
-        num_blocks: Optional[int] = None,
+        first_block: Optional[int] = None,  # None: auto-place from swarm state
+        num_blocks: Optional[int] = None,  # None: auto-size to device memory
         dht_prefix: Optional[str] = None,
         host: str = "127.0.0.1",
         port: int = 0,
@@ -58,9 +58,10 @@ class Server:
         compute_dtype=jnp.bfloat16,
         attn_cache_bytes: Optional[int] = None,
         max_chunk_size_bytes: int = 256 * 1024 * 1024,
-        throughput: float = 1.0,
+        throughput="auto",  # float, or "auto" to self-measure (server/throughput.py)
         public_name: Optional[str] = None,
         update_period: float = DEFAULT_UPDATE_PERIOD,
+        mean_balance_check_period: float = 0.0,  # >0: periodically rebalance span placement
         use_flash: Optional[bool] = None,
         max_alloc_timeout: float = 600.0,
         num_tp_devices: Optional[int] = None,  # >1: shard the span over this host's chips
@@ -69,9 +70,27 @@ class Server:
         self.model_path = model_path
         self.family, self.cfg = get_block_config(model_path)
         total = self.cfg.num_hidden_layers
-        self.first_block = first_block
-        self.num_blocks = num_blocks if num_blocks is not None else total - first_block
-        assert 0 <= first_block < first_block + self.num_blocks <= total
+        self.auto_placement = first_block is None
+        if attn_cache_bytes is None:
+            from petals_tpu.server.block_utils import device_memory_bytes
+
+            memory = device_memory_bytes()
+            # default KV budget: 15% of device memory (reference reserves an
+            # attn-cache fraction before packing blocks, server.py:275-326)
+            attn_cache_bytes = int(memory * 0.15) if memory else 2 << 30
+        if num_blocks is None:
+            if first_block is not None:
+                num_blocks = total - first_block
+            else:
+                from petals_tpu.server.block_utils import choose_num_blocks
+
+                num_blocks = choose_num_blocks(
+                    self.family, self.cfg, quant_type=quant_type,
+                    attn_cache_bytes=attn_cache_bytes or 0,
+                )
+        self.first_block = first_block if first_block is not None else 0
+        self.num_blocks = num_blocks
+        assert 0 <= self.first_block < self.first_block + self.num_blocks <= total
         self.dht_prefix = dht_prefix or default_dht_prefix(model_path)
         self.host, self.port = host, port
         self.initial_peers = list(initial_peers)
@@ -79,9 +98,13 @@ class Server:
         self.compute_dtype = compute_dtype
         self.attn_cache_bytes = attn_cache_bytes
         self.max_chunk_size_bytes = max_chunk_size_bytes
-        self.throughput = throughput
+        if not isinstance(throughput, (int, float)) and throughput != "auto":
+            raise ValueError(f'throughput must be a number or "auto", got {throughput!r}')
+        self._throughput_spec = throughput
+        self.throughput = throughput if isinstance(throughput, (int, float)) else 1.0
         self.public_name = public_name
         self.update_period = update_period
+        self.mean_balance_check_period = mean_balance_check_period
         self.use_flash = use_flash
         self.max_alloc_timeout = max_alloc_timeout
         self.num_tp_devices = num_tp_devices
@@ -102,6 +125,8 @@ class Server:
         self.backend: Optional[TransformerBackend] = None
         self.memory_cache: Optional[MemoryCache] = None
         self._announcer_task: Optional[asyncio.Task] = None
+        self._balancer_task: Optional[asyncio.Task] = None
+        self._state = ServerState.JOINING  # what the announce loop broadcasts
         self._ready = asyncio.Event()
 
     # ------------------------------------------------------------------ lifecycle
@@ -120,9 +145,35 @@ class Server:
             initial_peers=self.initial_peers,
         )
 
+        from petals_tpu.server.reachability import ReachabilityProtocol
+
+        ReachabilityProtocol().register(self.rpc_server)
+
         # max_alloc_timeout caps client-requested allocation waits so one
         # unsatisfiable session can't park at the head of the FIFO forever
         self.memory_cache = MemoryCache(self.attn_cache_bytes, max_alloc_timeout=self.max_alloc_timeout)
+
+        if self._throughput_spec == "auto":
+            from petals_tpu.server.throughput import get_server_throughput
+
+            info = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: get_server_throughput(
+                    self.family, self.cfg, compute_dtype=self.compute_dtype, num_blocks=self.num_blocks
+                ),
+            )
+            self.throughput = info["throughput"]
+            self._rps_info = info
+        else:
+            self._rps_info = None
+
+        if self.auto_placement:
+            self.first_block = await self._choose_start_block()
+            self.module_uids = [
+                make_uid(self.dht_prefix, i)
+                for i in range(self.first_block, self.first_block + self.num_blocks)
+            ]
+            logger.info(f"Auto placement: serving blocks [{self.first_block}, {self.first_block + self.num_blocks})")
 
         # announce JOINING while blocks load (reference server.py:468-481)
         await self._announce(ServerState.JOINING)
@@ -132,46 +183,18 @@ class Server:
             f"of {self.model_path}"
         )
         t0 = time.perf_counter()
-
-        def load_all():
-            per_block = [
-                convert_block_params(
-                    load_block_params(
-                        self.model_path, i, dtype=self.compute_dtype, family=self.family, cfg=self.cfg
-                    ),
-                    self.family.name,
-                    self.quant_type,
-                )
-                for i in range(self.first_block, self.first_block + self.num_blocks)
-            ]
-            return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
-
         # load off the event loop: the DHT node is already answering peers and
         # must not go dark for the (potentially minutes-long) weight load
-        stacked = await asyncio.get_running_loop().run_in_executor(None, load_all)
+        stacked = await asyncio.get_running_loop().run_in_executor(
+            None, self._load_span_params, self.first_block, self.num_blocks
+        )
         span_bytes = block_size_bytes(stacked)
         logger.info(
             f"Blocks loaded in {time.perf_counter() - t0:.1f}s "
             f"({span_bytes / 2**20:.0f} MiB for {self.num_blocks} blocks, quant={self.quant_type})"
         )
 
-        mesh = None
-        if self.num_tp_devices is not None and self.num_tp_devices > 1:
-            from petals_tpu.parallel.mesh import tp_mesh
-
-            mesh = tp_mesh(self.num_tp_devices)
-        self.backend = TransformerBackend(
-            self.family,
-            self.cfg,
-            stacked,
-            first_block=self.first_block,
-            n_blocks=self.num_blocks,
-            memory_cache=self.memory_cache,
-            compute_dtype=self.compute_dtype,
-            max_chunk_size_bytes=self.max_chunk_size_bytes,
-            use_flash=self.use_flash,
-            mesh=mesh,
-        )
+        self.backend = self._make_backend(stacked, self.first_block)
         self.handler = TransformerHandler(
             self.backend,
             dht_prefix=self.dht_prefix,
@@ -180,8 +203,11 @@ class Server:
         )
         self.handler.register(self.rpc_server)
 
+        self._state = ServerState.ONLINE
         await self._announce(ServerState.ONLINE)
         self._announcer_task = asyncio.create_task(self._announce_loop())
+        if self.mean_balance_check_period > 0:
+            self._balancer_task = asyncio.create_task(self._balance_loop())
         self._ready.set()
         logger.info(f"Server ready: {self.dht.own_addr.to_string()} serving {self.module_uids}")
 
@@ -189,6 +215,12 @@ class Server:
         await self._ready.wait()
 
     async def shutdown(self) -> None:
+        if self._balancer_task is not None:
+            self._balancer_task.cancel()
+            try:
+                await self._balancer_task
+            except asyncio.CancelledError:
+                pass
         if self._announcer_task is not None:
             self._announcer_task.cancel()
             try:
@@ -214,9 +246,13 @@ class Server:
             cache_tokens_left = int(
                 self.memory_cache.bytes_left // max(self.backend.cache_bytes_per_token(), 1)
             )
+        rps = getattr(self, "_rps_info", None) or {}
         return ServerInfo(
             state=state,
             throughput=self.throughput,
+            inference_rps=rps.get("inference_rps"),
+            forward_rps=rps.get("forward_rps"),
+            network_rps=rps.get("network_rps"),
             start_block=self.first_block,
             end_block=self.first_block + self.num_blocks,
             public_name=self.public_name,
@@ -232,10 +268,112 @@ class Server:
             self.dht, self.module_uids, self._server_info(state), expiration
         )
 
+    def _load_span_params(self, first_block: int, num_blocks: int):
+        per_block = [
+            convert_block_params(
+                load_block_params(
+                    self.model_path, i, dtype=self.compute_dtype, family=self.family, cfg=self.cfg
+                ),
+                self.family.name,
+                self.quant_type,
+            )
+            for i in range(first_block, first_block + num_blocks)
+        ]
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
+
+    def _make_backend(self, stacked, first_block: int) -> TransformerBackend:
+        mesh = None
+        if self.num_tp_devices is not None and self.num_tp_devices > 1:
+            from petals_tpu.parallel.mesh import tp_mesh
+
+            mesh = tp_mesh(self.num_tp_devices)
+        return TransformerBackend(
+            self.family,
+            self.cfg,
+            stacked,
+            first_block=first_block,
+            n_blocks=self.num_blocks,
+            memory_cache=self.memory_cache,
+            compute_dtype=self.compute_dtype,
+            max_chunk_size_bytes=self.max_chunk_size_bytes,
+            use_flash=self.use_flash,
+            mesh=mesh,
+        )
+
+    async def _choose_start_block(self, throughputs=None) -> int:
+        """Pick the span covering the swarm's weakest blocks (reference
+        server.py:403-418 via block_selection)."""
+        import numpy as np
+
+        from petals_tpu.data_structures import make_uid as _mk
+        from petals_tpu.server.block_selection import choose_best_start, compute_throughputs
+        from petals_tpu.utils.dht_utils import get_remote_module_infos
+
+        if throughputs is None:
+            all_uids = [_mk(self.dht_prefix, i) for i in range(self.cfg.num_hidden_layers)]
+            infos, _ = await get_remote_module_infos(self.dht, all_uids)
+            throughputs = compute_throughputs(infos, exclude_peer=self.dht.peer_id)
+        return choose_best_start(np.asarray(throughputs), self.num_blocks)
+
+    async def _balance_loop(self) -> None:
+        """Periodically re-evaluate placement and move if the swarm would gain
+        (reference server.py:369-384 rebalance loop)."""
+        import random as _random
+
+        from petals_tpu.data_structures import make_uid as _mk
+        from petals_tpu.server.block_selection import should_choose_other_blocks
+        from petals_tpu.utils.dht_utils import get_remote_module_infos
+
+        while True:
+            await asyncio.sleep(self.mean_balance_check_period * (0.5 + _random.random()))
+            try:
+                all_uids = [_mk(self.dht_prefix, i) for i in range(self.cfg.num_hidden_layers)]
+                infos, _ = await get_remote_module_infos(self.dht, all_uids)
+                if should_choose_other_blocks(self.dht.peer_id, infos, self.num_blocks):
+                    from petals_tpu.server.block_selection import compute_throughputs
+
+                    throughputs = compute_throughputs(infos, exclude_peer=self.dht.peer_id)
+                    new_start = await self._choose_start_block(throughputs)
+                    if new_start != self.first_block:
+                        logger.info(f"Rebalancing: moving span to start at block {new_start}")
+                        await self._reload_span(new_start)
+            except Exception as e:
+                logger.warning(f"Balance check failed: {e}")
+
+    async def _reload_span(self, new_first_block: int) -> None:
+        """Move to a new span: announce OFFLINE on the old blocks, reload, and
+        re-register (reference ModuleContainer restart, server.py:369-384)."""
+        old_uids = self.module_uids
+        try:
+            await declare_active_modules(
+                self.dht, old_uids, self._server_info(ServerState.OFFLINE), dht_time() + 60
+            )
+        except Exception:
+            pass
+        self.first_block = new_first_block
+        self.module_uids = [
+            make_uid(self.dht_prefix, i)
+            for i in range(self.first_block, self.first_block + self.num_blocks)
+        ]
+        self._state = ServerState.JOINING  # the announce loop must NOT say ONLINE yet
+        await self._announce(ServerState.JOINING)
+
+        stacked = await asyncio.get_running_loop().run_in_executor(
+            None, self._load_span_params, self.first_block, self.num_blocks
+        )
+        # Build a FRESH backend: open sessions keep their reference to the old
+        # one (consistent old-span compute until they close); the constructor
+        # also re-applies TP sharding for mesh servers.
+        self.backend = self._make_backend(stacked, self.first_block)
+        self.handler.backend = self.backend
+        self.handler._sub_backends = {}
+        self._state = ServerState.ONLINE
+        await self._announce(ServerState.ONLINE)
+
     async def _announce_loop(self) -> None:
         while True:
             await asyncio.sleep(self.update_period)
             try:
-                await self._announce(ServerState.ONLINE)
+                await self._announce(self._state)
             except Exception as e:
                 logger.warning(f"Announce failed: {e}")

@@ -1,0 +1,190 @@
+"""Server throughput self-measurement
+(counterpart of reference src/petals/server/throughput.py:37-237).
+
+Measures, per block:
+- inference_rps: 1-token decode steps/sec through a real jitted block
+- forward_rps:   1024-token forward tokens/sec
+- network_rps:   how many requests/sec the wire could carry, from a loopback
+  serialization+framing probe (the reference shells out to speedtest-cli; a
+  private TPU swarm measures its own stack instead — pass --network_mbps to
+  override with a known WAN budget)
+
+Results are cached in a fcntl-locked JSON file keyed by (model shape, dtype,
+quant, version) — reference throughput.py:53-94.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import time
+from pathlib import Path
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import petals_tpu
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_CACHE_PATH = Path(os.environ.get("PETALS_TPU_CACHE", Path.home() / ".cache" / "petals_tpu"))
+THROUGHPUT_FILE = "throughput_v1.json"
+RELAY_PENALTY = 0.2  # reference throughput.py:47
+
+
+def get_server_throughput(
+    family,
+    cfg,
+    *,
+    compute_dtype=jnp.bfloat16,
+    n_steps_inference: int = 50,
+    n_steps_forward: int = 5,
+    network_mbps: Optional[float] = None,
+    num_blocks: int = 1,
+    using_relay: bool = False,
+    cache_dir: Optional[Path] = None,
+    force_eval: bool = False,
+) -> dict:
+    """Returns {"throughput", "inference_rps", "forward_rps", "network_rps"}."""
+    cache_dir = Path(cache_dir or DEFAULT_CACHE_PATH)
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    cache_path = cache_dir / THROUGHPUT_FILE
+
+    cache_key = json.dumps(
+        {
+            "family": family.name,
+            "hidden": cfg.hidden_size,
+            "layers_probed": 1,
+            "dtype": str(jnp.dtype(compute_dtype).name),
+            "version": petals_tpu.__version__,
+            "backend": jax.default_backend(),
+        },
+        sort_keys=True,
+    )
+
+    cache = _read_cache(cache_path)
+    if not force_eval and cache_key in cache:
+        info = cache[cache_key]
+        logger.info(f"Using cached throughput: {info}")
+    else:
+        info = measure_compute_rps(
+            family, cfg, compute_dtype=compute_dtype,
+            n_steps_inference=n_steps_inference, n_steps_forward=n_steps_forward,
+        )
+        info["network_rps"] = measure_network_rps(cfg.hidden_size, network_mbps=network_mbps)
+        cache[cache_key] = info
+        _write_cache(cache_path, cache)
+
+    # blended throughput (reference throughput.py:96-106): compute spread over
+    # the hosted blocks vs what the network can carry
+    compute_rps = info["forward_rps"] / max(num_blocks, 1)
+    network_rps = info["network_rps"] * (RELAY_PENALTY if using_relay else 1.0)
+    return {
+        "throughput": min(compute_rps, network_rps),
+        "inference_rps": info["inference_rps"],
+        "forward_rps": info["forward_rps"],
+        "network_rps": network_rps,
+    }
+
+
+def measure_compute_rps(
+    family, cfg, *, compute_dtype=jnp.bfloat16, n_steps_inference: int = 50, n_steps_forward: int = 5
+) -> dict:
+    """Benchmark one real block (reference throughput.py:190-237)."""
+    shapes = family.block_param_shapes(cfg, compute_dtype)
+    key = jax.random.PRNGKey(0)
+    params = {}
+    for name, sds in sorted(shapes.items()):
+        key, sub = jax.random.split(key)
+        params[name] = jax.random.normal(sub, sds.shape, compute_dtype) * 0.02
+
+    hkv = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
+    kv = (
+        jnp.zeros((1, 256, hkv, cfg.head_dim), compute_dtype),
+        jnp.zeros((1, 256, hkv, cfg.head_dim), compute_dtype),
+    )
+    import functools
+
+    step = jax.jit(functools.partial(family.block_apply, cfg=cfg), donate_argnums=(2,))
+    token = jnp.zeros((1, 1, cfg.hidden_size), compute_dtype)
+
+    out, kv = step(params, token, kv, 0)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(n_steps_inference):
+        out, kv = step(params, token, kv, i + 1)
+    jax.block_until_ready(out)
+    inference_rps = n_steps_inference / (time.perf_counter() - t0)
+
+    fwd = jax.jit(lambda p, h: family.block_apply(p, h, None, 0, cfg)[0])
+    batch = jnp.zeros((1, 1024, cfg.hidden_size), compute_dtype)
+    jax.block_until_ready(fwd(params, batch))
+    t0 = time.perf_counter()
+    for _ in range(n_steps_forward):
+        out = fwd(params, batch)
+    jax.block_until_ready(out)
+    forward_rps = n_steps_forward * 1024 / (time.perf_counter() - t0)
+
+    logger.info(
+        f"Measured compute: inference {inference_rps:.1f} steps/s, "
+        f"forward {forward_rps:.0f} tok/s per block"
+    )
+    return {"inference_rps": inference_rps, "forward_rps": forward_rps}
+
+
+def measure_network_rps(hidden_size: int, *, network_mbps: Optional[float] = None) -> float:
+    """Tokens/sec the wire can carry at 16 bits/activation element
+    (reference throughput.py:147-175; default 100 Mbit/s on probe failure)."""
+    if network_mbps is None:
+        network_mbps = _loopback_serialization_mbps(hidden_size)
+    bits_per_token = hidden_size * 16
+    return network_mbps * 1e6 / bits_per_token
+
+
+def _loopback_serialization_mbps(hidden_size: int) -> float:
+    """Measure our own serialize->frame->deserialize path as the bandwidth
+    ceiling; fall back to 100 Mbit/s (the reference's default) on failure."""
+    try:
+        from petals_tpu.rpc.protocol import encode_frame
+        from petals_tpu.rpc.serialization import deserialize_array, serialize_array
+
+        arr = np.random.randn(1, 1024, hidden_size).astype(np.float16)
+        t0 = time.perf_counter()
+        n = 5
+        for _ in range(n):
+            frame = encode_frame({"tensors": {"hidden": serialize_array(arr)}})
+            _ = deserialize_array(
+                {"shape": arr.shape, "dtype": "float16", "wire_dtype": "float16",
+                 "compression": "none", "data": arr.tobytes()}
+            )
+        elapsed = time.perf_counter() - t0
+        mbps = (n * len(frame) * 8) / elapsed / 1e6
+        return min(mbps, 10_000.0)  # cap at 10 Gbit/s sanity bound
+    except Exception as e:
+        logger.warning(f"Network probe failed ({e}); assuming 100 Mbit/s")
+        return 100.0
+
+
+def _read_cache(path: Path) -> dict:
+    try:
+        with open(path) as f:
+            fcntl.flock(f, fcntl.LOCK_SH)
+            try:
+                return json.load(f)
+            finally:
+                fcntl.flock(f, fcntl.LOCK_UN)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return {}
+
+
+def _write_cache(path: Path, cache: dict) -> None:
+    with open(path, "w") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            json.dump(cache, f)
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)

@@ -1,0 +1,62 @@
+"""RTT measurement feeding routing decisions
+(counterpart of reference src/petals/utils/ping.py:15-64)."""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import time
+from typing import Dict, Optional, Sequence
+
+from petals_tpu.data_structures import PeerID
+from petals_tpu.dht.routing import PeerAddr
+from petals_tpu.rpc.pool import ConnectionPool
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+async def ping(
+    addr: PeerAddr, pool: ConnectionPool, *, timeout: float = 5.0
+) -> float:
+    """RTT to a peer in seconds; math.inf on failure."""
+    try:
+        start = time.perf_counter()
+        client = await pool.get(addr.host, addr.port)
+        await asyncio.wait_for(client.call("dht.ping", {}), timeout)
+        return time.perf_counter() - start
+    except Exception as e:
+        logger.debug(f"Ping to {addr} failed: {e}")
+        return math.inf
+
+
+class PingAggregator:
+    """EMA-smoothed RTT table with TTL expiry (reference ping.py:40-64)."""
+
+    def __init__(self, pool: ConnectionPool, *, ema_alpha: float = 0.2, expiration: float = 300.0):
+        self.pool = pool
+        self.ema_alpha = ema_alpha
+        self.expiration = expiration
+        self._rtts: Dict[PeerID, tuple] = {}  # peer -> (smoothed_rtt, expires_at)
+
+    async def ping(self, addrs: Sequence[PeerAddr], *, wait_timeout: float = 5.0) -> None:
+        rtts = await asyncio.gather(*(ping(a, self.pool, timeout=wait_timeout) for a in addrs))
+        now = time.monotonic()
+        for addr, rtt in zip(addrs, rtts):
+            prev = self._rtts.get(addr.peer_id)
+            if prev is not None and math.isfinite(prev[0]) and math.isfinite(rtt):
+                rtt = self.ema_alpha * rtt + (1 - self.ema_alpha) * prev[0]
+            self._rtts[addr.peer_id] = (rtt, now + self.expiration)
+
+    def to_dict(self) -> Dict[PeerID, float]:
+        now = time.monotonic()
+        return {pid: rtt for pid, (rtt, expires) in self._rtts.items() if expires > now}
+
+    def rtt(self, peer_id: Optional[PeerID], default: float = 0.01) -> float:
+        """Smoothed RTT for routing edges (default when unknown)."""
+        if peer_id is None:
+            return default
+        entry = self._rtts.get(peer_id)
+        if entry is None or entry[1] <= time.monotonic() or not math.isfinite(entry[0]):
+            return default
+        return entry[0]

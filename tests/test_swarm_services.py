@@ -1,0 +1,235 @@
+"""Swarm services: block selection/rebalancing logic, ping aggregation,
+throughput measurement + cache, reachability, auto-placement, CLI plumbing
+(reference: block_selection.py, throughput.py, ping.py, reachability.py)."""
+
+import asyncio
+import math
+import time
+
+import numpy as np
+import pytest
+
+from petals_tpu.data_structures import PeerID, RemoteModuleInfo, ServerInfo, ServerState
+from petals_tpu.server.block_selection import (
+    choose_best_start,
+    compute_throughputs,
+    should_choose_other_blocks,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _infos(spans):
+    """spans: list of (peer, start, end, throughput) -> module_infos over max end."""
+    n = max(end for _, _, end, _ in spans)
+    infos = [RemoteModuleInfo(f"m.{i}", {}) for i in range(n)]
+    for peer, start, end, thr in spans:
+        for i in range(start, end):
+            infos[i].servers[peer] = ServerInfo(
+                ServerState.ONLINE, thr, start_block=start, end_block=end
+            )
+    return infos
+
+
+def test_compute_throughputs_and_choose_start():
+    a, b = PeerID.from_seed(b"a"), PeerID.from_seed(b"b")
+    infos = _infos([(a, 0, 4, 10.0), (b, 0, 2, 5.0)])
+    thr = compute_throughputs(infos)
+    np.testing.assert_array_equal(thr, [15, 15, 10, 10])
+    # a newcomer with 2 blocks should cover the weakest region [2, 4)
+    assert choose_best_start(thr, 2) == 2
+    # excluding a peer removes its contribution
+    thr_wo = compute_throughputs(infos, exclude_peer=a)
+    np.testing.assert_array_equal(thr_wo, [5, 5, 0, 0])
+
+
+def test_should_choose_other_blocks():
+    a, b, c = (PeerID.from_seed(s) for s in (b"a", b"b", b"c"))
+    # a and b pile on blocks [0, 2); c alone serves [2, 4) -> badly balanced;
+    # moving b to [2, 4) would raise the bottleneck
+    infos = _infos([(a, 0, 2, 10.0), (b, 0, 2, 10.0), (c, 2, 4, 1.0)])
+    assert should_choose_other_blocks(b, infos, 2)
+    # a well-balanced swarm stays put
+    infos = _infos([(a, 0, 2, 10.0), (b, 2, 4, 10.0)])
+    assert not should_choose_other_blocks(b, infos, 2)
+
+
+def test_ping_aggregator_live():
+    async def main():
+        from petals_tpu.dht import DHTNode
+        from petals_tpu.rpc.pool import ConnectionPool
+        from petals_tpu.utils.ping import PingAggregator
+
+        node = await DHTNode.create(maintenance_period=1000)
+        pool = ConnectionPool()
+        agg = PingAggregator(pool)
+        try:
+            await agg.ping([node.own_addr])
+            rtt = agg.rtt(node.peer_id)
+            assert 0 < rtt < 1.0
+            # unknown peers return the routing default
+            assert agg.rtt(PeerID.generate(), default=0.123) == 0.123
+            # dead peer -> inf recorded, default returned for routing
+            from petals_tpu.dht.routing import PeerAddr
+
+            dead = PeerAddr("127.0.0.1", 1, PeerID.generate())
+            await agg.ping([dead])
+            assert agg.rtt(dead.peer_id, default=0.5) == 0.5
+        finally:
+            await pool.close()
+            await node.shutdown()
+
+    run(main())
+
+
+def test_throughput_measure_and_cache(tmp_path):
+    import jax.numpy as jnp
+
+    from petals_tpu.server.from_pretrained import get_block_config
+    from petals_tpu.server.throughput import get_server_throughput
+    from tests.utils import make_tiny_llama
+
+    path = make_tiny_llama(str(tmp_path))
+    family, cfg = get_block_config(path)
+    t0 = time.perf_counter()
+    info = get_server_throughput(
+        family, cfg, compute_dtype=jnp.float32, cache_dir=tmp_path,
+        n_steps_inference=5, n_steps_forward=2, num_blocks=2,
+    )
+    first_took = time.perf_counter() - t0
+    assert info["throughput"] > 0
+    assert info["inference_rps"] > 0 and info["forward_rps"] > 0 and info["network_rps"] > 0
+    # second call hits the cache
+    t0 = time.perf_counter()
+    info2 = get_server_throughput(
+        family, cfg, compute_dtype=jnp.float32, cache_dir=tmp_path, num_blocks=2
+    )
+    assert time.perf_counter() - t0 < first_took / 2
+    assert info2["inference_rps"] == info["inference_rps"]
+    # relay penalty applies
+    relayed = get_server_throughput(
+        family, cfg, compute_dtype=jnp.float32, cache_dir=tmp_path, num_blocks=2, using_relay=True
+    )
+    assert relayed["network_rps"] == pytest.approx(info["network_rps"] * 0.2)
+
+
+def test_reachability_protocol_live():
+    async def main():
+        from petals_tpu.dht import DHTNode
+        from petals_tpu.server.reachability import ReachabilityProtocol, check_direct_reachability
+
+        boot = await DHTNode.create(maintenance_period=1000)
+        ReachabilityProtocol().register(boot.server)
+        node = await DHTNode.create(initial_peers=[boot.own_addr], maintenance_period=1000)
+        ReachabilityProtocol().register(node.server)
+        try:
+            reachable = await check_direct_reachability(node)
+            assert reachable is True
+        finally:
+            await node.shutdown()
+            await boot.shutdown()
+
+    run(main())
+
+
+def test_auto_placement_and_rebalance_live(tmp_path):
+    """A server started with first_block=None must cover the unserved region;
+    the rebalancing loop moves a redundant server (reference server.py:369-418)."""
+    import jax.numpy as jnp
+
+    from petals_tpu.server.server import Server
+    from tests.test_full_model import SwarmHarness
+    from tests.utils import make_tiny_llama
+
+    path = make_tiny_llama(str(tmp_path))  # 4 blocks
+    harness = SwarmHarness(path, [dict(first_block=0, num_blocks=2, throughput=10.0)]).start()
+    try:
+        # auto-placed newcomer must pick the unserved tail [2, 4)
+        async def start_auto():
+            server = Server(
+                path,
+                initial_peers=[harness.bootstrap.own_addr],
+                first_block=None,
+                num_blocks=2,
+                compute_dtype=jnp.float32,
+                use_flash=False,
+                throughput=5.0,
+            )
+            await server.start()
+            return server
+
+        newcomer = harness.run(start_auto())
+        try:
+            assert newcomer.first_block == 2, f"expected auto-placement at 2, got {newcomer.first_block}"
+        finally:
+            harness.run(newcomer.shutdown())
+    finally:
+        harness.stop()
+
+
+def test_cli_parsers():
+    from petals_tpu.cli.run_dht import main as dht_main  # noqa: F401 — importable
+    from petals_tpu.cli.run_server import build_parser, parse_block_range
+
+    parser = build_parser()
+    args = parser.parse_args(
+        ["/path/model", "--block_indices", "4:12", "--quant_type", "nf4", "--throughput", "12.5"]
+    )
+    assert parse_block_range(args) == (4, 8)
+    assert args.quant_type == "nf4"
+    args = parser.parse_args(["/path/model"])
+    assert parse_block_range(args) == (None, None)
+
+
+def test_span_reload_moves_server(tmp_path):
+    """_reload_span (the rebalance move) swaps the served blocks in place and
+    the server keeps answering correctly for the new span."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
+    from petals_tpu.rpc import RpcClient
+    from petals_tpu.rpc.serialization import deserialize_array, serialize_array
+    from petals_tpu.server.server import Server, default_dht_prefix
+    from tests.test_full_model import SwarmHarness
+    from tests.utils import make_tiny_llama
+
+    path = make_tiny_llama(str(tmp_path))
+    harness = SwarmHarness(path, [dict(first_block=0, num_blocks=2)]).start()
+    try:
+        server = harness.servers[0]
+        prefix = default_dht_prefix(path)
+
+        harness.run(server._reload_span(2))
+        assert server.first_block == 2
+        assert server.module_uids == [make_uid(prefix, 2), make_uid(prefix, 3)]
+
+        async def probe():
+            client = await RpcClient.connect(server.rpc_server.host, server.rpc_server.port)
+            try:
+                hidden = np.random.RandomState(0).randn(1, 4, server.cfg.hidden_size).astype(np.float32)
+                uids = CHAIN_DELIMITER.join(make_uid(prefix, i) for i in (2, 3))
+                result = await client.call(
+                    "ptu.forward", {"uids": uids, "tensors": {"hidden": serialize_array(hidden)}}, timeout=60
+                )
+                out = deserialize_array(result["tensors"]["hidden"])
+                expected = np.asarray(server.backend.forward(hidden))
+                np.testing.assert_allclose(out, expected, atol=1e-5, rtol=0)
+                # the old span is rejected now
+                from petals_tpu.rpc import RpcError
+                old_uids = make_uid(prefix, 0)
+                try:
+                    await client.call(
+                        "ptu.forward", {"uids": old_uids, "tensors": {"hidden": serialize_array(hidden)}}, timeout=60
+                    )
+                    raise AssertionError("old span should be rejected")
+                except RpcError:
+                    pass
+            finally:
+                await client.close()
+
+        harness.run(probe())
+    finally:
+        harness.stop()
