@@ -12,12 +12,22 @@ surface of the Petals reference (see SURVEY.md):
 - Coordination happens through a DHT directory: servers announce which blocks
   they serve; clients build min-latency (inference) or max-throughput
   (training) chains, with bans/backoff and mid-generation failover.
+
+Quick start::
+
+    from petals_tpu import AutoDistributedModelForCausalLM
+
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        "/path/to/model", initial_peers=["host:port/peer_id"]
+    )
+    outputs = model.generate(input_ids, max_new_tokens=32)
 """
 
 __version__ = "0.1.0"
 
 from petals_tpu.data_structures import (
     ModuleUID,
+    PeerID,
     RemoteModuleInfo,
     RemoteSpanInfo,
     ServerInfo,
@@ -27,10 +37,37 @@ from petals_tpu.data_structures import (
 
 __all__ = [
     "ModuleUID",
+    "PeerID",
     "RemoteModuleInfo",
     "RemoteSpanInfo",
     "ServerInfo",
     "ServerState",
     "parse_uid",
+    "AutoDistributedModelForCausalLM",
+    "DistributedModelForCausalLM",
+    "Server",
+    "DHTNode",
+    "InferenceSession",
+    "RemoteSequential",
     "__version__",
 ]
+
+
+def __getattr__(name):  # lazy: client/server pull in jax & friends
+    if name in ("AutoDistributedModelForCausalLM", "DistributedModelForCausalLM"):
+        from petals_tpu.client import model as _model
+
+        return getattr(_model, name)
+    if name == "Server":
+        from petals_tpu.server.server import Server
+
+        return Server
+    if name == "DHTNode":
+        from petals_tpu.dht.node import DHTNode
+
+        return DHTNode
+    if name in ("InferenceSession", "RemoteSequential"):
+        import petals_tpu.client as _client
+
+        return getattr(_client, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

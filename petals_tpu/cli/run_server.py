@@ -48,6 +48,8 @@ def build_parser() -> argparse.ArgumentParser:
                         help=">0: periodically consider moving to under-served blocks")
     parser.add_argument("--num_tp_devices", type=int, default=None,
                         help="Tensor-parallel over this many local chips")
+    parser.add_argument("--adapters", nargs="*", default=[],
+                        help="PEFT adapter checkpoint dirs to host (multi-tenant LoRA)")
     parser.add_argument("--public_name", default=None, help="Display name announced to the swarm")
     parser.add_argument("--max_alloc_timeout", type=float, default=600.0)
     return parser
@@ -99,6 +101,7 @@ def main(argv=None) -> None:
         max_alloc_timeout=args.max_alloc_timeout,
         num_tp_devices=args.num_tp_devices,
         quant_type=args.quant_type,
+        adapters=args.adapters,
     )
 
     async def run():

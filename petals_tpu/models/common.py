@@ -46,9 +46,14 @@ ACTIVATIONS = {"silu": silu, "gelu_tanh": gelu_tanh, "gelu": jax.nn.gelu}
 
 
 def mm(x: jnp.ndarray, w) -> jnp.ndarray:
-    """Matmul that dispatches on dense vs quantized weights (ops/quant.py)."""
+    """Matmul dispatching on dense / quantized / LoRA-wrapped weights."""
     from petals_tpu.ops.quant import QuantizedLinear, quant_matmul
+    from petals_tpu.utils.peft import LoraLinear
 
+    if isinstance(w, LoraLinear):
+        base = mm(x, w.base)
+        delta = (x @ w.lora_a.astype(x.dtype)) @ w.lora_b.astype(x.dtype)
+        return base + delta * w.scaling
     if isinstance(w, QuantizedLinear):
         return quant_matmul(x, w)
     return x @ w

@@ -96,6 +96,8 @@ class TransformerBackend:
         self.num_kv_heads = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
         self.head_dim = cfg.head_dim
         self.hidden_size = cfg.hidden_size
+        # adapter name -> (stacked {leaf: (A, B)}, scaling); see utils/peft.py
+        self.adapters: Dict[str, tuple] = {}
 
     # ------------------------------------------------------------- cache descriptors
 
@@ -132,6 +134,18 @@ class TransformerBackend:
         if start == 0 and end == self.n_blocks:
             return self.params
         return jax.tree_util.tree_map(lambda x: x[start:end], self.params)
+
+    def params_for(self, active_adapter: Optional[str]):
+        """Span params with the requested LoRA adapter applied (reference
+        peft.py:132-170's per-request adapter selection, as a pytree arg)."""
+        if not active_adapter:
+            return self.params
+        if active_adapter not in self.adapters:
+            raise KeyError(f"Adapter {active_adapter!r} is not loaded on this server")
+        from petals_tpu.utils.peft import apply_adapter
+
+        stacked_adapter, scaling = self.adapters[active_adapter]
+        return apply_adapter(self.params, stacked_adapter, scaling)
 
     @functools.cached_property
     def _inference_step_fn(self):
@@ -224,6 +238,7 @@ class TransformerBackend:
         *,
         prompts: Optional[np.ndarray] = None,  # [n_blocks, batch, pre_seq, hidden]
         hypo_ids: Optional[np.ndarray] = None,  # [batch]
+        active_adapter: Optional[str] = None,
     ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
         """One (chunked-as-needed) inference step over the whole span chain."""
         k_stack, v_stack = kv
@@ -236,12 +251,14 @@ class TransformerBackend:
             )
 
         hidden = jnp.asarray(hidden, self.compute_dtype)
+        span_params = self.params_for(active_adapter)
         outputs = []
         offset = 0
         for chunk_len in self._chunk_plan(batch, total_seq):
             chunk = hidden[:, offset : offset + chunk_len]
             out, k_stack, v_stack = self._step_once(
-                chunk, k_stack, v_stack, position + offset, prompts, hypo_ids if offset == 0 else None
+                span_params, chunk, k_stack, v_stack, position + offset, prompts,
+                hypo_ids if offset == 0 else None,
             )
             outputs.append(out)
             offset += chunk_len
@@ -249,7 +266,7 @@ class TransformerBackend:
         result = outputs[0] if len(outputs) == 1 else jnp.concatenate(outputs, axis=1)
         return result, (k_stack, v_stack)
 
-    def _step_once(self, chunk, k_stack, v_stack, position, prompts, hypo_ids):
+    def _step_once(self, span_params, chunk, k_stack, v_stack, position, prompts, hypo_ids):
         batch, seq, _ = chunk.shape
         n_valid = seq
         if seq == 1:
@@ -273,7 +290,7 @@ class TransformerBackend:
         )
 
         out, k_stack, v_stack = self._inference_step_fn(
-            self.params,
+            span_params,
             k_stack,
             v_stack,
             padded,
@@ -306,19 +323,24 @@ class TransformerBackend:
             remaining -= step
         return chunks
 
-    def forward(self, hidden: np.ndarray, prompts: Optional[np.ndarray] = None) -> jax.Array:
+    def forward(
+        self, hidden: np.ndarray, prompts: Optional[np.ndarray] = None,
+        active_adapter: Optional[str] = None,
+    ) -> jax.Array:
         """Training-style forward over the span (no KV cache)."""
         hidden = jnp.asarray(hidden, self.compute_dtype)
+        span_params = self.params_for(active_adapter)
         with_prompts = prompts is not None
         prompts_arr = (
             jnp.asarray(prompts, self.compute_dtype)
             if prompts is not None
             else jnp.zeros((self.n_blocks, hidden.shape[0], 0, self.hidden_size), self.compute_dtype)
         )
-        return self._forward_fn(self.params, hidden, prompts_arr, with_prompts=with_prompts)
+        return self._forward_fn(span_params, hidden, prompts_arr, with_prompts=with_prompts)
 
     def backward(
-        self, hidden: np.ndarray, grad_out: np.ndarray, prompts: Optional[np.ndarray] = None
+        self, hidden: np.ndarray, grad_out: np.ndarray, prompts: Optional[np.ndarray] = None,
+        active_adapter: Optional[str] = None,
     ) -> Tuple[jax.Array, Optional[jax.Array]]:
         """Grads wrt inputs (and deep prompts if given) — recomputes the chain
         forward like the reference (run_rpc_backward, block_functions.py:84-141)."""
@@ -331,6 +353,6 @@ class TransformerBackend:
             else jnp.zeros((self.n_blocks, hidden.shape[0], 0, self.hidden_size), self.compute_dtype)
         )
         grad_hidden, grad_prompts = self._backward_fn(
-            self.params, hidden, prompts_arr, grad_out, with_prompts=with_prompts
+            self.params_for(active_adapter), hidden, prompts_arr, grad_out, with_prompts=with_prompts
         )
         return grad_hidden, (grad_prompts if with_prompts else None)

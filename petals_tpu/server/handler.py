@@ -114,9 +114,10 @@ class TransformerHandler:
         if hidden is None or hidden.ndim != 3:
             raise ValueError("rpc_forward expects a [batch, seq, hidden] tensor")
         backend = self._sub_backend(start, end)
+        adapter = payload.get("active_adapter")
         out = await asyncio.wait_for(
             self.queue.submit(
-                lambda: np.asarray(backend.forward(hidden, prompts=prompts)),
+                lambda: np.asarray(backend.forward(hidden, prompts=prompts, active_adapter=adapter)),
                 priority=PRIORITY_TRAINING,
                 size=hidden.shape[0] * hidden.shape[1],
             ),
@@ -132,9 +133,12 @@ class TransformerHandler:
         if hidden is None or grad_out is None:
             raise ValueError("rpc_backward expects hidden and grad_out tensors")
         backend = self._sub_backend(start, end)
+        adapter = payload.get("active_adapter")
 
         def run():
-            grad_hidden, grad_prompts = backend.backward(hidden, grad_out, prompts=prompts)
+            grad_hidden, grad_prompts = backend.backward(
+                hidden, grad_out, prompts=prompts, active_adapter=adapter
+            )
             return np.asarray(grad_hidden), (
                 np.asarray(grad_prompts) if grad_prompts is not None else None
             )
@@ -169,7 +173,9 @@ class TransformerHandler:
         start, end = self._parse_chain(open_msg["uids"])
         max_length = int(open_msg["max_length"])
         batch_size = int(open_msg.get("batch_size", 1))
+        active_adapter = open_msg.get("active_adapter")
         backend = self._sub_backend(start, end)
+        backend.params_for(active_adapter)  # validate the adapter exists up front
 
         descriptors = backend.cache_descriptors(batch_size, max_length, 0, end - start)
         async with self.memory_cache.allocate_cache(
@@ -214,7 +220,8 @@ class TransformerHandler:
 
                 def run_step():
                     out, new_kv = backend.inference_step(
-                        hidden, kv, pos, prompts=prompts, hypo_ids=hypo_ids
+                        hidden, kv, pos, prompts=prompts, hypo_ids=hypo_ids,
+                        active_adapter=active_adapter,
                     )
                     return np.asarray(out), new_kv
 
@@ -241,7 +248,7 @@ class TransformerHandler:
         key = (start, end)
         if key not in self._sub_backends:
             sliced = self.backend._slice_params(start, end)
-            self._sub_backends[key] = TransformerBackend(
+            sub = TransformerBackend(
                 self.backend.family,
                 self.backend.cfg,
                 sliced,
@@ -254,5 +261,12 @@ class TransformerHandler:
                 use_flash=self.backend.use_flash,
                 mesh=self.backend.mesh,
             )
+            import jax
+
+            sub.adapters = {
+                name: (jax.tree_util.tree_map(lambda x: x[start:end], stacked), scaling)
+                for name, (stacked, scaling) in self.backend.adapters.items()
+            }
+            self._sub_backends[key] = sub
         return self._sub_backends[key]
 

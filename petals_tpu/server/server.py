@@ -66,6 +66,7 @@ class Server:
         max_alloc_timeout: float = 600.0,
         num_tp_devices: Optional[int] = None,  # >1: shard the span over this host's chips
         quant_type: str = "none",  # "none" | "int8" | "nf4" (ops/quant.py)
+        adapters: Sequence[str] = (),  # PEFT checkpoint dirs to host (utils/peft.py)
     ):
         self.model_path = model_path
         self.family, self.cfg = get_block_config(model_path)
@@ -109,6 +110,7 @@ class Server:
         self.max_alloc_timeout = max_alloc_timeout
         self.num_tp_devices = num_tp_devices
         self.quant_type = quant_type
+        self.adapter_paths = list(adapters)
         if QuantType(quant_type) != QuantType.NONE and (num_tp_devices or 1) > 1:
             raise ValueError(
                 "quant_type and num_tp_devices>1 cannot be combined yet: "
@@ -195,6 +197,7 @@ class Server:
         )
 
         self.backend = self._make_backend(stacked, self.first_block)
+        self._install_adapters(self.backend)
         self.handler = TransformerHandler(
             self.backend,
             dht_prefix=self.dht_prefix,
@@ -259,6 +262,9 @@ class Server:
             version=petals_tpu.__version__,
             compute_dtype=str(jnp.dtype(self.compute_dtype).name),
             quant_type=self.quant_type,
+            adapters=tuple(
+                sorted(self.backend.adapters) if self.backend is not None else ()
+            ),
             cache_tokens_left=cache_tokens_left,
         )
 
@@ -280,6 +286,18 @@ class Server:
             for i in range(first_block, first_block + num_blocks)
         ]
         return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
+
+    def _install_adapters(self, backend: TransformerBackend) -> None:
+        if not self.adapter_paths:
+            return
+        from petals_tpu.utils.peft import load_adapter, stack_adapter
+
+        block_range = range(self.first_block, self.first_block + self.num_blocks)
+        for path in self.adapter_paths:
+            adapter = load_adapter(path, self.family.name, block_range=block_range)
+            stacked = stack_adapter(adapter, self.first_block, self.num_blocks, self.compute_dtype)
+            backend.adapters[adapter.name] = (stacked, adapter.scaling)
+        logger.info(f"Hosting adapters: {sorted(backend.adapters)}")
 
     def _make_backend(self, stacked, first_block: int) -> TransformerBackend:
         mesh = None
@@ -365,6 +383,7 @@ class Server:
         # one (consistent old-span compute until they close); the constructor
         # also re-applies TP sharding for mesh servers.
         self.backend = self._make_backend(stacked, self.first_block)
+        self._install_adapters(self.backend)
         self.handler.backend = self.backend
         self.handler._sub_backends = {}
         self._state = ServerState.ONLINE

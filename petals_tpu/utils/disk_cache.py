@@ -1,0 +1,96 @@
+"""Cross-process disk-cache management with a size budget
+(counterpart of reference src/petals/utils/disk_cache.py:18-83).
+
+Used by checkpoint/adapter download paths (when a hub is reachable) and by the
+throughput cache: a shared fcntl lock serializes mutations, and an LRU sweep
+frees space for new artifacts under ``--max_disk_space``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import os
+import shutil
+import time
+from pathlib import Path
+from typing import Optional
+
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_CACHE_DIR = Path(os.environ.get("PETALS_TPU_CACHE", Path.home() / ".cache" / "petals_tpu"))
+_LOCK_NAME = ".cache.lock"
+
+
+@contextlib.contextmanager
+def lock_cache_dir(cache_dir: Optional[Path] = None, *, shared: bool = False):
+    """flock over the cache dir: shared for readers, exclusive for mutation
+    (reference disk_cache.py:18-38)."""
+    cache_dir = Path(cache_dir or DEFAULT_CACHE_DIR)
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    lock_path = cache_dir / _LOCK_NAME
+    with open(lock_path, "w") as lock_file:
+        fcntl.flock(lock_file, fcntl.LOCK_SH if shared else fcntl.LOCK_EX)
+        try:
+            yield cache_dir
+        finally:
+            fcntl.flock(lock_file, fcntl.LOCK_UN)
+
+
+def cache_size_bytes(cache_dir: Optional[Path] = None) -> int:
+    cache_dir = Path(cache_dir or DEFAULT_CACHE_DIR)
+    total = 0
+    for root, _dirs, files in os.walk(cache_dir):
+        for name in files:
+            with contextlib.suppress(OSError):
+                total += os.path.getsize(os.path.join(root, name))
+    return total
+
+
+def free_disk_space_for(
+    needed_bytes: int,
+    *,
+    cache_dir: Optional[Path] = None,
+    max_disk_space: Optional[int] = None,
+) -> None:
+    """Evict least-recently-used top-level cache entries until ``needed_bytes``
+    fits under ``max_disk_space`` (reference disk_cache.py:41-83)."""
+    if max_disk_space is None:
+        return
+    with lock_cache_dir(cache_dir) as cache_dir:
+        entries = []
+        for child in cache_dir.iterdir():
+            if child.name == _LOCK_NAME:
+                continue
+            try:
+                stat = child.stat()
+                size = (
+                    sum(f.stat().st_size for f in child.rglob("*") if f.is_file())
+                    if child.is_dir()
+                    else stat.st_size
+                )
+                entries.append((stat.st_atime, size, child))
+            except OSError:
+                continue
+
+        current = sum(size for _, size, _ in entries)
+        for atime, size, child in sorted(entries):
+            if current + needed_bytes <= max_disk_space:
+                break
+            logger.info(
+                f"Evicting {child.name} ({size / 2**20:.0f} MiB, "
+                f"last used {time.time() - atime:.0f}s ago) to free cache space"
+            )
+            if child.is_dir():
+                shutil.rmtree(child, ignore_errors=True)
+            else:
+                with contextlib.suppress(OSError):
+                    child.unlink()
+            current -= size
+        if current + needed_bytes > max_disk_space:
+            raise OSError(
+                f"Insufficient disk space: need {needed_bytes} bytes but only "
+                f"{max_disk_space - current} available under the cache budget"
+            )

@@ -1,0 +1,153 @@
+"""Server-side LoRA multi-tenancy (reference tests/test_peft.py + utils/peft.py
+semantics): adapters load from PEFT checkpoints, apply per request, and match a
+manually LoRA-patched HF model."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.utils import make_tiny_llama
+
+RANK = 4
+ALPHA = 8.0
+
+
+def make_fake_peft_adapter(tmpdir: str, model_path: str, *, name="demo-adapter", seed=0) -> str:
+    """PEFT-format checkpoint: adapter_config.json + adapter_model.safetensors
+    with lora_A/lora_B for q_proj and down_proj of every layer."""
+    from safetensors.torch import save_file
+    from transformers import AutoConfig
+
+    cfg = AutoConfig.from_pretrained(model_path)
+    torch.manual_seed(seed)
+    tensors = {}
+    for i in range(cfg.num_hidden_layers):
+        for proj, (n_in, n_out) in {
+            "self_attn.q_proj": (cfg.hidden_size, cfg.hidden_size),
+            "mlp.down_proj": (cfg.intermediate_size, cfg.hidden_size),
+        }.items():
+            base = f"base_model.model.model.layers.{i}.{proj}"
+            tensors[f"{base}.lora_A.weight"] = torch.randn(RANK, n_in) * 0.1
+            tensors[f"{base}.lora_B.weight"] = torch.randn(n_out, RANK) * 0.1
+
+    path = os.path.join(tmpdir, name)
+    os.makedirs(path, exist_ok=True)
+    save_file(tensors, os.path.join(path, "adapter_model.safetensors"))
+    with open(os.path.join(path, "adapter_config.json"), "w") as f:
+        json.dump({"r": RANK, "lora_alpha": ALPHA, "peft_type": "LORA"}, f)
+    return path
+
+
+def _hf_with_lora(model_path, adapter_path, input_ids):
+    """HF model with the LoRA deltas merged into its weights — ground truth."""
+    from safetensors.torch import load_file
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(model_path, dtype=torch.float32).eval()
+    tensors = load_file(os.path.join(adapter_path, "adapter_model.safetensors"))
+    scaling = ALPHA / RANK
+    with torch.no_grad():
+        for key, a in tensors.items():
+            if ".lora_A." not in key:
+                continue
+            b = tensors[key.replace(".lora_A.", ".lora_B.")]
+            target = key.replace("base_model.model.", "").replace(".lora_A.weight", "")
+            module = model.get_submodule(target)
+            module.weight += (b @ a) * scaling
+        out = model(torch.from_numpy(input_ids))
+    return out.logits.numpy()
+
+
+def test_adapter_loading_and_block_math(tmp_path):
+    from petals_tpu.server.from_pretrained import get_block_config, load_block_params
+    from petals_tpu.utils.peft import apply_adapter, load_adapter, stack_adapter
+
+    model_path = make_tiny_llama(str(tmp_path))
+    adapter_path = make_fake_peft_adapter(str(tmp_path), model_path)
+    family, cfg = get_block_config(model_path)
+
+    adapter = load_adapter(adapter_path, "llama", block_range=range(cfg.num_hidden_layers))
+    assert adapter.rank == RANK and adapter.scaling == ALPHA / RANK
+    assert set(adapter.per_block) == set(range(cfg.num_hidden_layers))
+    assert set(adapter.per_block[0]) == {"wq", "wd"}
+
+    params = load_block_params(model_path, 0, dtype=jnp.float32)
+    stacked1 = stack_adapter(adapter, 0, 1, jnp.float32)
+    import jax
+
+    p1 = {k: (v[0:1] if hasattr(v, "shape") else v) for k, v in params.items()}
+    # manual check at the mm level: wq with lora == base + x@A@B*scaling
+    wrapped = apply_adapter(params, {k: (a[0], b[0]) for k, (a, b) in stacked1.items()}, adapter.scaling)
+    from petals_tpu.models.common import mm
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, cfg.hidden_size), jnp.float32)
+    expected = x @ params["wq"] + (x @ stacked1["wq"][0][0]) @ stacked1["wq"][1][0] * adapter.scaling
+    np.testing.assert_allclose(np.asarray(mm(x, wrapped["wq"])), np.asarray(expected), atol=1e-5)
+
+
+def test_lora_server_e2e_matches_patched_hf(tmp_path):
+    """Full-stack: a server hosting an adapter must produce logits equal to an
+    HF model with the deltas merged — and plain requests stay unaffected."""
+    from petals_tpu.client.model import AutoDistributedModelForCausalLM
+    from tests.test_full_model import SwarmHarness, _hf_logits
+
+    model_path = make_tiny_llama(str(tmp_path))
+    adapter_path = make_fake_peft_adapter(str(tmp_path), model_path)
+    harness = SwarmHarness(
+        model_path, [dict(first_block=0, num_blocks=4, adapters=[adapter_path])]
+    ).start()
+    try:
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, 100, (1, 6)).astype(np.int64)
+
+        plain = AutoDistributedModelForCausalLM.from_pretrained(
+            model_path, initial_peers=harness.initial_peers
+        )
+        try:
+            np.testing.assert_allclose(
+                np.asarray(plain.forward(ids)), _hf_logits(model_path, ids), atol=2e-4, rtol=0
+            )
+        finally:
+            plain.close()
+
+        tuned = AutoDistributedModelForCausalLM.from_pretrained(
+            model_path, initial_peers=harness.initial_peers, active_adapter="demo-adapter"
+        )
+        try:
+            logits = np.asarray(tuned.forward(ids))
+            expected = _hf_with_lora(model_path, adapter_path, ids)
+            np.testing.assert_allclose(logits, expected, atol=5e-4, rtol=0)
+            # inference sessions honor the adapter too
+            out = tuned.generate(ids, max_new_tokens=3)
+            assert out.shape == (1, 9)
+        finally:
+            tuned.close()
+    finally:
+        harness.stop()
+
+
+def test_unknown_adapter_rejected(tmp_path):
+    from petals_tpu.client.model import AutoDistributedModelForCausalLM
+    from petals_tpu.client.routing.sequence_manager import MissingBlocksError
+    from tests.test_full_model import SwarmHarness
+
+    model_path = make_tiny_llama(str(tmp_path))
+    harness = SwarmHarness(model_path, [dict(first_block=0, num_blocks=4)]).start()
+    try:
+        # routing filters servers by advertised adapters -> no usable servers
+        model = AutoDistributedModelForCausalLM.from_pretrained(
+            model_path, initial_peers=harness.initial_peers, active_adapter="nope",
+            max_retries=0,
+        )
+        try:
+            with pytest.raises((MissingBlocksError, RuntimeError)):
+                model.forward(np.zeros((1, 4), np.int64))
+        finally:
+            model.close()
+    finally:
+        harness.stop()

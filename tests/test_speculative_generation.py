@@ -1,0 +1,97 @@
+"""Speculative decoding (port of reference
+tests/test_speculative_generation.py:18-85): output must be token-identical to
+plain greedy regardless of draft quality; rollback must leave the session
+usable."""
+
+import numpy as np
+import pytest
+
+from petals_tpu.client.model import AutoDistributedModelForCausalLM
+from petals_tpu.client.speculative import make_local_draft_fn, speculative_generate
+from tests.test_full_model import SwarmHarness, _hf_greedy
+from tests.utils import make_tiny_llama
+
+NEW_TOKENS = 8
+
+
+@pytest.fixture(scope="module")
+def swarm(tmp_path_factory):
+    path = make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+    harness = SwarmHarness(path, [dict(first_block=0, num_blocks=4)]).start()
+    model = AutoDistributedModelForCausalLM.from_pretrained(path, initial_peers=harness.initial_peers)
+    yield path, harness, model
+    model.close()
+    harness.stop()
+
+
+def test_oracle_draft_token_identical_and_fast(swarm):
+    """A perfect draft (the same model run locally) accepts everything."""
+    path, harness, model = swarm
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+    draft = make_local_draft_fn(path)
+
+    out = speculative_generate(model, draft, ids, max_new_tokens=NEW_TOKENS, speculative_tokens=3)
+    np.testing.assert_array_equal(out, _hf_greedy(path, ids, NEW_TOKENS))
+
+
+def test_junk_draft_still_token_identical(swarm):
+    """An adversarial draft proposing garbage must not change the output —
+    only cost extra rollbacks."""
+    path, harness, model = swarm
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+
+    junk_rng = np.random.RandomState(99)
+
+    def junk_draft(context, k):
+        return junk_rng.randint(0, 100, size=k).astype(np.int64)
+
+    out = speculative_generate(model, junk_draft, ids, max_new_tokens=NEW_TOKENS, speculative_tokens=4)
+    np.testing.assert_array_equal(out, _hf_greedy(path, ids, NEW_TOKENS))
+
+
+def test_partial_acceptance(swarm):
+    """A draft that is right for one token then wrong exercises mid-chunk
+    rollback (start_from_position on the server)."""
+    path, harness, model = swarm
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, 100, (1, 4)).astype(np.int64)
+    expected = _hf_greedy(path, ids, NEW_TOKENS)
+    truth = expected[0, ids.shape[1]:]
+
+    calls = {"n": 0}
+
+    def half_right_draft(context, k):
+        # first draft token correct (from the true continuation), rest wrong
+        pos = len(context) - ids.shape[1]
+        out = []
+        for j in range(k):
+            if j == 0 and pos + j < len(truth):
+                out.append(truth[pos + j])
+            else:
+                out.append(1)  # almost surely wrong
+        calls["n"] += 1
+        return np.asarray(out, np.int64)
+
+    out = speculative_generate(model, half_right_draft, ids, max_new_tokens=NEW_TOKENS, speculative_tokens=3)
+    np.testing.assert_array_equal(out, expected)
+    assert calls["n"] >= 2
+
+
+def test_full_acceptance_no_duplicates(swarm):
+    """A draft that returns the TRUE greedy continuation guarantees the
+    all-accepted branch runs — output must still be token-identical (guards
+    against double-emitting the last accepted draft)."""
+    path, harness, model = swarm
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+    expected = _hf_greedy(path, ids, NEW_TOKENS)
+    truth = expected[0, ids.shape[1]:]
+
+    def oracle(context, k):
+        pos = len(context) - ids.shape[1]
+        return np.asarray(truth[pos : pos + k], np.int64)
+
+    out = speculative_generate(model, oracle, ids, max_new_tokens=NEW_TOKENS, speculative_tokens=3)
+    np.testing.assert_array_equal(out, expected)
