@@ -15,6 +15,7 @@
 from __future__ import annotations
 
 import asyncio
+import uuid
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -46,6 +47,9 @@ class _ServerInferenceSession:
         self.position = 0
         self.history: List[np.ndarray] = []  # inputs sent so far (for failover replay)
         self.closed = False
+        self.session_id: Optional[str] = None
+        # set after chain repair: dict = retarget pushes, False = disable them
+        self.pending_push_to = None
 
     @classmethod
     async def create(
@@ -57,20 +61,27 @@ class _ServerInferenceSession:
         max_length: int,
         batch_size: int = 1,
         step_timeout: float = 5 * 60,
+        session_id: Optional[str] = None,
+        push_to: Optional[dict] = None,
     ) -> "_ServerInferenceSession":
         stub: RpcClient = await seq_manager.get_stub(span.peer_id)
         stream = await stub.open_stream("ptu.inference")
-        await stream.send(
-            {
-                "uids": CHAIN_DELIMITER.join(uids),
-                "max_length": max_length,
-                "batch_size": batch_size,
-                "active_adapter": seq_manager.config.active_adapter,
-            }
-        )
+        open_msg = {
+            "uids": CHAIN_DELIMITER.join(uids),
+            "max_length": max_length,
+            "batch_size": batch_size,
+            "active_adapter": seq_manager.config.active_adapter,
+        }
+        if session_id:
+            open_msg["session_id"] = session_id
+        if push_to:
+            open_msg["push_to"] = push_to
+        await stream.send(open_msg)
         ack = await stream.recv(timeout=step_timeout)
         assert ack.get("session_open"), f"Unexpected open reply: {ack}"
-        return cls(span, uids, stream, max_length=max_length, step_timeout=step_timeout)
+        self = cls(span, uids, stream, max_length=max_length, step_timeout=step_timeout)
+        self.session_id = session_id
+        return self
 
     async def step(
         self,
@@ -79,11 +90,17 @@ class _ServerInferenceSession:
         prompts: Optional[np.ndarray] = None,
         hypo_ids: Optional[np.ndarray] = None,
         start_from_position: Optional[int] = None,
+        step_id: Optional[str] = None,
     ) -> np.ndarray:
         if start_from_position is not None:
             self._rollback_history(start_from_position)
 
         msg = {"tensors": {"hidden": serialize_array(hidden, CompressionType.NONE)}}
+        if step_id is not None:
+            msg["step_id"] = step_id
+        if self.pending_push_to is not None:
+            msg["push_to"] = self.pending_push_to if self.pending_push_to else None
+            self.pending_push_to = None
         if prompts is not None:
             msg["tensors"]["prompts"] = serialize_array(prompts)
         if hypo_ids is not None:
@@ -187,6 +204,7 @@ class InferenceSession:
 
         attempt = 0
         block_idx = 0
+        step_id = uuid.uuid4().hex  # dedups client relay vs server push downstream
         inputs = np.asarray(hidden)
         while block_idx < self.num_blocks:
             server_idx = self._find_session_index(block_idx)
@@ -204,6 +222,7 @@ class InferenceSession:
                     prompts=server_prompts,
                     hypo_ids=hypo_ids,
                     start_from_position=rollback,
+                    step_id=step_id,
                 )
                 assert outputs.shape == inputs.shape, f"{outputs.shape} != {inputs.shape}"
                 inputs = outputs
@@ -236,16 +255,29 @@ class InferenceSession:
         return None
 
     async def _enter_server_sessions(self, chain: List[RemoteSpanInfo]) -> List[_ServerInferenceSession]:
+        """Open one session per span; with use_server_to_server, each server is
+        told where to push its outputs (the next span's session) so downstream
+        compute starts before the client relays — reference
+        _collect_next_servers, inference_session.py:174-182."""
+        use_push = self.seq_manager.config.use_server_to_server and len(chain) > 1
+        session_ids = [uuid.uuid4().hex for _ in chain]
         sessions = []
         try:
-            for span in chain:
+            for i, span in enumerate(chain):
                 uids = self.seq_manager.block_uids[span.start : span.end]
+                push_to = None
+                if use_push and i + 1 < len(chain):
+                    next_addr = self.seq_manager.addr_of(chain[i + 1].peer_id)
+                    if next_addr is not None:
+                        push_to = {"addr": next_addr.to_string(), "session_id": session_ids[i + 1]}
                 session = await _ServerInferenceSession.create(
                     self.seq_manager,
                     span,
                     uids,
                     max_length=self.max_length,
                     batch_size=self.batch_size,
+                    session_id=session_ids[i],
+                    push_to=push_to,
                 )
                 sessions.append(session)
             return sessions
@@ -285,8 +317,26 @@ class InferenceSession:
         new_sessions = await self._enter_server_sessions(new_chain)
         self._sessions = keep + new_sessions
 
+        # the last surviving upstream server still pushes to a dead session;
+        # retarget it (or disable) on its next step
+        if keep:
+            new_target = None
+            if (
+                self.seq_manager.config.use_server_to_server
+                and new_sessions
+                and getattr(new_sessions[0], "session_id", None)
+            ):
+                addr = self.seq_manager.addr_of(new_sessions[0].span.peer_id)
+                if addr is not None:
+                    new_target = {
+                        "addr": addr.to_string(),
+                        "session_id": new_sessions[0].session_id,
+                    }
+            keep[-1].pending_push_to = new_target if new_target is not None else False
+
         if replay is not None and replay.shape[1] > 0:
-            # re-prefill the whole new suffix with everything sent before this step
+            # re-prefill the whole new suffix with everything sent before this
+            # step (step ids keep push/relay copies deduplicated downstream)
             chunk = replay
             for session in new_sessions:
                 span = session.span
@@ -295,7 +345,9 @@ class InferenceSession:
                     if self._last_prompts is not None
                     else None
                 )
-                chunk = await session.step(chunk, prompts=server_prompts)
+                chunk = await session.step(
+                    chunk, prompts=server_prompts, step_id=uuid.uuid4().hex
+                )
         return resume
 
     async def close(self) -> None:

@@ -1,0 +1,98 @@
+"""Native (C++) runtime components, bound via ctypes with Python fallbacks.
+
+The reference's native surface lives in its dependencies (Go p2pd daemon,
+C-backed serialization, CUDA kernels — SURVEY.md §2.3). Here the TPU compute
+kernels are Pallas (ops/), and the CPU-side hot paths ship as C++ compiled on
+first use with the host toolchain and cached next to the sources. Everything
+degrades gracefully to numpy if no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "_petals_tpu_native.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    src = os.path.join(_HERE, "qint8.cpp")
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _LIB_PATH
+    except Exception as e:
+        logger.info(f"Native codec build skipped ({type(e).__name__}); using numpy fallback")
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = _LIB_PATH if os.path.exists(_LIB_PATH) else _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            lib.qint8_quantize.argtypes = [
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int8), ctypes.POINTER(ctypes.c_float),
+            ]
+            lib.qint8_dequantize.argtypes = [
+                ctypes.POINTER(ctypes.c_int8), ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ]
+            _lib = lib
+            logger.debug("Native codec loaded")
+        except OSError as e:
+            logger.info(f"Native codec load failed ({e}); using numpy fallback")
+        return _lib
+
+
+def native_qint8_quantize(flat: np.ndarray, block: int):
+    """flat: contiguous f32[n] -> (q int8[n], scales f32[n_blocks]); None if no lib."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = flat.size
+    n_blocks = -(-n // block)
+    q = np.empty(n, np.int8)
+    scales = np.empty(n_blocks, np.float32)
+    lib.qint8_quantize(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n, block,
+        q.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        scales.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return q, scales
+
+
+def native_qint8_dequantize(q: np.ndarray, scales: np.ndarray, block: int):
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = q.size
+    out = np.empty(n, np.float32)
+    lib.qint8_dequantize(
+        q.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)), n, block,
+        scales.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return out

@@ -1,0 +1,53 @@
+// Native wire codec: blockwise-absmax int8 quantization of activation tensors
+// (the hot CPU path of RPC tensor compression — counterpart of the native
+// serialization/compression layer the reference gets from hivemind's C-backed
+// stack, SURVEY.md §2.3). Built as a plain shared library and bound via
+// ctypes; petals_tpu/rpc/serialization.py falls back to numpy when absent.
+//
+// Layout contract (must match the Python fallback):
+//   input  f32[n], processed in blocks of `block` elements (last may be short)
+//   scales f32[ceil(n/block)] = max(|x|) per block, clamped to >= 1e-8
+//   output i8[n] = clip(round(x / scale * 127), -127, 127)
+
+#include <cmath>
+#include <cstdint>
+#include <cstddef>
+
+extern "C" {
+
+void qint8_quantize(const float* input, std::int64_t n, std::int64_t block,
+                    std::int8_t* out, float* scales) {
+    const std::int64_t n_blocks = (n + block - 1) / block;
+    for (std::int64_t b = 0; b < n_blocks; ++b) {
+        const std::int64_t start = b * block;
+        const std::int64_t end = start + block < n ? start + block : n;
+        float absmax = 1e-8f;
+        for (std::int64_t i = start; i < end; ++i) {
+            const float a = std::fabs(input[i]);
+            if (a > absmax) absmax = a;
+        }
+        scales[b] = absmax;
+        const float inv = 127.0f / absmax;
+        for (std::int64_t i = start; i < end; ++i) {
+            float q = std::nearbyint(input[i] * inv);
+            if (q > 127.0f) q = 127.0f;
+            if (q < -127.0f) q = -127.0f;
+            out[i] = static_cast<std::int8_t>(q);
+        }
+    }
+}
+
+void qint8_dequantize(const std::int8_t* input, std::int64_t n, std::int64_t block,
+                      const float* scales, float* out) {
+    const std::int64_t n_blocks = (n + block - 1) / block;
+    for (std::int64_t b = 0; b < n_blocks; ++b) {
+        const std::int64_t start = b * block;
+        const std::int64_t end = start + block < n ? start + block : n;
+        const float scale = scales[b] / 127.0f;
+        for (std::int64_t i = start; i < end; ++i) {
+            out[i] = static_cast<float>(input[i]) * scale;
+        }
+    }
+}
+
+}  // extern "C"

@@ -78,13 +78,19 @@ def deserialize_array(obj: Dict[str, Any]) -> np.ndarray:
 
 def _serialize_qint8(arr: np.ndarray) -> Dict[str, Any]:
     flat = np.ascontiguousarray(arr, dtype=np.float32).reshape(-1)
-    pad = (-len(flat)) % _QBLOCK
-    if pad:
-        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
-    blocks = flat.reshape(-1, _QBLOCK)
-    scales = np.abs(blocks).max(axis=1, keepdims=True)
-    scales = np.maximum(scales, 1e-8).astype(np.float32)
-    q = np.clip(np.round(blocks / scales * 127.0), -127, 127).astype(np.int8)
+
+    from petals_tpu.native import native_qint8_quantize
+
+    native = native_qint8_quantize(flat, _QBLOCK)  # C++ fast path (1 pass, no temps)
+    if native is not None:
+        q, scales = native
+    else:
+        pad = (-len(flat)) % _QBLOCK
+        padded = np.concatenate([flat, np.zeros(pad, np.float32)]) if pad else flat
+        blocks = padded.reshape(-1, _QBLOCK)
+        scales = np.maximum(np.abs(blocks).max(axis=1), 1e-8).astype(np.float32)
+        q = np.clip(np.round(blocks / scales[:, None] * 127.0), -127, 127).astype(np.int8)
+        q = q.reshape(-1)[: len(flat)]
     return {
         "shape": list(arr.shape),
         "dtype": _dtype_name(arr.dtype),
@@ -99,10 +105,16 @@ def _deserialize_qint8(obj: Dict[str, Any]) -> np.ndarray:
     shape = tuple(obj["shape"])
     target_dtype = _dtype_from_name(obj["dtype"])
     n = int(np.prod(shape)) if shape else 1
-    q = np.frombuffer(bytearray(obj["data"]), dtype=np.int8).reshape(-1, _QBLOCK)
-    scales = np.frombuffer(bytearray(obj["scales"]), dtype=np.float32).reshape(-1, 1)
-    flat = (q.astype(np.float32) / 127.0) * scales
-    return flat.reshape(-1)[:n].reshape(shape).astype(target_dtype)
+    q = np.frombuffer(bytearray(obj["data"]), dtype=np.int8)[:n]
+    scales = np.frombuffer(bytearray(obj["scales"]), dtype=np.float32)
+
+    from petals_tpu.native import native_qint8_dequantize
+
+    flat = native_qint8_dequantize(q, scales, _QBLOCK)
+    if flat is None:
+        expand = np.repeat(scales, _QBLOCK)[:n]
+        flat = (q.astype(np.float32) / 127.0) * expand
+    return flat.reshape(shape).astype(target_dtype)
 
 
 def _dtype_name(dtype) -> str:

@@ -20,6 +20,7 @@ Wire payloads (msgpack):
 from __future__ import annotations
 
 import asyncio
+import contextlib
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -64,15 +65,37 @@ class TransformerHandler:
         self.queue = PriorityTaskQueue()
         self.queue.start()
         self._sub_backends: Dict[Tuple[int, int], TransformerBackend] = {}
+        # server-to-server activation push (reference handler.py:310-350):
+        # session_id -> queue of pushed step payloads
+        self._push_queues: Dict[str, asyncio.Queue] = {}
+        from petals_tpu.rpc.pool import ConnectionPool
+
+        self._push_pool = ConnectionPool()
+        self._push_tasks: set = set()
 
     def register(self, server: RpcServer) -> None:
         server.add_unary_handler("ptu.forward", self.rpc_forward)
         server.add_unary_handler("ptu.backward", self.rpc_backward)
         server.add_unary_handler("ptu.info", self.rpc_info)
+        server.add_unary_handler("ptu.push", self.rpc_push)
         server.add_stream_handler("ptu.inference", self.rpc_inference)
+
+    async def rpc_push(self, payload, ctx: RpcContext):
+        """Accept hidden states pushed by the previous server in a chain
+        (reference handler.py:310-318)."""
+        session_id = payload.get("session_id")
+        queue = self._push_queues.get(session_id)
+        if queue is None:
+            raise KeyError(f"No active inference session {session_id!r} on this server")
+        queue.put_nowait(payload)
+        return {"ok": True}
 
     def shutdown(self) -> None:
         self.queue.shutdown()
+        with contextlib.suppress(Exception):
+            loop = asyncio.get_event_loop()
+            if loop.is_running():
+                loop.create_task(self._push_pool.close())
 
     # ------------------------------------------------------------------ helpers
 
@@ -174,9 +197,13 @@ class TransformerHandler:
         max_length = int(open_msg["max_length"])
         batch_size = int(open_msg.get("batch_size", 1))
         active_adapter = open_msg.get("active_adapter")
+        session_id = open_msg.get("session_id")
+        # where to push our outputs: {"addr": "host:port/peer", "session_id": ...}
+        push_to = open_msg.get("push_to")
         backend = self._sub_backend(start, end)
         backend.params_for(active_adapter)  # validate the adapter exists up front
 
+        push_queue: Optional[asyncio.Queue] = None
         descriptors = backend.cache_descriptors(batch_size, max_length, 0, end - start)
         async with self.memory_cache.allocate_cache(
             *descriptors, timeout=open_msg.get("alloc_timeout")
@@ -184,15 +211,40 @@ class TransformerHandler:
             with self.memory_cache.use_cache(*handles) as (k_buf, v_buf):
                 kv = (k_buf, v_buf)
             position = 0
+            if session_id:
+                # registered only once allocation succeeded (no leak on failure)
+                push_queue = asyncio.Queue()
+                self._push_queues[session_id] = push_queue
             yield {"session_open": True, "position": 0, "max_length": max_length}
 
-            while True:
+            client_steps: asyncio.Queue = asyncio.Queue()
+
+            async def pump_client():
                 try:
-                    step = await asyncio.wait_for(anext(requests), self.session_timeout)
-                except StopAsyncIteration:
-                    break
+                    async for item in requests:
+                        client_steps.put_nowait(item)
+                except Exception:
+                    pass
+                finally:
+                    client_steps.put_nowait(None)  # client half-closed
+
+            pump_task = asyncio.create_task(pump_client())
+            next_step, cleanup_steps = self._step_source(
+                client_steps, push_queue, self.session_timeout
+            )
+            seen_steps = set()  # dedup: the same step may arrive via client AND push
+            try:
+              while True:
+                step = await next_step()
                 if step is None:
                     break
+                if "push_to" in step:  # chain repair moved our downstream peer
+                    push_to = step["push_to"] or None
+                step_id = step.get("step_id")
+                if step_id is not None:
+                    if step_id in seen_steps:
+                        continue
+                    seen_steps.add(step_id)
 
                 start_from = step.get("start_from_position")
                 if start_from is not None:
@@ -235,10 +287,80 @@ class TransformerHandler:
                 self.memory_cache.update_cache(handles[0], kv[0])
                 self.memory_cache.update_cache(handles[1], kv[1])
                 position += seq
-                yield {
-                    "tensors": {"hidden": serialize_array(out, self.compression)},
-                    "position": position,
-                }
+                wire_out = serialize_array(out, self.compression)
+                if push_to is not None and prompts is None:
+                    # can_push = no deep prompts (reference block_functions.py:233).
+                    # Fire-and-forget: the client's relay of this output remains
+                    # authoritative (dedup by step_id), so a slow/dead next peer
+                    # must never delay our own reply.
+                    wire_hypo = (step.get("tensors") or {}).get("hypo_ids")
+                    task = asyncio.create_task(
+                        self._push_outputs(push_to, wire_out, step_id, start_from, wire_hypo)
+                    )
+                    self._push_tasks.add(task)
+                    task.add_done_callback(self._push_tasks.discard)
+                yield {"tensors": {"hidden": wire_out}, "position": position}
+            finally:
+                await cleanup_steps()
+                pump_task.cancel()
+                if session_id:
+                    self._push_queues.pop(session_id, None)
+
+    @staticmethod
+    def _step_source(client_steps: asyncio.Queue, push_queue, timeout):
+        """Callable yielding the next step from either the client stream or the
+        push queue. Pending getters persist across calls (no per-step task
+        churn, no cancelled-task noise at teardown)."""
+        pending: Dict[str, asyncio.Task] = {}
+
+        async def next_step():
+            if "client" not in pending:
+                pending["client"] = asyncio.create_task(client_steps.get())
+            if push_queue is not None and "push" not in pending:
+                pending["push"] = asyncio.create_task(push_queue.get())
+            done, _ = await asyncio.wait(
+                set(pending.values()), timeout=timeout, return_when=asyncio.FIRST_COMPLETED
+            )
+            if not done:
+                await cleanup()
+                raise asyncio.TimeoutError("No inference step within session_timeout")
+            task = done.pop()
+            for name, t in list(pending.items()):
+                if t is task:
+                    del pending[name]
+            return task.result()
+
+        async def cleanup():
+            for task in pending.values():
+                task.cancel()
+                with contextlib.suppress(asyncio.CancelledError, Exception):
+                    await task
+            pending.clear()
+
+        return next_step, cleanup
+
+    async def _push_outputs(self, push_to: dict, wire_out, step_id, start_from, wire_hypo=None) -> None:
+        """Forward our outputs straight to the next server in the chain
+        (reference handler.py:320-350); push failures are non-fatal — the
+        client's copy is authoritative. A rollback marker on the original step
+        propagates so speculative rewinds stay coherent whichever copy wins."""
+        try:
+            from petals_tpu.dht.routing import PeerAddr
+
+            payload = {
+                "session_id": push_to["session_id"],
+                "step_id": step_id,
+                "tensors": {"hidden": wire_out},
+            }
+            if wire_hypo is not None:  # beam reorder must survive the push path
+                payload["tensors"]["hypo_ids"] = wire_hypo
+            if start_from is not None:
+                payload["start_from_position"] = int(start_from)
+            addr = PeerAddr.from_string(push_to["addr"])
+            client = await self._push_pool.get(addr.host, addr.port)
+            await asyncio.wait_for(client.call("ptu.push", payload), 10.0)
+        except Exception as e:
+            logger.debug(f"Push to next server failed (client copy still flows): {e}")
 
     def _sub_backend(self, start: int, end: int) -> TransformerBackend:
         if start == 0 and end == self.backend.n_blocks:

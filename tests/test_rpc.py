@@ -226,3 +226,37 @@ def test_server_disconnect_fails_pending_calls():
         await client.close()
 
     run(main())
+
+
+def test_native_codec_matches_numpy():
+    """The C++ qint8 codec must be bit-identical-ish to the numpy fallback and
+    actually load on this host (native runtime component, SURVEY.md §2.3)."""
+    import petals_tpu.native as native
+
+    lib = native.get_lib()
+    assert lib is not None, "native codec should build with the host toolchain"
+
+    rng = np.random.RandomState(0)
+    for n in (5, 1024, 5000):
+        flat = rng.randn(n).astype(np.float32)
+        q_c, scales_c = native.native_qint8_quantize(flat, 1024)
+        # numpy reference (same layout contract)
+        pad = (-n) % 1024
+        padded = np.concatenate([flat, np.zeros(pad, np.float32)]) if pad else flat
+        blocks = padded.reshape(-1, 1024)
+        scales_np = np.maximum(np.abs(blocks).max(axis=1), 1e-8).astype(np.float32)
+        q_np = np.clip(np.round(blocks / scales_np[:, None] * 127.0), -127, 127).astype(np.int8)
+        q_np = q_np.reshape(-1)[:n]
+        np.testing.assert_allclose(scales_c, scales_np, rtol=1e-6)
+        assert (np.abs(q_c.astype(np.int16) - q_np.astype(np.int16)) <= 1).all()  # rounding ties
+
+        out = native.native_qint8_dequantize(q_c, scales_c, 1024)
+        np.testing.assert_allclose(out, flat, atol=np.abs(flat).max() / 60)
+
+
+def test_qint8_wire_roundtrip_shapes():
+    """Ragged (non-multiple-of-block) tensors survive the wire."""
+    arr = np.random.randn(3, 7, 11).astype(np.float32)  # 231 elements
+    out = deserialize_array(serialize_array(arr, CompressionType.QINT8))
+    assert out.shape == arr.shape
+    np.testing.assert_allclose(out, arr, atol=np.abs(arr).max() / 60)

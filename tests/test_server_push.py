@@ -1,0 +1,66 @@
+"""Server-to-server activation push (reference handler.py:310-350 +
+use_server_to_server): downstream servers receive pushed steps directly; the
+client's relayed copy deduplicates; output stays token-identical."""
+
+import numpy as np
+import pytest
+
+from petals_tpu.client.model import AutoDistributedModelForCausalLM
+from tests.test_full_model import SwarmHarness, _hf_greedy
+from tests.utils import make_tiny_llama
+
+
+@pytest.fixture(scope="module")
+def chain_swarm(tmp_path_factory):
+    path = make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+    harness = SwarmHarness(
+        path, [dict(first_block=0, num_blocks=2), dict(first_block=2, num_blocks=2)]
+    ).start()
+    yield path, harness
+    harness.stop()
+
+
+def _count_pushes(harness):
+    total = 0
+    for server in harness.servers:
+        handler = server.handler
+        total += getattr(handler, "_pushes_received", 0)
+    return total
+
+
+def test_push_fires_and_output_identical(chain_swarm):
+    path, harness = chain_swarm
+    # instrument the push handler to count deliveries
+    for server in harness.servers:
+        handler = server.handler
+        handler._pushes_received = 0
+        original = handler.rpc_push
+
+        async def counted(payload, ctx, _h=handler, _orig=original):
+            _h._pushes_received += 1
+            return await _orig(payload, ctx)
+
+        handler.rpc_push = counted
+        server.rpc_server.add_unary_handler("ptu.push", counted)
+
+    model = AutoDistributedModelForCausalLM.from_pretrained(path, initial_peers=harness.initial_peers)
+    try:
+        ids = np.random.RandomState(0).randint(0, 100, (1, 5)).astype(np.int64)
+        out = model.generate(ids, max_new_tokens=5)
+        np.testing.assert_array_equal(out, _hf_greedy(path, ids, 5))
+        assert _count_pushes(harness) >= 5, "server-to-server pushes should have fired"
+    finally:
+        model.close()
+
+
+def test_push_disabled_still_works(chain_swarm):
+    path, harness = chain_swarm
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers, use_server_to_server=False
+    )
+    try:
+        ids = np.random.RandomState(1).randint(0, 100, (1, 4)).astype(np.int64)
+        out = model.generate(ids, max_new_tokens=4)
+        np.testing.assert_array_equal(out, _hf_greedy(path, ids, 4))
+    finally:
+        model.close()
