@@ -45,7 +45,9 @@ class _ServerInferenceSession:
         self.max_length = max_length
         self.step_timeout = step_timeout
         self.position = 0
-        self.history: List[np.ndarray] = []  # inputs sent so far (for failover replay)
+        # inputs sent so far, as (hidden, hypo_ids) steps — replay must repeat
+        # beam-lane reorders exactly (failover during beam search)
+        self.history: List[tuple] = []
         self.closed = False
         self.session_id: Optional[str] = None
         # set after chain repair: dict = retarget pushes, False = disable them
@@ -111,24 +113,23 @@ class _ServerInferenceSession:
         reply = await self.stream.recv(timeout=self.step_timeout)
         out = deserialize_array(reply["tensors"]["hidden"])
         self.position = reply["position"]
-        self.history.append(np.asarray(hidden))
+        self.history.append((np.asarray(hidden), None if hypo_ids is None else np.asarray(hypo_ids)))
         return out
 
     def _rollback_history(self, new_position: int) -> None:
         self.position = new_position
         kept, total = [], 0
-        for h in self.history:
+        for h, hypo in self.history:
             if total >= new_position:
                 break
             take = min(h.shape[1], new_position - total)
-            kept.append(h[:, :take] if take < h.shape[1] else h)
+            kept.append((h[:, :take] if take < h.shape[1] else h, hypo))
             total += take
         self.history = kept
 
-    def full_history(self) -> Optional[np.ndarray]:
-        if not self.history:
-            return None
-        return np.concatenate(self.history, axis=1)
+    def history_steps(self) -> List[tuple]:
+        """The (hidden, hypo_ids) steps fed so far, for failover replay."""
+        return list(self.history)
 
     async def close(self) -> None:
         if not self.closed:
@@ -293,7 +294,7 @@ class InferenceSession:
         # resume point: start of the span that covered failed_block (its inputs
         # are recorded in that session's history)
         resume = 0
-        replay: Optional[np.ndarray] = None
+        replay_steps: Optional[List[tuple]] = None
         keep: List[_ServerInferenceSession] = []
         drop: List[_ServerInferenceSession] = []
         for session in self._sessions:
@@ -303,8 +304,8 @@ class InferenceSession:
             if session.span.end <= resume and not session.closed:
                 keep.append(session)
             else:
-                if session.span.start == resume and replay is None:
-                    replay = session.full_history()
+                if session.span.start == resume and replay_steps is None:
+                    replay_steps = session.history_steps()
                 drop.append(session)
         for session in drop:
             await session.close()
@@ -334,20 +335,23 @@ class InferenceSession:
                     }
             keep[-1].pending_push_to = new_target if new_target is not None else False
 
-        if replay is not None and replay.shape[1] > 0:
-            # re-prefill the whole new suffix with everything sent before this
-            # step (step ids keep push/relay copies deduplicated downstream)
-            chunk = replay
-            for session in new_sessions:
-                span = session.span
-                server_prompts = (
-                    self._last_prompts[span.start : span.end]
-                    if self._last_prompts is not None
-                    else None
-                )
-                chunk = await session.step(
-                    chunk, prompts=server_prompts, step_id=uuid.uuid4().hex
-                )
+        if replay_steps:
+            # re-prefill the whole new suffix, repeating each recorded step —
+            # including its beam-lane reorder (hypo_ids) — in original order
+            # (step ids keep push/relay copies deduplicated downstream)
+            for hidden_step, hypo_step in replay_steps:
+                chunk = hidden_step
+                step_id = uuid.uuid4().hex
+                for session in new_sessions:
+                    span = session.span
+                    server_prompts = (
+                        self._last_prompts[span.start : span.end]
+                        if self._last_prompts is not None
+                        else None
+                    )
+                    chunk = await session.step(
+                        chunk, prompts=server_prompts, hypo_ids=hypo_step, step_id=step_id
+                    )
         return resume
 
     async def close(self) -> None:

