@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Production wrapper: keep a server running across crashes
+# (counterpart of reference src/petals/cli/run_prod_server.sh:1-8).
+# Usage: ./run_prod_server.sh MODEL_PATH [run_server flags...]
+set -u
+
+while true; do
+  python -m petals_tpu.cli.run_server "$@"
+  code=$?
+  if [ $code -eq 0 ]; then
+    echo "Server exited cleanly; stopping the restart loop."
+    break
+  fi
+  echo "Server died with code $code; restarting in 5s..."
+  sleep 5
+done
