@@ -31,6 +31,7 @@ def block_apply(
     *,
     use_flash: bool = False,
     n_valid=None,  # dynamic count of real (non-padding) tokens in this chunk
+    ring_mesh=None,  # training path only: sequence-parallel ring attention over "sp"
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     batch, seq, _ = hidden_states.shape
     hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -56,9 +57,16 @@ def block_apply(
     k = apply_rotary(k, cos, sin)
 
     k_all, v_all, kv_length = update_kv_cache(kv, k, v, position, n_valid)
-    attn = attend(
-        q, k_all, v_all, q_offset=position, kv_length=kv_length, use_flash=use_flash
-    )
+    if ring_mesh is not None and kv is None:
+        # sequence-parallel training: activations stay sharded on the seq axis;
+        # K/V shards rotate over the "sp" ring (ops/ring_attention.py)
+        from petals_tpu.ops.ring_attention import ring_attention_sharded
+
+        attn = ring_attention_sharded(q, k_all, v_all, ring_mesh)
+    else:
+        attn = attend(
+            q, k_all, v_all, q_offset=position, kv_length=kv_length, use_flash=use_flash
+        )
     attn = mm(attn.reshape(batch, seq, hq * d), params["wo"])
     if cfg.attention_bias:
         attn = attn + params["bo"]
