@@ -89,6 +89,15 @@ def native_qint8_dequantize(q: np.ndarray, scales: np.ndarray, block: int):
     if lib is None:
         return None
     n = q.size
+    # The C++ kernel reads scales[b] for ceil(n/block) blocks; guard here so
+    # every caller is covered, not just the wire deserializer.
+    if scales.size < -(-n // block):
+        raise ValueError(
+            f"qint8 dequantize: {scales.size} scales for {n} elements "
+            f"(need {-(-n // block)})"
+        )
+    q = np.ascontiguousarray(q, np.int8)
+    scales = np.ascontiguousarray(scales, np.float32)
     out = np.empty(n, np.float32)
     lib.qint8_dequantize(
         q.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)), n, block,

@@ -105,8 +105,19 @@ def _deserialize_qint8(obj: Dict[str, Any]) -> np.ndarray:
     shape = tuple(obj["shape"])
     target_dtype = _dtype_from_name(obj["dtype"])
     n = int(np.prod(shape)) if shape else 1
-    q = np.frombuffer(bytearray(obj["data"]), dtype=np.int8)[:n]
-    scales = np.frombuffer(bytearray(obj["scales"]), dtype=np.float32)
+    n_blocks = -(-n // _QBLOCK)
+    data, scales_bytes = obj["data"], obj["scales"]
+    # Wire data is untrusted: the native dequantizer reads scales[b] for every
+    # block, so a short buffer would be an out-of-bounds heap read in C++.
+    if len(data) < n:
+        raise ValueError(f"qint8 data too short: {len(data)} bytes for {n} elements")
+    if len(scales_bytes) != n_blocks * 4:
+        raise ValueError(
+            f"qint8 scales length {len(scales_bytes)} != {n_blocks * 4} "
+            f"(need {n_blocks} f32 scales for {n} elements)"
+        )
+    q = np.frombuffer(bytearray(data), dtype=np.int8)[:n]
+    scales = np.frombuffer(bytearray(scales_bytes), dtype=np.float32)
 
     from petals_tpu.native import native_qint8_dequantize
 

@@ -19,6 +19,12 @@ logger = get_logger(__name__)
 
 _END = object()
 
+# Per-call inbound buffer bound. A well-behaved streaming client (inference
+# session) keeps at most a couple of steps in flight; a peer stuffing frames
+# faster than the handler consumes would otherwise grow the queue — and server
+# memory — without limit (frames can be up to MAX_FRAME_BYTES each).
+MAX_INBOUND_QUEUE = 128
+
 
 class RpcError(Exception):
     """Error raised on the caller when the remote handler failed."""
@@ -105,19 +111,41 @@ class RpcServer:
                         self._run_unary(msg, ctx, writer, write_lock, call_tasks)
                     )
                 elif kind == "sopen":
-                    queue: asyncio.Queue = asyncio.Queue()
+                    queue: asyncio.Queue = asyncio.Queue(maxsize=MAX_INBOUND_QUEUE)
                     inbound_queues[msg["id"]] = queue
                     call_tasks[msg["id"]] = asyncio.create_task(
                         self._run_stream(msg, queue, ctx, writer, write_lock, call_tasks, inbound_queues)
                     )
-                elif kind == "sitem":
+                elif kind in ("sitem", "send"):
                     queue = inbound_queues.get(msg["id"])
                     if queue is not None:
-                        queue.put_nowait(msg.get("payload"))
-                elif kind == "send":
-                    queue = inbound_queues.get(msg["id"])
-                    if queue is not None:
-                        queue.put_nowait(_END)
+                        item = _END if kind == "send" else msg.get("payload")
+                        try:
+                            queue.put_nowait(item)
+                        except asyncio.QueueFull:
+                            # The handler is MAX_INBOUND_QUEUE frames behind this
+                            # peer: abusive or wedged either way. Kill the call
+                            # instead of buffering its frames unboundedly.
+                            logger.warning(
+                                f"Inbound queue overflow on call {msg['id']} from "
+                                f"{ctx.remote_addr}; cancelling the call"
+                            )
+                            stuck = call_tasks.get(msg["id"])
+                            if stuck is not None:
+                                stuck.cancel()
+                            inbound_queues.pop(msg["id"], None)
+                            # tell the peer: its pending recv should fail fast,
+                            # not hang until its own timeout
+                            await write_frame(
+                                writer,
+                                {
+                                    "t": "resp",
+                                    "id": msg["id"],
+                                    "ok": False,
+                                    "error": "RpcError: inbound queue overflow, call cancelled",
+                                },
+                                write_lock,
+                            )
                 elif kind == "cancel":
                     task_to_cancel = call_tasks.get(msg["id"])
                     if task_to_cancel is not None:

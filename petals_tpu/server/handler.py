@@ -87,7 +87,12 @@ class TransformerHandler:
         queue = self._push_queues.get(session_id)
         if queue is None:
             raise KeyError(f"No active inference session {session_id!r} on this server")
-        queue.put_nowait(payload)
+        try:
+            queue.put_nowait(payload)
+        except asyncio.QueueFull:
+            # Push is best-effort (the client relay is authoritative); refusing
+            # beats buffering an unbounded backlog from a runaway upstream peer.
+            raise RuntimeError(f"Push queue full for session {session_id!r}")
         return {"ok": True}
 
     def shutdown(self) -> None:
@@ -121,6 +126,33 @@ class TransformerHandler:
             )
         return lo - first, hi - first
 
+    def _validate_step_tensors(self, hidden, prompts, hypo_ids, batch_size: int, n_blocks: int) -> None:
+        """Reject malformed step tensors with a clean error instead of an opaque
+        XLA/scan failure — and keep clients from forcing fresh compilations with
+        novel batch sizes on the serving hot path."""
+        hsz = self.backend.cfg.hidden_size
+        if hidden is not None and (
+            hidden.ndim != 3 or hidden.shape[0] != batch_size or hidden.shape[2] != hsz
+        ):
+            raise ValueError(
+                f"step hidden must be [batch={batch_size}, seq, hidden={hsz}], "
+                f"got {tuple(hidden.shape)}"
+            )
+        if hypo_ids is not None and tuple(hypo_ids.shape) != (batch_size,):
+            raise ValueError(
+                f"hypo_ids must be [{batch_size}], got {tuple(hypo_ids.shape)}"
+            )
+        if prompts is not None and (
+            prompts.ndim != 4
+            or prompts.shape[0] != n_blocks
+            or prompts.shape[1] != batch_size
+            or prompts.shape[3] != hsz
+        ):
+            raise ValueError(
+                f"prompts must be [{n_blocks} blocks, batch={batch_size}, pre_seq, "
+                f"hidden={hsz}], got {tuple(prompts.shape)}"
+            )
+
     def _get_tensor(self, payload: dict, name: str) -> Optional[np.ndarray]:
         wire = (payload.get("tensors") or {}).get(name)
         if wire is None:
@@ -134,8 +166,11 @@ class TransformerHandler:
         start, end = self._parse_chain(payload["uids"])
         hidden = self._get_tensor(payload, "hidden")
         prompts = self._get_tensor(payload, "prompts")
-        if hidden is None or hidden.ndim != 3:
-            raise ValueError("rpc_forward expects a [batch, seq, hidden] tensor")
+        if hidden is None or hidden.ndim != 3 or hidden.shape[2] != self.backend.cfg.hidden_size:
+            raise ValueError(
+                f"rpc_forward expects a [batch, seq, hidden={self.backend.cfg.hidden_size}] "
+                f"tensor, got {None if hidden is None else tuple(hidden.shape)}"
+            )
         backend = self._sub_backend(start, end)
         adapter = payload.get("active_adapter")
         out = await asyncio.wait_for(
@@ -155,6 +190,15 @@ class TransformerHandler:
         prompts = self._get_tensor(payload, "prompts")
         if hidden is None or grad_out is None:
             raise ValueError("rpc_backward expects hidden and grad_out tensors")
+        if hidden.ndim != 3 or hidden.shape[2] != self.backend.cfg.hidden_size:
+            raise ValueError(
+                f"rpc_backward expects a [batch, seq, hidden={self.backend.cfg.hidden_size}] "
+                f"tensor, got {tuple(hidden.shape)}"
+            )
+        if grad_out.shape != hidden.shape:
+            raise ValueError(
+                f"grad_out shape {tuple(grad_out.shape)} != hidden shape {tuple(hidden.shape)}"
+            )
         backend = self._sub_backend(start, end)
         adapter = payload.get("active_adapter")
 
@@ -213,24 +257,12 @@ class TransformerHandler:
             position = 0
             if session_id:
                 # registered only once allocation succeeded (no leak on failure)
-                push_queue = asyncio.Queue()
+                push_queue = asyncio.Queue(maxsize=64)
                 self._push_queues[session_id] = push_queue
             yield {"session_open": True, "position": 0, "max_length": max_length}
 
-            client_steps: asyncio.Queue = asyncio.Queue()
-
-            async def pump_client():
-                try:
-                    async for item in requests:
-                        client_steps.put_nowait(item)
-                except Exception:
-                    pass
-                finally:
-                    client_steps.put_nowait(None)  # client half-closed
-
-            pump_task = asyncio.create_task(pump_client())
             next_step, cleanup_steps = self._step_source(
-                client_steps, push_queue, self.session_timeout
+                requests, push_queue, self.session_timeout
             )
             seen_steps = set()  # dedup: the same step may arrive via client AND push
             try:
@@ -257,6 +289,7 @@ class TransformerHandler:
                 hidden = self._get_tensor(step, "hidden")
                 prompts = self._get_tensor(step, "prompts")
                 hypo_ids = self._get_tensor(step, "hypo_ids")
+                self._validate_step_tensors(hidden, prompts, hypo_ids, batch_size, end - start)
                 seq = 0 if hidden is None else hidden.shape[1]
                 if hidden is not None and position + seq > max_length:
                     raise ValueError(
@@ -302,20 +335,30 @@ class TransformerHandler:
                 yield {"tensors": {"hidden": wire_out}, "position": position}
             finally:
                 await cleanup_steps()
-                pump_task.cancel()
                 if session_id:
                     self._push_queues.pop(session_id, None)
 
     @staticmethod
-    def _step_source(client_steps: asyncio.Queue, push_queue, timeout):
+    def _step_source(requests, push_queue, timeout):
         """Callable yielding the next step from either the client stream or the
         push queue. Pending getters persist across calls (no per-step task
-        churn, no cancelled-task noise at teardown)."""
+        churn, no cancelled-task noise at teardown). Pulls straight from the
+        request iterator — no intermediate buffer, so the transport's bounded
+        inbound queue is the *only* buffer and its backpressure actually
+        engages for flooding peers."""
         pending: Dict[str, asyncio.Task] = {}
+
+        async def _next_client():
+            try:
+                return await anext(requests)
+            except StopAsyncIteration:
+                return None  # client half-closed
+            except Exception:
+                return None  # transport error: treat as half-close
 
         async def next_step():
             if "client" not in pending:
-                pending["client"] = asyncio.create_task(client_steps.get())
+                pending["client"] = asyncio.create_task(_next_client())
             if push_queue is not None and "push" not in pending:
                 pending["push"] = asyncio.create_task(push_queue.get())
             done, _ = await asyncio.wait(

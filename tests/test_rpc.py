@@ -58,6 +58,46 @@ def test_serialize_qint8_compression():
     np.testing.assert_allclose(out, arr, atol=arr.max() / 60)
 
 
+def test_qint8_rejects_malformed_wire():
+    """Untrusted wire data with truncated scales/data must raise a clean
+    ValueError (the native dequantizer would otherwise read past the scales
+    buffer — an out-of-bounds heap read in C++)."""
+    import pytest
+
+    arr = np.random.randn(4, 1500).astype(np.float32)  # spans multiple 1024-blocks
+    wire = serialize_array(arr, CompressionType.QINT8)
+
+    short_scales = dict(wire, scales=wire["scales"][:4])
+    with pytest.raises(ValueError, match="scales"):
+        deserialize_array(short_scales)
+
+    empty_scales = dict(wire, scales=b"")
+    with pytest.raises(ValueError, match="scales"):
+        deserialize_array(empty_scales)
+
+    long_scales = dict(wire, scales=wire["scales"] + b"\x00" * 8)
+    with pytest.raises(ValueError, match="scales"):
+        deserialize_array(long_scales)
+
+    short_data = dict(wire, data=wire["data"][:10])
+    with pytest.raises(ValueError, match="data"):
+        deserialize_array(short_data)
+
+
+def test_native_qint8_dequantize_guards_scales():
+    from petals_tpu import native
+
+    if native.get_lib() is None:
+        import pytest
+
+        pytest.skip("native codec unavailable")
+    q = np.zeros(3000, np.int8)
+    import pytest
+
+    with pytest.raises(ValueError, match="scales"):
+        native.native_qint8_dequantize(q, np.ones(2, np.float32), 1024)
+
+
 def test_serialize_int_ignores_float_compression():
     arr = np.arange(10, dtype=np.int64)
     out = deserialize_array(serialize_array(arr, CompressionType.FLOAT16))

@@ -155,6 +155,57 @@ def test_inference_rejects_overflow_and_bad_chain(model_path):
     run(main())
 
 
+def test_inference_rejects_malformed_step_tensors(model_path):
+    """Wrong batch size / hidden dim / hypo_ids shape must fail with a clean
+    ValueError before reaching the jitted step (not an opaque XLA error)."""
+
+    async def main():
+        server, client = await _start_server(model_path)
+        try:
+            prefix = default_dht_prefix(model_path)
+            uids = make_uid(prefix, 0)
+            hsz = server.cfg.hidden_size
+
+            async def open_session():
+                stream = await client.open_stream("ptu.inference")
+                await stream.send({"uids": uids, "max_length": 8, "batch_size": 1})
+                await stream.recv(timeout=30)
+                return stream
+
+            stream = await open_session()
+            wrong_batch = np.zeros((2, 1, hsz), np.float32)
+            await stream.send({"tensors": {"hidden": serialize_array(wrong_batch)}})
+            with pytest.raises(RpcError, match="step hidden must be"):
+                await stream.recv(timeout=30)
+
+            stream = await open_session()
+            wrong_hidden = np.zeros((1, 1, hsz + 1), np.float32)
+            await stream.send({"tensors": {"hidden": serialize_array(wrong_hidden)}})
+            with pytest.raises(RpcError, match="step hidden must be"):
+                await stream.recv(timeout=30)
+
+            stream = await open_session()
+            ok = np.zeros((1, 1, hsz), np.float32)
+            bad_hypo = np.zeros((3,), np.int64)
+            await stream.send(
+                {"tensors": {"hidden": serialize_array(ok), "hypo_ids": serialize_array(bad_hypo)}}
+            )
+            with pytest.raises(RpcError, match="hypo_ids must be"):
+                await stream.recv(timeout=30)
+
+            with pytest.raises(RpcError, match="rpc_forward expects"):
+                await client.call(
+                    "ptu.forward",
+                    {"uids": uids, "tensors": {"hidden": serialize_array(wrong_hidden)}},
+                    timeout=30,
+                )
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(main())
+
+
 def test_server_announces_to_dht(model_path):
     async def main():
         from petals_tpu.dht import DHTNode
