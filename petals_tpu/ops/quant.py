@@ -22,6 +22,8 @@ exactly like dense ones.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 import functools
 from typing import Tuple
@@ -165,6 +167,21 @@ def quant_matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     return (x.astype(jnp.bfloat16) @ dequantize(w, jnp.bfloat16)).astype(x.dtype)
 
 
+# Trace-time switch: a Mosaic kernel has no GSPMD partitioning rule, so a
+# backend whose params carry tensor-parallel shardings traces the XLA
+# dequant-matmul path instead (XLA partitions it and inserts the psum).
+_FORCE_XLA_PATH = contextvars.ContextVar("ptu_quant_force_xla", default=False)
+
+
+@contextlib.contextmanager
+def force_xla_quant_matmul():
+    token = _FORCE_XLA_PATH.set(True)
+    try:
+        yield
+    finally:
+        _FORCE_XLA_PATH.reset(token)
+
+
 def _nf4_pallas_supported(x2d, data) -> bool:
     n_stored, n_out = data.shape[-2] * 2, data.shape[-1]
     return n_stored % _TK == 0 and n_out % _TN == 0 and data.ndim == 2
@@ -178,7 +195,11 @@ def _nf4_mm(x2d, data, scales):
 def _nf4_mm_fwd_impl(x2d, data, scales):
     # logical in_features comes from x; data rows may be padded to the k-tile
     w = QuantizedLinear("nf4", data, scales, x2d.shape[-1], data.shape[-1])
-    if jax.default_backend() == "tpu" and _nf4_pallas_supported(x2d, data):
+    if (
+        not _FORCE_XLA_PATH.get()
+        and jax.default_backend() == "tpu"
+        and _nf4_pallas_supported(x2d, data)
+    ):
         return nf4_matmul_pallas(x2d, w)
     return (x2d.astype(jnp.bfloat16) @ dequantize(w, jnp.bfloat16)).astype(x2d.dtype)
 

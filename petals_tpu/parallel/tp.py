@@ -100,9 +100,30 @@ def kv_cache_pspec() -> P:
     return P(None, None, None, COL, None)
 
 
+def quant_leaf_pspecs(q, spec: P):
+    """(data_spec, scales_spec) for a QuantizedLinear whose *dense* weight spec
+    is ``spec`` (leading stack/expert axes + trailing [in, out]).
+
+    The quantized layouts follow the dense axes directly (the reference
+    quantizes after its TP wrap, convert_block.py:25-73 — same composition,
+    expressed as shardings):
+    - int8: data int8 [..., in, out] shards like the dense weight; scales f32
+      [..., out] drop the input axis.
+    - nf4:  data uint8 [..., in/2, out] and scales bf16 [..., in/64, out] both
+      follow the dense spec — packed rows and absmax blocks track the input
+      axis, so an input-axis (row) split lands whole blocks per shard.
+    """
+    s = tuple(spec)
+    if q.kind == "int8":
+        return P(*s), P(*s[:-2], s[-1])
+    return P(*s), P(*s)
+
+
 def validate_tp_divisibility(params, mesh, specs, *, num_kv_heads: int = None) -> None:
     """Fail fast with a clear message instead of an opaque GSPMD error at
     session-open time."""
+    from petals_tpu.ops.quant import NF4_BLOCK, QuantizedLinear
+
     tp_size = mesh.shape.get(COL, 1)
     if tp_size == 1:
         return
@@ -113,24 +134,48 @@ def validate_tp_divisibility(params, mesh, specs, *, num_kv_heads: int = None) -
         )
     for name, leaf in params.items():
         spec = specs[name]
+        is_quant = isinstance(leaf, QuantizedLinear)
+        shape = leaf.shape  # QuantizedLinear.shape is the logical [..., in, out]
         for dim, axis in enumerate(tuple(spec)):
-            if axis == COL and leaf.shape[dim] % tp_size != 0:
+            if axis != COL:
+                continue
+            if shape[dim] % tp_size != 0:
                 raise ValueError(
-                    f"Parameter {name!r} dim {dim} (size {leaf.shape[dim]}) is not "
+                    f"Parameter {name!r} dim {dim} (size {shape[dim]}) is not "
                     f"divisible by the tensor-parallel axis size {tp_size}"
                 )
+            if is_quant and leaf.kind == "nf4" and dim == len(shape) - 2:
+                # input-axis split: every shard must hold whole absmax blocks
+                blocks = leaf.data.shape[-2] * 2 // NF4_BLOCK
+                if blocks % tp_size != 0:
+                    raise ValueError(
+                        f"NF4 parameter {name!r} has {blocks} absmax blocks, not "
+                        f"divisible by the tensor-parallel axis size {tp_size}"
+                    )
 
 
 def shard_span_params(params, mesh, family_name: str, cfg):
     """device_put the stacked params with TP shardings over ``mesh``."""
     import jax
 
+    from petals_tpu.ops.quant import QuantizedLinear
+
     specs = span_param_pspecs(family_name, cfg)
     validate_tp_divisibility(
         params, mesh, specs,
         num_kv_heads=getattr(cfg, "num_key_value_heads", cfg.num_attention_heads),
     )
-    return {
-        name: jax.device_put(leaf, NamedSharding(mesh, specs[name]))
-        for name, leaf in params.items()
-    }
+    out = {}
+    for name, leaf in params.items():
+        if isinstance(leaf, QuantizedLinear):
+            data_spec, scales_spec = quant_leaf_pspecs(leaf, specs[name])
+            out[name] = QuantizedLinear(
+                leaf.kind,
+                jax.device_put(leaf.data, NamedSharding(mesh, data_spec)),
+                jax.device_put(leaf.scales, NamedSharding(mesh, scales_spec)),
+                leaf.in_features,
+                leaf.out_features,
+            )
+        else:
+            out[name] = jax.device_put(leaf, NamedSharding(mesh, specs[name]))
+    return out

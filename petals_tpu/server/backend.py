@@ -23,6 +23,7 @@ TPU-first redesign:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 from typing import Dict, Optional, Sequence, Tuple
@@ -129,6 +130,15 @@ class TransformerBackend:
         )
 
     # ------------------------------------------------------------- jitted programs
+
+    def _quant_ctx(self):
+        """Under a TP mesh, trace quantized matmuls via the XLA dequant path
+        (Mosaic kernels cannot be GSPMD-partitioned). No-op otherwise."""
+        if self.mesh is not None:
+            from petals_tpu.ops.quant import force_xla_quant_matmul
+
+            return force_xla_quant_matmul()
+        return contextlib.nullcontext()
 
     def _slice_params(self, start: int, end: int):
         if start == 0 and end == self.n_blocks:
@@ -289,19 +299,20 @@ class TransformerBackend:
             jnp.asarray(hypo_ids, jnp.int32) if hypo_ids is not None else jnp.zeros((batch,), jnp.int32)
         )
 
-        out, k_stack, v_stack = self._inference_step_fn(
-            span_params,
-            k_stack,
-            v_stack,
-            padded,
-            jnp.asarray(position, jnp.int32),
-            jnp.asarray(n_valid, jnp.int32),
-            prompts_arr,
-            hypo_arr,
-            with_prompts=with_prompts,
-            with_hypo=with_hypo,
-            padded=is_padded,
-        )
+        with self._quant_ctx():
+            out, k_stack, v_stack = self._inference_step_fn(
+                span_params,
+                k_stack,
+                v_stack,
+                padded,
+                jnp.asarray(position, jnp.int32),
+                jnp.asarray(n_valid, jnp.int32),
+                prompts_arr,
+                hypo_arr,
+                with_prompts=with_prompts,
+                with_hypo=with_hypo,
+                padded=is_padded,
+            )
         if out.shape[1] != seq:
             out = out[:, :seq]
         return out, k_stack, v_stack
@@ -336,7 +347,8 @@ class TransformerBackend:
             if prompts is not None
             else jnp.zeros((self.n_blocks, hidden.shape[0], 0, self.hidden_size), self.compute_dtype)
         )
-        return self._forward_fn(span_params, hidden, prompts_arr, with_prompts=with_prompts)
+        with self._quant_ctx():
+            return self._forward_fn(span_params, hidden, prompts_arr, with_prompts=with_prompts)
 
     def backward(
         self, hidden: np.ndarray, grad_out: np.ndarray, prompts: Optional[np.ndarray] = None,
@@ -352,7 +364,9 @@ class TransformerBackend:
             if prompts is not None
             else jnp.zeros((self.n_blocks, hidden.shape[0], 0, self.hidden_size), self.compute_dtype)
         )
-        grad_hidden, grad_prompts = self._backward_fn(
-            self.params_for(active_adapter), hidden, prompts_arr, grad_out, with_prompts=with_prompts
-        )
+        with self._quant_ctx():
+            grad_hidden, grad_prompts = self._backward_fn(
+                self.params_for(active_adapter), hidden, prompts_arr, grad_out,
+                with_prompts=with_prompts,
+            )
         return grad_hidden, (grad_prompts if with_prompts else None)

@@ -111,12 +111,6 @@ class Server:
         self.num_tp_devices = num_tp_devices
         self.quant_type = quant_type
         self.adapter_paths = list(adapters)
-        if QuantType(quant_type) != QuantType.NONE and (num_tp_devices or 1) > 1:
-            raise ValueError(
-                "quant_type and num_tp_devices>1 cannot be combined yet: "
-                "quantized leaves have no tensor-parallel PartitionSpecs"
-            )
-
         self.module_uids = [
             make_uid(self.dht_prefix, i)
             for i in range(self.first_block, self.first_block + self.num_blocks)
