@@ -1,18 +1,27 @@
-"""Benchmark: single-stream autoregressive decode through the FULL stack
-(client -> RPC -> handler -> priority queue -> stacked-span scan on TPU ->
-KV cache in HBM -> back), on one real chip.
+"""Benchmarks on one real TPU chip.
 
-Mirrors the reference harness (benchmarks/benchmark_inference.py:44-68 — tok/s,
-1 token per step, real session) on a Llama-2-7B-shaped span: as many 7B-shaped
-blocks as fit one v5e chip alongside the KV budget. The reference baseline is
-6 tok/s single-stream for Llama-2-70B over an Internet swarm of consumer GPUs
-(README.md:86); vs_baseline reports our measured tok/s against that number.
+Primary (the ONE stdout JSON line, comparable across rounds): single-stream
+autoregressive decode through the FULL stack (client -> RPC -> handler ->
+priority queue -> stacked-span scan on TPU -> KV cache in HBM -> back) on a
+Llama-2-7B-shaped span, mirroring the reference harness
+(benchmarks/benchmark_inference.py:44-68 — tok/s, 1 token per step, real
+session). The reference baseline is 6 tok/s single-stream for Llama-2-70B over
+an Internet swarm of consumer GPUs (README.md:86).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+North-star shape benchmarks (BENCH_DETAILS.json + stderr), on-device:
+- 70B-block-shaped (hidden 8192, GQA 64/8) bf16 span decode: tok/s, p50 step
+  latency, HBM bandwidth utilisation (decode is weight-bandwidth-bound).
+- NF4-quantized 70B-shaped span decode via the fused Pallas dequant-matmul.
+- Long-context (8k) prefill through the flash-attention kernel: tok/s + MFU.
+
+Device timings subtract the axon-tunnel sync cost (each device->host sync pays
+a WAN round trip a co-located server would not).
 """
 
 import asyncio
+import gc
 import json
+import statistics
 import sys
 import time
 
@@ -25,8 +34,12 @@ PREFILL_TOKENS = 128
 MAX_LENGTH = 256
 BASELINE_TOK_S = 6.0  # reference: Llama-2-70B, Internet swarm (README.md:86)
 
+# v5e single-chip peaks (public spec): 819 GB/s HBM, 197 bf16 TFLOP/s
+PEAK_HBM_GBS = 819.0
+PEAK_BF16_TFLOPS = 197.0
 
-def llama7b_cfg():
+
+def llama7b_cfg(n_blocks=N_BLOCKS):
     from petals_tpu.models.llama.config import LlamaBlockConfig
 
     return LlamaBlockConfig(
@@ -35,33 +48,237 @@ def llama7b_cfg():
         num_key_value_heads=32,
         head_dim=128,
         intermediate_size=11008,
-        num_hidden_layers=N_BLOCKS,
+        num_hidden_layers=n_blocks,
         rms_norm_eps=1e-5,
         vocab_size=32000,
     )
 
 
-def random_params(cfg, n_blocks, dtype):
+def llama70b_cfg(n_blocks):
+    from petals_tpu.models.llama.config import LlamaBlockConfig
+
+    return LlamaBlockConfig(
+        hidden_size=8192,
+        num_attention_heads=64,
+        num_key_value_heads=8,
+        head_dim=128,
+        intermediate_size=28672,
+        num_hidden_layers=n_blocks,
+        rms_norm_eps=1e-5,
+        vocab_size=128256,
+    )
+
+
+def random_params(cfg, n_blocks, dtype, quant=None):
     import jax
     import jax.numpy as jnp
 
     from petals_tpu.models.llama.block import block_param_shapes
+    from petals_tpu.utils.convert_block import convert_block_params
 
     shapes = block_param_shapes(cfg, dtype)
     key = jax.random.PRNGKey(0)
+
+    if not quant:
+        # stacked leaves in one jit: no transient per-block copies in HBM
+        @jax.jit
+        def init_stacked(key):
+            params = {}
+            for name, sds in sorted(shapes.items()):
+                key, sub = jax.random.split(key)
+                params[name] = jax.random.normal(sub, (n_blocks, *sds.shape), dtype) * 0.02
+            return params
+
+        stacked = init_stacked(key)
+        jax.block_until_ready(stacked)
+        return stacked
 
     @jax.jit
     def init(key):
         params = {}
         for name, sds in sorted(shapes.items()):
             key, sub = jax.random.split(key)
-            params[name] = jax.random.normal(sub, (n_blocks, *sds.shape), dtype) * 0.02
+            params[name] = jax.random.normal(sub, sds.shape, dtype) * 0.02
         return params
 
-    return init(key)
+    per_block = []
+    for b in range(n_blocks):
+        key, sub = jax.random.split(key)
+        block = convert_block_params(init(sub), "llama", quant)
+        jax.block_until_ready(block)  # bound the dense-block transient
+        per_block.append(block)
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
+    jax.block_until_ready(stacked)
+    return stacked
 
 
-async def run_bench():
+def params_bytes(params) -> int:
+    import jax
+
+    from petals_tpu.ops.quant import QuantizedLinear
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedLinear)
+    ):
+        if isinstance(leaf, QuantizedLinear):
+            total += leaf.nbytes
+        else:
+            total += leaf.size * leaf.dtype.itemsize
+    return total
+
+
+def measure_sync_overhead() -> float:
+    """Per-sync cost of a device->host round trip through the axon tunnel."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.zeros((), jnp.float32)
+    f = jax.jit(lambda v: v + 1)
+    jax.block_until_ready(f(x))
+    t0 = time.perf_counter()
+    n = 10
+    for _ in range(n):
+        jax.block_until_ready(f(x))
+    return (time.perf_counter() - t0) / n
+
+
+def bench_device_decode(cfg, *, quant=None, label="", batches=3, steps=25):
+    """On-device span decode: p50 step latency + weight-stream bandwidth."""
+    import jax
+    import jax.numpy as jnp
+
+    from petals_tpu.models.registry import get_family
+    from petals_tpu.server.backend import TransformerBackend
+    from petals_tpu.server.memory_cache import MemoryCache
+
+    n_blocks = cfg.num_hidden_layers
+    dtype = jnp.bfloat16
+    t0 = time.perf_counter()
+    params = random_params(cfg, n_blocks, dtype, quant=quant)
+    init_s = time.perf_counter() - t0
+    weight_bytes = params_bytes(params)
+
+    backend = TransformerBackend(
+        get_family("llama"), cfg, params,
+        first_block=0, n_blocks=n_blocks,
+        memory_cache=MemoryCache(None), compute_dtype=dtype,
+    )
+    kd, vd = backend.cache_descriptors(1, MAX_LENGTH, 0, n_blocks)
+    kv = (kd.make_zeros(), vd.make_zeros())
+
+    rng = np.random.RandomState(0)
+    prefill = rng.randn(1, PREFILL_TOKENS, cfg.hidden_size).astype(np.float32) * 0.02
+    step_h = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.02
+
+    _, kv = backend.inference_step(prefill, kv, 0)
+    pos = PREFILL_TOKENS
+    out = None
+    for _ in range(WARMUP_STEPS):
+        out, kv = backend.inference_step(step_h, kv, pos)
+        pos += 1
+    jax.block_until_ready(out)
+
+    sync = measure_sync_overhead()
+    per_step = []
+    for _ in range(batches):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out, kv = backend.inference_step(step_h, kv, pos)
+            pos += 1
+        jax.block_until_ready(out)
+        elapsed = time.perf_counter() - t0
+        per_step.append(max(elapsed - sync, 1e-9) / steps)
+
+    p50 = statistics.median(per_step)
+    gbs = weight_bytes / p50 / 1e9
+    result = {
+        "label": label,
+        "n_blocks": n_blocks,
+        "quant": quant or "bf16",
+        "weight_gb": round(weight_bytes / 2**30, 2),
+        "decode_tok_s": round(1.0 / p50, 2),
+        "p50_step_ms": round(p50 * 1e3, 3),
+        "weight_stream_gb_s": round(gbs, 1),
+        "hbm_bw_pct": round(100.0 * gbs / PEAK_HBM_GBS, 1),
+        "param_init_s": round(init_s, 1),
+        "tunnel_sync_ms": round(sync * 1e3, 1),
+    }
+    del params, backend, kv, out
+    gc.collect()
+    return result
+
+
+def bench_flash_prefill(cfg, seq, *, runs=3):
+    """Long-context prefill through the Pallas flash kernel: tok/s + MFU."""
+    import jax
+    import jax.numpy as jnp
+
+    from petals_tpu.models.registry import get_family
+    from petals_tpu.server.backend import TransformerBackend
+    from petals_tpu.server.memory_cache import MemoryCache
+
+    n_blocks = cfg.num_hidden_layers
+    dtype = jnp.bfloat16
+    params = random_params(cfg, n_blocks, dtype)
+    backend = TransformerBackend(
+        get_family("llama"), cfg, params,
+        first_block=0, n_blocks=n_blocks,
+        memory_cache=MemoryCache(None), compute_dtype=dtype,
+        use_flash=True, max_chunk_size_bytes=1 << 30,
+    )
+    kd, vd = backend.cache_descriptors(1, seq, 0, n_blocks)
+
+    rng = np.random.RandomState(0)
+    # resident on device, in compute dtype, BEFORE timing: the 256 MB f32
+    # host array would otherwise ride the WAN tunnel inside every timed run
+    hidden = jax.device_put(
+        jnp.asarray(rng.randn(1, seq, cfg.hidden_size).astype(np.float32) * 0.02, dtype)
+    )
+    jax.block_until_ready(hidden)
+
+    kv = (kd.make_zeros(), vd.make_zeros())
+    out, kv = backend.inference_step(hidden, kv, 0)  # compile
+    jax.block_until_ready(out)
+    del kv
+
+    sync = measure_sync_overhead()
+    times = []
+    for _ in range(runs):
+        kv = (kd.make_zeros(), vd.make_zeros())
+        jax.block_until_ready(kv)
+        t0 = time.perf_counter()
+        out, kv = backend.inference_step(hidden, kv, 0)
+        jax.block_until_ready(out)
+        times.append(max(time.perf_counter() - t0 - sync, 1e-9))
+        del kv
+    t = statistics.median(times)
+
+    # matmul flops/block: 2*seq*(qkvo + mlp) params; attention: qk + av, causal
+    h, m = cfg.hidden_size, cfg.intermediate_size
+    qkvo = h * (cfg.num_attention_heads * cfg.head_dim)
+    qkvo += 2 * h * (cfg.num_key_value_heads * cfg.head_dim)
+    qkvo += (cfg.num_attention_heads * cfg.head_dim) * h
+    mlp = 3 * h * m
+    matmul_flops = 2 * seq * (qkvo + mlp)
+    attn_flops = 2 * 2 * cfg.num_attention_heads * cfg.head_dim * seq * seq / 2
+    flops = n_blocks * (matmul_flops + attn_flops)
+    tflops = flops / t / 1e12
+    result = {
+        "label": f"prefill_{seq}_flash",
+        "n_blocks": n_blocks,
+        "seq": seq,
+        "prefill_s": round(t, 3),
+        "prefill_tok_s": round(seq / t, 0),
+        "tflops": round(tflops, 1),
+        "mfu_pct": round(100.0 * tflops / PEAK_BF16_TFLOPS, 1),
+    }
+    del params, backend, out
+    gc.collect()
+    return result
+
+
+async def run_e2e_bench():
     import jax
     import jax.numpy as jnp
 
@@ -80,7 +297,6 @@ async def run_bench():
 
     t0 = time.perf_counter()
     params = random_params(cfg, N_BLOCKS, dtype)
-    jax.block_until_ready(params)
     load_s = time.perf_counter() - t0
 
     memory_cache = MemoryCache(2 << 30)
@@ -118,17 +334,18 @@ async def run_bench():
     for _ in range(WARMUP_STEPS):
         await one_step()
 
-    t0 = time.perf_counter()
+    step_times = []
     for _ in range(MEASURE_STEPS):
+        t0 = time.perf_counter()
         await one_step()
-    elapsed = time.perf_counter() - t0
+        step_times.append(time.perf_counter() - t0)
     await stream.end()
     await client.close()
     await server.stop()
     handler.shutdown()
 
-    step_latency = elapsed / MEASURE_STEPS
-    tok_s_span = 1.0 / step_latency
+    p50 = statistics.median(step_times)
+    mean = sum(step_times) / len(step_times)
 
     # Server-side compute rate without the per-step device->host sync (the
     # environment tunnels to a remote TPU, so each sync costs a WAN round trip
@@ -136,7 +353,6 @@ async def run_bench():
     kd, vd = backend.cache_descriptors(1, MAX_LENGTH, 0, N_BLOCKS)
     kv = (kd.make_zeros(), vd.make_zeros())
     _, kv = backend.inference_step(hidden_prefill, kv, 0)
-    import jax
 
     out = None
     for i in range(3):
@@ -148,30 +364,53 @@ async def run_bench():
     jax.block_until_ready(out)
     device_step = (time.perf_counter() - t0) / MEASURE_STEPS
 
-    return {
-        "tok_s": tok_s_span,
-        "step_ms": step_latency * 1e3,
+    result = {
+        "tok_s": 1.0 / mean,
+        "step_ms": mean * 1e3,
+        "p50_step_ms": p50 * 1e3,
         "device_step_ms": device_step * 1e3,
         "prefill_s": prefill_s,
         "param_init_s": load_s,
+        "weight_gb": round(params_bytes(params) / 2**30, 2),
     }
+    del params, backend, kv, out, memory_cache
+    gc.collect()
+    return result
 
 
 def main():
-    result = asyncio.run(run_bench())
+    details = {}
+
+    e2e = asyncio.run(run_e2e_bench())
+    details["e2e_8xllama7b"] = {k: round(v, 3) for k, v in e2e.items()}
+    print(f"# e2e 7B-span: {json.dumps(details['e2e_8xllama7b'])}", file=sys.stderr)
+
+    # 70B-shaped bf16 span: 6 blocks = 10.3 GB of weights on the chip
+    d70 = bench_device_decode(llama70b_cfg(6), label="decode_70b_bf16")
+    details["decode_70b_bf16"] = d70
+    print(f"# 70B-shape bf16: {json.dumps(d70)}", file=sys.stderr)
+
+    # NF4 70B-shaped span: 10 blocks = 4.6 GB quantized (fused Pallas dequant);
+    # stack-time peak is ~2x quantized size + one dense block, inside 16 GB
+    dnf4 = bench_device_decode(llama70b_cfg(10), quant="nf4", label="decode_70b_nf4")
+    details["decode_70b_nf4"] = dnf4
+    print(f"# 70B-shape nf4: {json.dumps(dnf4)}", file=sys.stderr)
+
+    # 8k-context prefill through the flash kernel on 70B-shaped blocks
+    pf = bench_flash_prefill(llama70b_cfg(2), 8192)
+    details["prefill_8k_flash"] = pf
+    print(f"# 8k flash prefill: {json.dumps(pf)}", file=sys.stderr)
+
+    with open("BENCH_DETAILS.json", "w") as f:
+        json.dump(details, f, indent=2)
+
     out = {
         "metric": f"single_stream_decode_tok_s_{N_BLOCKS}xllama7b_blocks_e2e",
-        "value": round(result["tok_s"], 2),
+        "value": round(e2e["tok_s"], 2),
         "unit": "tok/s",
-        "vs_baseline": round(result["tok_s"] / BASELINE_TOK_S, 2),
+        "vs_baseline": round(e2e["tok_s"] / BASELINE_TOK_S, 2),
     }
     print(json.dumps(out))
-    print(
-        f"# e2e_step={result['step_ms']:.1f}ms device_step={result['device_step_ms']:.1f}ms "
-        f"(tunnel sync overhead = difference) prefill({PREFILL_TOKENS}tok)={result['prefill_s']:.2f}s "
-        f"param_init={result['param_init_s']:.1f}s",
-        file=sys.stderr,
-    )
 
 
 if __name__ == "__main__":

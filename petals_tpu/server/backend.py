@@ -264,7 +264,7 @@ class TransformerBackend:
         span_params = self.params_for(active_adapter)
         outputs = []
         offset = 0
-        for chunk_len in self._chunk_plan(batch, total_seq):
+        for chunk_len in self._chunk_plan(batch, total_seq, kv_buf_len=max_length):
             chunk = hidden[:, offset : offset + chunk_len]
             out, k_stack, v_stack = self._step_once(
                 span_params, chunk, k_stack, v_stack, position + offset, prompts,
@@ -317,15 +317,37 @@ class TransformerBackend:
             out = out[:, :seq]
         return out, k_stack, v_stack
 
-    def _chunk_plan(self, batch: int, total_seq: int) -> Sequence[int]:
-        """Split a long prefill so each chunk's attention-logit footprint stays
-        under max_chunk_size_bytes (reference backend.py:126-152 semantics)."""
+    def _chunk_plan(self, batch: int, total_seq: int, kv_buf_len: int = None) -> Sequence[int]:
+        """Split a long prefill so each chunk's attention footprint stays under
+        max_chunk_size_bytes (reference backend.py:126-152 semantics)."""
         if total_seq <= 1:
             return [total_seq]
-        # attention logits per chunk ≈ batch * heads * chunk * total_seq * 4 bytes
-        heads = self.cfg.num_attention_heads
-        denom = max(batch * heads * total_seq * 4, 1)
-        max_chunk = max(self.max_chunk_size_bytes // denom, 1)
+        # The linear sizing below is only sound when the flash kernel will
+        # actually run: attend() silently falls back to the logit-materializing
+        # XLA path when the kernel can't handle the shapes (cache length not a
+        # multiple of 128, sliding-window attention), and then chunks must be
+        # sized by the quadratic formula.
+        flash_will_run = (
+            self.use_flash
+            and getattr(self.cfg, "sliding_window", None) is None
+            and (kv_buf_len is None or kv_buf_len % 128 == 0)
+        )
+        if flash_will_run:
+            # flash never materializes the [chunk, total_seq] logits; the
+            # footprint is the chunk's activations (hidden + MLP intermediate +
+            # per-head rows), linear in chunk length
+            itemsize = jnp.dtype(self.compute_dtype).itemsize
+            per_token = batch * itemsize * (
+                2 * self.hidden_size
+                + getattr(self.cfg, "intermediate_size", 4 * self.hidden_size)
+                + self.cfg.num_attention_heads * self.head_dim
+            )
+            max_chunk = max(self.max_chunk_size_bytes // max(per_token, 1), 1)
+        else:
+            # attention logits per chunk ≈ batch * heads * chunk * total_seq * 4 bytes
+            heads = self.cfg.num_attention_heads
+            denom = max(batch * heads * total_seq * 4, 1)
+            max_chunk = max(self.max_chunk_size_bytes // denom, 1)
         chunks = []
         remaining = total_seq
         while remaining > 0:
