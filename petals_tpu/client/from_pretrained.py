@@ -17,10 +17,12 @@ from petals_tpu.server.from_pretrained import (
 
 
 def load_client_params(model_name_or_path: str, *, dtype=jnp.float32, family=None, cfg=None) -> dict:
-    path = resolve_model_path(model_name_or_path)
     if family is None or cfg is None:
-        family, cfg = get_block_config(path)
+        family, cfg = get_block_config(model_name_or_path)
     assert family.hf_to_client_params is not None, f"{family.name} has no client mapping"
+    # repo ids stream in only the shards with client-held tensors (the
+    # reference skips `model.layers.*` downloads the same way)
+    path = resolve_model_path(model_name_or_path, prefixes=family.hf_client_prefixes)
     # single pass over the checkpoint; client mappings match absolute names
     tensors = _load_tensors_with_prefixes(path, family.hf_client_prefixes, keep_full_names=True)
     params = family.hf_to_client_params(tensors, cfg)

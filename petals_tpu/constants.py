@@ -24,3 +24,11 @@ DTYPE_MAP = {
 }
 
 DTYPE_NAMES = {v: k for k, v in DTYPE_MAP.items() if k != "auto"}
+
+# Checkpoint file names shared by the local loader (server/from_pretrained.py)
+# and the streaming Hub fetcher (utils/hub.py) — one definition so the
+# downloader's and the reader's notion of "a checkpoint" cannot diverge.
+SAFE_INDEX = "model.safetensors.index.json"
+SAFE_SINGLE = "model.safetensors"
+BIN_INDEX = "pytorch_model.bin.index.json"
+BIN_SINGLE = "pytorch_model.bin"

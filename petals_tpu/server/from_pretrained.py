@@ -1,12 +1,12 @@
 """Load exactly one transformer block's weights from an HF checkpoint
 (counterpart of reference src/petals/server/from_pretrained.py:35-224).
 
-The reference streams single-block shards from the HF Hub with retries and LRU
-disk eviction; this build reads local checkpoint directories (safetensors
-preferred, torch .bin fallback) and selects only the tensors belonging to the
-requested block — the same "load one block, not the model" capability. Hub
-download plumbing can be layered on via huggingface_hub when egress exists.
-"""
+Reads local checkpoint directories (safetensors preferred, torch .bin
+fallback) and selects only the tensors belonging to the requested block — the
+same "load one block, not the model" capability. Non-directory names resolve
+through the streaming Hub fetcher (utils/hub.py): config + shard index first,
+then ONLY the shards containing the requested prefixes, with retry + flock'd
+LRU disk cache (reference from_pretrained.py:81-128,162-213)."""
 
 from __future__ import annotations
 
@@ -23,19 +23,36 @@ from petals_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-SAFE_INDEX = "model.safetensors.index.json"
-SAFE_SINGLE = "model.safetensors"
-BIN_INDEX = "pytorch_model.bin.index.json"
-BIN_SINGLE = "pytorch_model.bin"
+from petals_tpu.constants import BIN_INDEX, BIN_SINGLE, SAFE_INDEX, SAFE_SINGLE  # noqa: F401 (re-exported)
 
 
-def resolve_model_path(model_name_or_path: str) -> str:
-    """Local directory only (zero-egress build); extend with hub download later."""
+def resolve_model_path(
+    model_name_or_path: str,
+    *,
+    prefixes: Optional[tuple] = None,
+    cache_dir=None,
+    max_disk_space: Optional[int] = None,
+) -> str:
+    """Local directory, or a repo id resolved through the streaming Hub cache.
+
+    With ``prefixes`` the weight shards containing those tensor prefixes are
+    fetched too; without it only config.json is ensured (enough for
+    AutoConfig / get_block_config)."""
     if os.path.isdir(model_name_or_path):
         return model_name_or_path
-    raise FileNotFoundError(
-        f"{model_name_or_path!r} is not a local directory; hub downloads are not "
-        f"enabled in this environment"
+    from petals_tpu.utils import hub
+
+    if prefixes is not None:
+        return str(
+            hub.ensure_weight_files(
+                model_name_or_path, prefixes,
+                cache_dir=cache_dir, max_disk_space=max_disk_space,
+            )
+        )
+    return str(
+        hub.ensure_config(
+            model_name_or_path, cache_dir=cache_dir, max_disk_space=max_disk_space
+        )
     )
 
 
@@ -134,11 +151,12 @@ def load_block_params(
     cfg=None,
 ) -> dict:
     """Load block ``block_index`` and return our parameter pytree on device."""
-    path = resolve_model_path(model_name_or_path)
     if family is None or cfg is None:
-        family, cfg = get_block_config(path)
+        family, cfg = get_block_config(model_name_or_path)
 
     prefixes = tuple(tpl.format(i=block_index) for tpl in family.hf_block_prefixes)
+    # for repo ids this streams in exactly the shards holding this block
+    path = resolve_model_path(model_name_or_path, prefixes=prefixes)
     tensors = _load_tensors_with_prefixes(path, prefixes)
     if not tensors:
         raise KeyError(

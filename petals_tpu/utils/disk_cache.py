@@ -54,13 +54,17 @@ def free_disk_space_for(
     *,
     cache_dir: Optional[Path] = None,
     max_disk_space: Optional[int] = None,
+    exclude: Optional[Path] = None,
 ) -> None:
     """Evict least-recently-used top-level cache entries until ``needed_bytes``
-    fits under ``max_disk_space`` (reference disk_cache.py:41-83)."""
+    fits under ``max_disk_space`` (reference disk_cache.py:41-83). ``exclude``
+    protects the entry currently being populated from evicting itself."""
     if max_disk_space is None:
         return
+    exclude = Path(exclude).resolve() if exclude is not None else None
     with lock_cache_dir(cache_dir) as cache_dir:
         entries = []
+        protected_bytes = 0
         for child in cache_dir.iterdir():
             if child.name == _LOCK_NAME:
                 continue
@@ -71,11 +75,14 @@ def free_disk_space_for(
                     if child.is_dir()
                     else stat.st_size
                 )
-                entries.append((stat.st_atime, size, child))
             except OSError:
                 continue
+            if exclude is not None and child.resolve() == exclude:
+                protected_bytes += size  # counts toward the budget, never evicted
+                continue
+            entries.append((stat.st_atime, size, child))
 
-        current = sum(size for _, size, _ in entries)
+        current = sum(size for _, size, _ in entries) + protected_bytes
         for atime, size, child in sorted(entries):
             if current + needed_bytes <= max_disk_space:
                 break
