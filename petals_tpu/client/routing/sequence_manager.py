@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import heapq
+import math
 import random
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -69,11 +70,12 @@ class RemoteSequenceManager:
         self.directory = ModuleDirectory(dht)
         self.state = RemoteSequenceInfo.make_empty(self.block_uids)
         self.pool = ConnectionPool(own_peer_id=dht.peer_id, connect_timeout=config.connect_timeout)
+        self._peer_infos: Dict[PeerID, object] = {}  # peer -> latest ServerInfo
         if rtt_fn is None:
             from petals_tpu.utils.ping import PingAggregator
 
             self.ping_aggregator = PingAggregator(self.pool)
-            rtt_fn = lambda src, dst: self.ping_aggregator.rtt(dst, DEFAULT_RTT)  # noqa: E731
+            rtt_fn = self._default_rtt
         else:
             self.ping_aggregator = None
         self.rtt_fn = rtt_fn
@@ -84,11 +86,29 @@ class RemoteSequenceManager:
 
     # ------------------------------------------------------------------ state upkeep
 
+    def _default_rtt(self, src: Optional[PeerID], dst: PeerID) -> float:
+        """Edge RTTs for min-latency routing (reference
+        sequence_manager.py:241-266): the client->first-server hop uses our own
+        ping measurements; server->server hops use the SOURCE server's
+        published ``next_pings`` — the client never sees those links itself."""
+        if src is None:
+            return self.ping_aggregator.rtt(dst, DEFAULT_RTT)
+        info = self._peer_infos.get(src)
+        next_pings = getattr(info, "next_pings", None)
+        if next_pings:
+            rtt = next_pings.get(dst.to_string())
+            if rtt is not None and math.isfinite(rtt):
+                return float(rtt)
+        return DEFAULT_RTT
+
     async def update(self) -> None:
         async with self._update_lock:
             infos = await self.directory.fetch(self.block_uids, active_adapter=self.config.active_adapter)
             infos = self._apply_allow_block_lists(infos)
             self.state.update_(infos)
+            self._peer_infos = {
+                span.peer_id: span.server_info for span in self.state.spans_by_priority
+            }
             await self._ping_candidates()
 
     async def _ping_candidates(self) -> None:

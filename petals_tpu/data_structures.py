@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import math
 import secrets
 from enum import IntEnum
 from typing import Any, Dict, Optional, Sequence, Tuple
@@ -152,6 +153,16 @@ class ServerInfo:
         known = {f.name for f in dataclasses.fields(cls)}
         extra_info = {k: v for k, v in extra_info.items() if k in known}
         extra_info["adapters"] = tuple(extra_info.get("adapters") or ())
+        # next_pings is remote-supplied: keep only {str: finite number} entries
+        # so one malformed announce can't crash every client's routing
+        raw_pings = extra_info.get("next_pings")
+        if raw_pings is not None:
+            cleaned = {}
+            if isinstance(raw_pings, dict):
+                for key, value in raw_pings.items():
+                    if isinstance(key, str) and isinstance(value, (int, float)) and math.isfinite(value):
+                        cleaned[key] = float(value)
+            extra_info["next_pings"] = cleaned or None
         return cls(state=ServerState(int(state)), throughput=float(throughput), **extra_info)
 
 

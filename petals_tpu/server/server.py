@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import math
 import re
 import time
 from typing import Optional, Sequence
@@ -124,6 +125,10 @@ class Server:
         self._balancer_task: Optional[asyncio.Task] = None
         self._state = ServerState.JOINING  # what the announce loop broadcasts
         self._ready = asyncio.Event()
+        # successor-server RTTs published with every announce so clients can
+        # cost server->server hops (reference server.py:717-751)
+        self._next_pings: dict = {}
+        self._ping_aggregator = None
 
     # ------------------------------------------------------------------ lifecycle
 
@@ -200,6 +205,14 @@ class Server:
         )
         self.handler.register(self.rpc_server)
 
+        from petals_tpu.utils.ping import PingAggregator
+
+        # ride the DHT node's existing connection pool (same peer identity);
+        # the first announce goes out WITHOUT next_pings — readiness must not
+        # block on pinging possibly-dead successors, the announce loop fills
+        # them in within one update_period
+        self._ping_aggregator = PingAggregator(self.dht.pool)
+
         self._state = ServerState.ONLINE
         await self._announce(ServerState.ONLINE)
         self._announcer_task = asyncio.create_task(self._announce_loop())
@@ -260,6 +273,7 @@ class Server:
                 sorted(self.backend.adapters) if self.backend is not None else ()
             ),
             cache_tokens_left=cache_tokens_left,
+            next_pings=dict(self._next_pings) or None,
         )
 
     async def _announce(self, state: ServerState, expiration: Optional[float] = None) -> None:
@@ -380,6 +394,9 @@ class Server:
         self._install_adapters(self.backend)
         self.handler.backend = self.backend
         self.handler._sub_backends = {}
+        # stale by construction: measured for the OLD span's successor block;
+        # the announce loop re-measures for the new span within one period
+        self._next_pings = {}
         self._state = ServerState.ONLINE
         await self._announce(ServerState.ONLINE)
 
@@ -387,6 +404,48 @@ class Server:
         while True:
             await asyncio.sleep(self.update_period)
             try:
+                await self._measure_next_pings()
                 await self._announce(self._state)
             except Exception as e:
                 logger.warning(f"Announce failed: {e}")
+
+    async def _measure_next_pings(self) -> None:
+        """Ping the servers that could follow us in an inference chain — those
+        serving our end block — and stage their RTTs for the next announce
+        (reference server.py:717-751: min-latency routing is half-blind to
+        multi-hop chains without these inter-server edges)."""
+        if self._ping_aggregator is None:
+            return
+        next_block = self.first_block + self.num_blocks
+        if next_block >= self.cfg.num_hidden_layers:
+            self._next_pings = {}
+            return
+        try:
+            from petals_tpu.utils.dht_utils import get_remote_module_infos
+            from petals_tpu.utils.random_utils import sample_up_to
+
+            infos, addr_book = await get_remote_module_infos(
+                self.dht, [make_uid(self.dht_prefix, next_block)]
+            )
+            if not infos or infos[0] is None:
+                self._next_pings = {}
+                return
+            own = self.dht.peer_id
+            candidates = [
+                addr_book[pid]
+                for pid, si in infos[0].servers.items()
+                # OFFLINE/JOINING announcements linger until expiry; pinging
+                # them would crowd live successors out of the sample
+                if pid != own and pid in addr_book and si.state == ServerState.ONLINE
+            ]
+            candidates = sample_up_to(candidates, 10)
+            if candidates:
+                await asyncio.wait_for(self._ping_aggregator.ping(candidates), 10.0)
+            candidate_ids = {addr.peer_id for addr in candidates}
+            self._next_pings = {
+                pid.to_string(): rtt
+                for pid, rtt in self._ping_aggregator.to_dict().items()
+                if pid in candidate_ids and math.isfinite(rtt)
+            }
+        except Exception as e:
+            logger.debug(f"next_pings round failed: {e}")

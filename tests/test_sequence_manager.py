@@ -110,6 +110,89 @@ def test_banned_server_is_routed_around_and_unbanned():
     run(main())
 
 
+def test_inter_server_rtt_changes_route():
+    """VERDICT done-criterion: with 3 servers, the min-latency chain flips when
+    an inter-server link is slow — rtt_fn's src argument must be honored."""
+
+    async def main():
+        boot, nodes, uids = await _swarm_with_servers(
+            4, [(0, 2, 10.0), (2, 4, 10.0), (2, 4, 10.0)]
+        )
+        a, b, c = (n.peer_id for n in nodes)
+        slow_link = {"pair": (a, b)}
+
+        def rtt_fn(src, dst):
+            if src is not None and (src, dst) == slow_link["pair"]:
+                return 0.5
+            return 0.001
+
+        manager = await RemoteSequenceManager.create(
+            ClientConfig(initial_peers=[boot.own_addr.to_string()], update_period=1000),
+            uids,
+            rtt_fn=rtt_fn,
+        )
+        try:
+            await manager.ensure_ready()
+            chain = await manager.make_sequence(mode="min_latency")
+            _chain_is_valid(chain, 0, 4)
+            assert chain[0].peer_id == a and chain[1].peer_id == c, (
+                "route must avoid the slow a->b link"
+            )
+            slow_link["pair"] = (a, c)  # now the a->c link is slow instead
+            chain = await manager.make_sequence(mode="min_latency")
+            assert chain[1].peer_id == b, "route must flip with the slow link"
+        finally:
+            await manager.shutdown()
+            for n in nodes + [boot]:
+                await n.shutdown()
+
+    run(main())
+
+
+def test_published_next_pings_drive_default_routing():
+    """Server->server edges come from the SOURCE server's announced next_pings
+    (reference sequence_manager.py:241-266) — no custom rtt_fn injected."""
+
+    async def main():
+        boot = await DHTNode.create(maintenance_period=1000)
+        uids = [make_uid("m", i) for i in range(4)]
+        nodes = []
+        for _ in range(3):
+            nodes.append(
+                await DHTNode.create(initial_peers=[boot.own_addr], maintenance_period=1000)
+            )
+        a, b, c = nodes
+        b_hex, c_hex = b.peer_id.to_string(), c.peer_id.to_string()
+        # a serves [0,2) and publishes: my link to b is slow, to c is fast
+        info_a = ServerInfo(
+            ServerState.ONLINE, 10.0, start_block=0, end_block=2,
+            inference_rps=10.0, next_pings={b_hex: 0.5, c_hex: 0.0001},
+        )
+        await declare_active_modules(a, uids[0:2], info_a, time.time() + 60)
+        for node in (b, c):
+            info = ServerInfo(
+                ServerState.ONLINE, 10.0, start_block=2, end_block=4, inference_rps=10.0
+            )
+            await declare_active_modules(node, uids[2:4], info, time.time() + 60)
+
+        manager = await RemoteSequenceManager.create(
+            ClientConfig(initial_peers=[boot.own_addr.to_string()], update_period=1000), uids
+        )
+        try:
+            await manager.ensure_ready()
+            chain = await manager.make_sequence(mode="min_latency")
+            _chain_is_valid(chain, 0, 4)
+            assert chain[1].peer_id == c.peer_id, (
+                "default routing must read the source server's next_pings"
+            )
+        finally:
+            await manager.shutdown()
+            for n in nodes + [boot]:
+                await n.shutdown()
+
+    run(main())
+
+
 def test_missing_blocks_raise():
     async def main():
         boot, nodes, uids = await _swarm_with_servers(4, [(0, 2, 1.0)])  # blocks 2,3 unserved

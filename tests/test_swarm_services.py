@@ -183,6 +183,33 @@ def test_cli_parsers():
     assert parse_block_range(args) == (None, None)
 
 
+def test_server_publishes_next_pings(tmp_path):
+    """A live server measures RTT to its successor-span servers and publishes
+    next_pings in its announce (reference server.py:717-751)."""
+    import math
+
+    from petals_tpu.data_structures import ServerState
+    from tests.test_full_model import SwarmHarness
+    from tests.utils import make_tiny_llama
+
+    path = make_tiny_llama(str(tmp_path))
+    harness = SwarmHarness(
+        path, [dict(first_block=0, num_blocks=2), dict(first_block=2, num_blocks=2)]
+    ).start()
+    try:
+        first, second = harness.servers
+        harness.run(first._measure_next_pings())
+        info = first._server_info(ServerState.ONLINE)
+        assert info.next_pings, "successor pings must be staged for announce"
+        rtt = info.next_pings.get(second.dht.peer_id.to_string())
+        assert rtt is not None and math.isfinite(rtt) and rtt >= 0
+        # the tail server has no successor: publishes nothing
+        harness.run(second._measure_next_pings())
+        assert second._server_info(ServerState.ONLINE).next_pings is None
+    finally:
+        harness.stop()
+
+
 def test_span_reload_moves_server(tmp_path):
     """_reload_span (the rebalance move) swaps the served blocks in place and
     the server keeps answering correctly for the new span."""
