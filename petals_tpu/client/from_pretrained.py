@@ -26,7 +26,30 @@ def load_client_params(model_name_or_path: str, *, dtype=jnp.float32, family=Non
     # single pass over the checkpoint; client mappings match absolute names
     tensors = _load_tensors_with_prefixes(path, family.hf_client_prefixes, keep_full_names=True)
     params = family.hf_to_client_params(tensors, cfg)
-    cast = lambda x: (
-        jnp.asarray(x, dtype) if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x)
+    return jax.tree_util.tree_map(_caster(dtype), params)
+
+
+def load_cls_client_params(
+    model_name_or_path: str, *, dtype=jnp.float32, family: ModelFamily = None, cfg=None
+) -> dict:
+    """Client params for sequence classification: embeddings + final norm +
+    the `score` head (reference models/llama/model.py:183), dispatched through
+    the family registry like every other checkpoint mapping."""
+    if family is None or cfg is None:
+        family, cfg = get_block_config(model_name_or_path)
+    if family.hf_to_cls_params is None:
+        raise NotImplementedError(
+            f"{family.name} has no sequence-classification client mapping"
+        )
+    path = resolve_model_path(model_name_or_path, prefixes=family.hf_cls_prefixes)
+    tensors = _load_tensors_with_prefixes(path, family.hf_cls_prefixes, keep_full_names=True)
+    params = family.hf_to_cls_params(tensors, cfg)
+    return jax.tree_util.tree_map(_caster(dtype), params)
+
+
+def _caster(dtype):
+    return lambda x: (
+        jnp.asarray(x, dtype)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+        else jnp.asarray(x)
     )
-    return jax.tree_util.tree_map(cast, params)

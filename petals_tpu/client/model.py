@@ -25,8 +25,9 @@ from petals_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
-class DistributedModelForCausalLM(RemoteGenerationMixin, PTuneMixin):
-    """Embeddings/norm/head local (JAX), blocks remote (the swarm)."""
+class _DistributedModelBase(PTuneMixin):
+    """Shared scaffolding for swarm-backed models: local embeddings, remote
+    blocks, one jitted head (subclass-chosen)."""
 
     def __init__(
         self,
@@ -34,6 +35,7 @@ class DistributedModelForCausalLM(RemoteGenerationMixin, PTuneMixin):
         cfg,
         client_params: dict,
         remote: RemoteSequential,
+        head_fn,
         *,
         ptune: Optional[PTuneConfig] = None,
     ):
@@ -42,10 +44,34 @@ class DistributedModelForCausalLM(RemoteGenerationMixin, PTuneMixin):
         self.client_params = client_params
         self.remote = remote
         self._embed_jit = jax.jit(lambda p, ids: family.client_embed(p, ids, cfg))
-        self._head_jit = jax.jit(lambda p, h: family.client_head(p, h, cfg))
+        self._head_jit = jax.jit(lambda p, h: head_fn(p, h, cfg))
         self.init_ptune(ptune)
 
-    # ------------------------------------------------------------------ construction
+    @classmethod
+    def _build_remote(
+        cls, model_name_or_path, initial_peers, config, dht_prefix, config_overrides, cfg
+    ):
+        if config is None:
+            config = ClientConfig(initial_peers=list(initial_peers), **config_overrides)
+        prefix = dht_prefix or config.dht_prefix or default_dht_prefix(model_name_or_path)
+        block_uids = [make_uid(prefix, i) for i in range(cfg.num_hidden_layers)]
+        return RemoteSequential(config, block_uids)
+
+    def embed(self, input_ids, *, with_prompts: bool = True) -> jnp.ndarray:
+        hidden = self._embed_jit(self.client_params, np.asarray(input_ids))
+        return self.apply_shallow_prompts(hidden) if with_prompts else hidden
+
+    def close(self) -> None:
+        self.remote.close()
+
+
+class DistributedModelForCausalLM(RemoteGenerationMixin, _DistributedModelBase):
+    """Embeddings/norm/head local (JAX), blocks remote (the swarm)."""
+
+    def __init__(self, family, cfg, client_params, remote, *, ptune=None):
+        super().__init__(
+            family, cfg, client_params, remote, family.client_head, ptune=ptune
+        )
 
     @classmethod
     def from_pretrained(
@@ -60,19 +86,13 @@ class DistributedModelForCausalLM(RemoteGenerationMixin, PTuneMixin):
         **config_overrides,
     ) -> "DistributedModelForCausalLM":
         family, cfg = get_block_config(model_name_or_path)
-        if config is None:
-            config = ClientConfig(initial_peers=list(initial_peers), **config_overrides)
-        prefix = dht_prefix or config.dht_prefix or default_dht_prefix(model_name_or_path)
-        block_uids = [make_uid(prefix, i) for i in range(cfg.num_hidden_layers)]
         client_params = load_client_params(model_name_or_path, dtype=dtype, family=family, cfg=cfg)
-        remote = RemoteSequential(config, block_uids)
+        remote = cls._build_remote(
+            model_name_or_path, initial_peers, config, dht_prefix, config_overrides, cfg
+        )
         return cls(family, cfg, client_params, remote, ptune=ptune)
 
     # ------------------------------------------------------------------ local compute
-
-    def embed(self, input_ids, *, with_prompts: bool = True) -> jnp.ndarray:
-        hidden = self._embed_jit(self.client_params, np.asarray(input_ids))
-        return self.apply_shallow_prompts(hidden) if with_prompts else hidden
 
     def lm_logits(self, hidden) -> jnp.ndarray:
         return self._head_jit(self.client_params, jnp.asarray(hidden))
@@ -88,8 +108,99 @@ class DistributedModelForCausalLM(RemoteGenerationMixin, PTuneMixin):
 
     __call__ = forward
 
-    def close(self) -> None:
-        self.remote.close()
+
+class DistributedModelForSequenceClassification(_DistributedModelBase):
+    """Sequence classification over the swarm (reference
+    models/llama/model.py:183 DistributedLlamaForSequenceClassification):
+    embeddings + final norm + `score` head local, blocks remote. Pools each
+    row's last non-pad token like HF's *ForSequenceClassification."""
+
+    def __init__(
+        self,
+        family,
+        cfg,
+        client_params: dict,
+        remote: RemoteSequential,
+        *,
+        num_labels: int,
+        pad_token_id: Optional[int] = None,
+        ptune: Optional[PTuneConfig] = None,
+    ):
+        if family.cls_head is None:
+            raise NotImplementedError(
+                f"{family.name} has no sequence-classification head"
+            )
+        super().__init__(
+            family, cfg, client_params, remote, family.cls_head, ptune=ptune
+        )
+        self.num_labels = num_labels
+        self.pad_token_id = pad_token_id
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        model_name_or_path: str,
+        *,
+        initial_peers: Sequence[str],
+        config: Optional[ClientConfig] = None,
+        dht_prefix: Optional[str] = None,
+        dtype=jnp.float32,
+        ptune: Optional[PTuneConfig] = None,
+        **config_overrides,
+    ) -> "DistributedModelForSequenceClassification":
+        from petals_tpu.client.from_pretrained import load_cls_client_params
+        from petals_tpu.server.from_pretrained import load_hf_config
+
+        family, cfg = get_block_config(model_name_or_path)
+        hf_config = load_hf_config(model_name_or_path)
+        client_params = load_cls_client_params(
+            model_name_or_path, dtype=dtype, family=family, cfg=cfg
+        )
+        remote = cls._build_remote(
+            model_name_or_path, initial_peers, config, dht_prefix, config_overrides, cfg
+        )
+        return cls(
+            family, cfg, client_params, remote,
+            num_labels=getattr(hf_config, "num_labels", 2),
+            pad_token_id=getattr(hf_config, "pad_token_id", None),
+            ptune=ptune,
+        )
+
+    # ------------------------------------------------------------------ compute
+
+    def cls_logits(self, hidden) -> jnp.ndarray:
+        """Per-position [batch, seq, num_labels] logits (norm + score)."""
+        return self._head_jit(self.client_params, jnp.asarray(hidden))
+
+    def pool_positions(self, input_ids: np.ndarray) -> np.ndarray:
+        """Index of each row's pooled token in the (possibly prompt-prefixed)
+        hidden sequence — HF semantics: the LAST non-pad token."""
+        input_ids = np.asarray(input_ids)
+        batch, seq = input_ids.shape
+        pre_seq = self.ptune.pre_seq_len if self.ptune.tuning_mode else 0
+        if self.pad_token_id is None:
+            if batch > 1:
+                raise ValueError(
+                    "Cannot handle batch sizes > 1 without a pad token "
+                    "(set pad_token_id, matching HF *ForSequenceClassification)"
+                )
+            return np.asarray([pre_seq + seq - 1])
+        non_pad = (input_ids != self.pad_token_id).astype(np.int64)
+        last = (np.arange(seq)[None, :] * non_pad).argmax(axis=-1)
+        return pre_seq + last
+
+    def forward(self, input_ids) -> jnp.ndarray:
+        """Pooled classification logits [batch, num_labels]."""
+        input_ids = np.asarray(input_ids)
+        hidden = self.embed(input_ids)
+        hidden = self.remote.forward(
+            np.asarray(hidden), prompts=self.deep_prompts_for_batch(hidden.shape[0])
+        )
+        logits = self.cls_logits(hidden)
+        pos = self.pool_positions(input_ids)
+        return logits[np.arange(input_ids.shape[0]), pos]
+
+    __call__ = forward
 
 
 class AutoDistributedModelForCausalLM:
@@ -98,3 +209,15 @@ class AutoDistributedModelForCausalLM:
     @classmethod
     def from_pretrained(cls, model_name_or_path: str, **kwargs) -> DistributedModelForCausalLM:
         return DistributedModelForCausalLM.from_pretrained(model_name_or_path, **kwargs)
+
+
+class AutoDistributedModelForSequenceClassification:
+    """Auto-class counterpart for classification checkpoints."""
+
+    @classmethod
+    def from_pretrained(
+        cls, model_name_or_path: str, **kwargs
+    ) -> DistributedModelForSequenceClassification:
+        return DistributedModelForSequenceClassification.from_pretrained(
+            model_name_or_path, **kwargs
+        )

@@ -59,6 +59,57 @@ def _softmax(x: np.ndarray) -> np.ndarray:
     return e / e.sum(axis=-1, keepdims=True)
 
 
+def apply_repetition_penalty(
+    scores: np.ndarray, generated: np.ndarray, penalty: float
+) -> np.ndarray:
+    """HF RepetitionPenaltyLogitsProcessor: for every token already in the
+    row's sequence, divide positive scores by ``penalty`` and multiply
+    negative ones (works identically on raw logits and on logprobs)."""
+    if penalty == 1.0:
+        return scores
+    scores = scores.copy()
+    for row in range(scores.shape[0]):
+        seen = np.unique(generated[row])
+        vals = scores[row, seen]
+        scores[row, seen] = np.where(vals > 0, vals / penalty, vals * penalty)
+    return scores
+
+
+def apply_no_repeat_ngram(
+    scores: np.ndarray, generated: np.ndarray, ngram_size: int
+) -> np.ndarray:
+    """HF NoRepeatNGramLogitsProcessor: ban every token that would complete an
+    n-gram already present in the row's sequence."""
+    if ngram_size <= 0:
+        return scores
+    scores = scores.copy()
+    cur_len = generated.shape[1]
+    if cur_len + 1 < ngram_size:
+        return scores
+    for row in range(scores.shape[0]):
+        seq = generated[row].tolist()
+        prefix = tuple(seq[cur_len - ngram_size + 1 :])
+        banned = [
+            seq[i + ngram_size - 1]
+            for i in range(cur_len - ngram_size + 1)
+            if tuple(seq[i : i + ngram_size - 1]) == prefix
+        ]
+        if banned:
+            scores[row, banned] = -np.inf
+    return scores
+
+
+def _process_scores(
+    scores: np.ndarray,
+    generated: np.ndarray,
+    *,
+    repetition_penalty: float = 1.0,
+    no_repeat_ngram_size: int = 0,
+) -> np.ndarray:
+    scores = apply_repetition_penalty(scores, generated, repetition_penalty)
+    return apply_no_repeat_ngram(scores, generated, no_repeat_ngram_size)
+
+
 class RemoteGenerationMixin:
     """Requires: self.embed(ids)->hidden, self.lm_logits(hidden)->logits,
     self.remote (RemoteSequential), self.active_session management."""
@@ -77,22 +128,39 @@ class RemoteGenerationMixin:
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
         eos_token_id: Optional[int] = None,
+        pad_token_id: Optional[int] = None,
+        length_penalty: float = 1.0,
+        early_stopping: bool = False,
+        repetition_penalty: float = 1.0,
+        no_repeat_ngram_size: int = 0,
         session=None,
         seed: Optional[int] = None,
         prompts: Optional[np.ndarray] = None,
     ) -> np.ndarray:
+        if max_length is not None:
+            # HF semantics: max_length caps the TOTAL sequence length
+            max_new_tokens = min(
+                max_new_tokens, max_length - np.asarray(input_ids).shape[1]
+            )
         if num_beams > 1:
             # explicit rejections beat silent divergence from HF semantics
             assert not do_sample, "beam search is deterministic (use num_beams=1 to sample)"
             if session is not None:
                 raise NotImplementedError("beam search opens its own session (session= unsupported)")
-            if eos_token_id is not None:
-                raise NotImplementedError("beam search does not finalize on EOS yet")
             ptune = getattr(self, "ptune", None)
             if ptune is not None and ptune.tuning_mode:
                 raise NotImplementedError("beam search with prompt tuning is not supported yet")
             return self._beam_search(
-                input_ids, max_new_tokens=max_new_tokens, num_beams=num_beams, prompts=prompts
+                input_ids,
+                max_new_tokens=max_new_tokens,
+                num_beams=num_beams,
+                prompts=prompts,
+                eos_token_id=eos_token_id,
+                pad_token_id=pad_token_id,
+                length_penalty=length_penalty,
+                early_stopping=early_stopping,
+                repetition_penalty=repetition_penalty,
+                no_repeat_ngram_size=no_repeat_ngram_size,
             )
         input_ids = np.asarray(input_ids)
         batch, prompt_len = input_ids.shape
@@ -132,8 +200,13 @@ class RemoteGenerationMixin:
 
             finished = np.zeros(batch, dtype=bool)
             for i in range(max_new_tokens):
+                scores = _process_scores(
+                    logits, generated,
+                    repetition_penalty=repetition_penalty,
+                    no_repeat_ngram_size=no_repeat_ngram_size,
+                )
                 next_token = sample_next_token(
-                    logits,
+                    scores,
                     do_sample=do_sample,
                     temperature=temperature,
                     top_k=top_k,
@@ -141,7 +214,9 @@ class RemoteGenerationMixin:
                     rng=rng,
                 )
                 if eos_token_id is not None:
-                    next_token = np.where(finished, eos_token_id, next_token)
+                    # HF: rows already finished emit pad (falling back to eos)
+                    fill = pad_token_id if pad_token_id is not None else eos_token_id
+                    next_token = np.where(finished, fill, next_token)
                     finished |= next_token == eos_token_id
                 generated = np.concatenate([generated, next_token[:, None]], axis=1)
                 if eos_token_id is not None and finished.all():
@@ -164,61 +239,166 @@ class RemoteGenerationMixin:
 
     def _beam_search(
         self,
-        input_ids: np.ndarray,  # [1, seq]
+        input_ids: np.ndarray,  # [batch, seq]
         *,
         max_new_tokens: int,
         num_beams: int,
         prompts: Optional[np.ndarray] = None,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: Optional[int] = None,
+        length_penalty: float = 1.0,
+        early_stopping: bool = False,
+        repetition_penalty: float = 1.0,
+        no_repeat_ngram_size: int = 0,
     ) -> np.ndarray:
-        """Beam search over the swarm: each step reorders every server's KV
-        cache lanes via hypo_ids (reference remote_generation.py beam hook +
-        backend.py:154-158)."""
+        """Beam search over the swarm with HF BeamSearchScorer semantics
+        (EOS finalization, length penalty, early stopping, batch > 1); each
+        step reorders every server's KV cache lanes via hypo_ids (reference
+        remote_generation.py beam hook + backend.py:154-158)."""
         input_ids = np.asarray(input_ids)
-        assert input_ids.shape[0] == 1, "beam search currently supports batch 1"
+        batch, prompt_len = input_ids.shape
         if max_new_tokens <= 0:
             return input_ids
-        prompt_len = input_ids.shape[1]
-        total = prompt_len + max_new_tokens
-        session = self.remote.inference_session(max_length=total, batch_size=num_beams)
+        if pad_token_id is None:
+            pad_token_id = eos_token_id
+        max_length = prompt_len + max_new_tokens
+        lanes = batch * num_beams
+
+        hyps = [
+            _BeamHypotheses(num_beams, length_penalty, early_stopping)
+            for _ in range(batch)
+        ]
+        done = [False] * batch
+        # HF trick: all but beam 0 start at -1e9 so the first expansion draws
+        # every candidate from beam 0 (identical prefixes otherwise)
+        beam_scores = np.zeros((batch, num_beams), np.float64)
+        beam_scores[:, 1:] = -1e9
+        sequences = np.repeat(input_ids, num_beams, axis=0)  # [lanes, seq]
+
+        session = self.remote.inference_session(max_length=max_length, batch_size=lanes)
         try:
-            # prefill: all beams start from the same prompt
-            tiled = np.repeat(input_ids, num_beams, axis=0)
-            hidden = np.asarray(self.embed(tiled, with_prompts=False))
+            hidden = np.asarray(self.embed(sequences, with_prompts=False))
             out = session.step(hidden, prompts=prompts)
-            logits = np.asarray(self.lm_logits(out[:, -1:]))[:, 0]  # [beams, vocab]
-            logprobs = _log_softmax(logits)
+            hypo_ids = None
+            for _step in range(max_new_tokens):
+                logits = np.asarray(self.lm_logits(out[:, -1:]))[:, 0]  # [lanes, vocab]
+                logprobs = _log_softmax(logits)
+                logprobs = _process_scores(
+                    logprobs, sequences,
+                    repetition_penalty=repetition_penalty,
+                    no_repeat_ngram_size=no_repeat_ngram_size,
+                )
+                vocab = logprobs.shape[-1]
+                totals = beam_scores.reshape(lanes, 1) + logprobs  # [lanes, vocab]
+                cur_len = sequences.shape[1]
 
-            # first expansion: only beam 0 counts (identical prefixes otherwise)
-            scores = logprobs[0]  # [vocab]
-            vocab = scores.shape[-1]
-            top = np.argsort(-scores)[:num_beams]
-            beam_scores = scores[top]
-            sequences = np.concatenate(
-                [np.repeat(input_ids, num_beams, axis=0), top[:, None]], axis=1
-            )
-            # all beams came from lane 0: reorder caches accordingly
-            hypo_ids = np.zeros(num_beams, np.int64)
+                # HF bookkeeping: cur_len counts the token being chosen now,
+                # and length penalties divide by GENERATED length only
+                generated_len = cur_len + 1 - prompt_len
+                next_beam_scores = np.zeros((batch, num_beams), np.float64)
+                next_beam_tokens = np.zeros((batch, num_beams), np.int64)
+                next_beam_idx = np.zeros((batch, num_beams), np.int64)  # lane index
+                for b in range(batch):
+                    if done[b]:
+                        next_beam_scores[b] = 0.0
+                        next_beam_tokens[b] = pad_token_id if pad_token_id is not None else 0
+                        next_beam_idx[b] = b * num_beams
+                        continue
+                    flat = totals[b * num_beams : (b + 1) * num_beams].reshape(-1)
+                    # 2*num_beams candidates guarantee num_beams non-EOS ones
+                    top = np.argsort(-flat, kind="stable")[: 2 * num_beams]
+                    beam_rank = 0
+                    for rank, flat_idx in enumerate(top):
+                        beam_of, token = int(flat_idx // vocab), int(flat_idx % vocab)
+                        lane = b * num_beams + beam_of
+                        if eos_token_id is not None and token == eos_token_id:
+                            if rank >= num_beams:
+                                continue  # HF: only top-num_beams EOS finalize
+                            # the finished hypothesis INCLUDES its eos token
+                            # (HF _beam_search stores running_sequences[:cur_len+1])
+                            hyps[b].add(
+                                np.append(sequences[lane], eos_token_id),
+                                float(flat[flat_idx]),
+                                generated_len=generated_len,
+                            )
+                        else:
+                            next_beam_scores[b, beam_rank] = flat[flat_idx]
+                            next_beam_tokens[b, beam_rank] = token
+                            next_beam_idx[b, beam_rank] = lane
+                            beam_rank += 1
+                        if beam_rank == num_beams:
+                            break
+                    done[b] = done[b] or hyps[b].is_done(float(flat.max()), generated_len)
 
-            for _step in range(max_new_tokens - 1):
+                beam_scores = next_beam_scores
+                lane_order = next_beam_idx.reshape(-1)
+                sequences = np.concatenate(
+                    [sequences[lane_order], next_beam_tokens.reshape(-1, 1)], axis=1
+                )
+                hypo_ids = lane_order.astype(np.int64)
+                if all(done):
+                    break
+                if _step + 1 == max_new_tokens:
+                    break
                 hidden = np.asarray(self.embed(sequences[:, -1:], with_prompts=False))
                 out = session.step(hidden, hypo_ids=hypo_ids)
-                logits = np.asarray(self.lm_logits(out[:, -1:]))[:, 0]
-                logprobs = _log_softmax(logits)  # [beams, vocab]
-                totals = beam_scores[:, None] + logprobs  # [beams, vocab]
-                flat = totals.reshape(-1)
-                top = np.argsort(-flat)[:num_beams]
-                beam_idx, token_idx = top // vocab, top % vocab
-                beam_scores = flat[top]
-                sequences = np.concatenate(
-                    [sequences[beam_idx], token_idx[:, None]], axis=1
-                )
-                hypo_ids = beam_idx.astype(np.int64)
-
-            # all beams have equal length (no EOS finalization yet), so the
-            # raw score argmax is HF-equivalent for any length penalty
-            return sequences[beam_scores.argmax()][None]
         finally:
             session.close()
+
+        # finalize (HF BeamSearchScorer.finalize): open beams become hypotheses
+        for b in range(batch):
+            if done[b]:
+                continue
+            for beam in range(num_beams):
+                lane = b * num_beams + beam
+                hyps[b].add(
+                    sequences[lane].copy(), float(beam_scores[b, beam]),
+                    generated_len=sequences.shape[1] - prompt_len,
+                )
+
+        best = [max(hyps[b].beams, key=lambda item: item[0])[1] for b in range(batch)]
+        sent_lengths = [len(seq) for seq in best]
+        out_len = min(max(sent_lengths), max_length)
+        # HF's output_fill_value, quirk included: a FALSY pad_token_id (0) is
+        # replaced by eos, so short rows' tails are filled with eos tokens
+        if eos_token_id is not None:
+            fill = pad_token_id or eos_token_id
+        elif pad_token_id is not None:
+            fill = pad_token_id
+        else:
+            fill = 0  # without eos every row has full length; never visible
+        decoded = np.full((batch, out_len), fill, np.int64)
+        for b, seq in enumerate(best):
+            decoded[b, : sent_lengths[b]] = seq[:out_len]
+        return decoded
+
+
+class _BeamHypotheses:
+    """Finished-hypothesis pool per batch item (HF BeamHypotheses semantics:
+    keep the best ``num_beams`` by length-penalized score)."""
+
+    def __init__(self, num_beams: int, length_penalty: float, early_stopping: bool):
+        self.num_beams = num_beams
+        self.length_penalty = length_penalty
+        self.early_stopping = early_stopping
+        self.beams = []  # (penalized_score, sequence)
+        self.worst_score = 1e9
+
+    def add(self, sequence: np.ndarray, sum_logprobs: float, *, generated_len: int) -> None:
+        score = sum_logprobs / (generated_len**self.length_penalty)
+        if len(self.beams) < self.num_beams or score > self.worst_score:
+            self.beams.append((score, sequence))
+            if len(self.beams) > self.num_beams:
+                worst = min(range(len(self.beams)), key=lambda i: self.beams[i][0])
+                del self.beams[worst]
+            self.worst_score = min(score for score, _ in self.beams)
+
+    def is_done(self, best_sum_logprobs: float, generated_len: int) -> bool:
+        if len(self.beams) < self.num_beams:
+            return False
+        if self.early_stopping:
+            return True
+        return self.worst_score >= best_sum_logprobs / (generated_len**self.length_penalty)
 
 
 def _log_softmax(x: np.ndarray) -> np.ndarray:

@@ -27,21 +27,12 @@ def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray, ignore_index: int = 
     return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
 
 
-def compute_loss_and_grads(
-    model: DistributedModelForCausalLM,
-    input_ids: np.ndarray,
-    labels: np.ndarray,
-) -> Tuple[float, Dict[str, jnp.ndarray]]:
-    """One swarm training step's worth of gradients.
-
-    Returns (loss, grads) where grads covers model.trainable_params()
-    (prompt_embeddings / deep_prompt_embeddings when ptune is enabled).
-    The remote middle is handled by the fault-tolerant sequential autograd:
-    local embed -> [swarm forward] -> local head/loss -> local head vjp ->
-    [swarm backward] -> local embed vjp.
-    """
+def _swarm_loss_and_grads(model, input_ids: np.ndarray, back_fn) -> Tuple[float, Dict[str, jnp.ndarray]]:
+    """Shared fault-tolerant sequential-autograd scaffolding:
+    local embed (vjp) -> [swarm forward] -> ``back_fn`` head+loss (vjp) ->
+    [swarm backward] -> local embed vjp. Servers stay stateless and recompute
+    activations during backward."""
     params = model.trainable_params()
-    pre_seq = model.ptune.pre_seq_len if model.ptune.tuning_mode else 0
     batch = input_ids.shape[0]
 
     # ---- local front: embeddings (+ shallow prompts), tracked by vjp
@@ -69,19 +60,7 @@ def compute_loss_and_grads(
         np.asarray(hidden0), prompts=deep_prompts
     )
 
-    # ---- local back: head + loss, tracked by vjp
-    padded_labels = labels
-    if pre_seq:
-        pad = np.full((batch, pre_seq), -100, dtype=labels.dtype)
-        padded_labels = np.concatenate([pad, labels], axis=1)
-
-    def back(out_hidden):
-        logits = model._head_jit(model.client_params, out_hidden)
-        shifted = logits[:, :-1]
-        targets = jnp.asarray(padded_labels)[:, 1:]
-        return cross_entropy(shifted, targets)
-
-    loss, back_vjp = jax.vjp(back, jnp.asarray(out_hidden))
+    loss, back_vjp = jax.vjp(back_fn, jnp.asarray(out_hidden))
     (grad_out_hidden,) = back_vjp(jnp.ones_like(loss))
 
     # ---- swarm backward
@@ -99,6 +78,50 @@ def compute_loss_and_grads(
         else:
             grads["deep_prompt_embeddings"] = jnp.zeros_like(params["deep_prompt_embeddings"])
     return float(loss), grads
+
+
+def compute_loss_and_grads(
+    model: DistributedModelForCausalLM,
+    input_ids: np.ndarray,
+    labels: np.ndarray,
+) -> Tuple[float, Dict[str, jnp.ndarray]]:
+    """Causal-LM swarm training step: (loss, grads) over
+    model.trainable_params() (prompt/deep-prompt embeddings under ptune)."""
+    pre_seq = model.ptune.pre_seq_len if model.ptune.tuning_mode else 0
+    batch = input_ids.shape[0]
+
+    padded_labels = labels
+    if pre_seq:
+        pad = np.full((batch, pre_seq), -100, dtype=labels.dtype)
+        padded_labels = np.concatenate([pad, labels], axis=1)
+
+    def back(out_hidden):
+        logits = model._head_jit(model.client_params, out_hidden)
+        shifted = logits[:, :-1]
+        targets = jnp.asarray(padded_labels)[:, 1:]
+        return cross_entropy(shifted, targets)
+
+    return _swarm_loss_and_grads(model, input_ids, back)
+
+
+def compute_cls_loss_and_grads(
+    model,  # DistributedModelForSequenceClassification
+    input_ids: np.ndarray,
+    labels: np.ndarray,  # [batch] class ids
+) -> Tuple[float, Dict[str, jnp.ndarray]]:
+    """Classification swarm training step (the reference's cls task in
+    benchmarks/benchmark_training.py:50-107): cross-entropy on the pooled
+    last-non-pad-token logits, grads for the ptune prompts."""
+    input_ids = np.asarray(input_ids)
+    pos = model.pool_positions(input_ids)
+    batch = input_ids.shape[0]
+
+    def back(out_hidden):
+        logits = model._head_jit(model.client_params, out_hidden)  # [b, seq, labels]
+        pooled = logits[jnp.arange(batch), jnp.asarray(pos)]
+        return cross_entropy(pooled, jnp.asarray(labels))
+
+    return _swarm_loss_and_grads(model, input_ids, back)
 
 
 def sgd_step(model: DistributedModelForCausalLM, grads: Dict[str, jnp.ndarray], lr: float) -> None:

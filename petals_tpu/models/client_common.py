@@ -32,3 +32,31 @@ def llama_style_client_head(params: dict, hidden, cfg):
         params["head"].astype(jnp.float32),
         preferred_element_type=jnp.float32,
     )
+
+
+# -- sequence classification (reference models/llama/model.py:183 —
+# DistributedLlamaForSequenceClassification keeps embed + final norm + the
+# `score` linear on the client; the blocks stay in the swarm)
+
+LLAMA_STYLE_CLS_PREFIXES = ("model.embed_tokens.", "model.norm.", "score.")
+
+
+def llama_style_hf_to_cls_params(tensors: dict, cfg) -> dict:
+    return {
+        "embed": np.asarray(tensors["model.embed_tokens.weight"]),
+        "norm": np.asarray(tensors["model.norm.weight"]),
+        "score": np.ascontiguousarray(
+            np.asarray(tensors["score.weight"]).T
+        ),  # [hidden, num_labels]
+    }
+
+
+def llama_style_cls_head(params: dict, hidden, cfg):
+    """Per-position classification logits (pooling happens in the model — it
+    needs the input ids to find each row's last non-pad token)."""
+    normed = rms_norm(jnp.asarray(hidden), params["norm"], cfg.rms_norm_eps)
+    return jnp.dot(
+        normed.astype(jnp.float32),
+        params["score"].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )

@@ -10,9 +10,12 @@ import dataclasses
 import petals_tpu.models.llama.block as block_mod
 from petals_tpu.models.client_common import (
     LLAMA_STYLE_CLIENT_PREFIXES,
+    LLAMA_STYLE_CLS_PREFIXES,
     llama_style_client_embed,
     llama_style_client_head,
+    llama_style_cls_head,
     llama_style_hf_to_client_params,
+    llama_style_hf_to_cls_params,
 )
 from petals_tpu.models.registry import register_family
 
@@ -23,5 +26,8 @@ FAMILY = register_family(
         hf_to_client_params=llama_style_hf_to_client_params,
         client_embed=llama_style_client_embed,
         client_head=llama_style_client_head,
+        hf_cls_prefixes=LLAMA_STYLE_CLS_PREFIXES,
+        hf_to_cls_params=llama_style_hf_to_cls_params,
+        cls_head=llama_style_cls_head,
     )
 )

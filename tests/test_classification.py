@@ -1,0 +1,132 @@
+"""Sequence classification over the swarm (reference models/llama/model.py:183
+DistributedLlamaForSequenceClassification): forward matches the local HF head
+exactly; classification ptune trains through the swarm with real gradients."""
+
+import numpy as np
+import pytest
+import torch
+
+from petals_tpu.client.model import AutoDistributedModelForSequenceClassification
+from petals_tpu.client.ptune import PTuneConfig
+from petals_tpu.client.training import compute_cls_loss_and_grads, sgd_step
+from tests.test_full_model import SwarmHarness
+from tests.utils import make_tiny_llama_cls
+
+
+@pytest.fixture(scope="module")
+def cls_swarm(tmp_path_factory):
+    path = make_tiny_llama_cls(str(tmp_path_factory.mktemp("models")))
+    harness = SwarmHarness(path, [dict(first_block=0, num_blocks=4)]).start()
+    yield path, harness
+    harness.stop()
+
+
+def _hf_cls_logits(model_path, input_ids):
+    from transformers import LlamaForSequenceClassification
+
+    model = LlamaForSequenceClassification.from_pretrained(
+        model_path, dtype=torch.float32
+    ).eval()
+    with torch.no_grad():
+        return model(torch.from_numpy(input_ids)).logits.numpy()
+
+
+def test_cls_forward_matches_hf(cls_swarm):
+    path, harness = cls_swarm
+    model = AutoDistributedModelForSequenceClassification.from_pretrained(
+        path, initial_peers=harness.initial_peers
+    )
+    try:
+        assert model.num_labels == 3
+        rng = np.random.RandomState(0)
+        # rows with trailing pad tokens: pooling must pick the LAST NON-PAD
+        input_ids = rng.randint(1, 100, (3, 8)).astype(np.int64)
+        input_ids[1, 5:] = 0  # pad_token_id = 0
+        input_ids[2, 3:] = 0
+        ours = np.asarray(model.forward(input_ids))
+        expected = _hf_cls_logits(path, input_ids)
+        assert ours.shape == (3, 3)
+        np.testing.assert_allclose(ours, expected, atol=2e-4, rtol=0)
+    finally:
+        model.close()
+
+
+def test_cls_ptune_training_reduces_loss(cls_swarm):
+    path, harness = cls_swarm
+    model = AutoDistributedModelForSequenceClassification.from_pretrained(
+        path,
+        initial_peers=harness.initial_peers,
+        ptune=PTuneConfig(pre_seq_len=4, tuning_mode="deep_ptune"),
+    )
+    try:
+        rng = np.random.RandomState(1)
+        ids = rng.randint(1, 100, (4, 6)).astype(np.int64)
+        labels = np.asarray([0, 1, 2, 1], np.int64)
+
+        loss0, grads = compute_cls_loss_and_grads(model, ids, labels)
+        assert np.isfinite(loss0)
+        assert np.abs(np.asarray(grads["prompt_embeddings"])).sum() > 0
+        assert np.abs(np.asarray(grads["deep_prompt_embeddings"])).sum() > 0
+
+        for _ in range(6):
+            _, grads = compute_cls_loss_and_grads(model, ids, labels)
+            sgd_step(model, grads, lr=0.3)
+        final, _ = compute_cls_loss_and_grads(model, ids, labels)
+        assert final < loss0 - 0.01, f"cls prompt tuning did not reduce loss: {loss0} -> {final}"
+    finally:
+        model.close()
+
+
+def test_cls_grads_match_local_chain(cls_swarm):
+    """Pooled-loss gradients through the swarm == a fully local jax replica
+    of embed -> blocks -> norm -> score -> pooled cross-entropy."""
+    import jax
+    import jax.numpy as jnp
+
+    from petals_tpu.client.training import cross_entropy
+    from petals_tpu.models.client_common import llama_style_cls_head
+    from petals_tpu.server.from_pretrained import get_block_config, load_block_params
+
+    path, harness = cls_swarm
+    family, cfg = get_block_config(path)
+    per_block = [
+        load_block_params(path, i, dtype=jnp.float32) for i in range(cfg.num_hidden_layers)
+    ]
+
+    pre_seq = 2
+    model = AutoDistributedModelForSequenceClassification.from_pretrained(
+        path,
+        initial_peers=harness.initial_peers,
+        ptune=PTuneConfig(pre_seq_len=pre_seq, tuning_mode="ptune"),
+    )
+    try:
+        rng = np.random.RandomState(2)
+        ids = rng.randint(1, 100, (2, 5)).astype(np.int64)
+        labels = np.asarray([2, 0], np.int64)
+        loss, grads = compute_cls_loss_and_grads(model, ids, labels)
+
+        pos = model.pool_positions(ids)
+        client = model.client_params
+        prompt0 = model.prompt_embeddings
+
+        def local_loss(prompt_embeds):
+            token_embeds = family.client_embed(client, ids, cfg)
+            prompts = jnp.broadcast_to(
+                prompt_embeds[None], (ids.shape[0], *prompt_embeds.shape)
+            ).astype(token_embeds.dtype)
+            h = jnp.concatenate([prompts, token_embeds], axis=1)
+            for p in per_block:
+                h, _ = family.block_apply(p, h, None, 0, cfg)
+            logits = llama_style_cls_head(client, h, cfg)
+            pooled = logits[jnp.arange(ids.shape[0]), jnp.asarray(pos)]
+            return cross_entropy(pooled, jnp.asarray(labels))
+
+        expected_loss, vjp = jax.vjp(local_loss, jnp.asarray(prompt0))
+        (expected_grad,) = vjp(jnp.ones_like(expected_loss))
+        np.testing.assert_allclose(loss, float(expected_loss), atol=1e-5, rtol=0)
+        np.testing.assert_allclose(
+            np.asarray(grads["prompt_embeddings"]), np.asarray(expected_grad),
+            atol=1e-4, rtol=0,
+        )
+    finally:
+        model.close()

@@ -38,6 +38,31 @@ def make_tiny_llama(
     return path
 
 
+def make_tiny_llama_cls(
+    tmpdir: str, *, n_layers: int = 4, vocab: int = 128, num_labels: int = 3
+) -> str:
+    from transformers import LlamaConfig, LlamaForSequenceClassification
+
+    cfg = LlamaConfig(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=n_layers,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        num_labels=num_labels,
+        pad_token_id=0,
+    )
+    torch.manual_seed(3)
+    model = LlamaForSequenceClassification(cfg).eval()
+    path = os.path.join(tmpdir, "tiny-llama-cls")
+    model.save_pretrained(path, safe_serialization=True)
+    return path
+
+
 def make_tiny_bloom(tmpdir: str, *, n_layers: int = 3, vocab: int = 128) -> str:
     from transformers import BloomConfig, BloomForCausalLM
 
