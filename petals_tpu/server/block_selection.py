@@ -49,34 +49,88 @@ def choose_best_start(throughputs: np.ndarray, num_blocks: int) -> int:
 def should_choose_other_blocks(
     local_peer: PeerID,
     module_infos: Sequence[Optional[RemoteModuleInfo]],
-    num_blocks: int,
+    num_blocks: Optional[int] = None,
     *,
     balance_quality: float = BALANCE_QUALITY,
+    rng: Optional[np.random.RandomState] = None,
 ) -> bool:
     """Would the swarm's bottleneck improve enough if this server moved?
-    Simulates our move plus greedy follow-up moves by others (reference :40-95)."""
-    throughputs_with_us = compute_throughputs(module_infos)
-    local_throughput = _local_throughput(local_peer, module_infos)
-    if local_throughput == 0:
+
+    Simulates our own best move AND everyone else's greedy follow-up moves
+    until no server wants to move (reference block_selection.py:40-95) — a
+    single-move simulation systematically over-estimates the benefit and
+    thrashes in swarms of 3+ servers, because the spot we vacate looks weak
+    to whoever evaluates next.
+    """
+    if balance_quality > 1.0:
+        return True  # debugging override: force a move on every check
+
+    from petals_tpu.utils.dht_utils import compute_spans
+
+    spans = compute_spans(module_infos, min_state=ServerState.JOINING)
+    if local_peer not in spans:
         return False
-
-    throughputs = compute_throughputs(module_infos, exclude_peer=local_peer)
-    actual_quality = throughputs_with_us.min() / max(throughputs_with_us.mean(), 1e-9)
-    if actual_quality >= balance_quality:
-        return False  # already well balanced
-
-    # simulate: we move to the best start given everyone else stays
-    new_start = choose_best_start(throughputs, num_blocks)
-    moved = throughputs.copy()
-    moved[new_start : new_start + num_blocks] += local_throughput
-
-    # if the bottleneck after our move is no better than now, don't thrash
+    local_span = spans[local_peer]
+    if num_blocks is not None and (local_span.end - local_span.start) != num_blocks:
+        # the DHT shows only a fragment of our span (expired/partial records):
+        # a verdict computed on the fragment would justify moves the caller's
+        # real num_blocks-sized reload never matches — wait for a clean view
+        return False
+    if (local_span.server_info.throughput or 0.0) <= 0:
+        return False  # still measuring: moving a zero-throughput span changes nothing
     eps = 1e-3
-    return moved.min() > throughputs_with_us.min() + eps
+    rng = rng or np.random
 
+    total = len(module_infos)
+    throughputs = np.zeros(total)
+    sim: Dict[PeerID, list] = {}  # peer -> [start, length, throughput]
+    for pid, span in spans.items():
+        tp = span.server_info.throughput or 0.0
+        sim[pid] = [span.start, span.end - span.start, tp]
+        throughputs[span.start : span.end] += tp
+    initial = throughputs.min()
 
-def _local_throughput(local_peer, module_infos) -> float:
-    for info in module_infos:
-        if info is not None and local_peer in info.servers:
-            return info.servers[local_peer].throughput
-    return 0.0
+    def best_move(pid) -> int:
+        """Lift the span out (eps-biased so near-ties prefer staying put) and
+        return its best start under the current simulated layout."""
+        start, length, tp = sim[pid]
+        throughputs[start : start + length] -= tp * (1 + eps)
+        new_start = choose_best_start(throughputs, length)
+        throughputs[start : start + length] += tp * eps
+        return new_start
+
+    def settle(pid, new_start) -> None:
+        sim[pid][0] = new_start
+        _, length, tp = sim[pid]
+        throughputs[new_start : new_start + length] += tp
+
+    # our own move first
+    start, length, tp = sim[local_peer]
+    without_us = throughputs.copy()
+    without_us[start : start + length] -= tp
+    if initial > eps and without_us.min() <= 0:
+        return False  # moving would disconnect the swarm
+    new_start = best_move(local_peer)
+    if new_start == start:
+        throughputs[start : start + length] += tp  # put ourselves back
+        return False  # already in the best place
+    settle(local_peer, new_start)
+
+    # everyone else's greedy follow-ups, to convergence (bounded for safety)
+    for _round in range(10 * max(len(sim), 1)):
+        peers = list(sim)
+        rng.shuffle(peers)
+        moved = False
+        for pid in peers:
+            prev = sim[pid][0]
+            target = best_move(pid)
+            settle(pid, target)
+            moved = moved or target != prev
+        if not moved:
+            break
+
+    converged = throughputs.min()
+    if converged < initial or converged < eps:
+        return False
+    actual_quality = initial / converged
+    return actual_quality < balance_quality - eps

@@ -10,7 +10,9 @@ from tests.test_full_model import SwarmHarness, _hf_greedy
 from tests.utils import make_tiny_llama
 
 
-@pytest.fixture(scope="module")
+# function-scoped: each test kills a server, so sharing a swarm would hand
+# later tests an already-dead "preferred" server and make their kills vacuous
+@pytest.fixture()
 def redundant_swarm(tmp_path_factory):
     path = make_tiny_llama(str(tmp_path_factory.mktemp("models")))
     harness = SwarmHarness(
@@ -58,8 +60,10 @@ def test_mid_generation_failover(redundant_swarm):
 
 
 def test_failover_during_beam_search(redundant_swarm):
-    """Server death mid-beam-search: the replay must repeat recorded hypo_ids
-    so rebuilt KV lanes match the beams (guards the history format)."""
+    """Server death BETWEEN BEAM STEPS INSIDE ONE SESSION: _repair_chain must
+    replay the recorded history — including the per-step hypo_ids KV-lane
+    reorders — into the replacement server, and the finished beam search must
+    still be token-identical to HF (guards the history format)."""
     from transformers import AutoModelForCausalLM
     import torch
 
@@ -77,15 +81,32 @@ def test_failover_during_beam_search(redundant_swarm):
                 torch.from_numpy(ids), max_new_tokens=6, num_beams=3, do_sample=False
             ).numpy()
 
-        # kill the preferred server after the first beam steps land by hooking
-        # the session: do a short beam run, kill, then full run must still match
-        alive = [s for s in harness.servers if s.handler is not None]
-        victim = max(alive, key=lambda s: s.throughput)
-        short = model.generate(ids, max_new_tokens=2, num_beams=3)
-        harness.run(victim.shutdown())
-        harness.servers = [s for s in harness.servers if s is not victim]
+        victim = max(harness.servers, key=lambda s: s.throughput)
+        state = {"steps": 0, "killed": False}
 
+        # hook the session the beam search opens: kill the preferred server
+        # right before the 3rd step (prefill + 1 beam step already recorded,
+        # with hypo_ids) so THIS session must repair and replay mid-beam
+        orig_inference_session = model.remote.inference_session
+
+        def hooked_inference_session(**kwargs):
+            session = orig_inference_session(**kwargs)
+            orig_step = session.step
+
+            def step(*args, **step_kwargs):
+                state["steps"] += 1
+                if state["steps"] == 3 and not state["killed"]:
+                    state["killed"] = True
+                    harness.run(victim.shutdown())
+                    harness.servers = [s for s in harness.servers if s is not victim]
+                return orig_step(*args, **step_kwargs)
+
+            session.step = step
+            return session
+
+        model.remote.inference_session = hooked_inference_session
         out = model.generate(ids, max_new_tokens=6, num_beams=3)
+        assert state["killed"], "test setup: the kill hook never fired"
         np.testing.assert_array_equal(out, expected)
     finally:
         model.close()

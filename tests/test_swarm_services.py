@@ -50,10 +50,38 @@ def test_should_choose_other_blocks():
     # a and b pile on blocks [0, 2); c alone serves [2, 4) -> badly balanced;
     # moving b to [2, 4) would raise the bottleneck
     infos = _infos([(a, 0, 2, 10.0), (b, 0, 2, 10.0), (c, 2, 4, 1.0)])
-    assert should_choose_other_blocks(b, infos, 2)
+    assert should_choose_other_blocks(b, infos, 2, rng=np.random.RandomState(0))
     # a well-balanced swarm stays put
     infos = _infos([(a, 0, 2, 10.0), (b, 2, 4, 10.0)])
-    assert not should_choose_other_blocks(b, infos, 2)
+    assert not should_choose_other_blocks(b, infos, 2, rng=np.random.RandomState(0))
+
+
+def test_block_selection_convergence_no_thrash():
+    """The greedy follow-up-move simulation (reference block_selection.py:68-95):
+    once the recommended move happens, NO server in the 3-server swarm wants to
+    move again — repeated evaluation is a fixed point, not a thrash loop."""
+    a, b, c = (PeerID.from_seed(s) for s in (b"a", b"b", b"c"))
+    piled = _infos([(a, 0, 2, 10.0), (b, 0, 2, 10.0), (c, 2, 4, 1.0)])
+    assert should_choose_other_blocks(b, piled, 2, rng=np.random.RandomState(0))
+
+    # b took the advice and moved to [2, 4): now every server must stay put,
+    # regardless of the follow-up-simulation's shuffle order
+    settled = _infos([(a, 0, 2, 10.0), (b, 2, 4, 10.0), (c, 2, 4, 1.0)])
+    for seed in range(5):
+        rng = np.random.RandomState(seed)
+        for peer in (a, b, c):
+            assert not should_choose_other_blocks(peer, settled, 2, rng=rng), (
+                f"peer {peer} thrashes with shuffle seed {seed}"
+            )
+
+
+def test_block_selection_disjoint_guard():
+    """A server never abandons blocks nobody else serves, even when its own
+    span looks like the best destination for a move."""
+    a, b = (PeerID.from_seed(s) for s in (b"a", b"b"))
+    infos = _infos([(a, 0, 2, 1.0), (b, 2, 4, 50.0)])
+    # a is the sole host of [0, 2): moving would disconnect the swarm
+    assert not should_choose_other_blocks(a, infos, 2, rng=np.random.RandomState(0))
 
 
 def test_ping_aggregator_live():
