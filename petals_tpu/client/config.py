@@ -31,7 +31,15 @@ class ClientConfig:
 
     use_server_to_server: bool = True  # direct server->server activation push
 
+    # wire compression for activations we SEND and the compression we REQUEST
+    # for server replies ("none" | "float16" | "bfloat16" | "qint8");
+    # reference clients negotiate this per request (handler.py:411-432)
+    compression: str = "none"
+
     def __post_init__(self):
         if self.max_retries is None:
             env = os.environ.get("PETALS_TPU_MAX_RETRIES")
             self.max_retries = int(env) if env else None
+        from petals_tpu.rpc.serialization import CompressionType
+
+        CompressionType(self.compression)  # fail at construction, not mid-session

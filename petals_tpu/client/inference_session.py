@@ -44,6 +44,7 @@ class _ServerInferenceSession:
         self.stream = stream
         self.max_length = max_length
         self.step_timeout = step_timeout
+        self.compression = CompressionType.NONE  # create() sets the negotiated codec
         self.position = 0
         # inputs sent so far, as (hidden, hypo_ids) steps — replay must repeat
         # beam-lane reorders exactly (failover during beam search)
@@ -68,12 +69,15 @@ class _ServerInferenceSession:
     ) -> "_ServerInferenceSession":
         stub: RpcClient = await seq_manager.get_stub(span.peer_id)
         stream = await stub.open_stream("ptu.inference")
+        compression = CompressionType(seq_manager.config.compression)
         open_msg = {
             "uids": CHAIN_DELIMITER.join(uids),
             "max_length": max_length,
             "batch_size": batch_size,
             "active_adapter": seq_manager.config.active_adapter,
         }
+        if compression != CompressionType.NONE:
+            open_msg["compression"] = compression.value  # reply compression for all steps
         if session_id:
             open_msg["session_id"] = session_id
         if push_to:
@@ -83,6 +87,7 @@ class _ServerInferenceSession:
         assert ack.get("session_open"), f"Unexpected open reply: {ack}"
         self = cls(span, uids, stream, max_length=max_length, step_timeout=step_timeout)
         self.session_id = session_id
+        self.compression = compression
         return self
 
     async def step(
@@ -97,14 +102,15 @@ class _ServerInferenceSession:
         if start_from_position is not None:
             self._rollback_history(start_from_position)
 
-        msg = {"tensors": {"hidden": serialize_array(hidden, CompressionType.NONE)}}
+        comp = self.compression
+        msg = {"tensors": {"hidden": serialize_array(hidden, comp)}}
         if step_id is not None:
             msg["step_id"] = step_id
         if self.pending_push_to is not None:
             msg["push_to"] = self.pending_push_to if self.pending_push_to else None
             self.pending_push_to = None
         if prompts is not None:
-            msg["tensors"]["prompts"] = serialize_array(prompts)
+            msg["tensors"]["prompts"] = serialize_array(prompts, comp)
         if hypo_ids is not None:
             msg["tensors"]["hypo_ids"] = serialize_array(np.asarray(hypo_ids, np.int64))
         if start_from_position is not None:

@@ -25,10 +25,13 @@ async def run_remote_forward(
 ) -> np.ndarray:
     stub = await seq_manager.get_stub(span.peer_id)
     uids = CHAIN_DELIMITER.join(seq_manager.block_uids[span.start : span.end])
-    tensors = {"hidden": serialize_array(hidden, CompressionType.NONE)}
+    comp = CompressionType(seq_manager.config.compression)
+    tensors = {"hidden": serialize_array(hidden, comp)}
     if prompts is not None:
-        tensors["prompts"] = serialize_array(prompts)
+        tensors["prompts"] = serialize_array(prompts, comp)
     payload = {"uids": uids, "tensors": tensors}
+    if comp != CompressionType.NONE:
+        payload["compression"] = comp.value  # ask the server to compress its reply
     if seq_manager.config.active_adapter:
         payload["active_adapter"] = seq_manager.config.active_adapter
     result = await stub.call(
@@ -48,13 +51,16 @@ async def run_remote_backward(
 ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
     stub = await seq_manager.get_stub(span.peer_id)
     uids = CHAIN_DELIMITER.join(seq_manager.block_uids[span.start : span.end])
+    comp = CompressionType(seq_manager.config.compression)
     tensors = {
-        "hidden": serialize_array(hidden, CompressionType.NONE),
-        "grad_out": serialize_array(grad_out, CompressionType.NONE),
+        "hidden": serialize_array(hidden, comp),
+        "grad_out": serialize_array(grad_out, comp),
     }
     if prompts is not None:
-        tensors["prompts"] = serialize_array(prompts)
+        tensors["prompts"] = serialize_array(prompts, comp)
     payload = {"uids": uids, "tensors": tensors}
+    if comp != CompressionType.NONE:
+        payload["compression"] = comp.value
     if seq_manager.config.active_adapter:
         payload["active_adapter"] = seq_manager.config.active_adapter
     result = await stub.call(

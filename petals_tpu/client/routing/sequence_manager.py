@@ -109,6 +109,7 @@ class RemoteSequenceManager:
             self._peer_infos = {
                 span.peer_id: span.server_info for span in self.state.spans_by_priority
             }
+            self._prune_expired_bans()
             await self._ping_candidates()
 
     async def _ping_candidates(self) -> None:
@@ -189,6 +190,17 @@ class RemoteSequenceManager:
             # ban expired; keep the streak so repeat offenders get longer bans
             return False
         return True
+
+    def _prune_expired_bans(self) -> None:
+        """Drop entries whose ban lapsed long ago: the streak memory is only
+        worth keeping for recent offenders, not for the life of the client."""
+        now = time.monotonic()
+        grace = max(20 * self.config.ban_timeout, 600.0)
+        self._banned = {
+            pid: (until, streak)
+            for pid, (until, streak) in self._banned.items()
+            if now - until <= grace
+        }
 
     # ------------------------------------------------------------------ sequences
 

@@ -160,10 +160,23 @@ class TransformerHandler:
         arr = deserialize_array(wire)
         return None if is_dummy(arr) else arr
 
+    def _reply_compression(self, payload: dict) -> CompressionType:
+        """Per-request output compression negotiation (reference
+        handler.py:411-432): the client's requested codec wins over the
+        server-wide default."""
+        requested = payload.get("compression")
+        if requested is None:
+            return self.compression
+        try:
+            return CompressionType(requested)
+        except ValueError:
+            raise ValueError(f"Unknown compression {requested!r}")
+
     # ------------------------------------------------------------------ rpc methods
 
     async def rpc_forward(self, payload, ctx: RpcContext):
         start, end = self._parse_chain(payload["uids"])
+        reply_comp = self._reply_compression(payload)  # reject bad codecs up front
         hidden = self._get_tensor(payload, "hidden")
         prompts = self._get_tensor(payload, "prompts")
         if hidden is None or hidden.ndim != 3 or hidden.shape[2] != self.backend.cfg.hidden_size:
@@ -181,10 +194,11 @@ class TransformerHandler:
             ),
             self.request_timeout,
         )
-        return {"tensors": {"hidden": serialize_array(out, self.compression)}}
+        return {"tensors": {"hidden": serialize_array(out, reply_comp)}}
 
     async def rpc_backward(self, payload, ctx: RpcContext):
         start, end = self._parse_chain(payload["uids"])
+        reply_comp = self._reply_compression(payload)  # reject bad codecs up front
         hidden = self._get_tensor(payload, "hidden")
         grad_out = self._get_tensor(payload, "grad_out")
         prompts = self._get_tensor(payload, "prompts")
@@ -216,9 +230,9 @@ class TransformerHandler:
             ),
             self.request_timeout,
         )
-        tensors = {"grad_hidden": serialize_array(grad_hidden, self.compression)}
+        tensors = {"grad_hidden": serialize_array(grad_hidden, reply_comp)}
         if grad_prompts is not None:
-            tensors["grad_prompts"] = serialize_array(grad_prompts, self.compression)
+            tensors["grad_prompts"] = serialize_array(grad_prompts, reply_comp)
         return {"tensors": tensors}
 
     async def rpc_info(self, payload, ctx: RpcContext):
@@ -240,6 +254,7 @@ class TransformerHandler:
         start, end = self._parse_chain(open_msg["uids"])
         max_length = int(open_msg["max_length"])
         batch_size = int(open_msg.get("batch_size", 1))
+        reply_comp = self._reply_compression(open_msg)  # for every step reply
         active_adapter = open_msg.get("active_adapter")
         session_id = open_msg.get("session_id")
         # where to push our outputs: {"addr": "host:port/peer", "session_id": ...}
@@ -252,8 +267,8 @@ class TransformerHandler:
         async with self.memory_cache.allocate_cache(
             *descriptors, timeout=open_msg.get("alloc_timeout")
         ) as handles:
-            with self.memory_cache.use_cache(*handles) as (k_buf, v_buf):
-                kv = (k_buf, v_buf)
+            k_buf, v_buf = self.memory_cache.get_buffers(*handles)
+            kv = (k_buf, v_buf)
             position = 0
             if session_id:
                 # registered only once allocation succeeded (no leak on failure)
@@ -320,7 +335,7 @@ class TransformerHandler:
                 self.memory_cache.update_cache(handles[0], kv[0])
                 self.memory_cache.update_cache(handles[1], kv[1])
                 position += seq
-                wire_out = serialize_array(out, self.compression)
+                wire_out = serialize_array(out, reply_comp)
                 if push_to is not None and prompts is None:
                     # can_push = no deep prompts (reference block_functions.py:233).
                     # Fire-and-forget: the client's relay of this output remains

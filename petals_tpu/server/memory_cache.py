@@ -9,10 +9,10 @@ the same contract collapses to asyncio:
 - ``allocate_cache(*descriptors, timeout=...)`` — async context manager that
   reserves budget and yields integer handles; oversubscribed requests QUEUE
   (FIFO) until space frees or the timeout elapses (AllocationFailed).
-- ``use_cache(*handles)`` — context manager for the compute side yielding the
-  device buffers; buffers are created lazily (zeros in HBM) on first use and
-  replaced functionally after each step via ``update_cache`` (XLA donation
-  makes this in-place at the buffer level).
+- ``get_buffers(*handles)`` — compute-side access to the device buffers;
+  buffers are created lazily (zeros in HBM) on first use and replaced
+  functionally after each step via ``update_cache`` (XLA donation makes this
+  in-place at the buffer level).
 
 Handles survive across RPC calls so an inference session touches its KV by
 integer id only — exactly the reference's cross-process contract, minus the
@@ -168,7 +168,12 @@ class MemoryCache:
 
     @contextlib.contextmanager
     def use_cache(self, *handles: Handle, device: Optional[jax.Device] = None):
-        """Compute-side access: yields the list of device buffers for ``handles``,
+        """Deprecated contextmanager shim; use :meth:`get_buffers` (the
+        single-process design never needed scoped access)."""
+        yield self.get_buffers(*handles, device=device)
+
+    def get_buffers(self, *handles: Handle, device: Optional[jax.Device] = None) -> list:
+        """Compute-side access: the device buffers for ``handles``,
         materializing zeros on first touch."""
         buffers = []
         for handle in handles:
@@ -177,7 +182,7 @@ class MemoryCache:
             if self._buffers[handle] is None:
                 self._buffers[handle] = self._allocated[handle].make_zeros(device)
             buffers.append(self._buffers[handle])
-        yield buffers
+        return buffers
 
     def update_cache(self, handle: Handle, new_buffer: jax.Array) -> None:
         """Store the post-step buffer for ``handle`` (functional update; pair with

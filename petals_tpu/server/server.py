@@ -160,7 +160,9 @@ class Server:
             info = await asyncio.get_running_loop().run_in_executor(
                 None,
                 lambda: get_server_throughput(
-                    self.family, self.cfg, compute_dtype=self.compute_dtype, num_blocks=self.num_blocks
+                    self.family, self.cfg, compute_dtype=self.compute_dtype,
+                    num_blocks=self.num_blocks, quant_type=QuantType(self.quant_type).value,
+                    num_devices=self.num_tp_devices or 1,
                 ),
             )
             self.throughput = info["throughput"]

@@ -46,6 +46,8 @@ def get_server_throughput(
     network_mbps: Optional[float] = None,
     num_blocks: int = 1,
     using_relay: bool = False,
+    quant_type: str = "none",
+    num_devices: int = 1,
     cache_dir: Optional[Path] = None,
     force_eval: bool = False,
 ) -> dict:
@@ -54,12 +56,20 @@ def get_server_throughput(
     cache_dir.mkdir(parents=True, exist_ok=True)
     cache_path = cache_dir / THROUGHPUT_FILE
 
+    # every field that changes the measured speed must be in the key — a
+    # server restarted with a different quant/shape/TP setting advertising a
+    # stale number would mis-drive routing and block selection swarm-wide
     cache_key = json.dumps(
         {
             "family": family.name,
             "hidden": cfg.hidden_size,
+            "intermediate": getattr(cfg, "intermediate_size", None),
+            "kv_heads": getattr(cfg, "num_key_value_heads", None),
+            "head_dim": getattr(cfg, "head_dim", None),
             "layers_probed": 1,
             "dtype": str(jnp.dtype(compute_dtype).name),
+            "quant": str(quant_type),
+            "num_devices": int(num_devices),
             "version": petals_tpu.__version__,
             "backend": jax.default_backend(),
         },
@@ -72,7 +82,8 @@ def get_server_throughput(
         logger.info(f"Using cached throughput: {info}")
     else:
         info = measure_compute_rps(
-            family, cfg, compute_dtype=compute_dtype,
+            family, cfg, compute_dtype=compute_dtype, quant_type=quant_type,
+            num_devices=num_devices,
             n_steps_inference=n_steps_inference, n_steps_forward=n_steps_forward,
         )
         info["network_rps"] = measure_network_rps(cfg.hidden_size, network_mbps=network_mbps)
@@ -92,46 +103,68 @@ def get_server_throughput(
 
 
 def measure_compute_rps(
-    family, cfg, *, compute_dtype=jnp.bfloat16, n_steps_inference: int = 50, n_steps_forward: int = 5
+    family, cfg, *, compute_dtype=jnp.bfloat16, quant_type: str = "none",
+    num_devices: int = 1, n_steps_inference: int = 50, n_steps_forward: int = 5,
 ) -> dict:
-    """Benchmark one real block (reference throughput.py:190-237)."""
+    """Benchmark one block through the REAL serving backend — same
+    quantization, and the same TP mesh when the devices exist (reference
+    throughput.py:190-237 measures the converted block for the same reason:
+    the advertised number must describe the path that will serve)."""
+    from petals_tpu.server.backend import TransformerBackend
+    from petals_tpu.server.memory_cache import MemoryCache
+
     shapes = family.block_param_shapes(cfg, compute_dtype)
     key = jax.random.PRNGKey(0)
     params = {}
     for name, sds in sorted(shapes.items()):
         key, sub = jax.random.split(key)
         params[name] = jax.random.normal(sub, sds.shape, compute_dtype) * 0.02
+    if str(quant_type) != "none":
+        from petals_tpu.utils.convert_block import convert_block_params
 
-    hkv = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
-    kv = (
-        jnp.zeros((1, 256, hkv, cfg.head_dim), compute_dtype),
-        jnp.zeros((1, 256, hkv, cfg.head_dim), compute_dtype),
+        params = convert_block_params(params, family.name, quant_type)
+    stacked = jax.tree_util.tree_map(lambda x: x[None] if hasattr(x, "ndim") else x, params)
+
+    mesh = None
+    if num_devices > 1:
+        if len(jax.devices()) >= num_devices:
+            from petals_tpu.parallel.mesh import tp_mesh
+
+            mesh = tp_mesh(num_devices)
+        else:
+            logger.warning(
+                f"Measuring throughput for num_devices={num_devices} on "
+                f"{len(jax.devices())} device(s): figure is a single-device estimate"
+            )
+    backend = TransformerBackend(
+        family, cfg, stacked, first_block=0, n_blocks=1,
+        memory_cache=MemoryCache(None), compute_dtype=compute_dtype, mesh=mesh,
     )
-    import functools
 
-    step = jax.jit(functools.partial(family.block_apply, cfg=cfg), donate_argnums=(2,))
-    token = jnp.zeros((1, 1, cfg.hidden_size), compute_dtype)
+    kd, vd = backend.cache_descriptors(1, 256, 0, 1)
+    kv = (kd.make_zeros(), vd.make_zeros())
+    token = np.zeros((1, 1, cfg.hidden_size), np.float32)
 
-    out, kv = step(params, token, kv, 0)
+    out, kv = backend.inference_step(token, kv, 0)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
     for i in range(n_steps_inference):
-        out, kv = step(params, token, kv, i + 1)
+        out, kv = backend.inference_step(token, kv, i + 1)
     jax.block_until_ready(out)
     inference_rps = n_steps_inference / (time.perf_counter() - t0)
 
-    fwd = jax.jit(lambda p, h: family.block_apply(p, h, None, 0, cfg)[0])
-    batch = jnp.zeros((1, 1024, cfg.hidden_size), compute_dtype)
-    jax.block_until_ready(fwd(params, batch))
+    batch = np.zeros((1, 1024, cfg.hidden_size), np.float32)
+    jax.block_until_ready(backend.forward(batch))
     t0 = time.perf_counter()
     for _ in range(n_steps_forward):
-        out = fwd(params, batch)
+        out = backend.forward(batch)
     jax.block_until_ready(out)
     forward_rps = n_steps_forward * 1024 / (time.perf_counter() - t0)
 
     logger.info(
         f"Measured compute: inference {inference_rps:.1f} steps/s, "
         f"forward {forward_rps:.0f} tok/s per block"
+        + (f" (tp={num_devices})" if mesh is not None else "")
     )
     return {"inference_rps": inference_rps, "forward_rps": forward_rps}
 

@@ -206,6 +206,74 @@ def test_inference_rejects_malformed_step_tensors(model_path):
     run(main())
 
 
+def test_per_request_compression_negotiation(model_path):
+    """Clients request reply compression per call/session; the server honors
+    it over its own default (reference handler.py:411-432 + the override test
+    tests/test_remote_sequential.py:147-167)."""
+
+    async def main():
+        server, client = await _start_server(model_path)
+        try:
+            prefix = default_dht_prefix(model_path)
+            n = server.cfg.num_hidden_layers
+            uids = CHAIN_DELIMITER.join(make_uid(prefix, i) for i in range(n))
+            rng = np.random.RandomState(3)
+            hidden = rng.randn(1, 4, server.cfg.hidden_size).astype(np.float32)
+            dense = np.asarray(server.backend.forward(hidden))
+
+            # unary forward: requested qint8 reply
+            result = await client.call(
+                "ptu.forward",
+                {
+                    "uids": uids,
+                    "compression": "qint8",
+                    "tensors": {"hidden": serialize_array(hidden)},
+                },
+                timeout=60,
+            )
+            wire = result["tensors"]["hidden"]
+            assert wire["compression"] == "qint8"
+            np.testing.assert_allclose(
+                deserialize_array(wire), dense, atol=np.abs(dense).max() / 50, rtol=0
+            )
+
+            # no request -> server default (none)
+            result = await client.call(
+                "ptu.forward",
+                {"uids": uids, "tensors": {"hidden": serialize_array(hidden)}},
+                timeout=60,
+            )
+            assert result["tensors"]["hidden"]["compression"] == "none"
+
+            # inference stream: compression fixed at session open
+            stream = await client.open_stream("ptu.inference")
+            await stream.send(
+                {"uids": uids, "max_length": 8, "batch_size": 1, "compression": "bfloat16"}
+            )
+            await stream.recv(timeout=30)
+            await stream.send({"tensors": {"hidden": serialize_array(hidden)}})
+            reply = await stream.recv(timeout=60)
+            assert reply["tensors"]["hidden"]["compression"] == "bfloat16"
+            await stream.end()
+
+            # unknown codec is rejected cleanly
+            with pytest.raises(RpcError, match="Unknown compression"):
+                await client.call(
+                    "ptu.forward",
+                    {
+                        "uids": uids,
+                        "compression": "zstd",
+                        "tensors": {"hidden": serialize_array(hidden)},
+                    },
+                    timeout=30,
+                )
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(main())
+
+
 def test_server_announces_to_dht(model_path):
     async def main():
         from petals_tpu.dht import DHTNode

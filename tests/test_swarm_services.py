@@ -142,6 +142,26 @@ def test_throughput_measure_and_cache(tmp_path):
     )
     assert relayed["network_rps"] == pytest.approx(info["network_rps"] * 0.2)
 
+    # a different quant_type / num_devices must NOT reuse the dense cache
+    # entry (a stale number would mis-drive routing swarm-wide); re-measures
+    # with actually-quantized params
+    t0 = time.perf_counter()
+    nf4 = get_server_throughput(
+        family, cfg, compute_dtype=jnp.float32, cache_dir=tmp_path,
+        n_steps_inference=5, n_steps_forward=2, num_blocks=2, quant_type="nf4",
+    )
+    assert time.perf_counter() - t0 > 0.05, "quant run must not be a cache hit"
+    assert nf4["inference_rps"] > 0 and nf4["inference_rps"] != info["inference_rps"]
+    # num_devices keys the cache AND the measurement runs on a real tp mesh
+    # (the conftest provides 8 virtual devices)
+    t0 = time.perf_counter()
+    tp2 = get_server_throughput(
+        family, cfg, compute_dtype=jnp.float32, cache_dir=tmp_path,
+        n_steps_inference=5, n_steps_forward=2, num_blocks=2, num_devices=2,
+    )
+    assert time.perf_counter() - t0 > 0.05, "tp run must not be a cache hit"
+    assert tp2["inference_rps"] > 0 and tp2["inference_rps"] != info["inference_rps"]
+
 
 def test_reachability_protocol_live():
     async def main():
