@@ -75,9 +75,10 @@ class _ServerInferenceSession:
             "max_length": max_length,
             "batch_size": batch_size,
             "active_adapter": seq_manager.config.active_adapter,
+            # reply compression for all steps; "none" must OVERRIDE a lossy
+            # server default, so it is always sent
+            "compression": compression.value,
         }
-        if compression != CompressionType.NONE:
-            open_msg["compression"] = compression.value  # reply compression for all steps
         if session_id:
             open_msg["session_id"] = session_id
         if push_to:

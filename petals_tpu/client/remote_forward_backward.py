@@ -29,9 +29,8 @@ async def run_remote_forward(
     tensors = {"hidden": serialize_array(hidden, comp)}
     if prompts is not None:
         tensors["prompts"] = serialize_array(prompts, comp)
-    payload = {"uids": uids, "tensors": tensors}
-    if comp != CompressionType.NONE:
-        payload["compression"] = comp.value  # ask the server to compress its reply
+    # always sent: "none" must OVERRIDE a server whose default is lossy
+    payload = {"uids": uids, "tensors": tensors, "compression": comp.value}
     if seq_manager.config.active_adapter:
         payload["active_adapter"] = seq_manager.config.active_adapter
     result = await stub.call(
@@ -58,9 +57,7 @@ async def run_remote_backward(
     }
     if prompts is not None:
         tensors["prompts"] = serialize_array(prompts, comp)
-    payload = {"uids": uids, "tensors": tensors}
-    if comp != CompressionType.NONE:
-        payload["compression"] = comp.value
+    payload = {"uids": uids, "tensors": tensors, "compression": comp.value}
     if seq_manager.config.active_adapter:
         payload["active_adapter"] = seq_manager.config.active_adapter
     result = await stub.call(

@@ -28,6 +28,7 @@ def block_apply(
     cfg: BloomBlockConfig,
     *,
     use_flash: bool = False,
+    tp_mesh=None,
     n_valid=None,  # dynamic count of real (non-padding) tokens in this chunk
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     batch, seq, _ = hidden_states.shape
@@ -50,6 +51,7 @@ def block_apply(
         kv_length=kv_length,
         alibi_slopes=slopes,
         use_flash=use_flash,
+        tp_mesh=tp_mesh,
     )
     attn = mm(attn.reshape(batch, seq, h * d), params["wo"]) + params["bo"]
     hidden_states = attn + residual

@@ -43,6 +43,7 @@ def block_apply(
     cfg: FalconBlockConfig,
     *,
     use_flash: bool = False,
+    tp_mesh=None,
     n_valid=None,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     batch, seq, _ = hidden_states.shape
@@ -90,6 +91,7 @@ def block_apply(
         kv_length=kv_length,
         alibi_slopes=alibi_slopes,
         use_flash=use_flash,
+        tp_mesh=tp_mesh,
     )
     attn = mm(attn.reshape(batch, seq, hq * d), params["wo"])
     if cfg.bias:

@@ -32,6 +32,7 @@ def block_apply(
     use_flash: bool = False,
     n_valid=None,  # dynamic count of real (non-padding) tokens in this chunk
     ring_mesh=None,  # training path only: sequence-parallel ring attention over "sp"
+    tp_mesh=None,  # serving path: run the flash kernel per TP head-shard
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     batch, seq, _ = hidden_states.shape
     hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -65,7 +66,8 @@ def block_apply(
         attn = ring_attention_sharded(q, k_all, v_all, ring_mesh)
     else:
         attn = attend(
-            q, k_all, v_all, q_offset=position, kv_length=kv_length, use_flash=use_flash
+            q, k_all, v_all, q_offset=position, kv_length=kv_length,
+            use_flash=use_flash, tp_mesh=tp_mesh,
         )
     attn = mm(attn.reshape(batch, seq, hq * d), params["wo"])
     if cfg.attention_bias:

@@ -70,6 +70,7 @@ def block_apply(
     cfg: MixtralBlockConfig,
     *,
     use_flash: bool = False,
+    tp_mesh=None,
     n_valid=None,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     batch, seq, _ = hidden_states.shape
@@ -96,6 +97,7 @@ def block_apply(
         kv_length=kv_length,
         sliding_window=cfg.sliding_window,
         use_flash=use_flash,
+        tp_mesh=tp_mesh,
     )
     hidden_states = residual + mm(attn.reshape(batch, seq, hq * d), params["wo"])
 

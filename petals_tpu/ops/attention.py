@@ -29,6 +29,47 @@ import jax.numpy as jnp
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
+def _flash_sharded(q, k, v, mesh, *, q_offset, kv_length, alibi_slopes, scale):
+    """Run the Pallas flash kernel per TP shard: q/kv heads are sharded over
+    the mesh's "tp" axis (Megatron layout, parallel/tp.py), the kernel is
+    per-head, and no cross-shard communication is needed — shard_map gives
+    Mosaic the per-device view GSPMD cannot derive for a custom call."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    heads_spec = P(None, None, "tp", None)
+    scalar_spec = P()
+
+    def per_shard(q_, k_, v_, q_offset_, kv_length_, slopes_):
+        from petals_tpu.ops.flash_attention import flash_attend
+
+        return flash_attend(
+            q_, k_, v_,
+            q_offset=q_offset_, kv_length=kv_length_,
+            alibi_slopes=slopes_ if alibi_slopes is not None else None,
+            scale=scale,
+        )
+
+    if kv_length is None:
+        kv_length = k.shape[1]
+    slopes = (
+        alibi_slopes
+        if alibi_slopes is not None
+        else jnp.zeros((q.shape[2],), jnp.float32)  # placeholder, unused per-shard
+    )
+    fn = shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(heads_spec, heads_spec, heads_spec, scalar_spec, scalar_spec, P("tp")),
+        out_specs=heads_spec,
+        check_vma=False,
+    )
+    return fn(
+        q, k, v,
+        jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_length, jnp.int32), slopes,
+    )
+
+
 def attend(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -41,6 +82,7 @@ def attend(
     scale: Optional[float] = None,
     causal: bool = True,
     use_flash: bool = False,
+    tp_mesh=None,
 ) -> jnp.ndarray:
     """Multi-head attention with causal masking over a prefix-valid KV buffer.
 
@@ -54,11 +96,19 @@ def attend(
       scale: softmax scale; default 1/sqrt(d).
       causal: apply causal mask (True for all served models).
       use_flash: route to the Pallas flash kernel when shapes allow.
+      tp_mesh: tensor-parallel Mesh with a "tp" axis — heads are sharded over
+        it, so the Mosaic kernel (no GSPMD rule) runs per-shard via shard_map.
     """
     if use_flash and causal:
         from petals_tpu.ops.flash_attention import flash_attend, flash_supported
 
         if flash_supported(q, k, v, sliding_window=sliding_window):
+            if tp_mesh is not None:
+                return _flash_sharded(
+                    q, k, v, tp_mesh,
+                    q_offset=q_offset, kv_length=kv_length,
+                    alibi_slopes=alibi_slopes, scale=scale,
+                )
             return flash_attend(
                 q,
                 k,

@@ -88,10 +88,9 @@ class TransformerBackend:
             from petals_tpu.parallel.tp import shard_span_params
 
             self.params = shard_span_params(self.params, mesh, family.name, cfg)
-            # the Pallas kernel is written per-device; under GSPMD sharding we
-            # rely on XLA's fused attention instead (ring/shard_map kernels are
-            # the sequence-parallel path, see petals_tpu/ops/ring_attention.py)
-            use_flash = False
+            # flash stays ON: attend() runs the Pallas kernel per TP head-shard
+            # via shard_map (ops/attention.py _flash_sharded) — GSPMD has no
+            # partitioning rule for Mosaic custom calls, shard_map sidesteps it
         self.use_flash = use_flash
 
         self.num_kv_heads = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
@@ -160,6 +159,7 @@ class TransformerBackend:
     @functools.cached_property
     def _inference_step_fn(self):
         family, cfg, use_flash = self.family, self.cfg, self.use_flash
+        tp_mesh = self.mesh
 
         @functools.partial(
             jax.jit,
@@ -194,6 +194,7 @@ class TransformerBackend:
                 out, (k_new, v_new) = family.block_apply(
                     p_block, h, (k_block, v_block), position, cfg,
                     use_flash=use_flash, n_valid=n_valid if padded else None,
+                    tp_mesh=tp_mesh,
                 )
                 return out, (k_new, v_new)
 
@@ -206,8 +207,13 @@ class TransformerBackend:
 
     @functools.cached_property
     def _forward_fn(self):
-        family, cfg, use_flash = self.family, self.cfg, self.use_flash
+        family, cfg = self.family, self.cfg
+        tp_mesh = self.mesh
 
+        # The training path (forward + vjp-recompute backward) NEVER uses the
+        # Pallas flash kernel: it has no reverse-mode AD rule, and keeping
+        # forward and backward on the same (XLA) attention means the backward
+        # recompute linearizes exactly what the client saw.
         @functools.partial(jax.jit, static_argnames=("with_prompts",))
         def fwd(params, hidden, prompts, *, with_prompts: bool):
             def body(h, xs):
@@ -215,7 +221,9 @@ class TransformerBackend:
                 if with_prompts:
                     pre = prompt.shape[1]
                     h = h.at[:, :pre].add(prompt.astype(h.dtype))
-                out, _ = family.block_apply(p_block, h, None, 0, cfg, use_flash=use_flash)
+                out, _ = family.block_apply(
+                    p_block, h, None, 0, cfg, use_flash=False, tp_mesh=tp_mesh
+                )
                 return out, None
 
             hidden, _ = jax.lax.scan(body, hidden, (params, prompts))

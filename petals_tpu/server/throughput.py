@@ -87,8 +87,13 @@ def get_server_throughput(
             n_steps_inference=n_steps_inference, n_steps_forward=n_steps_forward,
         )
         info["network_rps"] = measure_network_rps(cfg.hidden_size, network_mbps=network_mbps)
-        cache[cache_key] = info
-        _write_cache(cache_path, cache)
+        if num_devices <= 1 or len(jax.devices()) >= num_devices:
+            cache[cache_key] = info
+            _write_cache(cache_path, cache)
+        else:
+            # degraded single-device estimate of a TP config: never persist it
+            # under the TP key, or it would outlive the broken environment
+            logger.warning("Not caching single-device estimate for a TP config")
 
     # blended throughput (reference throughput.py:96-106): compute spread over
     # the hosted blocks vs what the network can carry

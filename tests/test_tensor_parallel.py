@@ -134,6 +134,62 @@ def test_tp_quantized_matches_single_device(quant, tmp_path):
     np.testing.assert_allclose(np.asarray(gt), np.asarray(gp), atol=2e-3, rtol=0)
 
 
+def test_tp_flash_prefill_matches_single_device(tmp_path):
+    """Flash attention stays ON under a TP mesh: the Pallas kernel runs per
+    head-shard via shard_map (ops/attention.py _flash_sharded) instead of
+    silently falling back to the XLA path (VERDICT weak #3)."""
+    from unittest import mock
+
+    import petals_tpu.ops.attention as attention_mod
+
+    tp_size = 2
+    path = make_tiny_llama(str(tmp_path))
+    family, cfg = get_block_config(path)
+    per_block = [
+        load_block_params(path, i, dtype=jnp.float32) for i in range(cfg.num_hidden_layers)
+    ]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
+
+    common = dict(
+        first_block=0,
+        n_blocks=cfg.num_hidden_layers,
+        memory_cache=MemoryCache(None),
+        compute_dtype=jnp.float32,
+    )
+    plain = TransformerBackend(family, cfg, stacked, use_flash=False, **common)
+    mesh = make_mesh((tp_size,), ("tp",))
+    tp = TransformerBackend(family, cfg, stacked, mesh=mesh, use_flash=True, **common)
+    assert tp.use_flash, "mesh must no longer disable flash"
+
+    calls = {"n": 0}
+    real = attention_mod._flash_sharded
+
+    def spy(*args, **kwargs):
+        calls["n"] += 1
+        return real(*args, **kwargs)
+
+    rng = np.random.RandomState(0)
+    hidden = rng.randn(1, 16, cfg.hidden_size).astype(np.float32)
+
+    def alloc(backend):
+        # kv buffer length must be a multiple of 128 for the kernel
+        kd, vd = backend.cache_descriptors(1, 128, 0, backend.n_blocks)
+        return kd.make_zeros(), vd.make_zeros()
+
+    with mock.patch.object(attention_mod, "_flash_sharded", side_effect=spy):
+        kv_p, kv_t = alloc(plain), alloc(tp)
+        out_p, kv_p = plain.inference_step(hidden, kv_p, 0)
+        out_t, kv_t = tp.inference_step(hidden, kv_t, 0)
+        assert calls["n"] > 0, "the sharded flash path must actually trace"
+    np.testing.assert_allclose(np.asarray(out_t), np.asarray(out_p), atol=1e-4, rtol=0)
+
+    # decode (q_len == 1) still goes through the XLA path under TP and matches
+    nxt = rng.randn(1, 1, cfg.hidden_size).astype(np.float32)
+    out_p, kv_p = plain.inference_step(nxt, kv_p, 16)
+    out_t, kv_t = tp.inference_step(nxt, kv_t, 16)
+    np.testing.assert_allclose(np.asarray(out_t), np.asarray(out_p), atol=1e-4, rtol=0)
+
+
 def test_tp_quantized_server_end_to_end(tmp_path):
     """An NF4 TP=2 server through the full client stack (the previously-
     rejected combination). NF4 is lossy, so like test_quantized_server_generates
