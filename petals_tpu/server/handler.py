@@ -37,6 +37,7 @@ from petals_tpu.server.task_queue import (
 )
 from petals_tpu.utils.logging import get_logger
 from petals_tpu.utils.misc import is_dummy
+from petals_tpu.utils.tracing import device_annotation, get_tracer
 
 logger = get_logger(__name__)
 
@@ -186,14 +187,22 @@ class TransformerHandler:
             )
         backend = self._sub_backend(start, end)
         adapter = payload.get("active_adapter")
-        out = await asyncio.wait_for(
-            self.queue.submit(
-                lambda: np.asarray(backend.forward(hidden, prompts=prompts, active_adapter=adapter)),
-                priority=PRIORITY_TRAINING,
-                size=hidden.shape[0] * hidden.shape[1],
-            ),
-            self.request_timeout,
-        )
+        def run_forward():
+            with device_annotation("rpc_forward"):  # on the compute thread
+                return np.asarray(backend.forward(hidden, prompts=prompts, active_adapter=adapter))
+
+        with get_tracer().span(
+            "rpc_forward", annotate=False, blocks=end - start,
+            tokens=hidden.shape[0] * hidden.shape[1],
+        ):
+            out = await asyncio.wait_for(
+                self.queue.submit(
+                    run_forward,
+                    priority=PRIORITY_TRAINING,
+                    size=hidden.shape[0] * hidden.shape[1],
+                ),
+                self.request_timeout,
+            )
         return {"tensors": {"hidden": serialize_array(out, reply_comp)}}
 
     async def rpc_backward(self, payload, ctx: RpcContext):
@@ -217,19 +226,24 @@ class TransformerHandler:
         adapter = payload.get("active_adapter")
 
         def run():
-            grad_hidden, grad_prompts = backend.backward(
-                hidden, grad_out, prompts=prompts, active_adapter=adapter
-            )
+            with device_annotation("rpc_backward"):
+                grad_hidden, grad_prompts = backend.backward(
+                    hidden, grad_out, prompts=prompts, active_adapter=adapter
+                )
             return np.asarray(grad_hidden), (
                 np.asarray(grad_prompts) if grad_prompts is not None else None
             )
 
-        grad_hidden, grad_prompts = await asyncio.wait_for(
-            self.queue.submit(
-                run, priority=PRIORITY_TRAINING, size=hidden.shape[0] * hidden.shape[1]
-            ),
-            self.request_timeout,
-        )
+        with get_tracer().span(
+            "rpc_backward", annotate=False, blocks=end - start,
+            tokens=hidden.shape[0] * hidden.shape[1],
+        ):
+            grad_hidden, grad_prompts = await asyncio.wait_for(
+                self.queue.submit(
+                    run, priority=PRIORITY_TRAINING, size=hidden.shape[0] * hidden.shape[1]
+                ),
+                self.request_timeout,
+            )
         tensors = {"grad_hidden": serialize_array(grad_hidden, reply_comp)}
         if grad_prompts is not None:
             tensors["grad_prompts"] = serialize_array(grad_prompts, reply_comp)
@@ -244,6 +258,7 @@ class TransformerHandler:
             first_block=self.backend.first_block,
             n_blocks=self.backend.n_blocks,
             dht_prefix=self.dht_prefix,
+            tracing=get_tracer().summary(),
         )
         return info
 
@@ -319,18 +334,23 @@ class TransformerHandler:
                 pos = position
 
                 def run_step():
-                    out, new_kv = backend.inference_step(
-                        hidden, kv, pos, prompts=prompts, hypo_ids=hypo_ids,
-                        active_adapter=active_adapter,
-                    )
+                    with device_annotation("inference_step"):
+                        out, new_kv = backend.inference_step(
+                            hidden, kv, pos, prompts=prompts, hypo_ids=hypo_ids,
+                            active_adapter=active_adapter,
+                        )
                     return np.asarray(out), new_kv
 
-                out, kv = await asyncio.wait_for(
-                    self.queue.submit(
-                        run_step, priority=PRIORITY_INFERENCE, size=batch_size * seq
-                    ),
-                    self.step_timeout,
-                )
+                with get_tracer().span(
+                    "inference_step", annotate=False,
+                    blocks=end - start, batch=batch_size, seq=seq,
+                ):
+                    out, kv = await asyncio.wait_for(
+                        self.queue.submit(
+                            run_step, priority=PRIORITY_INFERENCE, size=batch_size * seq
+                        ),
+                        self.step_timeout,
+                    )
                 # keep the allocator's view coherent (old buffers were donated)
                 self.memory_cache.update_cache(handles[0], kv[0])
                 self.memory_cache.update_cache(handles[1], kv[1])

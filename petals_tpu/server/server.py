@@ -215,6 +215,21 @@ class Server:
         # them in within one update_period
         self._ping_aggregator = PingAggregator(self.dht.pool)
 
+        from petals_tpu.utils.tracing import (
+            start_jax_trace,
+            stop_jax_trace,
+            trace_window_seconds,
+        )
+
+        if start_jax_trace() is not None:  # active only with PETALS_TPU_TRACE_DIR
+            # bounded window: the profiler buffers until stop, so an open-ended
+            # capture on a long-running server would grow host memory forever
+            async def _flush_trace():
+                await asyncio.sleep(trace_window_seconds())
+                stop_jax_trace()
+
+            asyncio.create_task(_flush_trace())
+
         self._state = ServerState.ONLINE
         await self._announce(ServerState.ONLINE)
         self._announcer_task = asyncio.create_task(self._announce_loop())
@@ -243,6 +258,9 @@ class Server:
             await self._announce(ServerState.OFFLINE, expiration=dht_time() + 60)
         except Exception:
             pass
+        from petals_tpu.utils.tracing import stop_jax_trace
+
+        stop_jax_trace()
         if self.handler is not None:
             self.handler.shutdown()
         if self.dht is not None:

@@ -87,7 +87,7 @@ def get_server_throughput(
             n_steps_inference=n_steps_inference, n_steps_forward=n_steps_forward,
         )
         info["network_rps"] = measure_network_rps(cfg.hidden_size, network_mbps=network_mbps)
-        if num_devices <= 1 or len(jax.devices()) >= num_devices:
+        if not info.pop("degraded", False):
             cache[cache_key] = info
             _write_cache(cache_path, cache)
         else:
@@ -131,12 +131,14 @@ def measure_compute_rps(
     stacked = jax.tree_util.tree_map(lambda x: x[None] if hasattr(x, "ndim") else x, params)
 
     mesh = None
+    degraded = False
     if num_devices > 1:
         if len(jax.devices()) >= num_devices:
             from petals_tpu.parallel.mesh import tp_mesh
 
             mesh = tp_mesh(num_devices)
         else:
+            degraded = True  # callers must not cache this as the TP number
             logger.warning(
                 f"Measuring throughput for num_devices={num_devices} on "
                 f"{len(jax.devices())} device(s): figure is a single-device estimate"
@@ -171,7 +173,7 @@ def measure_compute_rps(
         f"forward {forward_rps:.0f} tok/s per block"
         + (f" (tp={num_devices})" if mesh is not None else "")
     )
-    return {"inference_rps": inference_rps, "forward_rps": forward_rps}
+    return {"inference_rps": inference_rps, "forward_rps": forward_rps, "degraded": degraded}
 
 
 def measure_network_rps(hidden_size: int, *, network_mbps: Optional[float] = None) -> float:

@@ -1,0 +1,161 @@
+"""Tracing & profiling hooks (SURVEY §5.1 — the reference sets a low bar
+here: env-var log levels only, src/petals/utils/logging.py. This build adds
+per-RPC duration spans with aggregates, plus jax profiler integration so a
+device timeline can be captured on demand).
+
+Two layers:
+- host spans: ``tracer.span("rpc_forward", tokens=...)`` records wall time +
+  metadata into a bounded ring; ``tracer.summary()`` gives per-name
+  count/p50/p95/total for rpc_info and logs. Each span also emits a
+  ``jax.profiler.TraceAnnotation`` so the host block shows up aligned with
+  device ops when a jax trace is being captured.
+- device timeline: ``start_jax_trace(logdir)`` / ``stop_jax_trace()`` wrap
+  ``jax.profiler`` (served via ``PETALS_TPU_TRACE_DIR`` at server startup;
+  view in TensorBoard/XProf).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Dict, Optional
+
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+TRACE_DIR_ENV = "PETALS_TPU_TRACE_DIR"
+TRACE_SECONDS_ENV = "PETALS_TPU_TRACE_SECONDS"
+DEFAULT_TRACE_SECONDS = 60.0  # jax.profiler buffers until stop: bound the window
+_MAX_SPANS = 2048  # ring bound: tracing must never grow server memory
+_MAX_DURATIONS_PER_NAME = 4096
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    start: float  # time.time()
+    duration: float  # seconds
+    meta: dict
+
+
+class Tracer:
+    """Thread-safe span recorder with bounded memory."""
+
+    def __init__(self, max_spans: int = _MAX_SPANS):
+        self._spans: deque = deque(maxlen=max_spans)
+        self._durations: Dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=_MAX_DURATIONS_PER_NAME)
+        )
+        self._counts: Dict[str, int] = defaultdict(int)
+        self._totals: Dict[str, float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def span(self, name: str, annotate: bool = True, **meta):
+        """Record one timed span; with ``annotate`` it also marks the jax
+        profiler timeline. Pass ``annotate=False`` when the span wraps an
+        ``await`` on the event loop (concurrent spans would interleave
+        non-LIFO there) and put ``device_annotation(name)`` around the actual
+        compute on its worker thread instead."""
+        annotation = device_annotation(name) if annotate else contextlib.nullcontext()
+        t_wall = time.time()
+        t0 = time.perf_counter()
+        try:
+            with annotation:
+                yield
+        finally:
+            duration = time.perf_counter() - t0
+            with self._lock:
+                self._spans.append(Span(name, t_wall, duration, meta))
+                self._durations[name].append(duration)
+                self._counts[name] += 1
+                self._totals[name] += duration
+
+    def recent(self, limit: int = 100) -> list:
+        with self._lock:
+            return list(self._spans)[-limit:]
+
+    def summary(self) -> Dict[str, dict]:
+        """Per-span-name aggregates (msgpack-safe, for rpc_info / logs)."""
+        out = {}
+        with self._lock:
+            for name, durations in self._durations.items():
+                if not durations:
+                    continue
+                ordered = sorted(durations)
+                out[name] = {
+                    "count": self._counts[name],
+                    "p50_ms": round(ordered[len(ordered) // 2] * 1e3, 3),
+                    "p95_ms": round(ordered[int(len(ordered) * 0.95)] * 1e3, 3),
+                    "total_s": round(self._totals[name], 3),
+                }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._durations.clear()
+            self._counts.clear()
+            self._totals.clear()
+
+
+def device_annotation(name: str):
+    """A jax profiler TraceAnnotation (no-op when the profiler is absent) —
+    place it around the compute itself, on the thread that runs it."""
+    try:
+        import jax.profiler
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # profiler unavailable: spans still record wall time
+        return contextlib.nullcontext()
+
+
+_global_tracer: Optional[Tracer] = None
+_tracing_active = False
+
+
+def get_tracer() -> Tracer:
+    global _global_tracer
+    if _global_tracer is None:
+        _global_tracer = Tracer()
+    return _global_tracer
+
+
+def start_jax_trace(logdir: Optional[str] = None) -> Optional[str]:
+    """Begin capturing a jax device/host trace (TensorBoard/XProf format).
+    Uses ``PETALS_TPU_TRACE_DIR`` when ``logdir`` is not given; no-op (None)
+    when neither is set."""
+    global _tracing_active
+    logdir = logdir or os.environ.get(TRACE_DIR_ENV)
+    if not logdir or _tracing_active:
+        return None
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    _tracing_active = True
+    logger.info(f"jax trace capturing to {logdir}")
+    return logdir
+
+
+def stop_jax_trace() -> None:
+    global _tracing_active
+    if not _tracing_active:
+        return
+    import jax
+
+    jax.profiler.stop_trace()
+    _tracing_active = False
+    logger.info("jax trace stopped")
+
+
+def trace_window_seconds() -> float:
+    """How long a server-startup capture should run before being flushed:
+    jax.profiler buffers events until stop_trace, so an unbounded capture on
+    a long-running server grows host memory without limit."""
+    value = os.environ.get(TRACE_SECONDS_ENV, "").strip()
+    return float(value) if value else DEFAULT_TRACE_SECONDS

@@ -1,0 +1,83 @@
+"""Tracing spans (SURVEY §5.1): bounded recording, aggregates, and the
+per-RPC spans surfaced through rpc_info."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from petals_tpu.utils.tracing import Tracer, get_tracer
+
+
+def test_tracer_records_and_aggregates():
+    tracer = Tracer()
+    for i in range(10):
+        with tracer.span("op_a", idx=i):
+            time.sleep(0.001)
+    with tracer.span("op_b"):
+        pass
+    summary = tracer.summary()
+    assert summary["op_a"]["count"] == 10
+    assert summary["op_a"]["p50_ms"] >= 1.0
+    assert summary["op_a"]["p95_ms"] >= summary["op_a"]["p50_ms"]
+    assert summary["op_b"]["count"] == 1
+    recent = tracer.recent(5)
+    assert len(recent) == 5 and recent[-1].name == "op_b"
+    assert recent[0].meta == {"idx": 6}
+
+
+def test_tracer_span_records_on_exception():
+    tracer = Tracer()
+    with pytest.raises(ValueError):
+        with tracer.span("failing"):
+            raise ValueError("boom")
+    assert tracer.summary()["failing"]["count"] == 1
+
+
+def test_tracer_memory_is_bounded():
+    tracer = Tracer(max_spans=16)
+    for i in range(100):
+        with tracer.span("spin"):
+            pass
+    assert len(tracer.recent(1000)) == 16
+    assert tracer.summary()["spin"]["count"] == 100  # counts keep the truth
+
+
+def test_rpc_info_exposes_tracing(tmp_path):
+    """A live server's rpc_info carries span aggregates for its RPCs."""
+    import jax.numpy as jnp
+
+    from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
+    from petals_tpu.rpc import RpcClient
+    from petals_tpu.rpc.serialization import serialize_array
+    from petals_tpu.server.server import Server, default_dht_prefix
+    from tests.utils import make_tiny_llama
+
+    path = make_tiny_llama(str(tmp_path))
+    get_tracer().reset()
+
+    async def main():
+        server = Server(path, compute_dtype=jnp.float32, use_flash=False)
+        await server.start()
+        try:
+            client = await RpcClient.connect(server.rpc_server.host, server.rpc_server.port)
+            prefix = default_dht_prefix(path)
+            uids = CHAIN_DELIMITER.join(
+                make_uid(prefix, i) for i in range(server.cfg.num_hidden_layers)
+            )
+            hidden = np.random.RandomState(0).randn(1, 4, server.cfg.hidden_size).astype(np.float32)
+            await client.call(
+                "ptu.forward",
+                {"uids": uids, "tensors": {"hidden": serialize_array(hidden)}},
+                timeout=60,
+            )
+            info = await client.call("ptu.info", {}, timeout=10)
+            await client.close()
+            return info
+        finally:
+            await server.shutdown()
+
+    info = asyncio.run(main())
+    assert info["tracing"]["rpc_forward"]["count"] >= 1
+    assert info["tracing"]["rpc_forward"]["p50_ms"] > 0
