@@ -69,7 +69,9 @@ class RemoteSequenceManager:
         self.dht = dht
         self.directory = ModuleDirectory(dht)
         self.state = RemoteSequenceInfo.make_empty(self.block_uids)
-        self.pool = ConnectionPool(own_peer_id=dht.peer_id, connect_timeout=config.connect_timeout)
+        # the client's inference-plane pool authenticates with the DHT node's
+        # identity: servers see a proven id and prove theirs back
+        self.pool = ConnectionPool(identity=dht.identity, connect_timeout=config.connect_timeout)
         self._peer_infos: Dict[PeerID, object] = {}  # peer -> latest ServerInfo
         if rtt_fn is None:
             from petals_tpu.utils.ping import PingAggregator

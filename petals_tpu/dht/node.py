@@ -56,7 +56,7 @@ class DHTNode:
         host: str = "127.0.0.1",
         port: int = 0,
         initial_peers: Sequence[Union[str, PeerAddr]] = (),
-        peer_id: Optional[PeerID] = None,
+        identity=None,  # dht.identity.Identity (keypair); peer id = hash(pubkey)
         identity_seed: Optional[bytes] = None,
         client_mode: bool = False,
         bucket_size: int = DEFAULT_BUCKET_SIZE,
@@ -66,9 +66,13 @@ class DHTNode:
         request_timeout: float = 5.0,
         maintenance_period: float = 30.0,
     ) -> "DHTNode":
+        from petals_tpu.dht.identity import Identity
+
         self = object.__new__(cls)
-        if peer_id is None:
-            peer_id = PeerID.from_seed(identity_seed) if identity_seed else PeerID.generate()
+        if identity is None:
+            identity = Identity.from_seed(identity_seed) if identity_seed else Identity.generate()
+        self.identity = identity
+        peer_id = identity.peer_id
         self.peer_id = peer_id
         self.client_mode = client_mode
         self.replication = replication
@@ -76,14 +80,14 @@ class DHTNode:
         self.request_timeout = request_timeout
         self.table = RoutingTable(peer_id, bucket_size)
         self.storage = DHTStorage()
-        self.pool = ConnectionPool(own_peer_id=peer_id)
+        self.pool = ConnectionPool(identity=identity)
         self._owns_server = rpc_server is None and not client_mode
         self._maintenance_task: Optional[asyncio.Task] = None
 
         if client_mode:
             self.server = None
         else:
-            self.server = rpc_server or RpcServer(peer_id=peer_id, host=host, port=port)
+            self.server = rpc_server or RpcServer(identity=identity, host=host, port=port)
             self._register_handlers(self.server)
             if self._owns_server:
                 await self.server.start()
@@ -113,7 +117,12 @@ class DHTNode:
         entry = [kid.hex(), subkey, value, expiration_time]
         ok_any = False
         if self._stores_locally(kid, nearest):
-            ok_any |= self.storage.store(kid, value, expiration_time, subkey)
+            from petals_tpu.dht.identity import verify_announcement
+
+            # same rule as _handle_store: subkey records enter ANY storage
+            # (ours included) only with a valid signature from the subkey owner
+            if subkey is None or verify_announcement(value, subkey, expiration_time):
+                ok_any |= self.storage.store(kid, value, expiration_time, subkey)
         results = await asyncio.gather(
             *(self._rpc_store(addr, [entry]) for addr in nearest), return_exceptions=True
         )
@@ -279,8 +288,17 @@ class DHTNode:
 
     async def _handle_store(self, payload, ctx: RpcContext):
         self._note_sender(payload)
+        from petals_tpu.dht.identity import verify_announcement
+
         ok = []
         for kid_hex, subkey, value, expiration in payload["entries"]:
+            # per-peer subkey records must be SIGNED by the subkey's keyholder
+            # (hivemind RSASignatureValidator semantics): an unsigned or
+            # mis-signed record cannot overwrite another peer's announcements
+            if subkey is not None and not verify_announcement(value, subkey, float(expiration)):
+                logger.debug(f"Rejecting unsigned/invalid subkey record for {subkey!r}")
+                ok.append(False)
+                continue
             ok.append(self.storage.store(bytes.fromhex(kid_hex), value, float(expiration), subkey))
         return {"ok": ok}
 

@@ -73,24 +73,88 @@ class StreamCall:
 
 class RpcClient:
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
-                 peer_id: Optional[PeerID] = None):
+                 peer_id: Optional[PeerID] = None, identity=None):
+        import secrets
+
         self._reader, self._writer = reader, writer
-        self._peer_id = peer_id
+        self._identity = identity
+        self._peer_id = identity.peer_id if identity is not None else peer_id
+        self._nonce = secrets.token_bytes(16)
         self._write_lock = asyncio.Lock()
         self._call_ids = itertools.count()
         self._pending: dict = {}  # call_id -> Future (unary)
         self._streams: dict = {}  # call_id -> StreamCall
         self._closed = False
+        # set ONLY once the server PROVES the id by signing our nonce with the
+        # key whose hash is the id — an unauthenticated hello proves nothing
         self.remote_peer_id: Optional[PeerID] = None
+        self._server_pub: Optional[bytes] = None
+        self._server_nonce: Optional[bytes] = None
+        self._server_claimed: Optional[PeerID] = None
+        # set once the server's hello is processed (and our auth proof sent):
+        # connect() waits on it so our first request never overtakes the proof
+        self._handshake_done = asyncio.Event()
         self._loop_task = asyncio.create_task(self._read_loop())
+
+    async def _on_server_hello(self, msg) -> None:
+        self._server_pub = bytes.fromhex(msg["pub"]) if msg.get("pub") else None
+        self._server_nonce = bytes.fromhex(msg["nonce"]) if msg.get("nonce") else None
+        self._server_claimed = (
+            PeerID.from_string(msg["peer_id"]) if msg.get("peer_id") else None
+        )
+        if (
+            self._identity is not None
+            and self._server_pub is not None
+            and self._server_nonce is not None
+        ):
+            from petals_tpu.dht.identity import hello_challenge_message
+
+            sig = self._identity.sign(
+                hello_challenge_message(
+                    self._identity.public_bytes, self._server_pub, self._server_nonce
+                )
+            )
+            await self._send({"t": "auth", "sig": sig.hex()})
+        self._handshake_done.set()
+
+    def _on_server_auth(self, msg) -> None:
+        """The server's proof: its signature over OUR public key and nonce."""
+        from petals_tpu.dht import identity as ident
+
+        if self._server_pub is None or self._identity is None:
+            return
+        try:
+            sig = bytes.fromhex(msg.get("sig") or "")
+        except ValueError:
+            return
+        message = ident.hello_challenge_message(
+            self._server_pub, self._identity.public_bytes, self._nonce
+        )
+        if not ident.verify(self._server_pub, sig, message):
+            return
+        proven = ident.peer_id_of(self._server_pub)
+        if self._server_claimed is None or proven == self._server_claimed:
+            self.remote_peer_id = proven
 
     @classmethod
     async def connect(
-        cls, host: str, port: int, *, peer_id: Optional[PeerID] = None, timeout: float = 10.0
+        cls, host: str, port: int, *, peer_id: Optional[PeerID] = None,
+        identity=None, timeout: float = 10.0,
     ) -> "RpcClient":
         reader, writer = await asyncio.wait_for(asyncio.open_connection(host, port), timeout)
-        client = cls(reader, writer, peer_id)
-        await client._send({"t": "hello", "peer_id": peer_id.to_string() if peer_id else None})
+        client = cls(reader, writer, peer_id, identity)
+        hello = {"t": "hello", "peer_id": client._peer_id.to_string() if client._peer_id else None}
+        if identity is not None:
+            hello["pub"] = identity.public_bytes.hex()
+            hello["nonce"] = client._nonce.hex()
+        await client._send(hello)
+        try:
+            await asyncio.wait_for(client._handshake_done.wait(), timeout)
+        except asyncio.TimeoutError:
+            await client.close()
+            raise
+        if client._closed:
+            raise RpcError("Connection closed during handshake")
         return client
 
     async def _send(self, message: Any) -> None:
@@ -130,8 +194,9 @@ class RpcClient:
                 msg = await read_frame(self._reader)
                 kind = msg.get("t")
                 if kind == "hello":
-                    if msg.get("peer_id"):
-                        self.remote_peer_id = PeerID.from_string(msg["peer_id"])
+                    await self._on_server_hello(msg)
+                elif kind == "auth":
+                    self._on_server_auth(msg)
                 elif kind == "resp":
                     call_id = msg["id"]
                     if msg.get("ok"):
@@ -165,6 +230,9 @@ class RpcClient:
             error = RpcError(f"Client read loop crashed: {e}")
         finally:
             self._closed = True
+            # unblock connect(): a connection that died mid-handshake should
+            # fail immediately (connect checks _closed), not wait out the timeout
+            self._handshake_done.set()
             for future in self._pending.values():
                 if not future.done():
                     future.set_exception(error)

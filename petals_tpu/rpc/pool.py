@@ -15,8 +15,14 @@ logger = get_logger(__name__)
 
 
 class ConnectionPool:
-    def __init__(self, own_peer_id: Optional[PeerID] = None, connect_timeout: float = 10.0):
-        self.own_peer_id = own_peer_id
+    def __init__(
+        self,
+        own_peer_id: Optional[PeerID] = None,
+        connect_timeout: float = 10.0,
+        identity=None,  # dht.identity.Identity: proves our peer id in hellos
+    ):
+        self.identity = identity
+        self.own_peer_id = identity.peer_id if identity is not None else own_peer_id
         self.connect_timeout = connect_timeout
         self._clients: Dict[Tuple[str, int], RpcClient] = {}
         self._locks: Dict[Tuple[str, int], asyncio.Lock] = {}
@@ -29,7 +35,8 @@ class ConnectionPool:
             if client is not None and not client._closed:
                 return client
             client = await RpcClient.connect(
-                host, port, peer_id=self.own_peer_id, timeout=self.connect_timeout
+                host, port, peer_id=self.own_peer_id, identity=self.identity,
+                timeout=self.connect_timeout,
             )
             self._clients[key] = client
             return client

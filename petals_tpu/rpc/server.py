@@ -42,8 +42,15 @@ StreamHandler = Callable[[AsyncIterator[Any], RpcContext], AsyncIterator[Any]]
 
 
 class RpcServer:
-    def __init__(self, peer_id: Optional[PeerID] = None, host: str = "127.0.0.1", port: int = 0):
-        self.peer_id = peer_id
+    def __init__(
+        self,
+        peer_id: Optional[PeerID] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        identity=None,  # dht.identity.Identity: enables authenticated hellos
+    ):
+        self.identity = identity
+        self.peer_id = identity.peer_id if identity is not None else peer_id
         self.host, self._requested_port = host, port
         self._unary: Dict[str, UnaryHandler] = {}
         self._stream: Dict[str, StreamHandler] = {}
@@ -94,18 +101,66 @@ class RpcServer:
             remote_peer_id=None,
             remote_addr=writer.get_extra_info("peername") or ("?", 0),
         )
+        import secrets
+
+        our_nonce = secrets.token_bytes(16)
+        client_pub: Optional[bytes] = None
+        client_claimed: Optional[PeerID] = None
         try:
-            await write_frame(
-                writer,
-                {"t": "hello", "peer_id": self.peer_id.to_string() if self.peer_id else None},
-                write_lock,
-            )
+            hello = {"t": "hello", "peer_id": self.peer_id.to_string() if self.peer_id else None}
+            if self.identity is not None:
+                hello["pub"] = self.identity.public_bytes.hex()
+                hello["nonce"] = our_nonce.hex()
+            await write_frame(writer, hello, write_lock)
             while True:
                 msg = await read_frame(reader)
                 kind = msg.get("t")
                 if kind == "hello":
-                    if msg.get("peer_id"):
-                        ctx.remote_peer_id = PeerID.from_string(msg["peer_id"])
+                    # claims are recorded but remote_peer_id is set ONLY after
+                    # a valid "auth" proof — hello alone cannot impersonate
+                    client_pub = bytes.fromhex(msg["pub"]) if msg.get("pub") else None
+                    client_claimed = (
+                        PeerID.from_string(msg["peer_id"]) if msg.get("peer_id") else None
+                    )
+                    if (
+                        self.identity is not None
+                        and client_pub is not None
+                        and msg.get("nonce")
+                    ):
+                        # prove OUR identity to the client: sign its nonce,
+                        # with our own key bound into the message
+                        from petals_tpu.dht.identity import hello_challenge_message
+
+                        sig = self.identity.sign(
+                            hello_challenge_message(
+                                self.identity.public_bytes,
+                                client_pub,
+                                bytes.fromhex(msg["nonce"]),
+                            )
+                        )
+                        await write_frame(writer, {"t": "auth", "sig": sig.hex()}, write_lock)
+                elif kind == "auth":
+                    from petals_tpu.dht import identity as ident
+
+                    if self.identity is None or client_pub is None:
+                        continue
+                    try:
+                        sig = bytes.fromhex(msg.get("sig") or "")
+                    except ValueError:
+                        sig = b""
+                    message = ident.hello_challenge_message(
+                        client_pub, self.identity.public_bytes, our_nonce
+                    )
+                    proven = ident.peer_id_of(client_pub)
+                    if ident.verify(client_pub, sig, message) and (
+                        client_claimed is None or proven == client_claimed
+                    ):
+                        ctx.remote_peer_id = proven
+                    else:
+                        logger.warning(
+                            f"Rejecting peer {ctx.remote_addr}: invalid identity proof"
+                        )
+                        break  # close the connection
                 elif kind == "req":
                     call_tasks[msg["id"]] = asyncio.create_task(
                         self._run_unary(msg, ctx, writer, write_lock, call_tasks)

@@ -54,6 +54,7 @@ class TransformerHandler:
         session_timeout: float = 30 * 60,
         step_timeout: float = 5 * 60,
         compression: CompressionType = CompressionType.NONE,
+        identity=None,  # authenticates the server->server push plane
     ):
         self.backend = backend
         self.dht_prefix = dht_prefix
@@ -71,7 +72,7 @@ class TransformerHandler:
         self._push_queues: Dict[str, asyncio.Queue] = {}
         from petals_tpu.rpc.pool import ConnectionPool
 
-        self._push_pool = ConnectionPool()
+        self._push_pool = ConnectionPool(identity=identity)
         self._push_tasks: set = set()
 
     def register(self, server: RpcServer) -> None:

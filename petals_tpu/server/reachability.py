@@ -24,19 +24,33 @@ class ReachabilityProtocol:
     """Registers ``reach.check`` on a node's RPC server: the callee dials the
     requested address back and reports success."""
 
-    def __init__(self, *, probe_timeout: float = 5.0):
+    def __init__(self, *, probe_timeout: float = 5.0, identity=None):
         self.probe_timeout = probe_timeout
+        # probing WITH an identity makes the target prove ITS identity back,
+        # so a stale host:port reused by a different peer is detected
+        self.identity = identity
 
     def register(self, server: RpcServer) -> None:
+        if self.identity is None:
+            self.identity = server.identity
         server.add_unary_handler("reach.check", self.rpc_check)
 
     async def rpc_check(self, payload, ctx: RpcContext):
         addr = PeerAddr.from_string(payload["addr"])
         try:
             client = await asyncio.wait_for(
-                RpcClient.connect(addr.host, addr.port), self.probe_timeout
+                RpcClient.connect(addr.host, addr.port, identity=self.identity),
+                self.probe_timeout,
             )
-            ok = client.remote_peer_id == addr.peer_id or client.remote_peer_id is None
+            if self.identity is not None:
+                # authenticated probe: the endpoint must PROVE the claimed id
+                for _ in range(20):
+                    if client.remote_peer_id is not None:
+                        break
+                    await asyncio.sleep(0.05)
+                ok = client.remote_peer_id == addr.peer_id
+            else:
+                ok = client.remote_peer_id == addr.peer_id or client.remote_peer_id is None
             await client.close()
             return {"reachable": bool(ok)}
         except Exception as e:

@@ -129,19 +129,23 @@ class Server:
         # cost server->server hops (reference server.py:717-751)
         self._next_pings: dict = {}
         self._ping_aggregator = None
+        self._trace_flush_task: Optional[asyncio.Task] = None
 
     # ------------------------------------------------------------------ lifecycle
 
     async def start(self) -> None:
-        peer_id = (
-            PeerID.from_seed(self.identity_seed) if self.identity_seed else PeerID.generate()
+        from petals_tpu.dht.identity import Identity
+
+        identity = (
+            Identity.from_seed(self.identity_seed) if self.identity_seed else Identity.generate()
         )
-        self.rpc_server = RpcServer(peer_id=peer_id, host=self.host, port=self.port)
+        peer_id = identity.peer_id
+        self.rpc_server = RpcServer(identity=identity, host=self.host, port=self.port)
         # Start listening BEFORE the DHT bootstraps: the node advertises its
         # own (host, port) to peers during bootstrap.
         await self.rpc_server.start()
         self.dht = await DHTNode.create(
-            peer_id=peer_id,
+            identity=identity,
             rpc_server=self.rpc_server,
             initial_peers=self.initial_peers,
         )
@@ -204,6 +208,7 @@ class Server:
             dht_prefix=self.dht_prefix,
             memory_cache=self.memory_cache,
             server_info_fn=lambda: dataclasses.asdict(self._server_info(ServerState.ONLINE)),
+            identity=identity,
         )
         self.handler.register(self.rpc_server)
 
@@ -228,7 +233,9 @@ class Server:
                 await asyncio.sleep(trace_window_seconds())
                 stop_jax_trace()
 
-            asyncio.create_task(_flush_trace())
+            # keep a strong ref: asyncio holds tasks weakly, and a collected
+            # flush task would mean the capture never stops
+            self._trace_flush_task = asyncio.create_task(_flush_trace())
 
         self._state = ServerState.ONLINE
         await self._announce(ServerState.ONLINE)
@@ -260,6 +267,8 @@ class Server:
             pass
         from petals_tpu.utils.tracing import stop_jax_trace
 
+        if self._trace_flush_task is not None:
+            self._trace_flush_task.cancel()
         stop_jax_trace()
         if self.handler is not None:
             self.handler.shutdown()

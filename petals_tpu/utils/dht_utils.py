@@ -33,12 +33,26 @@ async def declare_active_modules(
     expiration_time: float,
     contact_addr: Optional[PeerAddr] = None,
 ) -> int:
-    """Announce that this peer serves ``uids``; returns how many records stored."""
+    """Announce that this peer serves ``uids``; returns how many records stored.
+
+    Every record is SIGNED by the node's identity over (uid, subkey, payload,
+    expiration), and storers/readers verify — a peer can only write under its
+    own subkey (hivemind RSASignatureValidator semantics)."""
+    from petals_tpu.dht.identity import sign_announcement
+
     contact = (contact_addr or dht.own_addr).to_wire() if (contact_addr or dht.own_addr) else None
-    value = {"info": list(server_info.to_tuple()), "addr": contact}
+    payload = {"info": list(server_info.to_tuple()), "addr": contact}
     subkey = dht.peer_id.to_string()
     results = await asyncio.gather(
-        *(dht.store(uid, value, expiration_time, subkey=subkey) for uid in uids)
+        *(
+            dht.store(
+                uid,
+                sign_announcement(dht.identity, uid, payload, expiration_time),
+                expiration_time,
+                subkey=subkey,
+            )
+            for uid in uids
+        )
     )
     return sum(bool(r) for r in results)
 
@@ -53,6 +67,8 @@ async def get_remote_module_infos(
 
     Returns (infos, addr_book): infos[i] is a RemoteModuleInfo or None;
     addr_book maps peer ids to their announced contact addresses."""
+    from petals_tpu.dht.identity import verify_announcement
+
     records = await asyncio.gather(*(dht.get(uid) for uid in uids))
     out: List[Optional[RemoteModuleInfo]] = []
     addr_book: Dict[PeerID, PeerAddr] = {}
@@ -61,16 +77,22 @@ async def get_remote_module_infos(
             out.append(None)
             continue
         servers: Dict[PeerID, ServerInfo] = {}
-        for subkey, (value, _expiration) in record[0].items():
+        for subkey, (value, expiration) in record[0].items():
             try:
+                # reader-side verification: a malicious DHT node could serve
+                # fabricated records even though honest storers reject them
+                if not verify_announcement(value, subkey, expiration) or value["uid"] != uid:
+                    logger.debug(f"Dropping unverified DHT entry for {uid} subkey {subkey!r}")
+                    continue
+                payload = value["payload"]
                 peer_id = PeerID.from_string(subkey)
-                info = ServerInfo.from_tuple(tuple(value["info"]))
+                info = ServerInfo.from_tuple(tuple(payload["info"]))
                 if active_adapter and active_adapter not in (info.adapters or ()):
                     logger.debug(f"Skipping {peer_id}: no adapter {active_adapter}")
                     continue
                 servers[peer_id] = info
-                if value.get("addr"):
-                    addr_book[peer_id] = PeerAddr.from_wire(value["addr"])
+                if payload.get("addr"):
+                    addr_book[peer_id] = PeerAddr.from_wire(payload["addr"])
             except (ValueError, KeyError, TypeError) as e:
                 logger.debug(f"Incorrect DHT entry for {uid} subkey {subkey!r}: {e}")
         out.append(RemoteModuleInfo(uid=uid, servers=servers) if servers else None)

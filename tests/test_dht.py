@@ -126,20 +126,71 @@ def test_store_get_across_swarm():
 
 def test_subkey_announcements_merge_across_swarm():
     """Two peers announce under the same key with different subkeys — readers
-    must see both (the pattern behind declare_active_modules)."""
+    must see both (the pattern behind declare_active_modules). Subkey records
+    must be SIGNED by the subkey's keyholder to be accepted."""
+    from petals_tpu.dht.identity import sign_announcement
 
     async def main():
         nodes = await _make_swarm(4)
         try:
             exp = dht_expiration(30)
-            await nodes[1].store("blocks.0", [2, 100.0], exp, subkey=nodes[1].peer_id.to_string())
-            await nodes[2].store("blocks.0", [2, 50.0], exp, subkey=nodes[2].peer_id.to_string())
+            for node, payload in ((nodes[1], [2, 100.0]), (nodes[2], [2, 50.0])):
+                await node.store(
+                    "blocks.0",
+                    sign_announcement(node.identity, "blocks.0", payload, exp),
+                    exp,
+                    subkey=node.peer_id.to_string(),
+                )
             record = await nodes[3].get("blocks.0")
             assert record is not None
             subkeys = record[0]
             assert nodes[1].peer_id.to_string() in subkeys
             assert nodes[2].peer_id.to_string() in subkeys
-            assert subkeys[nodes[1].peer_id.to_string()][0] == [2, 100.0]
+            assert subkeys[nodes[1].peer_id.to_string()][0]["payload"] == [2, 100.0]
+        finally:
+            await _shutdown(nodes)
+
+    run(main())
+
+
+def test_unsigned_or_forged_subkey_records_rejected():
+    """The swarm plane is authenticated (ADVICE.md): a peer cannot overwrite
+    another peer's announcements — unsigned subkey stores and records signed
+    by the WRONG key are rejected by honest storers."""
+    from petals_tpu.dht.identity import sign_announcement
+
+    async def main():
+        nodes = await _make_swarm(3)
+        try:
+            exp = dht_expiration(30)
+            victim = nodes[1]
+            attacker = nodes[2]
+            # 1) unsigned record under the victim's subkey: rejected remotely
+            ok = await attacker.store(
+                "blocks.0", {"fake": True}, exp, subkey=victim.peer_id.to_string()
+            )
+            # (local acceptance is irrelevant — attacker isn't in the lookup path
+            # for readers who verify; remote stores must all have failed)
+            record = await nodes[0].get("blocks.0")
+            if record is not None:
+                assert victim.peer_id.to_string() not in record[0]
+
+            # 2) record SIGNED BY THE ATTACKER but claiming the victim's subkey
+            forged = sign_announcement(attacker.identity, "blocks.0", {"fake": 2}, exp)
+            await attacker.store(
+                "blocks.0", forged, exp, subkey=victim.peer_id.to_string()
+            )
+            record = await nodes[0].get("blocks.0")
+            if record is not None:
+                assert victim.peer_id.to_string() not in record[0]
+
+            # 3) the honest signed record still lands
+            good = sign_announcement(victim.identity, "blocks.0", {"real": 1}, exp)
+            assert await victim.store(
+                "blocks.0", good, exp, subkey=victim.peer_id.to_string()
+            )
+            record = await nodes[0].get("blocks.0")
+            assert record is not None and victim.peer_id.to_string() in record[0]
         finally:
             await _shutdown(nodes)
 
@@ -201,10 +252,14 @@ def test_expired_record_disappears_from_swarm():
 
 
 def test_fixed_identity_from_seed():
+    from petals_tpu.dht.identity import Identity
+
     async def main():
         node = await DHTNode.create(identity_seed=b"bootstrap-1", maintenance_period=1000)
         try:
-            assert node.peer_id == PeerID.from_seed(b"bootstrap-1")
+            # ids are KEYPAIR-derived now: hash of the seed-derived public key
+            assert node.peer_id == Identity.from_seed(b"bootstrap-1").peer_id
+            assert node.peer_id != PeerID.from_seed(b"bootstrap-1")
         finally:
             await node.shutdown()
 

@@ -117,14 +117,19 @@ def test_serialize_jax_array():
 
 
 async def _make_pair(server: RpcServer):
+    from petals_tpu.dht.identity import Identity
+
     await server.start()
-    client = await RpcClient.connect("127.0.0.1", server.port, peer_id=PeerID.generate())
+    # authenticated client: ctx.remote_peer_id is set only for PROVEN ids
+    client = await RpcClient.connect("127.0.0.1", server.port, identity=Identity.generate())
     return client
 
 
 def test_unary_call_and_errors():
+    from petals_tpu.dht.identity import Identity
+
     async def main():
-        server = RpcServer(peer_id=PeerID.generate())
+        server = RpcServer(identity=Identity.generate())
 
         async def echo(payload, ctx):
             return {"echo": payload, "from": ctx.remote_peer_id.to_string()}
