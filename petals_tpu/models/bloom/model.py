@@ -24,16 +24,17 @@ CLIENT_PREFIXES = (
 )
 
 
-def hf_to_client_params(tensors: dict, cfg: BloomBlockConfig) -> dict:
+def _base_client_params(tensors: dict, cfg: BloomBlockConfig) -> dict:
+    """Embeddings + norms (no head) — shared by the LM and cls loaders."""
+
     def pick(*names):
         for name in names:
             if name in tensors:
                 return np.asarray(tensors[name])
         raise KeyError(f"None of {names} found in checkpoint")
 
-    embed = pick("transformer.word_embeddings.weight", "word_embeddings.weight")
     return {
-        "embed": embed,  # [vocab, hidden]
+        "embed": pick("transformer.word_embeddings.weight", "word_embeddings.weight"),
         "emb_ln_w": pick(
             "transformer.word_embeddings_layernorm.weight", "word_embeddings_layernorm.weight"
         ),
@@ -42,9 +43,14 @@ def hf_to_client_params(tensors: dict, cfg: BloomBlockConfig) -> dict:
         ),
         "ln_f_w": pick("transformer.ln_f.weight", "ln_f.weight"),
         "ln_f_b": pick("transformer.ln_f.bias", "ln_f.bias"),
-        # BLOOM ties the LM head to the embeddings
-        "head": np.ascontiguousarray(embed.T),
     }
+
+
+def hf_to_client_params(tensors: dict, cfg: BloomBlockConfig) -> dict:
+    params = _base_client_params(tensors, cfg)
+    # BLOOM ties the LM head to the embeddings
+    params["head"] = np.ascontiguousarray(params["embed"].T)
+    return params
 
 
 def client_embed(params: dict, input_ids, cfg: BloomBlockConfig):
@@ -61,6 +67,24 @@ def client_head(params: dict, hidden, cfg: BloomBlockConfig):
     )
 
 
+# -- sequence classification (reference models/bloom/model.py
+# DistributedBloomForSequenceClassification: score head over ln_f output)
+
+from petals_tpu.models.client_common import ln_f_cls_head, score_matrix  # noqa: E402
+
+CLS_PREFIXES = tuple(p for p in CLIENT_PREFIXES if p != "lm_head.") + ("score.",)
+
+
+def hf_to_cls_params(tensors: dict, cfg: BloomBlockConfig) -> dict:
+    params = _base_client_params(tensors, cfg)
+    params["score"] = score_matrix(tensors)
+    return params
+
+
+def cls_head(params: dict, hidden, cfg: BloomBlockConfig):
+    return ln_f_cls_head(params, hidden, cfg.layer_norm_epsilon)
+
+
 FAMILY = register_family(
     dataclasses.replace(
         block_mod.FAMILY,
@@ -68,5 +92,8 @@ FAMILY = register_family(
         hf_to_client_params=hf_to_client_params,
         client_embed=client_embed,
         client_head=client_head,
+        hf_cls_prefixes=CLS_PREFIXES,
+        hf_to_cls_params=hf_to_cls_params,
+        cls_head=cls_head,
     )
 )

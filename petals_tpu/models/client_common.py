@@ -60,3 +60,23 @@ def llama_style_cls_head(params: dict, hidden, cfg):
         params["score"].astype(jnp.float32),
         preferred_element_type=jnp.float32,
     )
+
+
+# -- shared pieces for LayerNorm-final families (bloom, falcon)
+
+def score_matrix(tensors: dict) -> np.ndarray:
+    """HF stores score as [num_labels, hidden]; we keep [hidden, num_labels]."""
+    return np.ascontiguousarray(np.asarray(tensors["score.weight"]).T)
+
+
+def ln_f_cls_head(params: dict, hidden, eps: float):
+    """Classification logits for families whose final norm is a LayerNorm
+    named ln_f (bloom/falcon): ln_f then the score projection."""
+    from petals_tpu.models.common import layer_norm
+
+    normed = layer_norm(jnp.asarray(hidden), params["ln_f_w"], params["ln_f_b"], eps)
+    return jnp.dot(
+        normed.astype(jnp.float32),
+        params["score"].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )

@@ -22,24 +22,29 @@ CLIENT_PREFIXES = (
 )
 
 
-def hf_to_client_params(tensors: dict, cfg: FalconBlockConfig) -> dict:
+def _base_client_params(tensors: dict, cfg: FalconBlockConfig) -> dict:
+    """Embeddings + final norm (no head) — shared by the LM and cls loaders."""
+
     def pick(*names):
         for name in names:
             if name in tensors:
                 return np.asarray(tensors[name])
         raise KeyError(f"None of {names} found in checkpoint")
 
-    embed = pick("transformer.word_embeddings.weight", "word_embeddings.weight")
-    if not cfg.tie_word_embeddings and "lm_head.weight" in tensors:
-        head = np.ascontiguousarray(np.asarray(tensors["lm_head.weight"]).T)
-    else:
-        head = np.ascontiguousarray(embed.T)
     return {
-        "embed": embed,
+        "embed": pick("transformer.word_embeddings.weight", "word_embeddings.weight"),
         "ln_f_w": pick("transformer.ln_f.weight", "ln_f.weight"),
         "ln_f_b": pick("transformer.ln_f.bias", "ln_f.bias"),
-        "head": head,
     }
+
+
+def hf_to_client_params(tensors: dict, cfg: FalconBlockConfig) -> dict:
+    params = _base_client_params(tensors, cfg)
+    if not cfg.tie_word_embeddings and "lm_head.weight" in tensors:
+        params["head"] = np.ascontiguousarray(np.asarray(tensors["lm_head.weight"]).T)
+    else:
+        params["head"] = np.ascontiguousarray(params["embed"].T)
+    return params
 
 
 def client_embed(params: dict, input_ids, cfg: FalconBlockConfig):
@@ -55,6 +60,24 @@ def client_head(params: dict, hidden, cfg: FalconBlockConfig):
     )
 
 
+# -- sequence classification (HF FalconForSequenceClassification layout:
+# score head over ln_f output; reference ships the bloom/llama analogues)
+
+from petals_tpu.models.client_common import ln_f_cls_head, score_matrix  # noqa: E402
+
+CLS_PREFIXES = tuple(p for p in CLIENT_PREFIXES if p != "lm_head.") + ("score.",)
+
+
+def hf_to_cls_params(tensors: dict, cfg: FalconBlockConfig) -> dict:
+    params = _base_client_params(tensors, cfg)
+    params["score"] = score_matrix(tensors)
+    return params
+
+
+def cls_head(params: dict, hidden, cfg: FalconBlockConfig):
+    return ln_f_cls_head(params, hidden, cfg.layer_norm_epsilon)
+
+
 FAMILY = register_family(
     dataclasses.replace(
         block_mod.FAMILY,
@@ -62,5 +85,8 @@ FAMILY = register_family(
         hf_to_client_params=hf_to_client_params,
         client_embed=client_embed,
         client_head=client_head,
+        hf_cls_prefixes=CLS_PREFIXES,
+        hf_to_cls_params=hf_to_cls_params,
+        cls_head=cls_head,
     )
 )

@@ -51,6 +51,46 @@ def test_cls_forward_matches_hf(cls_swarm):
         model.close()
 
 
+def test_bloom_cls_forward_matches_hf(tmp_path):
+    """The cls hooks are per-family (registry-dispatched): BLOOM's score head
+    over ln_f must match HF exactly too."""
+    from transformers import BloomForSequenceClassification
+
+    from tests.utils import make_tiny_bloom_cls
+
+    path = make_tiny_bloom_cls(str(tmp_path))
+    harness = SwarmHarness(path, [dict(first_block=0, num_blocks=3)]).start()
+    try:
+        model = AutoDistributedModelForSequenceClassification.from_pretrained(
+            path, initial_peers=harness.initial_peers
+        )
+        try:
+            rng = np.random.RandomState(3)
+            input_ids = rng.randint(1, 100, (2, 6)).astype(np.int64)
+            input_ids[1, 4:] = 0  # pad tail: pooling picks the last non-pad
+            ours = np.asarray(model.forward(input_ids))
+            hf = BloomForSequenceClassification.from_pretrained(
+                path, dtype=torch.float32
+            ).eval()
+            with torch.no_grad():
+                expected = hf(torch.from_numpy(input_ids)).logits.numpy()
+            np.testing.assert_allclose(ours, expected, atol=2e-4, rtol=0)
+        finally:
+            model.close()
+    finally:
+        harness.stop()
+
+
+def test_falcon_family_has_cls_hooks():
+    from petals_tpu.models.registry import get_family
+
+    for family_name in ("llama", "bloom", "falcon", "mixtral"):
+        family = get_family(family_name)
+        assert family.cls_head is not None, family_name
+        assert family.hf_to_cls_params is not None, family_name
+        assert any(p.startswith("score") for p in family.hf_cls_prefixes), family_name
+
+
 def test_cls_ptune_training_reduces_loss(cls_swarm):
     path, harness = cls_swarm
     model = AutoDistributedModelForSequenceClassification.from_pretrained(

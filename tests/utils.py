@@ -63,6 +63,27 @@ def make_tiny_llama_cls(
     return path
 
 
+def make_tiny_bloom_cls(
+    tmpdir: str, *, n_layers: int = 3, vocab: int = 128, num_labels: int = 3
+) -> str:
+    from transformers import BloomConfig, BloomForSequenceClassification
+
+    cfg = BloomConfig(
+        vocab_size=vocab,
+        hidden_size=64,
+        n_head=4,
+        n_layer=n_layers,
+        layer_norm_epsilon=1e-5,
+        num_labels=num_labels,
+        pad_token_id=0,
+    )
+    torch.manual_seed(5)
+    model = BloomForSequenceClassification(cfg).eval()
+    path = os.path.join(tmpdir, "tiny-bloom-cls")
+    model.save_pretrained(path, safe_serialization=True)
+    return path
+
+
 def make_tiny_bloom(tmpdir: str, *, n_layers: int = 3, vocab: int = 128) -> str:
     from transformers import BloomConfig, BloomForCausalLM
 
