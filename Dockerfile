@@ -1,0 +1,36 @@
+# Container image for a petals_tpu swarm server or DHT bootstrap on a TPU VM
+# (the reference ships a CUDA image, /root/reference/Dockerfile — this is its
+# TPU-native counterpart: libtpu comes from the jax[tpu] wheel, no conda).
+#
+#   docker build -t petals_tpu .
+#   docker run --privileged --network host \
+#       -v /cache:/cache -e PETALS_TPU_CACHE=/cache \
+#       petals_tpu python -m petals_tpu.cli.run_server MODEL --initial_peers ...
+#
+# --privileged + host networking are the standard TPU-VM container settings
+# (the TPU driver is exposed via /dev and the swarm needs inbound dials).
+
+FROM python:3.12-slim
+
+LABEL repository="petals_tpu"
+
+WORKDIR /home
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+  build-essential \
+  g++ \
+  && apt-get clean autoclean && rm -rf /var/lib/apt/lists/* /tmp/* /var/tmp/*
+
+# TPU-enabled jax (pulls libtpu); CPU torch only for checkpoint IO
+RUN pip install --no-cache-dir "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html && \
+    pip install --no-cache-dir torch --index-url https://download.pytorch.org/whl/cpu && \
+    rm -rf ~/.cache/pip
+
+VOLUME /cache
+ENV PETALS_TPU_CACHE=/cache
+
+COPY . petals_tpu/
+RUN pip install --no-cache-dir -e petals_tpu && rm -rf ~/.cache/pip
+
+WORKDIR /home/petals_tpu/
+CMD ["python", "-m", "petals_tpu.cli.run_dht", "--host", "0.0.0.0"]

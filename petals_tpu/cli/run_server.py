@@ -52,6 +52,16 @@ def build_parser() -> argparse.ArgumentParser:
                         help="PEFT adapter checkpoint dirs to host (multi-tenant LoRA)")
     parser.add_argument("--public_name", default=None, help="Display name announced to the swarm")
     parser.add_argument("--max_alloc_timeout", type=float, default=600.0)
+    parser.add_argument("--compression", default="none",
+                        choices=["none", "float16", "bfloat16", "qint8"],
+                        help="Default reply compression (clients may override per request)")
+    parser.add_argument("--max_disk_space", default=None,
+                        help="Hub/checkpoint cache budget, e.g. 300GB (LRU-evicted)")
+    parser.add_argument("--token", default=None,
+                        help="HF Hub access token for gated/private repos (or set HF_TOKEN)")
+    parser.add_argument("--trace_dir", default=None,
+                        help="Capture a bounded jax device trace here at startup "
+                             "(or set PETALS_TPU_TRACE_DIR)")
     return parser
 
 
@@ -63,8 +73,27 @@ def parse_block_range(args) -> tuple:
 
 
 def main(argv=None) -> None:
+    import os
+
     args = build_parser().parse_args(argv)
     first_block, num_blocks = parse_block_range(args)
+
+    # env-carried knobs: the hub/tracing modules read these at use time
+    if args.max_disk_space:
+        from petals_tpu.utils.hub import parse_size
+
+        try:
+            parse_size(args.max_disk_space)  # fail fast with the flag named
+        except ValueError:
+            build_parser().error(
+                f"--max_disk_space: cannot parse {args.max_disk_space!r} "
+                f"(expected e.g. 300GB, 512MB, or bytes)"
+            )
+        os.environ["PETALS_TPU_MAX_DISK_SPACE"] = args.max_disk_space
+    if args.token:
+        os.environ["HF_TOKEN"] = args.token
+    if args.trace_dir:
+        os.environ["PETALS_TPU_TRACE_DIR"] = args.trace_dir
 
     try:
         throughput = float(args.throughput)
@@ -102,6 +131,7 @@ def main(argv=None) -> None:
         num_tp_devices=args.num_tp_devices,
         quant_type=args.quant_type,
         adapters=args.adapters,
+        compression=args.compression,
     )
 
     async def run():

@@ -68,6 +68,7 @@ class Server:
         num_tp_devices: Optional[int] = None,  # >1: shard the span over this host's chips
         quant_type: str = "none",  # "none" | "int8" | "nf4" (ops/quant.py)
         adapters: Sequence[str] = (),  # PEFT checkpoint dirs to host (utils/peft.py)
+        compression: str = "none",  # default reply codec (clients may override per request)
     ):
         self.model_path = model_path
         self.family, self.cfg = get_block_config(model_path)
@@ -112,6 +113,9 @@ class Server:
         self.num_tp_devices = num_tp_devices
         self.quant_type = quant_type
         self.adapter_paths = list(adapters)
+        from petals_tpu.rpc.serialization import CompressionType
+
+        self.compression = CompressionType(compression)
         self.module_uids = [
             make_uid(self.dht_prefix, i)
             for i in range(self.first_block, self.first_block + self.num_blocks)
@@ -209,6 +213,7 @@ class Server:
             memory_cache=self.memory_cache,
             server_info_fn=lambda: dataclasses.asdict(self._server_info(ServerState.ONLINE)),
             identity=identity,
+            compression=self.compression,
         )
         self.handler.register(self.rpc_server)
 

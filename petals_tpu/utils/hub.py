@@ -41,8 +41,30 @@ _CHUNK = 1 << 20
 _MAX_BACKOFF_S = 60.0
 _REPO_ID_RE = re.compile(r"^[\w][\w.-]*(/[\w][\w.-]*)?$")
 # HTTP statuses that are facts about the repo/credentials, not the link —
-# retrying cannot help (gated repos return 401/403; we send no token)
+# retrying cannot help (gated repos return 401/403 when HF_TOKEN is absent
+# or lacks access)
 _PERMANENT_HTTP = {401, 403, 404}
+
+
+class _AuthStrippingRedirectHandler(urllib.request.HTTPRedirectHandler):
+    """Drop the Authorization header when a redirect leaves the original host:
+    the Hub 302s large files to presigned CDN URLs, where a forwarded Bearer
+    token both breaks the request (two auth mechanisms) and leaks the token
+    to a third party (huggingface_hub strips it for the same reason)."""
+
+    def redirect_request(self, req, fp, code, msg, headers, newurl):
+        import urllib.parse
+
+        new_req = super().redirect_request(req, fp, code, msg, headers, newurl)
+        if new_req is not None:
+            old_host = urllib.parse.urlsplit(req.full_url).netloc
+            new_host = urllib.parse.urlsplit(newurl).netloc
+            if old_host != new_host:
+                new_req.remove_header("Authorization")
+        return new_req
+
+
+_opener = urllib.request.build_opener(_AuthStrippingRedirectHandler)
 
 
 def validate_repo_id(repo_id: str) -> None:
@@ -68,6 +90,21 @@ def default_max_retries() -> Optional[int]:
     value = os.environ.get("PETALS_TPU_HUB_RETRIES", "").strip()
     if not value:
         return None
+    return int(value)
+
+
+def default_max_disk_space() -> Optional[int]:
+    """Cache budget in bytes from PETALS_TPU_MAX_DISK_SPACE (suffixes
+    KB/MB/GB/TB accepted, e.g. "300GB" — the reference's --max_disk_space)."""
+    value = os.environ.get("PETALS_TPU_MAX_DISK_SPACE", "").strip()
+    return parse_size(value) if value else None
+
+
+def parse_size(value: str) -> int:
+    value = value.strip().upper()
+    for suffix, mult in (("TB", 1 << 40), ("GB", 1 << 30), ("MB", 1 << 20), ("KB", 1 << 10), ("B", 1)):
+        if value.endswith(suffix):
+            return int(float(value[: -len(suffix)]) * mult)
     return int(value)
 
 
@@ -113,6 +150,8 @@ def fetch_file(
         return target
     if max_retries is None:
         max_retries = default_max_retries()
+    if max_disk_space is None:
+        max_disk_space = default_max_disk_space()
 
     url = _resolve_url(repo_id, filename, revision)
     attempt = 0
@@ -162,15 +201,18 @@ def _fetch_once(
     max_disk_space: Optional[int],
     timeout: float,
 ) -> Path:
+    request = urllib.request.Request(url)
+    token = os.environ.get("PETALS_TPU_HUB_TOKEN") or os.environ.get("HF_TOKEN")
+    if token:
+        request.add_header("Authorization", f"Bearer {token}")
     try:
-        response = urllib.request.urlopen(url, timeout=timeout)
+        response = _opener.open(request, timeout=timeout)
     except urllib.error.HTTPError as e:
         if e.code == 404:
             raise FileNotFoundError(f"{url} -> HTTP 404") from e
         if e.code in _PERMANENT_HTTP:
-            raise PermissionError(
-                f"{url} -> HTTP {e.code} (gated/private repo? no auth token is sent)"
-            ) from e
+            hint = "is HF_TOKEN valid?" if token else "gated/private repo? set HF_TOKEN"
+            raise PermissionError(f"{url} -> HTTP {e.code} ({hint})") from e
         raise
     with response:
         size = int(response.headers.get("Content-Length") or 0)

@@ -55,14 +55,23 @@ class _HubHandler(http.server.BaseHTTPRequestHandler):
     root: Path = None
     fail_next: dict = {}  # path suffix -> remaining 500s to serve
     requests_seen: list = []
+    auth_seen: list = []
 
     def log_message(self, *args):  # quiet
         pass
 
+    redirect_host: str = None  # when set, 302 first-hit requests to this netloc
+
     def do_GET(self):
         # /{org}/{repo}/resolve/{rev}/{filename}
         type(self).requests_seen.append(self.path)
-        parts = self.path.lstrip("/").split("/")
+        type(self).auth_seen.append(self.headers.get("Authorization"))
+        if type(self).redirect_host and "?r=1" not in self.path:
+            self.send_response(302)
+            self.send_header("Location", f"http://{type(self).redirect_host}{self.path}?r=1")
+            self.end_headers()
+            return
+        parts = self.path.split("?")[0].lstrip("/").split("/")
         if len(parts) < 5 or parts[2] != "resolve":
             self.send_error(404)
             return
@@ -89,6 +98,8 @@ def hub_server(sharded_repo, monkeypatch):
     _HubHandler.root = Path(root)
     _HubHandler.fail_next = {}
     _HubHandler.requests_seen = []
+    _HubHandler.auth_seen = []
+    _HubHandler.redirect_host = None
     httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _HubHandler)
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
@@ -264,6 +275,37 @@ def test_revisions_are_cached_separately(hub_server, tmp_path):
     # the fixture serves any revision path; the cache must still key on it
     b = hub.fetch_file(repo_id, "config.json", cache_dir=tmp_path, revision="v2")
     assert a != b and a.parent.name == "main" and b.parent.name == "v2"
+
+
+def test_token_header_and_size_parsing(hub_server, tmp_path, monkeypatch):
+    from petals_tpu.utils import hub
+
+    repo_id, _ = hub_server
+    monkeypatch.setenv("HF_TOKEN", "hf_test_token")
+    _HubHandler.auth_seen = []
+    hub.fetch_file(repo_id, "config.json", cache_dir=tmp_path)
+    assert _HubHandler.auth_seen == ["Bearer hf_test_token"]
+
+    # token is STRIPPED when a redirect leaves the original host (the Hub
+    # 302s shards to presigned CDN URLs; forwarding Bearer there breaks the
+    # request and leaks the token) — 'localhost' is a different netloc that
+    # still reaches the fixture
+    import urllib.parse
+
+    endpoint = os.environ["PETALS_TPU_HUB_ENDPOINT"]
+    port = urllib.parse.urlsplit(endpoint).port
+    _HubHandler.redirect_host = f"localhost:{port}"
+    _HubHandler.auth_seen = []
+    hub.fetch_file(repo_id, "model-layer2.safetensors", cache_dir=tmp_path)
+    assert _HubHandler.auth_seen[0] == "Bearer hf_test_token"  # original host
+    assert _HubHandler.auth_seen[1] is None, "token must not follow the redirect"
+    _HubHandler.redirect_host = None
+
+    assert hub.parse_size("300GB") == 300 * (1 << 30)
+    assert hub.parse_size("1.5MB") == int(1.5 * (1 << 20))
+    assert hub.parse_size("1024") == 1024
+    monkeypatch.setenv("PETALS_TPU_MAX_DISK_SPACE", "2KB")
+    assert hub.default_max_disk_space() == 2048
 
 
 def test_lru_eviction_under_disk_budget(hub_server, tmp_path):

@@ -229,6 +229,12 @@ def test_cli_parsers():
     assert args.quant_type == "nf4"
     args = parser.parse_args(["/path/model"])
     assert parse_block_range(args) == (None, None)
+    args = parser.parse_args(
+        ["/path/model", "--compression", "qint8", "--max_disk_space", "100GB",
+         "--token", "hf_x", "--trace_dir", "/tmp/tr"]
+    )
+    assert args.compression == "qint8" and args.max_disk_space == "100GB"
+    assert args.token == "hf_x" and args.trace_dir == "/tmp/tr"
 
 
 def test_server_publishes_next_pings(tmp_path):
