@@ -206,6 +206,63 @@ class RemoteSequenceManager:
 
     # ------------------------------------------------------------------ sequences
 
+    async def refresh_server_infos(
+        self, peer_ids: Optional[Sequence[PeerID]] = None, *, timeout: float = 5.0
+    ) -> None:
+        """Refresh perishable server state via direct ``rpc_info`` calls
+        (reference sequence_manager.py:423-466): DHT announces can be a whole
+        update_period stale, but cache_tokens_left moves with every session a
+        server admits — cache-aware routing needs the live number."""
+        if peer_ids is None:
+            peer_ids = list(self._peer_infos)
+        wanted = {p for p in peer_ids if not self._is_banned(p)}
+        # refresh in ROUTING-PREFERENCE order (spans_by_priority), not a random
+        # sample: the server Dijkstra is about to pick must be among the ones
+        # refreshed, or the stale-cache failure this exists to prevent returns
+        ordered = [s.peer_id for s in self.state.spans_by_priority if s.peer_id in wanted]
+        ordered += [p for p in wanted if p not in set(ordered)]
+        limit = max(self.config.max_pinged * 2, 1)
+        if len(ordered) > limit:
+            logger.debug(
+                f"rpc_info refresh capped at {limit} of {len(ordered)} candidates"
+            )
+        targets = ordered[:limit]
+
+        async def fetch(peer_id):
+            try:
+                stub = await self.get_stub(peer_id)
+                return peer_id, await stub.call("ptu.info", {})
+            except Exception as e:
+                logger.debug(f"rpc_info from {peer_id} failed: {e}")
+                return peer_id, None
+
+        # collective budget: one dead-but-not-yet-banned peer must not stall a
+        # session open for its whole connect timeout
+        tasks = [asyncio.ensure_future(fetch(p)) for p in targets]
+        done, pending = await asyncio.wait(tasks, timeout=timeout)
+        for task in pending:
+            task.cancel()
+        for task in done:
+            peer_id, info = task.result()
+            if not isinstance(info, dict):
+                continue
+            server_info = self._peer_infos.get(peer_id)
+            if server_info is None:
+                continue
+            # update the live ServerInfo objects the router reads (shared with
+            # state.spans_*); only fields rpc_info reports fresher than the
+            # DHT, and only when well-formed — a malformed reply from one
+            # server must not abort routing (same rule as ServerInfo.from_tuple)
+            try:
+                tokens = info.get("cache_tokens_available")
+                if tokens is not None:
+                    server_info.cache_tokens_left = int(tokens)
+                for field in ("throughput", "inference_rps", "forward_rps"):
+                    if info.get(field) is not None:
+                        setattr(server_info, field, float(info[field]))
+            except (TypeError, ValueError) as e:
+                logger.debug(f"Malformed rpc_info from {peer_id}: {e}")
+
     async def make_sequence(
         self,
         start_index: int = 0,
@@ -218,6 +275,20 @@ class RemoteSequenceManager:
         if self.state.last_updated_time is None:
             await self.ensure_ready()
 
+        async def refresh_for_cache():
+            # session-open path: the cache-miss penalty is only as good as the
+            # freshness of cache_tokens_left
+            if cache_tokens_needed is None:
+                return
+            candidates = {
+                span.peer_id
+                for i in range(start_index, end_index)
+                for span in self._usable_spans_for_block(i)
+            }
+            await self.refresh_server_infos(list(candidates))
+
+        await refresh_for_cache()
+
         if mode == "min_latency":
             sequence = self._make_sequence_min_latency(start_index, end_index, cache_tokens_needed)
         elif mode == "max_throughput":
@@ -226,8 +297,11 @@ class RemoteSequenceManager:
             raise ValueError(f"Unknown routing mode {mode!r}")
 
         if not sequence:
-            # one forced refresh before giving up
+            # one forced refresh before giving up; update() rebuilds spans
+            # from (possibly stale) DHT announces, so live cache numbers must
+            # be re-fetched on top of the fresh snapshot
             await self.update()
+            await refresh_for_cache()
             sequence = (
                 self._make_sequence_min_latency(start_index, end_index, cache_tokens_needed)
                 if mode == "min_latency"

@@ -237,6 +237,84 @@ def test_cli_parsers():
     assert args.token == "hf_x" and args.trace_dir == "/tmp/tr"
 
 
+def test_rpc_info_refresh_drives_cache_aware_routing(tmp_path):
+    """Session-open routing refreshes cache_tokens_left via direct rpc_info
+    (reference sequence_manager.py:423-466): a preferred server whose KV cache
+    just filled up is avoided even though its DHT announce is still stale."""
+    from petals_tpu.client.config import ClientConfig
+    from petals_tpu.client.routing.sequence_manager import RemoteSequenceManager
+    from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
+    from petals_tpu.rpc import RpcClient
+    from petals_tpu.server.server import default_dht_prefix
+    from tests.test_full_model import SwarmHarness
+    from tests.utils import make_tiny_llama
+
+    path = make_tiny_llama(str(tmp_path))
+    # tiny KV budgets; HUGE update_period so DHT announces stay stale
+    harness = SwarmHarness(
+        path,
+        [
+            dict(first_block=0, num_blocks=4, throughput=1000.0,
+                 attn_cache_bytes=64 * 1024, update_period=1000),
+            dict(first_block=0, num_blocks=4, throughput=1.0,
+                 attn_cache_bytes=64 * 1024, update_period=1000),
+        ],
+    ).start()
+    try:
+        preferred, fallback = harness.servers
+        prefix = default_dht_prefix(path)
+        uids = [make_uid(prefix, i) for i in range(4)]
+
+        async def main():
+            manager = await RemoteSequenceManager.create(
+                ClientConfig(
+                    initial_peers=[harness.bootstrap.own_addr.to_string()],
+                    update_period=1000,
+                ),
+                uids,
+            )
+            occupier = None
+            try:
+                await manager.ensure_ready()
+                # with everything free, the fast server wins
+                chain = await manager.make_sequence(
+                    mode="min_latency", cache_tokens_needed=32
+                )
+                assert chain[0].peer_id == preferred.dht.peer_id
+
+                # fill most of the preferred server's KV cache (the session
+                # holds its allocation as long as the stream stays open)
+                occupier = await RpcClient.connect(
+                    preferred.rpc_server.host, preferred.rpc_server.port
+                )
+                stream = await occupier.open_stream("ptu.inference")
+                await stream.send(
+                    {"uids": CHAIN_DELIMITER.join(uids), "max_length": 48, "batch_size": 1}
+                )
+                ack = await asyncio.wait_for(stream.recv(timeout=30), 30)
+                assert ack.get("session_open")
+
+                # DHT still says the preferred server has room; the rpc_info
+                # refresh inside make_sequence must see the live number
+                chain = await manager.make_sequence(
+                    mode="min_latency", cache_tokens_needed=32
+                )
+                assert chain[0].peer_id == fallback.dht.peer_id, (
+                    "stale-cache server must be avoided after rpc_info refresh"
+                )
+                refreshed = manager._peer_infos[preferred.dht.peer_id]
+                assert refreshed.cache_tokens_left is not None
+                assert refreshed.cache_tokens_left < 32
+            finally:
+                if occupier is not None:
+                    await occupier.close()
+                await manager.shutdown()
+
+        harness.run(main())
+    finally:
+        harness.stop()
+
+
 def test_server_publishes_next_pings(tmp_path):
     """A live server measures RTT to its successor-span servers and publishes
     next_pings in its announce (reference server.py:717-751)."""
