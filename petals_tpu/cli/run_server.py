@@ -52,6 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="PEFT adapter checkpoint dirs to host (multi-tenant LoRA)")
     parser.add_argument("--public_name", default=None, help="Display name announced to the swarm")
     parser.add_argument("--max_alloc_timeout", type=float, default=600.0)
+    parser.add_argument("--num_sp_devices", type=int, default=None,
+                        help=">1: ring-attention sequence parallelism for long-context "
+                             "forward/backward (stateless path)")
     parser.add_argument("--compression", default="none",
                         choices=["none", "float16", "bfloat16", "qint8"],
                         help="Default reply compression (clients may override per request)")
@@ -129,6 +132,7 @@ def main(argv=None) -> None:
         mean_balance_check_period=args.mean_balance_check_period,
         max_alloc_timeout=args.max_alloc_timeout,
         num_tp_devices=args.num_tp_devices,
+        num_sp_devices=args.num_sp_devices,
         quant_type=args.quant_type,
         adapters=args.adapters,
         compression=args.compression,

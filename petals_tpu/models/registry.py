@@ -32,6 +32,9 @@ class ModelFamily:
     hf_cls_prefixes: tuple = ()  # checkpoint prefixes incl. the score head
     hf_to_cls_params: Optional[Callable] = None  # (dict, cfg) -> params pytree
     cls_head: Optional[Callable] = None  # (params, hidden, cfg) -> per-position label logits
+    # block_apply accepts ring_mesh= for sequence-parallel attention on the
+    # stateless (no-KV) path (plain causal attention only — no ALiBi/sliding)
+    supports_ring_attention: bool = False
 
 
 def register_family(family: ModelFamily) -> ModelFamily:

@@ -101,10 +101,13 @@ def ring_attention_sharded(
     axis_name: str = "sp",
 ) -> jnp.ndarray:
     """shard_map wrapper: shards the sequence axis over ``axis_name`` and runs
-    the ring. seq must divide the axis size."""
+    the ring. seq must divide the axis size. When the mesh also has a "tp"
+    axis, heads ride it (Megatron layout) — the ring math is per-head, so tp
+    and sp compose with no extra collectives."""
     from jax import shard_map
 
-    spec = P(None, axis_name, None, None)
+    head_axis = "tp" if "tp" in mesh.axis_names and mesh.shape["tp"] > 1 else None
+    spec = P(None, axis_name, head_axis, None)
     fn = shard_map(
         functools.partial(ring_attend, axis_name=axis_name),
         mesh=mesh,

@@ -34,3 +34,10 @@ def tp_mesh(num_devices: Optional[int] = None) -> Mesh:
     devices = jax.devices()
     num_devices = num_devices or len(devices)
     return make_mesh((num_devices,), ("tp",), devices=devices)
+
+
+def serving_mesh(num_tp: int = 1, num_sp: int = 1) -> Mesh:
+    """2-D intra-server mesh: heads/FFN sharded over "tp", long-context
+    activations sharded over "sp" (ring attention on the stateless
+    forward/backward path)."""
+    return make_mesh((num_tp, num_sp), ("tp", "sp"))

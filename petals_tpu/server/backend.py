@@ -209,6 +209,12 @@ class TransformerBackend:
     def _forward_fn(self):
         family, cfg = self.family, self.cfg
         tp_mesh = self.mesh
+        # sequence parallelism on the stateless (no-KV) path: activations ride
+        # the "sp" axis and attention runs as a ring over it (ops/
+        # ring_attention.py) — the long-context training/forward path scales
+        # past one chip's activation memory
+        sp_size = self.mesh.shape.get("sp", 1) if self.mesh is not None else 1
+        supports_ring = family.supports_ring_attention and sp_size > 1
 
         # The training path (forward + vjp-recompute backward) NEVER uses the
         # Pallas flash kernel: it has no reverse-mode AD rule, and keeping
@@ -216,13 +222,26 @@ class TransformerBackend:
         # recompute linearizes exactly what the client saw.
         @functools.partial(jax.jit, static_argnames=("with_prompts",))
         def fwd(params, hidden, prompts, *, with_prompts: bool):
+            use_ring = supports_ring and hidden.shape[1] % sp_size == 0
+            if use_ring:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                hidden = jax.lax.with_sharding_constraint(
+                    hidden, NamedSharding(tp_mesh, P(None, "sp", None))
+                )
+
             def body(h, xs):
                 p_block, prompt = xs
                 if with_prompts:
                     pre = prompt.shape[1]
                     h = h.at[:, :pre].add(prompt.astype(h.dtype))
+                extra = (
+                    {"ring_mesh": tp_mesh if use_ring else None}
+                    if family.supports_ring_attention
+                    else {}
+                )
                 out, _ = family.block_apply(
-                    p_block, h, None, 0, cfg, use_flash=False, tp_mesh=tp_mesh
+                    p_block, h, None, 0, cfg, use_flash=False, tp_mesh=tp_mesh, **extra
                 )
                 return out, None
 

@@ -66,6 +66,7 @@ class Server:
         use_flash: Optional[bool] = None,
         max_alloc_timeout: float = 600.0,
         num_tp_devices: Optional[int] = None,  # >1: shard the span over this host's chips
+        num_sp_devices: Optional[int] = None,  # >1: ring-attention seq parallelism (fwd/bwd path)
         quant_type: str = "none",  # "none" | "int8" | "nf4" (ops/quant.py)
         adapters: Sequence[str] = (),  # PEFT checkpoint dirs to host (utils/peft.py)
         compression: str = "none",  # default reply codec (clients may override per request)
@@ -111,6 +112,13 @@ class Server:
         self.use_flash = use_flash
         self.max_alloc_timeout = max_alloc_timeout
         self.num_tp_devices = num_tp_devices
+        self.num_sp_devices = num_sp_devices
+        if (num_sp_devices or 1) > 1 and not self.family.supports_ring_attention:
+            raise ValueError(
+                f"num_sp_devices>1 needs ring attention, which {self.family.name} "
+                f"does not support (plain causal only) — the sp devices would "
+                f"sit idle holding replicated parameters"
+            )
         self.quant_type = quant_type
         self.adapter_paths = list(adapters)
         from petals_tpu.rpc.serialization import CompressionType
@@ -343,10 +351,16 @@ class Server:
 
     def _make_backend(self, stacked, first_block: int) -> TransformerBackend:
         mesh = None
-        if self.num_tp_devices is not None and self.num_tp_devices > 1:
+        tp = self.num_tp_devices or 1
+        sp = self.num_sp_devices or 1
+        if sp > 1:
+            from petals_tpu.parallel.mesh import serving_mesh
+
+            mesh = serving_mesh(tp, sp)
+        elif tp > 1:
             from petals_tpu.parallel.mesh import tp_mesh
 
-            mesh = tp_mesh(self.num_tp_devices)
+            mesh = tp_mesh(tp)
         return TransformerBackend(
             self.family,
             self.cfg,
