@@ -21,6 +21,7 @@ a WAN round trip a co-located server would not).
 import asyncio
 import gc
 import json
+import os
 import statistics
 import sys
 import time
@@ -378,7 +379,54 @@ async def run_e2e_bench():
     return result
 
 
+def _has_metric_line(text: str) -> bool:
+    for line in text.splitlines():
+        try:
+            obj = json.loads(line)
+        except (ValueError, TypeError):
+            continue
+        if isinstance(obj, dict) and "metric" in obj and "value" in obj:
+            return True
+    return False
+
+
 def main():
+    import subprocess
+
+    if "--inner" not in sys.argv:
+        # Supervise the real benchmark from a jax-free parent: if the
+        # accelerator tunnel is wedged, JAX initialization blocks forever —
+        # the driver must still get its ONE JSON line. stderr is inherited so
+        # progress streams live; only stdout (the metric line) is captured.
+        budget = float(os.environ.get("_PTU_BENCH_TIMEOUT", 2400))
+        child_stdout = ""
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--inner"],
+                stdout=subprocess.PIPE, text=True, timeout=budget,
+            )
+            child_stdout = proc.stdout or ""
+            error = None if proc.returncode == 0 else f"rc={proc.returncode}"
+        except subprocess.TimeoutExpired as e:
+            captured = e.stdout or b""  # bytes even under text=True (cpython quirk)
+            child_stdout = captured.decode(errors="replace") if isinstance(captured, bytes) else captured
+            sys.stderr.write(f"\n[bench] timed out after {budget:.0f}s\n")
+            error = "timeout (accelerator tunnel down?)"
+        # ONE-json-line contract: trust the child's metric line if it managed
+        # to print one (e.g. the run finished and the TPU runtime crashed at
+        # interpreter teardown); emit the error record only otherwise
+        if _has_metric_line(child_stdout):
+            sys.stdout.write(child_stdout)
+        else:
+            print(json.dumps({
+                "metric": f"single_stream_decode_tok_s_{N_BLOCKS}xllama7b_blocks_e2e",
+                "value": 0.0,
+                "unit": "tok/s",
+                "vs_baseline": 0.0,
+                "error": error or "no metric line from benchmark",
+            }))
+        return
+
     details = {}
 
     e2e = asyncio.run(run_e2e_bench())
