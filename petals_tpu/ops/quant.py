@@ -172,6 +172,83 @@ def quant_matmul(x: jnp.ndarray, w) -> jnp.ndarray:
 # dequant-matmul path instead (XLA partitions it and inserts the psum).
 _FORCE_XLA_PATH = contextvars.ContextVar("ptu_quant_force_xla", default=False)
 
+# DECODE-shape path choice. At M=1 the fused kernel is VPU-decode-bound (the
+# 16-way select chain, ~3% of HBM bandwidth on v5e — BENCH_DETAILS.json) while
+# XLA's gather-based dequantize may beat it; neither can be predicted across
+# toolchains, so servers measure both once at startup (autotune below) and the
+# winner is traced into the small-M path. Prefill (large M) always takes the
+# fused kernel: there the matmul amortizes the decode.
+_NF4_DECODE_MAX_M = 32
+_NF4_DECODE_USE_PALLAS = True
+_NF4_AUTOTUNED = False
+
+
+def set_nf4_decode_path(use_pallas: bool) -> None:
+    global _NF4_DECODE_USE_PALLAS
+    _NF4_DECODE_USE_PALLAS = bool(use_pallas)
+
+
+def maybe_autotune_nf4_decode(
+    in_features: int = 4096, out_features: int = 4096, *, steps: int = 20
+) -> bool:
+    """Measure the Pallas kernel vs the XLA dequant-matmul at decode shape on
+    the real device, once per process; returns the chosen use_pallas. No-op
+    (keeps the default) off-TPU."""
+    global _NF4_AUTOTUNED
+    if _NF4_AUTOTUNED or jax.default_backend() != "tpu":
+        return _NF4_DECODE_USE_PALLAS
+    import time
+
+    # a representative probe shape is enough — full 70B dims would allocate
+    # multi-GB f32 transients inside quantize_nf4 on an HBM already holding
+    # the span; tile-align so the kernel's supported-shape predicate holds
+    in_features = min(_round_up(in_features, _TK), 4096)
+    out_features = min(_round_up(out_features, _TN), 4096)
+
+    key = jax.random.PRNGKey(0)
+    w = quantize_nf4(jax.random.normal(key, (in_features, out_features), jnp.bfloat16) * 0.02)
+    x = jax.random.normal(key, (1, in_features), jnp.bfloat16) * 0.1
+    if not _nf4_pallas_supported(x, w.data):
+        _NF4_AUTOTUNED = True  # kernel can't serve this shape class anyway
+        return _NF4_DECODE_USE_PALLAS
+
+    def timed(fn, *args):
+        out = fn(x, *args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(x, *args)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    # weight leaves ride as jit ARGUMENTS, exactly like the production trace
+    # (_nf4_mm_fwd_impl) — as compile-time constants XLA could fold the
+    # dequantize away and the timing would flatter the XLA arm
+    pallas_fn = jax.jit(
+        lambda v, data, scales: nf4_matmul_pallas(
+            v, QuantizedLinear("nf4", data, scales, in_features, out_features)
+        )
+    )
+    xla_fn = jax.jit(
+        lambda v, data, scales: v.astype(jnp.bfloat16)
+        @ dequantize(
+            QuantizedLinear("nf4", data, scales, in_features, out_features), jnp.bfloat16
+        )
+    )
+    t_pallas = timed(pallas_fn, w.data, w.scales)
+    t_xla = timed(xla_fn, w.data, w.scales)
+    use_pallas = t_pallas <= t_xla
+    set_nf4_decode_path(use_pallas)
+    _NF4_AUTOTUNED = True
+    from petals_tpu.utils.logging import get_logger
+
+    get_logger(__name__).info(
+        f"NF4 decode autotune ({in_features}x{out_features}): pallas "
+        f"{t_pallas / steps * 1e3:.2f}ms vs xla {t_xla / steps * 1e3:.2f}ms "
+        f"-> {'pallas' if use_pallas else 'xla'}"
+    )
+    return use_pallas
+
 
 @contextlib.contextmanager
 def force_xla_quant_matmul():
@@ -195,10 +272,12 @@ def _nf4_mm(x2d, data, scales):
 def _nf4_mm_fwd_impl(x2d, data, scales):
     # logical in_features comes from x; data rows may be padded to the k-tile
     w = QuantizedLinear("nf4", data, scales, x2d.shape[-1], data.shape[-1])
+    is_decode = x2d.shape[0] <= _NF4_DECODE_MAX_M
     if (
         not _FORCE_XLA_PATH.get()
         and jax.default_backend() == "tpu"
         and _nf4_pallas_supported(x2d, data)
+        and (_NF4_DECODE_USE_PALLAS or not is_decode)
     ):
         return nf4_matmul_pallas(x2d, w)
     return (x2d.astype(jnp.bfloat16) @ dequantize(w, jnp.bfloat16)).astype(x2d.dtype)

@@ -96,6 +96,22 @@ class TransformerBackend:
         self.num_kv_heads = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
         self.head_dim = cfg.head_dim
         self.hidden_size = cfg.hidden_size
+
+        if mesh is None and jax.default_backend() == "tpu":
+            from petals_tpu.ops.quant import QuantizedLinear, maybe_autotune_nf4_decode
+
+            has_nf4 = any(
+                isinstance(leaf, QuantizedLinear) and leaf.kind == "nf4"
+                for leaf in jax.tree_util.tree_leaves(
+                    self.params, is_leaf=lambda x: isinstance(x, QuantizedLinear)
+                )
+            )
+            if has_nf4:
+                # pick the faster decode path ON THIS DEVICE before the first
+                # trace bakes one in (quant.py maybe_autotune_nf4_decode)
+                maybe_autotune_nf4_decode(
+                    cfg.hidden_size, getattr(cfg, "intermediate_size", cfg.hidden_size)
+                )
         # adapter name -> (stacked {leaf: (A, B)}, scaling); see utils/peft.py
         self.adapters: Dict[str, tuple] = {}
 

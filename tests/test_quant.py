@@ -120,3 +120,43 @@ def test_quantized_server_generates(tmp_path):
             model.close()
     finally:
         harness.stop()
+
+
+def test_nf4_decode_path_selection(monkeypatch):
+    """The autotuned decode-path flag picks pallas vs XLA for small-M (decode)
+    traces; prefill always takes the fused kernel (quant.py autotune)."""
+    import jax.numpy as jnp
+
+    from petals_tpu.ops import quant
+
+    calls = []
+    real_dequant = quant.dequantize
+
+    def fake_pallas(x, w, **kwargs):
+        calls.append(tuple(x.shape))
+        return (x.astype(jnp.bfloat16) @ real_dequant(w, jnp.bfloat16)).astype(x.dtype)
+
+    monkeypatch.setattr(quant, "nf4_matmul_pallas", fake_pallas)
+    monkeypatch.setattr(quant.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(quant, "_NF4_DECODE_USE_PALLAS", False)
+
+    rng = np.random.RandomState(0)
+    w = quant.quantize_nf4(jnp.asarray(rng.randn(512, 256).astype(np.float32) * 0.05))
+    decode_x = jnp.asarray(rng.randn(1, 512).astype(np.float32) * 0.1)
+    prefill_x = jnp.asarray(rng.randn(64, 512).astype(np.float32) * 0.1)
+
+    out = quant.quant_matmul(decode_x, w)  # decode + xla-preferred -> no kernel
+    assert calls == [] and out.shape == (1, 256)
+    quant.quant_matmul(prefill_x, w)  # prefill always uses the kernel
+    assert calls == [(64, 512)]
+
+    monkeypatch.setattr(quant, "_NF4_DECODE_USE_PALLAS", True)
+    quant.quant_matmul(decode_x, w)  # decode + pallas-preferred -> kernel
+    assert calls[-1] == (1, 512)
+
+
+def test_nf4_autotune_noop_off_tpu():
+    from petals_tpu.ops import quant
+
+    # on CPU the autotune must not run (keeps the default) and must not crash
+    assert quant.maybe_autotune_nf4_decode(128, 128) == quant._NF4_DECODE_USE_PALLAS

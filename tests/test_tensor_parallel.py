@@ -11,10 +11,15 @@ from petals_tpu.parallel.mesh import make_mesh
 from petals_tpu.server.backend import TransformerBackend
 from petals_tpu.server.from_pretrained import get_block_config, load_block_params
 from petals_tpu.server.memory_cache import MemoryCache
-from tests.utils import make_tiny_bloom, make_tiny_llama
+from tests.utils import make_tiny_bloom, make_tiny_llama, make_tiny_mixtral
 
 
-@pytest.mark.parametrize("model_maker,tp_size", [(make_tiny_llama, 2), (make_tiny_bloom, 4)])
+# mixtral's TP spec shards the EXPERT axis (expert parallelism, 4 experts / 2
+# devices) — this is the ep coverage VERDICT r1 flagged as spec-only
+@pytest.mark.parametrize(
+    "model_maker,tp_size",
+    [(make_tiny_llama, 2), (make_tiny_bloom, 4), (make_tiny_mixtral, 2)],
+)
 def test_tp_matches_single_device(model_maker, tp_size, tmp_path):
     assert len(jax.devices()) >= tp_size, "conftest must provide 8 virtual devices"
     path = model_maker(str(tmp_path))
