@@ -29,7 +29,7 @@ import jax.numpy as jnp
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def _flash_sharded(q, k, v, mesh, *, q_offset, kv_length, alibi_slopes, scale):
+def _flash_sharded(q, k, v, mesh, *, q_offset, kv_length, alibi_slopes, scale, sliding_window=None):
     """Run the Pallas flash kernel per TP shard: q/kv heads are sharded over
     the mesh's "tp" axis (Megatron layout, parallel/tp.py), the kernel is
     per-head, and no cross-shard communication is needed — shard_map gives
@@ -47,6 +47,7 @@ def _flash_sharded(q, k, v, mesh, *, q_offset, kv_length, alibi_slopes, scale):
             q_, k_, v_,
             q_offset=q_offset_, kv_length=kv_length_,
             alibi_slopes=slopes_ if alibi_slopes is not None else None,
+            sliding_window=sliding_window,
             scale=scale,
         )
 
@@ -107,7 +108,8 @@ def attend(
                 return _flash_sharded(
                     q, k, v, tp_mesh,
                     q_offset=q_offset, kv_length=kv_length,
-                    alibi_slopes=alibi_slopes, scale=scale,
+                    alibi_slopes=alibi_slopes, sliding_window=sliding_window,
+                    scale=scale,
                 )
             return flash_attend(
                 q,
@@ -116,6 +118,7 @@ def attend(
                 q_offset=q_offset,
                 kv_length=kv_length,
                 alibi_slopes=alibi_slopes,
+                sliding_window=sliding_window,
                 scale=scale,
             )
     return attend_reference(

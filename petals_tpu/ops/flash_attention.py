@@ -2,11 +2,13 @@
 
 Online-softmax tiled attention over a preallocated KV buffer of which only the
 first ``kv_length`` positions are valid. Supports GQA (kv heads shared by query
-head groups) and BLOOM-style ALiBi bias. Used for prefill / chunked prefill
-(q_len >= 8, i.e. anything above decode shapes); the XLA reference path in
-petals_tpu/ops/attention.py covers decode (q_len < 8), where the op is
-bandwidth-bound and XLA fusion is already optimal. Causal masking is always
-applied — non-causal requests must use the XLA path (attend() enforces this).
+head groups), BLOOM-style ALiBi bias, and Mixtral-style sliding windows (tiles
+beyond the window frontier are skipped like tiles beyond the causal frontier).
+Used for prefill / chunked prefill (q_len >= 8, i.e. anything above decode
+shapes); the XLA reference path in petals_tpu/ops/attention.py covers decode
+(q_len < 8), where the op is bandwidth-bound and XLA fusion is already
+optimal. Causal masking is always applied — non-causal requests must use the
+XLA path (attend() enforces this).
 
 Replaces the reference's torch SDPA path
 (/root/reference/src/petals/models/falcon/block.py:233-244) with a TPU-first
@@ -49,6 +51,7 @@ def _kernel(
     block_kv: int,
     num_kv_blocks: int,
     use_alibi: bool,
+    sliding_window: Optional[int] = None,
 ):
     h = pl.program_id(1)
     qi = pl.program_id(2)
@@ -67,6 +70,9 @@ def _kernel(
     kv_block_start = kj * block_kv
     # Any work in this tile? (causal frontier: last q row is q_block_start + block_q - 1)
     block_needed = (kv_block_start <= q_block_start + block_q - 1) & (kv_block_start < kv_length)
+    if sliding_window is not None:
+        # window frontier: the FIRST q row only sees kv > q_block_start - window
+        block_needed &= kv_block_start + block_kv - 1 > q_block_start - sliding_window
 
     @pl.when(block_needed)
     def _compute():
@@ -88,6 +94,8 @@ def _kernel(
 
         q_pos = q_block_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
         mask = (kv_pos <= q_pos) & (kv_pos < kv_length)
+        if sliding_window is not None:
+            mask &= kv_pos > q_pos - sliding_window  # Mixtral window semantics
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scratch[...]  # [bq, LANES] (all lanes equal)
@@ -122,7 +130,7 @@ def _kernel(
 
 def flash_supported(q, k, v, *, sliding_window: Optional[int] = None) -> bool:
     """Cheap static check whether the Pallas kernel handles these shapes."""
-    if sliding_window is not None:
+    if sliding_window is not None and sliding_window <= 0:
         return False
     batch, q_len, num_q_heads, head_dim = q.shape
     _, kv_buf_len, num_kv_heads, _ = k.shape
@@ -134,7 +142,8 @@ def flash_supported(q, k, v, *, sliding_window: Optional[int] = None) -> bool:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "block_q", "block_kv", "interpret")
+    jax.jit,
+    static_argnames=("scale", "block_q", "block_kv", "interpret", "sliding_window"),
 )
 def flash_attend(
     q: jnp.ndarray,
@@ -144,6 +153,7 @@ def flash_attend(
     q_offset: jnp.ndarray | int = 0,
     kv_length: Optional[jnp.ndarray | int] = None,
     alibi_slopes: Optional[jnp.ndarray] = None,
+    sliding_window: Optional[int] = None,
     scale: Optional[float] = None,
     block_q: int = 128,
     block_kv: int = 128,
@@ -198,6 +208,7 @@ def flash_attend(
         block_kv=block_kv,
         num_kv_blocks=num_kv_blocks,
         use_alibi=use_alibi,
+        sliding_window=sliding_window,
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -368,13 +368,9 @@ class TransformerBackend:
         # The linear sizing below is only sound when the flash kernel will
         # actually run: attend() silently falls back to the logit-materializing
         # XLA path when the kernel can't handle the shapes (cache length not a
-        # multiple of 128, sliding-window attention), and then chunks must be
-        # sized by the quadratic formula.
-        flash_will_run = (
-            self.use_flash
-            and getattr(self.cfg, "sliding_window", None) is None
-            and (kv_buf_len is None or kv_buf_len % 128 == 0)
-        )
+        # multiple of 128), and then chunks must be sized by the quadratic
+        # formula. Sliding windows are handled by the kernel.
+        flash_will_run = self.use_flash and (kv_buf_len is None or kv_buf_len % 128 == 0)
         if flash_will_run:
             # flash never materializes the [chunk, total_seq] logits; the
             # footprint is the chunk's activations (hidden + MLP intermediate +

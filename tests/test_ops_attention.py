@@ -100,3 +100,61 @@ def test_sliding_window_reference():
     w /= w.sum(-1, keepdims=True)
     expected = np.einsum("hj,jhd->hd", w, vf[0][allowed])
     np.testing.assert_allclose(np.asarray(out[0, i], np.float64), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("window", [32, 100, 128, 1000])
+def test_flash_sliding_window_matches_reference(window):
+    """Mixtral-style sliding windows in the flash kernel (block skipping at
+    both the causal AND the window frontier) vs the XLA reference."""
+    batch, hq, hkv, d = 1, 4, 2, 64
+    q_len = kv_len = 256
+    q, k, v = _make_qkv(batch, q_len, kv_len, hq, hkv, d, seed=7)
+    assert flash_supported(q, k, v, sliding_window=window)
+    out_ref = attend_reference(q, k, v, kv_length=kv_len, sliding_window=window)
+    out_flash = flash_attend(q, k, v, kv_length=kv_len, sliding_window=window)
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_ref), atol=2e-5, rtol=1e-5
+    )
+
+
+def test_flash_sliding_window_chunked_offset():
+    """Windowed chunked prefill: the second chunk's window reaches back into
+    the previous chunk's kv positions but not past it."""
+    batch, hq, hkv, d = 1, 4, 4, 64
+    total, chunk, window = 256, 128, 96
+    q, k, v = _make_qkv(batch, total, total, hq, hkv, d, seed=8)
+    full = attend_reference(q, k, v, kv_length=total, sliding_window=window)
+    chunk2 = flash_attend(
+        q[:, chunk:], k, v, q_offset=chunk, kv_length=total, sliding_window=window
+    )
+    np.testing.assert_allclose(
+        np.asarray(chunk2), np.asarray(full[:, chunk:]), atol=2e-5, rtol=1e-5
+    )
+
+
+def test_attend_routes_sliding_window_to_flash():
+    """attend(use_flash=True) no longer falls back to the XLA path for
+    sliding-window models (the Mixtral long-context gap)."""
+    from unittest import mock
+
+    import petals_tpu.ops.attention as attention_mod
+
+    batch, hq, hkv, d = 1, 4, 2, 64
+    q, k, v = _make_qkv(batch, 128, 128, hq, hkv, d, seed=9)
+    calls = []
+    real = attention_mod.attend_reference
+
+    def spy_ref(*args, **kwargs):
+        calls.append("xla")
+        return real(*args, **kwargs)
+
+    with mock.patch.object(attention_mod, "attend_reference", side_effect=spy_ref):
+        from petals_tpu.ops.attention import attend
+
+        out = attend(q, k, v, sliding_window=64, use_flash=True)
+    assert calls == [], "sliding-window attention must use the flash kernel"
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(attend_reference(q, k, v, sliding_window=64)),
+        atol=2e-5, rtol=1e-5,
+    )
