@@ -45,6 +45,8 @@ __all__ = [
     "parse_uid",
     "AutoDistributedModelForCausalLM",
     "DistributedModelForCausalLM",
+    "AutoDistributedModelForSequenceClassification",
+    "DistributedModelForSequenceClassification",
     "Server",
     "DHTNode",
     "InferenceSession",
@@ -54,7 +56,12 @@ __all__ = [
 
 
 def __getattr__(name):  # lazy: client/server pull in jax & friends
-    if name in ("AutoDistributedModelForCausalLM", "DistributedModelForCausalLM"):
+    if name in (
+        "AutoDistributedModelForCausalLM",
+        "DistributedModelForCausalLM",
+        "AutoDistributedModelForSequenceClassification",
+        "DistributedModelForSequenceClassification",
+    ):
         from petals_tpu.client import model as _model
 
         return getattr(_model, name)

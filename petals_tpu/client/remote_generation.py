@@ -133,10 +133,20 @@ class RemoteGenerationMixin:
         early_stopping: bool = False,
         repetition_penalty: float = 1.0,
         no_repeat_ngram_size: int = 0,
+        min_new_tokens: int = 0,
+        num_return_sequences: int = 1,
         session=None,
         seed: Optional[int] = None,
         prompts: Optional[np.ndarray] = None,
     ) -> np.ndarray:
+        if num_return_sequences < 1:
+            raise ValueError("num_return_sequences must be >= 1")
+        if num_return_sequences > 1 and num_beams == 1:
+            raise NotImplementedError(
+                "num_return_sequences > 1 requires beam search (num_beams > 1)"
+            )
+        if num_return_sequences > num_beams:
+            raise ValueError("num_return_sequences must be <= num_beams")
         if max_length is not None:
             # HF semantics: max_length caps the TOTAL sequence length
             max_new_tokens = min(
@@ -161,6 +171,8 @@ class RemoteGenerationMixin:
                 early_stopping=early_stopping,
                 repetition_penalty=repetition_penalty,
                 no_repeat_ngram_size=no_repeat_ngram_size,
+                min_new_tokens=min_new_tokens,
+                num_return_sequences=num_return_sequences,
             )
         input_ids = np.asarray(input_ids)
         batch, prompt_len = input_ids.shape
@@ -205,6 +217,10 @@ class RemoteGenerationMixin:
                     repetition_penalty=repetition_penalty,
                     no_repeat_ngram_size=no_repeat_ngram_size,
                 )
+                if eos_token_id is not None and i < min_new_tokens:
+                    # HF MinNewTokensLengthLogitsProcessor: eos banned early
+                    scores = scores.copy()
+                    scores[:, eos_token_id] = -np.inf
                 next_token = sample_next_token(
                     scores,
                     do_sample=do_sample,
@@ -250,6 +266,8 @@ class RemoteGenerationMixin:
         early_stopping: bool = False,
         repetition_penalty: float = 1.0,
         no_repeat_ngram_size: int = 0,
+        min_new_tokens: int = 0,
+        num_return_sequences: int = 1,
     ) -> np.ndarray:
         """Beam search over the swarm with HF BeamSearchScorer semantics
         (EOS finalization, length penalty, early stopping, batch > 1); each
@@ -258,7 +276,8 @@ class RemoteGenerationMixin:
         input_ids = np.asarray(input_ids)
         batch, prompt_len = input_ids.shape
         if max_new_tokens <= 0:
-            return input_ids
+            # degenerate call: still honor the promised row count
+            return np.repeat(input_ids, num_return_sequences, axis=0)
         if pad_token_id is None:
             pad_token_id = eos_token_id
         max_length = prompt_len + max_new_tokens
@@ -288,6 +307,9 @@ class RemoteGenerationMixin:
                     repetition_penalty=repetition_penalty,
                     no_repeat_ngram_size=no_repeat_ngram_size,
                 )
+                if eos_token_id is not None and _step < min_new_tokens:
+                    logprobs = logprobs.copy()
+                    logprobs[:, eos_token_id] = -np.inf
                 vocab = logprobs.shape[-1]
                 totals = beam_scores.reshape(lanes, 1) + logprobs  # [lanes, vocab]
                 cur_len = sequences.shape[1]
@@ -356,7 +378,19 @@ class RemoteGenerationMixin:
                     generated_len=sequences.shape[1] - prompt_len,
                 )
 
-        best = [max(hyps[b].beams, key=lambda item: item[0])[1] for b in range(batch)]
+        # HF layout: batch * num_return_sequences rows, each batch's finished
+        # hypotheses in descending score order
+        best = []
+        for b in range(batch):
+            # HF finalize sorts ascending (stable) and pops from the end, so
+            # among EXACT score ties the last-added hypothesis ranks first —
+            # encode that as (score, insertion_index) descending
+            ranked = sorted(
+                enumerate(hyps[b].beams),
+                key=lambda kv: (kv[1][0], kv[0]),
+                reverse=True,
+            )
+            best.extend(item[1] for _, item in ranked[:num_return_sequences])
         sent_lengths = [len(seq) for seq in best]
         out_len = min(max(sent_lengths), max_length)
         # HF's output_fill_value, quirk included: a FALSY pad_token_id (0) is
@@ -367,9 +401,9 @@ class RemoteGenerationMixin:
             fill = pad_token_id
         else:
             fill = 0  # without eos every row has full length; never visible
-        decoded = np.full((batch, out_len), fill, np.int64)
-        for b, seq in enumerate(best):
-            decoded[b, : sent_lengths[b]] = seq[:out_len]
+        decoded = np.full((len(best), out_len), fill, np.int64)
+        for row, seq in enumerate(best):
+            decoded[row, : sent_lengths[row]] = seq[:out_len]
         return decoded
 
 

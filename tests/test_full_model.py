@@ -334,6 +334,42 @@ def test_eos_padding_and_max_length_match_hf(llama_client):
         np.testing.assert_array_equal(ours, expected, err_msg=str(beam_kwargs))
 
 
+def test_num_return_sequences_and_min_new_tokens_match_hf(llama_client):
+    """num_return_sequences (ranked beam outputs) and min_new_tokens (EOS ban
+    until the minimum) must be token-identical to HF."""
+    from transformers import AutoModelForCausalLM
+
+    path, model = llama_client
+    rng = np.random.RandomState(15)
+    input_ids = rng.randint(1, 100, (1, 5)).astype(np.int64)
+    hf = AutoModelForCausalLM.from_pretrained(path, dtype=torch.float32).eval()
+
+    kwargs = dict(max_new_tokens=6, num_beams=4, num_return_sequences=3)
+    with torch.no_grad():
+        expected = hf.generate(torch.from_numpy(input_ids), do_sample=False, **kwargs).numpy()
+    ours = model.generate(input_ids, **kwargs)
+    assert ours.shape[0] == 3
+    np.testing.assert_array_equal(ours, expected)
+
+    # min_new_tokens with an eos that would otherwise fire immediately
+    with torch.no_grad():
+        free = hf.generate(
+            torch.from_numpy(input_ids), max_new_tokens=6, do_sample=False
+        ).numpy()
+    eos = int(free[0, 5])  # the very first generated token
+    for kwargs in (
+        dict(max_new_tokens=6, eos_token_id=eos, pad_token_id=0, min_new_tokens=3),
+        dict(max_new_tokens=6, num_beams=3, eos_token_id=eos, pad_token_id=0,
+             min_new_tokens=3),
+    ):
+        with torch.no_grad():
+            expected = hf.generate(
+                torch.from_numpy(input_ids), do_sample=False, **kwargs
+            ).numpy()
+        ours = model.generate(input_ids, **kwargs)
+        np.testing.assert_array_equal(ours, expected, err_msg=str(kwargs))
+
+
 def test_repetition_penalties_match_hf(llama_client):
     """repetition_penalty and no_repeat_ngram_size in greedy decoding must be
     token-identical to HF's logits processors."""
