@@ -105,9 +105,17 @@ def _process_scores(
     *,
     repetition_penalty: float = 1.0,
     no_repeat_ngram_size: int = 0,
+    ban_eos_token_id: Optional[int] = None,
 ) -> np.ndarray:
+    """HF logits-processor pipeline, in HF's order; ``ban_eos_token_id`` is
+    the MinNewTokensLengthLogitsProcessor ban (pass it while the generated
+    count is below min_new_tokens)."""
     scores = apply_repetition_penalty(scores, generated, repetition_penalty)
-    return apply_no_repeat_ngram(scores, generated, no_repeat_ngram_size)
+    scores = apply_no_repeat_ngram(scores, generated, no_repeat_ngram_size)
+    if ban_eos_token_id is not None:
+        scores = scores.copy()
+        scores[:, ban_eos_token_id] = -np.inf
+    return scores
 
 
 class RemoteGenerationMixin:
@@ -143,7 +151,8 @@ class RemoteGenerationMixin:
             raise ValueError("num_return_sequences must be >= 1")
         if num_return_sequences > 1 and num_beams == 1:
             raise NotImplementedError(
-                "num_return_sequences > 1 requires beam search (num_beams > 1)"
+                "num_return_sequences > 1 is only implemented for deterministic "
+                "beam search (set num_beams > 1 and do_sample=False)"
             )
         if num_return_sequences > num_beams:
             raise ValueError("num_return_sequences must be <= num_beams")
@@ -216,11 +225,10 @@ class RemoteGenerationMixin:
                     logits, generated,
                     repetition_penalty=repetition_penalty,
                     no_repeat_ngram_size=no_repeat_ngram_size,
+                    ban_eos_token_id=(
+                        eos_token_id if i < min_new_tokens else None
+                    ),
                 )
-                if eos_token_id is not None and i < min_new_tokens:
-                    # HF MinNewTokensLengthLogitsProcessor: eos banned early
-                    scores = scores.copy()
-                    scores[:, eos_token_id] = -np.inf
                 next_token = sample_next_token(
                     scores,
                     do_sample=do_sample,
@@ -306,10 +314,10 @@ class RemoteGenerationMixin:
                     logprobs, sequences,
                     repetition_penalty=repetition_penalty,
                     no_repeat_ngram_size=no_repeat_ngram_size,
+                    ban_eos_token_id=(
+                        eos_token_id if _step < min_new_tokens else None
+                    ),
                 )
-                if eos_token_id is not None and _step < min_new_tokens:
-                    logprobs = logprobs.copy()
-                    logprobs[:, eos_token_id] = -np.inf
                 vocab = logprobs.shape[-1]
                 totals = beam_scores.reshape(lanes, 1) + logprobs  # [lanes, vocab]
                 cur_len = sequences.shape[1]

@@ -81,6 +81,39 @@ def test_bloom_cls_forward_matches_hf(tmp_path):
         harness.stop()
 
 
+def test_falcon_cls_forward_matches_hf(tmp_path):
+    from transformers import FalconConfig, FalconForSequenceClassification
+
+    cfg = FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=3, num_attention_heads=4,
+        layer_norm_epsilon=1e-5, new_decoder_architecture=True, num_kv_heads=2,
+        multi_query=False, parallel_attn=True, bias=False, alibi=False,
+        num_labels=3, pad_token_id=0,
+    )
+    torch.manual_seed(6)
+    hf = FalconForSequenceClassification(cfg).eval()
+    path = str(tmp_path / "tiny-falcon-cls")
+    hf.save_pretrained(path, safe_serialization=True)
+
+    harness = SwarmHarness(path, [dict(first_block=0, num_blocks=3)]).start()
+    try:
+        model = AutoDistributedModelForSequenceClassification.from_pretrained(
+            path, initial_peers=harness.initial_peers
+        )
+        try:
+            rng = np.random.RandomState(4)
+            input_ids = rng.randint(1, 100, (2, 6)).astype(np.int64)
+            input_ids[0, 4:] = 0  # padded tail
+            ours = np.asarray(model.forward(input_ids))
+            with torch.no_grad():
+                expected = hf(torch.from_numpy(input_ids)).logits.numpy()
+            np.testing.assert_allclose(ours, expected, atol=2e-4, rtol=0)
+        finally:
+            model.close()
+    finally:
+        harness.stop()
+
+
 def test_falcon_family_has_cls_hooks():
     from petals_tpu.models.registry import get_family
 
