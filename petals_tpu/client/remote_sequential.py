@@ -27,17 +27,48 @@ class RemoteSequential:
         block_uids: Sequence[ModuleUID],
         *,
         runtime: Optional[SwarmRuntime] = None,
+        dht=None,
     ):
         self.config = config
         self.block_uids = tuple(block_uids)
         self._owns_runtime = runtime is None
         self.runtime = runtime or SwarmRuntime()
         self.sequence_manager: RemoteSequenceManager = self.runtime.run(
-            RemoteSequenceManager.create(config, self.block_uids)
+            RemoteSequenceManager.create(config, self.block_uids, dht=dht)
         )
 
     def __len__(self) -> int:
         return len(self.block_uids)
+
+    def __getitem__(self, index) -> "RemoteSequential":
+        """A sub-chain over a contiguous block range (the reference's
+        RemoteSequential slicing, used for custom pipelines). The slice shares
+        this instance's runtime and DHT node but OWNS its router (background
+        refresh + connections): close() it when done, or use it as a context
+        manager. Closing a slice never tears down the parent."""
+        if isinstance(index, int):
+            if index < 0:
+                index += len(self)
+            if not 0 <= index < len(self):
+                raise IndexError("RemoteSequential index out of range")
+            index = slice(index, index + 1)
+        if not isinstance(index, slice):
+            raise TypeError(f"Expected int or slice, got {type(index).__name__}")
+        start, stop, step = index.indices(len(self))
+        if step != 1 or stop <= start:
+            raise ValueError("RemoteSequential slices must be contiguous and non-empty")
+        return RemoteSequential(
+            self.config,
+            self.block_uids[start:stop],
+            runtime=self.runtime,
+            dht=self.sequence_manager.dht,
+        )
+
+    def __enter__(self) -> "RemoteSequential":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def forward(self, hidden: np.ndarray, prompts: Optional[np.ndarray] = None) -> np.ndarray:
         """Training-style forward (no server-side state); fault-tolerant."""

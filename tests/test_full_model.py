@@ -221,6 +221,37 @@ def test_falcon_full_model(tmp_path_factory):
         harness.stop()
 
 
+def test_remote_sequential_slicing(llama_client):
+    """remote[1:3] is a live sub-chain (reference RemoteSequential slicing):
+    its forward matches the local blocks 1..2, and closing the slice leaves
+    the parent connected."""
+    import jax.numpy as jnp
+
+    from petals_tpu.server.from_pretrained import get_block_config, load_block_params
+
+    path, model = llama_client
+    family, cfg = get_block_config(path)
+    with pytest.raises(IndexError):
+        model.remote[99]
+    sub = model.remote[1:3]
+    try:
+        assert len(sub) == 2
+        rng = np.random.RandomState(21)
+        hidden = rng.randn(1, 5, cfg.hidden_size).astype(np.float32)
+        out = np.asarray(sub.forward(hidden))
+        h = jnp.asarray(hidden)
+        for i in (1, 2):
+            h, _ = family.block_apply(
+                load_block_params(path, i, dtype=jnp.float32), h, None, 0, cfg
+            )
+        np.testing.assert_allclose(out, np.asarray(h), atol=1e-4, rtol=0)
+    finally:
+        sub.close()
+    # parent still works after the slice is closed
+    ids = np.random.RandomState(2).randint(0, 100, (1, 4)).astype(np.int64)
+    assert model.generate(ids, max_new_tokens=2).shape == (1, 6)
+
+
 def test_beam_search_matches_hf(llama_client):
     """Beam search with server-side KV lane reorder (hypo_ids) must match HF's
     beam search token-for-token (reference test_full_model.py beam coverage)."""
