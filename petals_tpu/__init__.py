@@ -43,6 +43,8 @@ __all__ = [
     "ServerInfo",
     "ServerState",
     "parse_uid",
+    "AutoDistributedModel",
+    "DistributedModel",
     "AutoDistributedModelForCausalLM",
     "DistributedModelForCausalLM",
     "AutoDistributedModelForSequenceClassification",
@@ -57,6 +59,8 @@ __all__ = [
 
 def __getattr__(name):  # lazy: client/server pull in jax & friends
     if name in (
+        "AutoDistributedModel",
+        "DistributedModel",
         "AutoDistributedModelForCausalLM",
         "DistributedModelForCausalLM",
         "AutoDistributedModelForSequenceClassification",

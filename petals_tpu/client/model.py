@@ -47,6 +47,31 @@ class _DistributedModelBase(PTuneMixin):
         self._head_jit = jax.jit(lambda p, h: head_fn(p, h, cfg))
         self.init_ptune(ptune)
 
+    _drop_head = False  # bare models never use the LM head: don't keep it
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        model_name_or_path: str,
+        *,
+        initial_peers: Sequence[str],
+        config: Optional[ClientConfig] = None,
+        dht_prefix: Optional[str] = None,
+        dtype=jnp.float32,
+        ptune: Optional[PTuneConfig] = None,
+        **config_overrides,
+    ):
+        family, cfg = get_block_config(model_name_or_path)
+        client_params = load_client_params(model_name_or_path, dtype=dtype, family=family, cfg=cfg)
+        if cls._drop_head:
+            # the head matrix is ~[hidden, vocab] (hundreds of MB on real
+            # models) and the bare-model surface never projects to the vocab
+            client_params.pop("head", None)
+        remote = cls._build_remote(
+            model_name_or_path, initial_peers, config, dht_prefix, config_overrides, cfg
+        )
+        return cls(family, cfg, client_params, remote, ptune=ptune)
+
     @classmethod
     def _build_remote(
         cls, model_name_or_path, initial_peers, config, dht_prefix, config_overrides, cfg
@@ -73,25 +98,6 @@ class DistributedModelForCausalLM(RemoteGenerationMixin, _DistributedModelBase):
             family, cfg, client_params, remote, family.client_head, ptune=ptune
         )
 
-    @classmethod
-    def from_pretrained(
-        cls,
-        model_name_or_path: str,
-        *,
-        initial_peers: Sequence[str],
-        config: Optional[ClientConfig] = None,
-        dht_prefix: Optional[str] = None,
-        dtype=jnp.float32,
-        ptune: Optional[PTuneConfig] = None,
-        **config_overrides,
-    ) -> "DistributedModelForCausalLM":
-        family, cfg = get_block_config(model_name_or_path)
-        client_params = load_client_params(model_name_or_path, dtype=dtype, family=family, cfg=cfg)
-        remote = cls._build_remote(
-            model_name_or_path, initial_peers, config, dht_prefix, config_overrides, cfg
-        )
-        return cls(family, cfg, client_params, remote, ptune=ptune)
-
     # ------------------------------------------------------------------ local compute
 
     def lm_logits(self, hidden) -> jnp.ndarray:
@@ -105,6 +111,33 @@ class DistributedModelForCausalLM(RemoteGenerationMixin, _DistributedModelBase):
         hidden = self.remote.forward(np.asarray(hidden), prompts=self.deep_prompts_for_batch(hidden.shape[0]))
         logits = self.lm_logits(hidden)
         return self.strip_shallow_prompt_logits(logits)
+
+    __call__ = forward
+
+
+class DistributedModel(_DistributedModelBase):
+    """The bare *Model surface (reference Distributed*Model, e.g.
+    models/bloom/model.py DistributedBloomModel): embeddings local, blocks
+    remote, final norm local — forward returns last_hidden_state."""
+
+    _drop_head = True
+
+    def __init__(self, family, cfg, client_params, remote, *, ptune=None):
+        if family.client_norm is None:
+            raise NotImplementedError(f"{family.name} has no client_norm hook")
+        super().__init__(
+            family, cfg, client_params, remote, family.client_norm, ptune=ptune
+        )
+
+    def forward(self, input_ids) -> jnp.ndarray:
+        """last_hidden_state [batch, seq, hidden] (post final norm), matching
+        HF's *Model forward."""
+        hidden = self.embed(input_ids)
+        hidden = self.remote.forward(
+            np.asarray(hidden), prompts=self.deep_prompts_for_batch(hidden.shape[0])
+        )
+        normed = self._head_jit(self.client_params, jnp.asarray(hidden))
+        return self.strip_shallow_prompt_logits(normed)
 
     __call__ = forward
 
@@ -209,6 +242,14 @@ class AutoDistributedModelForCausalLM:
     @classmethod
     def from_pretrained(cls, model_name_or_path: str, **kwargs) -> DistributedModelForCausalLM:
         return DistributedModelForCausalLM.from_pretrained(model_name_or_path, **kwargs)
+
+
+class AutoDistributedModel:
+    """Auto-class counterpart for the bare (last_hidden_state) model."""
+
+    @classmethod
+    def from_pretrained(cls, model_name_or_path: str, **kwargs) -> DistributedModel:
+        return DistributedModel.from_pretrained(model_name_or_path, **kwargs)
 
 
 class AutoDistributedModelForSequenceClassification:

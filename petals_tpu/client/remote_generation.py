@@ -124,6 +124,32 @@ class RemoteGenerationMixin:
 
     _active_session = None
 
+    def inference_session(self, max_length: int, batch_size: int = 1):
+        """Open a session that generate() picks up automatically inside the
+        block (the reference's ``with model.inference_session(...)`` chat
+        pattern)::
+
+            with model.inference_session(max_length=128) as sess:
+                out = model.generate(ids, max_new_tokens=8)      # uses sess
+                out = model.generate(out, max_new_tokens=8)      # continues it
+        """
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            session = self.remote.inference_session(
+                max_length=max_length, batch_size=batch_size
+            )
+            previous = self._active_session
+            self._active_session = session
+            try:
+                with session:
+                    yield session
+            finally:
+                self._active_session = previous
+
+        return scope()
+
     def generate(
         self,
         input_ids: np.ndarray,  # [batch, seq] int
@@ -164,8 +190,11 @@ class RemoteGenerationMixin:
         if num_beams > 1:
             # explicit rejections beat silent divergence from HF semantics
             assert not do_sample, "beam search is deterministic (use num_beams=1 to sample)"
-            if session is not None:
-                raise NotImplementedError("beam search opens its own session (session= unsupported)")
+            if session is not None or self._active_session is not None:
+                raise NotImplementedError(
+                    "beam search opens its own session; it cannot run with an "
+                    "explicit session= or inside model.inference_session(...)"
+                )
             ptune = getattr(self, "ptune", None)
             if ptune is not None and ptune.tuning_mode:
                 raise NotImplementedError("beam search with prompt tuning is not supported yet")

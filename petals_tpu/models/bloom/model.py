@@ -70,7 +70,7 @@ def client_head(params: dict, hidden, cfg: BloomBlockConfig):
 # -- sequence classification (reference models/bloom/model.py
 # DistributedBloomForSequenceClassification: score head over ln_f output)
 
-from petals_tpu.models.client_common import ln_f_cls_head, score_matrix  # noqa: E402
+from petals_tpu.models.client_common import ln_f_client_norm, ln_f_cls_head, score_matrix  # noqa: E402
 
 CLS_PREFIXES = tuple(p for p in CLIENT_PREFIXES if p != "lm_head.") + ("score.",)
 
@@ -79,6 +79,10 @@ def hf_to_cls_params(tensors: dict, cfg: BloomBlockConfig) -> dict:
     params = _base_client_params(tensors, cfg)
     params["score"] = score_matrix(tensors)
     return params
+
+
+def client_norm(params: dict, hidden, cfg):
+    return ln_f_client_norm(params, hidden, cfg.layer_norm_epsilon)
 
 
 def cls_head(params: dict, hidden, cfg: BloomBlockConfig):
@@ -92,6 +96,7 @@ FAMILY = register_family(
         hf_to_client_params=hf_to_client_params,
         client_embed=client_embed,
         client_head=client_head,
+        client_norm=client_norm,
         hf_cls_prefixes=CLS_PREFIXES,
         hf_to_cls_params=hf_to_cls_params,
         cls_head=cls_head,

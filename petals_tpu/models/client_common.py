@@ -25,6 +25,11 @@ def llama_style_client_embed(params: dict, input_ids, cfg):
     return jnp.take(params["embed"], jnp.asarray(input_ids), axis=0)
 
 
+def llama_style_client_norm(params: dict, hidden, cfg):
+    """Final RMSNorm only (the *Model surface: last_hidden_state, no head)."""
+    return rms_norm(jnp.asarray(hidden), params["norm"], cfg.rms_norm_eps)
+
+
 def llama_style_client_head(params: dict, hidden, cfg):
     normed = rms_norm(jnp.asarray(hidden), params["norm"], cfg.rms_norm_eps)
     return jnp.dot(
@@ -67,6 +72,13 @@ def llama_style_cls_head(params: dict, hidden, cfg):
 def score_matrix(tensors: dict) -> np.ndarray:
     """HF stores score as [num_labels, hidden]; we keep [hidden, num_labels]."""
     return np.ascontiguousarray(np.asarray(tensors["score.weight"]).T)
+
+
+def ln_f_client_norm(params: dict, hidden, eps: float):
+    """Final ln_f only (the *Model surface: last_hidden_state, no head)."""
+    from petals_tpu.models.common import layer_norm
+
+    return layer_norm(jnp.asarray(hidden), params["ln_f_w"], params["ln_f_b"], eps)
 
 
 def ln_f_cls_head(params: dict, hidden, eps: float):

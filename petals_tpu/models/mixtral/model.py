@@ -12,6 +12,7 @@ from petals_tpu.models.client_common import (
     LLAMA_STYLE_CLS_PREFIXES,
     llama_style_client_embed,
     llama_style_client_head,
+    llama_style_client_norm,
     llama_style_cls_head,
     llama_style_hf_to_client_params,
     llama_style_hf_to_cls_params,
@@ -25,6 +26,7 @@ FAMILY = register_family(
         hf_to_client_params=llama_style_hf_to_client_params,
         client_embed=llama_style_client_embed,
         client_head=llama_style_client_head,
+        client_norm=llama_style_client_norm,
         hf_cls_prefixes=LLAMA_STYLE_CLS_PREFIXES,
         hf_to_cls_params=llama_style_hf_to_cls_params,
         cls_head=llama_style_cls_head,

@@ -28,6 +28,7 @@ class ModelFamily:
     hf_to_client_params: Optional[Callable] = None  # (dict, cfg) -> params pytree
     client_embed: Optional[Callable] = None  # (params, input_ids, cfg) -> hidden
     client_head: Optional[Callable] = None  # (params, hidden, cfg) -> logits (f32)
+    client_norm: Optional[Callable] = None  # (params, hidden, cfg) -> final-norm'd hidden
     # Sequence classification (reference models/*/model.py *ForSequenceClassification):
     hf_cls_prefixes: tuple = ()  # checkpoint prefixes incl. the score head
     hf_to_cls_params: Optional[Callable] = None  # (dict, cfg) -> params pytree

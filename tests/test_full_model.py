@@ -221,6 +221,42 @@ def test_falcon_full_model(tmp_path_factory):
         harness.stop()
 
 
+def test_bare_distributed_model_matches_hf(llama_swarm):
+    """DistributedModel (the reference's bare Distributed*Model): forward is
+    HF's last_hidden_state, post final norm, no head."""
+    from transformers import AutoModel
+
+    from petals_tpu.client.model import AutoDistributedModel
+
+    path, harness = llama_swarm
+    model = AutoDistributedModel.from_pretrained(path, initial_peers=harness.initial_peers)
+    try:
+        rng = np.random.RandomState(19)
+        input_ids = rng.randint(0, 100, (2, 7)).astype(np.int64)
+        ours = np.asarray(model.forward(input_ids))
+        hf = AutoModel.from_pretrained(path, dtype=torch.float32).eval()
+        with torch.no_grad():
+            expected = hf(torch.from_numpy(input_ids)).last_hidden_state.numpy()
+        np.testing.assert_allclose(ours, expected, atol=2e-4, rtol=0)
+    finally:
+        model.close()
+
+
+def test_model_level_inference_session(llama_client):
+    """with model.inference_session(...): generate() picks up the active
+    session automatically (the reference's chat pattern)."""
+    path, model = llama_client
+    rng = np.random.RandomState(22)
+    input_ids = rng.randint(0, 100, (1, 4)).astype(np.int64)
+
+    with model.inference_session(max_length=32) as session:
+        first = model.generate(input_ids, max_new_tokens=3)
+        assert model._active_session is session
+        second = model.generate(first, max_new_tokens=3)
+    assert model._active_session is None
+    np.testing.assert_array_equal(second, _hf_greedy(path, input_ids, 6))
+
+
 def test_remote_sequential_slicing(llama_client):
     """remote[1:3] is a live sub-chain (reference RemoteSequential slicing):
     its forward matches the local blocks 1..2, and closing the slice leaves
