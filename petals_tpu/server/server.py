@@ -145,7 +145,34 @@ class Server:
 
     # ------------------------------------------------------------------ lifecycle
 
+    @staticmethod
+    def enable_compilation_cache() -> Optional[str]:
+        """Point XLA's persistent compilation cache at our disk cache so a
+        restarted server re-uses every compiled step executable instead of
+        paying tens of seconds per shape bucket again (the TPU analogue of the
+        reference warm-start concerns; disable with
+        PETALS_TPU_NO_COMPILATION_CACHE=1)."""
+        import os
+
+        if os.environ.get("PETALS_TPU_NO_COMPILATION_CACHE"):
+            return None
+        from petals_tpu.utils.disk_cache import DEFAULT_CACHE_DIR
+
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or str(
+            DEFAULT_CACHE_DIR / "xla_cache"
+        )
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            if not os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"):
+                # operator's env setting wins; otherwise skip sub-second compiles
+                jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception as e:  # older jax: feature-gate, never fail startup
+            logger.debug(f"Compilation cache unavailable: {e}")
+            return None
+        return cache_dir
+
     async def start(self) -> None:
+        self.enable_compilation_cache()
         from petals_tpu.dht.identity import Identity
 
         identity = (

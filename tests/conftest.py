@@ -11,6 +11,10 @@ jax but before any backend is initialized.
 import os
 import sys
 
+# hermetic tests: no persistent XLA cache in the developer's real ~/.cache
+# (the compilation-cache test opts back in explicitly with its own tmp dir)
+os.environ.setdefault("PETALS_TPU_NO_COMPILATION_CACHE", "1")
+
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()

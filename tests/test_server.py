@@ -300,3 +300,45 @@ def test_server_announces_to_dht(model_path):
             await boot.shutdown()
 
     run(main())
+
+
+def test_compilation_cache_persists_executables(tmp_path, monkeypatch):
+    """The persistent XLA cache fills with compiled step executables, so a
+    restarted server skips recompilation (PETALS_TPU_NO_COMPILATION_CACHE
+    opts out)."""
+    import jax
+
+    # conftest gates the cache off for hermeticity; opt back in with a tmp dir
+    monkeypatch.delenv("PETALS_TPU_NO_COMPILATION_CACHE", raising=False)
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path / "xla_cache"))
+
+    def _reset():  # best-effort de-init of the once-per-process singleton
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+
+    _reset()
+    assert Server.enable_compilation_cache() == str(tmp_path / "xla_cache")
+    # lower the persistence threshold so the tiny test program qualifies
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return (x @ x).sum()
+
+        jax.block_until_ready(step(jnp.ones((64, 64))))
+        cache_files = list((tmp_path / "xla_cache").rglob("*"))
+        assert cache_files, "compilation cache must be populated"
+    finally:
+        # restore process-wide state: later tests must not write to tmp_path
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset()
+
+    monkeypatch.setenv("PETALS_TPU_NO_COMPILATION_CACHE", "1")
+    assert Server.enable_compilation_cache() is None
