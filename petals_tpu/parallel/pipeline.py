@@ -1,0 +1,132 @@
+"""SPMD pipeline parallelism: a real microbatch schedule for the "pp" mesh axis.
+
+The reference's pipeline parallelism is inter-server (client-routed spans,
+SURVEY.md §2.2) and has no intra-step schedule; round 1 of this build sharded
+the stacked layer axis over "pp" inside one jit, which places weights but
+leaves every stage idle while the `lax.scan` carry walks through it. This
+module implements the real thing, TPU-style: a GPipe/1F1B-family microbatch
+schedule expressed in pure SPMD so XLA compiles stage compute and the
+stage-to-stage hop into overlapping device programs:
+
+- Stage s holds layers [s*L/S, (s+1)*L/S) — the stacked layer axis is
+  reshaped to [S, L/S, ...] and sharded over "pp" on the stage axis.
+- Each schedule step runs ``vmap(stage_fn)`` over the stage axis: with the
+  stage axis sharded, GSPMD turns the vmap into "every stage computes its
+  resident microbatch simultaneously" — the overlap 1F1B exists for.
+- Activations advance one stage per step via ``jnp.roll`` on the pp-sharded
+  stage axis, which XLA lowers to a single ICI ``CollectivePermute``.
+- After M microbatches + (S-1) bubble steps, outputs are collected from the
+  last stage. Differentiating through the schedule replays it in reverse
+  (the cotangent CollectivePermutes run backward) — pipelined backward for
+  free, with activations rematerialized by XLA where cheaper.
+
+Bubble fraction is the textbook (S-1)/(M+S-1); pick M >= S for efficiency.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def microbatch_split(x: jnp.ndarray, num_microbatches: int) -> jnp.ndarray:
+    """[batch, ...] -> [M, batch/M, ...] (M must divide batch)."""
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(f"batch {batch} does not divide into {num_microbatches} microbatches")
+    return x.reshape(num_microbatches, batch // num_microbatches, *x.shape[1:])
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    params: Any,
+    x: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    axis: str = "pp",
+    microbatch_spec: P | None = None,
+    param_specs: Any | None = None,
+) -> jnp.ndarray:
+    """Run microbatches through a pipeline of stages sharded over ``axis``.
+
+    Args:
+      stage_fn: ``(stage_params, h) -> h`` applying one stage's layer slice
+        (typically a ``lax.scan`` over the [L/S, ...] leaves it receives).
+      params: pytree whose leaves lead with the stacked layer axis [L, ...];
+        the axis size S must divide L. Leaves are reshaped to [S, L/S, ...]
+        and constrained to shard the stage axis over ``axis``.
+      x: microbatched input [M, ...single-microbatch shape...].
+      mesh: the device mesh (entered or passed; used for constraints).
+      axis: mesh axis name for pipeline stages.
+      microbatch_spec: PartitionSpec for one microbatch's value (e.g.
+        ``P("dp", "sp", None)``); used to keep activations sharded while they
+        move through the schedule.
+      param_specs: optional pytree of PartitionSpecs matching the STACKED
+        leaves (first entry = the layer axis, e.g. ``P("pp", "tp", None)``);
+        non-layer entries are preserved so tensor-parallel weight shardings
+        survive the stage reshape. Default: stage axis only, rest replicated.
+
+    Returns: y [M, ...] — stage_fn applied over all L layers, microbatched.
+    """
+    num_stages = mesh.shape[axis]
+    num_micro = x.shape[0]
+    mb_spec = tuple(microbatch_spec) if microbatch_spec is not None else (None,) * (x.ndim - 1)
+
+    def stack_stages(p: jnp.ndarray, spec: P | None) -> jnp.ndarray:
+        n_layers = p.shape[0]
+        if n_layers % num_stages:
+            raise ValueError(f"layer stack {n_layers} does not divide {num_stages} stages")
+        staged = p.reshape(num_stages, n_layers // num_stages, *p.shape[1:])
+        rest = tuple(spec)[1:] if spec is not None else (None,) * (p.ndim - 1)
+        rest = rest + (None,) * (p.ndim - 1 - len(rest))
+        return jax.lax.with_sharding_constraint(
+            staged, NamedSharding(mesh, P(axis, None, *rest))
+        )
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if param_specs is None:
+        spec_leaves = [None] * len(leaves)
+    else:
+        spec_leaves = jax.tree_util.tree_leaves(
+            param_specs, is_leaf=lambda s: s is None or isinstance(s, P)
+        )
+        if len(spec_leaves) != len(leaves):
+            raise ValueError("param_specs structure does not match params")
+    params_staged = jax.tree_util.tree_unflatten(
+        treedef, [stack_stages(p, s) for p, s in zip(leaves, spec_leaves)]
+    )
+    if num_stages == 1:
+        return jax.vmap(lambda mb: stage_fn(jax.tree_util.tree_map(lambda p: p[0], params_staged), mb))(x)
+
+    buf_sharding = NamedSharding(mesh, P(axis, *mb_spec))
+    total_steps = num_micro + num_stages - 1
+
+    buf0 = jax.lax.with_sharding_constraint(
+        jnp.zeros((num_stages, *x.shape[1:]), x.dtype), buf_sharding
+    )
+    out0 = jnp.zeros((total_steps, *x.shape[1:]), x.dtype)
+
+    def step(carry, t):
+        buf, out = carry
+        # feed the next microbatch into stage 0 (clamped re-reads past the end
+        # are never collected, and their cotangents are zero)
+        x_t = jax.lax.dynamic_index_in_dim(
+            x, jnp.clip(t, 0, num_micro - 1), 0, keepdims=False
+        )
+        buf = jax.lax.dynamic_update_index_in_dim(buf, x_t.astype(buf.dtype), 0, 0)
+        buf = jax.lax.with_sharding_constraint(buf, buf_sharding)
+        y = jax.vmap(stage_fn)(params_staged, buf)
+        y = jax.lax.with_sharding_constraint(y, buf_sharding)
+        # the last stage's result is microbatch t-(S-1); collect every step and
+        # slice off the warm-up garbage at the end
+        out = jax.lax.dynamic_update_index_in_dim(out, y[-1], t, 0)
+        # advance the pipeline: stage s+1's next input is stage s's output
+        # (roll on the pp-sharded stage axis == ICI collective-permute)
+        buf = jnp.roll(y, 1, axis=0)
+        return (buf, out), None
+
+    (_, out), _ = jax.lax.scan(step, (buf0, out0), jnp.arange(total_steps))
+    return out[num_stages - 1 :]
