@@ -16,6 +16,19 @@ from petals_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
+def _local_ip() -> str:
+    """Best-effort primary interface address (no packets are sent: connecting
+    a UDP socket only selects a route)."""
+    import socket
+
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description="Bootstrap/relay node for a petals_tpu swarm")
     parser.add_argument("--host", default="0.0.0.0")
@@ -25,6 +38,10 @@ def main(argv=None) -> None:
                         help="Seed string for a deterministic peer id (stable multiaddr)")
     parser.add_argument("--refresh_period", type=float, default=30.0,
                         help="Period of the liveness self-check (reference run_dht.py:24-34)")
+    parser.add_argument("--no_relay", action="store_true",
+                        help="Do not run a relay service for NAT'd servers (rpc/relay.py)")
+    parser.add_argument("--relay_port", type=int, default=0,
+                        help="Listen port for the relay service (default: ephemeral)")
     args = parser.parse_args(argv)
 
     async def run():
@@ -35,8 +52,21 @@ def main(argv=None) -> None:
             identity_seed=args.identity_seed.encode() if args.identity_seed else None,
         )
         ReachabilityProtocol().register(node.server)
+        relay = None
+        if not args.no_relay:
+            from petals_tpu.rpc.relay import RelayServer
+
+            relay = RelayServer(host=args.host, port=args.relay_port)
+            await relay.start()
+            relay.register_on(node.server)
+            logger.info(f"Relay service at {relay.host}:{relay.port} (--relay_via target)")
         logger.info(f"DHT bootstrap running at {node.own_addr.to_string()}")
         print(node.own_addr.to_string(), flush=True)  # scripts consume this line
+        if relay is not None:
+            # 0.0.0.0 is a listen address, not a dialable one: print something
+            # an operator can paste into --relay_via from another machine
+            relay_host = relay.host if relay.host not in ("0.0.0.0", "::") else _local_ip()
+            print(f"relay {relay_host}:{relay.port}", flush=True)
 
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
@@ -51,6 +81,8 @@ def main(argv=None) -> None:
         task = asyncio.create_task(heartbeat())
         await stop.wait()
         task.cancel()
+        if relay is not None:
+            await relay.stop()
         await node.shutdown()
 
     asyncio.run(run())

@@ -62,6 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Hub/checkpoint cache budget, e.g. 300GB (LRU-evicted)")
     parser.add_argument("--token", default=None,
                         help="HF Hub access token for gated/private repos (or set HF_TOKEN)")
+    parser.add_argument("--relay_via", default=None,
+                        help="host:port of a relay peer (run_dht prints one): serve from behind "
+                             "NAT/firewall with no inbound listener (rpc/relay.py)")
     parser.add_argument("--trace_dir", default=None,
                         help="Capture a bounded jax device trace here at startup "
                              "(or set PETALS_TPU_TRACE_DIR)")
@@ -136,11 +139,12 @@ def main(argv=None) -> None:
         quant_type=args.quant_type,
         adapters=args.adapters,
         compression=args.compression,
+        relay_via=args.relay_via,
     )
 
     async def run():
         await server.start()
-        logger.info(f"Serving; announce address: {server.dht.own_addr.to_string()}")
+        logger.info(f"Serving; announce address: {server.contact_addr.to_string()}")
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):

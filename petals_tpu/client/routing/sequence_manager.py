@@ -416,7 +416,7 @@ class RemoteSequenceManager:
         addr = self.addr_of(peer_id)
         if addr is None:
             raise KeyError(f"No known contact address for {peer_id}")
-        return await self.pool.get(addr.host, addr.port)
+        return await self.pool.get_addr(addr)
 
     async def shutdown(self) -> None:
         self._update_task.cancel()

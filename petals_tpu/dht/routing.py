@@ -22,27 +22,41 @@ DEFAULT_BUCKET_SIZE = 20
 @dataclasses.dataclass(frozen=True)
 class PeerAddr:
     """Contact info of a DHT peer. Textual form: host:port/peer_id_hex
-    (the framework's "multiaddr")."""
+    (the framework's "multiaddr"). ``relayed=True`` means host:port is a
+    RELAY (rpc/relay.py) through which the peer must be dialed — the analogue
+    of the reference's libp2p relay circuit addresses; textual form
+    relay+host:port/peer_id_hex."""
 
     host: str
     port: int
     peer_id: PeerID
+    relayed: bool = False
 
     def to_string(self) -> str:
-        return f"{self.host}:{self.port}/{self.peer_id.to_string()}"
+        prefix = "relay+" if self.relayed else ""
+        return f"{prefix}{self.host}:{self.port}/{self.peer_id.to_string()}"
 
     @classmethod
     def from_string(cls, s: str) -> "PeerAddr":
+        relayed = s.startswith("relay+")
+        if relayed:
+            s = s[len("relay+"):]
         hostport, peer_hex = s.rsplit("/", 1)
         host, port = hostport.rsplit(":", 1)
-        return cls(host=host, port=int(port), peer_id=PeerID.from_string(peer_hex))
+        return cls(host=host, port=int(port), peer_id=PeerID.from_string(peer_hex), relayed=relayed)
 
     def to_wire(self) -> list:
-        return [self.host, self.port, self.peer_id.to_string()]
+        wire = [self.host, self.port, self.peer_id.to_string()]
+        if self.relayed:
+            wire.append(True)  # omitted when direct: wire compat with old peers
+        return wire
 
     @classmethod
     def from_wire(cls, obj) -> "PeerAddr":
-        return cls(host=obj[0], port=int(obj[1]), peer_id=PeerID.from_string(obj[2]))
+        return cls(
+            host=obj[0], port=int(obj[1]), peer_id=PeerID.from_string(obj[2]),
+            relayed=bool(obj[3]) if len(obj) > 3 else False,
+        )
 
 
 def xor_distance(a: PeerID, b: PeerID) -> int:

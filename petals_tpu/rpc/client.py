@@ -94,6 +94,9 @@ class RpcClient:
         # set once the server's hello is processed (and our auth proof sent):
         # connect() waits on it so our first request never overtakes the proof
         self._handshake_done = asyncio.Event()
+        # set once the server's auth frame is processed (valid or not) — TCP
+        # ordering puts it right after the hello when the server will prove
+        self._auth_done = asyncio.Event()
         self._loop_task = asyncio.create_task(self._read_loop())
 
     async def _on_server_hello(self, msg) -> None:
@@ -121,20 +124,36 @@ class RpcClient:
         """The server's proof: its signature over OUR public key and nonce."""
         from petals_tpu.dht import identity as ident
 
-        if self._server_pub is None or self._identity is None:
-            return
         try:
-            sig = bytes.fromhex(msg.get("sig") or "")
-        except ValueError:
-            return
-        message = ident.hello_challenge_message(
-            self._server_pub, self._identity.public_bytes, self._nonce
-        )
-        if not ident.verify(self._server_pub, sig, message):
-            return
-        proven = ident.peer_id_of(self._server_pub)
-        if self._server_claimed is None or proven == self._server_claimed:
-            self.remote_peer_id = proven
+            if self._server_pub is None or self._identity is None:
+                return
+            try:
+                sig = bytes.fromhex(msg.get("sig") or "")
+            except ValueError:
+                return
+            message = ident.hello_challenge_message(
+                self._server_pub, self._identity.public_bytes, self._nonce
+            )
+            if not ident.verify(self._server_pub, sig, message):
+                return
+            proven = ident.peer_id_of(self._server_pub)
+            if self._server_claimed is None or proven == self._server_claimed:
+                self.remote_peer_id = proven
+        finally:
+            self._auth_done.set()
+
+    async def wait_authenticated(self, timeout: float = 10.0) -> Optional[PeerID]:
+        """Waits for the server's identity proof (if it advertised a key) and
+        returns the PROVEN peer id — None if the server never proves or the
+        proof is invalid. Callers pinning a peer id (relay circuits) must
+        compare against this, not the unauthenticated hello claim."""
+        if self._identity is None or self._server_pub is None:
+            return self.remote_peer_id
+        try:
+            await asyncio.wait_for(self._auth_done.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+        return self.remote_peer_id
 
     @classmethod
     async def connect(
@@ -142,6 +161,17 @@ class RpcClient:
         identity=None, timeout: float = 10.0,
     ) -> "RpcClient":
         reader, writer = await asyncio.wait_for(asyncio.open_connection(host, port), timeout)
+        return await cls.from_streams(
+            reader, writer, peer_id=peer_id, identity=identity, timeout=timeout
+        )
+
+    @classmethod
+    async def from_streams(
+        cls, reader: asyncio.StreamReader, writer: asyncio.StreamWriter, *,
+        peer_id: Optional[PeerID] = None, identity=None, timeout: float = 10.0,
+    ) -> "RpcClient":
+        """Handshake over an already-established byte stream (direct TCP or a
+        relay splice — rpc/relay.py): the hello/auth exchange is end-to-end."""
         client = cls(reader, writer, peer_id, identity)
         hello = {"t": "hello", "peer_id": client._peer_id.to_string() if client._peer_id else None}
         if identity is not None:
@@ -233,6 +263,7 @@ class RpcClient:
             # unblock connect(): a connection that died mid-handshake should
             # fail immediately (connect checks _closed), not wait out the timeout
             self._handshake_done.set()
+            self._auth_done.set()
             for future in self._pending.values():
                 if not future.done():
                     future.set_exception(error)

@@ -9,6 +9,7 @@ from typing import Dict, Optional, Tuple
 
 from petals_tpu.data_structures import PeerID
 from petals_tpu.rpc.client import RpcClient
+from petals_tpu.rpc.server import RpcError
 from petals_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -24,28 +25,58 @@ class ConnectionPool:
         self.identity = identity
         self.own_peer_id = identity.peer_id if identity is not None else own_peer_id
         self.connect_timeout = connect_timeout
-        self._clients: Dict[Tuple[str, int], RpcClient] = {}
-        self._locks: Dict[Tuple[str, int], asyncio.Lock] = {}
+        self._clients: Dict[tuple, RpcClient] = {}
+        self._locks: Dict[tuple, asyncio.Lock] = {}
 
     async def get(self, host: str, port: int) -> RpcClient:
-        key = (host, port)
+        return await self._get((host, port, None))
+
+    async def get_addr(self, addr) -> RpcClient:
+        """Connect to a PeerAddr — directly, or through its relay when the
+        address is a relay circuit (addr.relayed; rpc/relay.py)."""
+        target = addr.peer_id if addr.relayed else None
+        return await self._get((addr.host, addr.port, target))
+
+    async def _get(self, key: tuple) -> RpcClient:
+        host, port, relay_target = key
         lock = self._locks.setdefault(key, asyncio.Lock())
         async with lock:
             client = self._clients.get(key)
             if client is not None and not client._closed:
                 return client
-            client = await RpcClient.connect(
-                host, port, peer_id=self.own_peer_id, identity=self.identity,
-                timeout=self.connect_timeout,
-            )
+            if relay_target is not None:
+                from petals_tpu.rpc.relay import relay_dial
+
+                if self.identity is None:
+                    # without our identity the remote sends no auth proof, so a
+                    # malicious relay could splice us to any registered server
+                    raise RpcError("Relay circuits require an identity (mutual auth)")
+                reader, writer = await relay_dial(
+                    host, port, relay_target, timeout=self.connect_timeout
+                )
+                client = await RpcClient.from_streams(
+                    reader, writer, peer_id=self.own_peer_id, identity=self.identity,
+                    timeout=self.connect_timeout,
+                )
+                proven = await client.wait_authenticated(self.connect_timeout)
+                if proven != relay_target:
+                    # the relay spliced us to some OTHER (or unproven) peer
+                    await client.close()
+                    raise RpcError(f"Relay handed us {proven}, expected {relay_target}")
+            else:
+                client = await RpcClient.connect(
+                    host, port, peer_id=self.own_peer_id, identity=self.identity,
+                    timeout=self.connect_timeout,
+                )
             self._clients[key] = client
             return client
 
     def invalidate(self, host: str, port: int) -> None:
-        client = self._clients.pop((host, port), None)
-        if client is not None:
-            # close in the background: invalidate() is called from sync contexts
-            asyncio.ensure_future(self._close_quietly(client))
+        for key in [k for k in self._clients if k[0] == host and k[1] == port]:
+            client = self._clients.pop(key, None)
+            if client is not None:
+                # close in the background: invalidate() is called from sync contexts
+                asyncio.ensure_future(self._close_quietly(client))
 
     @staticmethod
     async def _close_quietly(client: RpcClient) -> None:

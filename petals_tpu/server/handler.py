@@ -436,7 +436,7 @@ class TransformerHandler:
             if start_from is not None:
                 payload["start_from_position"] = int(start_from)
             addr = PeerAddr.from_string(push_to["addr"])
-            client = await self._push_pool.get(addr.host, addr.port)
+            client = await self._push_pool.get_addr(addr)
             await asyncio.wait_for(client.call("ptu.push", payload), 10.0)
         except Exception as e:
             logger.debug(f"Push to next server failed (client copy still flows): {e}")

@@ -70,6 +70,7 @@ class Server:
         quant_type: str = "none",  # "none" | "int8" | "nf4" (ops/quant.py)
         adapters: Sequence[str] = (),  # PEFT checkpoint dirs to host (utils/peft.py)
         compression: str = "none",  # default reply codec (clients may override per request)
+        relay_via: Optional[str] = None,  # "host:port" of a relay peer: serve from behind NAT
     ):
         self.model_path = model_path
         self.family, self.cfg = get_block_config(model_path)
@@ -142,6 +143,9 @@ class Server:
         self._next_pings: dict = {}
         self._ping_aggregator = None
         self._trace_flush_task: Optional[asyncio.Task] = None
+        self.relay_via = relay_via
+        self._relay_registrar = None
+        self._contact_addr = None  # non-default announce addr (relay circuit)
 
     # ------------------------------------------------------------------ lifecycle
 
@@ -180,14 +184,37 @@ class Server:
         )
         peer_id = identity.peer_id
         self.rpc_server = RpcServer(identity=identity, host=self.host, port=self.port)
-        # Start listening BEFORE the DHT bootstraps: the node advertises its
-        # own (host, port) to peers during bootstrap.
-        await self.rpc_server.start()
-        self.dht = await DHTNode.create(
-            identity=identity,
-            rpc_server=self.rpc_server,
-            initial_peers=self.initial_peers,
-        )
+        if self.relay_via is not None:
+            # NAT'd / firewalled server: no listener at all. The rpc surface is
+            # served on REVERSE connections dialed out through the relay
+            # (rpc/relay.py), the DHT runs query-only (reference client-mode
+            # DHT, server.py:137-150), and the announced contact address is the
+            # relay circuit.
+            from petals_tpu.dht.routing import PeerAddr
+            from petals_tpu.rpc.relay import RelayRegistrar
+
+            relay_host, relay_port = self.relay_via.rsplit(":", 1)
+            self.dht = await DHTNode.create(
+                identity=identity,
+                client_mode=True,
+                initial_peers=self.initial_peers,
+            )
+            self._relay_registrar = RelayRegistrar(
+                relay_host, int(relay_port), identity, self.rpc_server
+            )
+            await self._relay_registrar.start()
+            await self._relay_registrar.wait_registered()
+            self._contact_addr = PeerAddr(relay_host, int(relay_port), peer_id, relayed=True)
+            logger.info(f"Serving behind relay {self.relay_via} (no inbound listener)")
+        else:
+            # Start listening BEFORE the DHT bootstraps: the node advertises its
+            # own (host, port) to peers during bootstrap.
+            await self.rpc_server.start()
+            self.dht = await DHTNode.create(
+                identity=identity,
+                rpc_server=self.rpc_server,
+                initial_peers=self.initial_peers,
+            )
 
         from petals_tpu.server.reachability import ReachabilityProtocol
 
@@ -283,7 +310,13 @@ class Server:
         if self.mean_balance_check_period > 0:
             self._balancer_task = asyncio.create_task(self._balance_loop())
         self._ready.set()
-        logger.info(f"Server ready: {self.dht.own_addr.to_string()} serving {self.module_uids}")
+        logger.info(f"Server ready: {self.contact_addr.to_string()} serving {self.module_uids}")
+
+    @property
+    def contact_addr(self):
+        """The address this server announces: its relay circuit when hidden,
+        otherwise the DHT node's own listen address."""
+        return self._contact_addr or (self.dht.own_addr if self.dht is not None else None)
 
     async def wait_ready(self) -> None:
         await self._ready.wait()
@@ -312,6 +345,8 @@ class Server:
         stop_jax_trace()
         if self.handler is not None:
             self.handler.shutdown()
+        if self._relay_registrar is not None:
+            await self._relay_registrar.stop()
         if self.dht is not None:
             await self.dht.shutdown()
         if self.rpc_server is not None:
@@ -348,7 +383,8 @@ class Server:
     async def _announce(self, state: ServerState, expiration: Optional[float] = None) -> None:
         expiration = expiration or (dht_time() + max(2 * self.update_period, 60.0))
         await declare_active_modules(
-            self.dht, self.module_uids, self._server_info(state), expiration
+            self.dht, self.module_uids, self._server_info(state), expiration,
+            contact_addr=self._contact_addr,
         )
 
     def _load_span_params(self, first_block: int, num_blocks: int):

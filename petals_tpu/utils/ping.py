@@ -22,7 +22,7 @@ async def ping(
     """RTT to a peer in seconds; math.inf on failure."""
     try:
         start = time.perf_counter()
-        client = await pool.get(addr.host, addr.port)
+        client = await pool.get_addr(addr)
         await asyncio.wait_for(client.call("dht.ping", {}), timeout)
         return time.perf_counter() - start
     except Exception as e:

@@ -1,0 +1,166 @@
+"""Relay circuits for NAT'd servers (rpc/relay.py): a server with NO inbound
+listener serves through a reverse connection dialed out via a relay peer —
+the reference's libp2p relay / client-mode role (reference server.py:137-150).
+End-to-end identity auth must survive the splice."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from petals_tpu.data_structures import make_uid
+from petals_tpu.dht import DHTNode, PeerAddr
+from petals_tpu.dht.identity import Identity
+from petals_tpu.rpc.pool import ConnectionPool
+from petals_tpu.rpc.relay import RelayRegistrar, RelayServer, relay_dial
+from petals_tpu.rpc.server import RpcServer
+from tests.utils import make_tiny_llama
+
+
+def test_peer_addr_relay_roundtrip():
+    ident = Identity.generate()
+    addr = PeerAddr("10.0.0.1", 4321, ident.peer_id, relayed=True)
+    assert addr.to_string().startswith("relay+")
+    assert PeerAddr.from_string(addr.to_string()) == addr
+    assert PeerAddr.from_wire(addr.to_wire()) == addr
+    direct = PeerAddr("10.0.0.1", 4321, ident.peer_id)
+    assert PeerAddr.from_wire(direct.to_wire()) == direct  # 3-element wire form
+
+
+def test_relay_reverse_connection_authenticated():
+    """Unary + stream calls through the relay; both sides prove identities."""
+
+    async def scenario():
+        relay = RelayServer()
+        await relay.start()
+
+        hidden_identity = Identity.generate()
+        hidden = RpcServer(identity=hidden_identity)  # never started: no listener
+
+        async def echo(payload, ctx):
+            return {"echo": payload, "from": ctx.remote_peer_id.to_string()}
+
+        async def double(items, ctx):
+            async for item in items:
+                yield {"doubled": item["x"] * 2}
+
+        hidden.add_unary_handler("test.echo", echo)
+        hidden.add_stream_handler("test.double", double)
+
+        registrar = RelayRegistrar(relay.host, relay.port, hidden_identity, hidden)
+        await registrar.start()
+        await registrar.wait_registered()
+        assert relay.is_registered(hidden_identity.peer_id)
+
+        client_identity = Identity.generate()
+        pool = ConnectionPool(identity=client_identity)
+        addr = PeerAddr(relay.host, relay.port, hidden_identity.peer_id, relayed=True)
+        client = await pool.get_addr(addr)
+
+        reply = await asyncio.wait_for(client.call("test.echo", {"v": 7}), 10)
+        assert reply["echo"] == {"v": 7}
+        # end-to-end auth through the splice: the hidden server proved ITS id
+        # to the client, and saw the CLIENT's proven id
+        assert client.remote_peer_id == hidden_identity.peer_id
+        assert reply["from"] == client_identity.peer_id.to_string()
+
+        stream = await client.open_stream("test.double")
+        await stream.send({"x": 21})
+        item = await stream.recv(timeout=10)
+        assert item == {"doubled": 42}
+        await stream.end()
+
+        # dialing an unregistered target fails cleanly
+        bogus = Identity.generate().peer_id
+        with pytest.raises(ConnectionError, match="not registered"):
+            await relay_dial(relay.host, relay.port, bogus)
+
+        await pool.close()
+        await registrar.stop()
+        assert not relay.is_registered(hidden_identity.peer_id)  # control dropped
+        await relay.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 60))
+
+
+def test_relay_register_requires_proof():
+    """A peer that cannot sign for the claimed id must be rejected."""
+
+    async def scenario():
+        from petals_tpu.rpc.protocol import read_frame, write_frame
+
+        relay = RelayServer()
+        await relay.start()
+        reader, writer = await asyncio.open_connection(relay.host, relay.port)
+        lock = asyncio.Lock()
+        await read_frame(reader)  # relay_hello w/ nonce
+        ident = Identity.generate()
+        await write_frame(
+            writer,
+            {"t": "relay_register", "pub": ident.public_bytes.hex(), "sig": "00" * 64},
+            lock,
+        )
+        reply = await asyncio.wait_for(read_frame(reader), 10)
+        assert reply["t"] == "relay_err"
+        assert not relay.is_registered(ident.peer_id)
+        writer.close()
+        await relay.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 30))
+
+
+def test_hidden_server_e2e(tmp_path):
+    """A full swarm server behind a relay: client-mode DHT, relayed announce
+    address, inference session through the reverse connection."""
+
+    async def scenario():
+        from petals_tpu.client.config import ClientConfig
+        from petals_tpu.client.routing.sequence_manager import RemoteSequenceManager
+        from petals_tpu.client.inference_session import InferenceSession
+        from petals_tpu.server.server import Server
+
+        bootstrap = await DHTNode.create(maintenance_period=1000)
+        relay = RelayServer()
+        await relay.start()
+
+        path = make_tiny_llama(str(tmp_path))
+        server = Server(
+            path,
+            initial_peers=[bootstrap.own_addr],
+            first_block=0,
+            num_blocks=4,
+            compute_dtype=jnp.float32,
+            use_flash=False,
+            relay_via=f"{relay.host}:{relay.port}",
+        )
+        await server.start()
+        assert server.dht.client_mode  # no DHT listener either
+
+        uids = [make_uid(server.dht_prefix, i) for i in range(4)]
+        manager = await RemoteSequenceManager.create(
+            ClientConfig(initial_peers=[bootstrap.own_addr.to_string()]), uids
+        )
+        try:
+            # the directory learned a RELAYED contact address
+            await manager.update()
+            addr = manager.addr_of(server.dht.peer_id)
+            assert addr is not None and addr.relayed
+
+            rng = np.random.RandomState(0)
+            session = InferenceSession(manager, max_length=16)
+            h = rng.randn(1, 4, 64).astype(np.float32) * 0.1
+            out1 = await session.step(h)
+            assert out1.shape == h.shape
+            out2 = await session.step(rng.randn(1, 1, 64).astype(np.float32) * 0.1)
+            assert out2.shape == (1, 1, 64)
+            assert np.isfinite(out1).all() and np.isfinite(out2).all()
+            await session.close()
+        finally:
+            await manager.shutdown()
+            await server.shutdown()
+            await relay.stop()
+            await bootstrap.shutdown()
+
+    asyncio.run(asyncio.wait_for(scenario(), 300))
