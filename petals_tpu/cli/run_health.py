@@ -1,0 +1,44 @@
+"""Run the swarm health monitor (the health.petals.dev analogue):
+``python -m petals_tpu.cli.run_health --initial_peers ADDR [--host H] [--port 8799]``
+Serves / (HTML), /api/v1/state (JSON), /api/v1/is_reachable/<peer>.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+
+from petals_tpu.utils.health import HealthMonitor
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="Swarm health monitor")
+    parser.add_argument("--initial_peers", nargs="+", required=True)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8799)
+    parser.add_argument("--update_period", type=float, default=15.0)
+    args = parser.parse_args(argv)
+
+    async def run():
+        monitor = HealthMonitor(
+            args.initial_peers, host=args.host, port=args.port,
+            update_period=args.update_period,
+        )
+        await monitor.start()
+        print(f"http://{args.host}:{monitor.port}/", flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await monitor.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -62,6 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Hub/checkpoint cache budget, e.g. 300GB (LRU-evicted)")
     parser.add_argument("--token", default=None,
                         help="HF Hub access token for gated/private repos (or set HF_TOKEN)")
+    parser.add_argument("--network_mbps", type=float, default=None,
+                        help="Known network budget in Mbit/s (default: probe swarm peers, "
+                             "utils/bandwidth.py; loopback stack probe when alone)")
     parser.add_argument("--relay_via", default=None,
                         help="host:port of a relay peer (run_dht prints one): serve from behind "
                              "NAT/firewall with no inbound listener (rpc/relay.py)")
@@ -140,6 +143,7 @@ def main(argv=None) -> None:
         adapters=args.adapters,
         compression=args.compression,
         relay_via=args.relay_via,
+        network_mbps=args.network_mbps,
     )
 
     async def run():

@@ -269,6 +269,9 @@ class DHTNode:
     # ------------------------------------------------------------------ RPC server side
 
     def _register_handlers(self, server: RpcServer) -> None:
+        from petals_tpu.utils.bandwidth import BandwidthProtocol
+
+        BandwidthProtocol().register(server)  # all listening nodes answer probes
         server.add_unary_handler("dht.ping", self._handle_ping)
         server.add_unary_handler("dht.store", self._handle_store)
         server.add_unary_handler("dht.find_node", self._handle_find_node)

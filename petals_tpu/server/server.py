@@ -71,6 +71,7 @@ class Server:
         adapters: Sequence[str] = (),  # PEFT checkpoint dirs to host (utils/peft.py)
         compression: str = "none",  # default reply codec (clients may override per request)
         relay_via: Optional[str] = None,  # "host:port" of a relay peer: serve from behind NAT
+        network_mbps: Optional[float] = None,  # known WAN budget; None = probe swarm peers
     ):
         self.model_path = model_path
         self.family, self.cfg = get_block_config(model_path)
@@ -144,6 +145,7 @@ class Server:
         self._ping_aggregator = None
         self._trace_flush_task: Optional[asyncio.Task] = None
         self.relay_via = relay_via
+        self.network_mbps = network_mbps
         self._relay_registrar = None
         self._contact_addr = None  # non-default announce addr (relay circuit)
 
@@ -205,6 +207,16 @@ class Server:
             await self._relay_registrar.start()
             await self._relay_registrar.wait_registered()
             self._contact_addr = PeerAddr(relay_host, int(relay_port), peer_id, relayed=True)
+            # the client-mode DHT registers nothing on our serving RpcServer,
+            # but peers still probe relayed servers (RTT for next_pings /
+            # routing, bandwidth, health dial-backs) — serve those here
+            from petals_tpu.utils.bandwidth import BandwidthProtocol
+
+            async def _ping(_payload, _ctx):
+                return {"peer_id": peer_id.to_string()}
+
+            self.rpc_server.add_unary_handler("dht.ping", _ping)
+            BandwidthProtocol().register(self.rpc_server)
             logger.info(f"Serving behind relay {self.relay_via} (no inbound listener)")
         else:
             # Start listening BEFORE the DHT bootstraps: the node advertises its
@@ -227,12 +239,26 @@ class Server:
         if self._throughput_spec == "auto":
             from petals_tpu.server.throughput import get_server_throughput
 
+            network_mbps = self.network_mbps
+            if network_mbps is None and self.initial_peers:
+                # measure the real path to swarm peers (utils/bandwidth.py) —
+                # the speedtest-cli role; falls back to the loopback stack probe
+                from petals_tpu.dht.routing import PeerAddr
+                from petals_tpu.utils.bandwidth import probe_swarm_bandwidth_mbps
+
+                peer_addrs = [
+                    p if isinstance(p, PeerAddr) else PeerAddr.from_string(p)
+                    for p in self.initial_peers
+                ]
+                network_mbps = await probe_swarm_bandwidth_mbps(self.dht.pool, peer_addrs)
             info = await asyncio.get_running_loop().run_in_executor(
                 None,
                 lambda: get_server_throughput(
                     self.family, self.cfg, compute_dtype=self.compute_dtype,
                     num_blocks=self.num_blocks, quant_type=QuantType(self.quant_type).value,
                     num_devices=self.num_tp_devices or 1,
+                    network_mbps=network_mbps,
+                    using_relay=self.relay_via is not None,
                 ),
             )
             self.throughput = info["throughput"]
@@ -386,6 +412,16 @@ class Server:
             self.dht, self.module_uids, self._server_info(state), expiration,
             contact_addr=self._contact_addr,
         )
+        if state != ServerState.OFFLINE:
+            from petals_tpu.utils.dht_utils import declare_model
+
+            await declare_model(
+                self.dht, self.dht_prefix,
+                num_blocks=self.cfg.num_hidden_layers,
+                expiration_time=expiration,
+                public_name=self.public_name,
+                model_type=self.family.name,
+            )
 
     def _load_span_params(self, first_block: int, num_blocks: int):
         per_block = [

@@ -32,7 +32,7 @@ from petals_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 DEFAULT_CACHE_PATH = Path(os.environ.get("PETALS_TPU_CACHE", Path.home() / ".cache" / "petals_tpu"))
-THROUGHPUT_FILE = "throughput_v1.json"
+THROUGHPUT_FILE = "throughput_v2.json"  # v2: compute-only entries (network always fresh)
 RELAY_PENALTY = 0.2  # reference throughput.py:47
 
 
@@ -78,15 +78,14 @@ def get_server_throughput(
 
     cache = _read_cache(cache_path)
     if not force_eval and cache_key in cache:
-        info = cache[cache_key]
-        logger.info(f"Using cached throughput: {info}")
+        info = dict(cache[cache_key])
+        logger.info(f"Using cached compute throughput: {info}")
     else:
         info = measure_compute_rps(
             family, cfg, compute_dtype=compute_dtype, quant_type=quant_type,
             num_devices=num_devices,
             n_steps_inference=n_steps_inference, n_steps_forward=n_steps_forward,
         )
-        info["network_rps"] = measure_network_rps(cfg.hidden_size, network_mbps=network_mbps)
         if not info.pop("degraded", False):
             cache[cache_key] = info
             _write_cache(cache_path, cache)
@@ -94,6 +93,10 @@ def get_server_throughput(
             # degraded single-device estimate of a TP config: never persist it
             # under the TP key, or it would outlive the broken environment
             logger.warning("Not caching single-device estimate for a TP config")
+    # the network figure is NEVER cached: the caller's swarm probe (or a
+    # --network_mbps override) must always win — a cached compute entry
+    # otherwise silently pins the network number from a past environment
+    info["network_rps"] = measure_network_rps(cfg.hidden_size, network_mbps=network_mbps)
 
     # blended throughput (reference throughput.py:96-106): compute spread over
     # the hosted blocks vs what the network can carry

@@ -118,6 +118,78 @@ class ModuleDirectory:
         return self.addr_book.get(peer_id)
 
 
+MODELS_REGISTRY_KEY = "ptu.models"
+# registry entries are self-signed, not attested: a bound on num_blocks keeps a
+# hostile announcement from making readers enumerate absurd uid ranges
+MAX_REGISTRY_BLOCKS = 4096
+
+
+async def declare_model(
+    dht: DHTNode,
+    dht_prefix: str,
+    *,
+    num_blocks: int,
+    expiration_time: float,
+    public_name: Optional[str] = None,
+    model_type: Optional[str] = None,
+) -> bool:
+    """Register the hosted model in the swarm-global registry (the reference's
+    ``_petals.models`` key, src/petals/server/server.py:738-744) so monitors
+    and clients can discover what the swarm serves without knowing prefixes."""
+    from petals_tpu.dht.identity import sign_announcement
+
+    payload = {
+        "prefix": dht_prefix,
+        "num_blocks": int(num_blocks),
+        "public_name": public_name,
+        "model_type": model_type,
+    }
+    subkey = dht.peer_id.to_string()
+    return await dht.store(
+        MODELS_REGISTRY_KEY,
+        sign_announcement(dht.identity, MODELS_REGISTRY_KEY, payload, expiration_time),
+        expiration_time,
+        subkey=subkey,
+    )
+
+
+async def list_models(dht: DHTNode) -> Dict[str, dict]:
+    """{dht_prefix: {"num_blocks", "public_name", "model_type", "peers": [...]}}
+    aggregated over live, signature-verified registry announcements."""
+    from petals_tpu.dht.identity import verify_announcement
+
+    record = await dht.get(MODELS_REGISTRY_KEY)
+    models: Dict[str, dict] = {}
+    if record is None or not isinstance(record[0], dict):
+        return models
+    for subkey, (value, expiration) in record[0].items():
+        try:
+            # uid check = domain separation: a module record can't be replayed
+            # into the registry (same rule as get_remote_module_infos)
+            if not verify_announcement(value, subkey, expiration) or value["uid"] != MODELS_REGISTRY_KEY:
+                continue
+            payload = value["payload"]
+            prefix = payload["prefix"]
+            num_blocks = int(payload["num_blocks"])
+            if not 1 <= num_blocks <= MAX_REGISTRY_BLOCKS:
+                logger.debug(f"Dropping registry entry {subkey!r}: num_blocks={num_blocks}")
+                continue
+            entry = models.setdefault(
+                prefix,
+                {
+                    "num_blocks": num_blocks,
+                    "public_name": payload.get("public_name"),
+                    "model_type": payload.get("model_type"),
+                    "peers": [],
+                },
+            )
+            entry["peers"].append(subkey)
+            entry["num_blocks"] = max(entry["num_blocks"], num_blocks)
+        except (ValueError, KeyError, TypeError) as e:
+            logger.debug(f"Incorrect models-registry entry {subkey!r}: {e}")
+    return models
+
+
 def compute_spans(
     module_infos: Sequence[Optional[RemoteModuleInfo]],
     *,

@@ -1,0 +1,202 @@
+"""Swarm health monitor: the in-framework analogue of health.petals.dev
+(reference constants.py:16 + the separate petals health-monitor app; also the
+centralized reachability API used by reference reachability.py:22-52).
+
+``HealthMonitor`` joins the swarm as a query-only DHT client, discovers hosted
+models from the ptu.models registry (utils/dht_utils.declare_model), and
+serves a minimal dependency-free HTTP API:
+
+  GET /api/v1/state                    — full swarm snapshot (JSON)
+  GET /api/v1/is_reachable/<peer_hex>  — dial-back probe of a peer's announced
+                                         contact address (the reachability API)
+  GET /                                — human-readable coverage table
+
+Run it with ``python -m petals_tpu.cli.run_health --initial_peers ...``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import html
+import json
+import time
+from typing import Dict, Optional
+
+from petals_tpu.data_structures import ServerState, make_uid
+from petals_tpu.dht import DHTNode
+from petals_tpu.utils.dht_utils import compute_spans, get_remote_module_infos, list_models
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class HealthMonitor:
+    def __init__(
+        self,
+        initial_peers,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        update_period: float = 15.0,
+    ):
+        self.initial_peers = list(initial_peers)
+        self.host, self._requested_port = host, port
+        self.update_period = update_period
+        self.dht: Optional[DHTNode] = None
+        self._http: Optional[asyncio.AbstractServer] = None
+        self._refresh_task: Optional[asyncio.Task] = None
+        self._state: dict = {"updated_at": None, "models": {}}
+        self._addr_book: dict = {}
+
+    @property
+    def port(self) -> int:
+        assert self._http is not None, "monitor not started"
+        return self._http.sockets[0].getsockname()[1]
+
+    async def start(self) -> None:
+        self.dht = await DHTNode.create(initial_peers=self.initial_peers, client_mode=True)
+        await self.refresh()
+        self._refresh_task = asyncio.create_task(self._refresh_loop())
+        self._http = await asyncio.start_server(self._serve_http, self.host, self._requested_port)
+        logger.info(f"Health monitor at http://{self.host}:{self.port}/")
+
+    async def stop(self) -> None:
+        if self._refresh_task is not None:
+            self._refresh_task.cancel()
+            try:
+                await self._refresh_task
+            except asyncio.CancelledError:
+                pass
+        if self._http is not None:
+            self._http.close()
+            await self._http.wait_closed()
+        if self.dht is not None:
+            await self.dht.shutdown()
+
+    # ------------------------------------------------------------------ state
+
+    async def refresh(self) -> dict:
+        models = await list_models(self.dht)
+        snapshot: Dict[str, dict] = {}
+        for prefix, meta in sorted(models.items()):
+            num_blocks = meta["num_blocks"]
+            uids = [make_uid(prefix, i) for i in range(num_blocks)]
+            infos, addr_book = await get_remote_module_infos(self.dht, uids)
+            self._addr_book.update(addr_book)
+            spans = compute_spans(infos, min_state=ServerState.JOINING)
+            covered = [info is not None and any(
+                s.state == ServerState.ONLINE for s in info.servers.values()
+            ) for info in infos]
+            servers = {}
+            for peer_id, span in spans.items():
+                info = span.server_info
+                servers[peer_id.to_string()] = {
+                    "state": info.state.name,
+                    "blocks": [span.start, span.end],
+                    "throughput": info.throughput,
+                    "inference_rps": info.inference_rps,
+                    "cache_tokens_left": info.cache_tokens_left,
+                    "version": info.version,
+                    "quant_type": info.quant_type,
+                    "public_name": info.public_name,
+                    "relayed": bool(getattr(self._addr_book.get(peer_id), "relayed", False)),
+                }
+            snapshot[prefix] = {
+                "public_name": meta.get("public_name"),
+                "model_type": meta.get("model_type"),
+                "num_blocks": num_blocks,
+                "blocks_covered": sum(covered),
+                "healthy": all(covered),
+                "servers": servers,
+            }
+        self._state = {"updated_at": time.time(), "models": snapshot}
+        return self._state
+
+    async def _refresh_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.update_period)
+            try:
+                await self.refresh()
+            except Exception as e:
+                logger.warning(f"Health refresh failed: {e}")
+
+    async def is_reachable(self, peer_hex: str) -> dict:
+        """Dial-back probe: can WE open (and authenticate) a connection to the
+        peer's announced contact address right now?"""
+        from petals_tpu.data_structures import PeerID
+
+        try:
+            peer_id = PeerID.from_string(peer_hex)
+        except Exception:
+            return {"ok": False, "error": "bad peer id"}
+        addr = self._addr_book.get(peer_id)
+        if addr is None:
+            return {"ok": False, "error": "no announced address"}
+        try:
+            client = await self.dht.pool.get_addr(addr)
+            await asyncio.wait_for(client.call("dht.ping", {}), 5.0)
+            return {"ok": True, "addr": addr.to_string(), "relayed": addr.relayed}
+        except Exception as e:
+            return {"ok": False, "addr": addr.to_string(), "error": str(e)}
+
+    # ------------------------------------------------------------------ http
+
+    async def _serve_http(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), 10.0)
+            parts = request_line.decode("latin-1").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), 10.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            if path == "/api/v1/state":
+                body, ctype = json.dumps(self._state, indent=2).encode(), "application/json"
+                status = "200 OK"
+            elif path.startswith("/api/v1/is_reachable/"):
+                result = await self.is_reachable(path.rsplit("/", 1)[1])
+                body, ctype = json.dumps(result).encode(), "application/json"
+                status = "200 OK"
+            elif path == "/":
+                body, ctype = self._render_html().encode(), "text/html; charset=utf-8"
+                status = "200 OK"
+            else:
+                body, ctype, status = b"not found", "text/plain", "404 Not Found"
+            writer.write(
+                f"HTTP/1.0 {status}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode() + body
+            )
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    def _render_html(self) -> str:
+        rows = []
+        for prefix, model in self._state["models"].items():
+            status = "✅ healthy" if model["healthy"] else (
+                f"⚠️ {model['blocks_covered']}/{model['num_blocks']} blocks"
+            )
+            rows.append(
+                f"<h2>{html.escape(model.get('public_name') or prefix)} "
+                f"<small>({model['num_blocks']} blocks, {html.escape(str(model.get('model_type')))}"
+                f")</small> — {status}</h2><table border=1 cellpadding=4>"
+                "<tr><th>server</th><th>state</th><th>blocks</th><th>throughput</th>"
+                "<th>cache tokens left</th><th>quant</th><th>via relay</th></tr>"
+            )
+            for peer, s in model["servers"].items():
+                rows.append(
+                    f"<tr><td><code>{peer[:12]}…</code> {html.escape(s.get('public_name') or '')}</td>"
+                    f"<td>{s['state']}</td><td>[{s['blocks'][0]}, {s['blocks'][1]})</td>"
+                    f"<td>{s['throughput']:.1f}</td><td>{s['cache_tokens_left']}</td>"
+                    f"<td>{html.escape(str(s['quant_type']))}</td><td>{'yes' if s['relayed'] else 'no'}</td></tr>"
+                )
+            rows.append("</table>")
+        updated = self._state["updated_at"]
+        return (
+            "<!doctype html><title>petals_tpu swarm health</title>"
+            "<h1>petals_tpu swarm health</h1>"
+            f"<p>updated {time.strftime('%H:%M:%S', time.localtime(updated)) if updated else 'never'}"
+            f" · <a href='/api/v1/state'>JSON</a></p>" + "".join(rows or ["<p>no models announced</p>"])
+        )

@@ -1,0 +1,115 @@
+"""Model registry (ptu.models), health monitor HTTP API, and peer bandwidth
+probes — the reference ecosystem's health.petals.dev + speedtest roles."""
+
+import asyncio
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from petals_tpu.dht import DHTNode
+from petals_tpu.utils.bandwidth import measure_peer_bandwidth_mbps, probe_swarm_bandwidth_mbps
+from petals_tpu.utils.dht_utils import declare_model, list_models
+from petals_tpu.utils.health import HealthMonitor
+from tests.utils import make_tiny_llama
+
+
+def test_model_registry_roundtrip():
+    async def scenario():
+        bootstrap = await DHTNode.create(maintenance_period=1000)
+        peer = await DHTNode.create(initial_peers=[bootstrap.own_addr], maintenance_period=1000)
+        from petals_tpu.dht.node import dht_time
+
+        ok = await declare_model(
+            peer, "tiny-llama-hf", num_blocks=4,
+            expiration_time=dht_time() + 60, public_name="Tiny", model_type="llama",
+        )
+        assert ok
+        models = await list_models(bootstrap)
+        assert "tiny-llama-hf" in models
+        assert models["tiny-llama-hf"]["num_blocks"] == 4
+        assert models["tiny-llama-hf"]["public_name"] == "Tiny"
+        assert peer.peer_id.to_string() in models["tiny-llama-hf"]["peers"]
+        await peer.shutdown()
+        await bootstrap.shutdown()
+
+    asyncio.run(asyncio.wait_for(scenario(), 60))
+
+
+def test_bandwidth_probe():
+    async def scenario():
+        bootstrap = await DHTNode.create(maintenance_period=1000)
+        client = await DHTNode.create(client_mode=True, initial_peers=[bootstrap.own_addr])
+        mbps = await measure_peer_bandwidth_mbps(
+            client.pool, bootstrap.own_addr, probe_bytes=1 << 20
+        )
+        assert mbps > 1.0  # loopback must beat 1 Mbit/s by orders of magnitude
+        best = await probe_swarm_bandwidth_mbps(client.pool, [bootstrap.own_addr])
+        assert best is not None and best > 1.0
+        # a dead peer yields None, not an exception
+        from petals_tpu.dht.routing import PeerAddr
+
+        dead = PeerAddr("127.0.0.1", 1, bootstrap.peer_id)
+        assert await probe_swarm_bandwidth_mbps(client.pool, [dead]) is None
+        await client.shutdown()
+        await bootstrap.shutdown()
+
+    asyncio.run(asyncio.wait_for(scenario(), 60))
+
+
+def test_health_monitor_e2e(tmp_path):
+    """Full loop: server announces modules + registry; the monitor discovers
+    the model, reports coverage, and answers the reachability API."""
+
+    async def scenario():
+        from petals_tpu.server.server import Server
+
+        bootstrap = await DHTNode.create(maintenance_period=1000)
+        path = make_tiny_llama(str(tmp_path))
+        server = Server(
+            path, initial_peers=[bootstrap.own_addr],
+            first_block=0, num_blocks=4,
+            compute_dtype=jnp.float32, use_flash=False,
+        )
+        await server.start()
+
+        monitor = HealthMonitor([bootstrap.own_addr.to_string()], update_period=600)
+        await monitor.start()
+        try:
+            state = await monitor.refresh()
+            assert server.dht_prefix in state["models"]
+            model = state["models"][server.dht_prefix]
+            assert model["healthy"] and model["blocks_covered"] == 4
+            peer_hex = server.dht.peer_id.to_string()
+            assert peer_hex in model["servers"]
+            assert model["servers"][peer_hex]["state"] == "ONLINE"
+            assert model["servers"][peer_hex]["blocks"] == [0, 4]
+
+            # HTTP surface (urllib is sync: run in a thread)
+            base = f"http://127.0.0.1:{monitor.port}"
+
+            def fetch(url):
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    return r.read()
+
+            loop = asyncio.get_running_loop()
+            api = json.loads(await loop.run_in_executor(None, fetch, base + "/api/v1/state"))
+            assert api["models"][server.dht_prefix]["healthy"]
+            page = (await loop.run_in_executor(None, fetch, base + "/")).decode()
+            assert "swarm health" in page and server.dht_prefix in page
+
+            reach = json.loads(
+                await loop.run_in_executor(
+                    None, fetch, f"{base}/api/v1/is_reachable/{peer_hex}"
+                )
+            )
+            assert reach["ok"] and not reach["relayed"]
+        finally:
+            await monitor.stop()
+            await server.shutdown()
+            await bootstrap.shutdown()
+
+    asyncio.run(asyncio.wait_for(scenario(), 300))

@@ -157,6 +157,19 @@ def test_hidden_server_e2e(tmp_path):
             assert out2.shape == (1, 1, 64)
             assert np.isfinite(out1).all() and np.isfinite(out2).all()
             await session.close()
+
+            # the health monitor's dial-back API must reach a relayed server
+            # (relay-mode servers answer dht.ping on the reverse connection)
+            from petals_tpu.utils.health import HealthMonitor
+
+            monitor = HealthMonitor([bootstrap.own_addr.to_string()], update_period=600)
+            await monitor.start()
+            try:
+                await monitor.refresh()
+                reach = await monitor.is_reachable(server.dht.peer_id.to_string())
+                assert reach["ok"] and reach["relayed"], reach
+            finally:
+                await monitor.stop()
         finally:
             await manager.shutdown()
             await server.shutdown()
