@@ -1,5 +1,5 @@
-"""Weight-only quantization: INT8 (per-output-channel) and NF4 (blockwise-64
-normal-float) with TPU dequant-matmul kernels.
+"""Weight-only quantization: INT8 (per-output-channel), NF4 (blockwise-64
+normal-float), and INT4 (blockwise-64 affine) with TPU dequant-matmul kernels.
 
 This is the genuinely native rebuild of the reference's bitsandbytes CUDA
 kernels (SURVEY.md §2.3: Int8 + NF4 blocksize-64/absmax via
@@ -12,9 +12,17 @@ formats and kernels are implemented here:
   axis per output column, two codes packed per byte, bf16 absmax => 4.25
   bits/param (the sizing constant the reference placement math uses,
   server/block_utils.py:46).
-- ``nf4_matmul_pallas``: fused kernel — packed tiles stream into VMEM, codes
-  are unpacked and decoded with a 16-way select chain on the VPU, dequantized
-  tiles feed the MXU; the bf16 weight matrix is never materialized in HBM.
+- INT4 (beyond reference): same packing/blocking as NF4 but with an AFFINE
+  code map, value = (code - 8) * scale. NF4's irregular codebook needs a
+  15-step select chain per weight element on the VPU — decode-bound at M=1 —
+  while the affine map decodes in two arithmetic ops and runs near the
+  bandwidth bound. Slightly worse quantization error than NF4 (uniform vs
+  normal-float levels), a TPU-native serving tradeoff the operator picks
+  with quant_type="int4".
+- ``packed4_matmul_pallas``: fused kernel for both 4-bit kinds — packed tiles
+  stream into VMEM, codes are unpacked and decoded on the VPU (select chain
+  for nf4, subtract for int4), dequantized tiles feed the MXU; the bf16
+  weight matrix is never materialized in HBM.
 
 ``QuantizedLinear`` is a pytree node, so quantized span params stack/scan/jit
 exactly like dense ones.
@@ -96,20 +104,23 @@ def quantize_int8(w: jnp.ndarray) -> QuantizedLinear:
     return QuantizedLinear("int8", q, scale.astype(jnp.float32), w.shape[0], w.shape[1])
 
 
-def quantize_nf4(w: jnp.ndarray) -> QuantizedLinear:
-    """Blockwise-64 NF4 along the input axis (w: [in, out], in % 64 == 0).
-
-    The stored format pads the input axis to a multiple of the Pallas k-tile
-    (512) with zero rows (which encode exactly: code 7 = 0.0, absmax 0), so the
-    fused kernel tiles cleanly for any layer shape; in_features records the
-    logical size."""
-    w = jnp.asarray(w)
+def _pad_rows(w: jnp.ndarray):
+    """Pad the input axis to a multiple of the Pallas k-tile (512) with zero
+    rows (which both 4-bit formats encode exactly), so the fused kernel tiles
+    cleanly for any layer shape; in_features records the logical size."""
     n_in, n_out = w.shape
     assert n_in % NF4_BLOCK == 0, f"in_features {n_in} must divide {NF4_BLOCK}"
     pad = (-n_in) % _TK
     if pad:
         w = jnp.concatenate([w, jnp.zeros((pad, n_out), w.dtype)], axis=0)
-    n_stored = n_in + pad
+    return w, n_in + pad
+
+
+def quantize_nf4(w: jnp.ndarray) -> QuantizedLinear:
+    """Blockwise-64 NF4 along the input axis (w: [in, out], in % 64 == 0)."""
+    w = jnp.asarray(w)
+    n_in, n_out = w.shape
+    w, n_stored = _pad_rows(w)
     wf = w.astype(jnp.float32).reshape(n_stored // NF4_BLOCK, NF4_BLOCK, n_out)
     absmax = jnp.max(jnp.abs(wf), axis=1)  # [blocks, out]
     normed = wf / jnp.maximum(absmax, 1e-8)[:, None, :]  # in [-1, 1]
@@ -122,11 +133,29 @@ def quantize_nf4(w: jnp.ndarray) -> QuantizedLinear:
     return QuantizedLinear("nf4", packed, absmax.astype(jnp.bfloat16), n_in, n_out)
 
 
+def quantize_int4(w: jnp.ndarray) -> QuantizedLinear:
+    """Blockwise-64 affine int4: value = (code - 8) * scale, scale = absmax/7,
+    codes clipped to [1, 15] (symmetric levels; zero rows encode exactly as
+    code 8 x any scale)."""
+    w = jnp.asarray(w)
+    n_in, n_out = w.shape
+    w, n_stored = _pad_rows(w)
+    wf = w.astype(jnp.float32).reshape(n_stored // NF4_BLOCK, NF4_BLOCK, n_out)
+    absmax = jnp.max(jnp.abs(wf), axis=1)  # [blocks, out]
+    scale = jnp.maximum(absmax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(wf / scale[:, None, :]), -7, 7) + 8
+    codes = q.astype(jnp.uint8).reshape(n_stored, n_out)
+    packed = (codes[0::2] | (codes[1::2] << 4)).astype(jnp.uint8)
+    return QuantizedLinear("int4", packed, scale.astype(jnp.bfloat16), n_in, n_out)
+
+
 def quantize(w: jnp.ndarray, kind: str) -> QuantizedLinear:
     if kind == "int8":
         return quantize_int8(w)
     if kind == "nf4":
         return quantize_nf4(w)
+    if kind == "int4":
+        return quantize_int4(w)
     raise ValueError(f"Unknown quantization kind {kind!r}")
 
 
@@ -141,9 +170,13 @@ def dequantize(q: QuantizedLinear, dtype=jnp.bfloat16) -> jnp.ndarray:
         return (q.data.astype(jnp.float32) * q.scales[..., None, :]).astype(dtype)
     lo = (q.data & 0x0F).astype(jnp.int32)
     hi = (q.data >> 4).astype(jnp.int32)
-    code = jnp.asarray(NF4_CODE)
-    d_lo = code[lo]  # [..., in//2, out]
-    d_hi = code[hi]
+    if q.kind == "int4":
+        d_lo = (lo - 8).astype(jnp.float32)
+        d_hi = (hi - 8).astype(jnp.float32)
+    else:
+        code = jnp.asarray(NF4_CODE)
+        d_lo = code[lo]  # [..., in//2, out]
+        d_hi = code[hi]
     vals = jnp.stack([d_lo, d_hi], axis=-2)  # [..., half, 2, out]
     *lead, half, _two, out = vals.shape
     vals = vals.reshape(*lead, half * 2, out)  # row-major => rows 2i, 2i+1 interleave
@@ -160,9 +193,10 @@ def quant_matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     are frozen server-side, like the reference's quantized blocks)."""
     if not isinstance(w, QuantizedLinear):
         return x @ w
-    if w.kind == "nf4":
+    if w.kind in ("nf4", "int4"):
         lead = x.shape[:-1]
-        out = _nf4_mm(x.reshape(-1, w.in_features), w.data, w.scales)
+        mm = _nf4_mm if w.kind == "nf4" else _int4_mm
+        out = mm(x.reshape(-1, w.in_features), w.data, w.scales)
         return out.reshape(*lead, w.out_features).astype(x.dtype)
     return (x.astype(jnp.bfloat16) @ dequantize(w, jnp.bfloat16)).astype(x.dtype)
 
@@ -264,40 +298,45 @@ def _nf4_pallas_supported(x2d, data) -> bool:
     return n_stored % _TK == 0 and n_out % _TN == 0 and data.ndim == 2
 
 
-@jax.custom_vjp
-def _nf4_mm(x2d, data, scales):
-    return _nf4_mm_fwd_impl(x2d, data, scales)
-
-
-def _nf4_mm_fwd_impl(x2d, data, scales):
+def _q4_mm_fwd_impl(kind, x2d, data, scales):
     # logical in_features comes from x; data rows may be padded to the k-tile
-    w = QuantizedLinear("nf4", data, scales, x2d.shape[-1], data.shape[-1])
+    w = QuantizedLinear(kind, data, scales, x2d.shape[-1], data.shape[-1])
     is_decode = x2d.shape[0] <= _NF4_DECODE_MAX_M
+    # int4's affine decode is never VPU-bound: always take the fused kernel
+    use_pallas_at_decode = _NF4_DECODE_USE_PALLAS or kind == "int4"
     if (
         not _FORCE_XLA_PATH.get()
         and jax.default_backend() == "tpu"
         and _nf4_pallas_supported(x2d, data)
-        and (_NF4_DECODE_USE_PALLAS or not is_decode)
+        and (use_pallas_at_decode or not is_decode)
     ):
-        return nf4_matmul_pallas(x2d, w)
+        return packed4_matmul_pallas(x2d, w)
     return (x2d.astype(jnp.bfloat16) @ dequantize(w, jnp.bfloat16)).astype(x2d.dtype)
 
 
-def _nf4_mm_fwd(x2d, data, scales):
-    return _nf4_mm_fwd_impl(x2d, data, scales), (data, scales, x2d.shape[-1])
+def _make_q4_mm(kind: str):
+    @jax.custom_vjp
+    def q4_mm(x2d, data, scales):
+        return _q4_mm_fwd_impl(kind, x2d, data, scales)
+
+    def fwd(x2d, data, scales):
+        return _q4_mm_fwd_impl(kind, x2d, data, scales), (data, scales, x2d.shape[-1])
+
+    def bwd(res, g):
+        data, scales, n_in = res
+        w = QuantizedLinear(kind, data, scales, n_in, data.shape[-1])
+        deq = dequantize(w, jnp.bfloat16)
+        dx = (g.astype(jnp.bfloat16) @ deq.T).astype(g.dtype)
+        d_data = np.zeros(data.shape, dtype=jax.dtypes.float0)
+        d_scales = jnp.zeros_like(scales)
+        return dx, d_data, d_scales
+
+    q4_mm.defvjp(fwd, bwd)
+    return q4_mm
 
 
-def _nf4_mm_bwd(res, g):
-    data, scales, n_in = res
-    w = QuantizedLinear("nf4", data, scales, n_in, data.shape[-1])
-    deq = dequantize(w, jnp.bfloat16)
-    dx = (g.astype(jnp.bfloat16) @ deq.T).astype(g.dtype)
-    d_data = np.zeros(data.shape, dtype=jax.dtypes.float0)
-    d_scales = jnp.zeros_like(scales)
-    return dx, d_data, d_scales
-
-
-_nf4_mm.defvjp(_nf4_mm_fwd, _nf4_mm_bwd)
+_nf4_mm = _make_q4_mm("nf4")
+_int4_mm = _make_q4_mm("int4")
 
 
 # ----------------------------------------------------------------------------------
@@ -306,7 +345,7 @@ _nf4_mm.defvjp(_nf4_mm_fwd, _nf4_mm_bwd)
 
 
 
-def _nf4_kernel(x_ref, packed_ref, scales_ref, o_ref, acc_ref, *, n_k: int):
+def _packed4_kernel(x_ref, packed_ref, scales_ref, o_ref, acc_ref, *, n_k: int, affine: bool):
     """Grid (m, n, k): accumulate x_tile @ dequant(w_tile) into acc."""
     k = pl.program_id(2)
 
@@ -319,11 +358,16 @@ def _nf4_kernel(x_ref, packed_ref, scales_ref, o_ref, acc_ref, *, n_k: int):
     lo = packed & 0x0F
     hi = (packed >> 4) & 0x0F
 
-    def decode(codes):
-        vals = jnp.full(codes.shape, NF4_CODE[0], jnp.float32)
-        for i in range(1, 16):
-            vals = jnp.where(codes == i, NF4_CODE[i], vals)
-        return vals
+    if affine:  # int4: two arithmetic ops per element — never decode-bound
+        def decode(codes):
+            return (codes - 8).astype(jnp.float32)
+    else:  # nf4: irregular codebook, 15-step select chain
+
+        def decode(codes):
+            vals = jnp.full(codes.shape, NF4_CODE[0], jnp.float32)
+            for i in range(1, 16):
+                vals = jnp.where(codes == i, NF4_CODE[i], vals)
+            return vals
 
     d_lo = decode(lo)  # rows 0,2,4,... of the TK tile
     d_hi = decode(hi)  # rows 1,3,5,...
@@ -344,8 +388,8 @@ def _nf4_kernel(x_ref, packed_ref, scales_ref, o_ref, acc_ref, *, n_k: int):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def nf4_matmul_pallas(x: jnp.ndarray, w: QuantizedLinear, *, interpret: bool | None = None):
-    """x: [M, in] -> [M, out] with fused NF4 dequantization."""
+def packed4_matmul_pallas(x: jnp.ndarray, w: QuantizedLinear, *, interpret: bool | None = None):
+    """x: [M, in] -> [M, out] with fused 4-bit (nf4 | int4) dequantization."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     m, n_in = x.shape
@@ -363,7 +407,7 @@ def nf4_matmul_pallas(x: jnp.ndarray, w: QuantizedLinear, *, interpret: bool | N
     n_m = mp // tm
 
     out = pl.pallas_call(
-        functools.partial(_nf4_kernel, n_k=n_k),
+        functools.partial(_packed4_kernel, n_k=n_k, affine=w.kind == "int4"),
         grid=(n_m, n_n, n_k),
         in_specs=[
             pl.BlockSpec((tm, _TK), lambda mi, n, k: (mi, k)),
@@ -381,6 +425,10 @@ def nf4_matmul_pallas(x: jnp.ndarray, w: QuantizedLinear, *, interpret: bool | N
     return out[:m] if m_pad else out
 
 
+# back-compat name from before int4 shared the kernel
+nf4_matmul_pallas = packed4_matmul_pallas
+
+
 def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
@@ -389,7 +437,7 @@ def _round_up(x: int, m: int) -> int:
 # Sizing (reference block_utils.py:22-53)
 # ----------------------------------------------------------------------------------
 
-BITS_PER_PARAM = {"none": 16.0, "int8": 8.25, "nf4": 4.25}
+BITS_PER_PARAM = {"none": 16.0, "int8": 8.25, "nf4": 4.25, "int4": 4.25}
 
 
 def quantized_bytes(n_params: int, kind: str) -> int:

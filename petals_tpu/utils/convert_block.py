@@ -23,6 +23,7 @@ class QuantType(str, enum.Enum):
     NONE = "none"
     INT8 = "int8"  # LLM.int8-class weight-only quantization
     NF4 = "nf4"  # QLoRA-style 4-bit normal float
+    INT4 = "int4"  # blockwise affine 4-bit: fastest TPU decode (ops/quant.py)
 
 
 # The big matmul weights of each family (norms/biases/router stay dense).

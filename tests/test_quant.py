@@ -1,4 +1,4 @@
-"""Quantization tests: INT8/NF4 formats, Pallas dequant-matmul vs XLA
+"""Quantization tests: INT8/NF4/INT4 formats, Pallas dequant-matmul vs XLA
 reference, quantized block error bounds, quantized server e2e
 (the TPU-native replacement for bitsandbytes — SURVEY.md §2.3)."""
 
@@ -11,7 +11,9 @@ from petals_tpu.ops.quant import (
     NF4_BLOCK,
     dequantize,
     nf4_matmul_pallas,
+    packed4_matmul_pallas,
     quant_matmul,
+    quantize_int4,
     quantize_int8,
     quantize_nf4,
     quantized_bytes,
@@ -50,14 +52,35 @@ def test_nf4_roundtrip_error():
     assert q.nbytes <= quantized_bytes(stored * 128, "nf4") + 1024
 
 
-def test_nf4_pallas_matches_xla():
+def test_int4_roundtrip_error():
+    rng = np.random.RandomState(7)
+    w = (rng.randn(256, 128) * 0.05).astype(np.float32)
+    q = quantize_int4(w)
+    assert q.kind == "int4" and q.data.dtype == jnp.uint8
+    deq = np.asarray(dequantize(q, jnp.float32))
+    # affine levels: error bounded by scale/2 = absmax/14 per block (+ the
+    # bf16 rounding of the stored scale)
+    blocks = w.reshape(-1, NF4_BLOCK, 128)
+    absmax = np.abs(blocks).max(axis=1)
+    bound = np.repeat(absmax, NF4_BLOCK, axis=0) / 14 + np.abs(w) * 2**-7 + 1e-6
+    assert (np.abs(deq - w) <= bound).all()
+    stored = q.data.shape[0] * 2
+    assert q.nbytes <= quantized_bytes(stored * 128, "int4") + 1024
+
+
+@pytest.mark.parametrize("quantizer", [quantize_nf4, quantize_int4])
+def test_packed4_pallas_matches_xla(quantizer):
     rng = np.random.RandomState(2)
     w = (rng.randn(512, 256) * 0.05).astype(np.float32)
     x = rng.randn(16, 512).astype(np.float32)
-    q = quantize_nf4(w)
+    q = quantizer(w)
     expected = x @ np.asarray(dequantize(q, jnp.float32))
-    got = np.asarray(nf4_matmul_pallas(jnp.asarray(x), q))
+    got = np.asarray(packed4_matmul_pallas(jnp.asarray(x), q))
     np.testing.assert_allclose(got, expected, atol=2e-2, rtol=1e-2)
+
+
+def test_nf4_pallas_alias():
+    assert nf4_matmul_pallas is packed4_matmul_pallas  # back-compat name
 
 
 def test_quant_matmul_grad_flows_to_x():
@@ -76,7 +99,7 @@ def test_quant_matmul_grad_flows_to_x():
     )
 
 
-@pytest.mark.parametrize("quant", [QuantType.INT8, QuantType.NF4])
+@pytest.mark.parametrize("quant", [QuantType.INT8, QuantType.NF4, QuantType.INT4])
 def test_quantized_block_close_to_dense(quant, tmp_path):
     from petals_tpu.server.from_pretrained import get_block_config, load_block_params
     from tests.utils import make_tiny_llama
@@ -91,18 +114,20 @@ def test_quantized_block_close_to_dense(quant, tmp_path):
     dense_out, _ = family.block_apply(params, hidden, None, 0, cfg)
     quant_out, _ = family.block_apply(qparams, hidden, None, 0, cfg)
     err = np.abs(np.asarray(quant_out) - np.asarray(dense_out)).max()
-    assert err < (0.2 if quant == QuantType.NF4 else 0.05), f"{quant}: err {err}"
+    bound = {QuantType.NF4: 0.2, QuantType.INT4: 0.3, QuantType.INT8: 0.05}[quant]
+    assert err < bound, f"{quant}: err {err}"
 
 
-def test_quantized_server_generates(tmp_path):
-    """NF4 server serves a session end-to-end (reference CI quantized-server
+@pytest.mark.parametrize("quant", ["nf4", "int4"])
+def test_quantized_server_generates(quant, tmp_path):
+    """4-bit servers serve a session end-to-end (reference CI quantized-server
     coverage); greedy tokens may differ from f32 HF — assert mechanics."""
     from petals_tpu.client.model import AutoDistributedModelForCausalLM
     from tests.test_full_model import SwarmHarness
     from tests.utils import make_tiny_llama
 
     path = make_tiny_llama(str(tmp_path))
-    harness = SwarmHarness(path, [dict(first_block=0, num_blocks=4, quant_type="nf4")]).start()
+    harness = SwarmHarness(path, [dict(first_block=0, num_blocks=4, quant_type=quant)]).start()
     try:
         model = AutoDistributedModelForCausalLM.from_pretrained(
             path, initial_peers=harness.initial_peers
@@ -136,7 +161,7 @@ def test_nf4_decode_path_selection(monkeypatch):
         calls.append(tuple(x.shape))
         return (x.astype(jnp.bfloat16) @ real_dequant(w, jnp.bfloat16)).astype(x.dtype)
 
-    monkeypatch.setattr(quant, "nf4_matmul_pallas", fake_pallas)
+    monkeypatch.setattr(quant, "packed4_matmul_pallas", fake_pallas)
     monkeypatch.setattr(quant.jax, "default_backend", lambda: "tpu")
     monkeypatch.setattr(quant, "_NF4_DECODE_USE_PALLAS", False)
 

@@ -71,7 +71,7 @@ def test_tp_matches_single_device(model_maker, tp_size, tmp_path):
     np.testing.assert_allclose(np.asarray(gt), np.asarray(gp), atol=2e-5, rtol=0)
 
 
-@pytest.mark.parametrize("quant", ["int8", "nf4"])
+@pytest.mark.parametrize("quant", ["int8", "nf4", "int4"])
 def test_tp_quantized_matches_single_device(quant, tmp_path):
     """Quant x TP composition (reference convert_block.py:25-73 quantizes after
     its TP wrap): a TP=2 quantized backend must match the single-device
