@@ -1,0 +1,83 @@
+"""Interactive chat against a petals_tpu swarm.
+
+One server-held KV session spans the whole conversation: each turn only sends
+the NEW tokens (the reference's multi-call `generate()` inside
+`model.inference_session(...)` — remote_generation.py session reuse).
+
+Usage:
+  python examples/chat.py MODEL_PATH --initial_peers ADDR \
+      [--max_new_tokens 64] [--temperature 0.8] [--top_p 0.95] [--max_length 2048]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("model")
+    parser.add_argument("--initial_peers", nargs="+", required=True)
+    parser.add_argument("--max_new_tokens", type=int, default=64)
+    parser.add_argument("--temperature", type=float, default=0.8)
+    parser.add_argument("--top_p", type=float, default=0.95)
+    parser.add_argument("--max_length", type=int, default=2048)
+    parser.add_argument("--greedy", action="store_true", help="greedy decoding instead of sampling")
+    args = parser.parse_args()
+
+    from transformers import AutoTokenizer
+
+    from petals_tpu.client.model import AutoDistributedModelForCausalLM
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model)
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        args.model, initial_peers=args.initial_peers
+    )
+    sample_kwargs = (
+        {} if args.greedy
+        else dict(do_sample=True, temperature=args.temperature, top_p=args.top_p)
+    )
+
+    print("Type your message (Ctrl-D or /quit to exit).")
+    try:
+        with model.inference_session(max_length=args.max_length):
+            # one KV session spans the chat: generate() takes the FULL history
+            # each turn but only the new tokens travel to the servers
+            # (token-skip resume, client/remote_generation.py)
+            history = None
+            while True:
+                try:
+                    user = input("you> ").strip()
+                except EOFError:
+                    break
+                if user == "/quit":
+                    break
+                if not user:
+                    continue
+                ids = np.asarray(
+                    tokenizer(
+                        user + "\n", return_tensors="np",
+                        # BOS belongs once at the start, not mid-history
+                        add_special_tokens=history is None,
+                    )["input_ids"]
+                )
+                history = ids if history is None else np.concatenate([history, ids], axis=1)
+                if history.shape[1] + args.max_new_tokens > args.max_length:
+                    print(f"(conversation reached --max_length {args.max_length}; restart to continue)")
+                    break
+                out = model.generate(
+                    history, max_new_tokens=args.max_new_tokens, **sample_kwargs
+                )
+                reply = tokenizer.decode(out[0, history.shape[1]:], skip_special_tokens=True)
+                history = out
+                print(f"bot> {reply.strip()}")
+    finally:
+        model.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Prompt tuning through a petals_tpu swarm (script form of the reference's
+examples/prompt-tuning-*.ipynb): trains client-held soft prompts to make the
+model reproduce a target text. Servers stay frozen; grads flow through
+rpc_backward (client/training.py).
+
+Usage:
+  python examples/prompt_tuning.py MODEL_PATH --initial_peers ADDR \
+      [--text "..."] [--steps 20] [--lr 0.05] [--pre_seq_len 8] [--deep]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("model")
+    parser.add_argument("--initial_peers", nargs="+", required=True)
+    parser.add_argument("--text", default="A quick brown fox jumps over the lazy dog")
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--pre_seq_len", type=int, default=8)
+    parser.add_argument("--deep", action="store_true", help="deep_ptune: per-block prompts")
+    parser.add_argument("--save", default=None, help="npz path for the trained prompts")
+    args = parser.parse_args()
+
+    from transformers import AutoTokenizer
+
+    from petals_tpu.client.model import AutoDistributedModelForCausalLM
+    from petals_tpu.client.ptune import PTuneConfig
+    from petals_tpu.client.training import compute_loss_and_grads, sgd_step
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model)
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        args.model,
+        initial_peers=args.initial_peers,
+        ptune=PTuneConfig(
+            pre_seq_len=args.pre_seq_len,
+            tuning_mode="deep_ptune" if args.deep else "ptune",
+        ),
+    )
+    try:
+        ids = np.asarray(tokenizer(args.text, return_tensors="np")["input_ids"], np.int64)
+        print(f"Training {args.pre_seq_len} soft prompts on {ids.shape[1]} tokens")
+        for step in range(args.steps):
+            loss, grads = compute_loss_and_grads(model, ids, ids)
+            sgd_step(model, grads, args.lr)
+            print(f"step {step:3d}  loss {float(loss):.4f}")
+
+        if args.save:
+            np.savez(args.save, **{k: np.asarray(v) for k, v in model.trainable_params().items()})
+            print(f"Saved trained prompts to {args.save}")
+    finally:
+        model.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Boot an all-in-one local swarm for experimentation: a bootstrap DHT node,
+a relay service, and N servers splitting the model's blocks evenly. Prints the
+initial-peer address the examples/clients need, then serves until Ctrl-C.
+
+Usage:
+  python examples/run_local_swarm.py MODEL_PATH [--num_servers 2] \
+      [--quant_type none|int8|nf4|int4] [--num_tp_devices N]
+"""
+
+import argparse
+import asyncio
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("model")
+    parser.add_argument("--num_servers", type=int, default=1)
+    parser.add_argument("--quant_type", default="none",
+                        choices=["none", "int8", "nf4", "int4"])
+    parser.add_argument("--num_tp_devices", type=int, default=None)
+    args = parser.parse_args()
+
+    from petals_tpu.dht import DHTNode
+    from petals_tpu.rpc.relay import RelayServer
+    from petals_tpu.server.from_pretrained import get_block_config
+    from petals_tpu.server.server import Server
+
+    _, cfg = get_block_config(args.model)
+    total = cfg.num_hidden_layers
+    if args.num_servers > total:
+        print(f"model has {total} blocks; capping --num_servers {args.num_servers} -> {total}")
+        args.num_servers = total
+    per = (total + args.num_servers - 1) // args.num_servers
+
+    async def run():
+        bootstrap = await DHTNode.create(host="127.0.0.1")
+        relay = RelayServer()
+        await relay.start()
+        relay.register_on(bootstrap.server)
+        print(f"initial peer: {bootstrap.own_addr.to_string()}", flush=True)
+        print(f"relay: {relay.host}:{relay.port}", flush=True)
+
+        servers = []
+        for i in range(args.num_servers):
+            first = i * per
+            server = Server(
+                args.model,
+                initial_peers=[bootstrap.own_addr],
+                first_block=first,
+                num_blocks=min(per, total - first),
+                quant_type=args.quant_type,
+                num_tp_devices=args.num_tp_devices,
+            )
+            await server.start()
+            servers.append(server)
+        print(f"{len(servers)} server(s) ready over blocks [0, {total})", flush=True)
+
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        for server in servers:
+            await server.shutdown()
+        await relay.stop()
+        await bootstrap.shutdown()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

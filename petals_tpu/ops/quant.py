@@ -63,7 +63,7 @@ NF4_CODE = np.array(
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class QuantizedLinear:
-    """A quantized [in, out] weight. ``kind`` in {"int8", "nf4"}."""
+    """A quantized [in, out] weight. ``kind`` in {"int8", "nf4", "int4"}."""
 
     kind: str
     data: jnp.ndarray  # int8 [in, out] | uint8 [in//2, out] (two codes/byte)
