@@ -444,6 +444,12 @@ def main():
     details["decode_70b_nf4"] = dnf4
     print(f"# 70B-shape nf4: {json.dumps(dnf4)}", file=sys.stderr)
 
+    # INT4 (affine decode — ops/quant.py): same 4.25 bits, 2-op dequant; the
+    # decode-bandwidth-optimal 4-bit serving path
+    dint4 = bench_device_decode(llama70b_cfg(10), quant="int4", label="decode_70b_int4")
+    details["decode_70b_int4"] = dint4
+    print(f"# 70B-shape int4: {json.dumps(dint4)}", file=sys.stderr)
+
     # 8k-context prefill through the flash kernel on 70B-shaped blocks
     pf = bench_flash_prefill(llama70b_cfg(2), 8192)
     details["prefill_8k_flash"] = pf

@@ -70,7 +70,8 @@ def main():
                     print(f"(conversation reached --max_length {args.max_length}; restart to continue)")
                     break
                 out = model.generate(
-                    history, max_new_tokens=args.max_new_tokens, **sample_kwargs
+                    history, max_new_tokens=args.max_new_tokens,
+                    eos_token_id=tokenizer.eos_token_id, **sample_kwargs
                 )
                 reply = tokenizer.decode(out[0, history.shape[1]:], skip_special_tokens=True)
                 history = out

@@ -35,7 +35,13 @@ def main():
     if args.num_servers > total:
         print(f"model has {total} blocks; capping --num_servers {args.num_servers} -> {total}")
         args.num_servers = total
-    per = (total + args.num_servers - 1) // args.num_servers
+    # even contiguous split: first (total % n) servers take one extra block
+    base, extra = divmod(total, args.num_servers)
+    spans, first = [], 0
+    for i in range(args.num_servers):
+        n = base + (1 if i < extra else 0)
+        spans.append((first, n))
+        first += n
 
     async def run():
         bootstrap = await DHTNode.create(host="127.0.0.1")
@@ -46,13 +52,12 @@ def main():
         print(f"relay: {relay.host}:{relay.port}", flush=True)
 
         servers = []
-        for i in range(args.num_servers):
-            first = i * per
+        for first_block, num_blocks in spans:
             server = Server(
                 args.model,
                 initial_peers=[bootstrap.own_addr],
-                first_block=first,
-                num_blocks=min(per, total - first),
+                first_block=first_block,
+                num_blocks=num_blocks,
                 quant_type=args.quant_type,
                 num_tp_devices=args.num_tp_devices,
             )

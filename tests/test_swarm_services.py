@@ -129,18 +129,22 @@ def test_throughput_measure_and_cache(tmp_path):
     first_took = time.perf_counter() - t0
     assert info["throughput"] > 0
     assert info["inference_rps"] > 0 and info["forward_rps"] > 0 and info["network_rps"] > 0
-    # second call hits the cache
+    # second call hits the compute cache — but a network override must still
+    # win (network figures are never cached, throughput.py v2 cache)
     t0 = time.perf_counter()
     info2 = get_server_throughput(
-        family, cfg, compute_dtype=jnp.float32, cache_dir=tmp_path, num_blocks=2
+        family, cfg, compute_dtype=jnp.float32, cache_dir=tmp_path, num_blocks=2,
+        network_mbps=100.0,
     )
     assert time.perf_counter() - t0 < first_took / 2
     assert info2["inference_rps"] == info["inference_rps"]
-    # relay penalty applies
+    assert info2["network_rps"] == pytest.approx(100e6 / (cfg.hidden_size * 16))
+    # relay penalty applies (fixed network budget so the comparison is exact)
     relayed = get_server_throughput(
-        family, cfg, compute_dtype=jnp.float32, cache_dir=tmp_path, num_blocks=2, using_relay=True
+        family, cfg, compute_dtype=jnp.float32, cache_dir=tmp_path, num_blocks=2,
+        using_relay=True, network_mbps=100.0,
     )
-    assert relayed["network_rps"] == pytest.approx(info["network_rps"] * 0.2)
+    assert relayed["network_rps"] == pytest.approx(info2["network_rps"] * 0.2)
 
     # a different quant_type / num_devices must NOT reuse the dense cache
     # entry (a stale number would mis-drive routing swarm-wide); re-measures
