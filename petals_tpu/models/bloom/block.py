@@ -30,6 +30,7 @@ def block_apply(
     use_flash: bool = False,
     tp_mesh=None,
     n_valid=None,  # dynamic count of real (non-padding) tokens in this chunk
+    ring_mesh=None,  # training path only: sequence-parallel ring attention over "sp"
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     batch, seq, _ = hidden_states.shape
     h, d = cfg.num_attention_heads, cfg.head_dim
@@ -43,16 +44,28 @@ def block_apply(
 
     k_all, v_all, kv_length = update_kv_cache(kv, k, v, position, n_valid)
     slopes = build_alibi_slopes(h)
-    attn = attend(
-        q,
-        k_all,
-        v_all,
-        q_offset=position,
-        kv_length=kv_length,
-        alibi_slopes=slopes,
-        use_flash=use_flash,
-        tp_mesh=tp_mesh,
-    )
+    if ring_mesh is not None and kv is None:
+        # sequence-parallel training: ALiBi bias is a function of global kv
+        # positions, so it rides the ring (ops/ring_attention.py)
+        if n_valid is not None or not isinstance(position, int) or position != 0:
+            raise ValueError(
+                "ring attention serves the stateless full-sequence path: "
+                "position must be literal 0 and n_valid None (no padded chunks)"
+            )
+        from petals_tpu.ops.ring_attention import ring_attention_sharded
+
+        attn = ring_attention_sharded(q, k_all, v_all, ring_mesh, alibi_slopes=slopes)
+    else:
+        attn = attend(
+            q,
+            k_all,
+            v_all,
+            q_offset=position,
+            kv_length=kv_length,
+            alibi_slopes=slopes,
+            use_flash=use_flash,
+            tp_mesh=tp_mesh,
+        )
     attn = mm(attn.reshape(batch, seq, h * d), params["wo"]) + params["bo"]
     hidden_states = attn + residual
 
@@ -146,5 +159,6 @@ FAMILY = register_family(
         hf_block_prefixes=_HF_BLOCK_PREFIXES,
         hf_to_block_params=hf_to_block_params,
         block_param_shapes=block_param_shapes,
+        supports_ring_attention=True,
     )
 )

@@ -45,6 +45,7 @@ def block_apply(
     use_flash: bool = False,
     tp_mesh=None,
     n_valid=None,
+    ring_mesh=None,  # training path only: sequence-parallel ring attention over "sp"
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     batch, seq, _ = hidden_states.shape
     hq, hkv, d = cfg.num_attention_heads, cfg.num_kv_heads, cfg.head_dim
@@ -83,16 +84,28 @@ def block_apply(
         k = apply_rotary(k, cos, sin)
 
     k_all, v_all, kv_length = update_kv_cache(kv, k, v, position, n_valid)
-    attn = attend(
-        q,
-        k_all,
-        v_all,
-        q_offset=position,
-        kv_length=kv_length,
-        alibi_slopes=alibi_slopes,
-        use_flash=use_flash,
-        tp_mesh=tp_mesh,
-    )
+    if ring_mesh is not None and kv is None:
+        # sequence-parallel training; works for both falcon attention flavors
+        # (pre-scaled ALiBi slopes or RoPE applied above)
+        if n_valid is not None or not isinstance(position, int) or position != 0:
+            raise ValueError(
+                "ring attention serves the stateless full-sequence path: "
+                "position must be literal 0 and n_valid None (no padded chunks)"
+            )
+        from petals_tpu.ops.ring_attention import ring_attention_sharded
+
+        attn = ring_attention_sharded(q, k_all, v_all, ring_mesh, alibi_slopes=alibi_slopes)
+    else:
+        attn = attend(
+            q,
+            k_all,
+            v_all,
+            q_offset=position,
+            kv_length=kv_length,
+            alibi_slopes=alibi_slopes,
+            use_flash=use_flash,
+            tp_mesh=tp_mesh,
+        )
     attn = mm(attn.reshape(batch, seq, hq * d), params["wo"])
     if cfg.bias:
         attn = attn + params["bo"]
@@ -235,5 +248,6 @@ FAMILY = register_family(
         hf_block_prefixes=_HF_BLOCK_PREFIXES,
         hf_to_block_params=hf_to_block_params,
         block_param_shapes=block_param_shapes,
+        supports_ring_attention=True,
     )
 )

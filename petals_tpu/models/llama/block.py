@@ -61,6 +61,11 @@ def block_apply(
     if ring_mesh is not None and kv is None:
         # sequence-parallel training: activations stay sharded on the seq axis;
         # K/V shards rotate over the "sp" ring (ops/ring_attention.py)
+        if n_valid is not None or not isinstance(position, int) or position != 0:
+            raise ValueError(
+                "ring attention serves the stateless full-sequence path: "
+                "position must be literal 0 and n_valid None (no padded chunks)"
+            )
         from petals_tpu.ops.ring_attention import ring_attention_sharded
 
         attn = ring_attention_sharded(q, k_all, v_all, ring_mesh)

@@ -72,6 +72,7 @@ def block_apply(
     use_flash: bool = False,
     tp_mesh=None,
     n_valid=None,
+    ring_mesh=None,  # training path only: sequence-parallel ring attention over "sp"
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     batch, seq, _ = hidden_states.shape
     hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -89,16 +90,30 @@ def block_apply(
     k = apply_rotary(k, cos, sin)
 
     k_all, v_all, kv_length = update_kv_cache(kv, k, v, position, n_valid)
-    attn = attend(
-        q,
-        k_all,
-        v_all,
-        q_offset=position,
-        kv_length=kv_length,
-        sliding_window=cfg.sliding_window,
-        use_flash=use_flash,
-        tp_mesh=tp_mesh,
-    )
+    if ring_mesh is not None and kv is None:
+        # sequence-parallel training: the sliding window applies to GLOBAL
+        # positions inside the ring (ops/ring_attention.py)
+        if n_valid is not None or not isinstance(position, int) or position != 0:
+            raise ValueError(
+                "ring attention serves the stateless full-sequence path: "
+                "position must be literal 0 and n_valid None (no padded chunks)"
+            )
+        from petals_tpu.ops.ring_attention import ring_attention_sharded
+
+        attn = ring_attention_sharded(
+            q, k_all, v_all, ring_mesh, sliding_window=cfg.sliding_window
+        )
+    else:
+        attn = attend(
+            q,
+            k_all,
+            v_all,
+            q_offset=position,
+            kv_length=kv_length,
+            sliding_window=cfg.sliding_window,
+            use_flash=use_flash,
+            tp_mesh=tp_mesh,
+        )
     hidden_states = residual + mm(attn.reshape(batch, seq, hq * d), params["wo"])
 
     residual = hidden_states
@@ -170,5 +185,6 @@ FAMILY = register_family(
         hf_block_prefixes=_HF_BLOCK_PREFIXES,
         hf_to_block_params=hf_to_block_params,
         block_param_shapes=block_param_shapes,
+        supports_ring_attention=True,
     )
 )

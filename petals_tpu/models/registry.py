@@ -34,7 +34,8 @@ class ModelFamily:
     hf_to_cls_params: Optional[Callable] = None  # (dict, cfg) -> params pytree
     cls_head: Optional[Callable] = None  # (params, hidden, cfg) -> per-position label logits
     # block_apply accepts ring_mesh= for sequence-parallel attention on the
-    # stateless (no-KV) path (plain causal attention only — no ALiBi/sliding)
+    # stateless (no-KV) path; ALiBi bias and sliding windows ride the ring
+    # on global positions (ops/ring_attention.py)
     supports_ring_attention: bool = False
 
 

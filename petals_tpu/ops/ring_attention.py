@@ -31,9 +31,13 @@ def ring_attend(
     *,
     axis_name: str = "sp",
     scale: Optional[float] = None,
+    alibi_slopes: Optional[jnp.ndarray] = None,  # [hq_local] BLOOM-style slopes
+    sliding_window: Optional[int] = None,  # Mixtral window, on GLOBAL positions
 ) -> jnp.ndarray:
     """Causal attention across the full (sharded) sequence. Call under
-    shard_map with q/k/v sharded on the sequence axis over ``axis_name``."""
+    shard_map with q/k/v sharded on the sequence axis over ``axis_name``.
+    ALiBi bias and sliding windows follow ops/attention.py semantics on
+    GLOBAL positions, so every family's attention can ride the ring."""
     batch, s_local, hq, d = q.shape
     hkv = k.shape[2]
     group = hq // hkv
@@ -54,8 +58,15 @@ def ring_attend(
         qg = qf.reshape(batch, s_local, hkv, group, d)
         logits = jnp.einsum("bqkgd,bskd->bkgqs", qg, k_blk.astype(jnp.float32)) * scale
         logits = logits.reshape(batch, hq, s_local, s_local)
+        if alibi_slopes is not None:
+            # bias is a function of the absolute kv position only (BLOOM
+            # build_alibi_tensor semantics, ops/attention.py:19-21), unscaled
+            bias = alibi_slopes[:, None, None] * kv_pos.astype(jnp.float32)[None, None, :]
+            logits = logits + bias[None]
 
         mask = kv_pos[None, :] <= q_pos[:, None]  # causal over GLOBAL positions
+        if sliding_window is not None:
+            mask = mask & (kv_pos[None, :] > q_pos[:, None] - sliding_window)
         logits = jnp.where(mask[None, None], logits, NEG_INF)
 
         m_cur = logits.max(axis=-1)
@@ -99,20 +110,35 @@ def ring_attention_sharded(
     mesh: Mesh,
     *,
     axis_name: str = "sp",
+    alibi_slopes: Optional[jnp.ndarray] = None,  # [hq]
+    sliding_window: Optional[int] = None,
 ) -> jnp.ndarray:
     """shard_map wrapper: shards the sequence axis over ``axis_name`` and runs
     the ring. seq must divide the axis size. When the mesh also has a "tp"
     axis, heads ride it (Megatron layout) — the ring math is per-head, so tp
-    and sp compose with no extra collectives."""
+    and sp compose with no extra collectives; ALiBi slopes shard with the
+    heads."""
     from jax import shard_map
 
     head_axis = "tp" if "tp" in mesh.axis_names and mesh.shape["tp"] > 1 else None
     spec = P(None, axis_name, head_axis, None)
+    # one shard_map for both cases: placeholder slopes when None, dropped
+    # inside the per-shard fn (the _flash_sharded pattern, ops/attention.py)
+    use_alibi = alibi_slopes is not None
+    slopes = alibi_slopes if use_alibi else jnp.zeros((q.shape[2],), jnp.float32)
+
+    def per_shard(q_, k_, v_, slopes_):
+        return ring_attend(
+            q_, k_, v_, axis_name=axis_name,
+            alibi_slopes=slopes_ if use_alibi else None,
+            sliding_window=sliding_window,
+        )
+
     fn = shard_map(
-        functools.partial(ring_attend, axis_name=axis_name),
+        per_shard,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, P(head_axis)),
         out_specs=spec,
         check_vma=False,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, slopes)

@@ -1,0 +1,146 @@
+"""Protocol robustness: the RPC server must survive malformed, truncated,
+hostile, and type-confused frames without crashing or leaking state (extends
+the hardening from the round-1 ADVICE findings: length validation, bounded
+queues, shape checks)."""
+
+import asyncio
+import struct
+
+import msgpack
+import pytest
+
+from petals_tpu.rpc.protocol import MAX_FRAME_BYTES, encode_frame, read_frame
+from petals_tpu.rpc.server import RpcServer
+
+
+async def _start_echo_server():
+    server = RpcServer()
+
+    async def echo(payload, ctx):
+        return {"echo": payload}
+
+    async def double(items, ctx):
+        async for item in items:
+            yield {"doubled": item["x"] * 2}
+
+    server.add_unary_handler("test.echo", echo)
+    server.add_stream_handler("test.double", double)
+    await server.start()
+    return server
+
+
+async def _raw_conn(server):
+    reader, writer = await asyncio.open_connection(server.host, server.port)
+    await read_frame(reader)  # server hello
+    return reader, writer
+
+
+def _frame(obj) -> bytes:
+    return encode_frame(obj)
+
+
+def test_server_survives_malformed_frames():
+    """Garbage at every protocol layer; a well-formed call must still work
+    on a FRESH connection afterwards (bad connections may be dropped)."""
+
+    async def scenario():
+        server = await _start_echo_server()
+
+        attacks = [
+            b"\x00\x00\x00\x04junk",  # valid length, invalid msgpack
+            struct.pack(">I", MAX_FRAME_BYTES + 1) + b"x",  # oversized length prefix
+            _frame([1, 2, 3]),  # not a dict
+            _frame({"t": "req"}),  # missing id/method
+            _frame({"t": "req", "id": "not-an-int", "method": "test.echo"}),
+            _frame({"t": "req", "id": 1, "method": "no.such.method"}),
+            _frame({"t": "sitem", "id": 999, "payload": {}}),  # stream never opened
+            _frame({"t": "cancel", "id": 12345}),  # cancel of nothing
+            _frame({"t": "resp", "id": 7, "ok": True}),  # client sending a response
+            _frame({"t": "hello", "peer_id": "zz-not-hex", "pub": "nope", "nonce": "!"}),
+            _frame({"t": "auth", "sig": "zz"}),
+            _frame({"t": None}),
+            b"\x00\x00\x00\x00",  # empty frame -> unpackb error
+        ]
+        for attack in attacks:
+            try:
+                reader, writer = await _raw_conn(server)
+                writer.write(attack)
+                await writer.drain()
+                # give the server a beat to process (and possibly drop us)
+                await asyncio.sleep(0.05)
+                writer.close()
+            except (ConnectionError, asyncio.IncompleteReadError):
+                pass  # server dropping the connection is acceptable
+
+        # the server is still alive and serves a clean client
+        from petals_tpu.rpc.client import RpcClient
+
+        client = await RpcClient.connect(server.host, server.port)
+        reply = await asyncio.wait_for(client.call("test.echo", {"v": 1}), 10)
+        assert reply == {"echo": {"v": 1}}
+        await client.close()
+        await server.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 60))
+
+
+def test_stream_errors_are_contained():
+    """A stream whose handler raises mid-way reports the error to THAT call;
+    other in-flight calls on the same connection are unaffected."""
+
+    async def scenario():
+        server = await _start_echo_server()
+
+        async def explode(items, ctx):
+            async for item in items:
+                if item.get("boom"):
+                    raise ValueError("kaboom")
+                yield {"ok": item}
+
+        server.add_stream_handler("test.explode", explode)
+
+        from petals_tpu.rpc.client import RpcClient
+        from petals_tpu.rpc.server import RpcError
+
+        client = await RpcClient.connect(server.host, server.port)
+        stream = await client.open_stream("test.explode")
+        await stream.send({"boom": False})
+        assert (await stream.recv(timeout=10))["ok"] == {"boom": False}
+        await stream.send({"boom": True})
+        with pytest.raises(RpcError, match="kaboom"):
+            await stream.recv(timeout=10)
+        # the connection survives for other calls
+        reply = await asyncio.wait_for(client.call("test.echo", {"v": 2}), 10)
+        assert reply == {"echo": {"v": 2}}
+        await client.close()
+        await server.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 60))
+
+
+def test_handler_exception_does_not_leak_tasks():
+    """Unary handlers that raise leave no dangling call tasks behind."""
+
+    async def scenario():
+        server = await _start_echo_server()
+
+        async def fail(payload, ctx):
+            raise RuntimeError("nope")
+
+        server.add_unary_handler("test.fail", fail)
+
+        from petals_tpu.rpc.client import RpcClient
+        from petals_tpu.rpc.server import RpcError
+
+        client = await RpcClient.connect(server.host, server.port)
+        for _ in range(20):
+            with pytest.raises(RpcError, match="nope"):
+                await asyncio.wait_for(client.call("test.fail", {}), 10)
+        # call-task registry must be empty after the dust settles
+        await asyncio.sleep(0.1)
+        live = [t for t in asyncio.all_tasks() if "_run_unary" in repr(t.get_coro())]
+        assert not live
+        await client.close()
+        await server.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 60))
