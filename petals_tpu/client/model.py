@@ -236,6 +236,50 @@ class DistributedModelForSequenceClassification(_DistributedModelBase):
     __call__ = forward
 
 
+class DistributedModelForSpeculativeGeneration:
+    """CausalLM over the swarm + a LOCAL draft model for draft-and-verify
+    greedy decoding (counterpart of the reference's
+    DistributedLlamaForSpeculativeGeneration, models/llama/speculative_model.py
+    — family-agnostic here). Output is token-identical to plain greedy; a bad
+    draft only costs speed."""
+
+    def __init__(self, model: DistributedModelForCausalLM, draft_fn, *, speculative_tokens: int = 4):
+        self.model = model
+        self.draft_fn = draft_fn
+        self.speculative_tokens = speculative_tokens
+        self.cfg = model.cfg
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        model_name_or_path: str,
+        draft_model_path: str,
+        *,
+        speculative_tokens: int = 4,
+        **kwargs,
+    ) -> "DistributedModelForSpeculativeGeneration":
+        from petals_tpu.client.speculative import make_local_draft_fn
+
+        model = DistributedModelForCausalLM.from_pretrained(model_name_or_path, **kwargs)
+        return cls(
+            model, make_local_draft_fn(draft_model_path), speculative_tokens=speculative_tokens
+        )
+
+    def generate(self, input_ids, *, max_new_tokens: int, speculative_tokens=None):
+        from petals_tpu.client.speculative import speculative_generate
+
+        return speculative_generate(
+            self.model, self.draft_fn, input_ids,
+            max_new_tokens=max_new_tokens,
+            speculative_tokens=(
+                speculative_tokens if speculative_tokens is not None else self.speculative_tokens
+            ),
+        )
+
+    def close(self) -> None:
+        self.model.close()
+
+
 class AutoDistributedModelForCausalLM:
     """Dispatch on checkpoint model_type (reference utils/auto_config.py:82-99)."""
 

@@ -21,7 +21,7 @@ from petals_tpu.models.common import KVCache, layer_norm, mm, update_kv_cache
 from petals_tpu.models.falcon.config import FalconBlockConfig
 from petals_tpu.models.registry import ModelFamily, register_family
 from petals_tpu.ops.alibi import build_alibi_slopes
-from petals_tpu.ops.attention import attend
+from petals_tpu.ops.attention import attend_maybe_ring
 from petals_tpu.ops.rotary import apply_rotary, rotary_tables
 
 
@@ -84,28 +84,11 @@ def block_apply(
         k = apply_rotary(k, cos, sin)
 
     k_all, v_all, kv_length = update_kv_cache(kv, k, v, position, n_valid)
-    if ring_mesh is not None and kv is None:
-        # sequence-parallel training; works for both falcon attention flavors
-        # (pre-scaled ALiBi slopes or RoPE applied above)
-        if n_valid is not None or not isinstance(position, int) or position != 0:
-            raise ValueError(
-                "ring attention serves the stateless full-sequence path: "
-                "position must be literal 0 and n_valid None (no padded chunks)"
-            )
-        from petals_tpu.ops.ring_attention import ring_attention_sharded
-
-        attn = ring_attention_sharded(q, k_all, v_all, ring_mesh, alibi_slopes=alibi_slopes)
-    else:
-        attn = attend(
-            q,
-            k_all,
-            v_all,
-            q_offset=position,
-            kv_length=kv_length,
-            alibi_slopes=alibi_slopes,
-            use_flash=use_flash,
-            tp_mesh=tp_mesh,
-        )
+    attn = attend_maybe_ring(
+        q, k_all, v_all, kv=kv, position=position, n_valid=n_valid,
+        kv_length=kv_length, ring_mesh=ring_mesh, use_flash=use_flash,
+        tp_mesh=tp_mesh, alibi_slopes=alibi_slopes,
+    )
     attn = mm(attn.reshape(batch, seq, hq * d), params["wo"])
     if cfg.bias:
         attn = attn + params["bo"]

@@ -24,7 +24,7 @@ import numpy as np
 from petals_tpu.models.common import KVCache, mm, rms_norm, silu, update_kv_cache
 from petals_tpu.models.mixtral.config import MixtralBlockConfig
 from petals_tpu.models.registry import ModelFamily, register_family
-from petals_tpu.ops.attention import attend
+from petals_tpu.ops.attention import attend_maybe_ring
 from petals_tpu.ops.rotary import apply_rotary, rotary_tables
 
 
@@ -90,30 +90,11 @@ def block_apply(
     k = apply_rotary(k, cos, sin)
 
     k_all, v_all, kv_length = update_kv_cache(kv, k, v, position, n_valid)
-    if ring_mesh is not None and kv is None:
-        # sequence-parallel training: the sliding window applies to GLOBAL
-        # positions inside the ring (ops/ring_attention.py)
-        if n_valid is not None or not isinstance(position, int) or position != 0:
-            raise ValueError(
-                "ring attention serves the stateless full-sequence path: "
-                "position must be literal 0 and n_valid None (no padded chunks)"
-            )
-        from petals_tpu.ops.ring_attention import ring_attention_sharded
-
-        attn = ring_attention_sharded(
-            q, k_all, v_all, ring_mesh, sliding_window=cfg.sliding_window
-        )
-    else:
-        attn = attend(
-            q,
-            k_all,
-            v_all,
-            q_offset=position,
-            kv_length=kv_length,
-            sliding_window=cfg.sliding_window,
-            use_flash=use_flash,
-            tp_mesh=tp_mesh,
-        )
+    attn = attend_maybe_ring(
+        q, k_all, v_all, kv=kv, position=position, n_valid=n_valid,
+        kv_length=kv_length, ring_mesh=ring_mesh, use_flash=use_flash,
+        tp_mesh=tp_mesh, sliding_window=cfg.sliding_window,
+    )
     hidden_states = residual + mm(attn.reshape(batch, seq, hq * d), params["wo"])
 
     residual = hidden_states

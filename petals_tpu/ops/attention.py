@@ -134,6 +134,45 @@ def attend(
     )
 
 
+def attend_maybe_ring(
+    q: jnp.ndarray,
+    k_all: jnp.ndarray,
+    v_all: jnp.ndarray,
+    *,
+    kv,  # the block's incoming cache (None on the stateless training path)
+    position,
+    n_valid,
+    kv_length,
+    ring_mesh,
+    use_flash: bool = False,
+    tp_mesh=None,
+    alibi_slopes: Optional[jnp.ndarray] = None,
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """The one attention dispatch every family block uses: sequence-parallel
+    ring attention on the stateless full-sequence path when a ring mesh is
+    given, plain ``attend`` otherwise. Centralised so the ring preconditions
+    (literal position 0, no padded chunks) are enforced in exactly one place."""
+    if ring_mesh is not None and kv is None:
+        if n_valid is not None or not isinstance(position, int) or position != 0:
+            raise ValueError(
+                "ring attention serves the stateless full-sequence path: "
+                "position must be literal 0 and n_valid None (no padded chunks)"
+            )
+        from petals_tpu.ops.ring_attention import ring_attention_sharded
+
+        return ring_attention_sharded(
+            q, k_all, v_all, ring_mesh,
+            alibi_slopes=alibi_slopes, sliding_window=sliding_window,
+        )
+    return attend(
+        q, k_all, v_all,
+        q_offset=position, kv_length=kv_length,
+        alibi_slopes=alibi_slopes, sliding_window=sliding_window,
+        use_flash=use_flash, tp_mesh=tp_mesh,
+    )
+
+
 def attend_reference(
     q: jnp.ndarray,
     k: jnp.ndarray,

@@ -38,17 +38,20 @@ class ReachabilityProtocol:
     async def rpc_check(self, payload, ctx: RpcContext):
         addr = PeerAddr.from_string(payload["addr"])
         try:
+            deadline = asyncio.get_running_loop().time() + self.probe_timeout
             client = await asyncio.wait_for(
                 RpcClient.connect(addr.host, addr.port, identity=self.identity),
                 self.probe_timeout,
             )
             if self.identity is not None:
-                # authenticated probe: the endpoint must PROVE the claimed id
-                for _ in range(20):
-                    if client.remote_peer_id is not None:
-                        break
-                    await asyncio.sleep(0.05)
-                ok = client.remote_peer_id == addr.peer_id
+                # authenticated probe: the endpoint must PROVE the claimed id.
+                # The whole probe shares ONE probe_timeout budget, so the reply
+                # lands inside the asking peer's RPC timeout even when the
+                # target accepts TCP but never proves (a definitive False beats
+                # a dropped vote).
+                remaining = max(deadline - asyncio.get_running_loop().time(), 0.1)
+                proven = await client.wait_authenticated(remaining)
+                ok = proven == addr.peer_id
             else:
                 ok = client.remote_peer_id == addr.peer_id or client.remote_peer_id is None
             await client.close()

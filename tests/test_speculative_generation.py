@@ -95,3 +95,22 @@ def test_full_acceptance_no_duplicates(swarm):
 
     out = speculative_generate(model, oracle, ids, max_new_tokens=NEW_TOKENS, speculative_tokens=3)
     np.testing.assert_array_equal(out, expected)
+
+
+def test_speculative_model_class(swarm):
+    """The model-level API (reference DistributedLlamaForSpeculativeGeneration
+    analogue) produces the same tokens as plain greedy."""
+    from petals_tpu.client.model import DistributedModelForSpeculativeGeneration
+
+    path, harness, model = swarm
+    spec_model = DistributedModelForSpeculativeGeneration.from_pretrained(
+        path, path, initial_peers=harness.initial_peers, speculative_tokens=3
+    )
+    try:
+        rng = np.random.RandomState(7)
+        ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+        out = spec_model.generate(ids, max_new_tokens=NEW_TOKENS)
+        expected = _hf_greedy(path, ids, NEW_TOKENS)
+        np.testing.assert_array_equal(out, expected)
+    finally:
+        spec_model.close()
