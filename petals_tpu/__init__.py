@@ -49,6 +49,7 @@ __all__ = [
     "DistributedModelForCausalLM",
     "AutoDistributedModelForSequenceClassification",
     "DistributedModelForSequenceClassification",
+    "DistributedModelForSpeculativeGeneration",
     "Server",
     "DHTNode",
     "InferenceSession",
@@ -65,6 +66,7 @@ def __getattr__(name):  # lazy: client/server pull in jax & friends
         "DistributedModelForCausalLM",
         "AutoDistributedModelForSequenceClassification",
         "DistributedModelForSequenceClassification",
+        "DistributedModelForSpeculativeGeneration",
     ):
         from petals_tpu.client import model as _model
 
