@@ -1,0 +1,1 @@
+"""PyTorch interop layer (see compat/torch_model.py)."""

@@ -1,0 +1,110 @@
+"""PyTorch adapter (compat/torch_model.py): torch autograd through the swarm
+must agree numerically with the native JAX training path, and torch
+optimizers must train soft prompts through remote servers."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from petals_tpu.client.model import AutoDistributedModelForCausalLM
+from petals_tpu.client.ptune import PTuneConfig
+from petals_tpu.client.training import compute_loss_and_grads
+from petals_tpu.compat.torch_model import TorchDistributedModelForCausalLM
+from tests.test_full_model import SwarmHarness
+from tests.utils import make_tiny_llama
+
+PRE_SEQ = 4
+
+
+@pytest.fixture(scope="module")
+def swarm(tmp_path_factory):
+    path = make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+    harness = SwarmHarness(path, [dict(first_block=0, num_blocks=4)]).start()
+    yield path, harness
+    harness.stop()
+
+
+def test_torch_logits_match_native(swarm):
+    path, harness = swarm
+    model = TorchDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers
+    )
+    native = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers
+    )
+    try:
+        rng = np.random.RandomState(0)
+        ids = torch.from_numpy(rng.randint(0, 100, (2, 6)).astype(np.int64))
+        out = model(ids)
+        assert out.loss is None
+        expected = np.asarray(native.forward(ids.numpy()))
+        np.testing.assert_allclose(out.logits.numpy(), expected, atol=1e-4, rtol=1e-4)
+
+        gen = model.generate(ids, max_new_tokens=3)
+        assert gen.shape == (2, 9)
+    finally:
+        model.close()
+        native.close()
+
+
+def test_torch_prompt_grads_match_native(swarm):
+    """Same checkpoint, same prompts, same loss formula: torch grads through
+    the swarm must equal the native JAX path's grads."""
+    path, harness = swarm
+    torch_model = TorchDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers, pre_seq_len=PRE_SEQ
+    )
+    native = AutoDistributedModelForCausalLM.from_pretrained(
+        path,
+        initial_peers=harness.initial_peers,
+        ptune=PTuneConfig(pre_seq_len=PRE_SEQ, tuning_mode="ptune"),
+    )
+    try:
+        # align the trainable state
+        native_prompts = np.asarray(native.trainable_params()["prompt_embeddings"])
+        with torch.no_grad():
+            torch_model.prompt_embeddings.copy_(torch.from_numpy(native_prompts.copy()))
+
+        rng = np.random.RandomState(1)
+        ids_np = rng.randint(0, 100, (2, 6)).astype(np.int64)
+        ids = torch.from_numpy(ids_np)
+
+        out = torch_model(ids, labels=ids)
+        out.loss.backward()
+
+        native_loss, native_grads = compute_loss_and_grads(native, ids_np, ids_np)
+
+        np.testing.assert_allclose(float(out.loss.detach()), native_loss, atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(
+            torch_model.prompt_embeddings.grad.numpy(),
+            np.asarray(native_grads["prompt_embeddings"]),
+            atol=1e-4, rtol=1e-3,
+        )
+    finally:
+        torch_model.close()
+        native.close()
+
+
+def test_torch_optimizer_trains_through_swarm(swarm):
+    path, harness = swarm
+    model = TorchDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers, pre_seq_len=PRE_SEQ
+    )
+    try:
+        torch.manual_seed(0)
+        opt = torch.optim.Adam([model.prompt_embeddings], lr=0.05)
+        rng = np.random.RandomState(2)
+        ids = torch.from_numpy(rng.randint(0, 100, (2, 8)).astype(np.int64))
+
+        losses = []
+        for _ in range(6):
+            opt.zero_grad()
+            out = model(ids, labels=ids)
+            out.loss.backward()
+            assert torch.isfinite(out.loss)
+            opt.step()
+            losses.append(float(out.loss))
+        assert losses[-1] < losses[0], losses
+    finally:
+        model.close()
