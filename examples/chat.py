@@ -42,6 +42,31 @@ def main():
         else dict(do_sample=True, temperature=args.temperature, top_p=args.top_p)
     )
 
+    class PrintStreamer:
+        """Prints tokens as they arrive (generate()'s HF streamer protocol).
+        Decodes the WHOLE reply each step and prints the new suffix — decoding
+        tokens in isolation would drop SentencePiece word boundaries and break
+        multi-token UTF-8 characters (the TextStreamer algorithm)."""
+
+        def __init__(self):
+            self.first = True  # the first put() is the prompt: don't echo it
+            self.tokens: list = []
+            self.printed = 0
+
+        def put(self, value):
+            if self.first:
+                self.first = False
+                return
+            self.tokens.extend(np.asarray(value).reshape(-1).tolist())
+            text = tokenizer.decode(self.tokens, skip_special_tokens=True)
+            if len(text) > self.printed and not text.endswith("\ufffd"):
+                print(text[self.printed:], end="", flush=True)
+                self.printed = len(text)
+
+        def end(self):
+            print(flush=True)
+            self.first, self.tokens, self.printed = True, [], 0
+
     print("Type your message (Ctrl-D or /quit to exit).")
     try:
         with model.inference_session(max_length=args.max_length):
@@ -69,13 +94,13 @@ def main():
                 if history.shape[1] + args.max_new_tokens > args.max_length:
                     print(f"(conversation reached --max_length {args.max_length}; restart to continue)")
                     break
+                print("bot> ", end="", flush=True)
                 out = model.generate(
                     history, max_new_tokens=args.max_new_tokens,
-                    eos_token_id=tokenizer.eos_token_id, **sample_kwargs
+                    eos_token_id=tokenizer.eos_token_id, streamer=PrintStreamer(),
+                    **sample_kwargs
                 )
-                reply = tokenizer.decode(out[0, history.shape[1]:], skip_special_tokens=True)
                 history = out
-                print(f"bot> {reply.strip()}")
     finally:
         model.close()
 

@@ -172,6 +172,7 @@ class RemoteGenerationMixin:
         session=None,
         seed: Optional[int] = None,
         prompts: Optional[np.ndarray] = None,
+        streamer=None,  # HF BaseStreamer protocol: .put(tokens), .end()
     ) -> np.ndarray:
         if num_return_sequences < 1:
             raise ValueError("num_return_sequences must be >= 1")
@@ -188,6 +189,8 @@ class RemoteGenerationMixin:
                 max_new_tokens, max_length - np.asarray(input_ids).shape[1]
             )
         if num_beams > 1:
+            if streamer is not None:
+                raise ValueError("streamer is not supported with beam search (HF semantics)")
             # explicit rejections beat silent divergence from HF semantics
             assert not do_sample, "beam search is deterministic (use num_beams=1 to sample)"
             if session is not None or self._active_session is not None:
@@ -244,6 +247,8 @@ class RemoteGenerationMixin:
                     f"(position {session.position}); pass the sequence returned by the "
                     f"previous generate() call, which includes the pending last token"
                 )
+            if streamer is not None:
+                streamer.put(input_ids)  # HF: the prompt goes first
             hidden = np.asarray(self.embed(new_tokens, with_prompts=session.position == 0))
             out_hidden = session.step(hidden, prompts=prompts)
             logits = np.asarray(self.lm_logits(out_hidden[:, -1:]))[:, 0]
@@ -272,6 +277,8 @@ class RemoteGenerationMixin:
                     next_token = np.where(finished, fill, next_token)
                     finished |= next_token == eos_token_id
                 generated = np.concatenate([generated, next_token[:, None]], axis=1)
+                if streamer is not None:
+                    streamer.put(np.asarray(next_token))
                 if eos_token_id is not None and finished.all():
                     break
                 if i + 1 == max_new_tokens:
@@ -285,6 +292,8 @@ class RemoteGenerationMixin:
                 hidden = np.asarray(self.embed(next_token[:, None], with_prompts=False))
                 out_hidden = session.step(hidden, prompts=prompts)
                 logits = np.asarray(self.lm_logits(out_hidden[:, -1:]))[:, 0]
+            if streamer is not None:
+                streamer.end()
             return generated
         finally:
             if own_session:

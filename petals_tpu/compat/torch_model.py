@@ -84,6 +84,10 @@ class TorchRemoteSequential(torch.nn.Module):
         self.remote = remote
 
     def forward(self, hidden: torch.Tensor) -> torch.Tensor:
+        if not torch.is_grad_enabled():
+            # eval path: no per-span activation histories retained
+            np_hidden = np.ascontiguousarray(hidden.detach().cpu().numpy(), dtype=np.float32)
+            return torch.from_numpy(np.ascontiguousarray(self.remote.forward(np_hidden))).to(hidden.dtype)
         return _RemoteBlocksFn.apply(hidden, self.remote)
 
     def close(self) -> None:
@@ -119,6 +123,10 @@ class TorchDistributedModelForCausalLM(torch.nn.Module):
         pre_seq_len: int = 0,
         **kwargs,
     ) -> "TorchDistributedModelForCausalLM":
+        if "ptune" in kwargs:
+            # two prompt states (random JAX prompts in generate, torch prompts
+            # in training) would silently diverge — prompts live torch-side here
+            raise ValueError("use pre_seq_len= (torch-held prompts), not ptune=")
         native = DistributedModelForCausalLM.from_pretrained(
             model_name_or_path, initial_peers=initial_peers, **kwargs
         )
@@ -146,7 +154,12 @@ class TorchDistributedModelForCausalLM(torch.nn.Module):
         hidden = self.blocks(hidden)
 
         head_fn = lambda h: self.native._head_jit(self.native.client_params, h)  # noqa: E731
-        logits_full = _JaxFn.apply(hidden, head_fn)  # [batch, pre+seq, vocab] f32
+        if torch.is_grad_enabled():
+            logits_full = _JaxFn.apply(hidden, head_fn)  # [batch, pre+seq, vocab] f32
+        else:  # eval path: plain jitted head, no vjp residuals
+            logits_full = torch.from_numpy(
+                np.array(head_fn(hidden.detach().cpu().numpy()), copy=True)
+            )
 
         loss = None
         if labels is not None:

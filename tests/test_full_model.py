@@ -458,3 +458,37 @@ def test_repetition_penalties_match_hf(llama_client):
             ).numpy()
         ours = model.generate(input_ids, **kwargs)
         np.testing.assert_array_equal(ours, expected, err_msg=str(kwargs))
+
+
+def test_generate_streamer(llama_swarm):
+    """HF streamer protocol: the prompt then every sampled token, then end();
+    the streamed tokens reassemble the returned sequence exactly."""
+    path, harness = llama_swarm
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers
+    )
+
+    class Recorder:
+        def __init__(self):
+            self.chunks, self.ended = [], False
+
+        def put(self, value):
+            self.chunks.append(np.asarray(value))
+
+        def end(self):
+            self.ended = True
+
+    try:
+        rng = np.random.RandomState(11)
+        ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+        rec = Recorder()
+        out = model.generate(ids, max_new_tokens=6, streamer=rec)
+        assert rec.ended
+        np.testing.assert_array_equal(rec.chunks[0], ids)  # prompt first
+        streamed = np.concatenate([c.reshape(1, -1) for c in rec.chunks], axis=1)
+        np.testing.assert_array_equal(streamed, out)
+
+        with pytest.raises(ValueError, match="streamer"):
+            model.generate(ids, max_new_tokens=2, num_beams=2, streamer=rec)
+    finally:
+        model.close()
