@@ -91,7 +91,7 @@ def random_params(cfg, n_blocks, dtype, quant=None):
             return params
 
         stacked = init_stacked(key)
-        jax.block_until_ready(stacked)
+        hard_sync(stacked)
         return stacked
 
     @jax.jit
@@ -106,10 +106,10 @@ def random_params(cfg, n_blocks, dtype, quant=None):
     for b in range(n_blocks):
         key, sub = jax.random.split(key)
         block = convert_block_params(init(sub), "llama", quant)
-        jax.block_until_ready(block)  # bound the dense-block transient
+        hard_sync(block)  # bound the dense-block transient
         per_block.append(block)
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
-    jax.block_until_ready(stacked)
+    hard_sync(stacked)
     return stacked
 
 
@@ -129,6 +129,20 @@ def params_bytes(params) -> int:
     return total
 
 
+def hard_sync(x) -> None:
+    """Real device->host sync. ``jax.block_until_ready`` does NOT block under
+    some axon tunnel builds (dispatch returns immediately and readiness is
+    proxied), which silently turns timing loops into dispatch-rate metrics —
+    fetching one data-dependent element forces the computation to finish."""
+    import jax
+    import jax.numpy as jnp
+
+    # every leaf: a pytree built from several dispatches (one stack per leaf,
+    # a (k, v) cache pair) is only fully settled when each buffer is forced
+    for leaf in jax.tree_util.tree_leaves(x):
+        np.asarray(jax.device_get(jnp.ravel(leaf)[:1]))
+
+
 def measure_sync_overhead() -> float:
     """Per-sync cost of a device->host round trip through the axon tunnel."""
     import jax
@@ -136,11 +150,11 @@ def measure_sync_overhead() -> float:
 
     x = jnp.zeros((), jnp.float32)
     f = jax.jit(lambda v: v + 1)
-    jax.block_until_ready(f(x))
+    np.asarray(jax.device_get(f(x)))
     t0 = time.perf_counter()
     n = 10
     for _ in range(n):
-        jax.block_until_ready(f(x))
+        np.asarray(jax.device_get(f(x)))
     return (time.perf_counter() - t0) / n
 
 
@@ -178,7 +192,7 @@ def bench_device_decode(cfg, *, quant=None, label="", batches=3, steps=25):
     for _ in range(WARMUP_STEPS):
         out, kv = backend.inference_step(step_h, kv, pos)
         pos += 1
-    jax.block_until_ready(out)
+    hard_sync(out)
 
     sync = measure_sync_overhead()
     per_step = []
@@ -187,7 +201,7 @@ def bench_device_decode(cfg, *, quant=None, label="", batches=3, steps=25):
         for _ in range(steps):
             out, kv = backend.inference_step(step_h, kv, pos)
             pos += 1
-        jax.block_until_ready(out)
+        hard_sync(out)
         elapsed = time.perf_counter() - t0
         per_step.append(max(elapsed - sync, 1e-9) / steps)
 
@@ -236,21 +250,21 @@ def bench_flash_prefill(cfg, seq, *, runs=3):
     hidden = jax.device_put(
         jnp.asarray(rng.randn(1, seq, cfg.hidden_size).astype(np.float32) * 0.02, dtype)
     )
-    jax.block_until_ready(hidden)
+    hard_sync(hidden)
 
     kv = (kd.make_zeros(), vd.make_zeros())
     out, kv = backend.inference_step(hidden, kv, 0)  # compile
-    jax.block_until_ready(out)
+    hard_sync(out)
     del kv
 
     sync = measure_sync_overhead()
     times = []
     for _ in range(runs):
         kv = (kd.make_zeros(), vd.make_zeros())
-        jax.block_until_ready(kv)
+        hard_sync(kv)
         t0 = time.perf_counter()
         out, kv = backend.inference_step(hidden, kv, 0)
-        jax.block_until_ready(out)
+        hard_sync(out)
         times.append(max(time.perf_counter() - t0 - sync, 1e-9))
         del kv
     t = statistics.median(times)
@@ -358,11 +372,11 @@ async def run_e2e_bench():
     out = None
     for i in range(3):
         out, kv = backend.inference_step(step_hidden, kv, PREFILL_TOKENS + i)
-    jax.block_until_ready(out)
+    hard_sync(out)
     t0 = time.perf_counter()
     for i in range(MEASURE_STEPS):
         out, kv = backend.inference_step(step_hidden, kv, PREFILL_TOKENS + 3 + i)
-    jax.block_until_ready(out)
+    hard_sync(out)
     device_step = (time.perf_counter() - t0) / MEASURE_STEPS
 
     result = {

@@ -64,6 +64,12 @@ def main():
                 self.printed = len(text)
 
         def end(self):
+            # flush whatever the � guard was still holding back (an
+            # incomplete char at the very end prints minus its broken tail)
+            text = tokenizer.decode(self.tokens, skip_special_tokens=True)
+            text = text.rstrip("�")
+            if len(text) > self.printed:
+                print(text[self.printed:], end="")
             print(flush=True)
             self.first, self.tokens, self.printed = True, [], 0
 

@@ -13,16 +13,13 @@ formats and kernels are implemented here:
   bits/param (the sizing constant the reference placement math uses,
   server/block_utils.py:46).
 - INT4 (beyond reference): same packing/blocking as NF4 but with an AFFINE
-  code map, value = (code - 8) * scale. NF4's irregular codebook needs a
-  15-step select chain per weight element on the VPU — decode-bound at M=1 —
-  while the affine map decodes in two arithmetic ops and runs near the
-  bandwidth bound. Slightly worse quantization error than NF4 (uniform vs
-  normal-float levels), a TPU-native serving tradeoff the operator picks
-  with quant_type="int4".
+  code map, value = (code - 8) * scale. Slightly worse quantization error
+  than NF4 (uniform vs normal-float levels); kept as a serving option.
 - ``packed4_matmul_pallas``: fused kernel for both 4-bit kinds — packed tiles
-  stream into VMEM, codes are unpacked and decoded on the VPU (select chain
-  for nf4, subtract for int4), dequantized tiles feed the MXU; the bf16
-  weight matrix is never materialized in HBM.
+  stream into VMEM, codes decode via the VPU's native 2-D lane gather into a
+  16-entry table (one op per element; both code maps ride the same gather),
+  dequantized tiles feed the MXU in bf16; the bf16 weight matrix is never
+  materialized in HBM. See _packed4_kernel for the decode design notes.
 
 ``QuantizedLinear`` is a pytree node, so quantized span params stack/scan/jit
 exactly like dense ones.
@@ -43,8 +40,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NF4_BLOCK = 64
-_TK = 512  # Pallas input-axis k-tile (packed rows: 256; 8 absmax blocks)
-_TN = 256  # Pallas output-axis tile
+_TK = 1024  # Pallas input-axis k-tile (packed rows: 512; 16 absmax blocks)
+_TN = 512  # Pallas output-axis tile (halved when out_features % 512 != 0)
+_TN_MIN = 256  # fallback output tile; also the supported-shape divisibility bar
 _TM = 512  # Pallas token-axis tile (bounds VMEM for long prefills)
 
 # QLoRA NormalFloat4 codebook (ascending)
@@ -105,7 +103,7 @@ def quantize_int8(w: jnp.ndarray) -> QuantizedLinear:
 
 
 def _pad_rows(w: jnp.ndarray):
-    """Pad the input axis to a multiple of the Pallas k-tile (512) with zero
+    """Pad the input axis to a multiple of the Pallas k-tile (_TK) with zero
     rows (which both 4-bit formats encode exactly), so the fused kernel tiles
     cleanly for any layer shape; in_features records the logical size."""
     n_in, n_out = w.shape
@@ -206,12 +204,12 @@ def quant_matmul(x: jnp.ndarray, w) -> jnp.ndarray:
 # dequant-matmul path instead (XLA partitions it and inserts the psum).
 _FORCE_XLA_PATH = contextvars.ContextVar("ptu_quant_force_xla", default=False)
 
-# DECODE-shape path choice. At M=1 the fused kernel is VPU-decode-bound (the
-# 16-way select chain, ~3% of HBM bandwidth on v5e — BENCH_DETAILS.json) while
-# XLA's gather-based dequantize may beat it; neither can be predicted across
-# toolchains, so servers measure both once at startup (autotune below) and the
-# winner is traced into the small-M path. Prefill (large M) always takes the
-# fused kernel: there the matmul amortizes the decode.
+# DECODE-shape path choice. The gather-decode kernel measured ~10x the old
+# select-chain kernel and ~1.5x XLA's dequant-matmul at M=1 on v5e, but the
+# margin over XLA varies with toolchain/load, so servers still measure both
+# once at startup (autotune below) and trace the winner into the small-M path.
+# Prefill (large M) always takes the fused kernel: there the MXU amortizes
+# the decode and the kernel's bf16 dots win decisively.
 _NF4_DECODE_MAX_M = 32
 _NF4_DECODE_USE_PALLAS = True
 _NF4_AUTOTUNED = False
@@ -222,9 +220,7 @@ def set_nf4_decode_path(use_pallas: bool) -> None:
     _NF4_DECODE_USE_PALLAS = bool(use_pallas)
 
 
-def maybe_autotune_nf4_decode(
-    in_features: int = 4096, out_features: int = 4096, *, steps: int = 20
-) -> bool:
+def maybe_autotune_nf4_decode(in_features: int = 4096, *, steps: int = 20) -> bool:
     """Measure the Pallas kernel vs the XLA dequant-matmul at decode shape on
     the real device, once per process; returns the chosen use_pallas. No-op
     (keeps the default) off-TPU."""
@@ -233,11 +229,12 @@ def maybe_autotune_nf4_decode(
         return _NF4_DECODE_USE_PALLAS
     import time
 
-    # a representative probe shape is enough — full 70B dims would allocate
-    # multi-GB f32 transients inside quantize_nf4 on an HBM already holding
-    # the span; tile-align so the kernel's supported-shape predicate holds
-    in_features = min(_round_up(in_features, _TK), 4096)
-    out_features = min(_round_up(out_features, _TN), 4096)
+    # probe at the model's hidden size (the path choice is shape-dependent:
+    # pallas won at 8192 but lost at 4096 on the same chip), capped at 8192 —
+    # full 70B MLP dims would allocate ~GB f32 transients inside quantize_nf4
+    # on an HBM that already holds the span
+    in_features = min(_round_up(in_features, _TK), 8192)
+    out_features = in_features  # square, so timed() can chain output -> input
 
     key = jax.random.PRNGKey(0)
     w = quantize_nf4(jax.random.normal(key, (in_features, out_features), jnp.bfloat16) * 0.02)
@@ -246,31 +243,49 @@ def maybe_autotune_nf4_decode(
         _NF4_AUTOTUNED = True  # kernel can't serve this shape class anyway
         return _NF4_DECODE_USE_PALLAS
 
-    def timed(fn, *args):
-        out = fn(x, *args)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            out = fn(x, *args)
-        jax.block_until_ready(out)
-        return time.perf_counter() - t0
+    def timed(mm):
+        # Chain data-dependent calls INSIDE one jit and take the slope between
+        # two chain lengths: per-dispatch latency (a WAN round trip under the
+        # axon tunnel, ~ms) and the device->host sync cost cancel out.
+        # jax.block_until_ready is NOT a real sync under some tunnel builds,
+        # so completion is forced by fetching one output element.
+        def chain(k):
+            @jax.jit
+            def f(v, data, scales):
+                a = v
+                for _ in range(k):
+                    a = mm(a, data, scales) * 1e-2
+                return a
+            return f
+
+        ts = {}
+        for k in (2, 2 + steps):
+            f = chain(k)
+            f(x, w.data, w.scales)  # compile
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    out = f(x, w.data, w.scales)
+                np.asarray(jax.device_get(out[0, :1]))
+                best = min(best, (time.perf_counter() - t0) / 5)
+            ts[k] = best
+        return max((ts[2 + steps] - ts[2]) / steps, 1e-9)
 
     # weight leaves ride as jit ARGUMENTS, exactly like the production trace
     # (_nf4_mm_fwd_impl) — as compile-time constants XLA could fold the
     # dequantize away and the timing would flatter the XLA arm
-    pallas_fn = jax.jit(
+    t_pallas = timed(
         lambda v, data, scales: nf4_matmul_pallas(
             v, QuantizedLinear("nf4", data, scales, in_features, out_features)
         )
     )
-    xla_fn = jax.jit(
+    t_xla = timed(
         lambda v, data, scales: v.astype(jnp.bfloat16)
         @ dequantize(
             QuantizedLinear("nf4", data, scales, in_features, out_features), jnp.bfloat16
         )
     )
-    t_pallas = timed(pallas_fn, w.data, w.scales)
-    t_xla = timed(xla_fn, w.data, w.scales)
     use_pallas = t_pallas <= t_xla
     set_nf4_decode_path(use_pallas)
     _NF4_AUTOTUNED = True
@@ -278,7 +293,7 @@ def maybe_autotune_nf4_decode(
 
     get_logger(__name__).info(
         f"NF4 decode autotune ({in_features}x{out_features}): pallas "
-        f"{t_pallas / steps * 1e3:.2f}ms vs xla {t_xla / steps * 1e3:.2f}ms "
+        f"{t_pallas * 1e3:.2f}ms vs xla {t_xla * 1e3:.2f}ms per matmul "
         f"-> {'pallas' if use_pallas else 'xla'}"
     )
     return use_pallas
@@ -295,7 +310,7 @@ def force_xla_quant_matmul():
 
 def _nf4_pallas_supported(x2d, data) -> bool:
     n_stored, n_out = data.shape[-2] * 2, data.shape[-1]
-    return n_stored % _TK == 0 and n_out % _TN == 0 and data.ndim == 2
+    return n_stored % _TK == 0 and n_out % _TN_MIN == 0 and data.ndim == 2
 
 
 def _q4_mm_fwd_impl(kind, x2d, data, scales):
@@ -345,8 +360,24 @@ _int4_mm = _make_q4_mm("int4")
 
 
 
-def _packed4_kernel(x_ref, packed_ref, scales_ref, o_ref, acc_ref, *, n_k: int, affine: bool):
-    """Grid (m, n, k): accumulate x_tile @ dequant(w_tile) into acc."""
+def _packed4_kernel(
+    xe_ref, xo_ref, packed_ref, scales_ref, table_ref, o_ref, acc_ref,
+    *, n_k: int, dot_in_f32: bool = False
+):
+    """Grid (m, n, k): accumulate x_tile @ dequant(w_tile) into acc.
+
+    Decode design (why this is ~10x the naive kernel at decode shapes):
+    - codes -> values via the VPU's native 2-D lane gather (take_along_axis on
+      a [rows, 128] table broadcast), ONE op per element, instead of a 15-step
+      compare+select chain over the irregular NF4 codebook. int4's affine map
+      rides the same gather with an affine table — one code path for both.
+    - x arrives pre-split into even/odd input rows (xe/xo, split OUTSIDE the
+      kernel where XLA handles the stride-2 slice), so the two decoded halves
+      feed two MXU dots directly — no [half, 2, TN] -> [TK, TN] sublane
+      interleave relayout, which Mosaic lowers slowly.
+    - dots run on bf16 inputs with f32 accumulation, mirroring the XLA
+      fallback's numerics (x.astype(bf16) @ dequantize(w, bf16)).
+    """
     k = pl.program_id(2)
 
     @pl.when(k == 0)
@@ -355,36 +386,49 @@ def _packed4_kernel(x_ref, packed_ref, scales_ref, o_ref, acc_ref, *, n_k: int, 
 
     # widen to int32 first: Mosaic has no 8-bit shift ops (arith.shrui on i8)
     packed = packed_ref[...].astype(jnp.int32)  # [TK//2, TN]
-    lo = packed & 0x0F
-    hi = (packed >> 4) & 0x0F
+    lo = packed & 0x0F  # rows 0,2,4,... of the logical TK tile
+    hi = (packed >> 4) & 0x0F  # rows 1,3,5,...
+    half, tn = lo.shape
+    rows = half * tn // 128
+    tbl = jnp.broadcast_to(table_ref[0:1, :], (rows, 128))
 
-    if affine:  # int4: two arithmetic ops per element — never decode-bound
-        def decode(codes):
-            return (codes - 8).astype(jnp.float32)
-    else:  # nf4: irregular codebook, 15-step select chain
+    def decode(codes):
+        # gather dimension must fit one vreg: view the tile as [rows, 128]
+        return jnp.take_along_axis(tbl, codes.reshape(rows, 128), axis=1).reshape(half, tn)
 
-        def decode(codes):
-            vals = jnp.full(codes.shape, NF4_CODE[0], jnp.float32)
-            for i in range(1, 16):
-                vals = jnp.where(codes == i, NF4_CODE[i], vals)
-            return vals
-
-    d_lo = decode(lo)  # rows 0,2,4,... of the TK tile
-    d_hi = decode(hi)  # rows 1,3,5,...
-    # interleave to [TK, TN]
-    w_tile = jnp.stack([d_lo, d_hi], axis=1).reshape(_TK, _TN)
-    # apply blockwise absmax: scales_ref [TK//NF4_BLOCK, TN]
-    scales = scales_ref[...].astype(jnp.float32)
-    w_tile = (w_tile.reshape(_TK // NF4_BLOCK, NF4_BLOCK, _TN) * scales[:, None, :]).reshape(_TK, _TN)
-
-    x_tile = x_ref[...].astype(jnp.float32)  # [M, TK]
+    # blockwise absmax for even/odd rows: interleaved rows 2i, 2i+1 share
+    # block (2i)//NF4_BLOCK == i // (NF4_BLOCK//2)
+    scales = jnp.repeat(scales_ref[...].astype(jnp.float32), NF4_BLOCK // 2, axis=0)
+    xe = xe_ref[...]  # [M, TK//2] bf16
+    xo = xo_ref[...]
+    if dot_in_f32:  # interpret mode: CPU XLA has no bf16 x bf16 -> f32 dot
+        xe, xo = xe.astype(jnp.float32), xo.astype(jnp.float32)
+    # value rounding matches the XLA fallback (dequantize(w, bf16)) either way
+    dot_dtype = jnp.float32 if dot_in_f32 else xe.dtype
+    d_lo = (decode(lo) * scales).astype(jnp.bfloat16).astype(dot_dtype)
+    d_hi = (decode(hi) * scales).astype(jnp.bfloat16).astype(dot_dtype)
     acc_ref[...] += jax.lax.dot_general(
-        x_tile, w_tile, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        xe, d_lo, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    acc_ref[...] += jax.lax.dot_general(
+        xo, d_hi, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
 
     @pl.when(k == n_k - 1)
     def _store():
         o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+# affine int4 decode table: value = code - 8
+_INT4_TABLE = np.arange(16, dtype=np.float32) - 8.0
+
+
+def _decode_table(kind: str) -> jnp.ndarray:
+    """16-entry decode table padded to one (8, 128) f32 vreg tile."""
+    code = NF4_CODE if kind == "nf4" else _INT4_TABLE
+    table = np.zeros((8, 128), np.float32)
+    table[0, :16] = code
+    return jnp.asarray(table)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -397,7 +441,8 @@ def packed4_matmul_pallas(x: jnp.ndarray, w: QuantizedLinear, *, interpret: bool
     n_out = w.out_features
     if n_stored != n_in:  # stored padding rows are exact zeros; pad x to match
         x = jnp.pad(x, ((0, 0), (0, n_stored - n_in)))
-    n_k, n_n = n_stored // _TK, n_out // _TN
+    tn = _TN if n_out % _TN == 0 else _TN_MIN
+    n_k, n_n = n_stored // _TK, n_out // tn
     # tile the token axis too: a prefill-sized M must not sit whole in VMEM
     tm = min(_TM, _round_up(m, 8))
     m_pad = (-m) % tm
@@ -406,22 +451,30 @@ def packed4_matmul_pallas(x: jnp.ndarray, w: QuantizedLinear, *, interpret: bool
     mp = x.shape[0]
     n_m = mp // tm
 
+    # the MXU path is bf16 inputs + f32 accumulate (same as the XLA fallback);
+    # split even/odd input rows here, where XLA lowers the stride-2 slice well
+    xb = x.astype(jnp.bfloat16)
+    xe, xo = xb[:, 0::2], xb[:, 1::2]
+    hk = _TK // 2
+
     out = pl.pallas_call(
-        functools.partial(_packed4_kernel, n_k=n_k, affine=w.kind == "int4"),
+        functools.partial(_packed4_kernel, n_k=n_k, dot_in_f32=interpret),
         grid=(n_m, n_n, n_k),
         in_specs=[
-            pl.BlockSpec((tm, _TK), lambda mi, n, k: (mi, k)),
-            pl.BlockSpec((_TK // 2, _TN), lambda mi, n, k: (k, n)),
-            pl.BlockSpec((_TK // NF4_BLOCK, _TN), lambda mi, n, k: (k, n)),
+            pl.BlockSpec((tm, hk), lambda mi, n, k: (mi, k)),
+            pl.BlockSpec((tm, hk), lambda mi, n, k: (mi, k)),
+            pl.BlockSpec((hk, tn), lambda mi, n, k: (k, n)),
+            pl.BlockSpec((_TK // NF4_BLOCK, tn), lambda mi, n, k: (k, n)),
+            pl.BlockSpec((8, 128), lambda mi, n, k: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((tm, _TN), lambda mi, n, k: (mi, n)),
+        out_specs=pl.BlockSpec((tm, tn), lambda mi, n, k: (mi, n)),
         out_shape=jax.ShapeDtypeStruct((mp, n_out), x.dtype),
-        scratch_shapes=[pltpu.VMEM((tm, _TN), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(x, w.data, w.scales)
+    )(xe, xo, w.data, w.scales, _decode_table(w.kind))
     return out[:m] if m_pad else out
 
 

@@ -109,9 +109,7 @@ class TransformerBackend:
             if has_nf4:
                 # pick the faster decode path ON THIS DEVICE before the first
                 # trace bakes one in (quant.py maybe_autotune_nf4_decode)
-                maybe_autotune_nf4_decode(
-                    cfg.hidden_size, getattr(cfg, "intermediate_size", cfg.hidden_size)
-                )
+                maybe_autotune_nf4_decode(cfg.hidden_size)
         # adapter name -> (stacked {leaf: (A, B)}, scaling); see utils/peft.py
         self.adapters: Dict[str, tuple] = {}
 

@@ -184,4 +184,4 @@ def test_nf4_autotune_noop_off_tpu():
     from petals_tpu.ops import quant
 
     # on CPU the autotune must not run (keeps the default) and must not crash
-    assert quant.maybe_autotune_nf4_decode(128, 128) == quant._NF4_DECODE_USE_PALLAS
+    assert quant.maybe_autotune_nf4_decode(128) == quant._NF4_DECODE_USE_PALLAS
