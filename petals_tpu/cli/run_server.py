@@ -71,6 +71,26 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--trace_dir", default=None,
                         help="Capture a bounded jax device trace here at startup "
                              "(or set PETALS_TPU_TRACE_DIR)")
+    parser.add_argument("--drain_seconds", type=float, default=0.0,
+                        help="On SIGTERM/SIGINT, park live sessions' KV and keep serving "
+                             "ptu.session_export for this long before exiting, so clients "
+                             "migrate caches to replacements instead of recomputing prefills")
+    parser.add_argument("--inference_max_length", type=int, default=None,
+                        help="Reject sessions longer than this (default: 8192 for GQA/MQA "
+                             "models, 2048 otherwise — reference server.py:194-198)")
+    parser.add_argument("--request_timeout", type=float, default=3 * 60,
+                        help="Timeout for forward/backward requests, seconds")
+    parser.add_argument("--session_timeout", type=float, default=30 * 60,
+                        help="Max lifetime of an idle inference session, seconds")
+    parser.add_argument("--step_timeout", type=float, default=5 * 60,
+                        help="Timeout for one inference step, seconds")
+    parser.add_argument("--balance_quality", type=float, default=0.75,
+                        help="Rebalance only when swarm quality falls below this fraction "
+                             "of the post-move optimum (reference --balance_quality)")
+    parser.add_argument("--revision", default="main",
+                        help="Hub revision (branch/tag/commit) for weight streaming")
+    parser.add_argument("--cache_dir", default=None,
+                        help="Hub download cache directory (default: PETALS_TPU_CACHE)")
     return parser
 
 
@@ -144,6 +164,13 @@ def main(argv=None) -> None:
         compression=args.compression,
         relay_via=args.relay_via,
         network_mbps=args.network_mbps,
+        inference_max_length=args.inference_max_length,
+        request_timeout=args.request_timeout,
+        session_timeout=args.session_timeout,
+        step_timeout=args.step_timeout,
+        balance_quality=args.balance_quality,
+        revision=args.revision,
+        cache_dir=args.cache_dir,
     )
 
     async def run():
@@ -154,6 +181,14 @@ def main(argv=None) -> None:
         for sig in (signal.SIGINT, signal.SIGTERM):
             loop.add_signal_handler(sig, stop.set)
         await stop.wait()
+        if args.drain_seconds > 0:
+            parked = await server.drain(park_ttl=args.drain_seconds + 30)
+            if parked:
+                logger.info(
+                    f"Drain window: serving KV exports for {parked} session(s) "
+                    f"for {args.drain_seconds:.0f}s"
+                )
+                await asyncio.sleep(args.drain_seconds)
         logger.info("Shutting down")
         await server.shutdown()
 

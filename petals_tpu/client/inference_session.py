@@ -91,6 +91,19 @@ class _ServerInferenceSession:
         self.compression = compression
         return self
 
+    async def import_kv(self, k: np.ndarray, v: np.ndarray, position: int) -> None:
+        """Seed this (fresh) session's server-side KV from an exported cache —
+        must run before any step; the server validates shapes and position."""
+        assert self.position == 0 and not self.history, "import_kv only on a fresh session"
+        await self.stream.send({
+            "kv_import": {"position": int(position)},
+            "tensors": {"k": serialize_array(k), "v": serialize_array(v)},
+        })
+        reply = await self.stream.recv(timeout=self.step_timeout)
+        if not reply.get("kv_import") or reply.get("position") != position:
+            raise RuntimeError(f"kv_import rejected: {reply}")
+        self.position = position
+
     async def step(
         self,
         hidden: np.ndarray,
@@ -262,12 +275,17 @@ class InferenceSession:
                 return i
         return None
 
-    async def _enter_server_sessions(self, chain: List[RemoteSpanInfo]) -> List[_ServerInferenceSession]:
+    async def _enter_server_sessions(
+        self, chain: List[RemoteSpanInfo], wire_push: bool = True
+    ) -> List[_ServerInferenceSession]:
         """Open one session per span; with use_server_to_server, each server is
         told where to push its outputs (the next span's session) so downstream
         compute starts before the client relays — reference
-        _collect_next_servers, inference_session.py:174-182."""
-        use_push = self.seq_manager.config.use_server_to_server and len(chain) > 1
+        _collect_next_servers, inference_session.py:174-182. Repair passes
+        ``wire_push=False`` so history replay / KV import into the fresh
+        sessions never leaks pushed steps into the surviving downstream chain
+        (pushes are wired afterwards via ``pending_push_to``)."""
+        use_push = wire_push and self.seq_manager.config.use_server_to_server and len(chain) > 1
         session_ids = [uuid.uuid4().hex for _ in chain]
         sessions = []
         try:
@@ -295,71 +313,168 @@ class InferenceSession:
             raise
 
     async def _repair_chain(self, failed_block: int) -> int:
-        """Rebuild the chain suffix from the failed span's START, replaying
-        recorded history into the fresh servers (reference _update_sequence).
-        Returns the block index from which the caller must resume."""
-        # resume point: start of the span that covered failed_block (its inputs
-        # are recorded in that session's history)
-        resume = 0
-        replay_steps: Optional[List[tuple]] = None
-        keep: List[_ServerInferenceSession] = []
-        drop: List[_ServerInferenceSession] = []
+        """Repair ONLY the failed span's range [resume, dead_end), keeping the
+        healthy downstream sessions — and their KV caches — alive (reference
+        _update_sequence repairs the same narrow range, inference_session.py
+        :364-391). The replacement is seeded by KV migration when the failed
+        server is still reachable (a draining/rebalancing peer serving
+        ``ptu.session_export`` — beyond reference), falling back to replaying
+        the recorded input history. Returns the block index to resume from."""
+        dead: Optional[_ServerInferenceSession] = None
         for session in self._sessions:
             if session.span.start <= failed_block < session.span.end:
-                resume = session.span.start
-        for session in self._sessions:
-            if session.span.end <= resume and not session.closed:
-                keep.append(session)
-            else:
-                if session.span.start == resume and replay_steps is None:
-                    replay_steps = session.history_steps()
-                drop.append(session)
+                dead = session
+        if dead is not None:
+            resume, dead_end = dead.span.start, dead.span.end
+            replay_steps = dead.history_steps()
+        else:  # inconsistent chain (shouldn't happen): rebuild the whole suffix
+            resume, dead_end = failed_block, self.num_blocks
+            replay_steps = []
+
+        keep_up = [s for s in self._sessions if s.span.end <= resume and not s.closed]
+        keep_down = [
+            s for s in self._sessions if s.span.start >= dead_end and not s.closed and s is not dead
+        ]
+        drop = [s for s in self._sessions if s not in keep_up and s not in keep_down]
+
+        # try to export the hole's KV from the dying server BEFORE closing
+        # anything (a drained server serves exports after its streams died)
+        exported = None
+        if dead is not None and dead.session_id and self._position > 0:
+            exported = await self._try_export(
+                dead.span.peer_id, dead.session_id, resume, dead_end
+            )
+
         for session in drop:
             await session.close()
 
         await self.seq_manager.update()
         new_chain = await self.seq_manager.make_sequence(
-            resume, self.num_blocks, mode="min_latency",
+            resume, dead_end, mode="min_latency",
             cache_tokens_needed=self.batch_size * self.max_length,
         )
-        new_sessions = await self._enter_server_sessions(new_chain)
-        self._sessions = keep + new_sessions
+        new_sessions = await self._enter_server_sessions(new_chain, wire_push=False)
+        self._sessions = sorted(
+            keep_up + new_sessions + keep_down, key=lambda s: s.span.start
+        )
 
-        # the last surviving upstream server still pushes to a dead session;
-        # retarget it (or disable) on its next step
-        if keep:
-            new_target = None
-            if (
-                self.seq_manager.config.use_server_to_server
-                and new_sessions
-                and getattr(new_sessions[0], "session_id", None)
-            ):
-                addr = self.seq_manager.addr_of(new_sessions[0].span.peer_id)
-                if addr is not None:
-                    new_target = {
-                        "addr": addr.to_string(),
-                        "session_id": new_sessions[0].session_id,
-                    }
-            keep[-1].pending_push_to = new_target if new_target is not None else False
-
-        if replay_steps:
-            # re-prefill the whole new suffix, repeating each recorded step —
-            # including its beam-lane reorder (hypo_ids) — in original order
-            # (step ids keep push/relay copies deduplicated downstream)
+        # Seed the replacement: KV import (single-span holes only — a split
+        # hole would leave later spans without input history for future
+        # failovers), else history replay.
+        seeded = False
+        if exported is not None and len(new_sessions) == 1:
+            try:
+                seeded = await self._seed_by_import(new_sessions[0], exported, replay_steps)
+            except Exception as e:
+                logger.warning(f"KV import failed, replaying history instead: {e}")
+                # the session's stream state is unknown after a failed import
+                await new_sessions[0].close()
+                new_sessions = await self._enter_server_sessions(new_chain, wire_push=False)
+                self._sessions = sorted(
+                    keep_up + new_sessions + keep_down, key=lambda s: s.span.start
+                )
+        if not seeded and replay_steps:
+            # re-prefill the hole, repeating each recorded step — including its
+            # beam-lane reorder (hypo_ids) — in original order
             for hidden_step, hypo_step in replay_steps:
                 chunk = hidden_step
                 step_id = uuid.uuid4().hex
                 for session in new_sessions:
-                    span = session.span
-                    server_prompts = (
-                        self._last_prompts[span.start : span.end]
-                        if self._last_prompts is not None
-                        else None
-                    )
-                    chunk = await session.step(
-                        chunk, prompts=server_prompts, hypo_ids=hypo_step, step_id=step_id
-                    )
+                    chunk = await self._replay_step(session, chunk, hypo_step, step_id)
+
+        self._wire_repair_pushes(keep_up, new_sessions, keep_down, dead_end)
         return resume
+
+    async def _replay_step(self, session, chunk, hypo_step, step_id):
+        span = session.span
+        server_prompts = (
+            self._last_prompts[span.start : span.end] if self._last_prompts is not None else None
+        )
+        return await session.step(
+            chunk, prompts=server_prompts, hypo_ids=hypo_step, step_id=step_id
+        )
+
+    async def _try_export(self, peer_id, session_id: str, start: int, end: int):
+        """Fetch the failed span's KV from its (possibly draining) server;
+        None when unreachable/refused — the caller falls back to replay."""
+        # Ride the session's negotiated wire codec, except qint8: blockwise
+        # quantization of KV would degrade every subsequent token, while the
+        # replay fallback is exact — bfloat16 is lossless for bf16 caches and
+        # half the bytes of an f32 one. Long-context caches are 100s of MB, so
+        # the timeout is generous; a failed export just means a full replay.
+        comp = self.seq_manager.config.compression
+        if comp == CompressionType.QINT8.value:
+            comp = CompressionType.BFLOAT16.value
+        try:
+            stub = await asyncio.wait_for(self.seq_manager.get_stub(peer_id), timeout=5)
+            reply = await asyncio.wait_for(
+                stub.call(
+                    "ptu.session_export",
+                    {
+                        "session_id": session_id, "start": start, "end": end,
+                        "compression": comp,
+                    },
+                ),
+                timeout=120,
+            )
+            if int(reply.get("batch_size", -1)) != self.batch_size:
+                return None
+            k = deserialize_array(reply["tensors"]["k"])
+            v = deserialize_array(reply["tensors"]["v"])
+            return k, v, int(reply["position"])
+        except Exception as e:
+            logger.info(f"KV export unavailable from {peer_id.to_string()[:8]}: {e}")
+            return None
+
+    async def _seed_by_import(self, session, exported, replay_steps) -> bool:
+        """Import exported KV up to a history step boundary, then replay any
+        remaining recorded steps (a parked export can be a little stale)."""
+        k, v, export_pos = exported
+        cap = min(export_pos, self._position)
+        # largest prefix of history steps whose total length fits the cap:
+        # imports must cut at step boundaries so each step's hypo_ids reorder
+        # stays atomic
+        cut = 0
+        n_prefix = 0
+        for hidden_step, _ in replay_steps:
+            take = hidden_step.shape[1]
+            if cut + take > cap:
+                break
+            cut += take
+            n_prefix += 1
+        if cut <= 0:
+            return False
+        await session.import_kv(k[:, :, :cut], v[:, :, :cut], cut)
+        session.history = [tuple(step) for step in replay_steps[:n_prefix]]
+        chunk = None
+        for hidden_step, hypo_step in replay_steps[n_prefix:]:
+            chunk = await self._replay_step(session, hidden_step, hypo_step, uuid.uuid4().hex)
+        logger.info(
+            f"Migrated {cut} cached tokens into {session.span.peer_id.to_string()[:8]} "
+            f"(+{len(replay_steps) - n_prefix} replayed steps)"
+        )
+        return True
+
+    def _wire_repair_pushes(self, keep_up, new_sessions, keep_down, dead_end: int) -> None:
+        """Re-link the server->server push chain around the repaired hole (the
+        surviving upstream server still pushes to a dead session id)."""
+        if not self.seq_manager.config.use_server_to_server:
+            return
+
+        def target_for(session) -> Optional[dict]:
+            if session is None or not session.session_id:
+                return None
+            addr = self.seq_manager.addr_of(session.span.peer_id)
+            if addr is None:
+                return None
+            return {"addr": addr.to_string(), "session_id": session.session_id}
+
+        downstream = keep_down[0] if keep_down and keep_down[0].span.start == dead_end else None
+        chain = list(new_sessions) + ([downstream] if downstream else [None])
+        for i, session in enumerate(new_sessions):
+            session.pending_push_to = target_for(chain[i + 1]) or False
+        if keep_up:
+            keep_up[-1].pending_push_to = target_for(new_sessions[0] if new_sessions else None) or False
 
     async def close(self) -> None:
         if not self._closed:

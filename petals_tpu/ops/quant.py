@@ -249,12 +249,19 @@ def maybe_autotune_nf4_decode(in_features: int = 4096, *, steps: int = 20) -> bo
         # axon tunnel, ~ms) and the device->host sync cost cancel out.
         # jax.block_until_ready is NOT a real sync under some tunnel builds,
         # so completion is forced by fetching one output element.
+        # Each link perturbs `scales` by a distinct factor: otherwise the XLA
+        # arm's loop-invariant dequantize(data, scales) is hoisted out of the
+        # unrolled chain by CSE, and its slope would exclude the per-call
+        # dequantize cost it pays in production (the scales multiply itself is
+        # one pass over a tiny [in/64, out] array — negligible in both arms).
         def chain(k):
             @jax.jit
             def f(v, data, scales):
                 a = v
-                for _ in range(k):
-                    a = mm(a, data, scales) * 1e-2
+                for j in range(k):
+                    # 1/128 = bf16 eps at 1.0: the factor must survive the
+                    # scales dtype or it folds to *1.0 and hoisting returns
+                    a = mm(a, data, scales * (1.0 + j / 128.0)) * 1e-2
                 return a
             return f
 

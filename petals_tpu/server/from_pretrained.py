@@ -32,6 +32,7 @@ def resolve_model_path(
     prefixes: Optional[tuple] = None,
     cache_dir=None,
     max_disk_space: Optional[int] = None,
+    revision: str = "main",
 ) -> str:
     """Local directory, or a repo id resolved through the streaming Hub cache.
 
@@ -46,12 +47,13 @@ def resolve_model_path(
         return str(
             hub.ensure_weight_files(
                 model_name_or_path, prefixes,
-                cache_dir=cache_dir, max_disk_space=max_disk_space,
+                cache_dir=cache_dir, max_disk_space=max_disk_space, revision=revision,
             )
         )
     return str(
         hub.ensure_config(
-            model_name_or_path, cache_dir=cache_dir, max_disk_space=max_disk_space
+            model_name_or_path, cache_dir=cache_dir, max_disk_space=max_disk_space,
+            revision=revision,
         )
     )
 
@@ -149,6 +151,8 @@ def load_block_params(
     device: Optional[jax.Device] = None,
     family: Optional[ModelFamily] = None,
     cfg=None,
+    revision: str = "main",
+    cache_dir=None,
 ) -> dict:
     """Load block ``block_index`` and return our parameter pytree on device."""
     if family is None or cfg is None:
@@ -156,7 +160,9 @@ def load_block_params(
 
     prefixes = tuple(tpl.format(i=block_index) for tpl in family.hf_block_prefixes)
     # for repo ids this streams in exactly the shards holding this block
-    path = resolve_model_path(model_name_or_path, prefixes=prefixes)
+    path = resolve_model_path(
+        model_name_or_path, prefixes=prefixes, revision=revision, cache_dir=cache_dir
+    )
     tensors = _load_tensors_with_prefixes(path, prefixes)
     if not tensors:
         raise KeyError(

@@ -12,6 +12,8 @@ Wire payloads (msgpack):
 - inference open:  {uids, max_length, batch_size, active_adapter?, session_id?}
 - inference step:  {tensors: {hidden, prompts?, hypo_ids?}, start_from_position?, step_id?}
 - inference reply: {tensors: {hidden}, position}
+- kv import step:  {kv_import: {position}, tensors: {k, v}} (first step only)
+- session export:  {session_id, start, end, compression?} -> {position, tensors: {k, v}, ...}
 - forward:         {uids, tensors: {hidden, prompts?}, active_adapter?}
 - backward:        {uids, tensors: {hidden, grad_out, prompts?}, active_adapter?}
 - info:            {} -> ServerInfo dict + cache stats
@@ -55,6 +57,7 @@ class TransformerHandler:
         step_timeout: float = 5 * 60,
         compression: CompressionType = CompressionType.NONE,
         identity=None,  # authenticates the server->server push plane
+        inference_max_length: Optional[int] = None,  # cap on per-session max_length
     ):
         self.backend = backend
         self.dht_prefix = dht_prefix
@@ -64,12 +67,21 @@ class TransformerHandler:
         self.session_timeout = session_timeout
         self.step_timeout = step_timeout
         self.compression = compression
+        self.inference_max_length = inference_max_length
         self.queue = PriorityTaskQueue()
         self.queue.start()
         self._sub_backends: Dict[Tuple[int, int], TransformerBackend] = {}
         # server-to-server activation push (reference handler.py:310-350):
         # session_id -> queue of pushed step payloads
         self._push_queues: Dict[str, asyncio.Queue] = {}
+        # KV migration (beyond reference): live-session registry for
+        # ptu.session_export, and host-RAM parking of session KV so a
+        # draining server can hand caches to replacements instead of making
+        # clients recompute the prefill (client/inference_session.py repair).
+        self._session_registry: Dict[str, dict] = {}
+        self._parked: Dict[str, dict] = {}
+        self.park_ttl = 60.0
+        self.draining = False
         from petals_tpu.rpc.pool import ConnectionPool
 
         self._push_pool = ConnectionPool(identity=identity)
@@ -80,6 +92,7 @@ class TransformerHandler:
         server.add_unary_handler("ptu.backward", self.rpc_backward)
         server.add_unary_handler("ptu.info", self.rpc_info)
         server.add_unary_handler("ptu.push", self.rpc_push)
+        server.add_unary_handler("ptu.session_export", self.rpc_session_export)
         server.add_stream_handler("ptu.inference", self.rpc_inference)
 
     async def rpc_push(self, payload, ctx: RpcContext):
@@ -96,6 +109,132 @@ class TransformerHandler:
             # beats buffering an unbounded backlog from a runaway upstream peer.
             raise RuntimeError(f"Push queue full for session {session_id!r}")
         return {"ok": True}
+
+    async def rpc_session_export(self, payload, ctx: RpcContext):
+        """Hand a session's KV cache (sliced to its position) to the caller so a
+        replacement server can be seeded without recomputing the prefill.
+        Serves live sessions and sessions parked by a draining server."""
+        session_id = payload.get("session_id")
+        want_start = int(payload["start"])
+        want_end = int(payload["end"])
+        comp = CompressionType(payload.get("compression", "none"))
+        self._prune_parked()
+
+        # live first: a parked snapshot goes stale if steps kept flowing
+        # between drain and shutdown
+        live = self._session_registry.get(session_id)
+        if live is not None:
+            src = await self._snapshot_session(live)
+        else:
+            src = self._parked.get(session_id)
+            if src is None:
+                raise KeyError(f"No live or parked session {session_id!r}")
+        position = src["position"]
+        if position <= 0:
+            raise ValueError(f"Session {session_id!r} has no cached tokens yet")
+        if not (src["start"] <= want_start < want_end <= src["end"]):
+            raise ValueError(
+                f"Requested blocks [{want_start}, {want_end}) outside session span "
+                f"[{src['start']}, {src['end']})"
+            )
+        b0, b1 = want_start - src["start"], want_end - src["start"]
+        return {
+            "position": position,
+            "start": want_start,
+            "end": want_end,
+            "batch_size": src["batch_size"],
+            "tensors": {
+                "k": serialize_array(src["k"][b0:b1], comp),
+                "v": serialize_array(src["v"][b0:b1], comp),
+            },
+        }
+
+    def _install_kv_import(
+        self, step, kv, handles, position, *, batch_size: int, n_blocks: int, max_length: int
+    ) -> int:
+        """Seed this session's KV buffers from another server's exported cache
+        (must arrive before any compute so the caches never mix histories)."""
+        import jax
+
+        if position != 0:
+            raise ValueError("kv_import must be the first step of a session")
+        new_position = int(step["kv_import"]["position"])
+        if not 0 < new_position <= max_length:
+            raise ValueError(f"kv_import position {new_position} outside (0, {max_length}]")
+        tensors = step.get("tensors") or {}
+        if "k" not in tensors or "v" not in tensors:
+            raise ValueError("kv_import needs k and v tensors")
+        k = deserialize_array(tensors["k"])
+        v = deserialize_array(tensors["v"])
+        k_buf, v_buf = kv
+        want_shape = (n_blocks, batch_size, new_position, *k_buf.shape[3:])
+        for name, arr in (("k", k), ("v", v)):
+            if tuple(arr.shape) != want_shape:
+                raise ValueError(f"kv_import {name} shape {arr.shape} != {want_shape}")
+        for handle, buf, arr in ((handles[0], k_buf, k), (handles[1], v_buf, v)):
+            full = np.zeros(buf.shape, jax.numpy.dtype(buf.dtype))
+            full[:, :, :new_position] = arr.astype(full.dtype)
+            new_buf = (
+                jax.device_put(full, buf.sharding)
+                if getattr(buf, "sharding", None) is not None
+                else jax.numpy.asarray(full)
+            )
+            self.memory_cache.update_cache(handle, new_buf)
+        return new_position
+
+    async def _snapshot_session(self, reg: dict) -> dict:
+        """Host copy of a live session's KV, sliced to its position. The step
+        loop donates buffers into XLA, so a fetch can race a step in flight
+        (the grabbed buffer gets invalidated) — retry on the fresh buffer.
+        The device->host copy is 100s of MB for long contexts, so it runs off
+        the event loop: other sessions' steps must not stall behind it."""
+        for attempt in range(20):
+            position = reg["position"]
+            try:
+                k_buf, v_buf = self.memory_cache.get_buffers(*reg["handles"])
+                k, v = await asyncio.to_thread(
+                    lambda: (
+                        np.asarray(k_buf[:, :, :position]),
+                        np.asarray(v_buf[:, :, :position]),
+                    )
+                )
+                break
+            except Exception:
+                if attempt == 19:
+                    raise
+                await asyncio.sleep(0.05)
+        return {
+            "k": k, "v": v, "position": position,
+            "start": reg["start"], "end": reg["end"],
+            "batch_size": reg["batch_size"], "max_length": reg["max_length"],
+        }
+
+    async def park_sessions(self, ttl: Optional[float] = None) -> int:
+        """Snapshot every live session's KV into host RAM (drain path: streams
+        are about to die with the server, but exports must keep working)."""
+        import time
+
+        ttl = self.park_ttl if ttl is None else ttl
+        parked = 0
+        for session_id, reg in list(self._session_registry.items()):
+            if reg["position"] <= 0:
+                continue
+            try:
+                snap = await self._snapshot_session(reg)
+            except Exception as e:
+                logger.warning(f"Could not park session {session_id!r}: {e}")
+                continue
+            snap["expires"] = time.monotonic() + ttl
+            self._parked[session_id] = snap
+            parked += 1
+        return parked
+
+    def _prune_parked(self) -> None:
+        import time
+
+        now = time.monotonic()
+        for sid in [s for s, p in self._parked.items() if p.get("expires", 0) < now]:
+            del self._parked[sid]
 
     def shutdown(self) -> None:
         self.queue.shutdown()
@@ -267,8 +406,15 @@ class TransformerHandler:
         """Bidirectional inference stream: open -> step* (reference
         handler.py:132-195 + block_functions.iterate_rpc_inference)."""
         open_msg = await asyncio.wait_for(anext(requests), self.step_timeout)
+        if self.draining:
+            raise RuntimeError("Server is draining: not accepting new sessions")
         start, end = self._parse_chain(open_msg["uids"])
         max_length = int(open_msg["max_length"])
+        if self.inference_max_length is not None and max_length > self.inference_max_length:
+            raise ValueError(
+                f"max_length {max_length} exceeds this server's inference_max_length "
+                f"{self.inference_max_length}"
+            )
         batch_size = int(open_msg.get("batch_size", 1))
         reply_comp = self._reply_compression(open_msg)  # for every step reply
         active_adapter = open_msg.get("active_adapter")
@@ -286,10 +432,18 @@ class TransformerHandler:
             k_buf, v_buf = self.memory_cache.get_buffers(*handles)
             kv = (k_buf, v_buf)
             position = 0
+            reg = None
             if session_id:
                 # registered only once allocation succeeded (no leak on failure)
                 push_queue = asyncio.Queue(maxsize=64)
                 self._push_queues[session_id] = push_queue
+                reg = {
+                    "handles": handles, "position": 0,
+                    "start": self.backend.first_block + start,
+                    "end": self.backend.first_block + end,
+                    "batch_size": batch_size, "max_length": max_length,
+                }
+                self._session_registry[session_id] = reg
             yield {"session_open": True, "position": 0, "max_length": max_length}
 
             next_step, cleanup_steps = self._step_source(
@@ -301,6 +455,12 @@ class TransformerHandler:
                 step = await next_step()
                 if step is None:
                     break
+                if self.draining:
+                    # fail fast so the client repairs its chain NOW, while the
+                    # parked KV export is still being served (drain window)
+                    raise RuntimeError(
+                        "Server is draining: migrate this session via ptu.session_export"
+                    )
                 if "push_to" in step:  # chain repair moved our downstream peer
                     push_to = step["push_to"] or None
                 step_id = step.get("step_id")
@@ -316,6 +476,19 @@ class TransformerHandler:
                             f"start_from_position {start_from} is ahead of cache ({position})"
                         )
                     position = int(start_from)  # rollback (speculative decoding)
+                    if reg is not None:
+                        reg["position"] = position
+
+                if "kv_import" in step:
+                    position = self._install_kv_import(
+                        step, kv, handles, position,
+                        batch_size=batch_size, n_blocks=end - start, max_length=max_length,
+                    )
+                    kv = tuple(self.memory_cache.get_buffers(*handles))
+                    if reg is not None:
+                        reg["position"] = position
+                    yield {"position": position, "kv_import": True}
+                    continue
 
                 hidden = self._get_tensor(step, "hidden")
                 prompts = self._get_tensor(step, "prompts")
@@ -356,6 +529,8 @@ class TransformerHandler:
                 self.memory_cache.update_cache(handles[0], kv[0])
                 self.memory_cache.update_cache(handles[1], kv[1])
                 position += seq
+                if reg is not None:
+                    reg["position"] = position
                 wire_out = serialize_array(out, reply_comp)
                 if push_to is not None and prompts is None:
                     # can_push = no deep prompts (reference block_functions.py:233).
@@ -373,6 +548,7 @@ class TransformerHandler:
                 await cleanup_steps()
                 if session_id:
                     self._push_queues.pop(session_id, None)
+                    self._session_registry.pop(session_id, None)
 
     @staticmethod
     def _step_source(requests, push_queue, timeout):

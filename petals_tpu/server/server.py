@@ -72,8 +72,17 @@ class Server:
         compression: str = "none",  # default reply codec (clients may override per request)
         relay_via: Optional[str] = None,  # "host:port" of a relay peer: serve from behind NAT
         network_mbps: Optional[float] = None,  # known WAN budget; None = probe swarm peers
+        inference_max_length: Optional[int] = None,  # None: 8192 for GQA/MQA, 2048 otherwise
+        request_timeout: float = 3 * 60,
+        session_timeout: float = 30 * 60,
+        step_timeout: float = 5 * 60,
+        balance_quality: float = 0.75,  # rebalance iff swarm quality < this (block_selection.py)
+        revision: str = "main",  # Hub revision for weight streaming (utils/hub.py)
+        cache_dir=None,  # Hub download cache (default PETALS_TPU_CACHE)
     ):
         self.model_path = model_path
+        self.revision = revision
+        self.cache_dir = cache_dir
         self.family, self.cfg = get_block_config(model_path)
         total = self.cfg.num_hidden_layers
         self.auto_placement = first_block is None
@@ -126,6 +135,17 @@ class Server:
         from petals_tpu.rpc.serialization import CompressionType
 
         self.compression = CompressionType(compression)
+        if inference_max_length is None:
+            # reference server.py:194-198: longer contexts for MQA/GQA models
+            # (their KV is cheap), conservative cap otherwise
+            heads = getattr(self.cfg, "num_attention_heads", 1)
+            kv_heads = getattr(self.cfg, "num_key_value_heads", heads) or heads
+            inference_max_length = 8192 if kv_heads < heads else 2048
+        self.inference_max_length = inference_max_length
+        self.request_timeout = request_timeout
+        self.session_timeout = session_timeout
+        self.step_timeout = step_timeout
+        self.balance_quality = balance_quality
         self.module_uids = [
             make_uid(self.dht_prefix, i)
             for i in range(self.first_block, self.first_block + self.num_blocks)
@@ -302,6 +322,10 @@ class Server:
             server_info_fn=lambda: dataclasses.asdict(self._server_info(ServerState.ONLINE)),
             identity=identity,
             compression=self.compression,
+            inference_max_length=self.inference_max_length,
+            request_timeout=self.request_timeout,
+            session_timeout=self.session_timeout,
+            step_timeout=self.step_timeout,
         )
         self.handler.register(self.rpc_server)
 
@@ -346,6 +370,25 @@ class Server:
 
     async def wait_ready(self) -> None:
         await self._ready.wait()
+
+    async def drain(self, park_ttl: float = 60.0) -> int:
+        """Graceful-shutdown prelude: stop accepting sessions, announce OFFLINE,
+        and park every live session's KV in host RAM so clients can migrate
+        their caches to replacement servers (``ptu.session_export``) instead of
+        recomputing prefills. The RPC server stays up — call :meth:`shutdown`
+        after the drain window. Returns the number of parked sessions."""
+        parked = 0
+        if self.handler is not None:
+            self.handler.draining = True
+            parked = await self.handler.park_sessions(ttl=park_ttl)
+        self._state = ServerState.OFFLINE
+        try:
+            await self._announce(ServerState.OFFLINE, expiration=dht_time() + 60)
+        except Exception:
+            pass
+        if parked:
+            logger.info(f"Draining: parked {parked} session(s) for migration")
+        return parked
 
     async def shutdown(self) -> None:
         if self._balancer_task is not None:
@@ -427,7 +470,8 @@ class Server:
         per_block = [
             convert_block_params(
                 load_block_params(
-                    self.model_path, i, dtype=self.compute_dtype, family=self.family, cfg=self.cfg
+                    self.model_path, i, dtype=self.compute_dtype, family=self.family,
+                    cfg=self.cfg, revision=self.revision, cache_dir=self.cache_dir,
                 ),
                 self.family.name,
                 self.quant_type,
@@ -502,7 +546,10 @@ class Server:
             try:
                 all_uids = [_mk(self.dht_prefix, i) for i in range(self.cfg.num_hidden_layers)]
                 infos, _ = await get_remote_module_infos(self.dht, all_uids)
-                if should_choose_other_blocks(self.dht.peer_id, infos, self.num_blocks):
+                if should_choose_other_blocks(
+                    self.dht.peer_id, infos, self.num_blocks,
+                    balance_quality=self.balance_quality,
+                ):
                     from petals_tpu.server.block_selection import compute_throughputs
 
                     throughputs = compute_throughputs(infos, exclude_peer=self.dht.peer_id)

@@ -1,0 +1,222 @@
+"""Session KV migration (beyond reference) + narrow chain repair.
+
+- Chain repair rebuilds ONLY the failed span's range: healthy downstream
+  sessions — and their server-side KV — survive untouched (the reference's
+  _update_sequence repairs the same narrow range).
+- A draining server (petals_tpu.server.Server.drain) parks its sessions' KV
+  and serves ``ptu.session_export``; clients seed the replacement server by
+  importing that cache instead of recomputing the prefill.
+"""
+
+import numpy as np
+import pytest
+
+from petals_tpu.client.inference_session import InferenceSession
+from petals_tpu.client.model import AutoDistributedModelForCausalLM
+from tests.test_full_model import SwarmHarness, _hf_greedy
+from tests.utils import make_tiny_llama
+
+
+@pytest.fixture()
+def split_swarm(tmp_path_factory):
+    """Front span [0,2) twice (fast + understudy), back span [2,4) once: a
+    front-server death must leave the back session untouched."""
+    path = make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+    harness = SwarmHarness(
+        path,
+        [
+            dict(first_block=0, num_blocks=2, throughput=1000.0),  # preferred front
+            dict(first_block=0, num_blocks=2, throughput=1.0),  # understudy front
+            dict(first_block=2, num_blocks=2, throughput=1000.0),  # the only back
+        ],
+    ).start()
+    yield path, harness
+    harness.stop()
+
+
+def test_repair_keeps_downstream_sessions(split_swarm):
+    """Killing the front server must not recreate (or replay into) the
+    downstream [2,4) session — its KV survives in place."""
+    path, harness = split_swarm
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers, min_backoff=0.1
+    )
+    try:
+        rng = np.random.RandomState(0)
+        input_ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+        expected = _hf_greedy(path, input_ids, 6)
+
+        with model.remote.inference_session(max_length=16, batch_size=1) as session:
+            first = model.generate(input_ids, max_new_tokens=3, session=session)
+            np.testing.assert_array_equal(first, expected[:, : input_ids.shape[1] + 3])
+
+            sessions = session._session._sessions
+            front = next(s for s in sessions if s.span.start == 0)
+            back = next(s for s in sessions if s.span.start == 2)
+            assert front.span.peer_id == harness.servers[0].dht.peer_id
+
+            harness.run(harness.servers[0].shutdown())
+
+            final = model.generate(first, max_new_tokens=3, session=session)
+            np.testing.assert_array_equal(final, expected)
+
+            # the downstream session OBJECT survived the repair untouched
+            sessions_after = session._session._sessions
+            back_after = next(s for s in sessions_after if s.span.start == 2)
+            assert back_after is back and not back_after.closed
+            front_after = next(s for s in sessions_after if s.span.start == 0)
+            assert front_after.span.peer_id == harness.servers[1].dht.peer_id
+    finally:
+        model.close()
+
+
+@pytest.fixture()
+def redundant_swarm(tmp_path_factory):
+    path = make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+    harness = SwarmHarness(
+        path,
+        [
+            dict(first_block=0, num_blocks=4, throughput=1000.0),  # preferred
+            dict(first_block=0, num_blocks=4, throughput=1.0),  # understudy
+        ],
+    ).start()
+    yield path, harness
+    harness.stop()
+
+
+def test_drain_migrates_kv(redundant_swarm, monkeypatch):
+    """A drained server fails further steps but serves its parked KV; the
+    client imports it into the replacement and does NOT replay history."""
+    path, harness = redundant_swarm
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers, min_backoff=0.1
+    )
+    migrations = []
+    real_seed = InferenceSession._seed_by_import
+
+    async def spy_seed(self, session, exported, replay_steps):
+        ok = await real_seed(self, session, exported, replay_steps)
+        migrations.append(ok)
+        return ok
+
+    monkeypatch.setattr(InferenceSession, "_seed_by_import", spy_seed)
+    replays = []
+    real_replay = InferenceSession._replay_step
+
+    async def spy_replay(self, session, chunk, hypo_step, step_id):
+        replays.append(step_id)
+        return await real_replay(self, session, chunk, hypo_step, step_id)
+
+    monkeypatch.setattr(InferenceSession, "_replay_step", spy_replay)
+    try:
+        rng = np.random.RandomState(1)
+        input_ids = rng.randint(0, 100, (1, 6)).astype(np.int64)
+        expected = _hf_greedy(path, input_ids, 6)
+
+        with model.remote.inference_session(max_length=16, batch_size=1) as session:
+            first = model.generate(input_ids, max_new_tokens=3, session=session)
+            np.testing.assert_array_equal(first, expected[:, : input_ids.shape[1] + 3])
+
+            fast = harness.servers[0]
+            assert session._session._sessions[0].span.peer_id == fast.dht.peer_id
+            parked = harness.run(fast.drain())
+            assert parked == 1
+
+            final = model.generate(first, max_new_tokens=3, session=session)
+        np.testing.assert_array_equal(final, expected)
+        assert migrations == [True], "repair must seed the replacement by KV import"
+        assert replays == [], "no history replay when the full cache migrated"
+    finally:
+        model.close()
+        harness.run(harness.servers[0].shutdown())
+        harness.servers.pop(0)  # stop() must not shut the same server twice
+
+
+def test_export_rejects_unknown_and_bad_imports(redundant_swarm):
+    """Protocol hardening: exports of unknown sessions fail cleanly; an import
+    with mismatched shapes is rejected by the server."""
+    import asyncio
+
+    path, harness = redundant_swarm
+    server = harness.servers[0]
+
+    async def check():
+        from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
+        from petals_tpu.rpc.client import RpcClient
+        from petals_tpu.rpc.serialization import serialize_array
+
+        host, port = server.rpc_server.host, server.rpc_server.port
+        client = await RpcClient.connect(host, port)
+        try:
+            with pytest.raises(Exception, match="(?i)no live or parked"):
+                await client.call(
+                    "ptu.session_export", {"session_id": "nope", "start": 0, "end": 4}
+                )
+
+            prefix = server.dht_prefix
+            uids = CHAIN_DELIMITER.join(make_uid(prefix, i) for i in range(4))
+            stream = await client.open_stream("ptu.inference")
+            await stream.send({"uids": uids, "max_length": 8, "batch_size": 1})
+            ack = await stream.recv(timeout=60)
+            assert ack.get("session_open")
+            bad = np.zeros((4, 1, 2, 3, 5), np.float32)  # wrong head dims
+            await stream.send({
+                "kv_import": {"position": 2},
+                "tensors": {"k": serialize_array(bad), "v": serialize_array(bad)},
+            })
+            with pytest.raises(Exception, match="(?i)shape|error"):
+                reply = await stream.recv(timeout=60)
+                if isinstance(reply, dict) and reply.get("error"):
+                    raise RuntimeError(reply["error"])
+            await stream.cancel()
+        finally:
+            await client.close()
+
+    harness.run(check())
+
+
+def test_seed_by_import_stale_export_tops_up_with_replay():
+    """A parked export can lag the client's position: the import must cut at a
+    history STEP boundary (hypo_ids reorders are atomic) and replay the rest."""
+    import asyncio
+
+    class FakeServerSession:
+        def __init__(self):
+            self.history = []
+            self.imported = None
+            self.stepped = []
+
+            class _Span:
+                start, end = 0, 4
+
+                class peer_id:
+                    @staticmethod
+                    def to_string():
+                        return "fakepeer0"
+
+            self.span = _Span()
+
+        async def import_kv(self, k, v, position):
+            self.imported = (k.shape, v.shape, position)
+
+        async def step(self, chunk, prompts=None, hypo_ids=None, step_id=None):
+            self.stepped.append(chunk.shape[1])
+            return chunk
+
+    sess = InferenceSession.__new__(InferenceSession)
+    sess._position = 7  # 5 (prefill) + 1 + 1
+    sess._last_prompts = None
+    replay_steps = [
+        (np.zeros((1, 5, 8), np.float32), None),
+        (np.zeros((1, 1, 8), np.float32), None),
+        (np.zeros((1, 1, 8), np.float32), None),
+    ]
+    k = np.zeros((4, 1, 6, 2, 4), np.float32)  # export stale: 6 of 7 positions
+    v = np.zeros_like(k)
+    target = FakeServerSession()
+    ok = asyncio.run(sess._seed_by_import(target, (k, v, 6), replay_steps))
+    assert ok
+    # cut lands on the 5+1 boundary (<= 6), the last 1-token step is replayed
+    assert target.imported == ((4, 1, 6, 2, 4), (4, 1, 6, 2, 4), 6)
+    assert target.stepped == [1]
+    assert len(target.history) == 2  # seeded prefix; step() stub didn't append
