@@ -224,6 +224,63 @@ def bench_device_decode(cfg, *, quant=None, label="", batches=3, steps=25):
     return result
 
 
+def bench_batched_decode(cfg, batch_sizes=(1, 8, 32), *, steps=20):
+    """Aggregate decode throughput vs batch size on one span: decode is
+    weight-bandwidth-bound, so batching multiplies tok/s almost for free until
+    the MXU starts to matter (the serving-throughput story; the reference's
+    task pools never batch across requests, reference task_pool.py:35-36)."""
+    import jax
+    import jax.numpy as jnp
+
+    from petals_tpu.models.registry import get_family
+    from petals_tpu.server.backend import TransformerBackend
+    from petals_tpu.server.memory_cache import MemoryCache
+
+    n_blocks = cfg.num_hidden_layers
+    dtype = jnp.bfloat16
+    params = random_params(cfg, n_blocks, dtype)
+    backend = TransformerBackend(
+        get_family("llama"), cfg, params,
+        first_block=0, n_blocks=n_blocks,
+        memory_cache=MemoryCache(None), compute_dtype=dtype,
+    )
+    rng = np.random.RandomState(0)
+    rows = []
+    sync = measure_sync_overhead()
+    for batch in batch_sizes:
+        kd, vd = backend.cache_descriptors(batch, MAX_LENGTH, 0, n_blocks)
+        kv = (kd.make_zeros(), vd.make_zeros())
+        prefill = rng.randn(batch, PREFILL_TOKENS, cfg.hidden_size).astype(np.float32) * 0.02
+        step_h = rng.randn(batch, 1, cfg.hidden_size).astype(np.float32) * 0.02
+        _, kv = backend.inference_step(prefill, kv, 0)
+        pos = PREFILL_TOKENS
+        out = None
+        for _ in range(3):
+            out, kv = backend.inference_step(step_h, kv, pos)
+            pos += 1
+        hard_sync(out)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out, kv = backend.inference_step(step_h, kv, pos)
+                pos += 1
+            hard_sync(out)
+            best = min(best, max(time.perf_counter() - t0 - sync, 1e-9) / steps)
+        rows.append({
+            "batch": batch,
+            "step_ms": round(best * 1e3, 3),
+            "tok_s": round(batch / best, 1),
+        })
+        del kv, out
+        # keep MAX_LENGTH-token caches from accumulating across batch sizes
+        gc.collect()
+    result = {"label": "decode_7b_batched", "n_blocks": n_blocks, "rows": rows}
+    del params, backend
+    gc.collect()
+    return result
+
+
 def bench_flash_prefill(cfg, seq, *, runs=3):
     """Long-context prefill through the Pallas flash kernel: tok/s + MFU."""
     import jax
@@ -468,6 +525,11 @@ def main():
     pf = bench_flash_prefill(llama70b_cfg(2), 8192)
     details["prefill_8k_flash"] = pf
     print(f"# 8k flash prefill: {json.dumps(pf)}", file=sys.stderr)
+
+    # batched decode throughput on the 7B span (serving-throughput scaling)
+    bd = bench_batched_decode(llama7b_cfg())
+    details["decode_7b_batched"] = bd
+    print(f"# batched decode: {json.dumps(bd)}", file=sys.stderr)
 
     with open("BENCH_DETAILS.json", "w") as f:
         json.dump(details, f, indent=2)

@@ -132,7 +132,9 @@ def main(argv=None) -> None:
     # token budget -> bytes happens inside Server once the config is known
     from petals_tpu.server.from_pretrained import get_block_config
 
-    family, cfg = get_block_config(args.model)
+    family, cfg = get_block_config(
+        args.model, revision=args.revision, cache_dir=args.cache_dir
+    )
     hkv = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
     dtype = DTYPE_MAP[args.dtype]
     attn_cache_bytes = (

@@ -36,6 +36,16 @@ class ClientConfig:
     # reference clients negotiate this per request (handler.py:411-432)
     compression: str = "none"
 
+    # live route upgrading (beyond reference): every `route_upgrade_period`
+    # seconds an active InferenceSession re-routes and, when the best chain is
+    # at most `route_upgrade_threshold` of the current chain's estimated
+    # latency, MIGRATES its server-held KV to the better servers via
+    # ptu.session_export — no prefill recompute. 0 disables. The check
+    # refreshes the swarm view inline (a DHT fetch + pings), so the one step
+    # that triggers it pays that latency — pick a period accordingly.
+    route_upgrade_period: float = 0.0
+    route_upgrade_threshold: float = 0.7
+
     def __post_init__(self):
         if self.max_retries is None:
             env = os.environ.get("PETALS_TPU_MAX_RETRIES")

@@ -16,13 +16,19 @@ from petals_tpu.server.from_pretrained import (
 )
 
 
-def load_client_params(model_name_or_path: str, *, dtype=jnp.float32, family=None, cfg=None) -> dict:
+def load_client_params(
+    model_name_or_path: str, *, dtype=jnp.float32, family=None, cfg=None,
+    revision: str = "main", cache_dir=None,
+) -> dict:
     if family is None or cfg is None:
-        family, cfg = get_block_config(model_name_or_path)
+        family, cfg = get_block_config(model_name_or_path, revision=revision, cache_dir=cache_dir)
     assert family.hf_to_client_params is not None, f"{family.name} has no client mapping"
     # repo ids stream in only the shards with client-held tensors (the
     # reference skips `model.layers.*` downloads the same way)
-    path = resolve_model_path(model_name_or_path, prefixes=family.hf_client_prefixes)
+    path = resolve_model_path(
+        model_name_or_path, prefixes=family.hf_client_prefixes,
+        revision=revision, cache_dir=cache_dir,
+    )
     # single pass over the checkpoint; client mappings match absolute names
     tensors = _load_tensors_with_prefixes(path, family.hf_client_prefixes, keep_full_names=True)
     params = family.hf_to_client_params(tensors, cfg)
@@ -30,18 +36,22 @@ def load_client_params(model_name_or_path: str, *, dtype=jnp.float32, family=Non
 
 
 def load_cls_client_params(
-    model_name_or_path: str, *, dtype=jnp.float32, family: ModelFamily = None, cfg=None
+    model_name_or_path: str, *, dtype=jnp.float32, family: ModelFamily = None, cfg=None,
+    revision: str = "main", cache_dir=None,
 ) -> dict:
     """Client params for sequence classification: embeddings + final norm +
     the `score` head (reference models/llama/model.py:183), dispatched through
     the family registry like every other checkpoint mapping."""
     if family is None or cfg is None:
-        family, cfg = get_block_config(model_name_or_path)
+        family, cfg = get_block_config(model_name_or_path, revision=revision, cache_dir=cache_dir)
     if family.hf_to_cls_params is None:
         raise NotImplementedError(
             f"{family.name} has no sequence-classification client mapping"
         )
-    path = resolve_model_path(model_name_or_path, prefixes=family.hf_cls_prefixes)
+    path = resolve_model_path(
+        model_name_or_path, prefixes=family.hf_cls_prefixes,
+        revision=revision, cache_dir=cache_dir,
+    )
     tensors = _load_tensors_with_prefixes(path, family.hf_cls_prefixes, keep_full_names=True)
     params = family.hf_to_cls_params(tensors, cfg)
     return jax.tree_util.tree_map(_caster(dtype), params)

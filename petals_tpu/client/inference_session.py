@@ -15,6 +15,7 @@
 from __future__ import annotations
 
 import asyncio
+import time
 import uuid
 from typing import List, Optional, Sequence
 
@@ -173,6 +174,7 @@ class InferenceSession:
         self._closed = False
         self._max_retries = seq_manager.config.max_retries
         self._last_prompts: Optional[np.ndarray] = None
+        self._last_route_check = time.monotonic()
 
     @property
     def position(self) -> int:
@@ -267,6 +269,14 @@ class InferenceSession:
                 block_idx = await self._repair_chain(block_idx)
 
         self._position += n_input_tokens
+
+        period = self.seq_manager.config.route_upgrade_period
+        if period and time.monotonic() - self._last_route_check >= period:
+            self._last_route_check = time.monotonic()
+            try:
+                await self._maybe_upgrade_route()
+            except Exception as e:
+                logger.warning(f"Route upgrade check failed (continuing as-is): {e}")
         return inputs
 
     def _find_session_index(self, block_idx: int) -> Optional[int]:
@@ -407,6 +417,10 @@ class InferenceSession:
             comp = CompressionType.BFLOAT16.value
         try:
             stub = await asyncio.wait_for(self.seq_manager.get_stub(peer_id), timeout=5)
+            # quick liveness probe first: this peer may be the one that just
+            # failed, and a zombie must cost seconds — not the generous
+            # transfer budget below — before the replay fallback kicks in
+            await asyncio.wait_for(stub.call("ptu.info", {}), timeout=3)
             reply = await asyncio.wait_for(
                 stub.call(
                     "ptu.session_export",
@@ -430,6 +444,13 @@ class InferenceSession:
         """Import exported KV up to a history step boundary, then replay any
         remaining recorded steps (a parked export can be a little stale)."""
         k, v, export_pos = exported
+        if export_pos > self._position:
+            # the server is AHEAD of the client: it processed a step whose
+            # reply was lost. If that step carried a hypo_ids reorder, the
+            # WHOLE exported cache is lane-permuted while the client's history
+            # (and the step it will re-send) assume pre-reorder lanes —
+            # importing would double-apply the permutation. Replay is exact.
+            return False
         cap = min(export_pos, self._position)
         # largest prefix of history steps whose total length fits the cap:
         # imports must cut at step boundaries so each step's hypo_ids reorder
@@ -454,6 +475,113 @@ class InferenceSession:
             f"(+{len(replay_steps) - n_prefix} replayed steps)"
         )
         return True
+
+    async def _maybe_upgrade_route(self) -> bool:
+        """Live route upgrading (beyond reference): when a clearly better chain
+        exists — a fast server joined, congestion cleared — migrate the
+        session's KV onto it via live ``ptu.session_export`` instead of staying
+        on the route chosen at session open. Safe-by-construction: the current
+        chain keeps serving until every replacement is seeded, and any failure
+        just abandons the attempt."""
+        current = [s for s in self._sessions if not s.closed]
+        if not current or self._position == 0:
+            return False
+        await self.seq_manager.update()
+        candidate = await self.seq_manager.make_sequence(
+            0, self.num_blocks, mode="min_latency",
+            cache_tokens_needed=self.batch_size * self.max_length,
+        )
+        cur_key = [(s.span.peer_id, s.span.start, s.span.end) for s in current]
+        cand_key = [(c.peer_id, c.start, c.end) for c in candidate]
+        if cand_key == cur_key:
+            return False
+        cur_cost = self.seq_manager.estimate_chain_latency([s.span for s in current])
+        new_cost = self.seq_manager.estimate_chain_latency(candidate)
+        if new_cost > self.seq_manager.config.route_upgrade_threshold * cur_cost:
+            return False
+        # history-transfer guard: each candidate span's input history must
+        # exist client-side, i.e. its start must be a current session start
+        # (otherwise a LATER failover of that span could not replay)
+        starts = {s.span.start for s in current}
+        if any(c.start not in starts for c in candidate):
+            return False
+        logger.info(
+            f"Upgrading route (estimated {cur_cost * 1e3:.0f} -> {new_cost * 1e3:.0f} ms/token)"
+        )
+        return await self._migrate_to(candidate, current)
+
+    async def _migrate_to(self, chain, current) -> bool:
+        """Open sessions for ``chain``, seeding each NEW span by exporting KV
+        from the live current sessions (block-sliced, concatenated across
+        session boundaries); reuse current sessions that match exactly."""
+        by_start = {s.span.start: s for s in current}
+        new_sessions: List[_ServerInferenceSession] = []
+        created: List[_ServerInferenceSession] = []
+        try:
+            for span in chain:
+                existing = by_start.get(span.start)
+                if (
+                    existing is not None
+                    and existing.span.peer_id == span.peer_id
+                    and existing.span.end == span.end
+                ):
+                    new_sessions.append(existing)
+                    continue
+                # gather [span.start, span.end) KV from the covering sessions
+                pieces = []
+                export_pos = self._position
+                for cur in sorted(current, key=lambda s: s.span.start):
+                    lo, hi = max(cur.span.start, span.start), min(cur.span.end, span.end)
+                    if lo >= hi:
+                        continue
+                    got = await self._try_export(cur.span.peer_id, cur.session_id, lo, hi)
+                    if got is None:
+                        raise RuntimeError(f"export of blocks [{lo}, {hi}) unavailable")
+                    k, v, pos = got
+                    pieces.append((lo, k, v))
+                    export_pos = min(export_pos, pos)
+                covered = sorted(pieces, key=lambda p: p[0])
+                k_all = np.concatenate([p[1][:, :, :export_pos] for p in covered], axis=0)
+                v_all = np.concatenate([p[2][:, :, :export_pos] for p in covered], axis=0)
+                if k_all.shape[0] != span.end - span.start:
+                    raise RuntimeError(
+                        f"exported {k_all.shape[0]} blocks for span [{span.start}, {span.end})"
+                    )
+                uids = self.seq_manager.block_uids[span.start : span.end]
+                session = await _ServerInferenceSession.create(
+                    self.seq_manager, span, uids,
+                    max_length=self.max_length, batch_size=self.batch_size,
+                    session_id=uuid.uuid4().hex,
+                )
+                created.append(session)
+                replay_steps = by_start[span.start].history_steps()
+                if not await self._seed_by_import(session, (k_all, v_all, export_pos), replay_steps):
+                    raise RuntimeError("exported cache too stale (or ahead of us) to seed from")
+                new_sessions.append(session)
+        except Exception as e:
+            logger.warning(f"Route upgrade abandoned (staying on current chain): {e}")
+            for session in created:
+                await session.close()
+            return False
+
+        for session in current:
+            if session not in new_sessions:
+                await session.close()
+        self._sessions = new_sessions
+        self._wire_push_chain(new_sessions)
+        return True
+
+    def _wire_push_chain(self, sessions: List[_ServerInferenceSession]) -> None:
+        if not self.seq_manager.config.use_server_to_server:
+            return
+        for i, session in enumerate(sessions):
+            nxt = sessions[i + 1] if i + 1 < len(sessions) else None
+            target = None
+            if nxt is not None and nxt.session_id:
+                addr = self.seq_manager.addr_of(nxt.span.peer_id)
+                if addr is not None:
+                    target = {"addr": addr.to_string(), "session_id": nxt.session_id}
+            session.pending_push_to = target if target is not None else False
 
     def _wire_repair_pushes(self, keep_up, new_sessions, keep_down, dead_end: int) -> None:
         """Re-link the server->server push chain around the repaired hole (the

@@ -59,10 +59,15 @@ class _DistributedModelBase(PTuneMixin):
         dht_prefix: Optional[str] = None,
         dtype=jnp.float32,
         ptune: Optional[PTuneConfig] = None,
+        revision: str = "main",
+        cache_dir=None,
         **config_overrides,
     ):
-        family, cfg = get_block_config(model_name_or_path)
-        client_params = load_client_params(model_name_or_path, dtype=dtype, family=family, cfg=cfg)
+        family, cfg = get_block_config(model_name_or_path, revision=revision, cache_dir=cache_dir)
+        client_params = load_client_params(
+            model_name_or_path, dtype=dtype, family=family, cfg=cfg,
+            revision=revision, cache_dir=cache_dir,
+        )
         if cls._drop_head:
             # the head matrix is ~[hidden, vocab] (hundreds of MB on real
             # models) and the bare-model surface never projects to the vocab
@@ -179,15 +184,18 @@ class DistributedModelForSequenceClassification(_DistributedModelBase):
         dht_prefix: Optional[str] = None,
         dtype=jnp.float32,
         ptune: Optional[PTuneConfig] = None,
+        revision: str = "main",
+        cache_dir=None,
         **config_overrides,
     ) -> "DistributedModelForSequenceClassification":
         from petals_tpu.client.from_pretrained import load_cls_client_params
         from petals_tpu.server.from_pretrained import load_hf_config
 
-        family, cfg = get_block_config(model_name_or_path)
-        hf_config = load_hf_config(model_name_or_path)
+        family, cfg = get_block_config(model_name_or_path, revision=revision, cache_dir=cache_dir)
+        hf_config = load_hf_config(model_name_or_path, revision=revision, cache_dir=cache_dir)
         client_params = load_cls_client_params(
-            model_name_or_path, dtype=dtype, family=family, cfg=cfg
+            model_name_or_path, dtype=dtype, family=family, cfg=cfg,
+            revision=revision, cache_dir=cache_dir,
         )
         remote = cls._build_remote(
             model_name_or_path, initial_peers, config, dht_prefix, config_overrides, cfg

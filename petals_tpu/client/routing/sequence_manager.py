@@ -407,6 +407,25 @@ class RemoteSequenceManager:
         sequence.reverse()
         return sequence
 
+    def estimate_chain_latency(self, chain: List[RemoteSpanInfo]) -> float:
+        """Estimated per-token latency of a chain under the same cost model the
+        min-latency Dijkstra uses (RTT hops + per-block decode cost), with each
+        span's ServerInfo refreshed from the current routing state — so a
+        chain chosen minutes ago is scored against today's swarm."""
+        cost, prev = 0.0, None
+        for span in chain:
+            info = span.server_info
+            by_block = self.state.spans_containing_block
+            if span.start < len(by_block):
+                for cand in by_block[span.start]:
+                    if cand.peer_id == span.peer_id:
+                        info = cand.server_info
+                        break
+            rps = info.inference_rps or info.throughput or 1.0
+            cost += self.rtt_fn(prev, span.peer_id) + (span.end - span.start) / max(rps, 1e-3)
+            prev = span.peer_id
+        return cost
+
     # ------------------------------------------------------------------ stubs
 
     def addr_of(self, peer_id: PeerID) -> Optional[PeerAddr]:

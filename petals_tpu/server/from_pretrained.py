@@ -58,14 +58,18 @@ def resolve_model_path(
     )
 
 
-def load_hf_config(model_name_or_path: str):
+def load_hf_config(model_name_or_path: str, *, revision: str = "main", cache_dir=None):
     from transformers import AutoConfig
 
-    return AutoConfig.from_pretrained(resolve_model_path(model_name_or_path))
+    return AutoConfig.from_pretrained(
+        resolve_model_path(model_name_or_path, revision=revision, cache_dir=cache_dir)
+    )
 
 
-def get_block_config(model_name_or_path: str) -> Tuple[ModelFamily, object]:
-    hf_config = load_hf_config(model_name_or_path)
+def get_block_config(
+    model_name_or_path: str, *, revision: str = "main", cache_dir=None
+) -> Tuple[ModelFamily, object]:
+    hf_config = load_hf_config(model_name_or_path, revision=revision, cache_dir=cache_dir)
     family = get_family(hf_config.model_type)
     return family, family.config_from_hf(hf_config)
 

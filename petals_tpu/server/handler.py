@@ -124,20 +124,31 @@ class TransformerHandler:
         # between drain and shutdown
         live = self._session_registry.get(session_id)
         if live is not None:
-            src = await self._snapshot_session(live)
+            if not (live["start"] <= want_start < want_end <= live["end"]):
+                raise ValueError(
+                    f"Requested blocks [{want_start}, {want_end}) outside session span "
+                    f"[{live['start']}, {live['end']})"
+                )
+            # slice the requested block range ON DEVICE: a route upgrade may
+            # ask for a narrow range of a long-context span, and the full-span
+            # host copy would be 100s of wasted MB per request
+            src = await self._snapshot_session(
+                live, want_start - live["start"], want_end - live["start"]
+            )
+            b0, b1 = 0, want_end - want_start
         else:
             src = self._parked.get(session_id)
             if src is None:
                 raise KeyError(f"No live or parked session {session_id!r}")
+            if not (src["start"] <= want_start < want_end <= src["end"]):
+                raise ValueError(
+                    f"Requested blocks [{want_start}, {want_end}) outside session span "
+                    f"[{src['start']}, {src['end']})"
+                )
+            b0, b1 = want_start - src["start"], want_end - src["start"]
         position = src["position"]
         if position <= 0:
             raise ValueError(f"Session {session_id!r} has no cached tokens yet")
-        if not (src["start"] <= want_start < want_end <= src["end"]):
-            raise ValueError(
-                f"Requested blocks [{want_start}, {want_end}) outside session span "
-                f"[{src['start']}, {src['end']})"
-            )
-        b0, b1 = want_start - src["start"], want_end - src["start"]
         return {
             "position": position,
             "start": want_start,
@@ -182,20 +193,24 @@ class TransformerHandler:
             self.memory_cache.update_cache(handle, new_buf)
         return new_position
 
-    async def _snapshot_session(self, reg: dict) -> dict:
-        """Host copy of a live session's KV, sliced to its position. The step
-        loop donates buffers into XLA, so a fetch can race a step in flight
-        (the grabbed buffer gets invalidated) — retry on the fresh buffer.
-        The device->host copy is 100s of MB for long contexts, so it runs off
-        the event loop: other sessions' steps must not stall behind it."""
+    async def _snapshot_session(
+        self, reg: dict, b0: Optional[int] = None, b1: Optional[int] = None
+    ) -> dict:
+        """Host copy of a live session's KV (optionally just blocks [b0, b1)
+        relative to the span), sliced to its position. The step loop donates
+        buffers into XLA, so a fetch can race a step in flight (the grabbed
+        buffer gets invalidated) — retry on the fresh buffer. The device->host
+        copy is 100s of MB for long contexts, so it runs off the event loop:
+        other sessions' steps must not stall behind it."""
+        bs = slice(b0, b1)
         for attempt in range(20):
             position = reg["position"]
             try:
                 k_buf, v_buf = self.memory_cache.get_buffers(*reg["handles"])
                 k, v = await asyncio.to_thread(
                     lambda: (
-                        np.asarray(k_buf[:, :, :position]),
-                        np.asarray(v_buf[:, :, :position]),
+                        np.asarray(k_buf[bs, :, :position]),
+                        np.asarray(v_buf[bs, :, :position]),
                     )
                 )
                 break

@@ -83,7 +83,11 @@ class Server:
         self.model_path = model_path
         self.revision = revision
         self.cache_dir = cache_dir
-        self.family, self.cfg = get_block_config(model_path)
+        # config must come from the SAME revision/cache the weights stream
+        # from, or block splitting and shapes follow a different architecture
+        self.family, self.cfg = get_block_config(
+            model_path, revision=revision, cache_dir=cache_dir
+        )
         total = self.cfg.num_hidden_layers
         self.auto_placement = first_block is None
         if attn_cache_bytes is None:
@@ -377,10 +381,24 @@ class Server:
         their caches to replacement servers (``ptu.session_export``) instead of
         recomputing prefills. The RPC server stays up — call :meth:`shutdown`
         after the drain window. Returns the number of parked sessions."""
+        # a rebalance firing mid-drain would reload blocks and re-announce
+        # ONLINE, overriding the OFFLINE below — stop considering moves first
+        if self._balancer_task is not None:
+            self._balancer_task.cancel()
+            try:
+                await self._balancer_task
+            except asyncio.CancelledError:
+                pass
+            self._balancer_task = None
         parked = 0
         if self.handler is not None:
-            self.handler.draining = True
+            # park BEFORE refusing steps: flipping `draining` first lets an
+            # in-flight step raise and unregister its session while the park
+            # snapshot awaits — the export would then find nothing. A step
+            # that lands between the snapshot and the flip only makes the
+            # parked copy stale, which clients top up by replaying the tail.
             parked = await self.handler.park_sessions(ttl=park_ttl)
+            self.handler.draining = True
         self._state = ServerState.OFFLINE
         try:
             await self._announce(ServerState.OFFLINE, expiration=dht_time() + 60)

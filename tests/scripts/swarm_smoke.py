@@ -96,6 +96,7 @@ def main() -> int:
             "--torch_dtype", "float32",
             "--throughput", "1.0",
             "--update_period", "5",
+            "--drain_seconds", "30",
         ]
         # subsystem-flag servers, reference CI style: TP+flash / NF4+chunking
         procs.append(spawn(
@@ -158,6 +159,64 @@ def main() -> int:
         assert "tracing" in info and info["tracing"], f"no tracing spans in {info.keys()}"
         assert "inference_step" in info["tracing"]
         print(f"[smoke] tracing summary: {info['tracing']}", flush=True)
+
+        # --- graceful drain + KV migration through the real CLI path ---
+        # a spare front server joins, the TP server gets SIGTERM with a drain
+        # window (--drain_seconds), and a live session must keep generating —
+        # migrating its cache to the spare via ptu.session_export
+        spare = spawn(
+            common + ["--identity_seed", "ci-spare", "--block_indices", "0:2"],
+            "server-spare",
+        )
+        procs.append(spare)
+        tp_proc = procs[1]
+
+        from petals_tpu.client.inference_session import InferenceSession
+
+        migrations = []
+        real_seed = InferenceSession._seed_by_import
+
+        async def spy_seed(self, session, exported, replay_steps):
+            ok = await real_seed(self, session, exported, replay_steps)
+            migrations.append(ok)
+            return ok
+
+        InferenceSession._seed_by_import = spy_seed
+        model2 = AutoDistributedModelForCausalLM.from_pretrained(
+            path, initial_peers=[boot_addr], update_period=5, min_backoff=0.1,
+        )
+        # the spare must be routable BEFORE the TP server drains, or the
+        # repair has nowhere to migrate to and this leg tests nothing
+        mgr = model2.remote.sequence_manager
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            model2.remote.runtime.run(mgr.update())
+            if len(mgr.state.spans_containing_block[0]) >= 2:
+                break
+            time.sleep(2)
+        else:
+            raise RuntimeError("spare server never became routable")
+
+        with model2.remote.inference_session(max_length=16, batch_size=1) as sess:
+            part = model2.generate(ids, max_new_tokens=2, session=sess)
+            # SIGTERM the server the session actually rides for block 0 (the
+            # router may have picked either front server) — its drain window
+            # must let the client migrate to the other one
+            from petals_tpu.dht.identity import Identity
+
+            front_peer = sess._session._sessions[0].span.peer_id
+            by_peer = {
+                Identity.from_seed(b"ci-tp").peer_id: tp_proc,
+                Identity.from_seed(b"ci-spare").peer_id: spare,
+            }
+            by_peer[front_peer].send_signal(signal.SIGTERM)
+            time.sleep(3.0)  # let the drain park + start refusing steps
+            out2 = model2.generate(part, max_new_tokens=3, session=sess)
+        model2.close()
+        assert out2.shape == (1, ids.shape[1] + 5), out2
+        assert any(migrations), f"drain repair should migrate KV, got {migrations}"
+        print(f"[smoke] drain migration OK: migrated={migrations}", flush=True)
+
         model.close()
         print("[smoke] PASS", flush=True)
         return 0

@@ -220,3 +220,62 @@ def test_seed_by_import_stale_export_tops_up_with_replay():
     assert target.imported == ((4, 1, 6, 2, 4), (4, 1, 6, 2, 4), 6)
     assert target.stepped == [1]
     assert len(target.history) == 2  # seeded prefix; step() stub didn't append
+
+
+def test_live_route_upgrade(tmp_path_factory, monkeypatch):
+    """A faster server joins mid-generation: the session must migrate its KV
+    onto it (live export from the old server, no prefill recompute) and keep
+    producing HF-identical tokens."""
+    import jax.numpy as jnp
+
+    from petals_tpu.server.server import Server
+
+    path = make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+    harness = SwarmHarness(
+        path, [dict(first_block=0, num_blocks=4, throughput=1.0)]  # slow, alone
+    ).start()
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers, min_backoff=0.1,
+        route_upgrade_period=0.01,
+    )
+    migrations = []
+    real_seed = InferenceSession._seed_by_import
+
+    async def spy_seed(self, session, exported, replay_steps):
+        ok = await real_seed(self, session, exported, replay_steps)
+        migrations.append(ok)
+        return ok
+
+    monkeypatch.setattr(InferenceSession, "_seed_by_import", spy_seed)
+    try:
+        rng = np.random.RandomState(2)
+        input_ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+        expected = _hf_greedy(path, input_ids, 6)
+
+        with model.remote.inference_session(max_length=16, batch_size=1) as session:
+            first = model.generate(input_ids, max_new_tokens=3, session=session)
+            np.testing.assert_array_equal(first, expected[:, : input_ids.shape[1] + 3])
+            slow_peer = harness.servers[0].dht.peer_id
+            assert session._session._sessions[0].span.peer_id == slow_peer
+
+            async def add_fast():
+                server = Server(
+                    path, initial_peers=[harness.bootstrap.own_addr],
+                    compute_dtype=jnp.float32, use_flash=False,
+                    first_block=0, num_blocks=4, throughput=1000.0,
+                )
+                await server.start()
+                harness.servers.append(server)
+
+            harness.run(add_fast())
+
+            final = model.generate(first, max_new_tokens=3, session=session)
+            np.testing.assert_array_equal(final, expected)
+            assert migrations and all(migrations), "upgrade must seed by KV import"
+            fast_peer = harness.servers[1].dht.peer_id
+            assert session._session._sessions[0].span.peer_id == fast_peer, (
+                "session should now ride the fast server"
+            )
+    finally:
+        model.close()
+        harness.stop()
