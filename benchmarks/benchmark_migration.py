@@ -1,0 +1,92 @@
+"""Repair-latency benchmark: KV migration vs history replay.
+
+When a server leaves gracefully (drain), a client can either replay its whole
+recorded input history into the replacement (the reference's only option —
+recomputing the full prefill) or import the dying server's exported KV cache
+(petals_tpu's ptu.session_export path). This measures both repair modes on the
+same swarm and prefix length, so the benefit is directly visible: replay cost
+grows with the prefix while migration moves bytes instead of recomputing.
+
+Self-contained: boots a 2-front-server loopback swarm in-process (tiny llama)
+and repairs a session whose prefix is ``--prefix`` tokens long.
+
+Usage: python benchmarks/benchmark_migration.py [--cpu] [--prefix 512]
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    parser.add_argument("--prefix", type=int, default=512, help="session prefix tokens")
+    parser.add_argument("--layers", type=int, default=4)
+    args = parser.parse_args()
+
+    import jax
+
+    if args.cpu or jax.default_backend() != "tpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from tests.test_full_model import SwarmHarness
+    from tests.utils import make_tiny_llama
+    from petals_tpu.client.model import AutoDistributedModelForCausalLM
+
+    path = make_tiny_llama(tempfile.mkdtemp(), n_layers=args.layers)
+    max_length = args.prefix + 64
+
+    def run_one(mode: str) -> float:
+        harness = SwarmHarness(
+            path,
+            [
+                dict(first_block=0, num_blocks=args.layers, throughput=1000.0),
+                dict(first_block=0, num_blocks=args.layers, throughput=1.0),
+            ],
+        ).start()
+        model = AutoDistributedModelForCausalLM.from_pretrained(
+            path, initial_peers=harness.initial_peers, min_backoff=0.05,
+        )
+        try:
+            rng = np.random.RandomState(0)
+            ids = rng.randint(0, 100, (1, args.prefix)).astype(np.int64)
+            with model.remote.inference_session(
+                max_length=max_length, batch_size=1
+            ) as session:
+                first = model.generate(ids, max_new_tokens=2, session=session)
+                fast = harness.servers[0]
+                if mode == "migrate":
+                    harness.run(fast.drain())  # exports stay served
+                else:
+                    harness.run(fast.shutdown())  # hard death: replay only
+                t0 = time.perf_counter()
+                model.generate(first, max_new_tokens=1, session=session)
+                repair_s = time.perf_counter() - t0
+            return repair_s
+        finally:
+            model.close()
+            if mode == "migrate":
+                harness.run(harness.servers[0].shutdown())
+                harness.servers.pop(0)
+            harness.stop()
+
+    t_replay = run_one("replay")
+    t_migrate = run_one("migrate")
+    print(
+        f"prefix={args.prefix} tokens, {args.layers} blocks: "
+        f"replay repair {t_replay * 1e3:.0f} ms, "
+        f"KV-migration repair {t_migrate * 1e3:.0f} ms "
+        f"({t_replay / max(t_migrate, 1e-9):.2f}x faster)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -495,8 +495,13 @@ class InferenceSession:
         cand_key = [(c.peer_id, c.start, c.end) for c in candidate]
         if cand_key == cur_key:
             return False
-        cur_cost = self.seq_manager.estimate_chain_latency([s.span for s in current])
-        new_cost = self.seq_manager.estimate_chain_latency(candidate)
+        tokens_needed = self.batch_size * self.max_length
+        cur_cost = self.seq_manager.estimate_chain_latency(
+            [s.span for s in current], cache_tokens_needed=tokens_needed
+        )
+        new_cost = self.seq_manager.estimate_chain_latency(
+            candidate, cache_tokens_needed=tokens_needed
+        )
         if new_cost > self.seq_manager.config.route_upgrade_threshold * cur_cost:
             return False
         # history-transfer guard: each candidate span's input history must
@@ -527,6 +532,16 @@ class InferenceSession:
                 ):
                     new_sessions.append(existing)
                     continue
+                # open the (cheap) replacement session BEFORE the (expensive,
+                # 100s-of-MB) exports: a candidate that refuses the open —
+                # draining, cache full — must not cost a full KV transfer
+                uids = self.seq_manager.block_uids[span.start : span.end]
+                session = await _ServerInferenceSession.create(
+                    self.seq_manager, span, uids,
+                    max_length=self.max_length, batch_size=self.batch_size,
+                    session_id=uuid.uuid4().hex,
+                )
+                created.append(session)
                 # gather [span.start, span.end) KV from the covering sessions
                 pieces = []
                 export_pos = self._position
@@ -547,13 +562,6 @@ class InferenceSession:
                     raise RuntimeError(
                         f"exported {k_all.shape[0]} blocks for span [{span.start}, {span.end})"
                     )
-                uids = self.seq_manager.block_uids[span.start : span.end]
-                session = await _ServerInferenceSession.create(
-                    self.seq_manager, span, uids,
-                    max_length=self.max_length, batch_size=self.batch_size,
-                    session_id=uuid.uuid4().hex,
-                )
-                created.append(session)
                 replay_steps = by_start[span.start].history_steps()
                 if not await self._seed_by_import(session, (k_all, v_all, export_pos), replay_steps):
                     raise RuntimeError("exported cache too stale (or ahead of us) to seed from")
@@ -562,6 +570,10 @@ class InferenceSession:
             logger.warning(f"Route upgrade abandoned (staying on current chain): {e}")
             for session in created:
                 await session.close()
+            # back off: without this, the identical doomed attempt (and its
+            # KV transfers) would repeat on every period tick
+            period = self.seq_manager.config.route_upgrade_period
+            self._last_route_check = time.monotonic() + 4 * period
             return False
 
         for session in current:

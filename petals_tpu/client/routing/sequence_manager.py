@@ -372,15 +372,9 @@ class RemoteSequenceManager:
             for span in self._usable_spans_for_block(block):
                 info = span.server_info
                 next_block = min(span.end, end)
-                n_blocks = next_block - block
-                rps = info.inference_rps or info.throughput or 1.0
-                edge = self.rtt_fn(peer, span.peer_id) + n_blocks / max(rps, 1e-3)
-                if (
-                    cache_tokens_needed is not None
-                    and info.cache_tokens_left is not None
-                    and info.cache_tokens_left < cache_tokens_needed
-                ):
-                    edge += CACHE_MISS_PENALTY
+                edge = self._edge_cost(
+                    peer, span.peer_id, info, next_block - block, cache_tokens_needed
+                )
                 nkey = (next_block, span.peer_id)
                 ncost = cost + edge
                 if ncost < best.get(nkey, float("inf")):
@@ -407,11 +401,29 @@ class RemoteSequenceManager:
         sequence.reverse()
         return sequence
 
-    def estimate_chain_latency(self, chain: List[RemoteSpanInfo]) -> float:
+    def _edge_cost(
+        self, prev_peer, peer_id, info, n_blocks: int, cache_tokens_needed: Optional[int]
+    ) -> float:
+        """One chain hop's cost: RTT + per-block decode cost + cache-miss
+        penalty — THE edge model, shared by the Dijkstra and
+        estimate_chain_latency so the two can never drift apart."""
+        rps = info.inference_rps or info.throughput or 1.0
+        edge = self.rtt_fn(prev_peer, peer_id) + n_blocks / max(rps, 1e-3)
+        if (
+            cache_tokens_needed is not None
+            and info.cache_tokens_left is not None
+            and info.cache_tokens_left < cache_tokens_needed
+        ):
+            edge += CACHE_MISS_PENALTY
+        return edge
+
+    def estimate_chain_latency(
+        self, chain: List[RemoteSpanInfo], cache_tokens_needed: Optional[int] = None
+    ) -> float:
         """Estimated per-token latency of a chain under the same cost model the
-        min-latency Dijkstra uses (RTT hops + per-block decode cost), with each
-        span's ServerInfo refreshed from the current routing state — so a
-        chain chosen minutes ago is scored against today's swarm."""
+        min-latency Dijkstra uses (``_edge_cost``), with each span's ServerInfo
+        refreshed from the current routing state — so a chain chosen minutes
+        ago is scored against today's swarm."""
         cost, prev = 0.0, None
         for span in chain:
             info = span.server_info
@@ -421,8 +433,9 @@ class RemoteSequenceManager:
                     if cand.peer_id == span.peer_id:
                         info = cand.server_info
                         break
-            rps = info.inference_rps or info.throughput or 1.0
-            cost += self.rtt_fn(prev, span.peer_id) + (span.end - span.start) / max(rps, 1e-3)
+            cost += self._edge_cost(
+                prev, span.peer_id, info, span.end - span.start, cache_tokens_needed
+            )
             prev = span.peer_id
         return cost
 

@@ -43,7 +43,7 @@ def block_apply(
     q = mm(x, params["wq"])
     k = mm(x, params["wk"])
     v = mm(x, params["wv"])
-    if cfg.attention_bias:
+    if cfg.attention_bias or cfg.qkv_bias:
         q = q + params["bq"]
         k = k + params["bk"]
         v = v + params["bv"]
@@ -61,6 +61,7 @@ def block_apply(
     attn = attend_maybe_ring(
         q, k_all, v_all, kv=kv, position=position, n_valid=n_valid,
         kv_length=kv_length, ring_mesh=ring_mesh, use_flash=use_flash, tp_mesh=tp_mesh,
+        sliding_window=cfg.sliding_window,  # mistral; None for llama/qwen2
     )
     attn = mm(attn.reshape(batch, seq, hq * d), params["wo"])
     if cfg.attention_bias:
@@ -107,10 +108,11 @@ def hf_to_block_params(tensors: dict, cfg: LlamaBlockConfig) -> dict:
         "wu": t("mlp.up_proj.weight"),
         "wd": t("mlp.down_proj.weight"),
     }
-    if cfg.attention_bias:
+    if cfg.attention_bias or cfg.qkv_bias:
         params["bq"] = np.asarray(tensors["self_attn.q_proj.bias"])
         params["bk"] = np.asarray(tensors["self_attn.k_proj.bias"])
         params["bv"] = np.asarray(tensors["self_attn.v_proj.bias"])
+    if cfg.attention_bias:
         params["bo"] = np.asarray(tensors["self_attn.o_proj.bias"])
     if cfg.mlp_bias:
         params["bg"] = np.asarray(tensors["mlp.gate_proj.bias"])
@@ -141,10 +143,11 @@ def block_param_shapes(cfg: LlamaBlockConfig, dtype=jnp.bfloat16) -> dict:
         "wu": S((h, m), dtype),
         "wd": S((m, h), dtype),
     }
-    if cfg.attention_bias:
+    if cfg.attention_bias or cfg.qkv_bias:
         shapes["bq"] = S((hq * d,), dtype)
         shapes["bk"] = S((hkv * d,), dtype)
         shapes["bv"] = S((hkv * d,), dtype)
+    if cfg.attention_bias:
         shapes["bo"] = S((h,), dtype)
     if cfg.mlp_bias:
         shapes["bg"] = S((m,), dtype)

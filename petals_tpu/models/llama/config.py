@@ -22,8 +22,11 @@ class LlamaBlockConfig:
     rope_theta: float = 10000.0
     # rope_scaling as a hashable tuple of (key, value) pairs, or None
     rope_scaling: Optional[Tuple[Tuple[str, float], ...]] = None
-    attention_bias: bool = False
+    attention_bias: bool = False  # bias on q,k,v AND o (HF llama convention)
+    qkv_bias: bool = False  # bias on q,k,v only (HF qwen2 convention)
     mlp_bias: bool = False
+    # all-layer sliding window (HF mistral convention); None = full attention
+    sliding_window: Optional[int] = None
     vocab_size: int = 32000
     tie_word_embeddings: bool = False
 

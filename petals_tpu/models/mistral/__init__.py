@@ -1,0 +1,29 @@
+"""Mistral family (beyond the reference's four families).
+
+A llama-style decoder whose only architectural delta is an (optional)
+all-layer sliding attention window — exactly the window semantics the
+attention stack already implements for Mixtral (kv > q_pos - window), so the
+family is the llama block with ``sliding_window`` taken from the checkpoint.
+Mistral v0.2+ ships ``sliding_window: null`` and degrades to plain llama.
+Sliding windows ride the flash kernel and the ring-attention sp axis alike.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import petals_tpu.models.llama.model as llama_model
+from petals_tpu.models.llama.config import LlamaBlockConfig
+from petals_tpu.models.registry import register_family
+
+
+def config_from_hf(hf_config) -> LlamaBlockConfig:
+    base = LlamaBlockConfig.from_hf_config(hf_config)
+    return dataclasses.replace(
+        base, sliding_window=getattr(hf_config, "sliding_window", None)
+    )
+
+
+FAMILY = register_family(
+    dataclasses.replace(llama_model.FAMILY, name="mistral", config_from_hf=config_from_hf)
+)

@@ -1,0 +1,37 @@
+"""Qwen2 / Qwen2.5 family (beyond the reference's four families).
+
+Architecturally a llama-style decoder with the qwen bias convention — bias on
+q/k/v but NOT on o_proj (transformers Qwen2Attention hardcodes bias=True for
+q/k/v, bias=False for o) — so the whole family is the llama block with
+``qkv_bias=True``. Tied embeddings (Qwen2-0.5B/1.5B) ride the llama-style
+client mapping's tie handling.
+
+Checkpoints with ``use_sliding_window=True`` layer-gate the window by
+``max_window_layers``; that per-layer gating is not represented in the uniform
+block config, so such configs are rejected at load (every released Qwen2/2.5
+checkpoint ships with use_sliding_window=False).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import petals_tpu.models.llama.model as llama_model
+from petals_tpu.models.llama.config import LlamaBlockConfig
+from petals_tpu.models.registry import register_family
+
+
+def config_from_hf(hf_config) -> LlamaBlockConfig:
+    if getattr(hf_config, "use_sliding_window", False):
+        raise NotImplementedError(
+            "Qwen2 checkpoints with use_sliding_window=True gate the window "
+            "per layer (max_window_layers); this build serves the (universal) "
+            "full-attention configuration only"
+        )
+    base = LlamaBlockConfig.from_hf_config(hf_config)
+    return dataclasses.replace(base, attention_bias=False, qkv_bias=True)
+
+
+FAMILY = register_family(
+    dataclasses.replace(llama_model.FAMILY, name="qwen2", config_from_hf=config_from_hf)
+)

@@ -160,7 +160,10 @@ def load_block_params(
 ) -> dict:
     """Load block ``block_index`` and return our parameter pytree on device."""
     if family is None or cfg is None:
-        family, cfg = get_block_config(model_name_or_path)
+        # same revision/cache as the weights, or the architecture could differ
+        family, cfg = get_block_config(
+            model_name_or_path, revision=revision, cache_dir=cache_dir
+        )
 
     prefixes = tuple(tpl.format(i=block_index) for tpl in family.hf_block_prefixes)
     # for repo ids this streams in exactly the shards holding this block

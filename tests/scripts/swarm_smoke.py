@@ -96,12 +96,15 @@ def main() -> int:
             "--torch_dtype", "float32",
             "--throughput", "1.0",
             "--update_period", "5",
-            "--drain_seconds", "30",
         ]
+        # only the FRONT servers need a drain window (the migration leg kills
+        # one of them); the others keep exercising the clean-SIGTERM exit
+        front_extra = ["--drain_seconds", "30"]
         # subsystem-flag servers, reference CI style: TP+flash / NF4+chunking
         procs.append(spawn(
-            common + ["--identity_seed", "ci-tp", "--block_indices", "0:2",
-                      "--num_tp_devices", "2"],
+            common + front_extra
+            + ["--identity_seed", "ci-tp", "--block_indices", "0:2",
+               "--num_tp_devices", "2"],
             "server-tp2",
         ))
         procs.append(spawn(
@@ -165,7 +168,8 @@ def main() -> int:
         # window (--drain_seconds), and a live session must keep generating —
         # migrating its cache to the spare via ptu.session_export
         spare = spawn(
-            common + ["--identity_seed", "ci-spare", "--block_indices", "0:2"],
+            common + front_extra
+            + ["--identity_seed", "ci-spare", "--block_indices", "0:2"],
             "server-spare",
         )
         procs.append(spare)

@@ -8,7 +8,7 @@ import pytest
 import torch
 
 from petals_tpu.server.from_pretrained import get_block_config, load_block_params
-from tests.utils import make_tiny_bloom, make_tiny_llama
+from tests.utils import make_tiny_bloom, make_tiny_llama, make_tiny_mistral, make_tiny_qwen2
 
 ATOL_FORWARD = 1e-4
 ATOL_INFERENCE = 1e-4
@@ -27,6 +27,17 @@ def tiny_bloom(tmp_path_factory):
 @pytest.fixture(scope="module")
 def tiny_llama_biased(tmp_path_factory):
     return make_tiny_llama(str(tmp_path_factory.mktemp("models")), n_layers=2, biased=True)
+
+
+@pytest.fixture(scope="module")
+def tiny_qwen2(tmp_path_factory):
+    return make_tiny_qwen2(str(tmp_path_factory.mktemp("models")), n_layers=2)
+
+
+@pytest.fixture(scope="module")
+def tiny_mistral(tmp_path_factory):
+    # window=6 < the 16-token test sequence, so the window edge is exercised
+    return make_tiny_mistral(str(tmp_path_factory.mktemp("models")), n_layers=2, window=6)
 
 
 def _hf_hidden_states(model_path, input_ids):
@@ -56,7 +67,10 @@ def _hf_hidden_states(model_path, input_ids):
     return [embeddings] + captured
 
 
-@pytest.mark.parametrize("model_fixture", ["tiny_llama", "tiny_bloom", "tiny_llama_biased"])
+@pytest.mark.parametrize(
+    "model_fixture",
+    ["tiny_llama", "tiny_bloom", "tiny_llama_biased", "tiny_qwen2", "tiny_mistral"],
+)
 def test_block_forward_exact_match(model_fixture, request):
     model_path = request.getfixturevalue(model_fixture)
     family, cfg = get_block_config(model_path)
@@ -79,7 +93,9 @@ def test_block_forward_exact_match(model_fixture, request):
         )
 
 
-@pytest.mark.parametrize("model_fixture", ["tiny_llama", "tiny_bloom"])
+@pytest.mark.parametrize(
+    "model_fixture", ["tiny_llama", "tiny_bloom", "tiny_qwen2", "tiny_mistral"]
+)
 def test_block_inference_with_cache_matches_forward(model_fixture, request):
     """Chunked prefill + token-by-token decode through the KV cache must equal
     one full forward (reference test_block_exact_match.py inference path)."""

@@ -1,0 +1,60 @@
+"""Qwen2 and Mistral end-to-end: token-identical greedy generation through a
+live swarm (the same acceptance bar as the reference's four families). These
+families are BEYOND the reference inventory — llama-style blocks with the
+qwen bias convention (q/k/v-only) and the mistral all-layer sliding window.
+"""
+
+import numpy as np
+import pytest
+
+from petals_tpu.client.model import AutoDistributedModelForCausalLM
+from tests.test_full_model import SwarmHarness, _hf_greedy
+from tests.utils import make_tiny_mistral, make_tiny_qwen2
+
+
+@pytest.fixture(scope="module", params=["qwen2", "mistral"])
+def family_swarm(request, tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("models"))
+    if request.param == "qwen2":
+        path = make_tiny_qwen2(tmp)
+    else:
+        # window=6: generation must cross the sliding-window edge mid-stream
+        path = make_tiny_mistral(tmp, window=6)
+    harness = SwarmHarness(
+        path, [dict(first_block=0, num_blocks=2), dict(first_block=2, num_blocks=2)]
+    ).start()
+    yield request.param, path, harness
+    harness.stop()
+
+
+def test_generate_token_identical(family_swarm):
+    name, path, harness = family_swarm
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers
+    )
+    try:
+        rng = np.random.RandomState(0)
+        input_ids = rng.randint(0, 100, (1, 6)).astype(np.int64)
+        expected = _hf_greedy(path, input_ids, 8)  # 6+8 = 14 tokens > window 6
+        out = model.generate(input_ids, max_new_tokens=8)
+        np.testing.assert_array_equal(out, expected, err_msg=f"{name} diverged from HF")
+    finally:
+        model.close()
+
+
+def test_session_reuse_and_failover_ready(family_swarm):
+    """Multi-call chat sessions (token-skip resume) work for the new families."""
+    name, path, harness = family_swarm
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers
+    )
+    try:
+        rng = np.random.RandomState(1)
+        input_ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+        expected = _hf_greedy(path, input_ids, 6)
+        with model.remote.inference_session(max_length=24, batch_size=1) as session:
+            first = model.generate(input_ids, max_new_tokens=3, session=session)
+            final = model.generate(first, max_new_tokens=3, session=session)
+        np.testing.assert_array_equal(final, expected, err_msg=f"{name} session diverged")
+    finally:
+        model.close()

@@ -157,3 +157,53 @@ def make_tiny_mixtral(tmpdir: str, *, n_layers: int = 2, vocab: int = 128) -> st
     path = os.path.join(tmpdir, "tiny-mixtral")
     model.save_pretrained(path, safe_serialization=True)
     return path
+
+
+def make_tiny_qwen2(tmpdir: str, *, n_layers: int = 4, vocab: int = 128, tied: bool = True) -> str:
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    cfg = Qwen2Config(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=n_layers,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        use_sliding_window=False,
+        tie_word_embeddings=tied,  # the 0.5B/1.5B checkpoints tie
+    )
+    torch.manual_seed(5)
+    model = Qwen2ForCausalLM(cfg).eval()
+    with torch.no_grad():  # default bias init is zeros, which would hide bugs
+        for name, p in model.named_parameters():
+            if name.endswith(".bias"):
+                p.normal_(0, 0.1)
+    path = os.path.join(tmpdir, "tiny-qwen2")
+    model.save_pretrained(path, safe_serialization=True)
+    return path
+
+
+def make_tiny_mistral(tmpdir: str, *, n_layers: int = 4, vocab: int = 128, window: int = 6) -> str:
+    from transformers import MistralConfig, MistralForCausalLM
+
+    cfg = MistralConfig(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=n_layers,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        sliding_window=window,  # small so tests actually cross the window edge
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(6)
+    model = MistralForCausalLM(cfg).eval()
+    path = os.path.join(tmpdir, "tiny-mistral")
+    model.save_pretrained(path, safe_serialization=True)
+    return path
