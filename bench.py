@@ -105,7 +105,7 @@ def random_params(cfg, n_blocks, dtype, quant=None):
     per_block = []
     for b in range(n_blocks):
         key, sub = jax.random.split(key)
-        block = convert_block_params(init(sub), "llama", quant)
+        block = convert_block_params(init(sub), "llama", quant, fuse=True)
         hard_sync(block)  # bound the dense-block transient
         per_block.append(block)
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)

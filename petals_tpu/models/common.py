@@ -50,11 +50,13 @@ def mm(x: jnp.ndarray, w) -> jnp.ndarray:
     from petals_tpu.ops.quant import QuantizedLinear, quant_matmul
     from petals_tpu.utils.peft import LoraLinear
 
+    from petals_tpu.ops.quant import StackedQuantLinear
+
     if isinstance(w, LoraLinear):
         base = mm(x, w.base)
         delta = (x @ w.lora_a.astype(x.dtype)) @ w.lora_b.astype(x.dtype)
         return base + delta * w.scaling
-    if isinstance(w, QuantizedLinear):
+    if isinstance(w, (QuantizedLinear, StackedQuantLinear)):
         return quant_matmul(x, w)
     return x @ w
 

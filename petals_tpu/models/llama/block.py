@@ -40,13 +40,21 @@ def block_apply(
     residual = hidden_states
     x = rms_norm(hidden_states, params["ln1"], cfg.rms_norm_eps)
 
-    q = mm(x, params["wq"])
-    k = mm(x, params["wk"])
-    v = mm(x, params["wv"])
-    if cfg.attention_bias or cfg.qkv_bias:
-        q = q + params["bq"]
-        k = k + params["bk"]
-        v = v + params["bv"]
+    if "wqkv" in params:  # fused quantized serving (convert_block.py _FUSE_GROUPS)
+        qkv = mm(x, params["wqkv"])
+        if cfg.attention_bias or cfg.qkv_bias:
+            qkv = qkv + params["bqkv"]
+        q = qkv[..., : hq * d]
+        k = qkv[..., hq * d : (hq + hkv) * d]
+        v = qkv[..., (hq + hkv) * d :]
+    else:
+        q = mm(x, params["wq"])
+        k = mm(x, params["wk"])
+        v = mm(x, params["wv"])
+        if cfg.attention_bias or cfg.qkv_bias:
+            q = q + params["bq"]
+            k = k + params["bk"]
+            v = v + params["bv"]
     q = q.reshape(batch, seq, hq, d)
     k = k.reshape(batch, seq, hkv, d)
     v = v.reshape(batch, seq, hkv, d)
@@ -70,11 +78,18 @@ def block_apply(
 
     residual = hidden_states
     x = rms_norm(hidden_states, params["ln2"], cfg.rms_norm_eps)
-    gate = mm(x, params["wg"])
-    up = mm(x, params["wu"])
-    if cfg.mlp_bias:
-        gate = gate + params["bg"]
-        up = up + params["bu"]
+    if "wgu" in params:  # fused quantized serving
+        gu = mm(x, params["wgu"])
+        if cfg.mlp_bias:
+            gu = gu + params["bgu"]
+        gate = gu[..., : cfg.intermediate_size]
+        up = gu[..., cfg.intermediate_size :]
+    else:
+        gate = mm(x, params["wg"])
+        up = mm(x, params["wu"])
+        if cfg.mlp_bias:
+            gate = gate + params["bg"]
+            up = up + params["bu"]
     mlp = mm(silu(gate) * up, params["wd"])
     if cfg.mlp_bias:
         mlp = mlp + params["bd"]

@@ -189,6 +189,29 @@ def dequantize(q: QuantizedLinear, dtype=jnp.bfloat16) -> jnp.ndarray:
 def quant_matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     """x @ w where w is dense or QuantizedLinear. Differentiable wrt x (weights
     are frozen server-side, like the reference's quantized blocks)."""
+    if isinstance(w, StackedQuantLinear):
+        # inference-only fast path (backend scan consts + traced block index);
+        # the 4-bit kinds DMA straight from the stacked bytes, int8 (and any
+        # shape the kernel can't tile) falls back to slice + XLA dequant
+        lead = x.shape[:-1]
+        x2d = x.reshape(-1, w.in_features)
+        if (
+            w.kind in ("nf4", "int4")
+            and not _FORCE_XLA_PATH.get()
+            and jax.default_backend() == "tpu"
+            and _nf4_pallas_supported(x2d, w.data[0])
+        ):
+            out = packed4_matmul_pallas_stacked(x2d, w)
+        else:
+            sliced = QuantizedLinear(
+                w.kind,
+                jax.lax.dynamic_index_in_dim(w.data, w.index, keepdims=False),
+                jax.lax.dynamic_index_in_dim(w.scales, w.index, keepdims=False),
+                w.in_features,
+                w.out_features,
+            )
+            out = (x2d.astype(jnp.bfloat16) @ dequantize(sliced, jnp.bfloat16)).astype(x.dtype)
+        return out.reshape(*lead, w.out_features).astype(x.dtype)
     if not isinstance(w, QuantizedLinear):
         return x @ w
     if w.kind in ("nf4", "int4"):
@@ -487,6 +510,90 @@ def packed4_matmul_pallas(x: jnp.ndarray, w: QuantizedLinear, *, interpret: bool
 
 # back-compat name from before int4 shared the kernel
 nf4_matmul_pallas = packed4_matmul_pallas
+
+
+@dataclasses.dataclass
+class StackedQuantLinear:
+    """A traced view of block ``index`` inside a SPAN-STACKED quantized weight
+    ([n_blocks, in//2, out] data). Produced inside the backend's scan body so
+    the Pallas kernel DMAs its tiles straight out of the stacked array —
+    carrying the leaves as scan xs would materialize a per-iteration slice of
+    the packed bytes in XLA-land, which runs at ~1/10 of kernel DMA rate for
+    uint8 and dominated quantized decode. NOT a pytree: it exists only inside
+    a trace (data/scales are scan consts, index is the loop counter)."""
+
+    kind: str
+    data: jnp.ndarray  # [n_blocks, in//2, out] uint8 | [n_blocks, in, out] int8
+    scales: jnp.ndarray
+    index: jnp.ndarray  # int32 scalar (traced)
+    in_features: int
+    out_features: int
+
+
+def _packed4_kernel_stacked(
+    idx_ref, xe_ref, xo_ref, packed_ref, scales_ref, table_ref, o_ref, acc_ref,
+    *, n_k: int, dot_in_f32: bool = False
+):
+    """Same compute as _packed4_kernel; operands carry a leading block axis
+    selected by the prefetched ``idx_ref`` in the BlockSpec index maps."""
+    _packed4_kernel(
+        xe_ref, xo_ref, packed_ref.at[0], scales_ref.at[0], table_ref, o_ref, acc_ref,
+        n_k=n_k, dot_in_f32=dot_in_f32,
+    )
+
+
+def packed4_matmul_pallas_stacked(
+    x: jnp.ndarray, w: StackedQuantLinear, *, interpret: bool | None = None
+):
+    """x: [M, in] -> [M, out] against block ``w.index`` of the stacked weight,
+    with the 4-bit tiles DMA'd directly from the stacked array (no XLA-side
+    slice materialization)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    m, n_in = x.shape
+    n_stored = w.data.shape[-2] * 2
+    n_out = w.out_features
+    if n_stored != n_in:
+        x = jnp.pad(x, ((0, 0), (0, n_stored - n_in)))
+    tn = _TN if n_out % _TN == 0 else _TN_MIN
+    n_k, n_n = n_stored // _TK, n_out // tn
+    tm = min(_TM, _round_up(m, 8))
+    m_pad = (-m) % tm
+    if m_pad:
+        x = jnp.pad(x, ((0, m_pad), (0, 0)))
+    mp = x.shape[0]
+    n_m = mp // tm
+
+    xb = x.astype(jnp.bfloat16)
+    xe, xo = xb[:, 0::2], xb[:, 1::2]
+    hk = _TK // 2
+    idx = jnp.asarray(w.index, jnp.int32).reshape(1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_m, n_n, n_k),
+        in_specs=[
+            pl.BlockSpec((tm, hk), lambda mi, n, k, idx_ref: (mi, k)),
+            pl.BlockSpec((tm, hk), lambda mi, n, k, idx_ref: (mi, k)),
+            pl.BlockSpec((1, hk, tn), lambda mi, n, k, idx_ref: (idx_ref[0], k, n)),
+            pl.BlockSpec(
+                (1, _TK // NF4_BLOCK, tn), lambda mi, n, k, idx_ref: (idx_ref[0], k, n)
+            ),
+            pl.BlockSpec((8, 128), lambda mi, n, k, idx_ref: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tm, tn), lambda mi, n, k, idx_ref: (mi, n)),
+        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_packed4_kernel_stacked, n_k=n_k, dot_in_f32=interpret),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((mp, n_out), x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(idx, xe, xo, w.data, w.scales, _decode_table(w.kind))
+    return out[:m] if m_pad else out
 
 
 def _round_up(x: int, m: int) -> int:

@@ -174,6 +174,30 @@ class TransformerBackend:
     def _inference_step_fn(self):
         family, cfg, use_flash = self.family, self.cfg, self.use_flash
         tp_mesh = self.mesh
+        from petals_tpu.ops.quant import QuantizedLinear, StackedQuantLinear
+
+        # Quantized leaves must NOT ride the scan xs: XLA materializes each
+        # iteration's slice of the packed uint8 bytes at a fraction of kernel
+        # DMA rate, which dominated quantized decode. Instead they stay whole
+        # as scan CONSTS and the body hands block_apply a StackedQuantLinear
+        # view (stacked bytes + the loop counter); the Pallas kernel then
+        # DMAs its tiles straight out of the stacked array. Off under TP —
+        # that path traces the XLA dequant matmul, which fuses its slices.
+        def split_quant(params):
+            # only span-stacked 2-D weights ([n_blocks, in//2, out]) take the
+            # consts path; mixtral's stacked EXPERT leaves are 4-D and their
+            # block code slices experts itself — leave them in the scan xs
+            is_q = lambda x: isinstance(x, QuantizedLinear) and x.data.ndim == 3
+            dense = {k: v for k, v in params.items() if not is_q(v)}
+            quant = {k: v for k, v in params.items() if is_q(v)}
+            return dense, quant
+
+        use_quant_consts = tp_mesh is None and any(
+            isinstance(leaf, QuantizedLinear)
+            for leaf in jax.tree_util.tree_leaves(
+                self.params, is_leaf=lambda x: isinstance(x, QuantizedLinear)
+            )
+        )
 
         @functools.partial(
             jax.jit,
@@ -196,8 +220,24 @@ class TransformerBackend:
                 pos_in_chunk = position + jnp.arange(seq, dtype=jnp.int32)
                 prompt_mask = (pos_in_chunk < pre_seq)[None, :, None]
 
+            if use_quant_consts:
+                dense_params, quant_params = split_quant(params)
+                n = k_stack.shape[0]
+                scan_xs_params = dense_params
+                block_indices = jnp.arange(n, dtype=jnp.int32)
+            else:
+                scan_xs_params = params
+                block_indices = jnp.zeros((k_stack.shape[0],), jnp.int32)  # unused
+
             def body(h, xs):
-                p_block, k_block, v_block, prompt = xs
+                p_block, k_block, v_block, prompt, block_idx = xs
+                if use_quant_consts:
+                    p_block = dict(p_block)
+                    for name, q in quant_params.items():
+                        p_block[name] = StackedQuantLinear(
+                            q.kind, q.data, q.scales, block_idx,
+                            q.in_features, q.out_features,
+                        )
                 if with_prompts:
                     seq = h.shape[1]
                     pre = prompt.shape[1]
@@ -213,7 +253,7 @@ class TransformerBackend:
                 return out, (k_new, v_new)
 
             hidden, (k_stack, v_stack) = jax.lax.scan(
-                body, hidden, (params, k_stack, v_stack, prompts)
+                body, hidden, (scan_xs_params, k_stack, v_stack, prompts, block_indices)
             )
             return hidden, k_stack, v_stack
 

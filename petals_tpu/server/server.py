@@ -485,6 +485,9 @@ class Server:
             )
 
     def _load_span_params(self, first_block: int, num_blocks: int):
+        # fused qkv/gate-up halves the Pallas call count at decode; off under
+        # TP (per-leaf PartitionSpecs) and with adapters (unfused leaf names)
+        fuse = (self.num_tp_devices or 1) <= 1 and not self.adapter_paths
         per_block = [
             convert_block_params(
                 load_block_params(
@@ -493,6 +496,7 @@ class Server:
                 ),
                 self.family.name,
                 self.quant_type,
+                fuse=fuse,
             )
             for i in range(first_block, first_block + num_blocks)
         ]

@@ -130,7 +130,10 @@ def measure_compute_rps(
     if str(quant_type) != "none":
         from petals_tpu.utils.convert_block import convert_block_params
 
-        params = convert_block_params(params, family.name, quant_type)
+        # mirror the serving config: fused leaves single-chip, unfused under TP
+        params = convert_block_params(
+            params, family.name, quant_type, fuse=num_devices <= 1
+        )
     stacked = jax.tree_util.tree_map(lambda x: x[None] if hasattr(x, "ndim") else x, params)
 
     mesh = None

@@ -37,12 +37,44 @@ QUANTIZABLE_LEAVES: Dict[str, Set[str]] = {
 }
 
 
-def convert_block_params(params: dict, family_name: str, quant_type: QuantType) -> dict:
-    """Quantize one (unstacked) block's matmul weights in place of dense leaves."""
+# Leaves fused into one matmul each for quantized single-chip serving: every
+# Pallas custom call carries a fixed launch/boundary cost (~0.2 ms measured on
+# v5e through the axon tunnel), so 7 calls/block -> 4 materially speeds up
+# decode. Fusion happens on the DENSE weights before quantization: 4-bit/int8
+# scales are per-output-column, so the fused quantization is bit-identical to
+# quantizing separately. Biases (qwen2) fuse alongside.
+_FUSE_GROUPS: Dict[str, tuple] = {
+    "llama": (
+        ("wqkv", ("wq", "wk", "wv"), "bqkv", ("bq", "bk", "bv")),
+        ("wgu", ("wg", "wu"), "bgu", ("bg", "bu")),
+    ),
+}
+
+
+def convert_block_params(
+    params: dict, family_name: str, quant_type: QuantType, *, fuse: bool = False
+) -> dict:
+    """Quantize one (unstacked) block's matmul weights in place of dense leaves.
+
+    ``fuse=True`` additionally merges qkv / gate+up into single leaves (llama
+    family, which qwen2/mistral share). Callers must keep it off under tensor
+    parallelism (the fused output axis breaks the per-leaf PartitionSpecs) and
+    when hosting LoRA adapters (they target the unfused leaf names).
+    """
     quant_type = QuantType(quant_type)
     if quant_type == QuantType.NONE:
         return params
-    quantizable = QUANTIZABLE_LEAVES.get(family_name, set())
+    if fuse:
+        for fused_w, parts, fused_b, bias_parts in _FUSE_GROUPS.get(family_name, ()):
+            if all(p in params for p in parts):
+                params = dict(params)
+                fused = jnp.concatenate([jnp.asarray(params.pop(p)) for p in parts], axis=1)
+                params[fused_w] = fused
+                if all(b in params for b in bias_parts):
+                    params[fused_b] = jnp.concatenate(
+                        [jnp.asarray(params.pop(b)) for b in bias_parts], axis=0
+                    )
+    quantizable = QUANTIZABLE_LEAVES.get(family_name, set()) | {"wqkv", "wgu"}
     out = {}
     for name, leaf in params.items():
         ndim = getattr(leaf, "ndim", 0)

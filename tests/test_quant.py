@@ -185,3 +185,34 @@ def test_nf4_autotune_noop_off_tpu():
 
     # on CPU the autotune must not run (keeps the default) and must not crash
     assert quant.maybe_autotune_nf4_decode(128) == quant._NF4_DECODE_USE_PALLAS
+
+
+@pytest.mark.parametrize("quant", ["nf4", "int4", "int8"])
+def test_fused_block_matches_unfused(quant):
+    """convert_block_params(fuse=True) merges qkv / gate+up into single leaves;
+    scales are per-output-column, so the fused block must match the unfused one
+    bit-for-bit (same codes, same scales, just concatenated columns)."""
+    import jax.numpy as jnp
+
+    from petals_tpu.models.registry import get_family
+    from petals_tpu.models.llama.config import LlamaBlockConfig
+
+    cfg = LlamaBlockConfig(
+        hidden_size=64, num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=128, num_hidden_layers=1, rms_norm_eps=1e-6, vocab_size=64,
+    )
+    family = get_family("llama")
+    rng = np.random.RandomState(0)
+    shapes = family.block_param_shapes(cfg, jnp.float32)
+    params = {
+        name: jnp.asarray(rng.randn(*sds.shape) * 0.05, jnp.float32)
+        for name, sds in shapes.items()
+    }
+    plain = convert_block_params(dict(params), "llama", quant)
+    fused = convert_block_params(dict(params), "llama", quant, fuse=True)
+    assert "wqkv" in fused and "wgu" in fused and "wq" not in fused
+
+    hidden = jnp.asarray(rng.randn(1, 5, cfg.hidden_size) * 0.1, jnp.float32)
+    out_plain, _ = family.block_apply(plain, hidden, None, 0, cfg)
+    out_fused, _ = family.block_apply(fused, hidden, None, 0, cfg)
+    np.testing.assert_array_equal(np.asarray(out_plain), np.asarray(out_fused))
