@@ -15,11 +15,16 @@ formats and kernels are implemented here:
 - INT4 (beyond reference): same packing/blocking as NF4 but with an AFFINE
   code map, value = (code - 8) * scale. Slightly worse quantization error
   than NF4 (uniform vs normal-float levels); kept as a serving option.
-- ``packed4_matmul_pallas``: fused kernel for both 4-bit kinds — packed tiles
-  stream into VMEM, codes decode via the VPU's native 2-D lane gather into a
-  16-entry table (one op per element; both code maps ride the same gather),
-  dequantized tiles feed the MXU in bf16; the bf16 weight matrix is never
-  materialized in HBM. See _packed4_kernel for the decode design notes.
+- ``packed4_matmul_pallas``: fused kernels for both 4-bit kinds — packed tiles
+  stream into VMEM and the bf16 weight matrix is never materialized in HBM.
+  Two kernels share a driver (_packed4_call): a big-dot PREFILL kernel that
+  dequantizes whole tiles (NF4 via the VPU's 2-D lane gather into the 16-entry
+  table, INT4 arithmetically), and a blockwise DECODE kernel (M <= 32) that
+  dots x against the raw code planes per 64-row quant block and applies scales
+  to the partial sums — for INT4 this removes all per-element decode work
+  (the affine offset becomes one extra small dot), which is what makes 4-bit
+  decode weight-bandwidth-bound instead of VPU-bound. See _packed4_kernel /
+  _packed4_decode_kernel for the measured design notes.
 
 ``QuantizedLinear`` is a pytree node, so quantized span params stack/scan/jit
 exactly like dense ones.
@@ -40,10 +45,22 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NF4_BLOCK = 64
-_TK = 1024  # Pallas input-axis k-tile (packed rows: 512; 16 absmax blocks)
-_TN = 512  # Pallas output-axis tile (halved when out_features % 512 != 0)
-_TN_MIN = 256  # fallback output tile; also the supported-shape divisibility bar
+_TK = 1024  # Pallas input-axis pad unit / fallback k-tile (packed rows: 512)
+_TK_WIDE = 2048  # preferred k-tile: measured 807 GB/s decode-free vs 475 at 1024
+_TN_OPTS = (1024, 512, 256)  # output-axis tile: widest divisor wins
+_TN_MIN = 256  # the supported-shape divisibility bar
 _TM = 512  # Pallas token-axis tile (bounds VMEM for long prefills)
+
+
+def _pick_tiles(n_stored: int, n_out: int) -> Tuple[int, int]:
+    tk = _TK_WIDE if n_stored % _TK_WIDE == 0 else _TK
+    tn = next((t for t in _TN_OPTS if n_out % t == 0), None)
+    if tn is None:
+        raise ValueError(
+            f"out_features {n_out} must be divisible by {_TN_MIN} for the "
+            f"packed-4-bit Pallas kernel (callers gate on _nf4_pallas_supported)"
+        )
+    return tk, tn
 
 # QLoRA NormalFloat4 codebook (ascending)
 NF4_CODE = np.array(
@@ -390,23 +407,44 @@ _int4_mm = _make_q4_mm("int4")
 
 
 
+def _extract_codes(packed):
+    """packed uint8 [half, tn] -> (lo, hi) int32 code planes.
+
+    Widen to int32 first: Mosaic has no 8-bit shift ops (arith.shrui on i8).
+    Rows 0,2,4,... of the logical TK tile are the lo nibbles, 1,3,5,... the hi.
+    """
+    p = packed.astype(jnp.int32)
+    return p & 0x0F, (p >> 4) & 0x0F
+
+
+def _gather_decode(codes, table_ref):
+    """codes [half, tn] -> f32 table values via the VPU's 2-D lane gather
+    (take_along_axis on a [rows, 128] table broadcast) — ONE op per element
+    instead of a 15-step compare+select chain over the irregular NF4 codebook.
+    The gather dimension must fit one vreg, hence the [rows, 128] view."""
+    half, tn = codes.shape
+    rows = half * tn // 128
+    tbl = jnp.broadcast_to(table_ref[0:1, :], (rows, 128))
+    return jnp.take_along_axis(tbl, codes.reshape(rows, 128), axis=1).reshape(half, tn)
+
+
 def _packed4_kernel(
     xe_ref, xo_ref, packed_ref, scales_ref, table_ref, o_ref, acc_ref,
-    *, n_k: int, dot_in_f32: bool = False
+    *, n_k: int, kind: str = "nf4", dot_in_f32: bool = False
 ):
-    """Grid (m, n, k): accumulate x_tile @ dequant(w_tile) into acc.
+    """Grid (m, n, k) PREFILL kernel: accumulate x_tile @ dequant(w_tile).
 
-    Decode design (why this is ~10x the naive kernel at decode shapes):
-    - codes -> values via the VPU's native 2-D lane gather (take_along_axis on
-      a [rows, 128] table broadcast), ONE op per element, instead of a 15-step
-      compare+select chain over the irregular NF4 codebook. int4's affine map
-      rides the same gather with an affine table — one code path for both.
     - x arrives pre-split into even/odd input rows (xe/xo, split OUTSIDE the
       kernel where XLA handles the stride-2 slice), so the two decoded halves
       feed two MXU dots directly — no [half, 2, TN] -> [TK, TN] sublane
       interleave relayout, which Mosaic lowers slowly.
+    - nf4 decodes via table gather; int4's affine map is pure arithmetic
+      (code - 8), which skips the gather entirely.
     - dots run on bf16 inputs with f32 accumulation, mirroring the XLA
       fallback's numerics (x.astype(bf16) @ dequantize(w, bf16)).
+
+    At decode shapes (M<=32) the blockwise _packed4_decode_kernel below is
+    used instead: per-element scale work there is the bandwidth killer.
     """
     k = pl.program_id(2)
 
@@ -414,17 +452,13 @@ def _packed4_kernel(
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # widen to int32 first: Mosaic has no 8-bit shift ops (arith.shrui on i8)
-    packed = packed_ref[...].astype(jnp.int32)  # [TK//2, TN]
-    lo = packed & 0x0F  # rows 0,2,4,... of the logical TK tile
-    hi = (packed >> 4) & 0x0F  # rows 1,3,5,...
-    half, tn = lo.shape
-    rows = half * tn // 128
-    tbl = jnp.broadcast_to(table_ref[0:1, :], (rows, 128))
-
-    def decode(codes):
-        # gather dimension must fit one vreg: view the tile as [rows, 128]
-        return jnp.take_along_axis(tbl, codes.reshape(rows, 128), axis=1).reshape(half, tn)
+    lo, hi = _extract_codes(packed_ref[...])
+    if kind == "int4":
+        d_lo_raw = (lo - 8).astype(jnp.float32)
+        d_hi_raw = (hi - 8).astype(jnp.float32)
+    else:
+        d_lo_raw = _gather_decode(lo, table_ref)
+        d_hi_raw = _gather_decode(hi, table_ref)
 
     # blockwise absmax for even/odd rows: interleaved rows 2i, 2i+1 share
     # block (2i)//NF4_BLOCK == i // (NF4_BLOCK//2)
@@ -435,14 +469,96 @@ def _packed4_kernel(
         xe, xo = xe.astype(jnp.float32), xo.astype(jnp.float32)
     # value rounding matches the XLA fallback (dequantize(w, bf16)) either way
     dot_dtype = jnp.float32 if dot_in_f32 else xe.dtype
-    d_lo = (decode(lo) * scales).astype(jnp.bfloat16).astype(dot_dtype)
-    d_hi = (decode(hi) * scales).astype(jnp.bfloat16).astype(dot_dtype)
+    d_lo = (d_lo_raw * scales).astype(jnp.bfloat16).astype(dot_dtype)
+    d_hi = (d_hi_raw * scales).astype(jnp.bfloat16).astype(dot_dtype)
     acc_ref[...] += jax.lax.dot_general(
         xe, d_lo, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
     acc_ref[...] += jax.lax.dot_general(
         xo, d_hi, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
+
+    @pl.when(k == n_k - 1)
+    def _store():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def _packed4_decode_kernel(
+    *refs, n_k: int, kind: str, dot_in_f32: bool = False
+):
+    """Grid (m, n, k) DECODE kernel (M <= 32): blockwise-scale decomposition.
+
+    int4 takes an extra leading ``xs`` operand (per-quant-block x sums for the
+    affine-offset correction dot); nf4 has no use for it, so its operand list
+    starts at ``xe`` — no dead zeros array rides the DMA on the nf4 path.
+
+    Decode at M=1 is pure weight streaming, and the round-3 on-chip ablation
+    (benchmarks/ablate_quant_kernel*.py) showed the old big-tile decode was
+    VPU-bound at ~12% of HBM bandwidth: per-element scale repeat/multiply/cast
+    plus (for nf4) the table gather cost ~8x the DMA itself. This kernel
+    restructures the math so per-element work is minimal:
+
+        out[m, n] = sum_b s[b, n] * (x_b . c_b)[m, n]  (- 8 * (X @ s)[m, n])
+
+    - per 64-row quant block b: a small [tm, 32] @ [32, tn] MXU dot of x
+      against the RAW codes (even/odd planes), so the only per-element ops are
+      widen/mask/shift/cast (int4) plus the gather (nf4 — irreducible there).
+    - scales multiply the per-block PARTIAL SUMS [tm, tn] — 64x fewer elements
+      than scaling the decoded weights.
+    - int4's affine offset is exact algebra: subtract 8 * (per-block x sums @
+      scales), ONE extra [tm, nb] @ [nb, tn] dot per tile. xs is precomputed
+      outside the kernel (it is n-independent).
+
+    Measured (interleaved, v5e): int4 539 GB/s (66% HBM) vs 95 GB/s before;
+    nf4 ~110 GB/s (gather-bound; the 16-entry table cannot ride anything
+    cheaper than take_along_axis on this VPU).
+    """
+    if kind == "int4":
+        xs_ref, xe_ref, xo_ref, packed_ref, scales_ref, table_ref, o_ref, acc_ref = refs
+    else:
+        xe_ref, xo_ref, packed_ref, scales_ref, table_ref, o_ref, acc_ref = refs
+        xs_ref = None
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    half, tn = packed_ref.shape
+    hb = NF4_BLOCK // 2  # half-rows (even/odd pairs) per quant block
+    nb = half // hb
+
+    lo, hi = _extract_codes(packed_ref[...])
+    dot_dtype = jnp.float32 if dot_in_f32 else jnp.bfloat16
+    if kind == "int4":
+        c_lo = lo.astype(dot_dtype)
+        c_hi = hi.astype(dot_dtype)
+    else:
+        c_lo = _gather_decode(lo, table_ref).astype(jnp.bfloat16).astype(dot_dtype)
+        c_hi = _gather_decode(hi, table_ref).astype(jnp.bfloat16).astype(dot_dtype)
+
+    xe = xe_ref[...]
+    xo = xo_ref[...]
+    if dot_in_f32:
+        xe, xo = xe.astype(jnp.float32), xo.astype(jnp.float32)
+    scales = scales_ref[...].astype(jnp.float32)  # [nb, tn]
+    acc = acc_ref[...]
+    for b in range(nb):
+        p = jax.lax.dot_general(
+            xe[:, b * hb:(b + 1) * hb], c_lo[b * hb:(b + 1) * hb, :],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        p += jax.lax.dot_general(
+            xo[:, b * hb:(b + 1) * hb], c_hi[b * hb:(b + 1) * hb, :],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        acc += p * scales[b:b + 1, :]
+    if kind == "int4":
+        xs = xs_ref[...].astype(jnp.float32)  # [nb, tm] per-block x sums
+        acc -= 8.0 * jax.lax.dot_general(
+            xs, scales, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+    acc_ref[...] = acc
 
     @pl.when(k == n_k - 1)
     def _store():
@@ -461,20 +577,24 @@ def _decode_table(kind: str) -> jnp.ndarray:
     return jnp.asarray(table)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def packed4_matmul_pallas(x: jnp.ndarray, w: QuantizedLinear, *, interpret: bool | None = None):
-    """x: [M, in] -> [M, out] with fused 4-bit (nf4 | int4) dequantization."""
+def _packed4_call(x, kind, data, scales, *, index=None, interpret=None):
+    """Shared driver for single ([in//2, out]) and stacked ([n_blocks, in//2,
+    out] + traced block index) packed-4-bit matmuls. Picks the decode kernel
+    (blockwise scales, gather-free for int4) at M <= _NF4_DECODE_MAX_M and the
+    big-dot prefill kernel otherwise; tiles via _pick_tiles."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    stacked = data.ndim == 3
     m, n_in = x.shape
-    n_stored = w.data.shape[-2] * 2
-    n_out = w.out_features
+    n_stored = data.shape[-2] * 2
+    n_out = data.shape[-1]
     if n_stored != n_in:  # stored padding rows are exact zeros; pad x to match
         x = jnp.pad(x, ((0, 0), (0, n_stored - n_in)))
-    tn = _TN if n_out % _TN == 0 else _TN_MIN
-    n_k, n_n = n_stored // _TK, n_out // tn
+    tk, tn = _pick_tiles(n_stored, n_out)
+    n_k, n_n = n_stored // tk, n_out // tn
+    decode_path = m <= _NF4_DECODE_MAX_M
     # tile the token axis too: a prefill-sized M must not sit whole in VMEM
-    tm = min(_TM, _round_up(m, 8))
+    tm = _round_up(m, 8) if decode_path else min(_TM, _round_up(m, 8))
     m_pad = (-m) % tm
     if m_pad:
         x = jnp.pad(x, ((0, m_pad), (0, 0)))
@@ -485,27 +605,86 @@ def packed4_matmul_pallas(x: jnp.ndarray, w: QuantizedLinear, *, interpret: bool
     # split even/odd input rows here, where XLA lowers the stride-2 slice well
     xb = x.astype(jnp.bfloat16)
     xe, xo = xb[:, 0::2], xb[:, 1::2]
-    hk = _TK // 2
+    hk = tk // 2
 
-    out = pl.pallas_call(
-        functools.partial(_packed4_kernel, n_k=n_k, dot_in_f32=interpret),
-        grid=(n_m, n_n, n_k),
-        in_specs=[
-            pl.BlockSpec((tm, hk), lambda mi, n, k: (mi, k)),
-            pl.BlockSpec((tm, hk), lambda mi, n, k: (mi, k)),
-            pl.BlockSpec((hk, tn), lambda mi, n, k: (k, n)),
-            pl.BlockSpec((_TK // NF4_BLOCK, tn), lambda mi, n, k: (k, n)),
-            pl.BlockSpec((8, 128), lambda mi, n, k: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((tm, tn), lambda mi, n, k: (mi, n)),
+    if stacked:
+        # weight operands carry a leading block axis selected by the
+        # prefetched scalar index; activation/table specs ignore it
+        def wspec(shape, imap):
+            return pl.BlockSpec(
+                (1, *shape), lambda mi, n, k, idx_ref, _f=imap: (idx_ref[0], *_f(mi, n, k))
+            )
+
+        def aspec(shape, imap):
+            return pl.BlockSpec(shape, lambda mi, n, k, idx_ref, _f=imap: _f(mi, n, k))
+    else:
+        def wspec(shape, imap):
+            return pl.BlockSpec(shape, lambda mi, n, k, _f=imap: _f(mi, n, k))
+
+        aspec = wspec
+
+    x_specs = [
+        aspec((tm, hk), lambda mi, n, k: (mi, k)),
+        aspec((tm, hk), lambda mi, n, k: (mi, k)),
+    ]
+    w_specs = [
+        wspec((hk, tn), lambda mi, n, k: (k, n)),
+        wspec((tk // NF4_BLOCK, tn), lambda mi, n, k: (k, n)),
+    ]
+    tbl_spec = aspec((8, 128), lambda mi, n, k: (0, 0))
+    out_spec = aspec((tm, tn), lambda mi, n, k: (mi, n))
+
+    if decode_path:
+        if kind == "int4":
+            # per-quant-block sums of x for the affine correction dot
+            nb_total = n_stored // NF4_BLOCK
+            xs = xb.astype(jnp.float32).reshape(mp, nb_total, NF4_BLOCK).sum(axis=2).T
+            in_specs = [aspec((tk // NF4_BLOCK, tm), lambda mi, n, k: (k, mi))]
+            operands = (xs,)
+        else:
+            in_specs, operands = [], ()
+        in_specs += x_specs + w_specs + [tbl_spec]
+        operands += (xe, xo, data, scales, _decode_table(kind))
+        body = _packed4_decode_kernel_stacked if stacked else _packed4_decode_kernel
+    else:
+        in_specs = x_specs + w_specs + [tbl_spec]
+        operands = (xe, xo, data, scales, _decode_table(kind))
+        body = _packed4_kernel_stacked if stacked else _packed4_kernel
+
+    kernel = functools.partial(body, n_k=n_k, kind=kind, dot_in_f32=interpret)
+    common = dict(
         out_shape=jax.ShapeDtypeStruct((mp, n_out), x.dtype),
-        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(xe, xo, w.data, w.scales, _decode_table(w.kind))
+    )
+    if stacked:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_m, n_n, n_k),
+            in_specs=in_specs,
+            out_specs=out_spec,
+            scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        )
+        idx = jnp.asarray(index, jnp.int32).reshape(1)
+        out = pl.pallas_call(kernel, grid_spec=grid_spec, **common)(idx, *operands)
+    else:
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_m, n_n, n_k),
+            in_specs=in_specs,
+            out_specs=out_spec,
+            scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+            **common,
+        )(*operands)
     return out[:m] if m_pad else out
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def packed4_matmul_pallas(x: jnp.ndarray, w: QuantizedLinear, *, interpret: bool | None = None):
+    """x: [M, in] -> [M, out] with fused 4-bit (nf4 | int4) dequantization."""
+    return _packed4_call(x, w.kind, w.data, w.scales, interpret=interpret)
 
 
 # back-compat name from before int4 shared the kernel
@@ -532,13 +711,25 @@ class StackedQuantLinear:
 
 def _packed4_kernel_stacked(
     idx_ref, xe_ref, xo_ref, packed_ref, scales_ref, table_ref, o_ref, acc_ref,
-    *, n_k: int, dot_in_f32: bool = False
+    *, n_k: int, kind: str = "nf4", dot_in_f32: bool = False
 ):
-    """Same compute as _packed4_kernel; operands carry a leading block axis
-    selected by the prefetched ``idx_ref`` in the BlockSpec index maps."""
+    """Same compute as _packed4_kernel; weight operands carry a leading block
+    axis selected by the prefetched ``idx_ref`` in the BlockSpec index maps."""
     _packed4_kernel(
         xe_ref, xo_ref, packed_ref.at[0], scales_ref.at[0], table_ref, o_ref, acc_ref,
-        n_k=n_k, dot_in_f32=dot_in_f32,
+        n_k=n_k, kind=kind, dot_in_f32=dot_in_f32,
+    )
+
+
+def _packed4_decode_kernel_stacked(
+    idx_ref, *refs, n_k: int, kind: str, dot_in_f32: bool = False
+):
+    """Same compute as _packed4_decode_kernel over stacked weight operands
+    (packed/scales carry a leading block axis selected by ``idx_ref``)."""
+    head, (packed_ref, scales_ref), tail = refs[:-5], refs[-5:-3], refs[-3:]
+    _packed4_decode_kernel(
+        *head, packed_ref.at[0], scales_ref.at[0], *tail,
+        n_k=n_k, kind=kind, dot_in_f32=dot_in_f32,
     )
 
 
@@ -548,52 +739,9 @@ def packed4_matmul_pallas_stacked(
     """x: [M, in] -> [M, out] against block ``w.index`` of the stacked weight,
     with the 4-bit tiles DMA'd directly from the stacked array (no XLA-side
     slice materialization)."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    m, n_in = x.shape
-    n_stored = w.data.shape[-2] * 2
-    n_out = w.out_features
-    if n_stored != n_in:
-        x = jnp.pad(x, ((0, 0), (0, n_stored - n_in)))
-    tn = _TN if n_out % _TN == 0 else _TN_MIN
-    n_k, n_n = n_stored // _TK, n_out // tn
-    tm = min(_TM, _round_up(m, 8))
-    m_pad = (-m) % tm
-    if m_pad:
-        x = jnp.pad(x, ((0, m_pad), (0, 0)))
-    mp = x.shape[0]
-    n_m = mp // tm
-
-    xb = x.astype(jnp.bfloat16)
-    xe, xo = xb[:, 0::2], xb[:, 1::2]
-    hk = _TK // 2
-    idx = jnp.asarray(w.index, jnp.int32).reshape(1)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(n_m, n_n, n_k),
-        in_specs=[
-            pl.BlockSpec((tm, hk), lambda mi, n, k, idx_ref: (mi, k)),
-            pl.BlockSpec((tm, hk), lambda mi, n, k, idx_ref: (mi, k)),
-            pl.BlockSpec((1, hk, tn), lambda mi, n, k, idx_ref: (idx_ref[0], k, n)),
-            pl.BlockSpec(
-                (1, _TK // NF4_BLOCK, tn), lambda mi, n, k, idx_ref: (idx_ref[0], k, n)
-            ),
-            pl.BlockSpec((8, 128), lambda mi, n, k, idx_ref: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((tm, tn), lambda mi, n, k, idx_ref: (mi, n)),
-        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+    return _packed4_call(
+        x, w.kind, w.data, w.scales, index=w.index, interpret=interpret
     )
-    out = pl.pallas_call(
-        functools.partial(_packed4_kernel_stacked, n_k=n_k, dot_in_f32=interpret),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((mp, n_out), x.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-        interpret=interpret,
-    )(idx, xe, xo, w.data, w.scales, _decode_table(w.kind))
-    return out[:m] if m_pad else out
 
 
 def _round_up(x: int, m: int) -> int:

@@ -79,6 +79,30 @@ def test_packed4_pallas_matches_xla(quantizer):
     np.testing.assert_allclose(got, expected, atol=2e-2, rtol=1e-2)
 
 
+@pytest.mark.parametrize("quantizer", [quantize_nf4, quantize_int4])
+@pytest.mark.parametrize("m", [1, 40])  # decode (M<=32) and prefill kernels
+def test_packed4_pallas_stacked_matches_xla(quantizer, m):
+    from petals_tpu.ops.quant import StackedQuantLinear, packed4_matmul_pallas_stacked
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(m, 512).astype(np.float32)
+    qs = [quantizer((rng.randn(512, 256) * 0.05).astype(np.float32)) for _ in range(3)]
+    data = jnp.stack([q.data for q in qs])
+    scales = jnp.stack([q.scales for q in qs])
+    for idx in (0, 2):
+        sq = StackedQuantLinear(qs[0].kind, data, scales, jnp.int32(idx), 512, 256)
+        expected = x @ np.asarray(dequantize(qs[idx], jnp.float32))
+        got = np.asarray(packed4_matmul_pallas_stacked(jnp.asarray(x), sq))
+        np.testing.assert_allclose(got, expected, atol=2e-2, rtol=1e-2)
+
+
+def test_pick_tiles_rejects_unsupported_out_features():
+    from petals_tpu.ops.quant import _pick_tiles
+
+    with pytest.raises(ValueError, match="divisible"):
+        _pick_tiles(1024, 384)
+
+
 def test_nf4_pallas_alias():
     assert nf4_matmul_pallas is packed4_matmul_pallas  # back-compat name
 
