@@ -174,6 +174,7 @@ def block_param_shapes(cfg: LlamaBlockConfig, dtype=jnp.bfloat16) -> dict:
 FAMILY = register_family(
     ModelFamily(
         name="llama",
+        block_arch="llama",
         config_from_hf=LlamaBlockConfig.from_hf_config,
         block_apply=block_apply,
         hf_block_prefixes=_HF_BLOCK_PREFIXES,

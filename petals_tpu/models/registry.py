@@ -23,6 +23,11 @@ class ModelFamily:
     hf_block_prefixes: tuple  # checkpoint prefixes of block i, with {i} placeholder
     hf_to_block_params: Callable  # (dict[str, np.ndarray], cfg) -> params pytree
     block_param_shapes: Optional[Callable] = None  # cfg -> pytree of jax.ShapeDtypeStruct
+    # Underlying block architecture ("" -> same as name). Derived families
+    # built via dataclasses.replace (qwen2/mistral over llama) inherit it, so
+    # architecture-keyed tables (quantizable leaves, fuse groups in
+    # utils/convert_block.py) resolve without per-alias entries.
+    block_arch: str = ""
     # Client-side (embeddings + final norm + LM head), filled by model.py modules:
     hf_client_prefixes: tuple = ()  # checkpoint prefixes of client-held tensors
     hf_to_client_params: Optional[Callable] = None  # (dict, cfg) -> params pytree

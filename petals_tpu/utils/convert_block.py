@@ -51,6 +51,21 @@ _FUSE_GROUPS: Dict[str, tuple] = {
 }
 
 
+def _block_arch(family_name: str) -> str:
+    """Resolve a family name to the block architecture keying the tables above
+    (qwen2/mistral are llama-architecture blocks registered under their own
+    model_type; quantization must not silently no-op for them)."""
+    if family_name in QUANTIZABLE_LEAVES:
+        return family_name
+    from petals_tpu.models import registry
+
+    try:
+        family = registry.get_family(family_name)
+    except KeyError:
+        return family_name
+    return family.block_arch or family.name
+
+
 def convert_block_params(
     params: dict, family_name: str, quant_type: QuantType, *, fuse: bool = False
 ) -> dict:
@@ -64,8 +79,9 @@ def convert_block_params(
     quant_type = QuantType(quant_type)
     if quant_type == QuantType.NONE:
         return params
+    arch = _block_arch(family_name)
     if fuse:
-        for fused_w, parts, fused_b, bias_parts in _FUSE_GROUPS.get(family_name, ()):
+        for fused_w, parts, fused_b, bias_parts in _FUSE_GROUPS.get(arch, ()):
             if all(p in params for p in parts):
                 params = dict(params)
                 fused = jnp.concatenate([jnp.asarray(params.pop(p)) for p in parts], axis=1)
@@ -74,17 +90,39 @@ def convert_block_params(
                     params[fused_b] = jnp.concatenate(
                         [jnp.asarray(params.pop(b)) for b in bias_parts], axis=0
                     )
-    quantizable = QUANTIZABLE_LEAVES.get(family_name, set()) | {"wqkv", "wgu"}
+    quantizable = QUANTIZABLE_LEAVES.get(arch, set()) | {"wqkv", "wgu"}
     out = {}
+    n_quantized = 0
     for name, leaf in params.items():
         ndim = getattr(leaf, "ndim", 0)
         if name in quantizable and ndim == 2:
             out[name] = quantize(jnp.asarray(leaf), quant_type.value)
+            n_quantized += 1
         elif name in quantizable and ndim == 3:  # expert stacks [E, in, out]
             per_expert = [quantize(jnp.asarray(leaf[e]), quant_type.value) for e in range(leaf.shape[0])]
             out[name] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_expert)
+            n_quantized += 1
         else:
             out[name] = leaf
+    if not n_quantized:
+        # A silent no-op here would serve dense weights while the operator
+        # believes the model is quantized (wrong memory footprint AND
+        # throughput advert) — refuse instead.
+        detail = f"family {family_name!r}" if family_name == arch else (
+            f"family {family_name!r} (block arch {arch!r})"
+        )
+        from petals_tpu.models import registry
+
+        known = registry.known_families()
+        hint = (
+            "QUANTIZABLE_LEAVES needs an entry for this block architecture"
+            if family_name in known
+            else f"family is not registered (known: {list(known)})"
+        )
+        raise ValueError(
+            f"quant_type={quant_type.value!r} requested but no quantizable "
+            f"leaves matched for {detail} (leaves: {sorted(params)}); {hint}"
+        )
     return out
 
 

@@ -4,12 +4,38 @@ families are BEYOND the reference inventory — llama-style blocks with the
 qwen bias convention (q/k/v-only) and the mistral all-layer sliding window.
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from petals_tpu.client.model import AutoDistributedModelForCausalLM
 from tests.test_full_model import SwarmHarness, _hf_greedy
 from tests.utils import make_tiny_mistral, make_tiny_qwen2
+
+
+@pytest.mark.parametrize("maker,name", [(make_tiny_qwen2, "qwen2"), (make_tiny_mistral, "mistral")])
+def test_quantization_applies_to_derived_families(tmp_path, maker, name):
+    """Families registered under their own model_type but sharing the llama
+    block architecture must still quantize: QUANTIZABLE_LEAVES/_FUSE_GROUPS
+    resolve through ModelFamily.block_arch, not the registry name (a silent
+    dense fallback here once shipped as a no-op --quant_type)."""
+    from petals_tpu.ops.quant import QuantizedLinear
+    from petals_tpu.server.from_pretrained import load_block_params
+    from petals_tpu.utils.convert_block import convert_block_params
+
+    path = maker(str(tmp_path))
+    params = load_block_params(path, 0, dtype=jnp.float32)
+    q = convert_block_params(params, name, "nf4", fuse=True)
+    quantized = [k for k, v in q.items() if isinstance(v, QuantizedLinear)]
+    assert "wqkv" in quantized and "wgu" in quantized, quantized
+    assert "wo" in quantized and "wd" in quantized, quantized
+
+
+def test_quantization_refuses_unknown_architecture():
+    from petals_tpu.utils.convert_block import convert_block_params
+
+    with pytest.raises(ValueError, match="no quantizable"):
+        convert_block_params({"w_mystery": jnp.ones((8, 8))}, "not-a-family", "nf4")
 
 
 @pytest.fixture(scope="module", params=["qwen2", "mistral"])
