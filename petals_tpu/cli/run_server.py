@@ -37,6 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=[k for k in DTYPE_MAP if k != "auto"], help="Compute dtype")
     parser.add_argument("--quant_type", default="none", choices=["none", "int8", "nf4", "int4"],
                         help="Weight quantization (ops/quant.py)")
+    parser.add_argument("--no_quant_weight_cache", action="store_true",
+                        help="Re-quantize at every start instead of persisting packed "
+                             "quantized blocks in the disk cache (utils/quant_cache.py)")
     parser.add_argument("--attn_cache_tokens", type=int, default=8192,
                         help="KV-cache budget in tokens (converted to bytes for the allocator)")
     parser.add_argument("--max_chunk_size_bytes", type=int, default=256 * 1024 * 1024,
@@ -173,6 +176,7 @@ def main(argv=None) -> None:
         balance_quality=args.balance_quality,
         revision=args.revision,
         cache_dir=args.cache_dir,
+        quant_weight_cache=not args.no_quant_weight_cache,
     )
 
     async def run():

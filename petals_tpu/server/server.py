@@ -79,6 +79,7 @@ class Server:
         balance_quality: float = 0.75,  # rebalance iff swarm quality < this (block_selection.py)
         revision: str = "main",  # Hub revision for weight streaming (utils/hub.py)
         cache_dir=None,  # Hub download cache (default PETALS_TPU_CACHE)
+        quant_weight_cache: bool = True,  # persist quantized blocks across restarts
     ):
         self.model_path = model_path
         self.revision = revision
@@ -135,6 +136,7 @@ class Server:
                 f"sit idle holding replicated parameters"
             )
         self.quant_type = quant_type
+        self.quant_weight_cache = quant_weight_cache
         self.adapter_paths = list(adapters)
         from petals_tpu.rpc.serialization import CompressionType
 
@@ -489,18 +491,45 @@ class Server:
         # TP (per-leaf PartitionSpecs) and with adapters (unfused leaf names)
         fuse = (self.num_tp_devices or 1) <= 1 and not self.adapter_paths
         per_block = [
-            convert_block_params(
-                load_block_params(
-                    self.model_path, i, dtype=self.compute_dtype, family=self.family,
-                    cfg=self.cfg, revision=self.revision, cache_dir=self.cache_dir,
-                ),
-                self.family.name,
-                self.quant_type,
-                fuse=fuse,
-            )
+            self._load_block_converted(i, fuse=fuse)
             for i in range(first_block, first_block + num_blocks)
         ]
         return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
+
+    def _load_block_converted(self, block_index: int, *, fuse: bool) -> dict:
+        """One block, quantized per --quant_type. Quantized conversions are
+        persisted in the disk cache (utils/quant_cache.py): the encode is a
+        pure function of (checkpoint, kind, fuse), so restarts stream packed
+        bytes instead of re-encoding (reference re-quantizes every start,
+        convert_block.py:76-115 — acceptable on CUDA, minutes at 405B here)."""
+        use_cache = self.quant_weight_cache and QuantType(self.quant_type) != QuantType.NONE
+        if use_cache:
+            from petals_tpu.utils import quant_cache
+
+            path = quant_cache.cache_path(
+                self.model_path, block_index, QuantType(self.quant_type).value,
+                fuse=fuse, revision=self.revision, cache_dir=self.cache_dir,
+                dtype_tag=jnp.dtype(self.compute_dtype).name,
+            )
+            cached = quant_cache.load_quantized_block(path)
+            if cached is not None:
+                return cached
+        params = convert_block_params(
+            load_block_params(
+                self.model_path, block_index, dtype=self.compute_dtype,
+                family=self.family, cfg=self.cfg, revision=self.revision,
+                cache_dir=self.cache_dir,
+            ),
+            self.family.name,
+            self.quant_type,
+            fuse=fuse,
+        )
+        if use_cache:
+            try:
+                quant_cache.save_quantized_block(path, params)
+            except OSError as e:
+                logger.warning(f"Could not cache quantized block {block_index}: {e!r}")
+        return params
 
     def _install_adapters(self, backend: TransformerBackend) -> None:
         if not self.adapter_paths:

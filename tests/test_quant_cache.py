@@ -1,0 +1,149 @@
+"""Quantized-weight disk cache: quantize once, stream packed bytes on restart
+(reference re-quantizes with bitsandbytes at every start, convert_block.py:76-115;
+disk-cache semantics after reference from_pretrained.py:162-213)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from petals_tpu.ops.quant import QuantizedLinear
+from petals_tpu.server.from_pretrained import load_block_params
+from petals_tpu.utils import quant_cache
+from petals_tpu.utils.convert_block import convert_block_params
+from tests.utils import make_tiny_llama
+
+
+def _tree_equal(a: dict, b: dict):
+    assert sorted(a) == sorted(b)
+    for name in a:
+        la, lb = a[name], b[name]
+        if isinstance(la, QuantizedLinear):
+            assert isinstance(lb, QuantizedLinear)
+            assert la.kind == lb.kind
+            assert (la.in_features, la.out_features) == (lb.in_features, lb.out_features)
+            assert la.data.dtype == lb.data.dtype and la.scales.dtype == lb.scales.dtype
+            np.testing.assert_array_equal(np.asarray(la.data), np.asarray(lb.data))
+            np.testing.assert_array_equal(
+                np.asarray(la.scales, np.float32), np.asarray(lb.scales, np.float32)
+            )
+        else:
+            assert la.dtype == lb.dtype, name
+            np.testing.assert_array_equal(
+                np.asarray(la, np.float32), np.asarray(lb, np.float32)
+            )
+
+
+@pytest.mark.parametrize("quant", ["nf4", "int4", "int8"])
+def test_roundtrip_bit_exact(tmp_path, quant):
+    model = make_tiny_llama(str(tmp_path / "model"))
+    params = convert_block_params(
+        load_block_params(model, 0, dtype=jnp.bfloat16), "llama", quant, fuse=True
+    )
+    path = quant_cache.cache_path(
+        model, 0, quant, fuse=True, cache_dir=tmp_path / "cache"
+    )
+    quant_cache.save_quantized_block(path, params)
+    loaded = quant_cache.load_quantized_block(path)
+    assert loaded is not None
+    _tree_equal(params, loaded)
+
+
+def test_miss_and_corruption(tmp_path):
+    path = quant_cache.cache_path("nope", 3, "nf4", fuse=False, cache_dir=tmp_path)
+    assert quant_cache.load_quantized_block(path) is None
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_bytes(b"not an npz")
+    assert quant_cache.load_quantized_block(path) is None
+    assert not path.exists()  # corrupt entries are dropped
+
+
+def test_fingerprint_tracks_checkpoint_changes(tmp_path):
+    model = make_tiny_llama(str(tmp_path / "model"))
+    p1 = quant_cache.cache_path(model, 0, "nf4", fuse=True, cache_dir=tmp_path)
+    p1_again = quant_cache.cache_path(model, 0, "nf4", fuse=True, cache_dir=tmp_path)
+    assert p1 == p1_again
+    # touching a weight file must change the key (stale-cache invalidation)
+    import os
+    import time
+
+    from pathlib import Path
+
+    weight_files = list(Path(model).glob("*.safetensors")) + list(Path(model).glob("*.bin"))
+    assert weight_files, f"no weight files under {model}"
+    for f in weight_files:
+        os.utime(f, (time.time() + 5, time.time() + 5))
+    p2 = quant_cache.cache_path(model, 0, "nf4", fuse=True, cache_dir=tmp_path)
+    assert p1 != p2
+
+
+def test_eviction_budget_and_protection(tmp_path, monkeypatch):
+    """Entries are top-level LRU units: the budget evicts the coldest entries
+    first and never the one being written (hub.py's eviction granularity)."""
+    import os
+    import time
+
+    model = make_tiny_llama(str(tmp_path / "model"))
+    params = convert_block_params(
+        load_block_params(model, 0, dtype=jnp.bfloat16), "llama", "int4", fuse=True
+    )
+    paths = [
+        quant_cache.cache_path(model, i, "int4", fuse=True, cache_dir=tmp_path / "c")
+        for i in range(3)
+    ]
+    quant_cache.save_quantized_block(paths[0], params)
+    entry_bytes = sum(f.stat().st_size for f in paths[0].parent.rglob("*") if f.is_file())
+    quant_cache.save_quantized_block(paths[1], params)
+    # age entry 0 so it ranks as coldest, then save with a budget that only
+    # fits two entries: entry 0 must be evicted, entry 2 (being written) kept
+    old = time.time() - 3600
+    os.utime(paths[0].parent, (old, old))
+    quant_cache.save_quantized_block(paths[2], params, max_disk_space=int(entry_bytes * 2.5))
+    assert not paths[0].exists(), "coldest entry should have been evicted"
+    assert paths[1].exists() and paths[2].exists()
+    # a cache hit refreshes the entry's LRU rank (utime on the unit dir)
+    os.utime(paths[1].parent, (old, old))
+    assert quant_cache.load_quantized_block(paths[1]) is not None
+    assert paths[1].parent.stat().st_atime > old + 1800
+
+
+def test_server_warm_start_uses_cache(tmp_path, monkeypatch):
+    """Second server start must not re-quantize: load_block_params is not
+    called when every block hits the quantized cache."""
+    from petals_tpu.server import server as server_mod
+
+    model = make_tiny_llama(str(tmp_path / "model"))
+    cache = tmp_path / "cache"
+
+    def make(**kw):
+        return server_mod.Server(
+            model, first_block=0, num_blocks=2, quant_type="nf4",
+            cache_dir=cache, throughput=1.0, **kw,
+        )
+
+    s1 = make()
+    stacked_cold = s1._load_span_params(0, 2)
+
+    calls = []
+    orig = server_mod.load_block_params
+
+    def counting(*a, **kw):
+        calls.append(a)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(server_mod, "load_block_params", counting)
+    s2 = make()
+    stacked_warm = s2._load_span_params(0, 2)
+    assert not calls, "warm start re-read the checkpoint instead of the quant cache"
+
+    import jax
+
+    flat_c, _ = jax.tree_util.tree_flatten(stacked_cold)
+    flat_w, _ = jax.tree_util.tree_flatten(stacked_warm)
+    for c, w in zip(flat_c, flat_w):
+        np.testing.assert_array_equal(np.asarray(c, np.float32), np.asarray(w, np.float32))
+
+    # opt-out knob serves the old path
+    calls.clear()
+    s3 = make(quant_weight_cache=False)
+    s3._load_span_params(0, 2)
+    assert calls, "quant_weight_cache=False must bypass the cache"
