@@ -64,10 +64,13 @@ def main():
                 self.printed = len(text)
 
         def end(self):
-            # flush whatever the � guard was still holding back (an
-            # incomplete char at the very end prints minus its broken tail)
+            # flush whatever the � guard was still holding back. Strip at most
+            # ONE trailing � — an incomplete multi-byte tail decodes to exactly
+            # one replacement char, while any further � are genuine undecodable
+            # bytes the tokenizer produced and must stay visible
             text = tokenizer.decode(self.tokens, skip_special_tokens=True)
-            text = text.rstrip("�")
+            if text.endswith("�"):
+                text = text[:-1]
             if len(text) > self.printed:
                 print(text[self.printed:], end="")
             print(flush=True)

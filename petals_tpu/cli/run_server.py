@@ -183,18 +183,32 @@ def main(argv=None) -> None:
         await server.start()
         logger.info(f"Serving; announce address: {server.contact_addr.to_string()}")
         stop = asyncio.Event()
+        force = asyncio.Event()
+
+        def on_signal():
+            # second SIGINT/SIGTERM skips the remaining drain window: an
+            # operator must always be able to force immediate shutdown
+            if stop.is_set():
+                force.set()
+            else:
+                stop.set()
+
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
-            loop.add_signal_handler(sig, stop.set)
+            loop.add_signal_handler(sig, on_signal)
         await stop.wait()
         if args.drain_seconds > 0:
             parked = await server.drain(park_ttl=args.drain_seconds + 30)
             if parked:
                 logger.info(
                     f"Drain window: serving KV exports for {parked} session(s) "
-                    f"for {args.drain_seconds:.0f}s"
+                    f"for {args.drain_seconds:.0f}s (signal again to skip)"
                 )
-                await asyncio.sleep(args.drain_seconds)
+                try:
+                    await asyncio.wait_for(force.wait(), args.drain_seconds)
+                    logger.info("Second signal: skipping the rest of the drain window")
+                except asyncio.TimeoutError:
+                    pass
         logger.info("Shutting down")
         await server.shutdown()
 

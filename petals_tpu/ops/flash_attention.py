@@ -54,6 +54,34 @@ def _block_env(name: str, default: int, multiple: int, pow2_multiple: bool = Fal
 
 DEFAULT_BLOCK_Q = _block_env("PETALS_TPU_FLASH_BLOCK_Q", 512, 8)
 DEFAULT_BLOCK_KV = _block_env("PETALS_TPU_FLASH_BLOCK_KV", 1024, LANES, pow2_multiple=True)
+_TILES_FROM_ENV = (
+    "PETALS_TPU_FLASH_BLOCK_Q" in os.environ or "PETALS_TPU_FLASH_BLOCK_KV" in os.environ
+)
+# v5e VMEM is ~16 MiB/core. The 512x1024 defaults are tuned for head_dim 128 —
+# wider heads grow the k/v tiles and the [block_q, head_dim] accumulators, so
+# the wrapper shrinks the DEFAULT tiles instead of failing Mosaic VMEM
+# allocation (explicit env/arg tile choices are respected as given). The
+# budget is calibrated to the estimator below such that the measured-good
+# 512x1024 tiles at head_dim 128 are EXACTLY preserved (the estimator is a
+# worst-case model, not an exact accounting, hence > 16 MiB).
+_VMEM_TILE_BUDGET = 17 * 2**20
+
+
+def _fit_tiles_to_vmem(block_q: int, block_kv: int, head_dim: int) -> tuple:
+    def est(bq, bkv):
+        # f32 working set: q/o/acc tiles [bq, head_dim] x3, k+v tiles
+        # [bkv, head_dim] x2, s/p/iota tiles [bq, bkv] x3; x2 for Mosaic's
+        # pipelining double-buffer
+        return 4 * 2 * (3 * bq * head_dim + 2 * bkv * head_dim + 3 * bq * bkv)
+
+    # halve block_kv only while the result stays a multiple of LANES (the
+    # lane-aligned s/p tile invariant; halving also preserves divisibility of
+    # kv_buf_len), then shrink block_q
+    while block_kv % (2 * LANES) == 0 and est(block_q, block_kv) > _VMEM_TILE_BUDGET:
+        block_kv //= 2
+    while block_q > 8 and est(block_q, block_kv) > _VMEM_TILE_BUDGET:
+        block_q //= 2
+    return block_q, block_kv
 
 
 def _tile_needed(q_block_start, kv_block_start, block_q, block_kv, kv_length, sliding_window):
@@ -234,10 +262,13 @@ def flash_attend(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
+    explicit_tiles = block_q is not None or block_kv is not None or _TILES_FROM_ENV
     block_q = min(block_q or DEFAULT_BLOCK_Q, _round_up(q_len, 8))
     block_kv = min(block_kv or DEFAULT_BLOCK_KV, kv_buf_len)
     while kv_buf_len % block_kv != 0:  # kv_buf_len is a multiple of 128 (flash_supported)
         block_kv //= 2
+    if not explicit_tiles:
+        block_q, block_kv = _fit_tiles_to_vmem(block_q, block_kv, head_dim)
 
     # Pad q to a multiple of block_q; padded rows are sliced away afterwards.
     q_pad = _round_up(q_len, block_q) - q_len

@@ -112,6 +112,7 @@ class TransformerBackend:
                 maybe_autotune_nf4_decode(cfg.hidden_size)
         # adapter name -> (stacked {leaf: (A, B)}, scaling); see utils/peft.py
         self.adapters: Dict[str, tuple] = {}
+        self._dummy_operands: Dict[tuple, jax.Array] = {}
 
     # ------------------------------------------------------------- cache descriptors
 
@@ -341,7 +342,11 @@ class TransformerBackend:
                 f"allocated cache ({max_length} tokens)"
             )
 
-        hidden = jnp.asarray(hidden, self.compute_dtype)
+        # keep hidden host-side (numpy): each chunk ships inside its step's ONE
+        # jit dispatch (the jit casts to compute dtype); an eager asarray+cast
+        # here cost two extra device round trips per decode token
+        if not isinstance(hidden, jax.Array):
+            hidden = np.ascontiguousarray(hidden)
         span_params = self.params_for(active_adapter)
         outputs = []
         offset = 0
@@ -372,12 +377,19 @@ class TransformerBackend:
 
         with_prompts = prompts is not None
         with_hypo = hypo_ids is not None
+        # dummy prompts/hypo operands: device-resident and cached per shape —
+        # allocating them per step added host->device dispatches on the
+        # per-token path (decode is called hundreds of times per second)
         if prompts is None:
-            prompts_arr = jnp.zeros((self.n_blocks, batch, 0, self.hidden_size), self.compute_dtype)
+            prompts_arr = self._dummy_operand(
+                (self.n_blocks, batch, 0, self.hidden_size), self.compute_dtype
+            )
         else:
             prompts_arr = jnp.asarray(prompts, self.compute_dtype)
         hypo_arr = (
-            jnp.asarray(hypo_ids, jnp.int32) if hypo_ids is not None else jnp.zeros((batch,), jnp.int32)
+            jnp.asarray(hypo_ids, jnp.int32)
+            if hypo_ids is not None
+            else self._dummy_operand((batch,), jnp.int32)
         )
 
         with self._quant_ctx():
@@ -386,8 +398,8 @@ class TransformerBackend:
                 k_stack,
                 v_stack,
                 padded,
-                jnp.asarray(position, jnp.int32),
-                jnp.asarray(n_valid, jnp.int32),
+                np.int32(position),
+                np.int32(n_valid),
                 prompts_arr,
                 hypo_arr,
                 with_prompts=with_prompts,
@@ -397,6 +409,13 @@ class TransformerBackend:
         if out.shape[1] != seq:
             out = out[:, :seq]
         return out, k_stack, v_stack
+
+    def _dummy_operand(self, shape, dtype) -> jax.Array:
+        key = (shape, jnp.dtype(dtype).name)
+        arr = self._dummy_operands.get(key)
+        if arr is None:
+            arr = self._dummy_operands[key] = jnp.zeros(shape, dtype)
+        return arr
 
     def _chunk_plan(self, batch: int, total_seq: int, kv_buf_len: int = None) -> Sequence[int]:
         """Split a long prefill so each chunk's attention footprint stays under

@@ -160,7 +160,7 @@ class TransformerHandler:
             },
         }
 
-    def _install_kv_import(
+    async def _install_kv_import(
         self, step, kv, handles, position, *, batch_size: int, n_blocks: int, max_length: int
     ) -> int:
         """Seed this session's KV buffers from another server's exported cache
@@ -175,22 +175,29 @@ class TransformerHandler:
         tensors = step.get("tensors") or {}
         if "k" not in tensors or "v" not in tensors:
             raise ValueError("kv_import needs k and v tensors")
-        k = deserialize_array(tensors["k"])
-        v = deserialize_array(tensors["v"])
         k_buf, v_buf = kv
         want_shape = (n_blocks, batch_size, new_position, *k_buf.shape[3:])
-        for name, arr in (("k", k), ("v", v)):
+
+        def stage(name, wire, buf):
+            # deserialize + zero-fill + device_put are 100s of MB for long
+            # contexts — run off the event loop (like _snapshot_session's
+            # device->host copy) so other sessions' steps don't stall
+            arr = deserialize_array(wire)
             if tuple(arr.shape) != want_shape:
                 raise ValueError(f"kv_import {name} shape {arr.shape} != {want_shape}")
-        for handle, buf, arr in ((handles[0], k_buf, k), (handles[1], v_buf, v)):
             full = np.zeros(buf.shape, jax.numpy.dtype(buf.dtype))
             full[:, :, :new_position] = arr.astype(full.dtype)
-            new_buf = (
+            return (
                 jax.device_put(full, buf.sharding)
                 if getattr(buf, "sharding", None) is not None
                 else jax.numpy.asarray(full)
             )
-            self.memory_cache.update_cache(handle, new_buf)
+
+        new_k = await asyncio.to_thread(stage, "k", tensors["k"], k_buf)
+        new_v = await asyncio.to_thread(stage, "v", tensors["v"], v_buf)
+        # only the cache-handle swap happens on the loop
+        self.memory_cache.update_cache(handles[0], new_k)
+        self.memory_cache.update_cache(handles[1], new_v)
         return new_position
 
     async def _snapshot_session(
@@ -495,7 +502,7 @@ class TransformerHandler:
                         reg["position"] = position
 
                 if "kv_import" in step:
-                    position = self._install_kv_import(
+                    position = await self._install_kv_import(
                         step, kv, handles, position,
                         batch_size=batch_size, n_blocks=end - start, max_length=max_length,
                     )

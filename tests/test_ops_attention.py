@@ -20,6 +20,24 @@ def _make_qkv(batch, q_len, kv_len, hq, hkv, d, seed=0, dtype=jnp.float32):
     return q, k, v
 
 
+def test_vmem_tile_clamp_for_wide_heads():
+    """Default flash tiles shrink for head_dim > 128 (VMEM fit) but the
+    measured-good 512x1024 tiles at head_dim <= 128 are preserved exactly."""
+    from petals_tpu.ops.flash_attention import _fit_tiles_to_vmem
+
+    assert _fit_tiles_to_vmem(512, 1024, 64) == (512, 1024)
+    assert _fit_tiles_to_vmem(512, 1024, 128) == (512, 1024)
+    bq, bkv = _fit_tiles_to_vmem(512, 1024, 256)
+    assert bkv < 1024 and bq % 8 == 0 and bkv % 128 == 0
+    bq, bkv = _fit_tiles_to_vmem(512, 1024, 1024)
+    assert bq >= 8 and bkv >= 128  # never collapses below hardware minima
+    # non-power-of-two multiples of 128 (kv_buf_len 640/896) must stay
+    # lane-aligned: no halving into a non-multiple of 128
+    for start_kv in (640, 896):
+        bq, bkv = _fit_tiles_to_vmem(512, start_kv, 1024)
+        assert bkv % 128 == 0 and bkv >= 128 and bq >= 8, (bq, bkv)
+
+
 @pytest.mark.parametrize(
     "batch,q_len,kv_len,hq,hkv,d",
     [
