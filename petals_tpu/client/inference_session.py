@@ -71,6 +71,8 @@ class _ServerInferenceSession:
         stub: RpcClient = await seq_manager.get_stub(span.peer_id)
         stream = await stub.open_stream("ptu.inference")
         compression = CompressionType(seq_manager.config.compression)
+        import petals_tpu
+
         open_msg = {
             "uids": CHAIN_DELIMITER.join(uids),
             "max_length": max_length,
@@ -79,6 +81,9 @@ class _ServerInferenceSession:
             # reply compression for all steps; "none" must OVERRIDE a lossy
             # server default, so it is always sent
             "compression": compression.value,
+            # handshake version gate: the server rejects incompatible clients
+            # with an actionable error instead of a wire mismatch mid-step
+            "client_version": petals_tpu.__version__,
         }
         if session_id:
             open_msg["session_id"] = session_id

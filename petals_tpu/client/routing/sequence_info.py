@@ -13,6 +13,10 @@ from petals_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
+# (peer, version) pairs already warned about — a stale server would otherwise
+# log on every routing refresh
+_warned_incompatible: set = set()
+
 
 @dataclasses.dataclass
 class RemoteSequenceInfo:
@@ -39,10 +43,27 @@ class RemoteSequenceInfo:
 
     @staticmethod
     def _compute_spans(block_infos):
+        from petals_tpu.utils.version import incompatibility_error, is_compatible
+
         spans = list(compute_spans(block_infos, min_state=ServerState.ONLINE).values())
-        spans_by_priority = sorted(spans, key=lambda s: (s.length, s.throughput), reverse=True)
-        spans_containing_block = tuple([] for _ in block_infos)
+        usable = []
         for span in spans:
+            # version gate at routing time: an incompatible server would fail
+            # mid-step with an opaque wire error — exclude it up front
+            version = getattr(span.server_info, "version", None)
+            if not is_compatible(version):
+                key = (str(span.peer_id), version)
+                if key not in _warned_incompatible:
+                    _warned_incompatible.add(key)
+                    logger.warning(
+                        f"Ignoring server {str(span.peer_id)[:16]}…: "
+                        + incompatibility_error(version)
+                    )
+                continue
+            usable.append(span)
+        spans_by_priority = sorted(usable, key=lambda s: (s.length, s.throughput), reverse=True)
+        spans_containing_block = tuple([] for _ in block_infos)
+        for span in usable:
             for block_idx in range(span.start, span.end):
                 spans_containing_block[block_idx].append(span)
         return spans_by_priority, spans_containing_block

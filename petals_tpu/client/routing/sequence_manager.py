@@ -236,6 +236,8 @@ class RemoteSequenceManager:
                 logger.debug(f"rpc_info from {peer_id} failed: {e}")
                 return peer_id, None
 
+        if not targets:  # e.g. every known peer is version-filtered or banned
+            return
         # collective budget: one dead-but-not-yet-banned peer must not stall a
         # session open for its whole connect timeout
         tasks = [asyncio.ensure_future(fetch(p)) for p in targets]
@@ -254,6 +256,19 @@ class RemoteSequenceManager:
             # DHT, and only when well-formed — a malformed reply from one
             # server must not abort routing (same rule as ServerInfo.from_tuple)
             try:
+                from petals_tpu.utils.version import incompatibility_error, is_compatible
+
+                version = info.get("version")
+                if not is_compatible(version):
+                    # a server upgraded/downgraded across a compatibility line
+                    # since its DHT announce. Recording the version only takes
+                    # effect at the NEXT spans recompute, so also ban the peer
+                    # — the in-flight make_sequence must not route through it
+                    # (forward/backward have no handshake backstop)
+                    server_info.version = version
+                    self.on_request_failure(peer_id)
+                    logger.warning(incompatibility_error(version, peer=f"server {str(peer_id)[:16]}…"))
+                    continue
                 tokens = info.get("cache_tokens_available")
                 if tokens is not None:
                     server_info.cache_tokens_left = int(tokens)

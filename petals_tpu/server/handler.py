@@ -430,6 +430,12 @@ class TransformerHandler:
         open_msg = await asyncio.wait_for(anext(requests), self.step_timeout)
         if self.draining:
             raise RuntimeError("Server is draining: not accepting new sessions")
+        client_version = open_msg.get("client_version")
+        if client_version is not None:
+            from petals_tpu.utils.version import incompatibility_error, is_compatible
+
+            if not is_compatible(client_version):
+                raise ValueError(incompatibility_error(client_version, peer="client"))
         start, end = self._parse_chain(open_msg["uids"])
         max_length = int(open_msg["max_length"])
         if self.inference_max_length is not None and max_length > self.inference_max_length:

@@ -1,0 +1,61 @@
+"""Protocol version compatibility
+(counterpart of reference src/petals/utils/version.py:21-51, which checks PyPI
+for updates and shims renamed repos; this build has no egress, so the useful
+half — keeping a mixed-version swarm from failing opaquely — is done by
+validating each server's announced ``ServerInfo.version`` against the client's
+supported range at routing time and at the rpc_info handshake).
+
+Policy: versions are ``MAJOR.MINOR.PATCH``; two builds interoperate iff their
+(MAJOR, MINOR) match. Servers announcing an incompatible version are excluded
+from routing (with a one-line warning naming the versions), and an explicit
+handshake with one fails with an actionable error instead of a shape/wire
+mismatch deep in a step. Unannounced versions (None — pre-gating builds) are
+accepted. ``PETALS_TPU_IGNORE_VERSION=1`` disables all gating (reference
+escape hatch: PETALS_IGNORE_DEPENDENCY_VERSION, __init__.py:23)."""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional, Tuple
+
+import petals_tpu
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_VER_RE = re.compile(r"^\s*(\d+)\.(\d+)(?:\.(\d+))?")
+
+
+def parse_version(version) -> Optional[Tuple[int, int]]:
+    """(MAJOR, MINOR) of a version string, or None if unparseable. Accepts
+    arbitrary junk (a malformed DHT announce must never crash routing)."""
+    if not isinstance(version, str):
+        return None
+    m = _VER_RE.match(version)
+    return (int(m.group(1)), int(m.group(2))) if m else None
+
+
+def gating_disabled() -> bool:
+    return os.environ.get("PETALS_TPU_IGNORE_VERSION", "").strip() not in ("", "0", "false")
+
+
+def is_compatible(server_version: Optional[str]) -> bool:
+    """Can this client talk to a server announcing ``server_version``?"""
+    if gating_disabled():
+        return True
+    if server_version is None:
+        return True  # pre-gating builds announce nothing; don't strand them
+    theirs = parse_version(server_version)
+    if theirs is None:
+        return True  # unparseable: opt for reachability, the handshake may still work
+    return theirs == parse_version(petals_tpu.__version__)
+
+
+def incompatibility_error(server_version: Optional[str], peer: str = "server") -> str:
+    ours = petals_tpu.__version__
+    return (
+        f"{peer} runs petals_tpu {server_version}, this client runs {ours}; "
+        f"builds interoperate only within the same MAJOR.MINOR line. Upgrade "
+        f"the older side (or set PETALS_TPU_IGNORE_VERSION=1 to force)."
+    )
