@@ -30,22 +30,7 @@ def sample_next_token(
         return logits.argmax(axis=-1)
 
     rng = rng or np.random
-    logits = logits.astype(np.float64)
-    if temperature != 1.0:
-        logits = logits / temperature
-    if top_k is not None and top_k > 0:
-        kth = np.partition(logits, -top_k, axis=-1)[:, -top_k][:, None]
-        logits = np.where(logits < kth, -np.inf, logits)
-    if top_p is not None and top_p < 1.0:
-        sorted_idx = np.argsort(-logits, axis=-1)
-        sorted_logits = np.take_along_axis(logits, sorted_idx, axis=-1)
-        probs = _softmax(sorted_logits)
-        cumulative = probs.cumsum(axis=-1)
-        cutoff = cumulative - probs > top_p  # keep first token above the nucleus
-        sorted_logits[cutoff] = -np.inf
-        restored = np.full_like(logits, -np.inf)
-        np.put_along_axis(restored, sorted_idx, sorted_logits, axis=-1)
-        logits = restored
+    logits = _warp_scores(logits, temperature=temperature, top_k=top_k, top_p=top_p)
     probs = _softmax(logits)
     out = np.empty(logits.shape[0], dtype=np.int64)
     for i in range(logits.shape[0]):
@@ -106,15 +91,65 @@ def _process_scores(
     repetition_penalty: float = 1.0,
     no_repeat_ngram_size: int = 0,
     ban_eos_token_id: Optional[int] = None,
+    logits_processor=None,
 ) -> np.ndarray:
     """HF logits-processor pipeline, in HF's order; ``ban_eos_token_id`` is
     the MinNewTokensLengthLogitsProcessor ban (pass it while the generated
-    count is below min_new_tokens)."""
+    count is below min_new_tokens). ``logits_processor`` is the plug-in point
+    for arbitrary HF-protocol processors — callables ``(input_ids, scores) ->
+    scores`` over numpy arrays — applied after the built-ins, in list order
+    (reference inherits this from transformers GenerationMixin)."""
     scores = apply_repetition_penalty(scores, generated, repetition_penalty)
     scores = apply_no_repeat_ngram(scores, generated, no_repeat_ngram_size)
     if ban_eos_token_id is not None:
         scores = scores.copy()
         scores[:, ban_eos_token_id] = -np.inf
+    for proc in logits_processor or ():
+        scores = np.asarray(proc(generated, scores))
+    return scores
+
+
+def _stop_requested(stopping_criteria, generated: np.ndarray, scores) -> bool:
+    """HF stopping_criteria protocol: callables ``(input_ids, scores) ->
+    bool | [batch] bool``. Per-row results are OR-ed ACROSS criteria and
+    generation stops when every row is finished by some criterion (matching
+    transformers, where the unfinished mask accumulates over the list)."""
+    if not stopping_criteria:
+        return False
+    stopped = np.zeros(generated.shape[0], dtype=bool)
+    for crit in stopping_criteria:
+        stopped |= np.broadcast_to(np.asarray(crit(generated, scores), bool), stopped.shape)
+        if stopped.all():
+            return True
+    return False
+
+
+def _warp_scores(
+    scores: np.ndarray,
+    *,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> np.ndarray:
+    """HF logits-warper pipeline (temperature -> top_k -> top_p) used by beam
+    sampling, where warping applies to the beam-score-added totals."""
+    scores = scores.astype(np.float64)
+    if temperature != 1.0 and temperature > 0:
+        scores = scores / temperature
+    if top_k is not None and top_k > 0:
+        k = min(top_k, scores.shape[-1])
+        kth = np.partition(scores, -k, axis=-1)[:, -k][:, None]
+        scores = np.where(scores < kth, -np.inf, scores)
+    if top_p is not None and top_p < 1.0:
+        sorted_idx = np.argsort(-scores, axis=-1)
+        sorted_scores = np.take_along_axis(scores, sorted_idx, axis=-1)
+        probs = _softmax(sorted_scores)
+        cumulative = probs.cumsum(axis=-1)
+        cutoff = cumulative - probs > top_p
+        sorted_scores[cutoff] = -np.inf
+        restored = np.full_like(scores, -np.inf)
+        np.put_along_axis(restored, sorted_idx, sorted_scores, axis=-1)
+        scores = restored
     return scores
 
 
@@ -173,15 +208,18 @@ class RemoteGenerationMixin:
         seed: Optional[int] = None,
         prompts: Optional[np.ndarray] = None,
         streamer=None,  # HF BaseStreamer protocol: .put(tokens), .end()
+        logits_processor=None,  # HF protocol: [(input_ids, scores) -> scores]
+        stopping_criteria=None,  # HF protocol: [(input_ids, scores) -> bool]
     ) -> np.ndarray:
         if num_return_sequences < 1:
             raise ValueError("num_return_sequences must be >= 1")
-        if num_return_sequences > 1 and num_beams == 1:
-            raise NotImplementedError(
-                "num_return_sequences > 1 is only implemented for deterministic "
-                "beam search (set num_beams > 1 and do_sample=False)"
+        if num_return_sequences > 1 and num_beams == 1 and not do_sample:
+            # HF raises the same way: greedy can only produce one sequence
+            raise ValueError(
+                "Greedy decoding can't return multiple sequences; set "
+                "do_sample=True or num_beams >= num_return_sequences"
             )
-        if num_return_sequences > num_beams:
+        if num_beams > 1 and num_return_sequences > num_beams:
             raise ValueError("num_return_sequences must be <= num_beams")
         if max_length is not None:
             # HF semantics: max_length caps the TOTAL sequence length
@@ -191,21 +229,17 @@ class RemoteGenerationMixin:
         if num_beams > 1:
             if streamer is not None:
                 raise ValueError("streamer is not supported with beam search (HF semantics)")
-            # explicit rejections beat silent divergence from HF semantics
-            assert not do_sample, "beam search is deterministic (use num_beams=1 to sample)"
-            if session is not None or self._active_session is not None:
-                raise NotImplementedError(
-                    "beam search opens its own session; it cannot run with an "
-                    "explicit session= or inside model.inference_session(...)"
-                )
-            ptune = getattr(self, "ptune", None)
-            if ptune is not None and ptune.tuning_mode:
-                raise NotImplementedError("beam search with prompt tuning is not supported yet")
             return self._beam_search(
                 input_ids,
                 max_new_tokens=max_new_tokens,
                 num_beams=num_beams,
                 prompts=prompts,
+                session=session if session is not None else self._active_session,
+                do_sample=do_sample,
+                temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
+                seed=seed,
                 eos_token_id=eos_token_id,
                 pad_token_id=pad_token_id,
                 length_penalty=length_penalty,
@@ -214,8 +248,16 @@ class RemoteGenerationMixin:
                 no_repeat_ngram_size=no_repeat_ngram_size,
                 min_new_tokens=min_new_tokens,
                 num_return_sequences=num_return_sequences,
+                logits_processor=logits_processor,
+                stopping_criteria=stopping_criteria,
             )
         input_ids = np.asarray(input_ids)
+        if num_return_sequences > 1:
+            # HF sampling semantics: each return sequence is an independent
+            # draw — expand every batch row into num_return_sequences lanes
+            input_ids = np.repeat(input_ids, num_return_sequences, axis=0)
+            if prompts is not None:
+                prompts = np.repeat(np.asarray(prompts), num_return_sequences, axis=1)
         batch, prompt_len = input_ids.shape
         rng = np.random.RandomState(seed) if seed is not None else np.random.RandomState()
 
@@ -229,9 +271,19 @@ class RemoteGenerationMixin:
             total = max_length if max_length is not None else pre_seq + prompt_len + max_new_tokens
             session = self.remote.inference_session(max_length=total, batch_size=batch)
             own_session = True
-        elif max_length is None:
-            # cache must hold prompts + all tokens except the final sampled one
-            max_new_tokens = min(max_new_tokens, session.max_length - pre_seq - prompt_len + 1)
+        else:
+            if getattr(session, "batch_size", batch) != batch:
+                raise ValueError(
+                    f"this generate() call needs {batch} cache lanes "
+                    f"(batch {input_ids.shape[0] // num_return_sequences} x "
+                    f"num_return_sequences {num_return_sequences}) but the open "
+                    f"session has batch_size={session.batch_size}; open "
+                    f"model.inference_session(batch_size={batch}) or let "
+                    f"generate() manage the session"
+                )
+            if max_length is None:
+                # cache must hold prompts + all tokens except the final sampled one
+                max_new_tokens = min(max_new_tokens, session.max_length - pre_seq - prompt_len + 1)
 
         try:
             generated = input_ids
@@ -262,6 +314,7 @@ class RemoteGenerationMixin:
                     ban_eos_token_id=(
                         eos_token_id if i < min_new_tokens else None
                     ),
+                    logits_processor=logits_processor,
                 )
                 next_token = sample_next_token(
                     scores,
@@ -280,6 +333,8 @@ class RemoteGenerationMixin:
                 if streamer is not None:
                     streamer.put(np.asarray(next_token))
                 if eos_token_id is not None and finished.all():
+                    break
+                if _stop_requested(stopping_criteria, generated, scores):
                     break
                 if i + 1 == max_new_tokens:
                     # the final token is deliberately NOT fed to the servers: a
@@ -306,6 +361,12 @@ class RemoteGenerationMixin:
         max_new_tokens: int,
         num_beams: int,
         prompts: Optional[np.ndarray] = None,
+        session=None,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        seed: Optional[int] = None,
         eos_token_id: Optional[int] = None,
         pad_token_id: Optional[int] = None,
         length_penalty: float = 1.0,
@@ -314,11 +375,26 @@ class RemoteGenerationMixin:
         no_repeat_ngram_size: int = 0,
         min_new_tokens: int = 0,
         num_return_sequences: int = 1,
+        logits_processor=None,
+        stopping_criteria=None,
     ) -> np.ndarray:
         """Beam search over the swarm with HF BeamSearchScorer semantics
         (EOS finalization, length penalty, early stopping, batch > 1); each
         step reorders every server's KV cache lanes via hypo_ids (reference
-        remote_generation.py beam hook + backend.py:154-158)."""
+        remote_generation.py beam hook + backend.py:154-158).
+
+        ``do_sample=True`` follows HF ``_beam_sample``: candidate tokens are
+        drawn (not ranked) from the warped softmax of beam-score-added
+        logprobs; warpers apply temperature/top-k/top-p AFTER the beam-score
+        addition, exactly like transformers. Sampled draws use this build's
+        numpy RNG, so token streams are seed-reproducible here but not
+        bit-identical to torch's RNG.
+
+        An explicit ``session=`` (or an enclosing ``inference_session``) is
+        used when it is fresh and sized for ``batch * num_beams`` lanes —
+        multi-turn beam conversations on one session are not supported (the
+        reference inherits the same limitation: a session's KV lanes hold the
+        LAST step's beam reordering, which a follow-up call cannot re-align)."""
         input_ids = np.asarray(input_ids)
         batch, prompt_len = input_ids.shape
         if max_new_tokens <= 0:
@@ -328,6 +404,46 @@ class RemoteGenerationMixin:
             pad_token_id = eos_token_id
         max_length = prompt_len + max_new_tokens
         lanes = batch * num_beams
+        rng = np.random.RandomState(seed) if seed is not None else np.random.RandomState()
+
+        ptune = getattr(self, "ptune", None)
+        pre_seq = ptune.pre_seq_len if (ptune and ptune.tuning_mode) else 0
+        if prompts is None and hasattr(self, "deep_prompts_for_batch"):
+            prompts = self.deep_prompts_for_batch(lanes)
+
+        own_session = False
+        if session is None:
+            session = self.remote.inference_session(
+                max_length=pre_seq + max_length, batch_size=lanes
+            )
+            own_session = True
+        else:
+            if session.batch_size != lanes:
+                raise ValueError(
+                    f"beam search over batch {batch} x {num_beams} beams needs a "
+                    f"session with batch_size={lanes}, got {session.batch_size}; "
+                    f"open model.inference_session(batch_size={lanes}) or let "
+                    f"generate() manage the session"
+                )
+            if session.position > 0:
+                raise NotImplementedError(
+                    "a session already holding beam-reordered KV lanes cannot "
+                    "host a second beam call; use a fresh session per beam "
+                    "generate()"
+                )
+            # the final chosen token is never fed, so the cache needs
+            # pre_seq + prompt_len + max_new_tokens - 1 positions; clamp like
+            # the sampling path instead of dying mid-beam on a short session
+            budget = session.max_length - pre_seq - prompt_len + 1
+            if budget <= 0:
+                raise ValueError(
+                    f"session max_length {session.max_length} cannot hold the "
+                    f"{pre_seq + prompt_len}-token prompt (+1 generated); open a "
+                    f"larger session"
+                )
+            if max_new_tokens > budget:
+                max_new_tokens = budget
+                max_length = prompt_len + max_new_tokens
 
         hyps = [
             _BeamHypotheses(num_beams, length_penalty, early_stopping)
@@ -340,9 +456,8 @@ class RemoteGenerationMixin:
         beam_scores[:, 1:] = -1e9
         sequences = np.repeat(input_ids, num_beams, axis=0)  # [lanes, seq]
 
-        session = self.remote.inference_session(max_length=max_length, batch_size=lanes)
         try:
-            hidden = np.asarray(self.embed(sequences, with_prompts=False))
+            hidden = np.asarray(self.embed(sequences, with_prompts=pre_seq > 0))
             out = session.step(hidden, prompts=prompts)
             hypo_ids = None
             for _step in range(max_new_tokens):
@@ -355,9 +470,15 @@ class RemoteGenerationMixin:
                     ban_eos_token_id=(
                         eos_token_id if _step < min_new_tokens else None
                     ),
+                    logits_processor=logits_processor,
                 )
                 vocab = logprobs.shape[-1]
                 totals = beam_scores.reshape(lanes, 1) + logprobs  # [lanes, vocab]
+                if do_sample:
+                    # HF _beam_sample: warp the beam-score-added totals
+                    totals = _warp_scores(
+                        totals, temperature=temperature, top_k=top_k, top_p=top_p
+                    )
                 cur_len = sequences.shape[1]
 
                 # HF bookkeeping: cur_len counts the token being chosen now,
@@ -373,8 +494,34 @@ class RemoteGenerationMixin:
                         next_beam_idx[b] = b * num_beams
                         continue
                     flat = totals[b * num_beams : (b + 1) * num_beams].reshape(-1)
-                    # 2*num_beams candidates guarantee num_beams non-EOS ones
-                    top = np.argsort(-flat, kind="stable")[: 2 * num_beams]
+                    if do_sample:
+                        # draw 2n candidates without replacement from the
+                        # warped distribution, then rank them by score
+                        # (HF: multinomial then sort by gathered scores).
+                        # Cold temperatures underflow most probs to exact 0 —
+                        # supplement with the best undrawn finite candidates
+                        # so the beam always has 2n to rank (and the
+                        # temperature->0 limit collapses to beam search)
+                        probs = _softmax(flat[None, :])[0]
+                        n_cand = min(2 * num_beams, int((probs > 0).sum()))
+                        drawn = rng.choice(
+                            flat.shape[0], size=n_cand, replace=False, p=probs
+                        )
+                        if n_cand < 2 * num_beams:
+                            have = set(drawn.tolist())
+                            extra = []
+                            for i in np.argsort(-flat, kind="stable"):
+                                if len(extra) == 2 * num_beams - n_cand:
+                                    break
+                                if not np.isfinite(flat[i]):
+                                    break  # sorted: everything after is -inf too
+                                if int(i) not in have:
+                                    extra.append(int(i))
+                            drawn = np.concatenate([drawn, np.asarray(extra, np.int64)])
+                        top = drawn[np.argsort(-flat[drawn], kind="stable")]
+                    else:
+                        # 2*num_beams candidates guarantee num_beams non-EOS ones
+                        top = np.argsort(-flat, kind="stable")[: 2 * num_beams]
                     beam_rank = 0
                     for rank, flat_idx in enumerate(top):
                         beam_of, token = int(flat_idx // vocab), int(flat_idx % vocab)
@@ -406,12 +553,15 @@ class RemoteGenerationMixin:
                 hypo_ids = lane_order.astype(np.int64)
                 if all(done):
                     break
+                if _stop_requested(stopping_criteria, sequences, totals):
+                    break
                 if _step + 1 == max_new_tokens:
                     break
                 hidden = np.asarray(self.embed(sequences[:, -1:], with_prompts=False))
-                out = session.step(hidden, hypo_ids=hypo_ids)
+                out = session.step(hidden, prompts=prompts, hypo_ids=hypo_ids)
         finally:
-            session.close()
+            if own_session:
+                session.close()
 
         # finalize (HF BeamSearchScorer.finalize): open beams become hypotheses
         for b in range(batch):

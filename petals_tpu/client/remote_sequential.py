@@ -132,6 +132,10 @@ class SyncInferenceSession:
     def max_length(self) -> int:
         return self._session.max_length
 
+    @property
+    def batch_size(self) -> int:
+        return self._session.batch_size
+
     def close(self) -> None:
         self._runtime.run(self._session.close())
 

@@ -1,0 +1,266 @@
+"""Round-3 generation surface: beam sampling, sampled num_return_sequences,
+beam inside a user session, beam + prompt tuning, and the logits_processor /
+stopping_criteria plug-in points (reference gets these from HF GenerationMixin,
+client/remote_generation.py:84-164)."""
+
+import numpy as np
+import pytest
+import torch
+
+from petals_tpu.client.model import AutoDistributedModelForCausalLM
+from tests.test_full_model import SwarmHarness, _hf_greedy
+from tests.utils import make_tiny_llama
+
+
+@pytest.fixture(scope="module")
+def swarm(tmp_path_factory):
+    path = make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+    harness = SwarmHarness(
+        path, [dict(first_block=0, num_blocks=3), dict(first_block=2, num_blocks=2)]
+    ).start()
+    yield path, harness
+    harness.stop()
+
+
+@pytest.fixture(scope="module")
+def client(swarm):
+    path, harness = swarm
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers
+    )
+    yield path, model
+    model.close()
+
+
+def test_beam_sample_mechanics_and_determinism(client):
+    path, model = client
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+    out1 = model.generate(
+        ids, max_new_tokens=5, num_beams=3, do_sample=True, temperature=1.3,
+        top_k=20, seed=11,
+    )
+    out2 = model.generate(
+        ids, max_new_tokens=5, num_beams=3, do_sample=True, temperature=1.3,
+        top_k=20, seed=11,
+    )
+    np.testing.assert_array_equal(out1, out2)  # seed-reproducible
+    assert out1.shape == (1, 10)
+    np.testing.assert_array_equal(out1[:, :5], ids)
+    assert (out1 >= 0).all() and (out1 < model.cfg.vocab_size).all()
+
+
+def test_beam_sample_machinery_matches_hf(client, monkeypatch):
+    """Token-identity for the whole beam-sample pipeline vs HF _beam_sample.
+    Random draws can't match across torch and numpy RNGs, so BOTH samplers are
+    stubbed to the same deterministic draw (top-2n of the sampling
+    distribution); everything else — warper order (after beam-score addition),
+    candidate ranking, EOS finalization, score bookkeeping — must then produce
+    token-identical output."""
+    from transformers import AutoModelForCausalLM
+
+    path, model = client
+    rng = np.random.RandomState(4)
+    ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+    kwargs = dict(max_new_tokens=5, num_beams=3, do_sample=True, temperature=1.7, top_k=40)
+
+    class TopKRandomState(np.random.RandomState):
+        def choice(self, n, size=None, replace=True, p=None):
+            assert p is not None and not replace
+            return np.argsort(-np.asarray(p), kind="stable")[:size]
+
+    monkeypatch.setattr(np.random, "RandomState", TopKRandomState)
+    ours = model.generate(np.asarray(ids), seed=0, **kwargs)
+
+    def torch_topk_multinomial(probs, num_samples, **_kw):
+        return torch.topk(probs, num_samples, dim=-1).indices
+
+    monkeypatch.setattr(torch, "multinomial", torch_topk_multinomial)
+    hf = AutoModelForCausalLM.from_pretrained(path, dtype=torch.float32).eval()
+    with torch.no_grad():
+        expected = hf.generate(torch.from_numpy(ids), **kwargs).numpy()
+    np.testing.assert_array_equal(ours, expected)
+
+
+def test_beam_inside_user_session_matches_standalone(client):
+    path, model = client
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+    standalone = model.generate(ids, max_new_tokens=5, num_beams=3)
+    with model.inference_session(max_length=10, batch_size=3):
+        in_session = model.generate(ids, max_new_tokens=5, num_beams=3)
+    np.testing.assert_array_equal(in_session, standalone)
+
+
+def test_beam_session_batch_mismatch_is_clean_error(client):
+    path, model = client
+    ids = np.arange(5, dtype=np.int64).reshape(1, 5)
+    with model.inference_session(max_length=10, batch_size=1):
+        with pytest.raises(ValueError, match="batch_size=3"):
+            model.generate(ids, max_new_tokens=3, num_beams=3)
+
+
+@pytest.mark.parametrize("mode", ["ptune", "deep_ptune"])
+def test_beam_with_prompt_tuning(swarm, mode):
+    """Beam search composes with client-held trainable prompts (shallow and
+    deep): mechanics + determinism (no HF analogue: HF has no ptune)."""
+    from petals_tpu.client.ptune import PTuneConfig
+
+    path, harness = swarm
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers,
+        ptune=PTuneConfig(pre_seq_len=3, tuning_mode=mode),
+    )
+    try:
+        rng = np.random.RandomState(6)
+        ids = rng.randint(0, 100, (1, 4)).astype(np.int64)
+        out1 = model.generate(ids, max_new_tokens=4, num_beams=2)
+        out2 = model.generate(ids, max_new_tokens=4, num_beams=2)
+        np.testing.assert_array_equal(out1, out2)
+        assert out1.shape == (1, 8)
+        np.testing.assert_array_equal(out1[:, :4], ids)
+    finally:
+        model.close()
+
+
+def test_sampled_num_return_sequences(client):
+    path, model = client
+    rng = np.random.RandomState(7)
+    ids = rng.randint(0, 100, (2, 4)).astype(np.int64)
+    out = model.generate(
+        ids, max_new_tokens=4, do_sample=True, temperature=2.0,
+        num_return_sequences=3, seed=21,
+    )
+    assert out.shape == (6, 8)
+    # HF layout: row-major by batch item, each item's returns contiguous
+    for b in range(2):
+        for r in range(3):
+            np.testing.assert_array_equal(out[b * 3 + r, :4], ids[b])
+    again = model.generate(
+        ids, max_new_tokens=4, do_sample=True, temperature=2.0,
+        num_return_sequences=3, seed=21,
+    )
+    np.testing.assert_array_equal(out, again)
+
+
+def test_greedy_num_return_sequences_rejected_like_hf(client):
+    path, model = client
+    ids = np.arange(4, dtype=np.int64).reshape(1, 4)
+    with pytest.raises(ValueError, match="[Gg]reedy"):
+        model.generate(ids, max_new_tokens=2, num_return_sequences=2)
+
+
+def test_logits_processor_matches_hf(client):
+    """A custom processor plugged into generate() matches transformers running
+    the equivalent processor: token-identical greedy streams."""
+    from transformers import AutoModelForCausalLM, LogitsProcessor, LogitsProcessorList
+
+    path, model = client
+    rng = np.random.RandomState(9)
+    ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+
+    plain = _hf_greedy(path, ids, 6)
+    banned = [int(t) for t in plain[0, 5:8]]  # ban what greedy would pick
+
+    def numpy_ban(input_ids, scores):
+        scores = scores.copy()
+        scores[:, banned] = -np.inf
+        return scores
+
+    ours = model.generate(ids, max_new_tokens=6, logits_processor=[numpy_ban])
+
+    class TorchBan(LogitsProcessor):
+        def __call__(self, input_ids, scores):
+            scores = scores.clone()
+            scores[:, banned] = -float("inf")
+            return scores
+
+    hf = AutoModelForCausalLM.from_pretrained(path, dtype=torch.float32).eval()
+    with torch.no_grad():
+        expected = hf.generate(
+            torch.from_numpy(ids), max_new_tokens=6, do_sample=False,
+            logits_processor=LogitsProcessorList([TorchBan()]),
+        ).numpy()
+    np.testing.assert_array_equal(ours, expected)
+    assert not np.intersect1d(ours[0, 5:], banned).size
+
+
+def test_logits_processor_in_beam_search_matches_hf(client):
+    from transformers import AutoModelForCausalLM, LogitsProcessor, LogitsProcessorList
+
+    path, model = client
+    rng = np.random.RandomState(10)
+    ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+    banned = [1, 2, 3]
+
+    def numpy_ban(input_ids, scores):
+        scores = scores.copy()
+        scores[:, banned] = -np.inf
+        return scores
+
+    class TorchBan(LogitsProcessor):
+        def __call__(self, input_ids, scores):
+            scores = scores.clone()
+            scores[:, banned] = -float("inf")
+            return scores
+
+    ours = model.generate(
+        ids, max_new_tokens=5, num_beams=3, logits_processor=[numpy_ban]
+    )
+    hf = AutoModelForCausalLM.from_pretrained(path, dtype=torch.float32).eval()
+    with torch.no_grad():
+        expected = hf.generate(
+            torch.from_numpy(ids), max_new_tokens=5, num_beams=3, do_sample=False,
+            logits_processor=LogitsProcessorList([TorchBan()]),
+        ).numpy()
+    np.testing.assert_array_equal(ours, expected)
+
+
+def test_stopping_criteria(client):
+    path, model = client
+    ids = np.arange(5, dtype=np.int64).reshape(1, 5)
+
+    def stop_at_8(input_ids, scores):
+        return input_ids.shape[1] >= 8
+    out = model.generate(ids, max_new_tokens=20, stopping_criteria=[stop_at_8])
+    assert out.shape[1] == 8, out.shape
+    np.testing.assert_array_equal(out, _hf_greedy(path, ids, 20)[:, :8])
+
+
+def test_stopping_criteria_or_across_list(client):
+    """HF semantics: per-row verdicts OR across the criteria list — two
+    criteria that each finish HALF the batch stop generation together."""
+    path, model = client
+    ids = np.arange(8, dtype=np.int64).reshape(2, 4)
+
+    def rows_0(input_ids, scores):
+        done = np.zeros(input_ids.shape[0], bool)
+        done[0] = input_ids.shape[1] >= 6
+        return done
+
+    def rows_1(input_ids, scores):
+        done = np.zeros(input_ids.shape[0], bool)
+        done[1] = input_ids.shape[1] >= 6
+        return done
+
+    out = model.generate(ids, max_new_tokens=20, stopping_criteria=[rows_0, rows_1])
+    assert out.shape == (2, 6), out.shape
+
+
+def test_sampled_nrs_session_batch_mismatch_is_clean_error(client):
+    path, model = client
+    ids = np.arange(4, dtype=np.int64).reshape(1, 4)
+    with model.inference_session(max_length=16, batch_size=1):
+        with pytest.raises(ValueError, match="batch_size=3"):
+            model.generate(ids, max_new_tokens=2, do_sample=True, num_return_sequences=3)
+
+
+def test_beam_short_session_clamps_instead_of_crashing(client):
+    path, model = client
+    ids = np.arange(5, dtype=np.int64).reshape(1, 5)
+    with model.inference_session(max_length=7, batch_size=2):
+        out = model.generate(ids, max_new_tokens=10, num_beams=2)
+    # budget = 7 - 5 + 1 = 3 generated tokens
+    assert out.shape == (1, 8), out.shape
+    full = model.generate(ids, max_new_tokens=3, num_beams=2)
+    np.testing.assert_array_equal(out, full)
