@@ -531,6 +531,21 @@ def main():
     details["decode_7b_batched"] = bd
     print(f"# batched decode: {json.dumps(bd)}", file=sys.stderr)
 
+    # 405B rehearsal: placement math + single-stream projection from THIS
+    # run's measured bandwidths (benchmarks/rehearsal_405b.py; the north-star
+    # arithmetic the driver records every round)
+    try:
+        from benchmarks.rehearsal_405b import rehearsal_report
+
+        rehearsal = rehearsal_report(details)
+        details["rehearsal_405b"] = rehearsal
+        print(
+            f"# 405B rehearsal: {json.dumps(rehearsal['projection'] + [rehearsal['north_star']])}",
+            file=sys.stderr,
+        )
+    except Exception as e:  # the projection must never sink the bench run
+        print(f"# 405B rehearsal failed: {e!r}", file=sys.stderr)
+
     with open("BENCH_DETAILS.json", "w") as f:
         json.dump(details, f, indent=2)
 

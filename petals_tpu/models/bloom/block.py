@@ -30,7 +30,7 @@ def block_apply(
     use_flash: bool = False,
     tp_mesh=None,
     n_valid=None,  # dynamic count of real (non-padding) tokens in this chunk
-    ring_mesh=None,  # training path only: sequence-parallel ring attention over "sp"
+    ring_mesh=None,  # "sp" mesh: ring attention (stateless path) or q-sharded prefill (cached)
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     batch, seq, _ = hidden_states.shape
     h, d = cfg.num_attention_heads, cfg.head_dim

@@ -31,7 +31,7 @@ def block_apply(
     *,
     use_flash: bool = False,
     n_valid=None,  # dynamic count of real (non-padding) tokens in this chunk
-    ring_mesh=None,  # training path only: sequence-parallel ring attention over "sp"
+    ring_mesh=None,  # "sp" mesh: ring attention (stateless path) or q-sharded prefill (cached)
     tp_mesh=None,  # serving path: run the flash kernel per TP head-shard
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     batch, seq, _ = hidden_states.shape

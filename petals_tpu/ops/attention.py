@@ -29,40 +29,52 @@ import jax.numpy as jnp
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def _flash_sharded(q, k, v, mesh, *, q_offset, kv_length, alibi_slopes, scale, sliding_window=None):
-    """Run the Pallas flash kernel per TP shard: q/kv heads are sharded over
-    the mesh's "tp" axis (Megatron layout, parallel/tp.py), the kernel is
-    per-head, and no cross-shard communication is needed — shard_map gives
-    Mosaic the per-device view GSPMD cannot derive for a custom call."""
+def _attend_sharded(
+    q, k, v, mesh, *, q_offset, kv_length, alibi_slopes, sliding_window,
+    use_flash, shard_seq: bool = False, scale=None,
+):
+    """Sharded attention dispatch over a device mesh.
+
+    Heads shard over a "tp" axis when present (Megatron layout, parallel/tp.py
+    — the math is per-head, so no cross-shard comms; shard_map gives Mosaic
+    the per-device view GSPMD cannot derive for a custom call). With
+    ``shard_seq`` the QUERY sequence additionally shards over the "sp" axis —
+    the KV-cached prefill path, where each device attends its query shard
+    against the replicated cache with a rank-adjusted ``q_offset``."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    heads_spec = P(None, None, "tp", None)
-    scalar_spec = P()
-
-    def per_shard(q_, k_, v_, q_offset_, kv_length_, slopes_):
-        from petals_tpu.ops.flash_attention import flash_attend
-
-        return flash_attend(
-            q_, k_, v_,
-            q_offset=q_offset_, kv_length=kv_length_,
-            alibi_slopes=slopes_ if alibi_slopes is not None else None,
-            sliding_window=sliding_window,
-            scale=scale,
-        )
-
+    head_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
+    seq_axis = "sp" if shard_seq else None
+    qspec = P(None, seq_axis, head_axis, None)
+    kvspec = P(None, None, head_axis, None)
+    use_alibi = alibi_slopes is not None
+    slopes = (
+        alibi_slopes if use_alibi else jnp.zeros((q.shape[2],), jnp.float32)
+    )
     if kv_length is None:
         kv_length = k.shape[1]
-    slopes = (
-        alibi_slopes
-        if alibi_slopes is not None
-        else jnp.zeros((q.shape[2],), jnp.float32)  # placeholder, unused per-shard
-    )
+
+    def per_shard(q_, k_, v_, q_offset_, kv_length_, slopes_):
+        import jax
+
+        if shard_seq:
+            q_offset_ = q_offset_ + jax.lax.axis_index("sp") * q_.shape[1]
+        return attend(
+            q_, k_, v_,
+            q_offset=q_offset_,
+            kv_length=kv_length_,
+            alibi_slopes=slopes_ if use_alibi else None,
+            sliding_window=sliding_window,
+            scale=scale,
+            use_flash=use_flash,  # per-device: the Mosaic kernel needs no GSPMD rule here
+        )
+
     fn = shard_map(
         per_shard,
         mesh=mesh,
-        in_specs=(heads_spec, heads_spec, heads_spec, scalar_spec, scalar_spec, P("tp")),
-        out_specs=heads_spec,
+        in_specs=(qspec, kvspec, kvspec, P(), P(), P(head_axis)),
+        out_specs=qspec,
         check_vma=False,
     )
     return fn(
@@ -105,11 +117,11 @@ def attend(
 
         if flash_supported(q, k, v, sliding_window=sliding_window):
             if tp_mesh is not None:
-                return _flash_sharded(
+                return _attend_sharded(
                     q, k, v, tp_mesh,
                     q_offset=q_offset, kv_length=kv_length,
                     alibi_slopes=alibi_slopes, sliding_window=sliding_window,
-                    scale=scale,
+                    scale=scale, use_flash=True,
                 )
             return flash_attend(
                 q,
@@ -150,9 +162,13 @@ def attend_maybe_ring(
     sliding_window: Optional[int] = None,
 ) -> jnp.ndarray:
     """The one attention dispatch every family block uses: sequence-parallel
-    ring attention on the stateless full-sequence path when a ring mesh is
-    given, plain ``attend`` otherwise. Centralised so the ring preconditions
-    (literal position 0, no padded chunks) are enforced in exactly one place."""
+    attention when a mesh with an "sp" axis is given — a K/V-rotating ring on
+    the stateless full-sequence path (K/V never materialize whole per device),
+    QUERY-sequence sharding on the KV-cached path (the cache must end up
+    replicated for tp-only decode anyway, so each device attends its query
+    shard against the replicated buffer; rotating K/V would add ICI traffic
+    for zero memory benefit) — plain ``attend`` otherwise. Centralised so the
+    preconditions are enforced in exactly one place."""
     if ring_mesh is not None and kv is None:
         if n_valid is not None or not isinstance(position, int) or position != 0:
             raise ValueError(
@@ -165,6 +181,21 @@ def attend_maybe_ring(
             q, k_all, v_all, ring_mesh,
             alibi_slopes=alibi_slopes, sliding_window=sliding_window,
         )
+    if ring_mesh is not None and kv is not None:
+        sp = ring_mesh.shape.get("sp", 1)
+        seq = q.shape[1]
+        if sp > 1 and seq > 1 and seq % sp == 0:
+            # KV-cached prefill under sequence parallelism: queries shard over
+            # "sp", the cache buffer stays replicated. Composes with chunked
+            # prefill (dynamic position/kv_length) and padded buckets (padding
+            # rows are masked by kv_length and sliced away by the caller).
+            return _attend_sharded(
+                q, k_all, v_all, ring_mesh,
+                q_offset=position, kv_length=kv_length,
+                alibi_slopes=alibi_slopes, sliding_window=sliding_window,
+                use_flash=use_flash, shard_seq=True,
+            )
+        # decode (seq == 1) and indivisible chunks fall through to tp-only
     return attend(
         q, k_all, v_all,
         q_offset=position, kv_length=kv_length,
