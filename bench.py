@@ -19,6 +19,7 @@ a WAN round trip a co-located server would not).
 """
 
 import asyncio
+import functools
 import gc
 import json
 import os
@@ -220,6 +221,74 @@ def bench_device_decode(cfg, *, quant=None, label="", batches=3, steps=25):
         "tunnel_sync_ms": round(sync * 1e3, 1),
     }
     del params, backend, kv, out
+    gc.collect()
+    return result
+
+
+def bench_moe_dispatch(seq=2048, *, runs=3):
+    """Mixtral-8x7B-shaped MoE layer at prefill: dense all-experts vs sparse
+    ragged_dot dispatch (FLOPs ratio = num_experts / top_k = 4x). The
+    round-3 sparse path's bench row (VERDICT r2 next-step #6)."""
+    import jax
+    import jax.numpy as jnp
+
+    from petals_tpu.models.mixtral.block import moe_apply
+    from petals_tpu.models.mixtral.config import MixtralBlockConfig
+
+    cfg = MixtralBlockConfig(
+        hidden_size=4096,
+        num_attention_heads=32,
+        num_key_value_heads=8,
+        head_dim=128,
+        intermediate_size=14336,
+        num_hidden_layers=1,
+        rms_norm_eps=1e-5,
+        vocab_size=32000,
+        num_local_experts=8,
+        num_experts_per_tok=2,
+        sliding_window=None,
+        rope_theta=1e6,
+    )
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 5)
+    h, m, E = cfg.hidden_size, cfg.intermediate_size, cfg.num_local_experts
+    params = {
+        "gate": jax.random.normal(ks[0], (h, E), jnp.bfloat16) * 0.2,
+        "w1": jax.random.normal(ks[1], (E, h, m), jnp.bfloat16) * 0.02,
+        "w2": jax.random.normal(ks[2], (E, m, h), jnp.bfloat16) * 0.02,
+        "w3": jax.random.normal(ks[3], (E, h, m), jnp.bfloat16) * 0.02,
+    }
+    x = jax.random.normal(ks[4], (1, seq, cfg.hidden_size), jnp.bfloat16) * 0.3
+    hard_sync(params)
+
+    fns = {
+        mode: jax.jit(functools.partial(moe_apply, cfg=cfg, sparse=(mode == "sparse")))
+        for mode in ("dense", "sparse")
+    }
+    times = {}
+    for mode, fn in fns.items():
+        hard_sync(fn(params, x))  # compile
+        sync = measure_sync_overhead()
+        best = float("inf")
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            out = fn(params, x)
+            hard_sync(out)
+            best = min(best, max(time.perf_counter() - t0 - sync, 1e-9))
+        times[mode] = best
+    # useful assignment flops (top-k only): 3 matmuls over N*k rows
+    flops_sparse = (
+        2 * seq * cfg.num_experts_per_tok * 3 * cfg.hidden_size * cfg.intermediate_size
+    )
+    result = {
+        "label": f"moe_prefill_{seq}",
+        "dense_ms": round(times["dense"] * 1e3, 1),
+        "sparse_ms": round(times["sparse"] * 1e3, 1),
+        "speedup": round(times["dense"] / times["sparse"], 2),
+        "flops_ratio_expected": round(cfg.num_local_experts / cfg.num_experts_per_tok, 1),
+        "sparse_tflops_useful": round(flops_sparse / times["sparse"] / 1e12, 1),
+    }
+    del params, x, fns
     gc.collect()
     return result
 
@@ -530,6 +599,11 @@ def main():
     bd = bench_batched_decode(llama7b_cfg())
     details["decode_7b_batched"] = bd
     print(f"# batched decode: {json.dumps(bd)}", file=sys.stderr)
+
+    # sparse vs dense MoE dispatch at prefill (mixtral-8x7B shapes, 1 layer)
+    moe = bench_moe_dispatch()
+    details["moe_prefill_2048"] = moe
+    print(f"# moe dispatch: {json.dumps(moe)}", file=sys.stderr)
 
     # 405B rehearsal: placement math + single-stream projection from THIS
     # run's measured bandwidths (benchmarks/rehearsal_405b.py; the north-star

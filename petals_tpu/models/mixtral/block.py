@@ -5,12 +5,21 @@ Capability parity with the reference's WrappedMixtralBlock
 on the hosting server (no cross-server expert parallelism, matching the
 reference), GQA attention with optional sliding window, top-k softmax routing.
 
-TPU-first MoE: instead of torch's per-expert gather/index_add loop, routing is
-expressed densely — every expert runs over every token (stacked expert weights,
-one batched einsum per projection) and a top-k one-hot combine weights the
-results. For 8 experts this keeps the MXU busy with static shapes and zero
-scatter; expert-sharded ("ep" axis) megablocks are the optimization path for
-larger expert counts.
+TPU-first MoE, two dispatch modes sharing HF-exact routing:
+
+- DENSE (decode + sharded/quantized paths): every expert runs over every token
+  (stacked expert weights, one batched einsum per projection) with a top-k
+  one-hot combine. At M=1 decode this is free — the step is weight-bandwidth
+  bound and dense compute keeps static shapes with zero scatter.
+- SPARSE (prefill, round-3): assignments are sorted by expert and the three
+  projections run as grouped matmuls via ``jax.lax.ragged_dot`` (static total
+  size N*k, dynamic per-expert group sizes), so prefill FLOPs scale with
+  top-k instead of num_experts (4x fewer for top-2-of-8) — the
+  megablocks-style dispatch expressed in XLA's native ragged op instead of
+  CUDA kernels. Tokens are never dropped (no GShard capacity factor);
+  outputs match the dense path's to within accumulation precision (the
+  sparse combine runs in f32 where the dense combine rounds the routing
+  weights to the compute dtype).
 """
 
 from __future__ import annotations
@@ -28,7 +37,34 @@ from petals_tpu.ops.attention import attend_maybe_ring
 from petals_tpu.ops.rotary import apply_rotary, rotary_tables
 
 
-def moe_apply(params: dict, x: jnp.ndarray, cfg: MixtralBlockConfig) -> jnp.ndarray:
+# prefill chunks at or above this many tokens take the sparse (ragged_dot)
+# dispatch; below it (decode especially) dense all-experts compute wins
+SPARSE_MIN_SEQ = 8
+
+
+def _moe_sparse(x, w1, w2, w3, top_idx, top_probs, cfg) -> jnp.ndarray:
+    """Grouped-matmul dispatch: FLOPs proportional to N * top_k."""
+    b, s, h = x.shape
+    E, k = cfg.num_local_experts, cfg.num_experts_per_tok
+    n_assign = b * s * k
+    xf = x.reshape(b * s, h)
+    flat_experts = top_idx.reshape(n_assign)
+    order = jnp.argsort(flat_experts, stable=True)  # group assignments by expert
+    token_of = order // k
+    xg = jnp.take(xf, token_of, axis=0)  # [N*k, h]
+    group_sizes = jnp.bincount(flat_experts, length=E).astype(jnp.int32)
+    g1 = jax.lax.ragged_dot(xg, w1, group_sizes)
+    g3 = jax.lax.ragged_dot(xg, w3, group_sizes)
+    out = jax.lax.ragged_dot(silu(g1) * g3, w2, group_sizes)  # [N*k, h]
+    wts = jnp.take(top_probs.reshape(n_assign), order).astype(jnp.float32)
+    y = jnp.zeros((b * s, h), jnp.float32)
+    y = y.at[token_of].add(out.astype(jnp.float32) * wts[:, None])
+    return y.astype(x.dtype).reshape(b, s, h)
+
+
+def moe_apply(
+    params: dict, x: jnp.ndarray, cfg: MixtralBlockConfig, *, sparse: bool = False
+) -> jnp.ndarray:
     """x: [batch, seq, hidden] -> mixture of top-k experts, HF-exact routing."""
     from petals_tpu.ops.quant import QuantizedLinear, quant_matmul
 
@@ -37,11 +73,13 @@ def moe_apply(params: dict, x: jnp.ndarray, cfg: MixtralBlockConfig) -> jnp.ndar
     top_probs, top_idx = jax.lax.top_k(probs, cfg.num_experts_per_tok)  # [b, s, k]
     top_probs = top_probs / top_probs.sum(axis=-1, keepdims=True)
 
+    w1, w2, w3 = params["w1"], params["w2"], params["w3"]
+    if sparse and not isinstance(w1, QuantizedLinear):
+        return _moe_sparse(x, w1, w2, w3, top_idx, top_probs, cfg)
+
     # combine weights per expert: [b, s, E]
     one_hot = jax.nn.one_hot(top_idx, cfg.num_local_experts, dtype=top_probs.dtype)
     combine = (one_hot * top_probs[..., None]).sum(axis=2).astype(x.dtype)
-
-    w1, w2, w3 = params["w1"], params["w2"], params["w3"]
     if isinstance(w1, QuantizedLinear):
         # Quantized experts: run each expert through quant_matmul (the fused
         # NF4 kernel on TPU) — dense expert weights are never materialized, so
@@ -99,7 +137,10 @@ def block_apply(
 
     residual = hidden_states
     x = rms_norm(hidden_states, params["ln2"], cfg.rms_norm_eps)
-    hidden_states = residual + moe_apply(params, x, cfg)
+    # sparse dispatch at prefill lengths, single-device only (under an ep/tp
+    # mesh the dense einsums carry the expert shardings; ragged groups don't)
+    sparse = seq >= SPARSE_MIN_SEQ and tp_mesh is None and ring_mesh is None
+    hidden_states = residual + moe_apply(params, x, cfg, sparse=sparse)
 
     new_kv = (k_all, v_all) if kv is not None else None
     return hidden_states, new_kv

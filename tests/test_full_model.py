@@ -194,6 +194,12 @@ def test_mixtral_full_model(tmp_path_factory):
             ours = model.generate(input_ids, max_new_tokens=5)
             expected = _hf_greedy(path, input_ids, 5)
             np.testing.assert_array_equal(ours, expected)
+
+            # a >= SPARSE_MIN_SEQ prompt exercises the sparse (ragged_dot)
+            # MoE dispatch in the serving prefill; still token-identical
+            long_ids = rng.randint(0, 100, (1, 12)).astype(np.int64)
+            ours_long = model.generate(long_ids, max_new_tokens=4)
+            np.testing.assert_array_equal(ours_long, _hf_greedy(path, long_ids, 4))
         finally:
             model.close()
     finally:
