@@ -110,13 +110,25 @@ class QuantizedLinear:
 # ----------------------------------------------------------------------------------
 
 
-def quantize_int8(w: jnp.ndarray) -> QuantizedLinear:
-    """Symmetric per-output-channel int8 (w: [in, out])."""
-    w = jnp.asarray(w)
+@jax.jit
+def _encode_int8(w: jnp.ndarray):
     absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)  # [out]
     scale = jnp.maximum(absmax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
-    return QuantizedLinear("int8", q, scale.astype(jnp.float32), w.shape[0], w.shape[1])
+    return q, scale
+
+
+def quantize_int8(w: jnp.ndarray) -> QuantizedLinear:
+    """Symmetric per-output-channel int8 (w: [in, out]). Rows are zero-padded
+    to the Pallas k-tile like the 4-bit formats (int8 zero rows are exact), so
+    the fused kernel tiles cleanly; in_features records the logical size."""
+    w = jnp.asarray(w)
+    n_in, n_out = w.shape
+    pad = (-n_in) % _TK
+    if pad:
+        w = jnp.concatenate([w, jnp.zeros((pad, n_out), w.dtype)], axis=0)
+    q, scale = _encode_int8(w)
+    return QuantizedLinear("int8", q, scale.astype(jnp.float32), n_in, n_out)
 
 
 def _pad_rows(w: jnp.ndarray):
@@ -199,7 +211,10 @@ def quantize(w: jnp.ndarray, kind: str) -> QuantizedLinear:
 def dequantize(q: QuantizedLinear, dtype=jnp.bfloat16) -> jnp.ndarray:
     """Reference (XLA) dequantization; handles leading stack axes."""
     if q.kind == "int8":
-        return (q.data.astype(jnp.float32) * q.scales[..., None, :]).astype(dtype)
+        deq = (q.data.astype(jnp.float32) * q.scales[..., None, :]).astype(dtype)
+        if deq.shape[-2] != q.in_features:  # stored padding (see quantize_int8)
+            deq = deq[..., : q.in_features, :]
+        return deq
     lo = (q.data & 0x0F).astype(jnp.int32)
     hi = (q.data >> 4).astype(jnp.int32)
     if q.kind == "int4":
@@ -225,8 +240,8 @@ def quant_matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     are frozen server-side, like the reference's quantized blocks)."""
     if isinstance(w, StackedQuantLinear):
         # inference-only fast path (backend scan consts + traced block index);
-        # the 4-bit kinds DMA straight from the stacked bytes, int8 (and any
-        # shape the kernel can't tile) falls back to slice + XLA dequant
+        # all three quant kinds DMA straight from the stacked bytes; any shape
+        # the kernels can't tile falls back to slice + XLA dequant
         lead = x.shape[:-1]
         x2d = x.reshape(-1, w.in_features)
         if (
@@ -236,6 +251,13 @@ def quant_matmul(x: jnp.ndarray, w) -> jnp.ndarray:
             and _nf4_pallas_supported(x2d, w.data[0])
         ):
             out = packed4_matmul_pallas_stacked(x2d, w)
+        elif (
+            w.kind == "int8"
+            and not _FORCE_XLA_PATH.get()
+            and jax.default_backend() == "tpu"
+            and _int8_pallas_supported(x2d, w.data[0])
+        ):
+            out = int8_matmul_pallas_stacked(x2d, w)
         else:
             sliced = QuantizedLinear(
                 w.kind,
@@ -248,9 +270,9 @@ def quant_matmul(x: jnp.ndarray, w) -> jnp.ndarray:
         return out.reshape(*lead, w.out_features).astype(x.dtype)
     if not isinstance(w, QuantizedLinear):
         return x @ w
-    if w.kind in ("nf4", "int4"):
+    if w.kind in ("nf4", "int4", "int8"):
         lead = x.shape[:-1]
-        mm = _nf4_mm if w.kind == "nf4" else _int4_mm
+        mm = {"nf4": _nf4_mm, "int4": _int4_mm, "int8": _int8_mm}[w.kind]
         out = mm(x.reshape(-1, w.in_features), w.data, w.scales)
         return out.reshape(*lead, w.out_features).astype(x.dtype)
     return (x.astype(jnp.bfloat16) @ dequantize(w, jnp.bfloat16)).astype(x.dtype)
@@ -377,29 +399,36 @@ def _nf4_pallas_supported(x2d, data) -> bool:
     return n_stored % _TK == 0 and n_out % _TN_MIN == 0 and data.ndim == 2
 
 
-def _q4_mm_fwd_impl(kind, x2d, data, scales):
+def _quant_mm_fwd_impl(kind, x2d, data, scales):
     # logical in_features comes from x; data rows may be padded to the k-tile
     w = QuantizedLinear(kind, data, scales, x2d.shape[-1], data.shape[-1])
-    is_decode = x2d.shape[0] <= _NF4_DECODE_MAX_M
-    # int4's affine decode is never VPU-bound: always take the fused kernel
-    use_pallas_at_decode = _NF4_DECODE_USE_PALLAS or kind == "int4"
-    if (
-        not _FORCE_XLA_PATH.get()
-        and jax.default_backend() == "tpu"
-        and _nf4_pallas_supported(x2d, data)
-        and (use_pallas_at_decode or not is_decode)
-    ):
-        return packed4_matmul_pallas(x2d, w)
+    on_tpu = not _FORCE_XLA_PATH.get() and jax.default_backend() == "tpu"
+    if kind == "int8":
+        if on_tpu and _int8_pallas_supported(x2d, data):
+            return int8_matmul_pallas(x2d, w)
+    else:
+        is_decode = x2d.shape[0] <= _NF4_DECODE_MAX_M
+        # int4's affine decode is never VPU-bound: always take the fused kernel
+        use_pallas_at_decode = _NF4_DECODE_USE_PALLAS or kind == "int4"
+        if (
+            on_tpu
+            and _nf4_pallas_supported(x2d, data)
+            and (use_pallas_at_decode or not is_decode)
+        ):
+            return packed4_matmul_pallas(x2d, w)
     return (x2d.astype(jnp.bfloat16) @ dequantize(w, jnp.bfloat16)).astype(x2d.dtype)
 
 
-def _make_q4_mm(kind: str):
+def _make_quant_mm(kind: str):
+    """custom_vjp wrapper: kernel/XLA forward, dequant-transpose backward for
+    the input (weights are frozen server-side, like the reference's blocks)."""
+
     @jax.custom_vjp
-    def q4_mm(x2d, data, scales):
-        return _q4_mm_fwd_impl(kind, x2d, data, scales)
+    def quant_mm(x2d, data, scales):
+        return _quant_mm_fwd_impl(kind, x2d, data, scales)
 
     def fwd(x2d, data, scales):
-        return _q4_mm_fwd_impl(kind, x2d, data, scales), (data, scales, x2d.shape[-1])
+        return _quant_mm_fwd_impl(kind, x2d, data, scales), (data, scales, x2d.shape[-1])
 
     def bwd(res, g):
         data, scales, n_in = res
@@ -410,18 +439,70 @@ def _make_q4_mm(kind: str):
         d_scales = jnp.zeros_like(scales)
         return dx, d_data, d_scales
 
-    q4_mm.defvjp(fwd, bwd)
-    return q4_mm
+    quant_mm.defvjp(fwd, bwd)
+    return quant_mm
 
 
-_nf4_mm = _make_q4_mm("nf4")
-_int4_mm = _make_q4_mm("int4")
+_nf4_mm = _make_quant_mm("nf4")
+_int4_mm = _make_quant_mm("int4")
+_int8_mm = _make_quant_mm("int8")
 
 
 # ----------------------------------------------------------------------------------
 # Pallas NF4 dequant-matmul kernel
 # ----------------------------------------------------------------------------------
 
+
+
+def _spec_makers(stacked: bool):
+    """(wspec, aspec) BlockSpec builders shared by the quant kernels. Weight
+    operands in STACKED mode carry a leading block axis selected by the
+    prefetched scalar index; activation/table specs ignore it."""
+    if stacked:
+        def wspec(shape, imap):
+            return pl.BlockSpec(
+                (1, *shape), lambda mi, n, k, idx_ref, _f=imap: (idx_ref[0], *_f(mi, n, k))
+            )
+
+        def aspec(shape, imap):
+            return pl.BlockSpec(shape, lambda mi, n, k, idx_ref, _f=imap: _f(mi, n, k))
+    else:
+        def wspec(shape, imap):
+            return pl.BlockSpec(shape, lambda mi, n, k, _f=imap: _f(mi, n, k))
+
+        aspec = wspec
+    return wspec, aspec
+
+
+def _quant_pallas_call(
+    kernel, *, grid, in_specs, out_spec, out_shape, tm, tn,
+    interpret, stacked, index, operands,
+):
+    """Shared pallas_call dispatch for the quant kernels: plain grid for a
+    single weight, PrefetchScalarGridSpec with the traced block index for the
+    span-stacked variants."""
+    common = dict(
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )
+    scratch = [pltpu.VMEM((tm, tn), jnp.float32)]
+    if stacked:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_spec,
+            scratch_shapes=scratch,
+        )
+        idx = jnp.asarray(index, jnp.int32).reshape(1)
+        return pl.pallas_call(kernel, grid_spec=grid_spec, **common)(idx, *operands)
+    return pl.pallas_call(
+        kernel, grid=grid, in_specs=in_specs, out_specs=out_spec,
+        scratch_shapes=scratch, **common,
+    )(*operands)
 
 
 def _extract_codes(packed):
@@ -624,21 +705,7 @@ def _packed4_call(x, kind, data, scales, *, index=None, interpret=None):
     xe, xo = xb[:, 0::2], xb[:, 1::2]
     hk = tk // 2
 
-    if stacked:
-        # weight operands carry a leading block axis selected by the
-        # prefetched scalar index; activation/table specs ignore it
-        def wspec(shape, imap):
-            return pl.BlockSpec(
-                (1, *shape), lambda mi, n, k, idx_ref, _f=imap: (idx_ref[0], *_f(mi, n, k))
-            )
-
-        def aspec(shape, imap):
-            return pl.BlockSpec(shape, lambda mi, n, k, idx_ref, _f=imap: _f(mi, n, k))
-    else:
-        def wspec(shape, imap):
-            return pl.BlockSpec(shape, lambda mi, n, k, _f=imap: _f(mi, n, k))
-
-        aspec = wspec
+    wspec, aspec = _spec_makers(stacked)
 
     x_specs = [
         aspec((tm, hk), lambda mi, n, k: (mi, k)),
@@ -669,32 +736,11 @@ def _packed4_call(x, kind, data, scales, *, index=None, interpret=None):
         body = _packed4_kernel_stacked if stacked else _packed4_kernel
 
     kernel = functools.partial(body, n_k=n_k, kind=kind, dot_in_f32=interpret)
-    common = dict(
-        out_shape=jax.ShapeDtypeStruct((mp, n_out), x.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-        interpret=interpret,
+    out = _quant_pallas_call(
+        kernel, grid=(n_m, n_n, n_k), in_specs=in_specs, out_spec=out_spec,
+        out_shape=jax.ShapeDtypeStruct((mp, n_out), x.dtype), tm=tm, tn=tn,
+        interpret=interpret, stacked=stacked, index=index, operands=operands,
     )
-    if stacked:
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(n_m, n_n, n_k),
-            in_specs=in_specs,
-            out_specs=out_spec,
-            scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
-        )
-        idx = jnp.asarray(index, jnp.int32).reshape(1)
-        out = pl.pallas_call(kernel, grid_spec=grid_spec, **common)(idx, *operands)
-    else:
-        out = pl.pallas_call(
-            kernel,
-            grid=(n_m, n_n, n_k),
-            in_specs=in_specs,
-            out_specs=out_spec,
-            scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
-            **common,
-        )(*operands)
     return out[:m] if m_pad else out
 
 
@@ -759,6 +805,94 @@ def packed4_matmul_pallas_stacked(
     return _packed4_call(
         x, w.kind, w.data, w.scales, index=w.index, interpret=interpret
     )
+
+
+def _int8_kernel(x_ref, w_ref, scales_ref, o_ref, acc_ref, *, n_k: int, dot_in_f32: bool):
+    """Grid (m, n, k): accumulate x_tile @ int8_tile with ONE cast per weight
+    element (int8 values are exact in bf16); the per-output-channel scale
+    multiplies the [tm, tn] accumulator once at store — int8's decode is
+    entirely free of per-element scale work, so the kernel streams at int4's
+    structural rate with half the compression (8.25 bits/param)."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    dot_dtype = jnp.float32 if dot_in_f32 else jnp.bfloat16
+    # Mosaic has no direct 8-bit -> bf16 cast; widen via int32
+    w = w_ref[...].astype(jnp.int32).astype(dot_dtype)
+    x = x_ref[...]
+    if dot_in_f32:
+        x = x.astype(jnp.float32)
+    acc_ref[...] += jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(k == n_k - 1)
+    def _store():
+        o_ref[...] = (acc_ref[...] * scales_ref[0, :].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _int8_kernel_stacked(idx_ref, x_ref, w_ref, scales_ref, o_ref, acc_ref, **kw):
+    _int8_kernel(x_ref, w_ref.at[0], scales_ref.at[0], o_ref, acc_ref, **kw)
+
+
+def _int8_pallas_supported(x2d, data) -> bool:
+    n_stored, n_out = data.shape[-2], data.shape[-1]
+    return n_stored % _TK == 0 and n_out % _TN_MIN == 0 and data.ndim == 2
+
+
+def _int8_call(x, data, scales, *, index=None, interpret=None):
+    """Fused int8 matmul, single ([in, out] int8) or stacked ([n_blocks, in,
+    out] + traced block index). One kernel covers decode and prefill: there is
+    no per-element decode work to restructure (contrast _packed4_call)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    stacked = data.ndim == 3
+    m, n_in = x.shape
+    n_stored, n_out = data.shape[-2], data.shape[-1]
+    if n_stored != n_in:  # stored padding rows are exact zeros; pad x to match
+        x = jnp.pad(x, ((0, 0), (0, n_stored - n_in)))
+    tk, tn = _pick_tiles(n_stored, n_out)
+    n_k, n_n = n_stored // tk, n_out // tn
+    tm = min(_TM, _round_up(m, 8))
+    m_pad = (-m) % tm
+    if m_pad:
+        x = jnp.pad(x, ((0, m_pad), (0, 0)))
+    mp = x.shape[0]
+    n_m = mp // tm
+    xb = x.astype(jnp.bfloat16)
+    scales2d = scales.reshape(*scales.shape[:-1], 1, n_out)  # [(,B) 1, out]
+
+    wspec, aspec = _spec_makers(stacked)
+    in_specs = [
+        aspec((tm, tk), lambda mi, n, k: (mi, k)),
+        wspec((tk, tn), lambda mi, n, k: (k, n)),
+        wspec((1, tn), lambda mi, n, k: (0, n)),
+    ]
+    out_spec = aspec((tm, tn), lambda mi, n, k: (mi, n))
+    kernel = functools.partial(_int8_kernel_stacked if stacked else _int8_kernel,
+                               n_k=n_k, dot_in_f32=interpret)
+    out = _quant_pallas_call(
+        kernel, grid=(n_m, n_n, n_k), in_specs=in_specs, out_spec=out_spec,
+        out_shape=jax.ShapeDtypeStruct((mp, n_out), x.dtype), tm=tm, tn=tn,
+        interpret=interpret, stacked=stacked, index=index,
+        operands=(xb, data, scales2d),
+    )
+    return out[:m] if m_pad else out
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int8_matmul_pallas(x: jnp.ndarray, w: QuantizedLinear, *, interpret: bool | None = None):
+    """x: [M, in] -> [M, out] with fused int8 dequantization."""
+    return _int8_call(x, w.data, w.scales, interpret=interpret)
+
+
+def int8_matmul_pallas_stacked(
+    x: jnp.ndarray, w: StackedQuantLinear, *, interpret: bool | None = None
+):
+    return _int8_call(x, w.data, w.scales, index=w.index, interpret=interpret)
 
 
 def _round_up(x: int, m: int) -> int:

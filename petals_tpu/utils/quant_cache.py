@@ -49,6 +49,11 @@ from petals_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 _PREFIX = "quantized--"
+# storage-layout version, part of every entry name: bump when the on-device
+# array layout of a quant kind changes (e.g. round-3 "f2": int8 rows padded to
+# the Pallas k-tile) so stale-format entries become misses instead of shape
+# mismatches inside the span stack
+_FORMAT = "f2"
 _BF16 = jnp.bfloat16.dtype
 
 
@@ -87,7 +92,7 @@ def cache_path(
     fp = checkpoint_fingerprint(model_name_or_path, revision)
     unit = (
         f"{_PREFIX}{_sanitize(model_name_or_path)}--{_sanitize(revision)}--{fp}"
-        f"--{quant_type}{'-fused' if fuse else ''}-{dtype_tag}--block{block_index}"
+        f"--{quant_type}{'-fused' if fuse else ''}-{dtype_tag}-{_FORMAT}--block{block_index}"
     )
     return base / unit / "block.npz"
 

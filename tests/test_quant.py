@@ -25,8 +25,11 @@ def test_int8_roundtrip_error():
     rng = np.random.RandomState(0)
     w = rng.randn(128, 256).astype(np.float32)
     q = quantize_int8(w)
-    assert q.data.dtype == jnp.int8 and q.data.shape == (128, 256)
+    # rows pad to the Pallas k-tile (zero rows are exact for int8); the
+    # logical size is recorded and dequantize slices back to it
+    assert q.data.dtype == jnp.int8 and q.data.shape[0] >= 128 and q.in_features == 128
     deq = np.asarray(dequantize(q, jnp.float32))
+    assert deq.shape == (128, 256)
     # symmetric per-channel int8: error bounded by scale/2 per channel
     scale = np.abs(w).max(axis=0) / 127
     assert (np.abs(deq - w) <= scale[None, :] * 0.5 + 1e-6).all()
@@ -93,6 +96,35 @@ def test_packed4_pallas_stacked_matches_xla(quantizer, m):
         sq = StackedQuantLinear(qs[0].kind, data, scales, jnp.int32(idx), 512, 256)
         expected = x @ np.asarray(dequantize(qs[idx], jnp.float32))
         got = np.asarray(packed4_matmul_pallas_stacked(jnp.asarray(x), sq))
+        np.testing.assert_allclose(got, expected, atol=2e-2, rtol=1e-2)
+
+
+@pytest.mark.parametrize("m", [1, 40])
+def test_int8_pallas_matches_xla(m):
+    from petals_tpu.ops.quant import int8_matmul_pallas
+
+    rng = np.random.RandomState(4)
+    w = (rng.randn(512, 256) * 0.05).astype(np.float32)
+    x = rng.randn(m, 512).astype(np.float32)
+    q = quantize_int8(w)
+    expected = x @ np.asarray(dequantize(q, np.float32))
+    got = np.asarray(int8_matmul_pallas(jnp.asarray(x), q))
+    np.testing.assert_allclose(got, expected, atol=2e-2, rtol=1e-2)
+
+
+@pytest.mark.parametrize("m", [1, 40])
+def test_int8_pallas_stacked_matches_xla(m):
+    from petals_tpu.ops.quant import StackedQuantLinear, int8_matmul_pallas_stacked
+
+    rng = np.random.RandomState(5)
+    x = rng.randn(m, 512).astype(np.float32)
+    qs = [quantize_int8((rng.randn(512, 256) * 0.05).astype(np.float32)) for _ in range(3)]
+    data = jnp.stack([q.data for q in qs])
+    scales = jnp.stack([q.scales for q in qs])
+    for idx in (0, 2):
+        sq = StackedQuantLinear("int8", data, scales, jnp.int32(idx), 512, 256)
+        expected = x @ np.asarray(dequantize(qs[idx], np.float32))
+        got = np.asarray(int8_matmul_pallas_stacked(jnp.asarray(x), sq))
         np.testing.assert_allclose(got, expected, atol=2e-2, rtol=1e-2)
 
 

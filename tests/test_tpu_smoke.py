@@ -74,6 +74,23 @@ def test_flash_attention_matches_xla_reference(tpu):
         assert err < 2e-2, f"flash mismatch {err} at {(q_len, kv_len, hq, hkv, window, alibi)}"
 
 
+def test_int8_kernel_matches_dequant_matmul(tpu):
+    import jax
+    import jax.numpy as jnp
+
+    from petals_tpu.ops import quant as Q
+
+    key = jax.random.PRNGKey(3)
+    w = jax.random.normal(key, (4096, 11008), jnp.bfloat16) * 0.02
+    q = Q.quantize(w, "int8")
+    for m in (1, 200):
+        x = jax.random.normal(jax.random.fold_in(key, m), (m, 4096), jnp.bfloat16) * 0.1
+        want = (x @ Q.dequantize(q, jnp.bfloat16)).astype(jnp.float32)
+        got = Q.int8_matmul_pallas(x, q)
+        err = _rel_err(got, want)
+        assert err < 2e-2, f"int8 single M={m}: {err}"
+
+
 @pytest.mark.parametrize("kind", ["nf4", "int4"])
 def test_packed4_kernels_match_dequant_matmul(tpu, kind):
     import jax
