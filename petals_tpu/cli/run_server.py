@@ -37,6 +37,11 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=[k for k in DTYPE_MAP if k != "auto"], help="Compute dtype")
     parser.add_argument("--quant_type", default="none", choices=["none", "int8", "nf4", "int4"],
                         help="Weight quantization (ops/quant.py)")
+    parser.add_argument("--coordinator_address", default=None,
+                        help="multi-host serving: jax.distributed coordinator (host:port); "
+                             "start num_hosts-1 run_worker processes with the same flags")
+    parser.add_argument("--num_hosts", type=int, default=1,
+                        help="multi-host serving: total processes incl. this leader")
     parser.add_argument("--no_quant_weight_cache", action="store_true",
                         help="Re-quantize at every start instead of persisting packed "
                              "quantized blocks in the disk cache (utils/quant_cache.py)")
@@ -177,6 +182,8 @@ def main(argv=None) -> None:
         revision=args.revision,
         cache_dir=args.cache_dir,
         quant_weight_cache=not args.no_quant_weight_cache,
+        coordinator_address=args.coordinator_address,
+        num_hosts=args.num_hosts,
     )
 
     async def run():

@@ -1,0 +1,371 @@
+"""Multi-host serving: one SPAN-server spanning several hosts' chips.
+
+The reference cannot express this at all — its tensor parallelism is bounded
+by one machine's GPUs (`tensor_parallel` over local CUDA devices, reference
+convert_block.py:118-135). On a v5e-64 (16 hosts x 4 chips) a 405B block
+sharded past 4 chips needs tensor parallelism ACROSS hosts, which in JAX is
+multi-controller SPMD: every participating process runs the same jitted
+computation over a global mesh, with XLA collectives riding ICI/DCN.
+
+Architecture (TPU-first; there is no torch/NCCL analogue to port):
+
+- ``init_multihost`` wraps ``jax.distributed.initialize`` — afterwards
+  ``jax.devices()`` spans all hosts and a Mesh built from it shards params and
+  KV caches across every chip of every host.
+- Only the LEADER (process 0) runs the swarm surface (DHT, RPC handler,
+  scheduler, memory-cache budgeting). Workers (``cli/run_worker.py``) build
+  the identical backend from the identical checkpoint and sit in
+  ``LockstepWorker.run``: multi-controller JAX requires every process to enter
+  every jitted computation together, so each leader-side compute call
+  broadcasts a compact descriptor (``multihost_utils.broadcast_one_to_all``)
+  and the workers invoke the same backend method on their shards.
+- KV buffers are mirrored by HANDLE: the leader's MemoryCache reserves
+  handles/budget as usual but broadcasts ALLOC/FREE (``LockstepMemoryCache``),
+  and each process materializes its own shards of the same logical buffer.
+  Only handles and replicated activations cross the control plane — KV shards
+  never move between hosts outside XLA collectives.
+- Array creation (zeros, device_put of identical host values) is process-local
+  in multi-controller JAX; the actual cross-host traffic is the in-program
+  collectives (psum/all_gather over the tp axis) plus the tiny control
+  broadcasts.
+
+Known v1 limits (enforced with clean errors at server start): session KV
+export/import (migration, drain-parking) and live rebalancing are disabled —
+both move whole KV buffers through the host, which is a per-shard gather this
+control plane does not do yet. Throughput must be given explicitly (the
+auto-probe builds throwaway backends workers don't mirror).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+OP_SHUTDOWN = 0
+OP_ALLOC = 1
+OP_FREE = 2
+OP_INFERENCE_STEP = 3
+OP_FORWARD = 4
+OP_BACKWARD = 5
+
+_HEADER_LEN = 14
+_FLAG_PROMPTS = 1
+_FLAG_HYPO = 2
+
+# One lockstep op (header + operand broadcasts + the jitted compute) must hit
+# the group atomically: ALLOC/FREE run on the asyncio event-loop thread while
+# compute ops run on the PriorityTaskQueue thread — interleaved broadcasts
+# would pair a worker's operand wait with the wrong leader collective and hang
+# the group. (Workers are single-threaded; only the leader needs the lock.)
+_BCAST_LOCK = threading.RLock()
+
+
+def init_multihost(coordinator_address: str, num_processes: int, process_id: int) -> None:
+    """Join the multi-controller group. Must run before ANYTHING initializes
+    the XLA backend (even jax.devices()) — hence the module flag instead of
+    querying jax state."""
+    import jax
+
+    if getattr(init_multihost, "_done", False):
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    init_multihost._done = True
+    logger.info(
+        f"multihost: process {jax.process_index()}/{jax.process_count()}, "
+        f"{len(jax.local_devices())} local / {len(jax.devices())} global devices"
+    )
+
+
+def multihost_mesh(tp: Optional[int] = None):
+    """tp serving mesh over the GLOBAL device set (all hosts' chips)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    tp = tp or len(devices)
+    if len(devices) < tp:
+        raise ValueError(
+            f"multihost mesh tp={tp} needs {tp} devices, {len(devices)} "
+            f"available across {jax.process_count()} processes"
+        )
+    return Mesh(np.array(devices[:tp]).reshape(tp), ("tp",))
+
+
+def _bcast_header(values=None):
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    header = np.zeros((_HEADER_LEN,), np.int64)
+    if values is not None:
+        header[: len(values)] = values
+    return np.asarray(
+        multihost_utils.broadcast_one_to_all(jnp.asarray(header))
+    ).tolist()
+
+
+def _bcast_array(arr, shape, dtype):
+    """Broadcast one operand (leader sends; workers pass zeros of the
+    announced shape — broadcast_one_to_all needs identical avals everywhere)."""
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    if arr is None:
+        arr = np.zeros(shape, dtype)
+    return np.asarray(
+        multihost_utils.broadcast_one_to_all(jnp.asarray(arr, dtype).reshape(shape))
+    )
+
+
+class _LockstepMixin:
+    """Shared op encoding for leader and worker."""
+
+    def _replicate_fn(self, mesh):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # Outputs can come back sharded across PROCESSES (XLA's choice);
+        # np.asarray on a non-addressable array raises. This jitted constraint
+        # all_gathers INSIDE the program — a collective every process enters
+        # (a host-side gather only the leader runs would deadlock the group).
+        return jax.jit(
+            lambda o: jax.lax.with_sharding_constraint(o, NamedSharding(mesh, P()))
+        )
+
+
+class LockstepBackend(_LockstepMixin):
+    """Leader-side wrapper with the TransformerBackend surface the handler and
+    server use. Attribute access falls through to the wrapped backend; the
+    compute methods broadcast before computing. ``handles`` identifies the
+    session's KV mirror on the workers (pass the k-handle)."""
+
+    # class attribute (NOT via __getattr__, which only fires for misses):
+    # handler gates sub-span wrapping and KV export/import on this
+    is_lockstep = True
+
+    def __init__(self, backend, *, span: Tuple[int, int] = None):
+        self._backend = backend
+        self._span = span or (0, backend.n_blocks)
+        self._replicate = self._replicate_fn(backend.mesh)
+
+    def __getattr__(self, name):
+        return getattr(self._backend, name)
+
+    def sub_view(self, backend_slice, start: int, end: int) -> "LockstepBackend":
+        """Lockstep view over a partial chain (handler._sub_backend)."""
+        base = self._span[0]
+        return LockstepBackend(backend_slice, span=(base + start, base + end))
+
+    # ------------------------------------------------------------- compute ops
+
+    def inference_step(self, hidden, kv, position, *, prompts=None, hypo_ids=None,
+                       active_adapter=None, handles=None):
+        if active_adapter:
+            raise NotImplementedError("LoRA adapters are not supported with multi-host serving yet")
+        batch, seq, _ = hidden.shape
+        flags = (_FLAG_PROMPTS if prompts is not None else 0) | (
+            _FLAG_HYPO if hypo_ids is not None else 0
+        )
+        pre_seq = 0 if prompts is None else prompts.shape[2]
+        mirror = -1 if handles is None else int(handles[0])
+        b0, b1 = self._span
+        with _BCAST_LOCK:
+            _bcast_header([
+                OP_INFERENCE_STEP, mirror, batch, seq, int(position), -1, flags,
+                pre_seq, 0, b0, b1,
+            ])
+            hidden = _bcast_array(hidden, (batch, seq, self._backend.hidden_size), np.float32)
+            if prompts is not None:
+                prompts = _bcast_array(
+                    prompts,
+                    (b1 - b0, batch, pre_seq, self._backend.hidden_size),
+                    np.float32,
+                )
+            if hypo_ids is not None:
+                hypo_ids = _bcast_array(hypo_ids, (batch,), np.int64)
+            out, new_kv = self._backend.inference_step(
+                hidden, kv, position, prompts=prompts, hypo_ids=hypo_ids
+            )
+            return self._replicate(out), new_kv
+
+    def forward(self, hidden, *, prompts=None, active_adapter=None):
+        if active_adapter:
+            raise NotImplementedError("LoRA adapters are not supported with multi-host serving yet")
+        batch, seq, _ = hidden.shape
+        flags = _FLAG_PROMPTS if prompts is not None else 0
+        pre_seq = 0 if prompts is None else prompts.shape[2]
+        b0, b1 = self._span
+        with _BCAST_LOCK:
+            _bcast_header([OP_FORWARD, -1, batch, seq, 0, -1, flags, pre_seq, 0, b0, b1])
+            hidden = _bcast_array(hidden, (batch, seq, self._backend.hidden_size), np.float32)
+            if prompts is not None:
+                prompts = _bcast_array(
+                    prompts, (b1 - b0, batch, pre_seq, self._backend.hidden_size), np.float32
+                )
+            return self._replicate(self._backend.forward(hidden, prompts=prompts))
+
+    def backward(self, hidden, grad_out, *, prompts=None, active_adapter=None):
+        if active_adapter:
+            raise NotImplementedError("LoRA adapters are not supported with multi-host serving yet")
+        batch, seq, _ = hidden.shape
+        flags = _FLAG_PROMPTS if prompts is not None else 0
+        pre_seq = 0 if prompts is None else prompts.shape[2]
+        b0, b1 = self._span
+        with _BCAST_LOCK:
+            _bcast_header([OP_BACKWARD, -1, batch, seq, 0, -1, flags, pre_seq, 0, b0, b1])
+            # operand order mirrors the worker's generic decode: hidden, then
+            # prompts (if flagged), then the op-specific grad_out
+            hidden = _bcast_array(hidden, (batch, seq, self._backend.hidden_size), np.float32)
+            if prompts is not None:
+                prompts = _bcast_array(
+                    prompts, (b1 - b0, batch, pre_seq, self._backend.hidden_size), np.float32
+                )
+            grad_out = _bcast_array(grad_out, (batch, seq, self._backend.hidden_size), np.float32)
+            grad_in, grad_prompts = self._backend.backward(hidden, grad_out, prompts=prompts)
+            grad_in = self._replicate(grad_in)
+            if grad_prompts is not None:
+                grad_prompts = self._replicate(grad_prompts)
+            return grad_in, grad_prompts
+
+    def shutdown_workers(self) -> None:
+        with _BCAST_LOCK:
+            _bcast_header([OP_SHUTDOWN])
+
+
+class LockstepMemoryCache:
+    """Leader-side MemoryCache wrapper: identical budget/queueing semantics
+    (delegation), but reservation and free broadcast ALLOC/FREE so every
+    worker mirrors the buffers for the same handles."""
+
+    def __init__(self, memory_cache):
+        self._cache = memory_cache
+        orig_reserve, orig_free = memory_cache._reserve, memory_cache._free
+
+        def reserve(descriptors, alloc_size):
+            handles = orig_reserve(descriptors, alloc_size)
+            # [op, h0, n, batch, max_len, hkv, hd, n_descr]
+            d = descriptors[0]
+            with _BCAST_LOCK:
+                _bcast_header([OP_ALLOC, handles[0], *d.shape, len(descriptors)])
+                # materialize NOW, in lockstep with the workers: creating an
+                # array whose sharding spans processes is itself a
+                # multi-controller computation — a lazy get_buffers on the
+                # leader would deadlock against workers waiting in broadcast
+                memory_cache.get_buffers(*handles)
+            return handles
+
+        def free(handles):
+            if handles:
+                with _BCAST_LOCK:
+                    _bcast_header([OP_FREE, handles[0], len(handles)])
+            orig_free(handles)
+
+        memory_cache._reserve = reserve
+        memory_cache._free = free
+
+    def __getattr__(self, name):
+        return getattr(self._cache, name)
+
+
+class LockstepWorker:
+    """Non-leader process: mirrors allocations and executes the leader's
+    compute ops in lockstep until OP_SHUTDOWN."""
+
+    def __init__(self, backend):
+        self.backend = backend
+        self._kv: Dict[int, Tuple] = {}
+        self._subs: Dict[Tuple[int, int], object] = {}
+        self._replicate = _LockstepMixin()._replicate_fn(backend.mesh)
+
+    def _sub(self, b0: int, b1: int):
+        if (b0, b1) == (0, self.backend.n_blocks):
+            return self.backend
+        key = (b0, b1)
+        if key not in self._subs:
+            from petals_tpu.server.backend import TransformerBackend
+            from petals_tpu.server.memory_cache import MemoryCache
+
+            self._subs[key] = TransformerBackend(
+                self.backend.family,
+                self.backend.cfg,
+                self.backend._slice_params(b0, b1),
+                first_block=self.backend.first_block + b0,
+                n_blocks=b1 - b0,
+                memory_cache=MemoryCache(None),
+                compute_dtype=self.backend.compute_dtype,
+                cache_dtype=self.backend.cache_dtype,
+                max_chunk_size_bytes=self.backend.max_chunk_size_bytes,
+                use_flash=self.backend.use_flash,
+                mesh=self.backend.mesh,
+            )
+        return self._subs[key]
+
+    def run(self) -> None:
+        import jax
+
+        logger.info(f"multihost worker {jax.process_index()}: serving lockstep ops")
+        while True:
+            header = _bcast_header()
+            op = header[0]
+            if op == OP_SHUTDOWN:
+                logger.info("multihost worker: shutdown")
+                return
+            if op == OP_ALLOC:
+                # [op, h0, n, batch, max_len, hkv, hd, n_descr]
+                _, h0, n, batch, max_len = header[:5]
+                # materialize immediately (lockstep with the leader's reserve:
+                # cross-process-sharded zeros are a collective computation)
+                kd, vd = self.backend.cache_descriptors(batch, max_len, 0, n)
+                self._kv[h0] = (kd.make_zeros(), vd.make_zeros())
+                continue
+            if op == OP_FREE:
+                _, h0, _count = header[:3]
+                self._kv.pop(h0, None)
+                continue
+
+            # compute ops: [op, mirror, batch, seq, position, n_valid, flags,
+            #               pre_seq, spare, b0, b1]
+            (_, mirror, batch, seq, position, _n_valid, flags, pre_seq,
+             _spare, b0, b1) = header[:11]
+            hidden = _bcast_array(
+                None, (batch, seq, self.backend.hidden_size), np.float32
+            )
+            prompts = hypo_ids = None
+            if flags & _FLAG_PROMPTS:
+                prompts = _bcast_array(
+                    None, (b1 - b0, batch, pre_seq, self.backend.hidden_size), np.float32
+                )
+            backend = self._sub(b0, b1)
+            if op == OP_INFERENCE_STEP:
+                if flags & _FLAG_HYPO:
+                    hypo_ids = _bcast_array(None, (batch,), np.int64)
+                kv = self._kv[mirror]
+                out, new_kv = backend.inference_step(
+                    hidden, kv, position, prompts=prompts, hypo_ids=hypo_ids
+                )
+                self._kv[mirror] = new_kv
+                self._replicate(out)
+            elif op == OP_FORWARD:
+                self._replicate(backend.forward(hidden, prompts=prompts))
+            elif op == OP_BACKWARD:
+                grad_out = _bcast_array(
+                    None, (batch, seq, self.backend.hidden_size), np.float32
+                )
+                g_in, g_p = backend.backward(hidden, grad_out, prompts=prompts)
+                self._replicate(g_in)
+                if g_p is not None:
+                    self._replicate(g_p)
+            else:
+                raise RuntimeError(f"multihost worker: unknown op {op}")
+
+

@@ -349,6 +349,7 @@ class TransformerBackend:
         prompts: Optional[np.ndarray] = None,  # [n_blocks, batch, pre_seq, hidden]
         hypo_ids: Optional[np.ndarray] = None,  # [batch]
         active_adapter: Optional[str] = None,
+        handles=None,  # session identity for the multi-host lockstep wrapper; unused here
     ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
         """One (chunked-as-needed) inference step over the whole span chain."""
         k_stack, v_stack = kv

@@ -167,6 +167,10 @@ class TransformerHandler:
         (must arrive before any compute so the caches never mix histories)."""
         import jax
 
+        if getattr(self.backend, "is_lockstep", False):
+            raise NotImplementedError(
+                "session KV import is not supported with multi-host serving yet"
+            )
         if position != 0:
             raise ValueError("kv_import must be the first step of a session")
         new_position = int(step["kv_import"]["position"])
@@ -209,6 +213,10 @@ class TransformerHandler:
         buffer gets invalidated) — retry on the fresh buffer. The device->host
         copy is 100s of MB for long contexts, so it runs off the event loop:
         other sessions' steps must not stall behind it."""
+        if getattr(self.backend, "is_lockstep", False):
+            raise NotImplementedError(
+                "session KV export is not supported with multi-host serving yet"
+            )
         bs = slice(b0, b1)
         for attempt in range(20):
             position = reg["position"]
@@ -539,7 +547,7 @@ class TransformerHandler:
                     with device_annotation("inference_step"):
                         out, new_kv = backend.inference_step(
                             hidden, kv, pos, prompts=prompts, hypo_ids=hypo_ids,
-                            active_adapter=active_adapter,
+                            active_adapter=active_adapter, handles=handles,
                         )
                     return np.asarray(out), new_kv
 
@@ -672,6 +680,10 @@ class TransformerHandler:
                 name: (jax.tree_util.tree_map(lambda x: x[start:end], stacked), scaling)
                 for name, (stacked, scaling) in self.backend.adapters.items()
             }
+            if getattr(self.backend, "is_lockstep", False):
+                # multi-host serving: the sliced chain must broadcast its span
+                # so workers execute the same sub-backend in lockstep
+                sub = self.backend.sub_view(sub, start, end)
             self._sub_backends[key] = sub
         return self._sub_backends[key]
 

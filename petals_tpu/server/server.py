@@ -80,7 +80,38 @@ class Server:
         revision: str = "main",  # Hub revision for weight streaming (utils/hub.py)
         cache_dir=None,  # Hub download cache (default PETALS_TPU_CACHE)
         quant_weight_cache: bool = True,  # persist quantized blocks across restarts
+        coordinator_address: Optional[str] = None,  # multi-host: jax.distributed coordinator
+        num_hosts: int = 1,  # multi-host: total processes (this leader + run_worker peers)
     ):
+        self.num_hosts = num_hosts or 1
+        self.coordinator_address = coordinator_address
+        if self.num_hosts > 1:
+            # MUST run before anything touches jax (even jax.devices());
+            # everything below may initialize the XLA backend
+            from petals_tpu.parallel.multihost import init_multihost
+
+            if not coordinator_address:
+                raise ValueError("num_hosts > 1 requires coordinator_address")
+            init_multihost(coordinator_address, self.num_hosts, 0)
+            if first_block is None or num_blocks is None:
+                raise ValueError(
+                    "multi-host serving needs an explicit --first_block/--num_blocks "
+                    "(workers load the identical span; auto-placement would desync them)"
+                )
+            if not isinstance(throughput, (int, float)):
+                raise ValueError(
+                    "multi-host serving needs an explicit numeric --throughput "
+                    "(the auto-probe builds throwaway backends workers don't mirror)"
+                )
+            if adapters:
+                raise ValueError("LoRA adapters are not supported with multi-host serving yet")
+            if (num_sp_devices or 1) > 1:
+                raise ValueError("multi-host serving is tp-only for now (num_sp_devices must be 1)")
+            if mean_balance_check_period:
+                raise ValueError(
+                    "live rebalancing is not supported with multi-host serving "
+                    "(a span move would strand the workers' shards)"
+                )
         self.model_path = model_path
         self.revision = revision
         self.cache_dir = cache_dir
@@ -261,6 +292,12 @@ class Server:
         # max_alloc_timeout caps client-requested allocation waits so one
         # unsatisfiable session can't park at the head of the FIFO forever
         self.memory_cache = MemoryCache(self.attn_cache_bytes, max_alloc_timeout=self.max_alloc_timeout)
+        if self.num_hosts > 1:
+            from petals_tpu.parallel.multihost import LockstepMemoryCache
+
+            # reservation/free broadcast ALLOC/FREE so workers mirror the
+            # session KV buffers by handle
+            self.memory_cache = LockstepMemoryCache(self.memory_cache)
 
         if self._throughput_spec == "auto":
             from petals_tpu.server.throughput import get_server_throughput
@@ -432,6 +469,13 @@ class Server:
         if self._trace_flush_task is not None:
             self._trace_flush_task.cancel()
         stop_jax_trace()
+        if self.num_hosts > 1 and self.backend is not None:
+            # release the lockstep workers before the handler dies — they sit
+            # in a blocking broadcast wait otherwise
+            try:
+                self.backend.shutdown_workers()
+            except Exception as e:
+                logger.warning(f"multihost worker shutdown broadcast failed: {e!r}")
         if self.handler is not None:
             self.handler.shutdown()
         if self._relay_registrar is not None:
@@ -488,8 +532,13 @@ class Server:
 
     def _load_span_params(self, first_block: int, num_blocks: int):
         # fused qkv/gate-up halves the Pallas call count at decode; off under
-        # TP (per-leaf PartitionSpecs) and with adapters (unfused leaf names)
-        fuse = (self.num_tp_devices or 1) <= 1 and not self.adapter_paths
+        # TP (per-leaf PartitionSpecs), with adapters (unfused leaf names),
+        # and multi-host (mesh always present; workers load fuse=False)
+        fuse = (
+            (self.num_tp_devices or 1) <= 1
+            and not self.adapter_paths
+            and self.num_hosts == 1
+        )
         per_block = [
             self._load_block_converted(i, fuse=fuse)
             for i in range(first_block, first_block + num_blocks)
@@ -547,7 +596,13 @@ class Server:
         mesh = None
         tp = self.num_tp_devices or 1
         sp = self.num_sp_devices or 1
-        if sp > 1:
+        if self.num_hosts > 1:
+            from petals_tpu.parallel.multihost import multihost_mesh
+
+            # tp over the GLOBAL device set (all hosts' chips); num_tp_devices
+            # None means every device in the group
+            mesh = multihost_mesh(self.num_tp_devices)
+        elif sp > 1:
             from petals_tpu.parallel.mesh import serving_mesh
 
             mesh = serving_mesh(tp, sp)
@@ -555,7 +610,7 @@ class Server:
             from petals_tpu.parallel.mesh import tp_mesh
 
             mesh = tp_mesh(tp)
-        return TransformerBackend(
+        backend = TransformerBackend(
             self.family,
             self.cfg,
             stacked,
@@ -567,6 +622,11 @@ class Server:
             use_flash=self.use_flash,
             mesh=mesh,
         )
+        if self.num_hosts > 1:
+            from petals_tpu.parallel.multihost import LockstepBackend
+
+            backend = LockstepBackend(backend)
+        return backend
 
     async def _choose_start_block(self, throughputs=None) -> int:
         """Pick the span covering the swarm's weakest blocks (reference
