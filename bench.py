@@ -651,19 +651,15 @@ def main():
             child_stdout = captured.decode(errors="replace") if isinstance(captured, bytes) else captured
             sys.stderr.write(f"\n[bench] timed out after {budget:.0f}s\n")
             error = "timeout (accelerator tunnel down?)"
-        # On-TPU exactness smoke (tests/test_tpu_smoke.py): runs HERE in the
-        # jax-free supervisor AFTER the inner bench exits — the chip is
-        # single-process, so a smoke child spawned while the inner holds the
-        # TPU would fall back to CPU and silently skip (a false PASS, the
-        # exact ship-silently failure the tier exists to prevent). PASS
-        # requires actual passed tests, not skips.
-        if _has_metric_line(child_stdout):
-            _run_tpu_smoke()
         # ONE-json-line contract: trust the child's metric line if it managed
         # to print one (e.g. the run finished and the TPU runtime crashed at
-        # interpreter teardown); emit the error record only otherwise
-        if _has_metric_line(child_stdout):
+        # interpreter teardown); emit the error record only otherwise. The
+        # metric line goes out FIRST — a driver timeout during the smoke below
+        # must never cost the round its measurement.
+        has_metric = _has_metric_line(child_stdout)
+        if has_metric:
             sys.stdout.write(child_stdout)
+            sys.stdout.flush()
         else:
             print(json.dumps({
                 "metric": f"single_stream_decode_tok_s_{N_BLOCKS}xllama7b_blocks_e2e",
@@ -671,7 +667,15 @@ def main():
                 "unit": "tok/s",
                 "vs_baseline": 0.0,
                 "error": error or "no metric line from benchmark",
-            }))
+            }), flush=True)
+        # On-TPU exactness smoke (tests/test_tpu_smoke.py): runs HERE in the
+        # jax-free supervisor AFTER the inner bench exits — the chip is
+        # single-process, so a smoke child spawned while the inner holds the
+        # TPU would fall back to CPU and silently skip (a false PASS, the
+        # exact ship-silently failure the tier exists to prevent). PASS
+        # requires actual passed tests, not skips.
+        if has_metric:
+            _run_tpu_smoke()
         return
 
     details = {}
