@@ -53,6 +53,7 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 model_path, out_path, coord = sys.argv[1], sys.argv[2], sys.argv[3]
+tp = int(sys.argv[4]) if len(sys.argv) > 4 else 2
 
 from petals_tpu.parallel.multihost import (
     LockstepBackend, LockstepMemoryCache, init_multihost, multihost_mesh,
@@ -73,7 +74,7 @@ stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
 backend = LockstepBackend(TransformerBackend(
     family, cfg, stacked, first_block=0, n_blocks=4,
     memory_cache=MemoryCache(None), compute_dtype=jnp.float32,
-    mesh=multihost_mesh(2), use_flash=False,
+    mesh=multihost_mesh(tp), use_flash=False,
 ))
 mc = LockstepMemoryCache(MemoryCache(None))
 
@@ -113,6 +114,7 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 model_path, coord = sys.argv[1], sys.argv[2]
+tp = int(sys.argv[3]) if len(sys.argv) > 3 else 2
 
 from petals_tpu.parallel.multihost import LockstepWorker, init_multihost, multihost_mesh
 
@@ -130,26 +132,43 @@ stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
 backend = TransformerBackend(
     family, cfg, stacked, first_block=0, n_blocks=4,
     memory_cache=MemoryCache(None), compute_dtype=jnp.float32,
-    mesh=multihost_mesh(2), use_flash=False,
+    mesh=multihost_mesh(tp), use_flash=False,
 )
 LockstepWorker(backend).run()
 """
 
 
-def test_multihost_lockstep_matches_single_process(tmp_path):
-    model = make_tiny_llama(str(tmp_path))
+@pytest.mark.parametrize(
+    "tp,devices_per_proc,kv_heads",
+    [
+        (2, 1, 2),  # every collective crosses the process boundary
+        (4, 2, 4),  # v5e-host-in-miniature: intra- AND inter-process collectives
+    ],
+)
+def test_multihost_lockstep_matches_single_process(tmp_path, tp, devices_per_proc, kv_heads):
+    model = make_tiny_llama(str(tmp_path), kv_heads=kv_heads)
     out_path = os.path.join(str(tmp_path), "leader_out.npz")
     coord = f"127.0.0.1:{_free_port()}"
-    env = _mp_env()
+    env = dict(
+        _mp_env(),
+        XLA_FLAGS=f"--xla_force_host_platform_device_count={devices_per_proc}",
+    )
     leader = subprocess.Popen(
-        [sys.executable, "-c", _LEADER, model, out_path, coord],
+        [sys.executable, "-c", _LEADER, model, out_path, coord, str(tp)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     worker = subprocess.Popen(
-        [sys.executable, "-c", _WORKER, model, coord],
+        [sys.executable, "-c", _WORKER, model, coord, str(tp)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
-    outs = [p.communicate(timeout=600)[0] for p in (leader, worker)]
+    try:
+        outs = [p.communicate(timeout=600)[0] for p in (leader, worker)]
+    finally:
+        # a deadlocked lockstep group (the failure mode this test exists to
+        # catch) must not leak children holding the coordinator port
+        for p in (leader, worker):
+            if p.poll() is None:
+                p.kill()
     for name, p, out in (("leader", leader, outs[0]), ("worker", worker, outs[1])):
         assert p.returncode == 0, f"{name} failed:\n{out[-3000:]}"
     assert "LEADER_DONE" in outs[0]

@@ -8,7 +8,8 @@ import torch
 
 
 def make_tiny_llama(
-    tmpdir: str, *, n_layers: int = 4, vocab: int = 128, biased: bool = False
+    tmpdir: str, *, n_layers: int = 4, vocab: int = 128, biased: bool = False,
+    kv_heads: int = 2,
 ) -> str:
     from transformers import LlamaConfig, LlamaForCausalLM
 
@@ -18,7 +19,7 @@ def make_tiny_llama(
         intermediate_size=128,
         num_hidden_layers=n_layers,
         num_attention_heads=4,
-        num_key_value_heads=2,
+        num_key_value_heads=kv_heads,
         max_position_embeddings=256,
         rms_norm_eps=1e-6,
         rope_theta=10000.0,
