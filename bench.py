@@ -581,15 +581,105 @@ async def run_e2e_bench():
     return result
 
 
-def _has_metric_line(text: str) -> bool:
+def _first_metric_line(text: str):
+    """The first ``{"metric": ..., "value": ...}`` JSON line, parsed, or None."""
     for line in text.splitlines():
         try:
             obj = json.loads(line)
         except (ValueError, TypeError):
             continue
         if isinstance(obj, dict) and "metric" in obj and "value" in obj:
-            return True
-    return False
+            return obj
+    return None
+
+
+def _has_metric_line(text: str) -> bool:
+    return _first_metric_line(text) is not None
+
+
+LKG_PATH = "BENCH_LKG.json"
+
+
+def _record_last_known_good(metric_line: dict) -> None:
+    """Persist the metric line of a successful run so a later outage can
+    republish it (marked stale) instead of reporting 0.0 — which reads, to a
+    dashboard, as a 100% perf regression. Mirrors the reference server's
+    persisted self-measurement (reference throughput.py:190-237: cached
+    throughput reused across restarts with its measurement date)."""
+    try:
+        with open(LKG_PATH, "w") as f:
+            json.dump({"measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                       "metric_line": metric_line}, f, indent=2)
+    except OSError:
+        pass
+
+
+def _stale_metric_line(error: str) -> dict:
+    """The line to emit when every attempt failed: last-known-good + an
+    explicit ``stale`` marker, or a zero record if no LKG exists yet."""
+    try:
+        with open(LKG_PATH) as f:
+            lkg = json.load(f)
+        out = dict(lkg["metric_line"])
+        out["stale"] = True
+        out["stale_measured_at"] = lkg.get("measured_at")
+        out["error"] = error
+        return out
+    except (OSError, ValueError, KeyError, TypeError):
+        return {
+            "metric": f"single_stream_decode_tok_s_{N_BLOCKS}xllama7b_blocks_e2e",
+            "value": 0.0,
+            "unit": "tok/s",
+            "vs_baseline": 0.0,
+            "error": error,
+        }
+
+
+def _mark_details_stale(error: str) -> None:
+    """Stamp BENCH_DETAILS.json when this round's bench failed: the perf
+    numbers in it are from a previous successful run, and any consumer must
+    be able to tell (the stdout metric line carries ``stale: true``, so the
+    details file needs the same marker)."""
+    try:
+        with open("BENCH_DETAILS.json") as f:
+            details = json.load(f)
+    except (OSError, ValueError):
+        return
+    details["_bench_run"] = {
+        "stale": True,
+        "error": error,
+        "note": "perf sections are from the last successful run, not this one",
+        "attempted_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    try:
+        with open("BENCH_DETAILS.json", "w") as f:
+            json.dump(details, f, indent=2)
+    except OSError:
+        pass
+
+
+def _probe_backend(timeout: float) -> bool:
+    """Cheap child that initializes the accelerator backend and forces one
+    computation through it. Lets the supervisor distinguish 'tunnel down'
+    (retry with backoff) from 'bench bug' (don't burn the budget retrying)."""
+    import subprocess
+
+    code = (
+        "import jax, numpy as np\n"
+        "assert jax.default_backend() != 'cpu', 'cpu fallback is not the chip'\n"
+        "x = jax.jit(lambda v: v + 1)(jax.numpy.zeros(()))\n"
+        "np.asarray(jax.device_get(x))\n"
+        "print('BACKEND_OK')\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, timeout=timeout,
+        )
+        return proc.returncode == 0 and "BACKEND_OK" in (proc.stdout or "")
+    except Exception:
+        return False
 
 
 def _run_tpu_smoke(timeout: float = 600.0) -> None:
@@ -637,45 +727,94 @@ def main():
         # accelerator tunnel is wedged, JAX initialization blocks forever —
         # the driver must still get its ONE JSON line. stderr is inherited so
         # progress streams live; only stdout (the metric line) is captured.
-        budget = float(os.environ.get("_PTU_BENCH_TIMEOUT", 2400))
-        child_stdout = ""
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--inner"],
-                stdout=subprocess.PIPE, text=True, timeout=budget,
-            )
-            child_stdout = proc.stdout or ""
-            error = None if proc.returncode == 0 else f"rc={proc.returncode}"
-        except subprocess.TimeoutExpired as e:
-            captured = e.stdout or b""  # bytes even under text=True (cpython quirk)
-            child_stdout = captured.decode(errors="replace") if isinstance(captured, bytes) else captured
-            sys.stderr.write(f"\n[bench] timed out after {budget:.0f}s\n")
-            error = "timeout (accelerator tunnel down?)"
+        #
+        # Outage resilience (the tunnel is known to flake for hours at a
+        # time): probe the backend first; while it is down, retry with
+        # backoff inside the budget instead of failing on the first attempt,
+        # and if every attempt fails, republish the last-known-good metric
+        # with an explicit ``stale: true`` marker rather than 0.0.
+        budget = float(os.environ.get("_PTU_BENCH_TIMEOUT", 3000))
+        deadline = time.time() + budget
+        # time kept back to emit the line + attempt the smoke tier; scaled
+        # down for small budgets so a tight driver timeout still gets at
+        # least one real bench attempt
+        reserve = min(240.0, budget / 4)
+        floor = min(120.0, budget / 8)  # min useful time for an attempt
+        child_stdout, metric_line, error, backoff = "", None, None, 45.0
+        inner_attempts, max_inner_attempts = 0, 3  # a healthy probe + failing
+        # bench means a bench bug, not an outage: don't burn the budget on it
+        while True:
+            remaining = deadline - reserve - time.time()
+            if remaining <= floor:
+                error = error or "budget exhausted before a healthy attempt"
+                break
+            if not _probe_backend(min(420.0, remaining)):
+                # don't clobber a previous inner attempt's error: 'rc=1 on a
+                # healthy probe' is the bench-bug signal, worth surfacing
+                error = error or "backend probe failed (accelerator tunnel down?)"
+                wait = min(backoff, max(deadline - reserve - time.time(), 0))
+                if wait <= 0:
+                    break
+                sys.stderr.write(
+                    f"[bench] backend unavailable; retrying in {wait:.0f}s\n")
+                time.sleep(wait)
+                backoff = min(backoff * 2, 360.0)
+                continue
+            remaining = deadline - reserve - time.time()
+            if remaining <= floor:
+                error = error or "budget exhausted after backend probe"
+                break
+            inner_attempts += 1
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), "--inner"],
+                    stdout=subprocess.PIPE, text=True, timeout=remaining,
+                )
+                child_stdout = proc.stdout or ""
+                error = None if proc.returncode == 0 else f"rc={proc.returncode}"
+            except subprocess.TimeoutExpired as e:
+                captured = e.stdout or b""  # bytes even under text=True (cpython quirk)
+                child_stdout = captured.decode(errors="replace") if isinstance(captured, bytes) else captured
+                sys.stderr.write(f"\n[bench] inner timed out after {remaining:.0f}s\n")
+                error = "timeout (accelerator tunnel stalled mid-run?)"
+            metric_line = _first_metric_line(child_stdout)
+            if metric_line is not None:
+                break
+            error = error or "no metric line despite rc=0"
+            if inner_attempts >= max_inner_attempts:
+                error = f"{error} after {inner_attempts} attempts"
+                break
+            # the probe passed but the run died — most likely the tunnel
+            # dropped mid-run; probe-gated retry within the attempt cap
+            sys.stderr.write(f"[bench] inner attempt failed ({error}); re-probing\n")
         # ONE-json-line contract: trust the child's metric line if it managed
         # to print one (e.g. the run finished and the TPU runtime crashed at
-        # interpreter teardown); emit the error record only otherwise. The
-        # metric line goes out FIRST — a driver timeout during the smoke below
-        # must never cost the round its measurement.
-        has_metric = _has_metric_line(child_stdout)
-        if has_metric:
+        # interpreter teardown); emit the stale/error record only otherwise.
+        # The metric line goes out FIRST — a driver timeout during the smoke
+        # below must never cost the round its measurement.
+        if metric_line is not None:
             sys.stdout.write(child_stdout)
             sys.stdout.flush()
+            _record_last_known_good(metric_line)
         else:
-            print(json.dumps({
-                "metric": f"single_stream_decode_tok_s_{N_BLOCKS}xllama7b_blocks_e2e",
-                "value": 0.0,
-                "unit": "tok/s",
-                "vs_baseline": 0.0,
-                "error": error or "no metric line from benchmark",
-            }), flush=True)
+            print(json.dumps(_stale_metric_line(error or "no metric line")), flush=True)
+            _mark_details_stale(error or "no metric line")
         # On-TPU exactness smoke (tests/test_tpu_smoke.py): runs HERE in the
         # jax-free supervisor AFTER the inner bench exits — the chip is
         # single-process, so a smoke child spawned while the inner holds the
         # TPU would fall back to CPU and silently skip (a false PASS, the
         # exact ship-silently failure the tier exists to prevent). PASS
-        # requires actual passed tests, not skips.
-        if has_metric:
-            _run_tpu_smoke()
+        # requires actual passed tests, not skips. Attempted on BOTH paths:
+        # an outage that sank the inner bench must still record the smoke
+        # tier's verdict (FAIL with the outage summary) rather than skip it.
+        # Clamped to what is left of the budget so the supervisor never
+        # overshoots the driver's kill timer mid-smoke (a kill mid-rewrite
+        # could corrupt BENCH_DETAILS.json); skipped if almost nothing left.
+        smoke_budget = deadline - time.time()
+        if smoke_budget > 30.0:
+            _run_tpu_smoke(timeout=min(600.0, smoke_budget))
+        else:
+            sys.stderr.write("[bench] budget exhausted; smoke tier skipped\n")
         return
 
     details = {}
@@ -731,6 +870,10 @@ def main():
     except Exception as e:  # the projection must never sink the bench run
         print(f"# 405B rehearsal failed: {e!r}", file=sys.stderr)
 
+    details["_bench_run"] = {
+        "stale": False,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
     with open("BENCH_DETAILS.json", "w") as f:
         json.dump(details, f, indent=2)
 
