@@ -61,6 +61,18 @@ def mm(x: jnp.ndarray, w) -> jnp.ndarray:
     return x @ w
 
 
+def absolute_positions(position, batch: int, seq: int) -> jnp.ndarray:
+    """[batch, seq] absolute positions for this chunk's tokens.
+
+    ``position`` is a scalar (all rows share a history length — the classic
+    session step) or a [batch] vector (per-lane positions: continuous batching
+    coalesces many sessions at different decode depths into one step)."""
+    pos = jnp.asarray(position, jnp.int32)
+    if pos.ndim == 0:
+        pos = jnp.broadcast_to(pos[None], (batch,))
+    return pos[:, None] + jnp.arange(seq, dtype=jnp.int32)[None, :]
+
+
 def update_kv_cache(
     kv: Optional[KVCache], k_new: jnp.ndarray, v_new: jnp.ndarray, position, n_valid=None
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -68,6 +80,11 @@ def update_kv_cache(
 
     Returns (k_all, v_all, kv_length) to attend over. With kv=None (training
     forward without a cache) the freshly computed k/v are used directly.
+
+    ``position`` may be a [batch] vector (per-lane positions, continuous
+    batching): each row writes at its own offset and kv_length comes back as
+    a vector. Rows whose position is >= the buffer length are DROPPED — the
+    out-of-range sentinel is how the batched step marks idle lanes.
 
     ``n_valid`` (dynamic scalar) marks how many of the ``s`` new tokens are
     real — the tail may be padding from shape bucketing. Padding IS written
@@ -80,6 +97,20 @@ def update_kv_cache(
         return k_new, v_new, jnp.asarray(n, jnp.int32)
     k_buf, v_buf = kv
     pos = jnp.asarray(position, jnp.int32)
+
+    if pos.ndim == 1:  # per-lane write (continuous batching across sessions)
+        batch = k_new.shape[0]
+        buf_len = k_buf.shape[1]
+        offsets = jnp.arange(seq, dtype=jnp.int32)
+        idx = pos[:, None] + offsets[None, :]  # [b, s]
+        if n_valid is not None:
+            idx = jnp.where(offsets[None, :] < jnp.asarray(n_valid, jnp.int32), idx, buf_len)
+        # rows at/past the buffer end (idle-lane sentinel or overflow) drop
+        b_idx = jnp.arange(batch, dtype=jnp.int32)[:, None]
+        k_buf = k_buf.at[b_idx, idx].set(k_new.astype(k_buf.dtype), mode="drop")
+        v_buf = v_buf.at[b_idx, idx].set(v_new.astype(v_buf.dtype), mode="drop")
+        n = seq if n_valid is None else jnp.asarray(n_valid, jnp.int32)
+        return k_buf, v_buf, pos + n
 
     if n_valid is None:
         # Unpadded write: the caller guarantees position + seq <= buffer length

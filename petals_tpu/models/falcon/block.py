@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from petals_tpu.models.common import KVCache, layer_norm, mm, update_kv_cache
+from petals_tpu.models.common import KVCache, absolute_positions, layer_norm, mm, update_kv_cache
 from petals_tpu.models.falcon.config import FalconBlockConfig
 from petals_tpu.models.registry import ModelFamily, register_family
 from petals_tpu.ops.alibi import build_alibi_slopes
@@ -77,8 +77,7 @@ def block_apply(
         # where the bias is added unscaled — so pre-scale the slopes here.
         alibi_slopes = build_alibi_slopes(hq) * (d**-0.5)
     else:
-        positions = jnp.asarray(position, jnp.int32) + jnp.arange(seq, dtype=jnp.int32)
-        positions = jnp.broadcast_to(positions[None, :], (batch, seq))
+        positions = absolute_positions(position, batch, seq)
         cos, sin = rotary_tables(positions, d, theta=cfg.rope_theta)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)

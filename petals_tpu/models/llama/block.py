@@ -15,7 +15,7 @@ from typing import Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from petals_tpu.models.common import KVCache, mm, rms_norm, silu, update_kv_cache
+from petals_tpu.models.common import KVCache, absolute_positions, mm, rms_norm, silu, update_kv_cache
 from petals_tpu.models.llama.config import LlamaBlockConfig
 from petals_tpu.models.registry import ModelFamily, register_family
 from petals_tpu.ops.attention import attend_maybe_ring
@@ -26,7 +26,7 @@ def block_apply(
     params: dict,
     hidden_states: jnp.ndarray,  # [batch, seq, hidden]
     kv: Optional[KVCache],
-    position,  # int32 scalar: tokens already in the cache
+    position,  # int32 scalar (or [batch] vector: per-lane batched decode): tokens already cached
     cfg: LlamaBlockConfig,
     *,
     use_flash: bool = False,
@@ -59,8 +59,7 @@ def block_apply(
     k = k.reshape(batch, seq, hkv, d)
     v = v.reshape(batch, seq, hkv, d)
 
-    positions = jnp.asarray(position, jnp.int32) + jnp.arange(seq, dtype=jnp.int32)
-    positions = jnp.broadcast_to(positions[None, :], (batch, seq))
+    positions = absolute_positions(position, batch, seq)
     cos, sin = rotary_tables(positions, d, theta=cfg.rope_theta, rope_scaling=cfg.rope_scaling_dict)
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)

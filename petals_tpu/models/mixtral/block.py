@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from petals_tpu.models.common import KVCache, mm, rms_norm, silu, update_kv_cache
+from petals_tpu.models.common import KVCache, absolute_positions, mm, rms_norm, silu, update_kv_cache
 from petals_tpu.models.mixtral.config import MixtralBlockConfig
 from petals_tpu.models.registry import ModelFamily, register_family
 from petals_tpu.ops.attention import attend_maybe_ring
@@ -121,8 +121,7 @@ def block_apply(
     k = mm(x, params["wk"]).reshape(batch, seq, hkv, d)
     v = mm(x, params["wv"]).reshape(batch, seq, hkv, d)
 
-    positions = jnp.asarray(position, jnp.int32) + jnp.arange(seq, dtype=jnp.int32)
-    positions = jnp.broadcast_to(positions[None, :], (batch, seq))
+    positions = absolute_positions(position, batch, seq)
     cos, sin = rotary_tables(positions, d, theta=cfg.rope_theta)
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)

@@ -112,7 +112,14 @@ def attend(
       tp_mesh: tensor-parallel Mesh with a "tp" axis — heads are sharded over
         it, so the Mosaic kernel (no GSPMD rule) runs per-shard via shard_map.
     """
-    if use_flash and causal:
+    # per-lane positions ([batch] vectors, continuous batching) run the XLA
+    # path: decode shapes never route to the flash kernel anyway, and the
+    # Mosaic kernel takes scalar offsets only
+    vector_pos = (
+        getattr(jnp.asarray(q_offset), "ndim", 0) > 0
+        or (kv_length is not None and getattr(jnp.asarray(kv_length), "ndim", 0) > 0)
+    )
+    if use_flash and causal and not vector_pos:
         from petals_tpu.ops.flash_attention import flash_attend, flash_supported
 
         if flash_supported(q, k, v, sliding_window=sliding_window):
@@ -239,17 +246,22 @@ def attend_reference(
         bias = alibi_slopes[:, None, None] * kv_pos.astype(jnp.float32)[None, None, :]
         logits = logits + bias[None]
 
-    q_pos = jnp.asarray(q_offset, jnp.int32) + jnp.arange(q_len, dtype=jnp.int32)
-    mask = kv_pos[None, :] < jnp.asarray(kv_length, jnp.int32)
-    mask = jnp.broadcast_to(mask, (q_len, kv_buf_len))
+    # q_offset / kv_length may be scalars (one shared history length) or
+    # [batch] vectors (per-lane positions, continuous batching); reshape(-1)
+    # gives a length-1-or-batch leading axis that broadcasts either way
+    q_off = jnp.asarray(q_offset, jnp.int32).reshape(-1, 1)  # [1|b, 1]
+    q_pos = q_off + jnp.arange(q_len, dtype=jnp.int32)[None, :]  # [1|b, q]
+    kv_len = jnp.asarray(kv_length, jnp.int32).reshape(-1, 1, 1)  # [1|b, 1, 1]
+    mask = kv_pos[None, None, :] < kv_len  # [1|b, 1, skv]
     if causal:
-        mask = mask & (kv_pos[None, :] <= q_pos[:, None])
+        mask = mask & (kv_pos[None, None, :] <= q_pos[:, :, None])
     if sliding_window is not None:
-        mask = mask & (kv_pos[None, :] > q_pos[:, None] - sliding_window)
+        mask = mask & (kv_pos[None, None, :] > q_pos[:, :, None] - sliding_window)
+    mask = jnp.broadcast_to(mask, (mask.shape[0], q_len, kv_buf_len))
 
-    logits = jnp.where(mask[None, None], logits, DEFAULT_MASK_VALUE)
+    logits = jnp.where(mask[:, None], logits, DEFAULT_MASK_VALUE)
     weights = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
-    weights = weights * mask[None, None]
+    weights = weights * mask[:, None]
     weights = weights / jnp.maximum(weights.sum(axis=-1, keepdims=True), 1e-30)
 
     wg = weights.reshape(batch, num_kv_heads, group, q_len, kv_buf_len)

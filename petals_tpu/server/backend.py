@@ -172,6 +172,37 @@ class TransformerBackend:
         return apply_adapter(self.params, stacked_adapter, scaling)
 
     @functools.cached_property
+    def _use_quant_consts(self):
+        """Quantized leaves must NOT ride the scan xs: XLA materializes each
+        iteration's slice of the packed uint8 bytes at a fraction of kernel
+        DMA rate, which dominated quantized decode. Instead they stay whole
+        as scan CONSTS and the body hands block_apply a StackedQuantLinear
+        view (stacked bytes + the loop counter); the Pallas kernel then
+        DMAs its tiles straight out of the stacked array. Off under TP —
+        that path traces the XLA dequant matmul, which fuses its slices."""
+        from petals_tpu.ops.quant import QuantizedLinear
+
+        return self.mesh is None and any(
+            isinstance(leaf, QuantizedLinear)
+            for leaf in jax.tree_util.tree_leaves(
+                self.params, is_leaf=lambda x: isinstance(x, QuantizedLinear)
+            )
+        )
+
+    @staticmethod
+    def _split_quant(params):
+        """Partition span params into (dense-for-scan-xs, quant-for-consts).
+        Only span-stacked 2-D weights ([n_blocks, in//2, out]) take the consts
+        path; mixtral's stacked EXPERT leaves are 4-D and their block code
+        slices experts itself — leave them in the scan xs."""
+        from petals_tpu.ops.quant import QuantizedLinear
+
+        is_q = lambda x: isinstance(x, QuantizedLinear) and x.data.ndim == 3
+        dense = {k: v for k, v in params.items() if not is_q(v)}
+        quant = {k: v for k, v in params.items() if is_q(v)}
+        return dense, quant
+
+    @functools.cached_property
     def _inference_step_fn(self):
         family, cfg, use_flash = self.family, self.cfg, self.use_flash
         tp_mesh = self.mesh
@@ -181,30 +212,10 @@ class TransformerBackend:
         # decode steps (seq == 1) stay tp-only
         sp_size = self.mesh.shape.get("sp", 1) if self.mesh is not None else 1
         supports_sp = family.supports_ring_attention and sp_size > 1
-        from petals_tpu.ops.quant import QuantizedLinear, StackedQuantLinear
+        from petals_tpu.ops.quant import StackedQuantLinear
 
-        # Quantized leaves must NOT ride the scan xs: XLA materializes each
-        # iteration's slice of the packed uint8 bytes at a fraction of kernel
-        # DMA rate, which dominated quantized decode. Instead they stay whole
-        # as scan CONSTS and the body hands block_apply a StackedQuantLinear
-        # view (stacked bytes + the loop counter); the Pallas kernel then
-        # DMAs its tiles straight out of the stacked array. Off under TP —
-        # that path traces the XLA dequant matmul, which fuses its slices.
-        def split_quant(params):
-            # only span-stacked 2-D weights ([n_blocks, in//2, out]) take the
-            # consts path; mixtral's stacked EXPERT leaves are 4-D and their
-            # block code slices experts itself — leave them in the scan xs
-            is_q = lambda x: isinstance(x, QuantizedLinear) and x.data.ndim == 3
-            dense = {k: v for k, v in params.items() if not is_q(v)}
-            quant = {k: v for k, v in params.items() if is_q(v)}
-            return dense, quant
-
-        use_quant_consts = tp_mesh is None and any(
-            isinstance(leaf, QuantizedLinear)
-            for leaf in jax.tree_util.tree_leaves(
-                self.params, is_leaf=lambda x: isinstance(x, QuantizedLinear)
-            )
-        )
+        split_quant = self._split_quant
+        use_quant_consts = self._use_quant_consts
 
         @functools.partial(
             jax.jit,
@@ -277,6 +288,103 @@ class TransformerBackend:
             return hidden, k_stack, v_stack
 
         return step
+
+    @functools.cached_property
+    def _batched_decode_fn(self):
+        """One decode step for MANY independent sessions at once — the
+        continuous-batching hot path (beats the reference, whose task pools
+        explicitly never batch across requests: reference task_pool.py:35-36).
+
+        The whole lane pool rides every step with a per-lane position vector:
+        lanes without a request this step carry the out-of-range sentinel
+        (pool length), so their KV writes drop (scatter mode="drop") and
+        their outputs are ignored. One shape -> ONE compiled program, no
+        recompilation as sessions join and leave mid-flight; decode is
+        weight-bandwidth-bound, so the extra lanes are nearly free."""
+        family, cfg = self.family, self.cfg
+        from petals_tpu.ops.quant import StackedQuantLinear
+
+        split_quant = self._split_quant
+        use_quant_consts = self._use_quant_consts
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def step(params, k_pool, v_pool, hidden, positions):
+            # hidden: [n_lanes, 1, hidden]; positions: [n_lanes] int32
+            hidden = hidden.astype(k_pool.dtype)
+            if use_quant_consts:
+                dense_params, quant_params = split_quant(params)
+                xs_params = dense_params
+                block_indices = jnp.arange(k_pool.shape[0], dtype=jnp.int32)
+            else:
+                xs_params = params
+                block_indices = jnp.zeros((k_pool.shape[0],), jnp.int32)  # unused
+
+            def body(h, xs):
+                p_block, k_block, v_block, block_idx = xs
+                if use_quant_consts:
+                    p_block = dict(p_block)
+                    for name, q in quant_params.items():
+                        p_block[name] = StackedQuantLinear(
+                            q.kind, q.data, q.scales, block_idx,
+                            q.in_features, q.out_features,
+                        )
+                out, (k_new, v_new) = family.block_apply(
+                    p_block, h, (k_block, v_block), positions, cfg,
+                    use_flash=False, tp_mesh=None,
+                )
+                return out, (k_new, v_new)
+
+            hidden, (k_pool, v_pool) = jax.lax.scan(
+                body, hidden, (xs_params, k_pool, v_pool, block_indices)
+            )
+            return hidden, k_pool, v_pool
+
+        return step
+
+    def batched_decode_step(self, hidden, pool_kv, positions):
+        """One coalesced decode step over the whole lane pool.
+
+        Args:
+          hidden: [n_lanes, 1, hidden] (idle lanes: any finite filler).
+          pool_kv: (k, v) pool buffers [n_blocks, n_lanes, max_len, hkv, d].
+          positions: int32 [n_lanes]; idle lanes hold max_len (the sentinel).
+        """
+        k_pool, v_pool = pool_kv
+        if not isinstance(hidden, jax.Array):
+            hidden = np.ascontiguousarray(hidden)
+        out, k_pool, v_pool = self._batched_decode_fn(
+            self.params, k_pool, v_pool, hidden, np.asarray(positions, np.int32)
+        )
+        return out, (k_pool, v_pool)
+
+    @functools.cached_property
+    def _lane_extract_fn(self):
+        """Copy one lane out of the pool as a [n_blocks, 1, max_len, hkv, d]
+        session-shaped KV pair (for non-batchable work: prefill, kv export)."""
+
+        @jax.jit
+        def f(k_pool, v_pool, lane):
+            k = jax.lax.dynamic_slice_in_dim(k_pool, lane, 1, axis=1)
+            v = jax.lax.dynamic_slice_in_dim(v_pool, lane, 1, axis=1)
+            return k, v
+
+        return f
+
+    @functools.cached_property
+    def _lane_insert_fn(self):
+        # only the pool buffers are donatable (the lane tensors cannot alias
+        # an output: their shapes differ from both outputs)
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def f(k_pool, v_pool, k, v, lane):
+            k_pool = jax.lax.dynamic_update_slice_in_dim(
+                k_pool, k.astype(k_pool.dtype), lane, axis=1
+            )
+            v_pool = jax.lax.dynamic_update_slice_in_dim(
+                v_pool, v.astype(v_pool.dtype), lane, axis=1
+            )
+            return k_pool, v_pool
+
+        return f
 
     @functools.cached_property
     def _forward_fn(self):

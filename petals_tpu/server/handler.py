@@ -58,6 +58,9 @@ class TransformerHandler:
         compression: CompressionType = CompressionType.NONE,
         identity=None,  # authenticates the server->server push plane
         inference_max_length: Optional[int] = None,  # cap on per-session max_length
+        batching: bool = True,  # continuous batching across decode sessions
+        batch_lanes: int = 8,
+        batch_max_length: Optional[int] = None,  # pool lane length (tokens)
     ):
         self.backend = backend
         self.dht_prefix = dht_prefix
@@ -86,6 +89,26 @@ class TransformerHandler:
 
         self._push_pool = ConnectionPool(identity=identity)
         self._push_tasks: set = set()
+
+        # Continuous batching (server/batching.py): concurrent single-stream
+        # decode sessions on the full span coalesce into one device step.
+        # Off under multi-host lockstep and TP meshes (v1) — those paths pin
+        # their own step shapes.
+        self.batcher = None
+        if (
+            batching
+            and backend.mesh is None
+            and not getattr(backend, "is_lockstep", False)
+        ):
+            from petals_tpu.server.batching import DecodeBatcher
+
+            self.batcher = DecodeBatcher(
+                backend,
+                memory_cache,
+                self.queue,
+                n_lanes=batch_lanes,
+                max_length=batch_max_length or inference_max_length or 1024,
+            )
 
     def register(self, server: RpcServer) -> None:
         server.add_unary_handler("ptu.forward", self.rpc_forward)
@@ -204,6 +227,54 @@ class TransformerHandler:
         self.memory_cache.update_cache(handles[1], new_v)
         return new_position
 
+    @contextlib.asynccontextmanager
+    async def _lane_ctx(self, lane: int):
+        """Session-lifetime scope of a borrowed pool lane (yields None in the
+        position of the private path's cache handles)."""
+        try:
+            yield None
+        finally:
+            self.batcher.release_lane(lane)
+
+    async def _install_kv_import_pooled(
+        self, step, lane: int, position, *, batch_size: int, n_blocks: int, max_length: int
+    ) -> int:
+        """Seed a pooled session's lane from another server's exported cache."""
+        import jax.numpy as jnp
+
+        if position != 0:
+            raise ValueError("kv_import must be the first step of a session")
+        new_position = int(step["kv_import"]["position"])
+        if not 0 < new_position <= max_length:
+            raise ValueError(f"kv_import position {new_position} outside (0, {max_length}]")
+        tensors = step.get("tensors") or {}
+        if "k" not in tensors or "v" not in tensors:
+            raise ValueError("kv_import needs k and v tensors")
+        backend = self.batcher.backend
+        lane_shape = (
+            n_blocks, batch_size, self.batcher.max_length,
+            backend.num_kv_heads, backend.head_dim,
+        )
+        want_shape = (n_blocks, batch_size, new_position, *lane_shape[3:])
+        cache_dtype = jnp.dtype(backend.cache_dtype)
+
+        def stage(name, wire):
+            arr = deserialize_array(wire)
+            if tuple(arr.shape) != want_shape:
+                raise ValueError(f"kv_import {name} shape {arr.shape} != {want_shape}")
+            full = np.zeros(lane_shape, cache_dtype)
+            full[:, :, :new_position] = arr.astype(cache_dtype)
+            return full
+
+        new_k = await asyncio.to_thread(stage, "k", tensors["k"])
+        new_v = await asyncio.to_thread(stage, "v", tensors["v"])
+
+        def replace(kv_lane):
+            return None, (jnp.asarray(new_k), jnp.asarray(new_v))
+
+        await self.batcher.run_exclusive(lane, replace)
+        return new_position
+
     async def _snapshot_session(
         self, reg: dict, b0: Optional[int] = None, b1: Optional[int] = None
     ) -> dict:
@@ -217,6 +288,20 @@ class TransformerHandler:
             raise NotImplementedError(
                 "session KV export is not supported with multi-host serving yet"
             )
+        if reg.get("lane") is not None:
+            # pooled session: the lane copy runs on the compute thread, so it
+            # serializes with batched steps — no donation race to retry
+            n = reg["end"] - reg["start"]
+            position = reg["position"]
+            k, v = await self.batcher.snapshot_lane(
+                reg["lane"], position, b0 if b0 is not None else 0,
+                b1 if b1 is not None else n,
+            )
+            return {
+                "k": k, "v": v, "position": position,
+                "start": reg["start"], "end": reg["end"],
+                "batch_size": reg["batch_size"], "max_length": reg["max_length"],
+            }
         bs = slice(b0, b1)
         for attempt in range(20):
             position = reg["position"]
@@ -272,6 +357,8 @@ class TransformerHandler:
             loop = asyncio.get_event_loop()
             if loop.is_running():
                 loop.create_task(self._push_pool.close())
+                if self.batcher is not None:
+                    loop.create_task(self.batcher.close())
 
     # ------------------------------------------------------------------ helpers
 
@@ -430,6 +517,12 @@ class TransformerHandler:
             dht_prefix=self.dht_prefix,
             tracing=get_tracer().summary(),
         )
+        if self.batcher is not None:
+            info["continuous_batching"] = {
+                "lanes": self.batcher.n_lanes,
+                "max_length": self.batcher.max_length,
+                **self.batcher.stats,
+            }
         return info
 
     async def rpc_inference(self, requests, ctx: RpcContext):
@@ -460,13 +553,42 @@ class TransformerHandler:
         backend = self._sub_backend(start, end)
         backend.params_for(active_adapter)  # validate the adapter exists up front
 
+        # Continuous batching: single-stream full-span sessions borrow a lane
+        # of the shared pool and decode coalesced with their neighbors; every
+        # other shape gets the classic private cache.
+        lane: Optional[int] = None
+        if (
+            self.batcher is not None
+            and batch_size == 1
+            and active_adapter is None
+            and start == 0
+            and end == self.backend.n_blocks
+            and max_length <= self.batcher.max_length
+        ):
+            from petals_tpu.server.memory_cache import AllocationFailed
+
+            alloc_timeout = open_msg.get("alloc_timeout")
+            try:
+                lane = await self.batcher.acquire_lane(
+                    timeout=30.0 if alloc_timeout is None else alloc_timeout
+                )
+            except AllocationFailed as e:
+                logger.debug(f"No decode lane ({e}); serving with a private cache")
+
         push_queue: Optional[asyncio.Queue] = None
-        descriptors = backend.cache_descriptors(batch_size, max_length, 0, end - start)
-        async with self.memory_cache.allocate_cache(
-            *descriptors, timeout=open_msg.get("alloc_timeout")
-        ) as handles:
-            k_buf, v_buf = self.memory_cache.get_buffers(*handles)
-            kv = (k_buf, v_buf)
+        if lane is not None:
+            cache_ctx = self._lane_ctx(lane)
+        else:
+            descriptors = backend.cache_descriptors(batch_size, max_length, 0, end - start)
+            cache_ctx = self.memory_cache.allocate_cache(
+                *descriptors, timeout=open_msg.get("alloc_timeout")
+            )
+        async with cache_ctx as handles:
+            if lane is None:
+                k_buf, v_buf = self.memory_cache.get_buffers(*handles)
+                kv = (k_buf, v_buf)
+            else:
+                kv = None  # lives in the batcher's pool, keyed by lane
             position = 0
             reg = None
             if session_id:
@@ -474,7 +596,7 @@ class TransformerHandler:
                 push_queue = asyncio.Queue(maxsize=64)
                 self._push_queues[session_id] = push_queue
                 reg = {
-                    "handles": handles, "position": 0,
+                    "handles": handles, "lane": lane, "position": 0,
                     "start": self.backend.first_block + start,
                     "end": self.backend.first_block + end,
                     "batch_size": batch_size, "max_length": max_length,
@@ -516,11 +638,18 @@ class TransformerHandler:
                         reg["position"] = position
 
                 if "kv_import" in step:
-                    position = await self._install_kv_import(
-                        step, kv, handles, position,
-                        batch_size=batch_size, n_blocks=end - start, max_length=max_length,
-                    )
-                    kv = tuple(self.memory_cache.get_buffers(*handles))
+                    if lane is not None:
+                        position = await self._install_kv_import_pooled(
+                            step, lane, position,
+                            batch_size=batch_size, n_blocks=end - start,
+                            max_length=max_length,
+                        )
+                    else:
+                        position = await self._install_kv_import(
+                            step, kv, handles, position,
+                            batch_size=batch_size, n_blocks=end - start, max_length=max_length,
+                        )
+                        kv = tuple(self.memory_cache.get_buffers(*handles))
                     if reg is not None:
                         reg["position"] = position
                     yield {"position": position, "kv_import": True}
@@ -543,27 +672,52 @@ class TransformerHandler:
 
                 pos = position
 
-                def run_step():
-                    with device_annotation("inference_step"):
-                        out, new_kv = backend.inference_step(
-                            hidden, kv, pos, prompts=prompts, hypo_ids=hypo_ids,
-                            active_adapter=active_adapter, handles=handles,
-                        )
-                    return np.asarray(out), new_kv
-
                 with get_tracer().span(
                     "inference_step", annotate=False,
                     blocks=end - start, batch=batch_size, seq=seq,
                 ):
-                    out, kv = await asyncio.wait_for(
-                        self.queue.submit(
-                            run_step, priority=PRIORITY_INFERENCE, size=batch_size * seq
-                        ),
-                        self.step_timeout,
-                    )
-                # keep the allocator's view coherent (old buffers were donated)
-                self.memory_cache.update_cache(handles[0], kv[0])
-                self.memory_cache.update_cache(handles[1], kv[1])
+                    if lane is not None and seq == 1 and prompts is None and hypo_ids is None:
+                        # the continuous-batching hot path: one token, coalesced
+                        # with whatever other sessions are stepping right now
+                        out = await asyncio.wait_for(
+                            self.batcher.step(lane, hidden, pos), self.step_timeout
+                        )
+                    elif lane is not None:
+                        # pooled session, non-batchable step (chunked prefill,
+                        # deep prompts, explicit hypo_ids): run on the lane
+                        # extracted into session-shaped buffers
+                        def run_lane(kv_lane, hidden=hidden, prompts=prompts, hypo_ids=hypo_ids):
+                            with device_annotation("inference_step"):
+                                out, new_kv = backend.inference_step(
+                                    hidden, kv_lane, pos, prompts=prompts,
+                                    hypo_ids=hypo_ids, active_adapter=active_adapter,
+                                )
+                            return np.asarray(out), new_kv
+
+                        out = await asyncio.wait_for(
+                            self.batcher.run_exclusive(
+                                lane, run_lane, size=batch_size * seq
+                            ),
+                            self.step_timeout,
+                        )
+                    else:
+                        def run_step():
+                            with device_annotation("inference_step"):
+                                out, new_kv = backend.inference_step(
+                                    hidden, kv, pos, prompts=prompts, hypo_ids=hypo_ids,
+                                    active_adapter=active_adapter, handles=handles,
+                                )
+                            return np.asarray(out), new_kv
+
+                        out, kv = await asyncio.wait_for(
+                            self.queue.submit(
+                                run_step, priority=PRIORITY_INFERENCE, size=batch_size * seq
+                            ),
+                            self.step_timeout,
+                        )
+                        # keep the allocator's view coherent (old buffers donated)
+                        self.memory_cache.update_cache(handles[0], kv[0])
+                        self.memory_cache.update_cache(handles[1], kv[1])
                 position += seq
                 if reg is not None:
                     reg["position"] = position

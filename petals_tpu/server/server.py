@@ -82,6 +82,9 @@ class Server:
         quant_weight_cache: bool = True,  # persist quantized blocks across restarts
         coordinator_address: Optional[str] = None,  # multi-host: jax.distributed coordinator
         num_hosts: int = 1,  # multi-host: total processes (this leader + run_worker peers)
+        batching: bool = True,  # continuous batching of concurrent decode sessions
+        batch_lanes: Optional[int] = None,  # None: auto-size to the cache budget (<=8)
+        batch_max_length: Optional[int] = None,  # pool lane length; None: min(inference_max_length, 1024)
     ):
         self.num_hosts = num_hosts or 1
         self.coordinator_address = coordinator_address
@@ -179,6 +182,9 @@ class Server:
             kv_heads = getattr(self.cfg, "num_key_value_heads", heads) or heads
             inference_max_length = 8192 if kv_heads < heads else 2048
         self.inference_max_length = inference_max_length
+        self.batching = batching
+        self.batch_lanes = batch_lanes
+        self.batch_max_length = batch_max_length
         self.request_timeout = request_timeout
         self.session_timeout = session_timeout
         self.step_timeout = step_timeout
@@ -358,6 +364,15 @@ class Server:
 
         self.backend = self._make_backend(stacked, self.first_block)
         self._install_adapters(self.backend)
+        # Continuous-batching pool sizing: lanes cost HBM for their full lane
+        # length, so cap the pool at half the cache budget (private sessions
+        # and training still need room) and disable if fewer than 2 lanes fit.
+        batch_max_length = self.batch_max_length or min(self.inference_max_length, 1024)
+        batch_lanes = self.batch_lanes
+        if batch_lanes is None:
+            lane_bytes = self.backend.cache_bytes_per_token() * batch_max_length
+            affordable = int(self.memory_cache.max_size_bytes // 2 // max(lane_bytes, 1))
+            batch_lanes = max(min(8, affordable), 0)
         self.handler = TransformerHandler(
             self.backend,
             dht_prefix=self.dht_prefix,
@@ -369,6 +384,9 @@ class Server:
             request_timeout=self.request_timeout,
             session_timeout=self.session_timeout,
             step_timeout=self.step_timeout,
+            batching=self.batching and batch_lanes >= 2,
+            batch_lanes=batch_lanes,
+            batch_max_length=batch_max_length,
         )
         self.handler.register(self.rpc_server)
 
