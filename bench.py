@@ -958,6 +958,17 @@ def main():
     details["moe_prefill_2048"] = moe
     print(f"# moe dispatch: {json.dumps(moe)}", file=sys.stderr)
 
+    # quantization quality table (VERDICT r3 #4): weight+activation error at
+    # 7B shapes per format, so the serving default is re-derived every run
+    try:
+        from benchmarks.quant_quality import quality_report
+
+        qq = quality_report(include_model_tier=False)  # model tier is a CPU test
+        details["quant_quality"] = qq
+        print(f"# quant quality: {json.dumps(qq['activation_space_7b_shapes'])}", file=sys.stderr)
+    except Exception as e:  # quality table must never sink the bench run
+        print(f"# quant quality failed: {e!r}", file=sys.stderr)
+
     # 405B rehearsal: placement math + single-stream projection from THIS
     # run's measured bandwidths (benchmarks/rehearsal_405b.py; the north-star
     # arithmetic the driver records every round)
