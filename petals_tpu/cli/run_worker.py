@@ -41,6 +41,8 @@ def main() -> None:
     parser.add_argument("--torch_dtype", "--dtype", dest="dtype", default="bfloat16",
                         choices=[k for k in DTYPE_MAP if k != "auto"])
     parser.add_argument("--max_chunk_size_bytes", type=int, default=256 * 1024 * 1024)
+    parser.add_argument("--adapters", nargs="*", default=(),
+                        help="PEFT checkpoint dirs — MUST match the leader's --adapters")
     parser.add_argument("--revision", default="main")
     parser.add_argument("--cache_dir", default=None)
     parser.add_argument("--no_quant_weight_cache", action="store_true")
@@ -95,6 +97,16 @@ def main() -> None:
         max_chunk_size_bytes=args.max_chunk_size_bytes,
         mesh=mesh,
     )
+    if args.adapters:
+        from petals_tpu.utils.peft import load_adapter, stack_adapter
+
+        block_range = range(args.first_block, args.first_block + args.num_blocks)
+        for path in args.adapters:
+            adapter = load_adapter(path, family.name, block_range=block_range)
+            stacked_a = stack_adapter(adapter, args.first_block, args.num_blocks, dtype)
+            backend.adapters[adapter.name] = (stacked_a, adapter.scaling)
+        logger.info(f"worker hosting adapters: {sorted(backend.adapters)}")
+
     logger.info(
         f"worker {args.host_index}/{args.num_hosts}: span "
         f"[{args.first_block}, {args.first_block + args.num_blocks}) over tp={mesh.shape['tp']}"

@@ -29,11 +29,22 @@ Architecture (TPU-first; there is no torch/NCCL analogue to port):
   collectives (psum/all_gather over the tp axis) plus the tiny control
   broadcasts.
 
-Known v1 limits (enforced with clean errors at server start): session KV
-export/import (migration, drain-parking) and live rebalancing are disabled —
-both move whole KV buffers through the host, which is a per-shard gather this
-control plane does not do yet. Throughput must be given explicitly (the
-auto-probe builds throwaway backends workers don't mirror).
+v2 (this round): per-request LoRA adapters cross the control plane as indices
+into the sorted adapter list (leader and workers host identical sets); session
+KV export/import runs as an in-program all_gather every process enters
+(OP_EXPORT_KV) and a broadcast prefix every process shards (OP_IMPORT_KV) —
+re-enabling migration, drain-parking and route upgrades for multi-host spans;
+auto-throughput probes the REAL lockstep backend (server._measure_multihost_throughput)
+instead of a throwaway; and a dead worker degrades the group FAST
+(_degrade_on_failure) instead of hanging every subsequent collective — the
+leader stops serving with clear errors, clients fail over, and the group is
+re-formed by restarting its processes (XLA bakes the mesh into every compiled
+program and shards params across member processes, so a worker hot-swap is a
+rebuild by construction; elasticity lives at the swarm layer, where the unit
+of failure is the span server — same as the reference's whole-server process).
+
+Remaining v1 limit: live rebalancing (a span move would strand the workers'
+shards) and sp meshes.
 """
 
 from __future__ import annotations
@@ -54,6 +65,8 @@ OP_FREE = 2
 OP_INFERENCE_STEP = 3
 OP_FORWARD = 4
 OP_BACKWARD = 5
+OP_EXPORT_KV = 6  # v2: per-shard all_gather of a session's KV (migration/drain)
+OP_IMPORT_KV = 7  # v2: seed a KV mirror from an exported prefix
 
 _HEADER_LEN = 14
 _FLAG_PROMPTS = 1
@@ -65,6 +78,50 @@ _FLAG_HYPO = 2
 # would pair a worker's operand wait with the wrong leader collective and hang
 # the group. (Workers are single-threaded; only the leader needs the lock.)
 _BCAST_LOCK = threading.RLock()
+
+# v2 worker-death detection: one lockstep group per process, so group health
+# is module state. A worker that dies mid-collective makes the runtime's
+# barrier/collective raise on the leader (coordination-service heartbeat or
+# collective timeout); once that happens the group's compiled programs and
+# sharded arrays are unrecoverable without a rebuild, so every subsequent op
+# must fail FAST with a clear error instead of hanging a fresh collective.
+_GROUP_STATE = {"degraded": None}
+
+
+class MultihostDegraded(RuntimeError):
+    """The lockstep group lost a member; the span server must stop serving."""
+
+
+def group_degraded() -> Optional[BaseException]:
+    """The exception that degraded this process's lockstep group, if any."""
+    return _GROUP_STATE["degraded"]
+
+
+def _check_group() -> None:
+    if _GROUP_STATE["degraded"] is not None:
+        raise MultihostDegraded(
+            f"multihost group degraded: {_GROUP_STATE['degraded']!r} — "
+            f"restart the leader and workers to re-form the group"
+        ) from _GROUP_STATE["degraded"]
+
+
+@contextlib.contextmanager
+def _degrade_on_failure():
+    """Mark the group degraded when a lockstep op dies in a collective."""
+    _check_group()
+    try:
+        yield
+    except MultihostDegraded:
+        raise
+    except Exception as e:
+        _GROUP_STATE["degraded"] = e
+        logger.error(
+            f"multihost lockstep op failed ({e!r}): marking the group degraded"
+        )
+        raise MultihostDegraded(
+            f"multihost group degraded: {e!r} — restart the leader and "
+            f"workers to re-form the group"
+        ) from e
 
 
 def init_multihost(coordinator_address: str, num_processes: int, process_id: int) -> None:
@@ -127,6 +184,26 @@ def _bcast_array(arr, shape, dtype):
     )
 
 
+def _stage_kv_mirror(backend, k_prefix, v_prefix, position, batch_size, max_length, n_blocks):
+    """Full sharded KV buffers seeded with an imported prefix. Runs in
+    lockstep on every process (device_put with a cross-process sharding is a
+    multi-controller operation: each process materializes its shards of the
+    same logical value)."""
+    import jax
+    import jax.numpy as jnp
+
+    kd, vd = backend.cache_descriptors(batch_size, max_length, 0, n_blocks)
+
+    def stage(prefix, descr):
+        full = np.zeros(descr.shape, jnp.dtype(descr.dtype))
+        full[:, :, :position] = prefix.astype(full.dtype)
+        if descr.sharding is not None:
+            return jax.device_put(full, descr.sharding)
+        return jnp.asarray(full)
+
+    return stage(k_prefix, kd), stage(v_prefix, vd)
+
+
 class _LockstepMixin:
     """Shared op encoding for leader and worker."""
 
@@ -166,12 +243,24 @@ class LockstepBackend(_LockstepMixin):
         base = self._span[0]
         return LockstepBackend(backend_slice, span=(base + start, base + end))
 
+    def _adapter_code(self, active_adapter) -> int:
+        """Adapters cross the control plane as 1-based indices into the SORTED
+        adapter-name list — leader and workers host identical adapter sets
+        (same flags, same checkpoints), so the mapping agrees by construction
+        and one int64 slot identifies the pytree the worker must apply."""
+        if not active_adapter:
+            return 0
+        names = sorted(self._backend.adapters)
+        try:
+            return names.index(active_adapter) + 1
+        except ValueError:
+            raise KeyError(f"Adapter {active_adapter!r} is not loaded on this server")
+
     # ------------------------------------------------------------- compute ops
 
     def inference_step(self, hidden, kv, position, *, prompts=None, hypo_ids=None,
                        active_adapter=None, handles=None):
-        if active_adapter:
-            raise NotImplementedError("LoRA adapters are not supported with multi-host serving yet")
+        adapter_code = self._adapter_code(active_adapter)
         batch, seq, _ = hidden.shape
         flags = (_FLAG_PROMPTS if prompts is not None else 0) | (
             _FLAG_HYPO if hypo_ids is not None else 0
@@ -179,10 +268,10 @@ class LockstepBackend(_LockstepMixin):
         pre_seq = 0 if prompts is None else prompts.shape[2]
         mirror = -1 if handles is None else int(handles[0])
         b0, b1 = self._span
-        with _BCAST_LOCK:
+        with _BCAST_LOCK, _degrade_on_failure():
             _bcast_header([
                 OP_INFERENCE_STEP, mirror, batch, seq, int(position), -1, flags,
-                pre_seq, 0, b0, b1,
+                pre_seq, adapter_code, b0, b1,
             ])
             hidden = _bcast_array(hidden, (batch, seq, self._backend.hidden_size), np.float32)
             if prompts is not None:
@@ -194,35 +283,36 @@ class LockstepBackend(_LockstepMixin):
             if hypo_ids is not None:
                 hypo_ids = _bcast_array(hypo_ids, (batch,), np.int64)
             out, new_kv = self._backend.inference_step(
-                hidden, kv, position, prompts=prompts, hypo_ids=hypo_ids
+                hidden, kv, position, prompts=prompts, hypo_ids=hypo_ids,
+                active_adapter=active_adapter,
             )
             return self._replicate(out), new_kv
 
     def forward(self, hidden, *, prompts=None, active_adapter=None):
-        if active_adapter:
-            raise NotImplementedError("LoRA adapters are not supported with multi-host serving yet")
+        adapter_code = self._adapter_code(active_adapter)
         batch, seq, _ = hidden.shape
         flags = _FLAG_PROMPTS if prompts is not None else 0
         pre_seq = 0 if prompts is None else prompts.shape[2]
         b0, b1 = self._span
-        with _BCAST_LOCK:
-            _bcast_header([OP_FORWARD, -1, batch, seq, 0, -1, flags, pre_seq, 0, b0, b1])
+        with _BCAST_LOCK, _degrade_on_failure():
+            _bcast_header([OP_FORWARD, -1, batch, seq, 0, -1, flags, pre_seq, adapter_code, b0, b1])
             hidden = _bcast_array(hidden, (batch, seq, self._backend.hidden_size), np.float32)
             if prompts is not None:
                 prompts = _bcast_array(
                     prompts, (b1 - b0, batch, pre_seq, self._backend.hidden_size), np.float32
                 )
-            return self._replicate(self._backend.forward(hidden, prompts=prompts))
+            return self._replicate(
+                self._backend.forward(hidden, prompts=prompts, active_adapter=active_adapter)
+            )
 
     def backward(self, hidden, grad_out, *, prompts=None, active_adapter=None):
-        if active_adapter:
-            raise NotImplementedError("LoRA adapters are not supported with multi-host serving yet")
+        adapter_code = self._adapter_code(active_adapter)
         batch, seq, _ = hidden.shape
         flags = _FLAG_PROMPTS if prompts is not None else 0
         pre_seq = 0 if prompts is None else prompts.shape[2]
         b0, b1 = self._span
-        with _BCAST_LOCK:
-            _bcast_header([OP_BACKWARD, -1, batch, seq, 0, -1, flags, pre_seq, 0, b0, b1])
+        with _BCAST_LOCK, _degrade_on_failure():
+            _bcast_header([OP_BACKWARD, -1, batch, seq, 0, -1, flags, pre_seq, adapter_code, b0, b1])
             # operand order mirrors the worker's generic decode: hidden, then
             # prompts (if flagged), then the op-specific grad_out
             hidden = _bcast_array(hidden, (batch, seq, self._backend.hidden_size), np.float32)
@@ -231,13 +321,71 @@ class LockstepBackend(_LockstepMixin):
                     prompts, (b1 - b0, batch, pre_seq, self._backend.hidden_size), np.float32
                 )
             grad_out = _bcast_array(grad_out, (batch, seq, self._backend.hidden_size), np.float32)
-            grad_in, grad_prompts = self._backend.backward(hidden, grad_out, prompts=prompts)
+            grad_in, grad_prompts = self._backend.backward(
+                hidden, grad_out, prompts=prompts, active_adapter=active_adapter
+            )
             grad_in = self._replicate(grad_in)
             if grad_prompts is not None:
                 grad_prompts = self._replicate(grad_prompts)
             return grad_in, grad_prompts
 
+    # ------------------------------------------------------- KV export/import (v2)
+
+    def export_kv(self, handles, get_buffers, b0: int, b1: int, position: int):
+        """Host copy of blocks [b0, b1) of a session's KV mirror, sliced to
+        ``position`` — the migration/drain/park path under lockstep. Every
+        process enters an in-program all_gather (the replicate constraint) on
+        its shards; only the leader reads the result. The gather is bounded to
+        the live prefix rounded up to 128 tokens (bucketed so the replicate
+        program compiles once per bucket, not once per position).
+
+        ``get_buffers`` is called UNDER the broadcast lock so no step can be
+        mid-donation; a buffer already donated but not yet swapped by the
+        handler's update_cache is retried. Local errors (freed handles, a
+        closing session) stay per-request errors — only a failure INSIDE the
+        collective degrades the group."""
+        import time
+
+        for attempt in range(40):
+            with _BCAST_LOCK:
+                _check_group()
+                # local fetch: failures here must NOT mark the group degraded
+                k_buf, v_buf = get_buffers()
+                if not (k_buf.is_deleted() or v_buf.is_deleted()):
+                    max_len = k_buf.shape[2]
+                    pad_pos = min(-(-max(position, 1) // 128) * 128, max_len)
+                    with _degrade_on_failure():
+                        _bcast_header([OP_EXPORT_KV, int(handles[0]), b0, b1, pad_pos])
+                        k = self._replicate(k_buf[b0:b1, :, :pad_pos])
+                        v = self._replicate(v_buf[b0:b1, :, :pad_pos])
+                        return (
+                            np.asarray(k)[:, :, :position],
+                            np.asarray(v)[:, :, :position],
+                        )
+            time.sleep(0.05)
+        raise RuntimeError("KV buffers kept being donated mid-export")
+
+    def import_kv(self, handles, k_prefix, v_prefix, position: int,
+                  batch_size: int, max_length: int, n_blocks: int):
+        """Seed a session's KV mirror from an exported prefix: the prefix is
+        broadcast once and every process materializes its own shards of the
+        full buffer. Returns the leader's new (k, v) global arrays."""
+        shape = tuple(k_prefix.shape)
+        with _BCAST_LOCK, _degrade_on_failure():
+            _bcast_header([
+                OP_IMPORT_KV, int(handles[0]), int(position),
+                n_blocks, batch_size, max_length,
+            ])
+            k_prefix = _bcast_array(k_prefix, shape, np.float32)
+            v_prefix = _bcast_array(v_prefix, shape, np.float32)
+            return _stage_kv_mirror(
+                self._backend, k_prefix, v_prefix, position,
+                batch_size, max_length, n_blocks,
+            )
+
     def shutdown_workers(self) -> None:
+        if _GROUP_STATE["degraded"] is not None:
+            return  # the group is gone; a release broadcast would only hang
         with _BCAST_LOCK:
             _bcast_header([OP_SHUTDOWN])
 
@@ -252,23 +400,37 @@ class LockstepMemoryCache:
         orig_reserve, orig_free = memory_cache._reserve, memory_cache._free
 
         def reserve(descriptors, alloc_size):
+            _check_group()  # before booking anything the broadcast can't mirror
             handles = orig_reserve(descriptors, alloc_size)
             # [op, h0, n, batch, max_len, hkv, hd, n_descr]
             d = descriptors[0]
-            with _BCAST_LOCK:
-                _bcast_header([OP_ALLOC, handles[0], *d.shape, len(descriptors)])
-                # materialize NOW, in lockstep with the workers: creating an
-                # array whose sharding spans processes is itself a
-                # multi-controller computation — a lazy get_buffers on the
-                # leader would deadlock against workers waiting in broadcast
-                memory_cache.get_buffers(*handles)
+            try:
+                with _BCAST_LOCK, _degrade_on_failure():
+                    _bcast_header([OP_ALLOC, handles[0], *d.shape, len(descriptors)])
+                    # materialize NOW, in lockstep with the workers: creating
+                    # an array whose sharding spans processes is itself a
+                    # multi-controller computation — a lazy get_buffers on the
+                    # leader would deadlock against workers waiting in broadcast
+                    memory_cache.get_buffers(*handles)
+            except BaseException:
+                orig_free(handles)  # never strand booked budget on failure
+                raise
             return handles
 
         def free(handles):
-            if handles:
-                with _BCAST_LOCK:
-                    _bcast_header([OP_FREE, handles[0], len(handles)])
-            orig_free(handles)
+            # the leader-side free must ALWAYS run — on a degraded group the
+            # mirrors died with the workers, but draining sessions still have
+            # to return their budget so the surviving leader's accounting and
+            # teardown stay clean. A broadcast failure here still marks the
+            # group degraded but never propagates out of cleanup.
+            try:
+                if handles and _GROUP_STATE["degraded"] is None:
+                    with _BCAST_LOCK, _degrade_on_failure():
+                        _bcast_header([OP_FREE, handles[0], len(handles)])
+            except MultihostDegraded as e:
+                logger.warning(f"FREE broadcast failed on a degraded group: {e}")
+            finally:
+                orig_free(handles)
 
         memory_cache._reserve = reserve
         memory_cache._free = free
@@ -295,7 +457,9 @@ class LockstepWorker:
             from petals_tpu.server.backend import TransformerBackend
             from petals_tpu.server.memory_cache import MemoryCache
 
-            self._subs[key] = TransformerBackend(
+            import jax
+
+            sub = TransformerBackend(
                 self.backend.family,
                 self.backend.cfg,
                 self.backend._slice_params(b0, b1),
@@ -308,7 +472,25 @@ class LockstepWorker:
                 use_flash=self.backend.use_flash,
                 mesh=self.backend.mesh,
             )
+            # mirror the leader handler's sub-backend adapter slicing
+            sub.adapters = {
+                name: (jax.tree_util.tree_map(lambda x: x[b0:b1], stacked), scaling)
+                for name, (stacked, scaling) in self.backend.adapters.items()
+            }
+            self._subs[key] = sub
         return self._subs[key]
+
+    def _adapter_name(self, code: int):
+        if code == 0:
+            return None
+        names = sorted(self.backend.adapters)
+        if code > len(names):
+            raise RuntimeError(
+                f"Leader requested adapter #{code} but this worker hosts only "
+                f"{names} — leader and workers must be started with identical "
+                f"--adapters flags"
+            )
+        return names[code - 1]
 
     def run(self) -> None:
         import jax
@@ -332,11 +514,30 @@ class LockstepWorker:
                 _, h0, _count = header[:3]
                 self._kv.pop(h0, None)
                 continue
+            if op == OP_EXPORT_KV:
+                # [op, mirror, b0, b1, pad_pos]: enter the all_gather (bounded
+                # to the bucketed live prefix); the leader reads the result
+                _, mirror, b0, b1, pad_pos = header[:5]
+                k_buf, v_buf = self._kv[mirror]
+                self._replicate(k_buf[b0:b1, :, :pad_pos])
+                self._replicate(v_buf[b0:b1, :, :pad_pos])
+                continue
+            if op == OP_IMPORT_KV:
+                # [op, mirror, position, n, batch, max_len]
+                _, mirror, position, n, batch, max_len = header[:6]
+                hkv, hd = self.backend.num_kv_heads, self.backend.head_dim
+                shape = (n, batch, position, hkv, hd)
+                k_prefix = _bcast_array(None, shape, np.float32)
+                v_prefix = _bcast_array(None, shape, np.float32)
+                self._kv[mirror] = _stage_kv_mirror(
+                    self.backend, k_prefix, v_prefix, position, batch, max_len, n
+                )
+                continue
 
             # compute ops: [op, mirror, batch, seq, position, n_valid, flags,
-            #               pre_seq, spare, b0, b1]
+            #               pre_seq, adapter_code, b0, b1]
             (_, mirror, batch, seq, position, _n_valid, flags, pre_seq,
-             _spare, b0, b1) = header[:11]
+             adapter_code, b0, b1) = header[:11]
             hidden = _bcast_array(
                 None, (batch, seq, self.backend.hidden_size), np.float32
             )
@@ -346,22 +547,28 @@ class LockstepWorker:
                     None, (b1 - b0, batch, pre_seq, self.backend.hidden_size), np.float32
                 )
             backend = self._sub(b0, b1)
+            adapter = self._adapter_name(adapter_code)
             if op == OP_INFERENCE_STEP:
                 if flags & _FLAG_HYPO:
                     hypo_ids = _bcast_array(None, (batch,), np.int64)
                 kv = self._kv[mirror]
                 out, new_kv = backend.inference_step(
-                    hidden, kv, position, prompts=prompts, hypo_ids=hypo_ids
+                    hidden, kv, position, prompts=prompts, hypo_ids=hypo_ids,
+                    active_adapter=adapter,
                 )
                 self._kv[mirror] = new_kv
                 self._replicate(out)
             elif op == OP_FORWARD:
-                self._replicate(backend.forward(hidden, prompts=prompts))
+                self._replicate(
+                    backend.forward(hidden, prompts=prompts, active_adapter=adapter)
+                )
             elif op == OP_BACKWARD:
                 grad_out = _bcast_array(
                     None, (batch, seq, self.backend.hidden_size), np.float32
                 )
-                g_in, g_p = backend.backward(hidden, grad_out, prompts=prompts)
+                g_in, g_p = backend.backward(
+                    hidden, grad_out, prompts=prompts, active_adapter=adapter
+                )
                 self._replicate(g_in)
                 if g_p is not None:
                     self._replicate(g_p)

@@ -187,13 +187,11 @@ class TransformerHandler:
         self, step, kv, handles, position, *, batch_size: int, n_blocks: int, max_length: int
     ) -> int:
         """Seed this session's KV buffers from another server's exported cache
-        (must arrive before any compute so the caches never mix histories)."""
+        (must arrive before any compute so the caches never mix histories).
+        Under multi-host lockstep the prefix is broadcast once and every
+        process materializes its own shards (multihost.py import_kv)."""
         import jax
 
-        if getattr(self.backend, "is_lockstep", False):
-            raise NotImplementedError(
-                "session KV import is not supported with multi-host serving yet"
-            )
         if position != 0:
             raise ValueError("kv_import must be the first step of a session")
         new_position = int(step["kv_import"]["position"])
@@ -205,23 +203,35 @@ class TransformerHandler:
         k_buf, v_buf = kv
         want_shape = (n_blocks, batch_size, new_position, *k_buf.shape[3:])
 
-        def stage(name, wire, buf):
-            # deserialize + zero-fill + device_put are 100s of MB for long
-            # contexts — run off the event loop (like _snapshot_session's
-            # device->host copy) so other sessions' steps don't stall
+        def parse(name, wire):
             arr = deserialize_array(wire)
             if tuple(arr.shape) != want_shape:
                 raise ValueError(f"kv_import {name} shape {arr.shape} != {want_shape}")
-            full = np.zeros(buf.shape, jax.numpy.dtype(buf.dtype))
-            full[:, :, :new_position] = arr.astype(full.dtype)
-            return (
-                jax.device_put(full, buf.sharding)
-                if getattr(buf, "sharding", None) is not None
-                else jax.numpy.asarray(full)
-            )
+            return arr
 
-        new_k = await asyncio.to_thread(stage, "k", tensors["k"], k_buf)
-        new_v = await asyncio.to_thread(stage, "v", tensors["v"], v_buf)
+        if getattr(self.backend, "is_lockstep", False):
+            arr_k = await asyncio.to_thread(parse, "k", tensors["k"])
+            arr_v = await asyncio.to_thread(parse, "v", tensors["v"])
+            new_k, new_v = await asyncio.to_thread(
+                self.backend.import_kv, handles, arr_k, arr_v,
+                new_position, batch_size, max_length, n_blocks,
+            )
+        else:
+            def stage(name, wire, buf):
+                # deserialize + zero-fill + device_put are 100s of MB for long
+                # contexts — run off the event loop (like _snapshot_session's
+                # device->host copy) so other sessions' steps don't stall
+                arr = parse(name, wire)
+                full = np.zeros(buf.shape, jax.numpy.dtype(buf.dtype))
+                full[:, :, :new_position] = arr.astype(full.dtype)
+                return (
+                    jax.device_put(full, buf.sharding)
+                    if getattr(buf, "sharding", None) is not None
+                    else jax.numpy.asarray(full)
+                )
+
+            new_k = await asyncio.to_thread(stage, "k", tensors["k"], k_buf)
+            new_v = await asyncio.to_thread(stage, "v", tensors["v"], v_buf)
         # only the cache-handle swap happens on the loop
         self.memory_cache.update_cache(handles[0], new_k)
         self.memory_cache.update_cache(handles[1], new_v)
@@ -285,9 +295,24 @@ class TransformerHandler:
         copy is 100s of MB for long contexts, so it runs off the event loop:
         other sessions' steps must not stall behind it."""
         if getattr(self.backend, "is_lockstep", False):
-            raise NotImplementedError(
-                "session KV export is not supported with multi-host serving yet"
+            # multi-host: every process all_gathers its shards in-program
+            # (multihost.py export_kv); buffer fetch + donation retry happen
+            # inside, under the broadcast lock
+            n = reg["end"] - reg["start"]
+            position = reg["position"]
+            handles = reg["handles"]
+            k, v = await asyncio.to_thread(
+                self.backend.export_kv, handles,
+                lambda: self.memory_cache.get_buffers(*handles),
+                b0 if b0 is not None else 0,
+                b1 if b1 is not None else n,
+                position,
             )
+            return {
+                "k": k, "v": v, "position": position,
+                "start": reg["start"], "end": reg["end"],
+                "batch_size": reg["batch_size"], "max_length": reg["max_length"],
+            }
         if reg.get("lane") is not None:
             # pooled session: the lane copy runs on the compute thread, so it
             # serializes with batched steps — no donation race to retry

@@ -101,13 +101,6 @@ class Server:
                     "multi-host serving needs an explicit --first_block/--num_blocks "
                     "(workers load the identical span; auto-placement would desync them)"
                 )
-            if not isinstance(throughput, (int, float)):
-                raise ValueError(
-                    "multi-host serving needs an explicit numeric --throughput "
-                    "(the auto-probe builds throwaway backends workers don't mirror)"
-                )
-            if adapters:
-                raise ValueError("LoRA adapters are not supported with multi-host serving yet")
             if (num_sp_devices or 1) > 1:
                 raise ValueError("multi-host serving is tp-only for now (num_sp_devices must be 1)")
             if mean_balance_check_period:
@@ -305,21 +298,10 @@ class Server:
             # session KV buffers by handle
             self.memory_cache = LockstepMemoryCache(self.memory_cache)
 
-        if self._throughput_spec == "auto":
+        if self._throughput_spec == "auto" and self.num_hosts == 1:
             from petals_tpu.server.throughput import get_server_throughput
 
-            network_mbps = self.network_mbps
-            if network_mbps is None and self.initial_peers:
-                # measure the real path to swarm peers (utils/bandwidth.py) —
-                # the speedtest-cli role; falls back to the loopback stack probe
-                from petals_tpu.dht.routing import PeerAddr
-                from petals_tpu.utils.bandwidth import probe_swarm_bandwidth_mbps
-
-                peer_addrs = [
-                    p if isinstance(p, PeerAddr) else PeerAddr.from_string(p)
-                    for p in self.initial_peers
-                ]
-                network_mbps = await probe_swarm_bandwidth_mbps(self.dht.pool, peer_addrs)
+            network_mbps = await self._resolve_network_mbps()
             info = await asyncio.get_running_loop().run_in_executor(
                 None,
                 lambda: get_server_throughput(
@@ -333,6 +315,8 @@ class Server:
             self.throughput = info["throughput"]
             self._rps_info = info
         else:
+            # multi-host "auto" probes the REAL lockstep backend after it is
+            # built (workers mirror every op) — see below
             self._rps_info = None
 
         if self.auto_placement:
@@ -364,6 +348,8 @@ class Server:
 
         self.backend = self._make_backend(stacked, self.first_block)
         self._install_adapters(self.backend)
+        if self._throughput_spec == "auto" and self.num_hosts > 1:
+            await self._measure_multihost_throughput()
         # Continuous-batching pool sizing: lanes cost HBM for their full lane
         # length, so cap the pool at half the cache budget (private sessions
         # and training still need room) and disable if fewer than 2 lanes fit.
@@ -727,10 +713,102 @@ class Server:
         while True:
             await asyncio.sleep(self.update_period)
             try:
+                if self.num_hosts > 1 and await self._check_group_health():
+                    return  # degraded: final OFFLINE announce already sent
                 await self._measure_next_pings()
                 await self._announce(self._state)
             except Exception as e:
                 logger.warning(f"Announce failed: {e}")
+
+    async def _check_group_health(self) -> bool:
+        """Multi-host worker-death detection: when a lockstep op has degraded
+        the group (a member died mid-collective), stop accepting sessions and
+        go OFFLINE so clients fail over NOW — in-flight sessions already got
+        clean MultihostDegraded errors from their steps. Returns True once
+        degraded (the announce loop then stops)."""
+        from petals_tpu.parallel.multihost import group_degraded
+
+        err = group_degraded()
+        if err is None:
+            return False
+        logger.error(
+            f"multihost group degraded ({err!r}): draining and going OFFLINE "
+            f"— restart the leader and workers to re-form the group"
+        )
+        if self.handler is not None:
+            self.handler.draining = True
+        self._state = ServerState.OFFLINE
+        await self._announce(ServerState.OFFLINE)
+        return True
+
+    async def _resolve_network_mbps(self):
+        network_mbps = self.network_mbps
+        if network_mbps is None and self.initial_peers:
+            # measure the real path to swarm peers (utils/bandwidth.py) —
+            # the speedtest-cli role; falls back to the loopback stack probe
+            from petals_tpu.dht.routing import PeerAddr
+            from petals_tpu.utils.bandwidth import probe_swarm_bandwidth_mbps
+
+            peer_addrs = [
+                p if isinstance(p, PeerAddr) else PeerAddr.from_string(p)
+                for p in self.initial_peers
+            ]
+            network_mbps = await probe_swarm_bandwidth_mbps(self.dht.pool, peer_addrs)
+        return network_mbps
+
+    async def _measure_multihost_throughput(self) -> None:
+        """Auto-throughput for multi-host spans (v2): probe the REAL lockstep
+        backend — every op broadcasts, so the workers mirror the probe exactly
+        like serving traffic. Measures the whole span (already 'per num_blocks'),
+        never disk-cached (the number belongs to this group composition)."""
+        import time as _time
+
+        from petals_tpu.server.throughput import RELAY_PENALTY, measure_network_rps
+
+        cfg = self.cfg
+        rng = np.random.RandomState(0)
+        step_h = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.01
+        fwd_h = rng.randn(1, 16, cfg.hidden_size).astype(np.float32) * 0.01
+
+        descriptors = self.backend.cache_descriptors(1, 64, 0, self.num_blocks)
+        async with self.memory_cache.allocate_cache(*descriptors) as handles:
+            kv = tuple(self.memory_cache.get_buffers(*handles))
+
+            def probe():
+                nonlocal kv
+                out, kv2 = self.backend.inference_step(step_h, kv, 0, handles=handles)
+                np.asarray(out)
+                pos, n = 1, 20
+                t0 = _time.perf_counter()
+                for _ in range(n):
+                    out, kv2 = self.backend.inference_step(step_h, kv2, pos, handles=handles)
+                    pos += 1
+                np.asarray(out)
+                inference_rps = n / (_time.perf_counter() - t0)
+                np.asarray(self.backend.forward(fwd_h))  # compile
+                t0 = _time.perf_counter()
+                for _ in range(3):
+                    np.asarray(self.backend.forward(fwd_h))
+                forward_rps = 3 * fwd_h.shape[1] / (_time.perf_counter() - t0)
+                return inference_rps, forward_rps
+
+            # lockstep ops block on collectives: keep the event loop free
+            inference_rps, forward_rps = await asyncio.get_running_loop().run_in_executor(
+                None, probe
+            )
+        network_mbps = await self._resolve_network_mbps()
+        network_rps = measure_network_rps(cfg.hidden_size, network_mbps=network_mbps)
+        if self.relay_via is not None:
+            network_rps *= RELAY_PENALTY
+        # the span probe already spreads compute over num_blocks blocks
+        self.throughput = min(forward_rps, network_rps)
+        self._rps_info = {
+            "throughput": self.throughput,
+            "inference_rps": inference_rps,
+            "forward_rps": forward_rps,
+            "network_rps": network_rps,
+        }
+        logger.info(f"multihost auto-throughput: {self._rps_info}")
 
     async def _measure_next_pings(self) -> None:
         """Ping the servers that could follow us in an inference chain — those

@@ -204,6 +204,282 @@ def test_multihost_lockstep_matches_single_process(tmp_path, tp, devices_per_pro
     np.testing.assert_allclose(got["g_in"], np.asarray(r_gin), atol=2e-4, rtol=0)
 
 
+_LEADER_V2 = r"""
+import asyncio
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+model_path, adapter_path, out_path, coord = sys.argv[1:5]
+
+from petals_tpu.parallel.multihost import (
+    LockstepBackend, LockstepMemoryCache, init_multihost, multihost_mesh,
+)
+
+init_multihost(coord, 2, 0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from petals_tpu.server.backend import TransformerBackend
+from petals_tpu.server.from_pretrained import get_block_config, load_block_params
+from petals_tpu.server.memory_cache import MemoryCache
+from petals_tpu.utils.peft import load_adapter, stack_adapter
+
+family, cfg = get_block_config(model_path)
+per_block = [load_block_params(model_path, i, dtype=jnp.float32) for i in range(4)]
+stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
+inner = TransformerBackend(
+    family, cfg, stacked, first_block=0, n_blocks=4,
+    memory_cache=MemoryCache(None), compute_dtype=jnp.float32,
+    mesh=multihost_mesh(2), use_flash=False,
+)
+adapter = load_adapter(adapter_path, family.name, block_range=range(4))
+inner.adapters[adapter.name] = (
+    stack_adapter(adapter, 0, 4, jnp.float32), adapter.scaling,
+)
+backend = LockstepBackend(inner)
+mc = LockstepMemoryCache(MemoryCache(None))
+
+rng = np.random.RandomState(0)
+prefill = rng.randn(1, 6, cfg.hidden_size).astype(np.float32) * 0.1
+step = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.1
+
+
+async def main():
+    descriptors = backend.cache_descriptors(1, 16, 0, 4)
+    async with mc.allocate_cache(*descriptors) as handles:
+        kv = tuple(mc.get_buffers(*handles))
+        _, kv = backend.inference_step(prefill, kv, 0, handles=handles)
+        out_a, kv = backend.inference_step(step, kv, 6, handles=handles)
+        mc.update_cache(handles[0], kv[0]); mc.update_cache(handles[1], kv[1])
+        # v2: per-shard KV export (migration/drain under lockstep)
+        exp_k, exp_v = backend.export_kv(
+            handles, lambda: mc.get_buffers(*handles), 0, 4, 7)
+
+        # v2: import into a FRESH mirror, continue decoding there
+        async with mc.allocate_cache(*descriptors) as handles2:
+            new_k, new_v = backend.import_kv(handles2, exp_k, exp_v, 7, 1, 16, 4)
+            mc.update_cache(handles2[0], new_k); mc.update_cache(handles2[1], new_v)
+            kv2 = (new_k, new_v)
+            out_resumed, kv2 = backend.inference_step(step, kv2, 7, handles=handles2)
+
+        # v2: per-request LoRA through the lockstep plane
+        out_lora = backend.forward(prefill, active_adapter=adapter.name)
+        out_plain = backend.forward(prefill)
+
+        np.savez(
+            out_path,
+            out_a=np.asarray(out_a), exp_k=exp_k, exp_v=exp_v,
+            out_resumed=np.asarray(out_resumed),
+            out_lora=np.asarray(out_lora), out_plain=np.asarray(out_plain),
+        )
+    backend.shutdown_workers()
+    print("LEADER_DONE", flush=True)
+
+
+asyncio.run(main())
+"""
+
+_WORKER_V2 = r"""
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+model_path, adapter_path, coord = sys.argv[1:4]
+
+from petals_tpu.parallel.multihost import LockstepWorker, init_multihost, multihost_mesh
+
+init_multihost(coord, 2, 1)
+
+import jax.numpy as jnp
+
+from petals_tpu.server.backend import TransformerBackend
+from petals_tpu.server.from_pretrained import get_block_config, load_block_params
+from petals_tpu.server.memory_cache import MemoryCache
+from petals_tpu.utils.peft import load_adapter, stack_adapter
+
+family, cfg = get_block_config(model_path)
+per_block = [load_block_params(model_path, i, dtype=jnp.float32) for i in range(4)]
+stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
+backend = TransformerBackend(
+    family, cfg, stacked, first_block=0, n_blocks=4,
+    memory_cache=MemoryCache(None), compute_dtype=jnp.float32,
+    mesh=multihost_mesh(2), use_flash=False,
+)
+adapter = load_adapter(adapter_path, family.name, block_range=range(4))
+backend.adapters[adapter.name] = (
+    stack_adapter(adapter, 0, 4, jnp.float32), adapter.scaling,
+)
+LockstepWorker(backend).run()
+"""
+
+
+def test_multihost_v2_adapters_and_kv_migration(tmp_path):
+    """v2 lockstep surface: per-request LoRA, KV export, import-and-resume —
+    all must match a single-process backend doing the same ops."""
+    from tests.test_peft import make_fake_peft_adapter
+
+    model = make_tiny_llama(str(tmp_path), kv_heads=2)
+    adapter_path = make_fake_peft_adapter(str(tmp_path), model)
+    out_path = os.path.join(str(tmp_path), "leader_out.npz")
+    coord = f"127.0.0.1:{_free_port()}"
+    env = _mp_env()
+    leader = subprocess.Popen(
+        [sys.executable, "-c", _LEADER_V2, model, adapter_path, out_path, coord],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    worker = subprocess.Popen(
+        [sys.executable, "-c", _WORKER_V2, model, adapter_path, coord],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        outs = [p.communicate(timeout=600)[0] for p in (leader, worker)]
+    finally:
+        for p in (leader, worker):
+            if p.poll() is None:
+                p.kill()
+    for name, p, out in (("leader", leader, outs[0]), ("worker", worker, outs[1])):
+        assert p.returncode == 0, f"{name} failed:\n{out[-3000:]}"
+
+    # single-process reference
+    from petals_tpu.utils.peft import load_adapter, stack_adapter
+
+    family, cfg = get_block_config(model)
+    per_block = [load_block_params(model, i, dtype=jnp.float32) for i in range(4)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
+    ref = TransformerBackend(
+        family, cfg, stacked, first_block=0, n_blocks=4,
+        memory_cache=MemoryCache(None), compute_dtype=jnp.float32, use_flash=False,
+    )
+    adapter = load_adapter(adapter_path, family.name, block_range=range(4))
+    ref.adapters[adapter.name] = (
+        stack_adapter(adapter, 0, 4, jnp.float32), adapter.scaling,
+    )
+    rng = np.random.RandomState(0)
+    prefill = rng.randn(1, 6, cfg.hidden_size).astype(np.float32) * 0.1
+    step = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.1
+
+    kd, vd = ref.cache_descriptors(1, 16, 0, 4)
+    kv = (kd.make_zeros(), vd.make_zeros())
+    _, kv = ref.inference_step(prefill, kv, 0)
+    r_a, kv = ref.inference_step(step, kv, 6)
+    r_resumed, kv = ref.inference_step(step, kv, 7)
+    r_lora = ref.forward(prefill, active_adapter=adapter.name)
+    r_plain = ref.forward(prefill)
+
+    got = np.load(out_path)
+    np.testing.assert_allclose(got["out_a"], np.asarray(r_a), atol=2e-4, rtol=0)
+    # exported KV equals the reference cache prefix
+    np.testing.assert_allclose(got["exp_k"], np.asarray(kv[0])[:, :, :7], atol=2e-4, rtol=0)
+    np.testing.assert_allclose(got["exp_v"], np.asarray(kv[1])[:, :, :7], atol=2e-4, rtol=0)
+    # decoding resumed on the imported mirror equals the uninterrupted session
+    np.testing.assert_allclose(got["out_resumed"], np.asarray(r_resumed), atol=2e-4, rtol=0)
+    # per-request LoRA through the control plane
+    np.testing.assert_allclose(got["out_lora"], np.asarray(r_lora), atol=2e-4, rtol=0)
+    np.testing.assert_allclose(got["out_plain"], np.asarray(r_plain), atol=2e-4, rtol=0)
+    assert np.abs(got["out_lora"] - got["out_plain"]).max() > 1e-3  # adapter did something
+
+
+_LEADER_KILL = r"""
+import os, sys, time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+model_path, coord, marker_dir = sys.argv[1:4]
+
+from petals_tpu.parallel.multihost import (
+    LockstepBackend, MultihostDegraded, init_multihost, multihost_mesh,
+)
+
+init_multihost(coord, 2, 0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from petals_tpu.server.backend import TransformerBackend
+from petals_tpu.server.from_pretrained import get_block_config, load_block_params
+from petals_tpu.server.memory_cache import MemoryCache
+
+family, cfg = get_block_config(model_path)
+per_block = [load_block_params(model_path, i, dtype=jnp.float32) for i in range(4)]
+stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
+backend = LockstepBackend(TransformerBackend(
+    family, cfg, stacked, first_block=0, n_blocks=4,
+    memory_cache=MemoryCache(None), compute_dtype=jnp.float32,
+    mesh=multihost_mesh(2), use_flash=False,
+))
+rng = np.random.RandomState(0)
+fwd_in = rng.randn(1, 5, cfg.hidden_size).astype(np.float32) * 0.1
+
+np.asarray(backend.forward(fwd_in))
+print("STEP1_OK", flush=True)
+open(os.path.join(marker_dir, "step1"), "w").close()
+while not os.path.exists(os.path.join(marker_dir, "worker_killed")):
+    time.sleep(0.2)
+
+t0 = time.monotonic()
+try:
+    np.asarray(backend.forward(fwd_in))
+    print("UNEXPECTED_SUCCESS", flush=True)
+except MultihostDegraded as e:
+    print(f"DEGRADED_OK after {time.monotonic() - t0:.1f}s", flush=True)
+except BaseException as e:
+    print(f"WRONG_ERROR {type(e).__name__}: {e}", flush=True)
+
+# subsequent ops fail FAST without touching a collective
+t0 = time.monotonic()
+try:
+    np.asarray(backend.forward(fwd_in))
+    print("UNEXPECTED_SUCCESS_2", flush=True)
+except MultihostDegraded:
+    fast = time.monotonic() - t0
+    print(f"FAST_FAIL {fast:.3f}s", flush=True)
+    assert fast < 1.0
+print("LEADER_ALIVE", flush=True)
+"""
+
+
+def test_multihost_worker_death_degrades_cleanly(tmp_path):
+    """Kill the worker mid-group: the leader's next lockstep op must raise
+    MultihostDegraded (bounded by the runtime's collective timeout, not an
+    infinite hang), subsequent ops fail fast, and the leader process itself
+    survives to report status."""
+    model = make_tiny_llama(str(tmp_path))
+    coord = f"127.0.0.1:{_free_port()}"
+    marker_dir = str(tmp_path)
+    env = _mp_env()
+    leader = subprocess.Popen(
+        [sys.executable, "-c", _LEADER_KILL, model, coord, marker_dir],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    worker = subprocess.Popen(
+        [sys.executable, "-c", _WORKER, model, coord, "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        t0 = time.time()
+        while not os.path.exists(os.path.join(marker_dir, "step1")):
+            assert time.time() - t0 < 300, "leader never finished step 1"
+            assert leader.poll() is None, "leader died early"
+            time.sleep(0.2)
+        worker.kill()
+        worker.wait(timeout=30)
+        open(os.path.join(marker_dir, "worker_killed"), "w").close()
+        out = leader.communicate(timeout=300)[0]
+    finally:
+        for p in (leader, worker):
+            if p.poll() is None:
+                p.kill()
+    assert "DEGRADED_OK" in out, f"leader output:\n{out[-3000:]}"
+    assert "FAST_FAIL" in out, f"leader output:\n{out[-3000:]}"
+    assert "LEADER_ALIVE" in out, f"leader output:\n{out[-3000:]}"
+    assert "UNEXPECTED_SUCCESS" not in out
+
+
 def test_multihost_server_end_to_end(tmp_path):
     """Full stack: run_server leader + run_worker over a 2-process tp mesh
     serve a live swarm; client generation is token-identical to HF."""
