@@ -678,6 +678,171 @@ async def run_continuous_batching_bench(concurrent=8, steps=20, prefill=32):
     return result
 
 
+def llama405b_span_cfg(n_blocks=1):
+    """405B-shaped span: the real per-hop activation and per-block weight
+    sizes of the north star (shape constants live in rehearsal_405b)."""
+    from benchmarks.rehearsal_405b import llama405b_cfg
+
+    return llama405b_cfg(n_layers=n_blocks)
+
+
+async def run_chain_hop_bench(cfg=None, *, quant="int4", steps=15, prefill=16,
+                              max_length=64):
+    """Measured 405B-chain feasibility (VERDICT r3 #6): TWO span servers in
+    this process (chip time-sliced), each serving 405B-SHAPED quantized
+    blocks, chained through the REAL stack — client -> server A -> reply +
+    rpc_push -> server B -> reply — measuring what the rehearsal previously
+    assumed: per-hop serialize/transfer/deserialize at hidden=16384 and the
+    per-token chain overhead beyond device compute. The resulting
+    hop_software_ms feeds rehearsal_405b's projection as a same-round
+    measured input (plus an assumed DCN wire latency, reported separately)."""
+    import jax
+    import jax.numpy as jnp
+
+    from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
+    from petals_tpu.models.registry import get_family
+    from petals_tpu.rpc import RpcClient
+    from petals_tpu.rpc.serialization import deserialize_array, serialize_array
+    from petals_tpu.rpc.server import RpcServer
+    from petals_tpu.server.backend import TransformerBackend
+    from petals_tpu.server.handler import TransformerHandler
+    from petals_tpu.server.memory_cache import MemoryCache
+
+    cfg = cfg or llama405b_span_cfg()
+    family = get_family("llama")
+    dtype = jnp.bfloat16
+    n = cfg.num_hidden_layers
+
+    # ---- wire micro-costs at the real activation shape [1, 1, hidden] ----
+    act = np.random.RandomState(0).randn(1, 1, cfg.hidden_size).astype(np.float32)
+    t0 = time.perf_counter()
+    reps = 50
+    for _ in range(reps):
+        wire = serialize_array(act)
+    ser_ms = (time.perf_counter() - t0) / reps * 1e3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        deserialize_array(wire)
+    deser_ms = (time.perf_counter() - t0) / reps * 1e3
+    wire_bytes = len(wire) if isinstance(wire, (bytes, bytearray)) else len(wire.get("data", b""))
+
+    # ---- two span servers, chained ----
+    servers, handlers, clients, backends = [], [], [], []
+    t0 = time.perf_counter()
+    for s in range(2):
+        params = random_params(cfg, n, dtype, quant=quant)
+        memcache = MemoryCache(4 << 30)
+        backend = TransformerBackend(
+            family, cfg, params, first_block=0, n_blocks=n,
+            memory_cache=memcache, compute_dtype=dtype,
+        )
+        handler = TransformerHandler(
+            backend, dht_prefix=f"span{s}", memory_cache=memcache, batching=False,
+        )
+        server = RpcServer()
+        handler.register(server)
+        await server.start()
+        servers.append(server)
+        handlers.append(handler)
+        backends.append(backend)
+        clients.append(await RpcClient.connect("127.0.0.1", server.port))
+    init_s = time.perf_counter() - t0
+
+    rng = np.random.RandomState(0)
+    prefill_h = rng.randn(1, prefill, cfg.hidden_size).astype(np.float32) * 0.02
+    step_h = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.02
+
+    uids = [CHAIN_DELIMITER.join(make_uid(f"span{s}", i) for i in range(n)) for s in range(2)]
+    # B first (gets a session id A can push to), then A with push_to=B
+    stream_b = await clients[1].open_stream("ptu.inference")
+    await stream_b.send({
+        "uids": uids[1], "max_length": max_length, "batch_size": 1,
+        "session_id": "chain-bench-b",
+    })
+    await stream_b.recv(timeout=600)
+    # push addresses are "host:port/peerhex" (PeerAddr.to_string); direct
+    # dials ignore the peer id, so an ephemeral identity fills the slot
+    from petals_tpu.dht.identity import Identity
+
+    peer_hex = Identity.generate().peer_id.to_string()
+    stream_a = await clients[0].open_stream("ptu.inference")
+    await stream_a.send({
+        "uids": uids[0], "max_length": max_length, "batch_size": 1,
+        "push_to": {
+            "addr": f"127.0.0.1:{servers[1].port}/{peer_hex}",
+            "session_id": "chain-bench-b",
+        },
+    })
+    await stream_a.recv(timeout=600)
+
+    async def chain_token(hidden, step_id):
+        """client -> A; A replies AND pushes to B; B's reply closes the token."""
+        await stream_a.send({
+            "tensors": {"hidden": serialize_array(hidden)}, "step_id": step_id,
+        })
+        reply_a = await stream_a.recv(timeout=600)
+        reply_b = await stream_b.recv(timeout=600)
+        return deserialize_array(reply_b["tensors"]["hidden"]), reply_a, reply_b
+
+    out, _, _ = await chain_token(prefill_h, "p0")
+    for i in range(3):  # warmup (compile both spans' decode)
+        out, _, _ = await chain_token(step_h, f"w{i}")
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        out, _, _ = await chain_token(step_h, f"s{i}")
+    chain_step_ms = (time.perf_counter() - t0) / steps * 1e3
+
+    # device-only step per span at the same position (cached executables)
+    dev_ms = []
+    for backend in backends:
+        kd, vd = backend.cache_descriptors(1, max_length, 0, n)
+        kv = (kd.make_zeros(), vd.make_zeros())
+        _, kv = backend.inference_step(prefill_h, kv, 0)
+        o = None
+        for i in range(3):
+            o, kv = backend.inference_step(step_h, kv, prefill + i)
+        hard_sync(o)
+        t0 = time.perf_counter()
+        for i in range(10):
+            o, kv = backend.inference_step(step_h, kv, prefill + 3 + i)
+        hard_sync(o)
+        dev_ms.append((time.perf_counter() - t0) / 10 * 1e3)
+        del kv, o
+
+    for stream in (stream_a, stream_b):
+        await stream.end()
+    for c in clients:
+        await c.close()
+    for s in servers:
+        await s.stop()
+    for h in handlers:
+        h.shutdown()
+
+    device_total_ms = sum(dev_ms)
+    # software cost of ONE hop (serialize + framing + loopback + queue +
+    # deserialize), measured as the chain's per-token overhead over device
+    # compute, split over the 2 hops (client->A and A->B-push)
+    hop_software_ms = max((chain_step_ms - device_total_ms) / 2, 0.0)
+    result = {
+        "label": "chain_hop_405b_shapes",
+        "hidden_size": cfg.hidden_size,
+        "quant": quant or "bf16",
+        "blocks_per_span": n,
+        "serialize_ms": round(ser_ms, 3),
+        "deserialize_ms": round(deser_ms, 3),
+        "wire_bytes_per_activation": wire_bytes,
+        "chain_step_ms": round(chain_step_ms, 3),
+        "device_ms_per_span": [round(d, 3) for d in dev_ms],
+        "hop_software_ms": round(hop_software_ms, 3),
+        "chain_tok_s": round(1000.0 / chain_step_ms, 2),
+        "param_init_s": round(init_s, 1),
+    }
+    del backends, handlers
+    gc.collect()
+    return result
+
+
 def _first_metric_line(text: str):
     """The first ``{"metric": ..., "value": ...}`` JSON line, parsed, or None."""
     for line in text.splitlines():
@@ -957,6 +1122,15 @@ def main():
     moe = bench_moe_dispatch()
     details["moe_prefill_2048"] = moe
     print(f"# moe dispatch: {json.dumps(moe)}", file=sys.stderr)
+
+    # measured 405B-chain hop costs (VERDICT r3 #6): 2 span servers of
+    # 405B-shaped int4 blocks chained through the real RPC stack with push
+    try:
+        ch = asyncio.run(run_chain_hop_bench())
+        details["chain_hop_405b_shapes"] = ch
+        print(f"# 405B chain hops: {json.dumps(ch)}", file=sys.stderr)
+    except Exception as e:  # the chain bench must never sink the run
+        print(f"# 405B chain hop bench failed: {e!r}", file=sys.stderr)
 
     # quantization quality table (VERDICT r3 #4): weight+activation error at
     # 7B shapes per format, so the serving default is re-derived every run

@@ -32,11 +32,17 @@ N_HOSTS = 16
 KV_BUDGET_TOKENS = 8192  # per-span KV allocation the placement must absorb
 # LAN hop between hosts in the same pod's DCN: server->server push latency.
 # This is an ASSUMPTION (the tunnel RTT here is WAN and not representative);
-# the table reports sensitivity to it.
+# the table reports sensitivity to it. When the bench's chain_hop row exists
+# (2 real span servers chained through the RPC stack at hidden=16384), the
+# measured per-hop SOFTWARE cost replaces the software part of this guess and
+# only the wire RTT below stays assumed.
 HOP_MS_LAN = 2.0
+WIRE_RTT_MS_DCN = 0.5  # assumed intra-pod DCN round trip added to measured hops
 
 
-def llama405b_cfg():
+def llama405b_cfg(n_layers: int = 126):
+    """The 405B block shape — single source of truth (bench.py's chain-hop
+    measurement uses the same constants with a shallow layer stack)."""
     from petals_tpu.models.llama.config import LlamaBlockConfig
 
     return LlamaBlockConfig(
@@ -45,7 +51,7 @@ def llama405b_cfg():
         num_key_value_heads=8,
         head_dim=128,
         intermediate_size=53248,
-        num_hidden_layers=126,
+        num_hidden_layers=n_layers,
         rms_norm_eps=1e-5,
         vocab_size=128256,
     )
@@ -209,33 +215,54 @@ def rehearsal_report(bench_details: Optional[dict] = None) -> Dict:
 
     n_int4 = report["placement"]["int4"]["n_per_host"]
     n_by_quant = {"int4": n_int4, "nf4": report["placement"]["nf4"]["n_per_host"]}
+
+    # measured per-hop software cost (bench chain_hop row: real RPC chain at
+    # hidden=16384) + an assumed DCN wire RTT — replaces the 2.0 ms guess
+    hop_ms = HOP_MS_LAN
+    hop_source = "assumed"
+    chain = (bench_details or {}).get("chain_hop_405b_shapes") or {}
+    if chain.get("hop_software_ms") is not None:
+        hop_ms = float(chain["hop_software_ms"]) + WIRE_RTT_MS_DCN
+        hop_source = (
+            f"measured software {chain['hop_software_ms']} ms "
+            f"+ assumed wire {WIRE_RTT_MS_DCN} ms"
+        )
+
     rows = []
     for q in ("int4", "nf4"):
         if q in measured:
-            rows.append(
-                project_single_stream(
-                    measured[q], quant=q, n_per_span=n_by_quant[q],
-                    device_overhead_frac=round(overhead_frac, 3),
-                )
+            row = project_single_stream(
+                measured[q], quant=q, n_per_span=n_by_quant[q],
+                hop_ms=hop_ms,
+                device_overhead_frac=round(overhead_frac, 3),
             )
+            row["hop_source"] = hop_source
+            rows.append(row)
     # the gate scenarios: VERDICT's 400 GB/s bar and the bf16-class ceiling
-    rows.append(project_single_stream(400.0, quant="int4", n_per_span=n_int4))
-    rows.append(project_single_stream(790.0, quant="int4", n_per_span=n_int4))
+    rows.append(project_single_stream(400.0, quant="int4", n_per_span=n_int4, hop_ms=hop_ms))
+    rows.append(project_single_stream(790.0, quant="int4", n_per_span=n_int4, hop_ms=hop_ms))
     report["projection"] = rows
     report["north_star"] = {
         "target_tok_s": 6.0,
-        "min_chip_gb_s_for_target": round(_solve_required_gbs(6.0, n_per_span=n_int4), 1),
+        "hop_ms": round(hop_ms, 3),
+        "hop_source": hop_source,
+        "min_chip_gb_s_for_target": round(
+            _solve_required_gbs(6.0, n_per_span=n_int4, hop_ms=hop_ms), 1
+        ),
     }
     return report
 
 
 def _solve_required_gbs(
-    target_tok_s: float, quant: str = "int4", n_per_span: Optional[int] = None
+    target_tok_s: float, quant: str = "int4", n_per_span: Optional[int] = None,
+    hop_ms: float = HOP_MS_LAN,
 ) -> float:
     lo, hi = 10.0, 2000.0
     for _ in range(60):
         mid = (lo + hi) / 2
-        if project_single_stream(mid, quant=quant, n_per_span=n_per_span)["tok_s"] >= target_tok_s:
+        if project_single_stream(
+            mid, quant=quant, n_per_span=n_per_span, hop_ms=hop_ms
+        )["tok_s"] >= target_tok_s:
             hi = mid
         else:
             lo = mid

@@ -184,6 +184,17 @@ def _bcast_array(arr, shape, dtype):
     )
 
 
+def _adapter_digest(adapters) -> int:
+    """31-bit digest of the sorted adapter-name list: leader and workers must
+    agree on the index->name mapping, not just the count. 31 bits because the
+    header broadcast rides jnp's default int32 (no x64) — wider values would
+    truncate silently."""
+    import hashlib
+
+    blob = ",".join(sorted(adapters)).encode()
+    return int.from_bytes(hashlib.sha256(blob).digest()[:4], "big") >> 1
+
+
 def _stage_kv_mirror(backend, k_prefix, v_prefix, position, batch_size, max_length, n_blocks):
     """Full sharded KV buffers seeded with an imported prefix. Runs in
     lockstep on every process (device_put with a cross-process sharding is a
@@ -247,7 +258,9 @@ class LockstepBackend(_LockstepMixin):
         """Adapters cross the control plane as 1-based indices into the SORTED
         adapter-name list — leader and workers host identical adapter sets
         (same flags, same checkpoints), so the mapping agrees by construction
-        and one int64 slot identifies the pytree the worker must apply."""
+        and one int64 slot identifies the pytree the worker must apply. A
+        digest of the name list rides along (header slot 11) so a drifted
+        worker set fails loud instead of applying the wrong adapter."""
         if not active_adapter:
             return 0
         names = sorted(self._backend.adapters)
@@ -272,6 +285,7 @@ class LockstepBackend(_LockstepMixin):
             _bcast_header([
                 OP_INFERENCE_STEP, mirror, batch, seq, int(position), -1, flags,
                 pre_seq, adapter_code, b0, b1,
+                _adapter_digest(self._backend.adapters) if adapter_code else 0,
             ])
             hidden = _bcast_array(hidden, (batch, seq, self._backend.hidden_size), np.float32)
             if prompts is not None:
@@ -295,7 +309,10 @@ class LockstepBackend(_LockstepMixin):
         pre_seq = 0 if prompts is None else prompts.shape[2]
         b0, b1 = self._span
         with _BCAST_LOCK, _degrade_on_failure():
-            _bcast_header([OP_FORWARD, -1, batch, seq, 0, -1, flags, pre_seq, adapter_code, b0, b1])
+            _bcast_header([
+                OP_FORWARD, -1, batch, seq, 0, -1, flags, pre_seq, adapter_code, b0, b1,
+                _adapter_digest(self._backend.adapters) if adapter_code else 0,
+            ])
             hidden = _bcast_array(hidden, (batch, seq, self._backend.hidden_size), np.float32)
             if prompts is not None:
                 prompts = _bcast_array(
@@ -312,7 +329,10 @@ class LockstepBackend(_LockstepMixin):
         pre_seq = 0 if prompts is None else prompts.shape[2]
         b0, b1 = self._span
         with _BCAST_LOCK, _degrade_on_failure():
-            _bcast_header([OP_BACKWARD, -1, batch, seq, 0, -1, flags, pre_seq, adapter_code, b0, b1])
+            _bcast_header([
+                OP_BACKWARD, -1, batch, seq, 0, -1, flags, pre_seq, adapter_code, b0, b1,
+                _adapter_digest(self._backend.adapters) if adapter_code else 0,
+            ])
             # operand order mirrors the worker's generic decode: hidden, then
             # prompts (if flagged), then the op-specific grad_out
             hidden = _bcast_array(hidden, (batch, seq, self._backend.hidden_size), np.float32)
@@ -480,15 +500,17 @@ class LockstepWorker:
             self._subs[key] = sub
         return self._subs[key]
 
-    def _adapter_name(self, code: int):
+    def _adapter_name(self, code: int, digest: int):
         if code == 0:
             return None
         names = sorted(self.backend.adapters)
-        if code > len(names):
+        # the digest catches sets that differ in NAMES, not just count —
+        # without it a drifted worker would silently apply the wrong adapter
+        if code > len(names) or digest != _adapter_digest(names):
             raise RuntimeError(
-                f"Leader requested adapter #{code} but this worker hosts only "
-                f"{names} — leader and workers must be started with identical "
-                f"--adapters flags"
+                f"Leader requested adapter #{code} of a set with digest "
+                f"{digest} but this worker hosts {names} — leader and workers "
+                f"must be started with identical --adapters flags"
             )
         return names[code - 1]
 
@@ -535,9 +557,9 @@ class LockstepWorker:
                 continue
 
             # compute ops: [op, mirror, batch, seq, position, n_valid, flags,
-            #               pre_seq, adapter_code, b0, b1]
+            #               pre_seq, adapter_code, b0, b1, adapter_digest]
             (_, mirror, batch, seq, position, _n_valid, flags, pre_seq,
-             adapter_code, b0, b1) = header[:11]
+             adapter_code, b0, b1, adapter_digest) = header[:12]
             hidden = _bcast_array(
                 None, (batch, seq, self.backend.hidden_size), np.float32
             )
@@ -547,7 +569,7 @@ class LockstepWorker:
                     None, (b1 - b0, batch, pre_seq, self.backend.hidden_size), np.float32
                 )
             backend = self._sub(b0, b1)
-            adapter = self._adapter_name(adapter_code)
+            adapter = self._adapter_name(adapter_code, adapter_digest)
             if op == OP_INFERENCE_STEP:
                 if flags & _FLAG_HYPO:
                     hypo_ids = _bcast_array(None, (batch,), np.int64)

@@ -768,7 +768,10 @@ class Server:
         cfg = self.cfg
         rng = np.random.RandomState(0)
         step_h = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.01
-        fwd_h = rng.randn(1, 16, cfg.hidden_size).astype(np.float32) * 0.01
+        # 1024-token forwards: the SAME basis as the single-host probe
+        # (throughput.py measure_compute_rps) — announced numbers must be
+        # comparable across servers or routing deprioritizes multi-host spans
+        fwd_h = rng.randn(1, 1024, cfg.hidden_size).astype(np.float32) * 0.01
 
         descriptors = self.backend.cache_descriptors(1, 64, 0, self.num_blocks)
         async with self.memory_cache.allocate_cache(*descriptors) as handles:
