@@ -198,6 +198,55 @@ def test_lane_pressure_fallback(model_path):
     run(main())
 
 
+def test_batched_decode_bloom_alibi(tmp_path_factory):
+    """Vector-position batched decode on the ALiBi family (no RoPE): bloom's
+    bias depends only on absolute kv positions, but the per-lane causal mask
+    must still isolate each lane's history."""
+    from tests.utils import make_tiny_bloom
+
+    path = make_tiny_bloom(str(tmp_path_factory.mktemp("models_bloom")))
+
+    async def main():
+        server, client = await _start_server(path, batching=True)
+        try:
+            cfg = server.cfg
+            prefix = default_dht_prefix(path)
+            uids = CHAIN_DELIMITER.join(
+                make_uid(prefix, i) for i in range(cfg.num_hidden_layers)
+            )
+            plans = [_session_plan(cfg, i, n_steps=5, prefill_len=2 + 2 * i) for i in range(3)]
+            barrier = asyncio.Event()
+            tasks = [
+                asyncio.create_task(
+                    _drive_session(client, uids, p, s, start_barrier=barrier)
+                )
+                for p, s in plans
+            ]
+            await asyncio.sleep(0.1)
+            barrier.set()
+            results = await asyncio.gather(*tasks)
+            assert server.handler.batcher.stats["max_batch"] >= 2
+
+            backend = server.backend
+            for (prefill, steps), got in zip(plans, results):
+                kd, vd = backend.cache_descriptors(1, 64, 0, backend.n_blocks)
+                kv = (kd.make_zeros(), vd.make_zeros())
+                want, kv = backend.inference_step(prefill, kv, 0)
+                np.testing.assert_allclose(got[0], np.asarray(want), atol=2e-5, rtol=0)
+                pos = prefill.shape[1]
+                for i, h in enumerate(steps):
+                    want, kv = backend.inference_step(h, kv, pos)
+                    pos += 1
+                    np.testing.assert_allclose(
+                        got[1 + i], np.asarray(want), atol=2e-5, rtol=0
+                    )
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(main())
+
+
 def test_lane_lifecycle_races(model_path):
     """Two allocator races: (a) a waiter cancelled right after release_lane
     handed it a lane must put the lane back (no capacity leak); (b) releasing
