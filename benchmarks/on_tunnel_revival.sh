@@ -7,16 +7,16 @@ set -u
 cd "$(dirname "$0")/.."
 export PYTHONPATH=/root/.axon_site:.
 
-echo "== 1/4 probe =="
+echo "== 1/5 probe =="
 timeout 120 python -c "import jax; assert jax.default_backend() == 'tpu', jax.default_backend(); print('tpu up')" || exit 1
 
-echo "== 2/4 backend-step ablation (int4; VERDICT weak #2 breakdown) =="
+echo "== 2/5 backend-step ablation (int4; VERDICT weak #2 breakdown) =="
 timeout 1200 python benchmarks/ablate_backend_step.py 2>&1 | grep -v WARNING | tail -6
 
-echo "== 3/4 bench (metric + BENCH_DETAILS + 405B projection + smoke) =="
+echo "== 3/5 bench (metric + BENCH_DETAILS + 405B projection + smoke) =="
 timeout 3600 env _PTU_BENCH_TIMEOUT=2400 python bench.py
 
-echo "== 4/4 profiler spot-check (int8 kernel rate) =="
+echo "== 4/5 profiler spot-check (int8 kernel rate) =="
 timeout 900 python - <<'EOF' 2>&1 | grep -v WARNING | tail -4
 import time, jax, jax.numpy as jnp, numpy as np
 from petals_tpu.ops import quant as Q
@@ -47,4 +47,7 @@ sec = (ts[6] - ts[2]) / 4
 gbs = q.nbytes / sec / 1e9
 print(f"int8 kernel 8192x28672 decode: {sec*1e3:.3f} ms, {gbs:.0f} GB/s ({100*gbs/819:.0f}% HBM)")
 EOF
+echo "== 5/5 flash head-to-head (ours vs jax official, tile sweep) =="
+timeout 1200 python benchmarks/ablate_flash.py 2>&1 | grep -v WARNING | tail -6
+
 echo "== revival queue done =="
