@@ -477,7 +477,7 @@ class TransformerBackend:
         span_params = self.params_for(active_adapter)
         outputs = []
         offset = 0
-        for chunk_len in self._chunk_plan(batch, total_seq, kv_buf_len=max_length):
+        for chunk_len in self.chunk_plan(batch, total_seq, kv_buf_len=max_length):
             chunk = hidden[:, offset : offset + chunk_len]
             out, k_stack, v_stack = self._step_once(
                 span_params, chunk, k_stack, v_stack, position + offset, prompts,
@@ -544,9 +544,11 @@ class TransformerBackend:
             arr = self._dummy_operands[key] = jnp.zeros(shape, dtype)
         return arr
 
-    def _chunk_plan(self, batch: int, total_seq: int, kv_buf_len: int = None) -> Sequence[int]:
+    def chunk_plan(self, batch: int, total_seq: int, kv_buf_len: int = None) -> Sequence[int]:
         """Split a long prefill so each chunk's attention footprint stays under
-        max_chunk_size_bytes (reference backend.py:126-152 semantics)."""
+        max_chunk_size_bytes (reference backend.py:126-152 semantics). Public:
+        the continuous batcher plans queue-task boundaries with it, so the
+        chunk policy lives here in exactly one place."""
         if total_seq <= 1:
             return [total_seq]
         # The linear sizing below is only sound when the flash kernel will

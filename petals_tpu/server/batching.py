@@ -240,25 +240,73 @@ class DecodeBatcher:
 
     # ------------------------------------------------------- non-batchable ops
 
+    def _extract_lane(self, lane: int):
+        """Compute-thread body: lane checked OUT of the pool as session-shaped
+        [n_blocks, 1, max_len, hkv, d] buffers."""
+        k_pool, v_pool = self._buffers()
+        return self.backend._lane_extract_fn(k_pool, v_pool, np.int32(lane))
+
+    def _insert_lane(self, lane: int, kv_lane) -> None:
+        """Compute-thread body: lane checked back IN."""
+        k2, v2 = kv_lane
+        k_pool, v_pool = self._buffers()
+        k_pool, v_pool = self.backend._lane_insert_fn(
+            k_pool, v_pool, k2, v2, np.int32(lane)
+        )
+        self._update(k_pool, v_pool)
+
     async def run_exclusive(self, lane: int, fn, *, size: int = 0):
         """Run ``fn(kv_lane) -> (result, kv_lane')`` with the lane extracted
-        into session-shaped [n_blocks, 1, max_len, hkv, d] buffers, then
-        insert the updated lane back. Used for chunked prefill, KV import and
-        any step the batched program doesn't cover. Serialized with batched
-        steps by the priority queue."""
+        into session-shaped buffers, then insert the updated lane back — all
+        in ONE atomic queue task. Used for KV import and any step the batched
+        program doesn't cover. Serialized with batched steps by the queue."""
 
         def run():
-            k_pool, v_pool = self._buffers()
-            k, v = self.backend._lane_extract_fn(k_pool, v_pool, np.int32(lane))
-            result, (k2, v2) = fn((k, v))
-            k_pool, v_pool = self._buffers()
-            k_pool, v_pool = self.backend._lane_insert_fn(
-                k_pool, v_pool, k2, v2, np.int32(lane)
-            )
-            self._update(k_pool, v_pool)
+            result, kv_lane = fn(self._extract_lane(lane))
+            self._insert_lane(lane, kv_lane)
             return result
 
         return await self.queue.submit(run, priority=PRIORITY_INFERENCE, size=size)
+
+    async def run_exclusive_chunks(self, lane: int, chunk_fns, *, size: int = 0):
+        """Chunked-prefill interleaving (Sarathi-style): extract the lane
+        once, run each ``fn(kv_lane) -> (result, kv_lane')`` as its OWN
+        priority-queue task, insert once. Between chunks the flush loop's
+        batched decode steps run freely — a long prefill no longer stalls
+        every decoding session for its full length. Safe while checked out:
+        batched steps never write an idle-sentinel lane, and the FIFO queue
+        guarantees the final insert lands before any new tenant's first task
+        even if this session is cancelled mid-chunks (stale content beyond a
+        tenant's position is masked by attention anyway)."""
+        if len(chunk_fns) == 1:
+            # short prefills skip the extract/insert round-trips
+            return [await self.run_exclusive(lane, chunk_fns[0], size=size)]
+        state = {}
+
+        def extract():
+            state["kv"] = self._extract_lane(lane)
+
+        await self.queue.submit(extract, priority=PRIORITY_INFERENCE, size=0)
+        results = []
+        try:
+            for fn in chunk_fns:
+                def run_chunk(fn=fn):
+                    res, state["kv"] = fn(state["kv"])
+                    self.stats["exclusive_chunks"] = self.stats.get("exclusive_chunks", 0) + 1
+                    return res
+
+                results.append(
+                    await self.queue.submit(run_chunk, priority=PRIORITY_INFERENCE, size=size)
+                )
+        finally:
+            # always check the lane back in (a failed chunk leaves the last
+            # consistent kv; the session's host-side position was not advanced)
+            if "kv" in state:
+                await self.queue.submit(
+                    lambda: self._insert_lane(lane, state["kv"]),
+                    priority=PRIORITY_INFERENCE, size=0,
+                )
+        return results
 
     async def snapshot_lane(self, lane: int, position: int, b0: int, b1: int):
         """Host copy of blocks [b0, b1) of a lane, sliced to ``position``

@@ -707,10 +707,39 @@ class TransformerHandler:
                         out = await asyncio.wait_for(
                             self.batcher.step(lane, hidden, pos), self.step_timeout
                         )
+                    elif lane is not None and prompts is None and hypo_ids is None:
+                        # pooled long prefill: each chunk is its OWN queue
+                        # task, so other sessions' batched decode steps
+                        # interleave between chunks instead of stalling for
+                        # the whole prefill (Sarathi-style)
+                        chunk_fns = []
+                        off = 0
+                        for clen in backend.chunk_plan(
+                            batch_size, seq, kv_buf_len=self.batcher.max_length
+                        ):
+                            chunk = hidden[:, off : off + clen]
+                            chunk_pos = pos + off
+
+                            def run_chunk(kv_lane, chunk=chunk, chunk_pos=chunk_pos):
+                                with device_annotation("inference_step"):
+                                    out, new_kv = backend.inference_step(
+                                        chunk, kv_lane, chunk_pos,
+                                        active_adapter=active_adapter,
+                                    )
+                                return np.asarray(out), new_kv
+
+                            chunk_fns.append(run_chunk)
+                            off += clen
+                        outs = await asyncio.wait_for(
+                            self.batcher.run_exclusive_chunks(
+                                lane, chunk_fns, size=batch_size * seq
+                            ),
+                            self.step_timeout,
+                        )
+                        out = outs[0] if len(outs) == 1 else np.concatenate(outs, axis=1)
                     elif lane is not None:
-                        # pooled session, non-batchable step (chunked prefill,
-                        # deep prompts, explicit hypo_ids): run on the lane
-                        # extracted into session-shaped buffers
+                        # pooled session with deep prompts or explicit
+                        # hypo_ids: one atomic exclusive pass on the lane
                         def run_lane(kv_lane, hidden=hidden, prompts=prompts, hypo_ids=hypo_ids):
                             with device_annotation("inference_step"):
                                 out, new_kv = backend.inference_step(

@@ -102,7 +102,7 @@ def test_chunked_prefill_matches_single_shot(setup):
         use_flash=False,
         max_chunk_size_bytes=4 * cfg.num_attention_heads * 12 * 4,  # forces 4-token chunks
     )
-    assert len(small._chunk_plan(1, 12)) > 1
+    assert len(small.chunk_plan(1, 12)) > 1
     kv = _alloc_kv(small, 1, 16)
     out, kv = small.inference_step(hidden, kv, 0)
     np.testing.assert_allclose(np.asarray(out), full, atol=3e-5, rtol=0)

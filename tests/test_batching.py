@@ -198,6 +198,89 @@ def test_lane_pressure_fallback(model_path):
     run(main())
 
 
+def test_prefill_interleaves_with_decode(model_path):
+    """Sarathi-style chunked-prefill interleaving: a long prefill runs as one
+    queue task per chunk, so a concurrent session's decode steps complete
+    BETWEEN chunks instead of stalling for the whole prefill."""
+
+    async def main():
+        server, client = await _start_server(
+            model_path, batching=True, max_chunk_size_bytes=4096,
+        )
+        try:
+            cfg = server.cfg
+            prefix = default_dht_prefix(model_path)
+            uids = CHAIN_DELIMITER.join(
+                make_uid(prefix, i) for i in range(cfg.num_hidden_layers)
+            )
+            rng = np.random.RandomState(3)
+            long_prefill = rng.randn(1, 96, cfg.hidden_size).astype(np.float32) * 0.1
+            b_prefill = rng.randn(1, 2, cfg.hidden_size).astype(np.float32) * 0.1
+            b_steps = [
+                rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.1
+                for _ in range(3)
+            ]
+
+            # session B first: prefilled and ready to decode
+            stream_b = await client.open_stream("ptu.inference")
+            await stream_b.send({"uids": uids, "max_length": 128, "batch_size": 1})
+            await stream_b.recv(timeout=60)
+            await stream_b.send({"tensors": {"hidden": serialize_array(b_prefill)}})
+            await stream_b.recv(timeout=120)
+
+            # session A: the long, many-chunk prefill
+            stream_a = await client.open_stream("ptu.inference")
+            await stream_a.send({"uids": uids, "max_length": 128, "batch_size": 1})
+            await stream_a.recv(timeout=60)
+
+            times = {}
+
+            async def run_a():
+                await stream_a.send({"tensors": {"hidden": serialize_array(long_prefill)}})
+                reply = await stream_a.recv(timeout=300)
+                times["a_done"] = asyncio.get_running_loop().time()
+                return deserialize_array(reply["tensors"]["hidden"])
+
+            async def run_b():
+                await asyncio.sleep(0.05)  # let A's prefill get going
+                outs = []
+                for h in b_steps:
+                    await stream_b.send({"tensors": {"hidden": serialize_array(h)}})
+                    reply = await stream_b.recv(timeout=300)
+                    outs.append(deserialize_array(reply["tensors"]["hidden"]))
+                times["b_done"] = asyncio.get_running_loop().time()
+                return outs
+
+            out_a, outs_b = await asyncio.gather(run_a(), run_b())
+            await stream_a.end()
+            await stream_b.end()
+
+            stats = server.handler.batcher.stats
+            assert stats.get("exclusive_chunks", 0) >= 4, stats
+            assert times["b_done"] < times["a_done"], (
+                f"decode stalled behind the whole prefill: {times}, {stats}"
+            )
+
+            # both sessions token-correct
+            backend = server.backend
+            kd, vd = backend.cache_descriptors(1, 128, 0, backend.n_blocks)
+            kv = (kd.make_zeros(), vd.make_zeros())
+            want_a, kv = backend.inference_step(long_prefill, kv, 0)
+            np.testing.assert_allclose(out_a, np.asarray(want_a), atol=2e-5, rtol=0)
+            kv = (kd.make_zeros(), vd.make_zeros())
+            want, kv = backend.inference_step(b_prefill, kv, 0)
+            pos = 2
+            for i, h in enumerate(b_steps):
+                want, kv = backend.inference_step(h, kv, pos)
+                pos += 1
+                np.testing.assert_allclose(outs_b[i], np.asarray(want), atol=2e-5, rtol=0)
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(main())
+
+
 def test_batched_decode_bloom_alibi(tmp_path_factory):
     """Vector-position batched decode on the ALiBi family (no RoPE): bloom's
     bias depends only on absolute kv positions, but the per-lane causal mask
