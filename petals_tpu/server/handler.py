@@ -61,6 +61,7 @@ class TransformerHandler:
         batching: bool = True,  # continuous batching across decode sessions
         batch_lanes: int = 8,
         batch_max_length: Optional[int] = None,  # pool lane length (tokens)
+        prefix_cache_bytes: int = 256 * 2**20,  # 0 disables prefix caching
     ):
         self.backend = backend
         self.dht_prefix = dht_prefix
@@ -109,6 +110,15 @@ class TransformerHandler:
                 n_lanes=batch_lanes,
                 max_length=batch_max_length or inference_max_length or 1024,
             )
+
+        # Content-addressed prefix cache (server/prefix_cache.py): sessions
+        # sharing a prompt prefix skip its prefill compute. Off under
+        # lockstep (host<->device staging would need the broadcast plane).
+        self.prefix_cache = None
+        if prefix_cache_bytes > 0 and not getattr(backend, "is_lockstep", False):
+            from petals_tpu.server.prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(prefix_cache_bytes)
 
     def register(self, server: RpcServer) -> None:
         server.add_unary_handler("ptu.forward", self.rpc_forward)
@@ -190,8 +200,6 @@ class TransformerHandler:
         (must arrive before any compute so the caches never mix histories).
         Under multi-host lockstep the prefix is broadcast once and every
         process materializes its own shards (multihost.py import_kv)."""
-        import jax
-
         if position != 0:
             raise ValueError("kv_import must be the first step of a session")
         new_position = int(step["kv_import"]["position"])
@@ -209,32 +217,21 @@ class TransformerHandler:
                 raise ValueError(f"kv_import {name} shape {arr.shape} != {want_shape}")
             return arr
 
+        arr_k = await asyncio.to_thread(parse, "k", tensors["k"])
+        arr_v = await asyncio.to_thread(parse, "v", tensors["v"])
         if getattr(self.backend, "is_lockstep", False):
-            arr_k = await asyncio.to_thread(parse, "k", tensors["k"])
-            arr_v = await asyncio.to_thread(parse, "v", tensors["v"])
             new_k, new_v = await asyncio.to_thread(
                 self.backend.import_kv, handles, arr_k, arr_v,
                 new_position, batch_size, max_length, n_blocks,
             )
+            self.memory_cache.update_cache(handles[0], new_k)
+            self.memory_cache.update_cache(handles[1], new_v)
         else:
-            def stage(name, wire, buf):
-                # deserialize + zero-fill + device_put are 100s of MB for long
-                # contexts — run off the event loop (like _snapshot_session's
-                # device->host copy) so other sessions' steps don't stall
-                arr = parse(name, wire)
-                full = np.zeros(buf.shape, jax.numpy.dtype(buf.dtype))
-                full[:, :, :new_position] = arr.astype(full.dtype)
-                return (
-                    jax.device_put(full, buf.sharding)
-                    if getattr(buf, "sharding", None) is not None
-                    else jax.numpy.asarray(full)
-                )
-
-            new_k = await asyncio.to_thread(stage, "k", tensors["k"], k_buf)
-            new_v = await asyncio.to_thread(stage, "v", tensors["v"], v_buf)
-        # only the cache-handle swap happens on the loop
-        self.memory_cache.update_cache(handles[0], new_k)
-        self.memory_cache.update_cache(handles[1], new_v)
+            # staging shared with the prefix-cache hit path
+            await self._seed_session_kv(
+                None, kv, handles, arr_k, arr_v, new_position,
+                batch_size=batch_size, n_blocks=n_blocks,
+            )
         return new_position
 
     @contextlib.asynccontextmanager
@@ -249,9 +246,10 @@ class TransformerHandler:
     async def _install_kv_import_pooled(
         self, step, lane: int, position, *, batch_size: int, n_blocks: int, max_length: int
     ) -> int:
-        """Seed a pooled session's lane from another server's exported cache."""
-        import jax.numpy as jnp
-
+        """Seed a pooled session's lane from another server's exported cache
+        (validation here; the staging is shared with the prefix-cache hit
+        path in _seed_session_kv)."""
+        backend = self.batcher.backend
         if position != 0:
             raise ValueError("kv_import must be the first step of a session")
         new_position = int(step["kv_import"]["position"])
@@ -260,30 +258,107 @@ class TransformerHandler:
         tensors = step.get("tensors") or {}
         if "k" not in tensors or "v" not in tensors:
             raise ValueError("kv_import needs k and v tensors")
-        backend = self.batcher.backend
-        lane_shape = (
-            n_blocks, batch_size, self.batcher.max_length,
-            backend.num_kv_heads, backend.head_dim,
+        want_shape = (
+            n_blocks, batch_size, new_position, backend.num_kv_heads, backend.head_dim,
         )
-        want_shape = (n_blocks, batch_size, new_position, *lane_shape[3:])
-        cache_dtype = jnp.dtype(backend.cache_dtype)
 
-        def stage(name, wire):
+        def parse(name, wire):
             arr = deserialize_array(wire)
             if tuple(arr.shape) != want_shape:
                 raise ValueError(f"kv_import {name} shape {arr.shape} != {want_shape}")
-            full = np.zeros(lane_shape, cache_dtype)
-            full[:, :, :new_position] = arr.astype(cache_dtype)
-            return full
+            return arr
 
-        new_k = await asyncio.to_thread(stage, "k", tensors["k"])
-        new_v = await asyncio.to_thread(stage, "v", tensors["v"])
-
-        def replace(kv_lane):
-            return None, (jnp.asarray(new_k), jnp.asarray(new_v))
-
-        await self.batcher.run_exclusive(lane, replace)
+        arr_k = await asyncio.to_thread(parse, "k", tensors["k"])
+        arr_v = await asyncio.to_thread(parse, "v", tensors["v"])
+        await self._seed_session_kv(
+            lane, None, None, arr_k, arr_v, new_position,
+            batch_size=batch_size, n_blocks=n_blocks,
+        )
         return new_position
+
+    async def _seed_session_kv(
+        self, lane, kv, handles, k_arr, v_arr, new_position: int,
+        *, batch_size: int, n_blocks: int,
+    ):
+        """Install k/v prefix rows [0, new_position) into a FRESH session's
+        cache (pooled lane or private buffers) — the prefix-cache hit path.
+        Returns the updated kv pair for the private path."""
+        import jax
+        import jax.numpy as jnp
+
+        if lane is not None:
+            backend0 = self.batcher.backend
+            lane_shape = (
+                n_blocks, batch_size, self.batcher.max_length,
+                backend0.num_kv_heads, backend0.head_dim,
+            )
+            cache_dtype = jnp.dtype(backend0.cache_dtype)
+
+            def build(arr):
+                full = np.zeros(lane_shape, cache_dtype)
+                full[:, :, :new_position] = arr.astype(cache_dtype)
+                return full
+
+            new_k = await asyncio.to_thread(build, k_arr)
+            new_v = await asyncio.to_thread(build, v_arr)
+
+            def replace(kv_lane):
+                return None, (jnp.asarray(new_k), jnp.asarray(new_v))
+
+            await self.batcher.run_exclusive(lane, replace)
+            return kv
+
+        k_buf, v_buf = kv
+
+        def stage(arr, buf):
+            full = np.zeros(buf.shape, jnp.dtype(buf.dtype))
+            full[:, :, :new_position] = arr.astype(full.dtype)
+            return (
+                jax.device_put(full, buf.sharding)
+                if getattr(buf, "sharding", None) is not None
+                else jnp.asarray(full)
+            )
+
+        new_k = await asyncio.to_thread(stage, k_arr, k_buf)
+        new_v = await asyncio.to_thread(stage, v_arr, v_buf)
+        self.memory_cache.update_cache(handles[0], new_k)
+        self.memory_cache.update_cache(handles[1], new_v)
+        return (new_k, new_v)
+
+    async def _store_prefix_async(
+        self, keys, n_hit: int, boundary: int, lane, handles, out_full, n_blocks: int
+    ) -> None:
+        """Snapshot KV rows [0, boundary) and store the freshly computed
+        segments. Runs as a task after the prefill reply; the session loop
+        awaits it before executing any LATER step of the same session, so the
+        stored rows always match the content hash (content-addressed: a
+        rollback later cannot poison the mapping)."""
+        try:
+            if lane is not None:
+                k, v = await self.batcher.snapshot_lane(lane, boundary, 0, n_blocks)
+            else:
+                for attempt in range(20):
+                    try:
+                        k_buf, v_buf = self.memory_cache.get_buffers(*handles)
+                        k, v = await asyncio.to_thread(
+                            lambda: (
+                                np.asarray(k_buf[:, :, :boundary]),
+                                np.asarray(v_buf[:, :, :boundary]),
+                            )
+                        )
+                        break
+                    except Exception:
+                        if attempt == 19:
+                            return
+                        await asyncio.sleep(0.05)
+        except Exception:
+            return  # storing is best-effort; the session must never notice
+        from petals_tpu.server.prefix_cache import SEGMENT_TOKENS
+
+        L = n_hit * SEGMENT_TOKENS
+        self.prefix_cache.put(
+            keys, n_hit, k[:, :, L:], v[:, :, L:], out_full[:, L:boundary]
+        )
 
     async def _snapshot_session(
         self, reg: dict, b0: Optional[int] = None, b1: Optional[int] = None
@@ -548,6 +623,8 @@ class TransformerHandler:
                 "max_length": self.batcher.max_length,
                 **self.batcher.stats,
             }
+        if self.prefix_cache is not None:
+            info["prefix_cache"] = self.prefix_cache.summary()
         return info
 
     async def rpc_inference(self, requests, ctx: RpcContext):
@@ -633,9 +710,17 @@ class TransformerHandler:
                 requests, push_queue, self.session_timeout
             )
             seen_steps = set()  # dedup: the same step may arrive via client AND push
+            pending_store = None  # in-flight prefix-cache store task
             try:
               while True:
                 step = await next_step()
+                # a later step may mutate the rows being stored (rollback,
+                # overwrite): finish the store first so content stays honest
+                if pending_store is not None:
+                    if not pending_store.done():
+                        with contextlib.suppress(Exception):
+                            await pending_store
+                    pending_store = None
                 if step is None:
                     break
                 if self.draining:
@@ -697,11 +782,49 @@ class TransformerHandler:
 
                 pos = position
 
+                # content-addressed prefix cache: a fresh session's prefill
+                # probes for its longest cached prefix, seeds KV from host
+                # RAM, and computes only the tail (server/prefix_cache.py)
+                exec_hidden, prefix_out, pc_keys, pc_hits = hidden, None, None, 0
+                if (
+                    self.prefix_cache is not None
+                    and position == 0
+                    and batch_size == 1
+                    and prompts is None and hypo_ids is None
+                    and active_adapter is None
+                ):
+                    from petals_tpu.server.prefix_cache import SEGMENT_TOKENS, segment_keys
+
+                    if seq >= SEGMENT_TOKENS:
+                        salt = (
+                            f"{self.dht_prefix}:{self.backend.first_block + start}:"
+                            f"{self.backend.first_block + end}"
+                        )
+                        # hashing is multi-MB work: off the event loop, like
+                        # every other bulk host op in this file
+                        pc_keys = await asyncio.to_thread(segment_keys, hidden, salt)
+                        pc_hits = self.prefix_cache.probe(pc_keys)
+                        if pc_hits:
+                            hit_len = pc_hits * SEGMENT_TOKENS
+                            k_pre, v_pre, prefix_out = await asyncio.to_thread(
+                                self.prefix_cache.get_range, pc_keys, pc_hits
+                            )
+                            kv = await self._seed_session_kv(
+                                lane, kv, handles, k_pre, v_pre, hit_len,
+                                batch_size=batch_size, n_blocks=end - start,
+                            )
+                            exec_hidden = hidden[:, hit_len:]
+                            pos = hit_len
+
                 with get_tracer().span(
                     "inference_step", annotate=False,
                     blocks=end - start, batch=batch_size, seq=seq,
                 ):
-                    if lane is not None and seq == 1 and prompts is None and hypo_ids is None:
+                    if exec_hidden.shape[1] == 0:
+                        # the whole prefill was cached: no device work at all
+                        out = prefix_out
+                        prefix_out = None
+                    elif lane is not None and seq == 1 and prompts is None and hypo_ids is None:
                         # the continuous-batching hot path: one token, coalesced
                         # with whatever other sessions are stepping right now
                         out = await asyncio.wait_for(
@@ -715,9 +838,9 @@ class TransformerHandler:
                         chunk_fns = []
                         off = 0
                         for clen in backend.chunk_plan(
-                            batch_size, seq, kv_buf_len=self.batcher.max_length
+                            batch_size, exec_hidden.shape[1], kv_buf_len=self.batcher.max_length
                         ):
-                            chunk = hidden[:, off : off + clen]
+                            chunk = exec_hidden[:, off : off + clen]
                             chunk_pos = pos + off
 
                             def run_chunk(kv_lane, chunk=chunk, chunk_pos=chunk_pos):
@@ -732,7 +855,7 @@ class TransformerHandler:
                             off += clen
                         outs = await asyncio.wait_for(
                             self.batcher.run_exclusive_chunks(
-                                lane, chunk_fns, size=batch_size * seq
+                                lane, chunk_fns, size=batch_size * exec_hidden.shape[1]
                             ),
                             self.step_timeout,
                         )
@@ -755,23 +878,56 @@ class TransformerHandler:
                             self.step_timeout,
                         )
                     else:
-                        def run_step():
+                        def run_step(exec_hidden=exec_hidden, kv=kv):
                             with device_annotation("inference_step"):
                                 out, new_kv = backend.inference_step(
-                                    hidden, kv, pos, prompts=prompts, hypo_ids=hypo_ids,
+                                    exec_hidden, kv, pos, prompts=prompts, hypo_ids=hypo_ids,
                                     active_adapter=active_adapter, handles=handles,
                                 )
                             return np.asarray(out), new_kv
 
                         out, kv = await asyncio.wait_for(
                             self.queue.submit(
-                                run_step, priority=PRIORITY_INFERENCE, size=batch_size * seq
+                                run_step, priority=PRIORITY_INFERENCE,
+                                size=batch_size * exec_hidden.shape[1],
                             ),
                             self.step_timeout,
                         )
                         # keep the allocator's view coherent (old buffers donated)
                         self.memory_cache.update_cache(handles[0], kv[0])
                         self.memory_cache.update_cache(handles[1], kv[1])
+                if prefix_out is not None:
+                    # cached prefix outputs + the freshly computed tail
+                    out = await asyncio.to_thread(
+                        lambda out=out: np.concatenate(
+                            [prefix_out.astype(out.dtype), out], axis=1
+                        )
+                    )
+                if pc_keys is not None and len(pc_keys) > pc_hits:
+                    from petals_tpu.server.prefix_cache import SEGMENT_TOKENS
+
+                    # skip the device->host snapshot entirely when nothing
+                    # would be stored (all keys already present — e.g. a
+                    # racing session won — or one segment exceeds the budget)
+                    import jax.numpy as jnp
+
+                    backend0 = self.backend
+                    seg_bytes = (
+                        2 * (end - start) * SEGMENT_TOKENS
+                        * backend0.num_kv_heads * backend0.head_dim
+                        * jnp.dtype(backend0.cache_dtype).itemsize
+                        + SEGMENT_TOKENS * backend0.hidden_size
+                        * jnp.dtype(backend0.compute_dtype).itemsize
+                    )
+                    if self.prefix_cache.worth_storing(pc_keys, pc_hits, seg_bytes):
+                        # store off the reply path; the loop awaits this
+                        # before any LATER step of this session
+                        pending_store = asyncio.create_task(
+                            self._store_prefix_async(
+                                pc_keys, pc_hits, len(pc_keys) * SEGMENT_TOKENS,
+                                lane, handles, np.asarray(out), end - start,
+                            )
+                        )
                 position += seq
                 if reg is not None:
                     reg["position"] = position
@@ -789,6 +945,10 @@ class TransformerHandler:
                     task.add_done_callback(self._push_tasks.discard)
                 yield {"tensors": {"hidden": wire_out}, "position": position}
             finally:
+                if pending_store is not None and not pending_store.done():
+                    # the lane may be re-tenanted right after release: a store
+                    # still in flight must not snapshot the next session
+                    pending_store.cancel()
                 await cleanup_steps()
                 if session_id:
                     self._push_queues.pop(session_id, None)

@@ -1,0 +1,129 @@
+"""Content-addressed prefix cache: identical prompt prefixes across sessions
+skip their prefill compute (beats the reference, which recomputes every
+session's full prompt; the vLLM-style automatic-prefix-caching idea, built
+for this server's hidden-state wire protocol).
+
+Servers receive prefills as HIDDEN STATES, which are deterministic functions
+of the prompt prefix for a fixed model/span — so a prefix is identified by a
+hash CHAIN over fixed-size token segments: key_i = H(key_{i-1}, bytes of
+segment i). A session's prefill probes the chain for its longest cached
+prefix, seeds its KV buffers from host RAM, computes only the tail, and
+stores the new segments for the next session. Rollbacks can't poison the
+store: entries are content-addressed (same segment bytes -> same KV), never
+keyed by session state.
+
+Storage is host-RAM numpy with an LRU byte budget — HBM stays dedicated to
+live sessions; re-staging a hit costs one host->device copy, which is far
+cheaper than recomputing the prefix through the span.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+SEGMENT_TOKENS = 128
+
+
+def segment_keys(hidden: np.ndarray, salt: str) -> List[str]:
+    """Hash-chain keys for every FULL segment of ``hidden`` [1, seq, h].
+    blake2b (fast, keyed by the span salt so spans never cross-pollute)."""
+    seq = hidden.shape[1]
+    keys = []
+    prev = salt.encode()
+    for s in range(seq // SEGMENT_TOKENS):
+        seg = np.ascontiguousarray(hidden[:, s * SEGMENT_TOKENS : (s + 1) * SEGMENT_TOKENS])
+        h = hashlib.blake2b(prev, digest_size=16)
+        h.update(seg.tobytes())
+        prev = h.digest()
+        keys.append(prev.hex())
+    return keys
+
+
+class PrefixCache:
+    """LRU store of per-segment (k, v, out) host arrays, budgeted by bytes."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._store: "OrderedDict[str, dict]" = OrderedDict()
+        self._bytes = 0
+        self.stats = {"hits": 0, "misses": 0, "hit_tokens": 0, "stored_segments": 0}
+
+    @property
+    def current_bytes(self) -> int:
+        return self._bytes
+
+    def probe(self, keys: Sequence[str]) -> int:
+        """Longest cached prefix (in segments); touches hits for LRU."""
+        n = 0
+        for key in keys:
+            entry = self._store.get(key)
+            if entry is None:
+                break
+            self._store.move_to_end(key)
+            n += 1
+        if n:
+            self.stats["hits"] += 1
+            self.stats["hit_tokens"] += n * SEGMENT_TOKENS
+        else:
+            self.stats["misses"] += 1
+        return n
+
+    def get_range(self, keys: Sequence[str], n: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Concatenated (k, v, out) for segments [0, n) along the token axis:
+        k/v [n_blocks, 1, n*SEG, hkv, d], out [1, n*SEG, hidden]."""
+        entries = [self._store[k] for k in keys[:n]]
+        k = np.concatenate([e["k"] for e in entries], axis=2)
+        v = np.concatenate([e["v"] for e in entries], axis=2)
+        out = np.concatenate([e["out"] for e in entries], axis=1)
+        return k, v, out
+
+    def put(self, keys: Sequence[str], first: int, k: np.ndarray, v: np.ndarray, out: np.ndarray) -> None:
+        """Store segments [first, len(keys)) from span-shaped arrays COVERING
+        those segments: k/v [n_blocks, 1, tokens, hkv, d] and out
+        [1, tokens, hidden] whose token axis starts at segment ``first``."""
+        for i, key in enumerate(keys[first:]):
+            if key in self._store:
+                self._store.move_to_end(key)
+                continue
+            t0, t1 = i * SEGMENT_TOKENS, (i + 1) * SEGMENT_TOKENS
+            if t1 > k.shape[2]:
+                break
+            entry = {
+                "k": np.ascontiguousarray(k[:, :, t0:t1]),
+                "v": np.ascontiguousarray(v[:, :, t0:t1]),
+                "out": np.ascontiguousarray(out[:, t0:t1]),
+            }
+            entry_bytes = sum(a.nbytes for a in entry.values())
+            if entry_bytes > self.max_bytes:
+                return  # a single segment over budget: nothing fits
+            while self._bytes + entry_bytes > self.max_bytes and self._store:
+                _, old = self._store.popitem(last=False)
+                self._bytes -= old["bytes"]
+            entry["bytes"] = entry_bytes
+            self._store[key] = entry
+            self._bytes += entry_bytes
+            self.stats["stored_segments"] += 1
+
+    def worth_storing(self, keys: Sequence[str], first: int, est_entry_bytes: int) -> bool:
+        """Whether a store pass would actually add anything: at least one
+        novel key, and a single entry fits the budget (callers use this to
+        skip the device->host snapshot entirely otherwise)."""
+        if est_entry_bytes > self.max_bytes:
+            return False
+        return any(k not in self._store for k in keys[first:])
+
+    def summary(self) -> dict:
+        return {
+            "segments": len(self._store),
+            "bytes": self._bytes,
+            "max_bytes": self.max_bytes,
+            **self.stats,
+        }

@@ -85,6 +85,7 @@ class Server:
         batching: bool = True,  # continuous batching of concurrent decode sessions
         batch_lanes: Optional[int] = None,  # None: auto-size to the cache budget (<=8)
         batch_max_length: Optional[int] = None,  # pool lane length; None: min(inference_max_length, 1024)
+        prefix_cache_bytes: int = 256 * 2**20,  # host-RAM prompt-prefix cache; 0 disables
     ):
         self.num_hosts = num_hosts or 1
         self.coordinator_address = coordinator_address
@@ -178,6 +179,7 @@ class Server:
         self.batching = batching
         self.batch_lanes = batch_lanes
         self.batch_max_length = batch_max_length
+        self.prefix_cache_bytes = prefix_cache_bytes
         self.request_timeout = request_timeout
         self.session_timeout = session_timeout
         self.step_timeout = step_timeout
@@ -373,6 +375,7 @@ class Server:
             batching=self.batching and batch_lanes >= 2,
             batch_lanes=batch_lanes,
             batch_max_length=batch_max_length,
+            prefix_cache_bytes=self.prefix_cache_bytes,
         )
         self.handler.register(self.rpc_server)
 

@@ -1,0 +1,209 @@
+"""Content-addressed prefix caching (server/prefix_cache.py): sessions
+sharing a prompt prefix skip its prefill compute, token-identically.
+Beats the reference, which recomputes every session's full prompt."""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
+from petals_tpu.rpc import RpcClient
+from petals_tpu.rpc.serialization import deserialize_array, serialize_array
+from petals_tpu.server.prefix_cache import SEGMENT_TOKENS, PrefixCache, segment_keys
+from petals_tpu.server.server import Server, default_dht_prefix
+from tests.utils import make_tiny_llama
+
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    return make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_segment_keys_chain():
+    rng = np.random.RandomState(0)
+    h = rng.randn(1, 3 * SEGMENT_TOKENS + 17, 8).astype(np.float32)
+    keys = segment_keys(h, "salt")
+    assert len(keys) == 3  # the 17-token tail never participates
+    # chain property: same prefix -> same keys; divergence changes the suffix
+    h2 = h.copy()
+    h2[:, SEGMENT_TOKENS + 3] += 1.0
+    keys2 = segment_keys(h2, "salt")
+    assert keys2[0] == keys[0] and keys2[1] != keys[1] and keys2[2] != keys[2]
+    assert segment_keys(h, "other-salt") != keys  # spans never cross-pollute
+
+
+def test_lru_eviction():
+    rng = np.random.RandomState(1)
+    seg_kv = rng.randn(2, 1, SEGMENT_TOKENS, 2, 4).astype(np.float32)
+    seg_out = rng.randn(1, SEGMENT_TOKENS, 8).astype(np.float32)
+    entry_bytes = 2 * seg_kv.nbytes + seg_out.nbytes
+    cache = PrefixCache(max_bytes=3 * entry_bytes + 10)
+    for i in range(5):
+        cache.put([f"k{i}"], 0, seg_kv, seg_kv, seg_out)
+    assert len(cache._store) == 3  # oldest two evicted
+    assert "k0" not in cache._store and "k4" in cache._store
+    assert cache.current_bytes <= cache.max_bytes
+
+
+async def _start_server(model_path, **kwargs):
+    server = Server(model_path, compute_dtype=jnp.float32, use_flash=False, **kwargs)
+    await server.start()
+    client = await RpcClient.connect(server.rpc_server.host, server.rpc_server.port)
+    return server, client
+
+
+async def _one_session(client, uids, prefill, steps, max_length=512):
+    stream = await client.open_stream("ptu.inference")
+    await stream.send({"uids": uids, "max_length": max_length, "batch_size": 1})
+    await stream.recv(timeout=60)
+    outs = []
+    await stream.send({"tensors": {"hidden": serialize_array(prefill)}})
+    reply = await stream.recv(timeout=300)
+    outs.append(deserialize_array(reply["tensors"]["hidden"]))
+    for h in steps:
+        await stream.send({"tensors": {"hidden": serialize_array(h)}})
+        reply = await stream.recv(timeout=300)
+        outs.append(deserialize_array(reply["tensors"]["hidden"]))
+    await stream.end()
+    return outs
+
+
+@pytest.mark.parametrize("batching", [True, False])
+def test_shared_prefix_skips_compute_token_identical(model_path, batching):
+    """Session 2 shares session 1's prompt prefix (plus a different tail):
+    its prefill must hit the cache AND stay token-identical to full compute."""
+
+    async def main():
+        server, client = await _start_server(model_path, batching=batching)
+        try:
+            cfg = server.cfg
+            prefix = default_dht_prefix(model_path)
+            uids = CHAIN_DELIMITER.join(
+                make_uid(prefix, i) for i in range(cfg.num_hidden_layers)
+            )
+            rng = np.random.RandomState(0)
+            shared = rng.randn(1, 2 * SEGMENT_TOKENS, cfg.hidden_size).astype(np.float32) * 0.1
+            tail1 = rng.randn(1, 9, cfg.hidden_size).astype(np.float32) * 0.1
+            tail2 = rng.randn(1, 5, cfg.hidden_size).astype(np.float32) * 0.1
+            step = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.1
+
+            p1 = np.concatenate([shared, tail1], axis=1)
+            p2 = np.concatenate([shared, tail2], axis=1)
+
+            out1 = await _one_session(client, uids, p1, [step])
+            pc = server.handler.prefix_cache
+            assert pc.stats["stored_segments"] == 2, pc.summary()
+
+            out2 = await _one_session(client, uids, p2, [step])
+            assert pc.stats["hit_tokens"] == 2 * SEGMENT_TOKENS, pc.summary()
+
+            # ground truth: full uncached compute for session 2
+            backend = server.backend
+            kd, vd = backend.cache_descriptors(1, 512, 0, backend.n_blocks)
+            kv = (kd.make_zeros(), vd.make_zeros())
+            want, kv = backend.inference_step(p2, kv, 0)
+            np.testing.assert_allclose(out2[0], np.asarray(want), atol=2e-5, rtol=0)
+            want, kv = backend.inference_step(step, kv, p2.shape[1])
+            np.testing.assert_allclose(out2[1], np.asarray(want), atol=2e-5, rtol=0)
+
+            # session 1 correctness too (it populated the cache)
+            kv = (kd.make_zeros(), vd.make_zeros())
+            want, kv = backend.inference_step(p1, kv, 0)
+            np.testing.assert_allclose(out1[0], np.asarray(want), atol=2e-5, rtol=0)
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(main())
+
+
+def test_exact_full_match_skips_all_compute(model_path):
+    """A prefill that is entirely cached does zero device work and still
+    returns the right outputs."""
+
+    async def main():
+        server, client = await _start_server(model_path, batching=False)
+        try:
+            cfg = server.cfg
+            prefix = default_dht_prefix(model_path)
+            uids = CHAIN_DELIMITER.join(
+                make_uid(prefix, i) for i in range(cfg.num_hidden_layers)
+            )
+            rng = np.random.RandomState(2)
+            prompt = rng.randn(1, 2 * SEGMENT_TOKENS, cfg.hidden_size).astype(np.float32) * 0.1
+            step = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.1
+
+            out1 = await _one_session(client, uids, prompt, [step])
+            # count device steps for the second, fully-cached session
+            calls = {"n": 0}
+            backend = server.backend
+            orig = backend.inference_step
+
+            def counted(*a, **k):
+                calls["n"] += 1
+                return orig(*a, **k)
+
+            backend.inference_step = counted
+            out2 = await _one_session(client, uids, prompt, [step])
+            backend.inference_step = orig
+
+            # prefill skipped entirely: only the decode step touched the device
+            assert calls["n"] == 1, calls
+            np.testing.assert_allclose(out2[0], out1[0], atol=0, rtol=0)
+            np.testing.assert_allclose(out2[1], out1[1], atol=2e-5, rtol=0)
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(main())
+
+
+def test_rollback_cannot_poison_cache(model_path):
+    """A session that rolls back and rewrites early rows must not corrupt
+    what later sessions get from the cache (content-addressing + the
+    store-before-next-step barrier)."""
+
+    async def main():
+        server, client = await _start_server(model_path, batching=False)
+        try:
+            cfg = server.cfg
+            prefix = default_dht_prefix(model_path)
+            uids = CHAIN_DELIMITER.join(
+                make_uid(prefix, i) for i in range(cfg.num_hidden_layers)
+            )
+            rng = np.random.RandomState(3)
+            prompt = rng.randn(1, SEGMENT_TOKENS + 4, cfg.hidden_size).astype(np.float32) * 0.1
+            alt = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.1
+
+            stream = await client.open_stream("ptu.inference")
+            await stream.send({"uids": uids, "max_length": 512, "batch_size": 1})
+            await stream.recv(timeout=60)
+            await stream.send({"tensors": {"hidden": serialize_array(prompt)}})
+            await stream.recv(timeout=300)
+            # roll back INTO the stored segment and rewrite a row
+            await stream.send({
+                "tensors": {"hidden": serialize_array(alt)},
+                "start_from_position": 5,
+            })
+            await stream.recv(timeout=300)
+            await stream.end()
+
+            # a fresh session with the same prompt must still get the
+            # ORIGINAL prefix semantics (content-addressed, not session state)
+            out = await _one_session(client, uids, prompt, [])
+            backend = server.backend
+            kd, vd = backend.cache_descriptors(1, 512, 0, backend.n_blocks)
+            kv = (kd.make_zeros(), vd.make_zeros())
+            want, kv = backend.inference_step(prompt, kv, 0)
+            np.testing.assert_allclose(out[0], np.asarray(want), atol=2e-5, rtol=0)
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(main())
