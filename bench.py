@@ -692,6 +692,84 @@ async def run_continuous_batching_bench(concurrent=8, steps=20, prefill=32):
     return result
 
 
+async def run_prefix_cache_bench(prefill=512, *, cfg=None, n_blocks=None):
+    """Time-to-first-token with a shared prompt prefix: two sessions send the
+    SAME prefill; the second must hit the content-addressed prefix cache
+    (server/prefix_cache.py) and skip its prefill compute. The reference
+    recomputes every prompt, so its ratio is ~1.0 by construction."""
+    import jax.numpy as jnp
+
+    from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
+    from petals_tpu.models.registry import get_family
+    from petals_tpu.rpc import RpcClient
+    from petals_tpu.rpc.serialization import serialize_array
+    from petals_tpu.rpc.server import RpcServer
+    from petals_tpu.server.backend import TransformerBackend
+    from petals_tpu.server.handler import TransformerHandler
+    from petals_tpu.server.memory_cache import MemoryCache
+
+    cfg = cfg or llama7b_cfg(n_blocks or N_BLOCKS)
+    n = cfg.num_hidden_layers
+    family = get_family("llama")
+    dtype = jnp.bfloat16
+    params = random_params(cfg, n, dtype)
+    memory_cache = MemoryCache(4 << 30)
+    backend = TransformerBackend(
+        family, cfg, params, first_block=0, n_blocks=n,
+        memory_cache=memory_cache, compute_dtype=dtype,
+    )
+    handler = TransformerHandler(
+        backend, dht_prefix="bench", memory_cache=memory_cache, batching=False,
+    )
+    server = RpcServer()
+    handler.register(server)
+    await server.start()
+    client = await RpcClient.connect("127.0.0.1", server.port)
+    uids = CHAIN_DELIMITER.join(make_uid("bench", i) for i in range(n))
+    rng = np.random.RandomState(0)
+    prefill_h = rng.randn(1, prefill, cfg.hidden_size).astype(np.float32) * 0.02
+    try:
+        async def one_prefill():
+            stream = await client.open_stream("ptu.inference")
+            await stream.send({"uids": uids, "max_length": prefill + 32, "batch_size": 1})
+            await stream.recv(timeout=300)
+            t0 = time.perf_counter()
+            await stream.send({"tensors": {"hidden": serialize_array(prefill_h)}})
+            await stream.recv(timeout=600)
+            elapsed = time.perf_counter() - t0
+            await stream.end()
+            return elapsed
+
+        async def wait_stored():
+            for _ in range(100):  # stores run off the reply path
+                if handler.prefix_cache.summary()["segments"] > 0:
+                    return
+                await asyncio.sleep(0.1)
+
+        t_warm = await one_prefill()  # compile
+        await wait_stored()  # let the warm store LAND before clearing, or it
+        handler.prefix_cache.clear()  # would repopulate and fake the miss
+        t_miss = await one_prefill()  # stores segments (asynchronously)
+        await wait_stored()
+        t_hit = await one_prefill()  # seeds from cache, computes only the tail
+        stats = handler.prefix_cache.summary()
+    finally:
+        await client.close()
+        await server.stop()
+        handler.shutdown()
+    result = {
+        "label": "prefix_cache_ttft",
+        "prefill_tokens": prefill,
+        "miss_prefill_ms": round(t_miss * 1e3, 1),
+        "hit_prefill_ms": round(t_hit * 1e3, 1),
+        "speedup": round(t_miss / max(t_hit, 1e-9), 2),
+        "hit_tokens": stats.get("hit_tokens", 0),
+    }
+    del params, backend, memory_cache
+    gc.collect()
+    return result
+
+
 def llama405b_span_cfg(n_blocks=1):
     """405B-shaped span: the real per-hop activation and per-block weight
     sizes of the north star (shape constants live in rehearsal_405b)."""
@@ -1147,6 +1225,15 @@ def main():
     moe = bench_moe_dispatch()
     details["moe_prefill_2048"] = moe
     print(f"# moe dispatch: {json.dumps(moe)}", file=sys.stderr)
+
+    # prefix-cache TTFT: a shared 512-token prompt's second prefill skips
+    # its compute (the reference recomputes every prompt)
+    try:
+        pcb = asyncio.run(run_prefix_cache_bench())
+        details["prefix_cache_ttft"] = pcb
+        print(f"# prefix cache: {json.dumps(pcb)}", file=sys.stderr)
+    except Exception as e:
+        print(f"# prefix cache bench failed: {e!r}", file=sys.stderr)
 
     # measured 405B-chain hop costs (VERDICT r3 #6): 2 span servers of
     # 405B-shaped int4 blocks chained through the real RPC stack with push

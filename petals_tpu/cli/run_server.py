@@ -99,6 +99,14 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Hub revision (branch/tag/commit) for weight streaming")
     parser.add_argument("--cache_dir", default=None,
                         help="Hub download cache directory (default: PETALS_TPU_CACHE)")
+    parser.add_argument("--no_batching", action="store_true",
+                        help="Disable continuous batching of concurrent decode sessions")
+    parser.add_argument("--batch_lanes", type=int, default=None,
+                        help="Continuous-batching lane count (default: auto-size to the cache budget, <=8)")
+    parser.add_argument("--batch_max_length", type=int, default=None,
+                        help="Lane length in tokens (default: min(inference_max_length, 1024))")
+    parser.add_argument("--prefix_cache_bytes", type=int, default=256 * 2**20,
+                        help="Host-RAM prompt-prefix cache budget; 0 disables")
     return parser
 
 
@@ -184,6 +192,10 @@ def main(argv=None) -> None:
         quant_weight_cache=not args.no_quant_weight_cache,
         coordinator_address=args.coordinator_address,
         num_hosts=args.num_hosts,
+        batching=not args.no_batching,
+        batch_lanes=args.batch_lanes,
+        batch_max_length=args.batch_max_length,
+        prefix_cache_bytes=args.prefix_cache_bytes,
     )
 
     async def run():

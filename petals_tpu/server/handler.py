@@ -946,9 +946,26 @@ class TransformerHandler:
                 yield {"tensors": {"hidden": wire_out}, "position": position}
             finally:
                 if pending_store is not None and not pending_store.done():
-                    # the lane may be re-tenanted right after release: a store
-                    # still in flight must not snapshot the next session
-                    pending_store.cancel()
+                    import sys as _sys
+
+                    if _sys.exc_info()[1] is not None:
+                        # error/cancellation teardown: drop the store NOW —
+                        # holding the lane 30s on an abrupt disconnect would
+                        # stall new-session admission
+                        pending_store.cancel()
+                    else:
+                        # graceful stream end: finish the store BEFORE the
+                        # lane/buffers are released (a session that ends right
+                        # after its prefill — every hop of a chain does — must
+                        # still populate the cache); bounded, and a
+                        # re-tenanted lane is never snapshotted
+                        try:
+                            await asyncio.wait_for(asyncio.shield(pending_store), 30.0)
+                        except asyncio.TimeoutError:
+                            pending_store.cancel()
+                        except BaseException:
+                            pending_store.cancel()
+                            raise
                 await cleanup_steps()
                 if session_id:
                     self._push_queues.pop(session_id, None)

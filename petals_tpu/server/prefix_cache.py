@@ -112,6 +112,11 @@ class PrefixCache:
             self._bytes += entry_bytes
             self.stats["stored_segments"] += 1
 
+    def clear(self) -> None:
+        """Drop every entry (stats are kept — they describe the lifetime)."""
+        self._store.clear()
+        self._bytes = 0
+
     def worth_storing(self, keys: Sequence[str], first: int, est_entry_bytes: int) -> bool:
         """Whether a store pass would actually add anything: at least one
         novel key, and a single entry fits the budget (callers use this to

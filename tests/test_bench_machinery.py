@@ -48,6 +48,12 @@ def test_continuous_batching_bench_machinery(tiny_cfg, monkeypatch):
     assert r["batcher_stats"]["max_batch"] >= 2, r  # coalescing really happened
 
 
+def test_prefix_cache_bench_machinery(tiny_cfg):
+    r = asyncio.run(bench.run_prefix_cache_bench(prefill=256, cfg=tiny_cfg))
+    assert r["hit_tokens"] >= 256, r
+    assert r["miss_prefill_ms"] > 0 and r["hit_prefill_ms"] > 0
+
+
 def test_e2e_bench_machinery(tiny_cfg, monkeypatch):
     # MHA tiny (the matmul-chain tail assumes wq/wk/wv share an output dim)
     mha = LlamaBlockConfig(
