@@ -745,6 +745,8 @@ async def run_prefix_cache_bench(prefill=512, *, cfg=None, n_blocks=None):
                 if handler.prefix_cache.summary()["segments"] > 0:
                     return
                 await asyncio.sleep(0.1)
+            # fail LOUD: a silent timeout here would fake the miss/hit split
+            raise RuntimeError("prefix store did not land within 10s")
 
         t_warm = await one_prefill()  # compile
         await wait_stored()  # let the warm store LAND before clearing, or it
@@ -1183,107 +1185,102 @@ def main():
         return
 
     details = {}
+    # keep the previous successful run's rows reachable (explicitly marked)
+    # even if this run crashes after its first incremental write
+    try:
+        with open("BENCH_DETAILS.json") as f:
+            previous = json.load(f)
+        previous.pop("_previous_run", None)  # never nest
+        details["_previous_run"] = previous
+    except (OSError, ValueError):
+        pass
+
+    def write_details():
+        # atomic + incremental: every completed row survives a later crash
+        # or a driver kill mid-run
+        details["_bench_run"] = {
+            "stale": False,
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        tmp = "BENCH_DETAILS.json.tmp"
+        with open(tmp, "w") as f:
+            json.dump(details, f, indent=2)
+        os.replace(tmp, "BENCH_DETAILS.json")
+
+    def row(name, label, fn):
+        # one failing DETAIL row must never sink the run: the metric line is
+        # already out, and the remaining rows still carry this round's data
+        try:
+            details[name] = fn()
+            print(f"# {label}: {json.dumps(details[name])}", file=sys.stderr)
+        except Exception as e:
+            print(f"# {label} failed: {e!r}", file=sys.stderr)
+        write_details()
 
     e2e = asyncio.run(run_e2e_bench())
     details["e2e_8xllama7b"] = {k: round(v, 3) for k, v in e2e.items()}
     print(f"# e2e 7B-span: {json.dumps(details['e2e_8xllama7b'])}", file=sys.stderr)
+    write_details()
 
-    # 70B-shaped bf16 span: 6 blocks = 10.3 GB of weights on the chip
-    d70 = bench_device_decode(llama70b_cfg(6), label="decode_70b_bf16")
-    details["decode_70b_bf16"] = d70
-    print(f"# 70B-shape bf16: {json.dumps(d70)}", file=sys.stderr)
-
-    # NF4 70B-shaped span: 10 blocks = 4.6 GB quantized (fused Pallas dequant);
-    # stack-time peak is ~2x quantized size + one dense block, inside 16 GB
-    dnf4 = bench_device_decode(llama70b_cfg(10), quant="nf4", label="decode_70b_nf4")
-    details["decode_70b_nf4"] = dnf4
-    print(f"# 70B-shape nf4: {json.dumps(dnf4)}", file=sys.stderr)
-
-    # INT4 (affine decode — ops/quant.py): same 4.25 bits, 2-op dequant; the
-    # decode-bandwidth-optimal 4-bit serving path
-    dint4 = bench_device_decode(llama70b_cfg(10), quant="int4", label="decode_70b_int4")
-    details["decode_70b_int4"] = dint4
-    print(f"# 70B-shape int4: {json.dumps(dint4)}", file=sys.stderr)
-
-    # 8k-context prefill through the flash kernel on 70B-shaped blocks
-    pf = bench_flash_prefill(llama70b_cfg(2), 8192)
-    details["prefill_8k_flash"] = pf
-    print(f"# 8k flash prefill: {json.dumps(pf)}", file=sys.stderr)
-
-    # batched decode throughput on the 7B span (serving-throughput scaling)
-    bd = bench_batched_decode(llama7b_cfg())
-    details["decode_7b_batched"] = bd
-    print(f"# batched decode: {json.dumps(bd)}", file=sys.stderr)
-
-    # continuous batching through the full RPC stack: 8 concurrent sessions
-    # vs 8 serial (VERDICT r3 #3 bar: >=5x serial aggregate)
-    cb = asyncio.run(run_continuous_batching_bench())
-    details["continuous_batching_e2e"] = cb
-    print(f"# continuous batching: {json.dumps(cb)}", file=sys.stderr)
-
-    # sparse vs dense MoE dispatch at prefill (mixtral-8x7B shapes, 1 layer)
-    moe = bench_moe_dispatch()
-    details["moe_prefill_2048"] = moe
-    print(f"# moe dispatch: {json.dumps(moe)}", file=sys.stderr)
-
-    # prefix-cache TTFT: a shared 512-token prompt's second prefill skips
-    # its compute (the reference recomputes every prompt)
-    try:
-        pcb = asyncio.run(run_prefix_cache_bench())
-        details["prefix_cache_ttft"] = pcb
-        print(f"# prefix cache: {json.dumps(pcb)}", file=sys.stderr)
-    except Exception as e:
-        print(f"# prefix cache bench failed: {e!r}", file=sys.stderr)
-
-    # measured 405B-chain hop costs (VERDICT r3 #6): 2 span servers of
-    # 405B-shaped int4 blocks chained through the real RPC stack with push
-    try:
-        ch = asyncio.run(run_chain_hop_bench())
-        details["chain_hop_405b_shapes"] = ch
-        print(f"# 405B chain hops: {json.dumps(ch)}", file=sys.stderr)
-    except Exception as e:  # the chain bench must never sink the run
-        print(f"# 405B chain hop bench failed: {e!r}", file=sys.stderr)
-
-    # quantization quality table (VERDICT r3 #4): weight+activation error at
-    # 7B shapes per format, so the serving default is re-derived every run
-    try:
-        from benchmarks.quant_quality import quality_report
-
-        qq = quality_report(include_model_tier=False)  # model tier is a CPU test
-        details["quant_quality"] = qq
-        print(f"# quant quality: {json.dumps(qq['activation_space_7b_shapes'])}", file=sys.stderr)
-    except Exception as e:  # quality table must never sink the bench run
-        print(f"# quant quality failed: {e!r}", file=sys.stderr)
-
-    # 405B rehearsal: placement math + single-stream projection from THIS
-    # run's measured bandwidths (benchmarks/rehearsal_405b.py; the north-star
-    # arithmetic the driver records every round)
-    try:
-        from benchmarks.rehearsal_405b import rehearsal_report
-
-        rehearsal = rehearsal_report(details)
-        details["rehearsal_405b"] = rehearsal
-        print(
-            f"# 405B rehearsal: {json.dumps(rehearsal['projection'] + [rehearsal['north_star']])}",
-            file=sys.stderr,
-        )
-    except Exception as e:  # the projection must never sink the bench run
-        print(f"# 405B rehearsal failed: {e!r}", file=sys.stderr)
-
-    details["_bench_run"] = {
-        "stale": False,
-        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-    }
-    with open("BENCH_DETAILS.json", "w") as f:
-        json.dump(details, f, indent=2)
-
-    out = {
+    # the ONE metric line goes out the moment its input exists: a failure in
+    # any detail row below must not cost the round its measurement
+    print(json.dumps({
         "metric": f"single_stream_decode_tok_s_{N_BLOCKS}xllama7b_blocks_e2e",
         "value": round(e2e["tok_s"], 2),
         "unit": "tok/s",
         "vs_baseline": round(e2e["tok_s"] / BASELINE_TOK_S, 2),
-    }
-    print(json.dumps(out))
+    }), flush=True)
+
+    # 70B-shaped bf16 span: 6 blocks = 10.3 GB of weights on the chip
+    row("decode_70b_bf16", "70B-shape bf16",
+        lambda: bench_device_decode(llama70b_cfg(6), label="decode_70b_bf16"))
+    # NF4 70B-shaped span: 10 blocks = 4.6 GB quantized (fused Pallas
+    # dequant); stack-time peak is ~2x quantized size + one dense block
+    row("decode_70b_nf4", "70B-shape nf4",
+        lambda: bench_device_decode(llama70b_cfg(10), quant="nf4", label="decode_70b_nf4"))
+    # INT4 (affine decode - ops/quant.py): same 4.25 bits, 2-op dequant; the
+    # decode-bandwidth throughput option
+    row("decode_70b_int4", "70B-shape int4",
+        lambda: bench_device_decode(llama70b_cfg(10), quant="int4", label="decode_70b_int4"))
+    # 8k-context prefill through the flash kernel on 70B-shaped blocks
+    row("prefill_8k_flash", "8k flash prefill",
+        lambda: bench_flash_prefill(llama70b_cfg(2), 8192))
+    # batched decode throughput on the 7B span (serving-throughput scaling)
+    row("decode_7b_batched", "batched decode",
+        lambda: bench_batched_decode(llama7b_cfg()))
+    # continuous batching through the full RPC stack: 8 concurrent sessions
+    # vs 8 serial (VERDICT r3 #3 bar: >=5x serial aggregate)
+    row("continuous_batching_e2e", "continuous batching",
+        lambda: asyncio.run(run_continuous_batching_bench()))
+    # prefix-cache TTFT: a shared 512-token prompt's second prefill skips
+    # its compute (the reference recomputes every prompt)
+    row("prefix_cache_ttft", "prefix cache",
+        lambda: asyncio.run(run_prefix_cache_bench()))
+    # measured 405B-chain hop costs (VERDICT r3 #6): 2 span servers of
+    # 405B-shaped int4 blocks chained through the real RPC stack with push
+    row("chain_hop_405b_shapes", "405B chain hops",
+        lambda: asyncio.run(run_chain_hop_bench()))
+
+    # quantization quality table (VERDICT r3 #4): weight+activation error at
+    # 7B shapes per format, so the serving default is re-derived every run
+    def quality_row():
+        from benchmarks.quant_quality import quality_report
+
+        return quality_report(include_model_tier=False)  # model tier is a CPU test
+
+    row("quant_quality", "quant quality", quality_row)
+    # sparse vs dense MoE dispatch at prefill (mixtral-8x7B shapes, 1 layer)
+    row("moe_prefill_2048", "moe dispatch", bench_moe_dispatch)
+
+    # 405B rehearsal: placement math + single-stream projection from THIS
+    # run's measured bandwidths (benchmarks/rehearsal_405b.py; the north-star
+    # arithmetic the driver records every round)
+    def rehearsal_row():
+        from benchmarks.rehearsal_405b import rehearsal_report
+
+        return rehearsal_report(details)
+
+    row("rehearsal_405b", "405B rehearsal", rehearsal_row)
 
 
 if __name__ == "__main__":

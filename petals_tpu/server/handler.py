@@ -961,11 +961,14 @@ class TransformerHandler:
                         # re-tenanted lane is never snapshotted
                         try:
                             await asyncio.wait_for(asyncio.shield(pending_store), 30.0)
-                        except asyncio.TimeoutError:
-                            pending_store.cancel()
-                        except BaseException:
+                        except asyncio.CancelledError:
                             pending_store.cancel()
                             raise
+                        except Exception:
+                            # incl. TimeoutError and store-internal failures:
+                            # storing is best-effort — an otherwise-successful
+                            # stream must not error over a cache hiccup
+                            pending_store.cancel()
                 await cleanup_steps()
                 if session_id:
                     self._push_queues.pop(session_id, None)
