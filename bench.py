@@ -1025,6 +1025,29 @@ def _mark_details_stale(error: str) -> None:
         pass
 
 
+def _mark_details_partial(error: str) -> None:
+    """The child printed its metric but died before finishing the detail
+    rows: annotate BENCH_DETAILS so the partial row set is distinguishable
+    from a complete run (the incremental writes preserved what finished)."""
+    try:
+        with open("BENCH_DETAILS.json") as f:
+            details = json.load(f)
+    except (OSError, ValueError):
+        return
+    run_info = details.get("_bench_run") or {}
+    if run_info.get("complete"):
+        return  # the final write landed; nothing partial about it
+    run_info.update(partial=True, error=error)
+    details["_bench_run"] = run_info
+    try:
+        tmp = "BENCH_DETAILS.json.tmp"
+        with open(tmp, "w") as f:
+            json.dump(details, f, indent=2)
+        os.replace(tmp, "BENCH_DETAILS.json")
+    except OSError:
+        pass
+
+
 def _probe_backend(timeout: float) -> bool:
     """Cheap child that initializes the accelerator backend and forces one
     computation through it. Lets the supervisor distinguish 'tunnel down'
@@ -1163,6 +1186,11 @@ def main():
             sys.stdout.write(child_stdout)
             sys.stdout.flush()
             _record_last_known_good(metric_line)
+            if error is not None:
+                # the metric is real, but the child died mid-detail-rows:
+                # say so instead of shipping a partial set as complete
+                sys.stderr.write(f"[bench] run incomplete after metric: {error}\n")
+                _mark_details_partial(error)
         else:
             print(json.dumps(_stale_metric_line(error or "no metric line")), flush=True)
             _mark_details_stale(error or "no metric line")
@@ -1185,21 +1213,27 @@ def main():
         return
 
     details = {}
-    # keep the previous successful run's rows reachable (explicitly marked)
-    # even if this run crashes after its first incremental write
+    # keep the previous COMPLETE run's rows reachable (explicitly marked)
+    # even if this run crashes after its first incremental write; a partial
+    # previous file hands its own _previous_run (the older complete set) on
     try:
         with open("BENCH_DETAILS.json") as f:
             previous = json.load(f)
-        previous.pop("_previous_run", None)  # never nest
-        details["_previous_run"] = previous
+        prev_prev = previous.pop("_previous_run", None)
+        if previous.get("_bench_run", {}).get("complete"):
+            details["_previous_run"] = previous
+        elif prev_prev is not None:
+            details["_previous_run"] = prev_prev
     except (OSError, ValueError):
         pass
 
-    def write_details():
+    def write_details(complete: bool = False):
         # atomic + incremental: every completed row survives a later crash
-        # or a driver kill mid-run
+        # or a driver kill mid-run; ``complete`` is stamped only by the final
+        # write so partial files are distinguishable
         details["_bench_run"] = {
             "stale": False,
+            "complete": complete,
             "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
         tmp = "BENCH_DETAILS.json.tmp"
@@ -1281,6 +1315,7 @@ def main():
         return rehearsal_report(details)
 
     row("rehearsal_405b", "405B rehearsal", rehearsal_row)
+    write_details(complete=True)
 
 
 if __name__ == "__main__":

@@ -969,6 +969,11 @@ class TransformerHandler:
                             # storing is best-effort — an otherwise-successful
                             # stream must not error over a cache hiccup
                             pending_store.cancel()
+                        except BaseException:
+                            # GeneratorExit (transport aclose), KeyboardInterrupt:
+                            # never leak the store task holding the lane
+                            pending_store.cancel()
+                            raise
                 await cleanup_steps()
                 if session_id:
                     self._push_queues.pop(session_id, None)

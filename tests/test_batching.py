@@ -330,6 +330,61 @@ def test_batched_decode_bloom_alibi(tmp_path_factory):
     run(main())
 
 
+@pytest.mark.parametrize("quant", ["int8", "int4"])
+def test_batched_decode_quantized(model_path, quant):
+    """The batched program's quant-consts path (StackedQuantLinear views over
+    scan consts) must match per-session scalar decode bit-for-bit."""
+    import jax
+    import jax.numpy as jnp
+
+    from petals_tpu.server.backend import TransformerBackend
+    from petals_tpu.server.from_pretrained import get_block_config, load_block_params
+    from petals_tpu.server.memory_cache import MemoryCache
+    from petals_tpu.utils.convert_block import convert_block_params
+
+    family, cfg = get_block_config(model_path)
+    per_block = [
+        convert_block_params(
+            load_block_params(model_path, i, dtype=jnp.float32, family=family, cfg=cfg),
+            family.name, quant, fuse=False,
+        )
+        for i in range(2)
+    ]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
+    backend = TransformerBackend(
+        family, cfg, stacked, first_block=0, n_blocks=2,
+        memory_cache=MemoryCache(None), compute_dtype=jnp.float32, use_flash=False,
+    )
+    rng = np.random.RandomState(0)
+    L, MAXLEN = 3, 32
+    positions = np.array([4, 0, 9], np.int32)
+    hidden = rng.randn(L, 1, cfg.hidden_size).astype(np.float32) * 0.1
+
+    # per-lane ground truth with the same quantized weights
+    kd, vd = backend.cache_descriptors(1, MAXLEN, 0, 2)
+    want = []
+    lanes_kv = []
+    for l in range(L):
+        kv = (kd.make_zeros(), vd.make_zeros())
+        if positions[l]:
+            pre = rng.randn(1, positions[l], cfg.hidden_size).astype(np.float32) * 0.1
+            _, kv = backend.inference_step(pre, kv, 0)
+        # host copies BEFORE the decode step donates the buffers
+        lanes_kv.append((np.asarray(kv[0]), np.asarray(kv[1])))
+        out, _ = backend.inference_step(hidden[l : l + 1], kv, int(positions[l]))
+        want.append(np.asarray(out))
+
+    # pool assembled from the same per-lane caches
+    k_pool = jnp.asarray(np.concatenate([kv[0] for kv in lanes_kv], axis=1))
+    v_pool = jnp.asarray(np.concatenate([kv[1] for kv in lanes_kv], axis=1))
+    out, _ = backend.batched_decode_step(hidden, (k_pool, v_pool), positions)
+    for l in range(L):
+        np.testing.assert_allclose(
+            np.asarray(out)[l : l + 1], want[l], atol=1e-5, rtol=0,
+            err_msg=f"lane {l} ({quant})",
+        )
+
+
 def test_lane_lifecycle_races(model_path):
     """Two allocator races: (a) a waiter cancelled right after release_lane
     handed it a lane must put the lane back (no capacity leak); (b) releasing
