@@ -180,6 +180,9 @@ class InferenceSession:
         self._max_retries = seq_manager.config.max_retries
         self._last_prompts: Optional[np.ndarray] = None
         self._last_route_check = time.monotonic()
+        # prompt-prefix routing affinity: same prompt -> same replicas ->
+        # server-side prefix-cache hits (sequence_manager._edge_cost)
+        self._affinity_seed: Optional[int] = None
 
     @property
     def position(self) -> int:
@@ -224,9 +227,25 @@ class InferenceSession:
             )
 
         if not self._sessions:
+            from petals_tpu.server.prefix_cache import SEGMENT_TOKENS
+
+            if (
+                self._affinity_seed is None
+                and self._position == 0
+                and n_input_tokens >= SEGMENT_TOKENS
+            ):
+                # hash the first prefill segment (the unit the server-side
+                # prefix cache stores) so identical prompts route identically
+                import hashlib
+
+                seg = np.ascontiguousarray(np.asarray(hidden)[:, :SEGMENT_TOKENS])
+                self._affinity_seed = int.from_bytes(
+                    hashlib.blake2b(seg.tobytes(), digest_size=8).digest(), "big"
+                )
             chain = await self.seq_manager.make_sequence(
                 0, self.num_blocks, mode="min_latency",
                 cache_tokens_needed=self.batch_size * self.max_length,
+                affinity_seed=self._affinity_seed,
             )
             self._sessions = await self._enter_server_sessions(chain)
 
@@ -367,6 +386,7 @@ class InferenceSession:
         new_chain = await self.seq_manager.make_sequence(
             resume, dead_end, mode="min_latency",
             cache_tokens_needed=self.batch_size * self.max_length,
+            affinity_seed=self._affinity_seed,
         )
         new_sessions = await self._enter_server_sessions(new_chain, wire_push=False)
         self._sessions = sorted(
@@ -495,6 +515,7 @@ class InferenceSession:
         candidate = await self.seq_manager.make_sequence(
             0, self.num_blocks, mode="min_latency",
             cache_tokens_needed=self.batch_size * self.max_length,
+            affinity_seed=self._affinity_seed,
         )
         cur_key = [(s.span.peer_id, s.span.start, s.span.end) for s in current]
         cand_key = [(c.peer_id, c.start, c.end) for c in candidate]

@@ -37,6 +37,41 @@ from petals_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 CACHE_MISS_PENALTY = 10.0  # seconds added when a server's KV cache can't fit us
+# Prompt-prefix affinity amplitude (see _edge_cost): must dominate
+# noise-level cost differences between near-equal replicas (sub-ms RTT
+# jitter) or identical prompts scatter and never share a prefix cache; must
+# stay below REAL routing signal (tens-of-ms WAN RTT gaps, CACHE_MISS_PENALTY).
+# 5 ms sits between the two — and a prefix-cache hit repays it thousandfold
+# (it skips the whole shared-prefix prefill).
+AFFINITY_JITTER_S = 5e-3
+
+
+def _affinity01(seed: int, peer_id) -> float:
+    """Deterministic [0, 1) from (seed, peer): same prompt prefix -> same
+    replica preference on every client, every session."""
+    import hashlib
+
+    h = hashlib.blake2b(
+        seed.to_bytes(8, "big", signed=False) + peer_id.to_string().encode(),
+        digest_size=8,
+    )
+    return int.from_bytes(h.digest(), "big") / 2**64
+
+
+def _affinity_jitters(seed: Optional[int]):
+    """Per-peer jitter, memoized for one route computation (the Dijkstra
+    relaxes each peer many times; the hash depends only on (seed, peer))."""
+    if seed is None:
+        return lambda peer_id: 0.0
+    cache: Dict = {}
+
+    def jitter(peer_id) -> float:
+        val = cache.get(peer_id)
+        if val is None:
+            val = cache[peer_id] = AFFINITY_JITTER_S * _affinity01(seed, peer_id)
+        return val
+
+    return jitter
 DEFAULT_RTT = 0.01
 
 
@@ -285,6 +320,7 @@ class RemoteSequenceManager:
         *,
         mode: str = "min_latency",
         cache_tokens_needed: Optional[int] = None,
+        affinity_seed: Optional[int] = None,
     ) -> List[RemoteSpanInfo]:
         end_index = end_index if end_index is not None else len(self.block_uids)
         if self.state.last_updated_time is None:
@@ -305,7 +341,9 @@ class RemoteSequenceManager:
         await refresh_for_cache()
 
         if mode == "min_latency":
-            sequence = self._make_sequence_min_latency(start_index, end_index, cache_tokens_needed)
+            sequence = self._make_sequence_min_latency(
+                start_index, end_index, cache_tokens_needed, affinity_seed
+            )
         elif mode == "max_throughput":
             sequence = self._make_sequence_max_throughput(start_index, end_index)
         else:
@@ -318,7 +356,9 @@ class RemoteSequenceManager:
             await self.update()
             await refresh_for_cache()
             sequence = (
-                self._make_sequence_min_latency(start_index, end_index, cache_tokens_needed)
+                self._make_sequence_min_latency(
+                    start_index, end_index, cache_tokens_needed, affinity_seed
+                )
                 if mode == "min_latency"
                 else self._make_sequence_max_throughput(start_index, end_index)
             )
@@ -364,12 +404,14 @@ class RemoteSequenceManager:
         return sequence
 
     def _make_sequence_min_latency(
-        self, start: int, end: int, cache_tokens_needed: Optional[int]
+        self, start: int, end: int, cache_tokens_needed: Optional[int],
+        affinity_seed: Optional[int] = None,
     ) -> List[RemoteSpanInfo]:
         """Dijkstra over (block, peer) states; edge = RTT + per-block decode cost
         (+ cache-miss penalty), mirroring reference :177-300."""
         import itertools
 
+        jitter = _affinity_jitters(affinity_seed)
         tiebreak = itertools.count()  # heap entries: (cost, counter, block, peer)
         heap: List[Tuple] = [(0.0, next(tiebreak), start, None)]
         best: Dict[Tuple[int, Optional[PeerID]], float] = {(start, None): 0.0}
@@ -388,7 +430,8 @@ class RemoteSequenceManager:
                 info = span.server_info
                 next_block = min(span.end, end)
                 edge = self._edge_cost(
-                    peer, span.peer_id, info, next_block - block, cache_tokens_needed
+                    peer, span.peer_id, info, next_block - block, cache_tokens_needed,
+                    affinity_jitter=jitter(span.peer_id),
                 )
                 nkey = (next_block, span.peer_id)
                 ncost = cost + edge
@@ -417,11 +460,21 @@ class RemoteSequenceManager:
         return sequence
 
     def _edge_cost(
-        self, prev_peer, peer_id, info, n_blocks: int, cache_tokens_needed: Optional[int]
+        self, prev_peer, peer_id, info, n_blocks: int, cache_tokens_needed: Optional[int],
+        *, affinity_jitter: float = 0.0,
     ) -> float:
         """One chain hop's cost: RTT + per-block decode cost + cache-miss
         penalty — THE edge model, shared by the Dijkstra and
-        estimate_chain_latency so the two can never drift apart."""
+        estimate_chain_latency so the two can never drift apart.
+
+        ``affinity_jitter`` (prompt-prefix affinity, up to AFFINITY_JITTER_S
+        = 5 ms): a deterministic per-(prompt, peer) bias that consistently
+        resolves choices between replicas whose measured costs differ by
+        less than a few ms (noise scale), so sessions with the same prompt
+        prefix pick the same replica and hit its prefix cache
+        (server/prefix_cache.py), while different prompts spread load. It
+        CAN flip a genuinely ≤5 ms-better replica — accepted: a prefix-cache
+        hit repays that thousandfold by skipping the shared prefill."""
         rps = info.inference_rps or info.throughput or 1.0
         edge = self.rtt_fn(prev_peer, peer_id) + n_blocks / max(rps, 1e-3)
         if (
@@ -430,7 +483,7 @@ class RemoteSequenceManager:
             and info.cache_tokens_left < cache_tokens_needed
         ):
             edge += CACHE_MISS_PENALTY
-        return edge
+        return edge + affinity_jitter
 
     def estimate_chain_latency(
         self, chain: List[RemoteSpanInfo], cache_tokens_needed: Optional[int] = None

@@ -63,6 +63,57 @@ def test_make_sequence_both_modes():
     run(main())
 
 
+def test_prefix_affinity_breaks_ties_deterministically():
+    """Two equal-cost replicas of the same span: a given affinity seed must
+    pick the SAME replica every time (so identical prompts hit the same
+    server's prefix cache), different seeds must reach both replicas, and the
+    jitter must never override a real cost difference."""
+
+    async def main():
+        boot, nodes, uids = await _swarm_with_servers(
+            2, [(0, 2, 10.0), (0, 2, 10.0)]
+        )
+        manager = await RemoteSequenceManager.create(
+            ClientConfig(initial_peers=[boot.own_addr.to_string()], update_period=1000), uids
+        )
+        try:
+            await manager.ensure_ready()
+            # constant RTT: live ping jitter must not decide this test
+            manager.rtt_fn = lambda a, b: 0.01
+            # same seed -> same replica, across many route computations
+            picks = {
+                seed: {
+                    (await manager.make_sequence(affinity_seed=seed))[0].peer_id
+                    for _ in range(5)
+                }
+                for seed in range(16)
+            }
+            assert all(len(p) == 1 for p in picks.values()), picks
+            # enough seeds reach both replicas (load still spreads); peer ids
+            # are random per run, so 16 seeds make a miss ~2^-15
+            distinct = {next(iter(p)) for p in picks.values()}
+            assert len(distinct) == 2, f"all seeds picked one replica: {picks}"
+
+            # a genuinely better server must win regardless of the seed
+            fast = await DHTNode.create(initial_peers=[boot.own_addr], maintenance_period=1000)
+            info = ServerInfo(
+                ServerState.ONLINE, 1000.0, start_block=0, end_block=2,
+                inference_rps=1000.0,
+            )
+            await declare_active_modules(fast, uids, info, time.time() + 60)
+            nodes.append(fast)
+            await manager.update()
+            for seed in (1, 2, 3):
+                chain = await manager.make_sequence(affinity_seed=seed)
+                assert chain[0].peer_id == fast.peer_id, seed
+        finally:
+            await manager.shutdown()
+            for n in nodes + [boot]:
+                await n.shutdown()
+
+    run(main())
+
+
 def test_min_latency_prefers_fast_servers_and_fewer_hops():
     async def main():
         boot, nodes, uids = await _swarm_with_servers(
