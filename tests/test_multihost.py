@@ -534,6 +534,38 @@ def test_multihost_server_end_to_end(tmp_path):
             # training path across hosts too
             logits = np.asarray(client.forward(ids))
             assert np.isfinite(logits).all()
+
+            # --- v2 worker-death, full stack: kill the worker; the next
+            # request must fail CLEANLY (bounded by the collective timeout,
+            # not a hang) and the leader process must survive to be drained
+            worker.kill()
+            worker.wait(timeout=30)
+            result = {}
+
+            def degraded_generate():
+                try:
+                    client.generate(ids, max_new_tokens=2)
+                    result["error"] = None
+                except Exception as e:
+                    result["error"] = e
+
+            t = threading.Thread(target=degraded_generate, daemon=True)
+            t.start()
+            # enforced bound: client step_timeout is 300s, so a healthy
+            # degradation path errors by then; a hang fails HERE, not in CI
+            t.join(timeout=330)
+            assert not t.is_alive(), "request on a degraded group hung"
+            err = result.get("error")
+            assert err is not None, "request on a degraded group should error"
+            # the error must come from the degradation path, not some
+            # unrelated client bug: group-degraded, banned-servers-missing,
+            # or a step/recv timeout are the legitimate shapes
+            msg = f"{type(err).__name__}: {err}"
+            assert any(
+                key in msg.lower()
+                for key in ("degraded", "missing", "no server", "timeout", "timed out")
+            ), msg
+            assert leader.poll() is None, "leader must survive worker death"
         finally:
             client.close()
     finally:
