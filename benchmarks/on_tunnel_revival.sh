@@ -10,11 +10,14 @@ export PYTHONPATH=/root/.axon_site:.
 echo "== 1/5 probe =="
 timeout 120 python -c "import jax; assert jax.default_backend() == 'tpu', jax.default_backend(); print('tpu up')" || exit 1
 
-echo "== 2/5 backend-step ablation (int4; VERDICT weak #2 breakdown) =="
-timeout 1200 python benchmarks/ablate_backend_step.py 2>&1 | grep -v WARNING | tail -6
-
-echo "== 3/5 bench (metric + BENCH_DETAILS + 405B projection + smoke) =="
+# bench FIRST: BENCH_DETAILS + the metric line are the round's critical
+# artifacts — if the tunnel dies again (or the round ends) mid-queue, they
+# must already be captured; ablations are diagnosis, not evidence of record
+echo "== 2/5 bench (metric + BENCH_DETAILS + 405B projection + smoke) =="
 timeout 3600 env _PTU_BENCH_TIMEOUT=2400 python bench.py
+
+echo "== 3/5 backend-step ablation (int4; VERDICT weak #2 breakdown) =="
+timeout 1200 python benchmarks/ablate_backend_step.py 2>&1 | grep -v WARNING | tail -6
 
 echo "== 4/5 profiler spot-check (int8 kernel rate) =="
 timeout 900 python - <<'EOF' 2>&1 | grep -v WARNING | tail -4
