@@ -68,6 +68,12 @@ class DecodeBatcher:
 
         self._pool_stack: Optional[contextlib.AsyncExitStack] = None
         self._handles = None
+        # a failed donating step can consume the pool buffers; recovery zeros
+        # the pool and bumps the generation so every OUTSTANDING lane is
+        # invalidated (its KV is gone — silently serving zeros would corrupt
+        # every tenant token-by-token)
+        self._generation = 0
+        self._lane_generation: Dict[int, int] = {}
         self._free_lanes: List[int] = []
         self._lane_waiters: List[asyncio.Future] = []
         self._pending: List[tuple] = []  # (lane, hidden, position, future)
@@ -150,14 +156,20 @@ class DecodeBatcher:
         if self._closed:
             raise AllocationFailed("Batcher is closed")
         if self._free_lanes:
-            return self._free_lanes.pop()
+            lane = self._free_lanes.pop()
+            self._lane_generation[lane] = self._generation
+            return lane
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._lane_waiters.append(fut)
         try:
-            return await asyncio.wait_for(fut, timeout)
+            lane = await asyncio.wait_for(fut, timeout)
+            self._lane_generation[lane] = self._generation
+            return lane
         except asyncio.TimeoutError:
             if fut.done() and not fut.cancelled() and fut.exception() is None:
-                return fut.result()  # resolved in the cancellation race window
+                lane = fut.result()  # resolved in the cancellation race window
+                self._lane_generation[lane] = self._generation
+                return lane
             raise AllocationFailed(
                 f"No free decode lane within {timeout} s "
                 f"({self.n_lanes} lanes busy, {len(self._lane_waiters)} waiters)"
@@ -184,6 +196,7 @@ class DecodeBatcher:
             else:
                 kept.append(entry)
         self._pending = kept
+        self._lane_generation.pop(lane, None)
         # hand straight to the next waiter, else back to the free list; the
         # new session overwrites the lane from position 0, so no zeroing
         while self._lane_waiters:
@@ -195,11 +208,19 @@ class DecodeBatcher:
 
     # ------------------------------------------------------------------ stepping
 
+    def _check_lane(self, lane: int) -> None:
+        if self._lane_generation.get(lane) != self._generation:
+            raise AllocationFailed(
+                "Lane pool was reset after a failed device step: this "
+                "session's KV is gone; the client must re-open the session"
+            )
+
     async def step(self, lane: int, hidden: np.ndarray, position: int) -> np.ndarray:
         """One decode token for ``lane`` (hidden [1, 1, hidden]); coalesced
         with whatever other lanes are pending by the time the device is free."""
+        self._check_lane(lane)
         fut = asyncio.get_running_loop().create_future()
-        self._pending.append((lane, hidden, int(position), fut))
+        self._pending.append((lane, hidden, int(position), fut, self._generation))
         if self._flush_task is None or self._flush_task.done():
             self._flush_task = asyncio.create_task(self._flush_loop())
         return await fut
@@ -207,25 +228,64 @@ class DecodeBatcher:
     async def _flush_loop(self) -> None:
         while self._pending:
             batch, self._pending = self._pending, []
+            # entries enqueued before a pool reset must fail loudly — running
+            # them against the rematerialized (zeroed) pool would be the
+            # silent corruption the generation machinery exists to prevent
+            stale = [e for e in batch if e[4] != self._generation]
+            batch = [e for e in batch if e[4] == self._generation]
+            for *_, fut, _gen in stale:
+                if not fut.done():
+                    fut.set_exception(AllocationFailed(
+                        "Lane pool was reset while this step was pending"
+                    ))
+            if not batch:
+                continue
             try:
                 out = await self.queue.submit(
                     self._run_batch, batch, priority=PRIORITY_INFERENCE, size=len(batch)
                 )
             except BaseException as e:  # noqa: BLE001 — deliver to every waiter
-                for *_, fut in batch:
+                for *_, fut, _gen in batch:
                     if not fut.done():
                         fut.set_exception(e)
+                self._maybe_reset_pool()
                 continue
-            for lane, _, _, fut in batch:
+            for lane, _, _, fut, _gen in batch:
                 if not fut.done():
                     fut.set_result(out[lane : lane + 1])
+
+    def _maybe_reset_pool(self) -> None:
+        """A failed batched step may have CONSUMED the donated pool buffers.
+        Zero the pool and invalidate every outstanding lane (generation bump)
+        — their KV is unrecoverable, and letting tenants silently decode
+        against zeros would corrupt outputs; their next step errors instead,
+        so clients re-open through the normal failover path."""
+        if self._handles is None:
+            return
+        try:
+            k_pool, v_pool = self._buffers()
+            broken = k_pool.is_deleted() or v_pool.is_deleted()
+        except Exception:
+            broken = True
+        if not broken:
+            return
+        logger.warning(
+            "Pool-touching step failed with the donated buffers consumed: "
+            "resetting the lane pool; outstanding pooled sessions are invalidated"
+        )
+        self._generation += 1
+        for handle in self._handles or ():
+            try:
+                self.memory_cache.reset_buffer(handle)
+            except KeyError:
+                pass  # racing close(): handles already freed
 
     def _run_batch(self, batch) -> np.ndarray:
         """Compute-thread body: ONE jitted step for every pending lane."""
         hsz = self.backend.hidden_size
         hidden = np.zeros((self.n_lanes, 1, hsz), np.float32)
         positions = np.full((self.n_lanes,), self.max_length, np.int32)  # idle sentinel
-        for lane, h, pos, _ in batch:
+        for lane, h, pos, _fut, _gen in batch:
             hidden[lane] = np.asarray(h, np.float32).reshape(1, hsz)
             positions[lane] = pos
         k_pool, v_pool = self._buffers()
@@ -261,12 +321,23 @@ class DecodeBatcher:
         in ONE atomic queue task. Used for KV import and any step the batched
         program doesn't cover. Serialized with batched steps by the queue."""
 
+        self._check_lane(lane)
+
         def run():
+            self._check_lane(lane)  # re-check: a reset may have raced the queue
             result, kv_lane = fn(self._extract_lane(lane))
             self._insert_lane(lane, kv_lane)
             return result
 
-        return await self.queue.submit(run, priority=PRIORITY_INFERENCE, size=size)
+        try:
+            return await self.queue.submit(run, priority=PRIORITY_INFERENCE, size=size)
+        except AllocationFailed:
+            raise
+        except BaseException:
+            # exclusive ops donate the pool buffers too (_lane_insert_fn):
+            # a failure here can consume them just like a batched step
+            self._maybe_reset_pool()
+            raise
 
     async def run_exclusive_chunks(self, lane: int, chunk_fns, *, size: int = 0):
         """Chunked-prefill interleaving (Sarathi-style): extract the lane
@@ -278,41 +349,60 @@ class DecodeBatcher:
         guarantees the final insert lands before any new tenant's first task
         even if this session is cancelled mid-chunks (stale content beyond a
         tenant's position is masked by attention anyway)."""
+        self._check_lane(lane)
         if len(chunk_fns) == 1:
             # short prefills skip the extract/insert round-trips
             return [await self.run_exclusive(lane, chunk_fns[0], size=size)]
         state = {}
 
         def extract():
+            self._check_lane(lane)  # re-check: a reset may have raced the queue
             state["kv"] = self._extract_lane(lane)
+
+        def insert():
+            self._check_lane(lane)  # a stale lane's data must not be re-inserted
+            self._insert_lane(lane, state["kv"])
 
         await self.queue.submit(extract, priority=PRIORITY_INFERENCE, size=0)
         results = []
         try:
             for fn in chunk_fns:
                 def run_chunk(fn=fn):
+                    self._check_lane(lane)
                     res, state["kv"] = fn(state["kv"])
                     self.stats["exclusive_chunks"] = self.stats.get("exclusive_chunks", 0) + 1
                     return res
 
-                results.append(
-                    await self.queue.submit(run_chunk, priority=PRIORITY_INFERENCE, size=size)
-                )
+                try:
+                    results.append(
+                        await self.queue.submit(run_chunk, priority=PRIORITY_INFERENCE, size=size)
+                    )
+                except AllocationFailed:
+                    raise
+                except BaseException:
+                    self._maybe_reset_pool()
+                    raise
         finally:
             # always check the lane back in (a failed chunk leaves the last
             # consistent kv; the session's host-side position was not advanced)
             if "kv" in state:
-                await self.queue.submit(
-                    lambda: self._insert_lane(lane, state["kv"]),
-                    priority=PRIORITY_INFERENCE, size=0,
-                )
+                try:
+                    await self.queue.submit(insert, priority=PRIORITY_INFERENCE, size=0)
+                except AllocationFailed:
+                    pass  # lane invalidated mid-prefill: nothing to check in
+                except BaseException:
+                    self._maybe_reset_pool()
+                    raise
         return results
 
     async def snapshot_lane(self, lane: int, position: int, b0: int, b1: int):
         """Host copy of blocks [b0, b1) of a lane, sliced to ``position``
         (KV export/migration for pooled sessions)."""
 
+        self._check_lane(lane)
+
         def run():
+            self._check_lane(lane)  # re-check: a reset may have raced the queue
             k_pool, v_pool = self._buffers()
             k, v = self.backend._lane_extract_fn(k_pool, v_pool, np.int32(lane))
             return (

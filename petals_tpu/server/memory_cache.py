@@ -184,6 +184,13 @@ class MemoryCache:
             buffers.append(self._buffers[handle])
         return buffers
 
+    def reset_buffer(self, handle: Handle) -> None:
+        """Drop a handle's buffer so the next get_buffers rematerializes
+        zeros (recovery path: a failed donating step consumed the buffer)."""
+        if handle not in self._allocated:
+            raise KeyError(f"Handle {handle} was not allocated (or already freed)")
+        self._buffers[handle] = None
+
     def update_cache(self, handle: Handle, new_buffer: jax.Array) -> None:
         """Store the post-step buffer for ``handle`` (functional update; pair with
         XLA donation so the HBM allocation is reused)."""

@@ -421,6 +421,71 @@ def test_lane_lifecycle_races(model_path):
     run(main())
 
 
+def test_pool_reset_after_consumed_buffers(model_path):
+    """A batched step that fails AFTER consuming the donated pool buffers
+    must reset the pool and invalidate every outstanding lane — tenants get
+    loud errors (client failover re-opens), never silent zeroed-KV decode."""
+
+    async def main():
+        server, client = await _start_server(model_path, batching=True)
+        try:
+            batcher = server.handler.batcher
+            await batcher.ensure_open()
+            lane = await batcher.acquire_lane()
+            cfg = server.cfg
+
+            # simulate a device failure that consumed the donated buffers
+            orig_run = batcher._run_batch
+
+            def exploding_run(batch):
+                k_pool, v_pool = batcher._buffers()
+                k_pool.delete()
+                v_pool.delete()
+                raise RuntimeError("simulated device failure mid-donation")
+
+            batcher._run_batch = exploding_run
+            h = np.zeros((1, 1, cfg.hidden_size), np.float32)
+            with pytest.raises(RuntimeError, match="simulated device failure"):
+                await batcher.step(lane, h, 0)
+            batcher._run_batch = orig_run
+
+            # the outstanding lane is invalidated...
+            from petals_tpu.server.memory_cache import AllocationFailed
+
+            with pytest.raises(AllocationFailed, match="pool was reset"):
+                await batcher.step(lane, h, 1)
+            # ...including entries that were already PENDING when the reset
+            # landed (they must never run against the rematerialized pool)
+            fut = asyncio.get_running_loop().create_future()
+            batcher._pending.append((lane, h, 1, fut, batcher._generation - 1))
+            await batcher._flush_loop()
+            assert isinstance(fut.exception(), AllocationFailed)
+            batcher.release_lane(lane)
+
+            # ...but a NEW session works on the fresh pool, token-correct
+            prefix = default_dht_prefix(model_path)
+            uids = CHAIN_DELIMITER.join(
+                make_uid(prefix, i) for i in range(cfg.num_hidden_layers)
+            )
+            prefill, steps = _session_plan(cfg, 0, n_steps=3, prefill_len=4)
+            got = await _drive_session(client, uids, prefill, steps)
+            backend = server.backend
+            kd, vd = backend.cache_descriptors(1, 64, 0, backend.n_blocks)
+            kv = (kd.make_zeros(), vd.make_zeros())
+            want, kv = backend.inference_step(prefill, kv, 0)
+            np.testing.assert_allclose(got[0], np.asarray(want), atol=2e-5, rtol=0)
+            pos = 4
+            for i, hstep in enumerate(steps):
+                want, kv = backend.inference_step(hstep, kv, pos)
+                pos += 1
+                np.testing.assert_allclose(got[1 + i], np.asarray(want), atol=2e-5, rtol=0)
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(main())
+
+
 def test_pooled_session_rollback(model_path):
     """start_from_position (speculative-decoding rollback) on a pooled
     session: later tokens must be recomputed from the rewound cache."""
