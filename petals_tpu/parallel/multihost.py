@@ -43,8 +43,12 @@ program and shards params across member processes, so a worker hot-swap is a
 rebuild by construction; elasticity lives at the swarm layer, where the unit
 of failure is the span server — same as the reference's whole-server process).
 
+The prefix cache (server/prefix_cache.py) rides the same import/export ops,
+so shared-prompt prefills skip compute on multi-host spans too.
+
 Remaining v1 limit: live rebalancing (a span move would strand the workers'
-shards) and sp meshes.
+shards), sp meshes, and continuous batching (lockstep spans serve sessions
+individually; the lane pool's device ops are not broadcast ops yet).
 """
 
 from __future__ import annotations

@@ -76,7 +76,7 @@ class DecodeBatcher:
         self._lane_generation: Dict[int, int] = {}
         self._free_lanes: List[int] = []
         self._lane_waiters: List[asyncio.Future] = []
-        self._pending: List[tuple] = []  # (lane, hidden, position, future)
+        self._pending: List[tuple] = []  # (lane, hidden, position, future, generation)
         self._flush_task: Optional[asyncio.Task] = None
         self._open_lock = asyncio.Lock()
         self._closed = False
@@ -282,6 +282,12 @@ class DecodeBatcher:
 
     def _run_batch(self, batch) -> np.ndarray:
         """Compute-thread body: ONE jitted step for every pending lane."""
+        # generation guards on BOTH sides of the device step: an exclusive
+        # op's failure can reset the pool from the event loop while this
+        # task is queued or mid-flight, and decoding against the
+        # rematerialized zeros must fail loudly, never resolve futures
+        if batch and batch[0][4] != self._generation:
+            raise AllocationFailed("Lane pool was reset before this batched step ran")
         hsz = self.backend.hidden_size
         hidden = np.zeros((self.n_lanes, 1, hsz), np.float32)
         positions = np.full((self.n_lanes,), self.max_length, np.int32)  # idle sentinel
@@ -296,7 +302,12 @@ class DecodeBatcher:
         self.stats["batched_steps"] += 1
         self.stats["batched_tokens"] += len(batch)
         self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
-        return np.asarray(out)
+        host_out = np.asarray(out)
+        if batch and batch[0][4] != self._generation:
+            # the reset landed while this step executed: the buffers it read
+            # were either consumed (we would have raised) or already zeroed
+            raise AllocationFailed("Lane pool was reset while this batched step ran")
+        return host_out
 
     # ------------------------------------------------------- non-batchable ops
 

@@ -112,10 +112,10 @@ class TransformerHandler:
             )
 
         # Content-addressed prefix cache (server/prefix_cache.py): sessions
-        # sharing a prompt prefix skip its prefill compute. Off under
-        # lockstep (host<->device staging would need the broadcast plane).
+        # sharing a prompt prefix skip its prefill compute. Under lockstep
+        # the staging rides the v2 broadcast ops (import_kv / export_kv).
         self.prefix_cache = None
-        if prefix_cache_bytes > 0 and not getattr(backend, "is_lockstep", False):
+        if prefix_cache_bytes > 0:
             from petals_tpu.server.prefix_cache import PrefixCache
 
             self.prefix_cache = PrefixCache(prefix_cache_bytes)
@@ -309,6 +309,15 @@ class TransformerHandler:
             return kv
 
         k_buf, v_buf = kv
+        if getattr(self.backend, "is_lockstep", False):
+            # multihost: every process shards its own mirror (v2 import op)
+            new_k, new_v = await asyncio.to_thread(
+                self.backend.import_kv, handles, k_arr, v_arr,
+                new_position, batch_size, k_buf.shape[2], n_blocks,
+            )
+            self.memory_cache.update_cache(handles[0], new_k)
+            self.memory_cache.update_cache(handles[1], new_v)
+            return (new_k, new_v)
 
         def stage(arr, buf):
             full = np.zeros(buf.shape, jnp.dtype(buf.dtype))
@@ -336,6 +345,14 @@ class TransformerHandler:
         try:
             if lane is not None:
                 k, v = await self.batcher.snapshot_lane(lane, boundary, 0, n_blocks)
+            elif getattr(self.backend, "is_lockstep", False):
+                # multihost: per-shard all_gather (v2 export op), bounded to
+                # the 128-bucketed boundary inside export_kv
+                k, v = await asyncio.to_thread(
+                    self.backend.export_kv, handles,
+                    lambda: self.memory_cache.get_buffers(*handles),
+                    0, n_blocks, boundary,
+                )
             else:
                 for attempt in range(20):
                     try:

@@ -535,6 +535,35 @@ def test_multihost_server_end_to_end(tmp_path):
             logits = np.asarray(client.forward(ids))
             assert np.isfinite(logits).all()
 
+            # --- prefix caching under lockstep (v2 import/export ops): the
+            # second identical long prompt must hit the leader's prefix
+            # cache — and stay token-identical — with every process
+            # sharding its mirror of the seeded KV
+            long_ids = rng.randint(0, 100, (1, 140)).astype(np.int64)
+            want_long = _hf_greedy(model, long_ids, 2)
+            np.testing.assert_array_equal(
+                client.generate(long_ids, max_new_tokens=2), want_long
+            )
+            np.testing.assert_array_equal(
+                client.generate(long_ids, max_new_tokens=2), want_long
+            )
+            import asyncio as _a
+
+            from petals_tpu.rpc import RpcClient
+
+            host, port = addr.rsplit("/", 1)[0].rsplit(":", 1)
+
+            async def leader_info():
+                c = await RpcClient.connect(host, int(port))
+                try:
+                    return await c.call("ptu.info", {}, timeout=30)
+                finally:
+                    await c.close()
+
+            info = _a.run(leader_info())
+            pc = info.get("prefix_cache") or {}
+            assert pc.get("hit_tokens", 0) >= 128, pc
+
             # --- v2 worker-death, full stack: kill the worker; the next
             # request must fail CLEANLY (bounded by the collective timeout,
             # not a hang) and the leader process must survive to be drained
