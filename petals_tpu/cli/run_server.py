@@ -107,6 +107,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Lane length in tokens (default: min(inference_max_length, 1024))")
     parser.add_argument("--prefix_cache_bytes", type=int, default=256 * 2**20,
                         help="Host-RAM prompt-prefix cache budget; 0 disables")
+    parser.add_argument("--prefix_share_scope", choices=["swarm", "peer"], default="swarm",
+                        help="'swarm' shares cached prefixes across all clients (fastest; a client "
+                             "can time-probe whether a prompt prefix was recently served); 'peer' "
+                             "salts entries per authenticated client identity, closing that "
+                             "side channel at the cost of cross-client sharing")
     return parser
 
 
@@ -196,6 +201,7 @@ def main(argv=None) -> None:
         batch_lanes=args.batch_lanes,
         batch_max_length=args.batch_max_length,
         prefix_cache_bytes=args.prefix_cache_bytes,
+        prefix_share_scope=args.prefix_share_scope,
     )
 
     async def run():

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -73,6 +74,10 @@ class DecodeBatcher:
         # invalidated (its KV is gone — silently serving zeros would corrupt
         # every tenant token-by-token)
         self._generation = 0
+        # makes the compute thread's post-step generation-check + buffer swap
+        # atomic w.r.t. the event loop's reset (check-then-update alone is a
+        # TOCTOU: a reset landing between them would be overwritten)
+        self._reset_lock = threading.Lock()
         self._lane_generation: Dict[int, int] = {}
         self._free_lanes: List[int] = []
         self._lane_waiters: List[asyncio.Future] = []
@@ -273,12 +278,13 @@ class DecodeBatcher:
             "Pool-touching step failed with the donated buffers consumed: "
             "resetting the lane pool; outstanding pooled sessions are invalidated"
         )
-        self._generation += 1
-        for handle in self._handles or ():
-            try:
-                self.memory_cache.reset_buffer(handle)
-            except KeyError:
-                pass  # racing close(): handles already freed
+        with self._reset_lock:
+            self._generation += 1
+            for handle in self._handles or ():
+                try:
+                    self.memory_cache.reset_buffer(handle)
+                except KeyError:
+                    pass  # racing close(): handles already freed
 
     def _run_batch(self, batch) -> np.ndarray:
         """Compute-thread body: ONE jitted step for every pending lane."""
@@ -298,15 +304,20 @@ class DecodeBatcher:
         out, (k_pool, v_pool) = self.backend.batched_decode_step(
             hidden, (k_pool, v_pool), positions
         )
-        self._update(k_pool, v_pool)
+        host_out = np.asarray(out)  # device sync: the step has fully executed
+        with self._reset_lock:
+            if batch and batch[0][4] != self._generation:
+                # the reset landed while this step executed: the buffers it
+                # read were either consumed (we would have raised) or already
+                # zeroed. Checked atomically with the swap (under the reset
+                # lock) so the freshly reset pool stays zeroed — swapping in
+                # the stale stepped buffers would silently break the 'reset
+                # leaves a zeroed pool' recovery invariant.
+                raise AllocationFailed("Lane pool was reset while this batched step ran")
+            self._update(k_pool, v_pool)
         self.stats["batched_steps"] += 1
         self.stats["batched_tokens"] += len(batch)
         self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
-        host_out = np.asarray(out)
-        if batch and batch[0][4] != self._generation:
-            # the reset landed while this step executed: the buffers it read
-            # were either consumed (we would have raised) or already zeroed
-            raise AllocationFailed("Lane pool was reset while this batched step ran")
         return host_out
 
     # ------------------------------------------------------- non-batchable ops
@@ -318,13 +329,21 @@ class DecodeBatcher:
         return self.backend._lane_extract_fn(k_pool, v_pool, np.int32(lane))
 
     def _insert_lane(self, lane: int, kv_lane) -> None:
-        """Compute-thread body: lane checked back IN."""
+        """Compute-thread body: lane checked back IN. The whole read-insert-
+        swap runs under the reset lock: a reset landing mid-way would
+        otherwise let the insert donate the freshly zeroed pool's buffers (or
+        swap stale pre-reset buffers back in), breaking the 'reset leaves a
+        zeroed pool' invariant — the same TOCTOU _run_batch guards against.
+        The lane check raises BEFORE any buffer is donated, so a failed
+        insert leaves the new pool untouched."""
         k2, v2 = kv_lane
-        k_pool, v_pool = self._buffers()
-        k_pool, v_pool = self.backend._lane_insert_fn(
-            k_pool, v_pool, k2, v2, np.int32(lane)
-        )
-        self._update(k_pool, v_pool)
+        with self._reset_lock:
+            self._check_lane(lane)
+            k_pool, v_pool = self._buffers()
+            k_pool, v_pool = self.backend._lane_insert_fn(
+                k_pool, v_pool, k2, v2, np.int32(lane)
+            )
+            self._update(k_pool, v_pool)
 
     async def run_exclusive(self, lane: int, fn, *, size: int = 0):
         """Run ``fn(kv_lane) -> (result, kv_lane')`` with the lane extracted

@@ -62,6 +62,7 @@ class TransformerHandler:
         batch_lanes: int = 8,
         batch_max_length: Optional[int] = None,  # pool lane length (tokens)
         prefix_cache_bytes: int = 256 * 2**20,  # 0 disables prefix caching
+        prefix_share_scope: str = "swarm",  # "swarm" shares across clients; "peer" salts per client
     ):
         self.backend = backend
         self.dht_prefix = dht_prefix
@@ -115,6 +116,13 @@ class TransformerHandler:
         # sharing a prompt prefix skip its prefill compute. Under lockstep
         # the staging rides the v2 broadcast ops (import_kv / export_kv).
         self.prefix_cache = None
+        if prefix_share_scope not in ("swarm", "peer"):
+            raise ValueError(f"prefix_share_scope must be 'swarm' or 'peer', got {prefix_share_scope!r}")
+        # "peer" folds the requester's peer id into the hash salt: no
+        # cross-client sharing, which closes the cache-hit timing side
+        # channel an open swarm otherwise accepts (server/prefix_cache.py
+        # module docstring spells out the tradeoff)
+        self.prefix_share_scope = prefix_share_scope
         if prefix_cache_bytes > 0:
             from petals_tpu.server.prefix_cache import PrefixCache
 
@@ -809,6 +817,16 @@ class TransformerHandler:
                     and batch_size == 1
                     and prompts is None and hypo_ids is None
                     and active_adapter is None
+                    # "peer" scope isolates clients BY their authenticated
+                    # identity: an unauthenticated connection has none, and
+                    # salting with a shared 'None' would silently merge every
+                    # such client back into one timing-observable pool — the
+                    # exact channel the mode exists to close. No identity, no
+                    # caching.
+                    and (
+                        self.prefix_share_scope == "swarm"
+                        or getattr(ctx, "remote_peer_id", None) is not None
+                    )
                 ):
                     from petals_tpu.server.prefix_cache import SEGMENT_TOKENS, segment_keys
 
@@ -817,14 +835,25 @@ class TransformerHandler:
                             f"{self.dht_prefix}:{self.backend.first_block + start}:"
                             f"{self.backend.first_block + end}"
                         )
+                        if self.prefix_share_scope == "peer":
+                            # full id, not repr (repr truncates to 12 hex
+                            # chars — 48 bits an attacker could grind a
+                            # colliding keypair for); non-None: gated above
+                            salt += f":{ctx.remote_peer_id.to_string()}"
                         # hashing is multi-MB work: off the event loop, like
                         # every other bulk host op in this file
                         pc_keys = await asyncio.to_thread(segment_keys, hidden, salt)
+                        # probe + entry resolution stay synchronous on the
+                        # loop: no await separates them, so a concurrent
+                        # put()'s LRU eviction cannot invalidate a probed key
+                        # before its entry reference is held (the heavy
+                        # concatenation then runs off-loop on the references)
                         pc_hits = self.prefix_cache.probe(pc_keys)
                         if pc_hits:
                             hit_len = pc_hits * SEGMENT_TOKENS
+                            pc_entries = self.prefix_cache.get_entries(pc_keys, pc_hits)
                             k_pre, v_pre, prefix_out = await asyncio.to_thread(
-                                self.prefix_cache.get_range, pc_keys, pc_hits
+                                self.prefix_cache.concat_entries, pc_entries
                             )
                             kv = await self._seed_session_kv(
                                 lane, kv, handles, k_pre, v_pre, hit_len,
@@ -933,8 +962,13 @@ class TransformerHandler:
                         2 * (end - start) * SEGMENT_TOKENS
                         * backend0.num_kv_heads * backend0.head_dim
                         * jnp.dtype(backend0.cache_dtype).itemsize
+                        # the stored "out" segment is np.asarray(out) — its
+                        # ACTUAL host dtype, not compute_dtype: on bf16
+                        # servers the wire/concat path yields float32, and
+                        # estimating with bf16's itemsize undercounts 2x
+                        # (approving snapshots put() then has to discard)
                         + SEGMENT_TOKENS * backend0.hidden_size
-                        * jnp.dtype(backend0.compute_dtype).itemsize
+                        * np.asarray(out).dtype.itemsize
                     )
                     if self.prefix_cache.worth_storing(pc_keys, pc_hits, seg_bytes):
                         # store off the reply path; the loop awaits this

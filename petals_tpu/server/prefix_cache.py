@@ -15,6 +15,19 @@ keyed by session state.
 Storage is host-RAM numpy with an LRU byte budget — HBM stays dedicated to
 live sessions; re-staging a hit costs one host->device copy, which is far
 cheaper than recomputing the prefix through the span.
+
+Trust model (standard automatic-prefix-caching tradeoff): the cache is
+shared across ALL clients of this server by default, and a hit is faster
+than a miss in a way a client can time — so any client that can produce the
+same hidden states (i.e. knows the model and a candidate prompt) can probe
+whether that prompt prefix was recently served to someone else. In an open
+swarm this is consistent with the existing trust model: prompt hidden
+states already transit servers in the clear, so a server (or anyone who can
+hash candidate prompts) learns nothing new from the cache — only OTHER
+clients gain the timing probe. Deployments that care can set the handler's
+``prefix_share_scope="peer"``, which folds the requesting peer's id into
+the hash salt: each client then only ever hits its own entries, closing the
+cross-tenant channel at the cost of cross-client sharing.
 """
 
 from __future__ import annotations
@@ -76,14 +89,26 @@ class PrefixCache:
             self.stats["misses"] += 1
         return n
 
-    def get_range(self, keys: Sequence[str], n: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Concatenated (k, v, out) for segments [0, n) along the token axis:
+    def get_entries(self, keys: Sequence[str], n: int) -> List[dict]:
+        """Entry references for segments [0, n). Cheap dict lookups — callers
+        on the event loop resolve these BEFORE handing the multi-MB
+        concatenation to a worker thread: a concurrent put()'s LRU eviction
+        only pops dict slots, so already-held references stay valid, whereas
+        re-looking keys up from the thread can raise KeyError mid-read."""
+        return [self._store[k] for k in keys[:n]]
+
+    @staticmethod
+    def concat_entries(entries: Sequence[dict]) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Concatenate resolved entries along the token axis:
         k/v [n_blocks, 1, n*SEG, hkv, d], out [1, n*SEG, hidden]."""
-        entries = [self._store[k] for k in keys[:n]]
         k = np.concatenate([e["k"] for e in entries], axis=2)
         v = np.concatenate([e["v"] for e in entries], axis=2)
         out = np.concatenate([e["out"] for e in entries], axis=1)
         return k, v, out
+
+    def get_range(self, keys: Sequence[str], n: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """get_entries + concat_entries in one call (single-threaded users)."""
+        return self.concat_entries(self.get_entries(keys, n))
 
     def put(self, keys: Sequence[str], first: int, k: np.ndarray, v: np.ndarray, out: np.ndarray) -> None:
         """Store segments [first, len(keys)) from span-shaped arrays COVERING

@@ -86,6 +86,7 @@ class Server:
         batch_lanes: Optional[int] = None,  # None: auto-size to the cache budget (<=8)
         batch_max_length: Optional[int] = None,  # pool lane length; None: min(inference_max_length, 1024)
         prefix_cache_bytes: int = 256 * 2**20,  # host-RAM prompt-prefix cache; 0 disables
+        prefix_share_scope: str = "swarm",  # "peer" isolates the prefix cache per client identity
     ):
         self.num_hosts = num_hosts or 1
         self.coordinator_address = coordinator_address
@@ -180,6 +181,7 @@ class Server:
         self.batch_lanes = batch_lanes
         self.batch_max_length = batch_max_length
         self.prefix_cache_bytes = prefix_cache_bytes
+        self.prefix_share_scope = prefix_share_scope
         self.request_timeout = request_timeout
         self.session_timeout = session_timeout
         self.step_timeout = step_timeout
@@ -376,6 +378,7 @@ class Server:
             batch_lanes=batch_lanes,
             batch_max_length=batch_max_length,
             prefix_cache_bytes=self.prefix_cache_bytes,
+            prefix_share_scope=self.prefix_share_scope,
         )
         self.handler.register(self.rpc_server)
 

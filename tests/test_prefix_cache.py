@@ -207,3 +207,66 @@ def test_rollback_cannot_poison_cache(model_path):
             await server.shutdown()
 
     run(main())
+
+
+def test_peer_scope_isolates_clients(model_path):
+    """prefix_share_scope='peer': entries are salted by the AUTHENTICATED
+    client identity — another client's identical prompt misses (closing the
+    cross-tenant timing probe), the same client's repeat still hits, and an
+    unauthenticated connection gets no caching at all (a shared 'no identity'
+    pool would silently reopen the channel)."""
+
+    async def main():
+        from petals_tpu.dht.identity import Identity
+
+        server, client0 = await _start_server(model_path, prefix_share_scope="peer")
+        host, port = server.rpc_server.host, server.rpc_server.port
+        ident_a, ident_b = Identity.from_seed(b"pc-a"), Identity.from_seed(b"pc-b")
+        client_a = await RpcClient.connect(host, port, identity=ident_a)
+        client_b = await RpcClient.connect(host, port, identity=ident_b)
+        # the auth proof rides the handshake asynchronously: wait until it is
+        # on the wire (before any sopen) so the server sees an identity
+        await client_a.wait_authenticated()
+        await client_b.wait_authenticated()
+        try:
+            cfg = server.cfg
+            prefix = default_dht_prefix(model_path)
+            uids = CHAIN_DELIMITER.join(
+                make_uid(prefix, i) for i in range(cfg.num_hidden_layers)
+            )
+            rng = np.random.RandomState(7)
+            prompt = rng.randn(1, 2 * SEGMENT_TOKENS, cfg.hidden_size).astype(np.float32) * 0.1
+            step = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.1
+            pc = server.handler.prefix_cache
+
+            # every session runs one post-prefill step: the handler awaits the
+            # async prefix store before any LATER step, so the stats below are
+            # deterministic by the time the session replies
+            out_a = await _one_session(client_a, uids, prompt, [step])
+            assert pc.stats["stored_segments"] == 2, pc.summary()
+
+            # a DIFFERENT authenticated client: same bytes, zero hits
+            out_b = await _one_session(client_b, uids, prompt, [step])
+            assert pc.stats["hit_tokens"] == 0, pc.summary()
+            assert pc.stats["stored_segments"] == 4, pc.summary()  # stored under B's salt
+
+            # the SAME client again: hits its own entries
+            await _one_session(client_a, uids, prompt, [step])
+            assert pc.stats["hit_tokens"] == 2 * SEGMENT_TOKENS, pc.summary()
+
+            # unauthenticated connection: caching disabled entirely
+            before = dict(pc.stats)
+            out_anon = await _one_session(client0, uids, prompt, [step])
+            assert pc.stats["stored_segments"] == before["stored_segments"], pc.summary()
+            assert pc.stats["hits"] == before["hits"], pc.summary()
+
+            # isolation must not change results: all three are byte-comparable
+            np.testing.assert_allclose(out_b[0], out_a[0], atol=2e-5, rtol=0)
+            np.testing.assert_allclose(out_anon[0], out_a[0], atol=2e-5, rtol=0)
+        finally:
+            await client_a.close()
+            await client_b.close()
+            await client0.close()
+            await server.shutdown()
+
+    run(main())
