@@ -1327,8 +1327,13 @@ def main():
     # dequant); stack-time peak is ~2x quantized size + one dense block
     row("decode_70b_nf4", "70B-shape nf4",
         lambda: bench_device_decode(llama70b_cfg(10), quant="nf4", label="decode_70b_nf4"))
+    # NF4A (cubic-fitted levels, gather-free decode — ops/quant.py): the
+    # 4-bit SERVING DEFAULT; must land in int4's bandwidth class, not NF4's
+    # gather-bound ~110 GB/s (the round-5 default-gap gate)
+    row("decode_70b_nf4a", "70B-shape nf4a",
+        lambda: bench_device_decode(llama70b_cfg(10), quant="nf4a", label="decode_70b_nf4a"))
     # INT4 (affine decode - ops/quant.py): same 4.25 bits, 2-op dequant; the
-    # decode-bandwidth throughput option
+    # uniform-level option
     row("decode_70b_int4", "70B-shape int4",
         lambda: bench_device_decode(llama70b_cfg(10), quant="int4", label="decode_70b_int4"))
     # 8k-context prefill through the flash kernel on 70B-shaped blocks

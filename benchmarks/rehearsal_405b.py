@@ -24,7 +24,11 @@ from __future__ import annotations
 
 import json
 import math
+import os
+import sys
 from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 V5E_CHIP_HBM = 16 * 2**30
 CHIPS_PER_HOST = 4
@@ -196,12 +200,12 @@ def project_single_stream(
 def rehearsal_report(bench_details: Optional[dict] = None) -> Dict:
     """The driver-visible artifact: placement + projections, using measured
     bandwidths when a BENCH_DETAILS dict (or file) is available."""
-    report = {"placement": {q: placement_rehearsal(q) for q in ("int4", "nf4")}}
+    report = {"placement": {q: placement_rehearsal(q) for q in ("nf4a", "int4", "nf4")}}
 
     measured = {}
     overhead_frac = 0.0
     if bench_details:
-        for q in ("int4", "nf4", "bf16"):
+        for q in ("nf4a", "int4", "nf4", "bf16"):
             row = bench_details.get(f"decode_70b_{q}") or {}
             if row.get("weight_stream_gb_s"):
                 measured[q] = float(row["weight_stream_gb_s"])
@@ -215,7 +219,9 @@ def rehearsal_report(bench_details: Optional[dict] = None) -> Dict:
             overhead_frac = max(float(e2e["device_step_ms"]) / bound_ms - 1.0, 0.0)
 
     n_int4 = report["placement"]["int4"]["n_per_host"]
-    n_by_quant = {"int4": n_int4, "nf4": report["placement"]["nf4"]["n_per_host"]}
+    n_by_quant = {
+        q: report["placement"][q]["n_per_host"] for q in ("nf4a", "int4", "nf4")
+    }
 
     # measured per-hop software cost (bench chain_hop row: real RPC chain at
     # hidden=16384) + an assumed DCN wire RTT — replaces the 2.0 ms guess
@@ -230,7 +236,8 @@ def rehearsal_report(bench_details: Optional[dict] = None) -> Dict:
         )
 
     rows = []
-    for q in ("int4", "nf4"):
+    # nf4a first: it is the serving default the north-star claim rides on
+    for q in ("nf4a", "int4", "nf4"):
         if q in measured:
             row = project_single_stream(
                 measured[q], quant=q, n_per_span=n_by_quant[q],

@@ -35,7 +35,7 @@ def main() -> None:
     parser.add_argument("--num_tp_devices", type=int, default=None,
                         help="global tp width (default: every device in the group)")
     parser.add_argument("--quant_type", default="none",
-                        choices=["none", "int8", "nf4", "int4"])
+                        choices=["none", "int8", "nf4", "nf4a", "int4"])
     from petals_tpu.constants import DTYPE_MAP
 
     parser.add_argument("--torch_dtype", "--dtype", dest="dtype", default="bfloat16",

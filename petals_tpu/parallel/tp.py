@@ -109,7 +109,7 @@ def quant_leaf_pspecs(q, spec: P):
     expressed as shardings):
     - int8: data int8 [..., in, out] shards like the dense weight; scales f32
       [..., out] drop the input axis.
-    - nf4/int4: data uint8 [..., in/2, out] and scales bf16 [..., in/64, out]
+    - nf4/nf4a/int4: data uint8 [..., in/2, out] and scales bf16 [..., in/64, out]
       both follow the dense spec — packed rows and absmax blocks track the
       input axis, so an input-axis (row) split lands whole blocks per shard.
     """
@@ -144,7 +144,7 @@ def validate_tp_divisibility(params, mesh, specs, *, num_kv_heads: int = None) -
                     f"Parameter {name!r} dim {dim} (size {shape[dim]}) is not "
                     f"divisible by the tensor-parallel axis size {tp_size}"
                 )
-            if is_quant and leaf.kind in ("nf4", "int4") and dim == len(shape) - 2:
+            if is_quant and leaf.kind in ("nf4", "nf4a", "int4") and dim == len(shape) - 2:
                 # input-axis split: every shard must hold whole absmax blocks
                 blocks = leaf.data.shape[-2] * 2 // NF4_BLOCK
                 if blocks % tp_size != 0:

@@ -67,7 +67,7 @@ class Server:
         max_alloc_timeout: float = 600.0,
         num_tp_devices: Optional[int] = None,  # >1: shard the span over this host's chips
         num_sp_devices: Optional[int] = None,  # >1: ring-attention seq parallelism (fwd/bwd path)
-        quant_type: str = "none",  # "none" | "int8" | "nf4" | "int4" (ops/quant.py)
+        quant_type: str = "none",  # "none" | "int8" | "nf4" | "nf4a" | "int4" (ops/quant.py)
         adapters: Sequence[str] = (),  # PEFT checkpoint dirs to host (utils/peft.py)
         compression: str = "none",  # default reply codec (clients may override per request)
         relay_via: Optional[str] = None,  # "host:port" of a relay peer: serve from behind NAT

@@ -22,8 +22,9 @@ logger = get_logger(__name__)
 class QuantType(str, enum.Enum):
     NONE = "none"
     INT8 = "int8"  # LLM.int8-class weight-only quantization
-    NF4 = "nf4"  # QLoRA-style 4-bit normal float
-    INT4 = "int4"  # blockwise affine 4-bit: fastest TPU decode (ops/quant.py)
+    NF4 = "nf4"  # QLoRA-style 4-bit normal float (gather-bound decode on TPU)
+    NF4A = "nf4a"  # NF4-fitted cubic levels, gather-free decode: the 4-bit serving default
+    INT4 = "int4"  # blockwise affine 4-bit: uniform levels (ops/quant.py)
 
 
 # The big matmul weights of each family (norms/biases/router stay dense).

@@ -16,6 +16,7 @@ from petals_tpu.ops.quant import (
     quantize_int4,
     quantize_int8,
     quantize_nf4,
+    quantize_nf4a,
     quantized_bytes,
 )
 from petals_tpu.utils.convert_block import QuantType, convert_block_params
@@ -71,7 +72,7 @@ def test_int4_roundtrip_error():
     assert q.nbytes <= quantized_bytes(stored * 128, "int4") + 1024
 
 
-@pytest.mark.parametrize("quantizer", [quantize_nf4, quantize_int4])
+@pytest.mark.parametrize("quantizer", [quantize_nf4, quantize_nf4a, quantize_int4])
 def test_packed4_pallas_matches_xla(quantizer):
     rng = np.random.RandomState(2)
     w = (rng.randn(512, 256) * 0.05).astype(np.float32)
@@ -82,7 +83,7 @@ def test_packed4_pallas_matches_xla(quantizer):
     np.testing.assert_allclose(got, expected, atol=2e-2, rtol=1e-2)
 
 
-@pytest.mark.parametrize("quantizer", [quantize_nf4, quantize_int4])
+@pytest.mark.parametrize("quantizer", [quantize_nf4, quantize_nf4a, quantize_int4])
 @pytest.mark.parametrize("m", [1, 40])  # decode (M<=32) and prefill kernels
 def test_packed4_pallas_stacked_matches_xla(quantizer, m):
     from petals_tpu.ops.quant import StackedQuantLinear, packed4_matmul_pallas_stacked
@@ -155,7 +156,7 @@ def test_quant_matmul_grad_flows_to_x():
     )
 
 
-@pytest.mark.parametrize("quant", [QuantType.INT8, QuantType.NF4, QuantType.INT4])
+@pytest.mark.parametrize("quant", [QuantType.INT8, QuantType.NF4, QuantType.NF4A, QuantType.INT4])
 def test_quantized_block_close_to_dense(quant, tmp_path):
     from petals_tpu.server.from_pretrained import get_block_config, load_block_params
     from tests.utils import make_tiny_llama
@@ -170,11 +171,11 @@ def test_quantized_block_close_to_dense(quant, tmp_path):
     dense_out, _ = family.block_apply(params, hidden, None, 0, cfg)
     quant_out, _ = family.block_apply(qparams, hidden, None, 0, cfg)
     err = np.abs(np.asarray(quant_out) - np.asarray(dense_out)).max()
-    bound = {QuantType.NF4: 0.2, QuantType.INT4: 0.3, QuantType.INT8: 0.05}[quant]
+    bound = {QuantType.NF4: 0.2, QuantType.NF4A: 0.2, QuantType.INT4: 0.3, QuantType.INT8: 0.05}[quant]
     assert err < bound, f"{quant}: err {err}"
 
 
-@pytest.mark.parametrize("quant", ["nf4", "int4"])
+@pytest.mark.parametrize("quant", ["nf4", "nf4a", "int4"])
 def test_quantized_server_generates(quant, tmp_path):
     """4-bit servers serve a session end-to-end (reference CI quantized-server
     coverage); greedy tokens may differ from f32 HF — assert mechanics."""
@@ -243,7 +244,7 @@ def test_nf4_autotune_noop_off_tpu():
     assert quant.maybe_autotune_nf4_decode(128) == quant._NF4_DECODE_USE_PALLAS
 
 
-@pytest.mark.parametrize("quant", ["nf4", "int4", "int8"])
+@pytest.mark.parametrize("quant", ["nf4", "nf4a", "int4", "int8"])
 def test_fused_block_matches_unfused(quant):
     """convert_block_params(fuse=True) merges qkv / gate+up into single leaves;
     scales are per-output-column, so the fused block must match the unfused one
@@ -272,3 +273,45 @@ def test_fused_block_matches_unfused(quant):
     out_plain, _ = family.block_apply(plain, hidden, None, 0, cfg)
     out_fused, _ = family.block_apply(fused, hidden, None, 0, cfg)
     np.testing.assert_array_equal(np.asarray(out_plain), np.asarray(out_fused))
+
+
+def test_nf4a_roundtrip_error_and_levels():
+    """NF4A: cubic-fitted levels track NF4's codebook to ~0.05 absolute, so
+    the same blockwise-absmax error bound applies — while decode is pure
+    arithmetic (no codebook gather in the kernels)."""
+    from petals_tpu.ops.quant import NF4A_A, NF4A_B, NF4A_CODE, NF4_CODE
+
+    # the levels ARE the cubic map (what the kernels compute arithmetically)
+    d = np.arange(16) - 7.5
+    np.testing.assert_allclose(NF4A_CODE, NF4A_A * d + NF4A_B * d**3, rtol=1e-6)
+    assert np.abs(NF4A_CODE - NF4_CODE).max() < 0.06
+    rng = np.random.RandomState(11)
+    w = (rng.randn(256, 128) * 0.05).astype(np.float32)
+    q = quantize_nf4a(w)
+    assert q.kind == "nf4a" and q.data.dtype == jnp.uint8
+    deq = np.asarray(dequantize(q, jnp.float32))
+    blocks = w.reshape(-1, NF4_BLOCK, 128)
+    absmax = np.abs(blocks).max(axis=1)
+    max_gap = 0.23  # largest NF4A inter-level distance (at the tails)
+    bound = np.repeat(absmax, NF4_BLOCK, axis=0) * max_gap
+    assert (np.abs(deq - w) <= bound + 1e-6).all()
+    stored = q.data.shape[0] * 2
+    assert q.nbytes <= quantized_bytes(stored * 128, "nf4a") + 1024
+
+
+def test_nf4a_matches_nf4_quality():
+    """The serving-default claim: NF4A's weight-space SNR is at least NF4's
+    (within measurement slack) on gaussian AND heavy-tailed weights — the
+    regimes where uniform int4 loses 1-3 dB (benchmarks/quant_quality.py)."""
+    rng = np.random.RandomState(5)
+    shape = (1024, 512)
+    for w in (
+        (rng.randn(*shape) * 0.02).astype(np.float32),
+        (rng.standard_t(df=4, size=shape) * 0.02).astype(np.float32),
+    ):
+        def snr(q):
+            dq = np.asarray(dequantize(q, jnp.float32))
+            rel = np.square(dq - w).mean() / np.square(w).mean()
+            return 10 * np.log10(1.0 / rel)
+
+        assert snr(quantize_nf4a(w)) >= snr(quantize_nf4(w)) - 0.1
