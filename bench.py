@@ -1366,6 +1366,17 @@ def main():
     # sparse vs dense MoE dispatch at prefill (mixtral-8x7B shapes, 1 layer)
     row("moe_prefill_2048", "moe dispatch", bench_moe_dispatch)
 
+    # continuous batching UNDER MULTI-HOST LOCKSTEP (round-5 composition):
+    # a real 2-process tp span on CPU subprocesses (axon stripped from their
+    # PYTHONPATH) — measures the composition, not the chip; placed after the
+    # on-chip rows so a tight budget can never cost them
+    def multihost_batching_row():
+        from benchmarks.multihost_batching import run_bench
+
+        return run_bench()
+
+    row("multihost_batched_e2e", "multihost batching", multihost_batching_row)
+
     # 405B rehearsal: placement math + single-stream projection from THIS
     # run's measured bandwidths (benchmarks/rehearsal_405b.py; the north-star
     # arithmetic the driver records every round)

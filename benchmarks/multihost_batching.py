@@ -1,0 +1,115 @@
+"""Continuous batching under multi-host lockstep: the round-5 composition
+bench (VERDICT r4 next-round #3).
+
+Spawns a REAL 2-process tp span (run_server leader + run_worker, CPU devices,
+loopback) and drives N concurrent decode sessions through the RPC stack from
+one event loop, the sends of each round issued before any reply is awaited so
+the leader's lane pool actually coalesces. Reports aggregate decode
+throughput, the coalescing evidence (max_batch / mean batch), and the serial
+baseline (same sessions, one at a time) for the speedup ratio.
+
+Runs entirely on CPU subprocesses (the axon site dir is stripped from the
+children's PYTHONPATH), so the row is available even when the chip is not —
+it measures COMPOSITION overhead (broadcast + collectives + batching),
+not chip throughput.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_SESSIONS = 4
+N_STEPS = 24
+PREFILL = 8
+
+
+async def _drive(addr: str, model: str, *, concurrent: bool) -> dict:
+    from transformers import AutoConfig
+
+    from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
+    from petals_tpu.rpc import RpcClient
+    from petals_tpu.rpc.serialization import deserialize_array, serialize_array
+    from petals_tpu.server.server import default_dht_prefix
+
+    hsz = AutoConfig.from_pretrained(model).hidden_size
+    host, port = addr.rsplit("/", 1)[0].rsplit(":", 1)
+    c = await RpcClient.connect(host, int(port))
+    rng = np.random.RandomState(0)
+    uids = CHAIN_DELIMITER.join(make_uid(default_dht_prefix(model), i) for i in range(4))
+    try:
+        streams = []
+        for _ in range(N_SESSIONS):
+            s = await c.open_stream("ptu.inference")
+            await s.send({"uids": uids, "max_length": PREFILL + N_STEPS + 8, "batch_size": 1})
+            await s.recv(timeout=60)
+            await s.send({"tensors": {"hidden": serialize_array(
+                rng.randn(1, PREFILL, hsz).astype(np.float32) * 0.1)}})
+            await s.recv(timeout=300)
+            streams.append(s)
+        t0 = time.perf_counter()
+        if concurrent:
+            for _ in range(N_STEPS):
+                step = rng.randn(1, 1, hsz).astype(np.float32) * 0.1
+                for s in streams:  # all sends before any recv -> coalescing
+                    await s.send({"tensors": {"hidden": serialize_array(step)}})
+                for s in streams:
+                    deserialize_array((await s.recv(timeout=300))["tensors"]["hidden"])
+        else:
+            for s in streams:
+                for _ in range(N_STEPS):
+                    step = rng.randn(1, 1, hsz).astype(np.float32) * 0.1
+                    await s.send({"tensors": {"hidden": serialize_array(step)}})
+                    deserialize_array((await s.recv(timeout=300))["tensors"]["hidden"])
+        elapsed = time.perf_counter() - t0
+        for s in streams:
+            await s.end()
+        info = await c.call("ptu.info", {}, timeout=30)
+        return {
+            "tok_s": N_SESSIONS * N_STEPS / elapsed,
+            "stats": info.get("continuous_batching") or {},
+        }
+    finally:
+        await c.close()
+
+
+def run_bench(model: str | None = None) -> dict:
+    from tests.utils import make_tiny_llama, spawn_multihost_pair, stop_multihost_pair
+
+    if model is None:
+        model = make_tiny_llama(tempfile.mkdtemp())
+    # shared spawn helper (tests/utils.py): one definition of the leader
+    # announce protocol + CPU child env for tests AND benchmarks
+    leader, worker, addr = spawn_multihost_pair(
+        model, leader_args=("--throughput", "7.0")
+    )
+    try:
+        conc = asyncio.run(_drive(addr, model, concurrent=True))
+        serial = asyncio.run(_drive(addr, model, concurrent=False))
+        stats = conc["stats"]
+        return {
+            "sessions": N_SESSIONS,
+            "steps_per_session": N_STEPS,
+            "aggregate_tok_s_batched": round(conc["tok_s"], 2),
+            "aggregate_tok_s_serial": round(serial["tok_s"], 2),
+            "batched_vs_serial": round(conc["tok_s"] / max(serial["tok_s"], 1e-9), 2),
+            "max_batch": stats.get("max_batch"),
+            "batched_steps": stats.get("batched_steps"),
+            "batched_tokens": stats.get("batched_tokens"),
+        }
+    finally:
+        stop_multihost_pair(leader, worker, timeout=20)
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run_bench(), indent=2))

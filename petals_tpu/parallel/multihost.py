@@ -46,9 +46,17 @@ of failure is the span server — same as the reference's whole-server process).
 The prefix cache (server/prefix_cache.py) rides the same import/export ops,
 so shared-prompt prefills skip compute on multi-host spans too.
 
-Remaining v1 limit: live rebalancing (a span move would strand the workers'
-shards), sp meshes, and continuous batching (lockstep spans serve sessions
-individually; the lane pool's device ops are not broadcast ops yet).
+v3 (round 5): continuous batching composes with lockstep. The lane pool is
+one more mirrored allocation (OP_ALLOC's 5-slot shape covers it — the batch
+slot carries n_lanes); the batched decode step broadcasts hidden + the
+per-lane position vector (OP_BATCHED_DECODE); non-batchable work checks a
+lane out into a synthetic negative-handle mirror (OP_LANE_EXTRACT), runs the
+ordinary lockstep session ops against it, and checks it back in
+(OP_LANE_INSERT) — so chunked prefill, prefix-cache seeding/storing, and KV
+import/export all work on pooled multi-host sessions.
+
+Remaining v1 limits: live rebalancing (a span move would strand the workers'
+shards) and sp meshes (the serving mesh is tp-only across hosts).
 """
 
 from __future__ import annotations
@@ -71,6 +79,14 @@ OP_FORWARD = 4
 OP_BACKWARD = 5
 OP_EXPORT_KV = 6  # v2: per-shard all_gather of a session's KV (migration/drain)
 OP_IMPORT_KV = 7  # v2: seed a KV mirror from an exported prefix
+# v3: continuous batching composes with lockstep — the lane pool is one more
+# mirrored allocation, and its three device ops broadcast like any other.
+# Extracted lanes live on the workers as SYNTHETIC mirrors (negative handles
+# minted by the leader's DecodeBatcher), so exclusive ops (chunked prefill,
+# kv import/seed) target them with the ordinary OP_INFERENCE_STEP/IMPORT_KV.
+OP_BATCHED_DECODE = 8
+OP_LANE_EXTRACT = 9
+OP_LANE_INSERT = 10
 
 _HEADER_LEN = 14
 _FLAG_PROMPTS = 1
@@ -353,6 +369,50 @@ class LockstepBackend(_LockstepMixin):
                 grad_prompts = self._replicate(grad_prompts)
             return grad_in, grad_prompts
 
+    # ------------------------------------------------- continuous batching (v3)
+
+    def batched_decode_step(self, hidden, pool_kv, positions, handles=None):
+        """One coalesced decode step over the whole mirrored lane pool
+        (server/batching.py flush loop). ``handles`` carries the pool's
+        mirror handle; hidden/positions broadcast, every process steps its
+        shards of the pool."""
+        n_lanes = int(hidden.shape[0])
+        with _BCAST_LOCK, _degrade_on_failure():
+            _bcast_header([OP_BATCHED_DECODE, int(handles[0]), n_lanes])
+            hidden = _bcast_array(
+                hidden, (n_lanes, 1, self._backend.hidden_size), np.float32
+            )
+            positions = _bcast_array(
+                np.asarray(positions, np.int64), (n_lanes,), np.int64
+            )
+            out, new_kv = self._backend.batched_decode_step(hidden, pool_kv, positions)
+            return self._replicate(out), new_kv
+
+    def lane_extract(self, k_pool, v_pool, lane: int, *, pool_handle: int, temp_handle: int):
+        """Check a lane OUT of the pool on every process; workers register the
+        session-shaped copy under the synthetic ``temp_handle`` mirror so
+        subsequent exclusive ops (inference steps, imports, exports) can
+        address it like any session KV."""
+        with _BCAST_LOCK, _degrade_on_failure():
+            _bcast_header([OP_LANE_EXTRACT, int(pool_handle), int(lane), int(temp_handle)])
+            return self._backend._lane_extract_fn(k_pool, v_pool, np.int32(lane))
+
+    def lane_insert(self, k_pool, v_pool, kv_lane, lane: int, *, pool_handle: int, temp_handle: int):
+        """Check a lane back IN on every process; workers consume (pop) their
+        ``temp_handle`` mirror. Returns the leader's new pool buffers."""
+        k2, v2 = kv_lane
+        with _BCAST_LOCK, _degrade_on_failure():
+            _bcast_header([OP_LANE_INSERT, int(pool_handle), int(lane), int(temp_handle)])
+            return self._backend._lane_insert_fn(k_pool, v_pool, k2, v2, np.int32(lane))
+
+    def release_temp(self, temp_handle: int) -> None:
+        """Drop a synthetic mirror that will not be inserted back (read-only
+        extracts, e.g. lane snapshots). Rides OP_FREE — workers pop the id."""
+        if _GROUP_STATE["degraded"] is not None:
+            return
+        with _BCAST_LOCK, _degrade_on_failure():
+            _bcast_header([OP_FREE, int(temp_handle), 1])
+
     # ------------------------------------------------------- KV export/import (v2)
 
     def export_kv(self, handles, get_buffers, b0: int, b1: int, position: int):
@@ -557,6 +617,36 @@ class LockstepWorker:
                 v_prefix = _bcast_array(None, shape, np.float32)
                 self._kv[mirror] = _stage_kv_mirror(
                     self.backend, k_prefix, v_prefix, position, batch, max_len, n
+                )
+                continue
+            if op == OP_BATCHED_DECODE:
+                # [op, pool_h, n_lanes]: step every lane of the pool mirror
+                _, pool_h, n_lanes = header[:3]
+                hidden = _bcast_array(
+                    None, (n_lanes, 1, self.backend.hidden_size), np.float32
+                )
+                positions = _bcast_array(None, (n_lanes,), np.int64)
+                out, new_kv = self.backend.batched_decode_step(
+                    hidden, self._kv[pool_h], positions
+                )
+                self._kv[pool_h] = new_kv
+                self._replicate(out)
+                continue
+            if op == OP_LANE_EXTRACT:
+                # [op, pool_h, lane, temp]: session-shaped copy under ``temp``
+                _, pool_h, lane, temp = header[:4]
+                k_pool, v_pool = self._kv[pool_h]
+                self._kv[temp] = self.backend._lane_extract_fn(
+                    k_pool, v_pool, np.int32(lane)
+                )
+                continue
+            if op == OP_LANE_INSERT:
+                # [op, pool_h, lane, temp]: consume the temp mirror back in
+                _, pool_h, lane, temp = header[:4]
+                k_pool, v_pool = self._kv[pool_h]
+                k2, v2 = self._kv.pop(temp)
+                self._kv[pool_h] = self.backend._lane_insert_fn(
+                    k_pool, v_pool, k2, v2, np.int32(lane)
                 )
                 continue
 

@@ -300,8 +300,14 @@ class TransformerBackend:
         (pool length), so their KV writes drop (scatter mode="drop") and
         their outputs are ignored. One shape -> ONE compiled program, no
         recompilation as sessions join and leave mid-flight; decode is
-        weight-bandwidth-bound, so the extra lanes are nearly free."""
+        weight-bandwidth-bound, so the extra lanes are nearly free.
+
+        Under a TP mesh (incl. multi-host lockstep) the batched step shards
+        like the single-session step: params carry their PartitionSpecs, the
+        pool's kv-head axis is sharded, and block_apply inserts the psum —
+        decode steps are seq==1, so no sp handling is needed here."""
         family, cfg = self.family, self.cfg
+        tp_mesh = self.mesh
         from petals_tpu.ops.quant import StackedQuantLinear
 
         split_quant = self._split_quant
@@ -330,7 +336,7 @@ class TransformerBackend:
                         )
                 out, (k_new, v_new) = family.block_apply(
                     p_block, h, (k_block, v_block), positions, cfg,
-                    use_flash=False, tp_mesh=None,
+                    use_flash=False, tp_mesh=tp_mesh,
                 )
                 return out, (k_new, v_new)
 
@@ -341,20 +347,23 @@ class TransformerBackend:
 
         return step
 
-    def batched_decode_step(self, hidden, pool_kv, positions):
+    def batched_decode_step(self, hidden, pool_kv, positions, handles=None):
         """One coalesced decode step over the whole lane pool.
 
         Args:
           hidden: [n_lanes, 1, hidden] (idle lanes: any finite filler).
           pool_kv: (k, v) pool buffers [n_blocks, n_lanes, max_len, hkv, d].
           positions: int32 [n_lanes]; idle lanes hold max_len (the sentinel).
+          handles: ignored here; the lockstep wrapper uses the pool's mirror
+            handle to address the workers' copy (parallel/multihost.py).
         """
         k_pool, v_pool = pool_kv
         if not isinstance(hidden, jax.Array):
             hidden = np.ascontiguousarray(hidden)
-        out, k_pool, v_pool = self._batched_decode_fn(
-            self.params, k_pool, v_pool, hidden, np.asarray(positions, np.int32)
-        )
+        with self._quant_ctx():  # mesh: XLA dequant path (Mosaic can't GSPMD)
+            out, k_pool, v_pool = self._batched_decode_fn(
+                self.params, k_pool, v_pool, hidden, np.asarray(positions, np.int32)
+            )
         return out, (k_pool, v_pool)
 
     @functools.cached_property

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import itertools
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -85,6 +86,12 @@ class DecodeBatcher:
         self._flush_task: Optional[asyncio.Task] = None
         self._open_lock = asyncio.Lock()
         self._closed = False
+        # multi-host lockstep (parallel/multihost.py): lane ops broadcast so
+        # every process mirrors the pool; extracted lanes live on workers as
+        # synthetic NEGATIVE-handle mirrors minted here (never colliding with
+        # MemoryCache's non-negative handles)
+        self._lockstep = bool(getattr(backend, "is_lockstep", False))
+        self._temp_ids = itertools.count(-2, -1)
         # observability + tests: how many device steps served how many tokens
         self.stats = {"batched_steps": 0, "batched_tokens": 0, "max_batch": 0}
 
@@ -102,21 +109,20 @@ class DecodeBatcher:
         async with self._open_lock:
             if self._handles is not None or self._closed:
                 return
-            from petals_tpu.server.memory_cache import TensorDescriptor
-
-            shape = (
-                self.backend.n_blocks,
-                self.n_lanes,
-                self.max_length,
-                self.backend.num_kv_heads,
-                self.backend.head_dim,
+            # descriptors come from the backend so the pool carries the same
+            # sharding as session caches (kv-head axis over the tp mesh) —
+            # under lockstep the workers mirror the alloc with the identical
+            # sharded descriptors, and materialization is a collective every
+            # process must enter with the SAME specs (an unsharded leader
+            # pool would deadlock the group at open)
+            kd, vd = self.backend.cache_descriptors(
+                self.n_lanes, self.max_length, 0, self.backend.n_blocks
             )
-            descr = TensorDescriptor(shape, self.backend.cache_dtype)
             stack = contextlib.AsyncExitStack()
             try:
                 handles = await stack.enter_async_context(
                     self.memory_cache.allocate_cache(
-                        descr, descr,
+                        kd, vd,
                         timeout=self.alloc_timeout if timeout is None else timeout,
                     )
                 )
@@ -273,6 +279,20 @@ class DecodeBatcher:
         except Exception:
             broken = True
         if not broken:
+            return  # routine failures (cancellation, rejects) leave the pool intact
+        if self._lockstep:
+            # a consumed pool under lockstep means a device op died mid-
+            # collective: the GROUP is degraded (multihost._degrade_on_failure)
+            # and every subsequent op fails loudly through _check_group. A
+            # leader-local reset would both desync the workers' mirrors and
+            # hang (rematerializing a cross-process-sharded buffer is itself
+            # a collective the workers aren't entering).
+            with self._reset_lock:
+                self._generation += 1
+            logger.warning(
+                "Pool-consuming lockstep op failed: invalidating outstanding "
+                "pooled sessions (group degradation handles the rest)"
+            )
             return
         logger.warning(
             "Pool-touching step failed with the donated buffers consumed: "
@@ -302,7 +322,7 @@ class DecodeBatcher:
             positions[lane] = pos
         k_pool, v_pool = self._buffers()
         out, (k_pool, v_pool) = self.backend.batched_decode_step(
-            hidden, (k_pool, v_pool), positions
+            hidden, (k_pool, v_pool), positions, handles=self._handles
         )
         host_out = np.asarray(out)  # device sync: the step has fully executed
         with self._reset_lock:
@@ -322,13 +342,28 @@ class DecodeBatcher:
 
     # ------------------------------------------------------- non-batchable ops
 
-    def _extract_lane(self, lane: int):
+    def _new_temp(self) -> Optional[tuple]:
+        """Synthetic mirror handles for an extracted lane under lockstep
+        (None otherwise): exclusive-op fns pass these to the backend so
+        workers address their copy of the checked-out lane."""
+        if not self._lockstep:
+            return None
+        t = next(self._temp_ids)
+        return (t, t)
+
+    def _extract_lane(self, lane: int, temp: Optional[tuple] = None):
         """Compute-thread body: lane checked OUT of the pool as session-shaped
-        [n_blocks, 1, max_len, hkv, d] buffers."""
+        [n_blocks, 1, max_len, hkv, d] buffers (broadcast under lockstep so
+        workers mirror the copy under ``temp``)."""
         k_pool, v_pool = self._buffers()
+        if temp is not None:
+            return self.backend.lane_extract(
+                k_pool, v_pool, lane,
+                pool_handle=self._handles[0], temp_handle=temp[0],
+            )
         return self.backend._lane_extract_fn(k_pool, v_pool, np.int32(lane))
 
-    def _insert_lane(self, lane: int, kv_lane) -> None:
+    def _insert_lane(self, lane: int, kv_lane, temp: Optional[tuple] = None) -> None:
         """Compute-thread body: lane checked back IN. The whole read-insert-
         swap runs under the reset lock: a reset landing mid-way would
         otherwise let the insert donate the freshly zeroed pool's buffers (or
@@ -340,23 +375,53 @@ class DecodeBatcher:
         with self._reset_lock:
             self._check_lane(lane)
             k_pool, v_pool = self._buffers()
-            k_pool, v_pool = self.backend._lane_insert_fn(
-                k_pool, v_pool, k2, v2, np.int32(lane)
-            )
+            if temp is not None:
+                k_pool, v_pool = self.backend.lane_insert(
+                    k_pool, v_pool, (k2, v2), lane,
+                    pool_handle=self._handles[0], temp_handle=temp[0],
+                )
+            else:
+                k_pool, v_pool = self.backend._lane_insert_fn(
+                    k_pool, v_pool, k2, v2, np.int32(lane)
+                )
             self._update(k_pool, v_pool)
 
-    async def run_exclusive(self, lane: int, fn, *, size: int = 0):
-        """Run ``fn(kv_lane) -> (result, kv_lane')`` with the lane extracted
-        into session-shaped buffers, then insert the updated lane back — all
-        in ONE atomic queue task. Used for KV import and any step the batched
-        program doesn't cover. Serialized with batched steps by the queue."""
+    def _release_temp(self, temp: Optional[tuple]) -> None:
+        """Best-effort drop of a synthetic lockstep mirror that will NOT be
+        inserted back (a failed/cancelled exclusive op): without the OP_FREE
+        broadcast every worker would retain a full lane-sized KV copy per
+        failure — an unbounded leak under repeated client disconnects."""
+        if temp is None:
+            return
+        try:
+            self.backend.release_temp(temp[0])
+        except Exception:
+            pass  # degraded group: the mirrors died with the workers
+
+    async def run_exclusive(self, lane: int, fn, *, size: int = 0, extract: bool = True):
+        """Run ``fn(kv_lane, lane_handles) -> (result, kv_lane')`` with the
+        lane extracted into session-shaped buffers, then insert the updated
+        lane back — all in ONE atomic queue task. Used for KV import and any
+        step the batched program doesn't cover. Serialized with batched steps
+        by the queue. ``lane_handles`` is None single-host; under lockstep it
+        is the synthetic mirror handle pair the fn must pass to the backend
+        (e.g. ``backend.inference_step(..., handles=lane_handles)``).
+        ``extract=False`` skips the checkout (fn receives kv_lane=None) for
+        ops that wholesale REPLACE the lane (prefix seed, kv import) — under
+        lockstep that saves every process a full-lane device copy."""
 
         self._check_lane(lane)
 
         def run():
             self._check_lane(lane)  # re-check: a reset may have raced the queue
-            result, kv_lane = fn(self._extract_lane(lane))
-            self._insert_lane(lane, kv_lane)
+            temp = self._new_temp()
+            try:
+                kv_lane = self._extract_lane(lane, temp) if extract else None
+                result, kv_lane = fn(kv_lane, temp)
+                self._insert_lane(lane, kv_lane, temp)
+            except BaseException:
+                self._release_temp(temp)
+                raise
             return result
 
         try:
@@ -371,14 +436,14 @@ class DecodeBatcher:
 
     async def run_exclusive_chunks(self, lane: int, chunk_fns, *, size: int = 0):
         """Chunked-prefill interleaving (Sarathi-style): extract the lane
-        once, run each ``fn(kv_lane) -> (result, kv_lane')`` as its OWN
-        priority-queue task, insert once. Between chunks the flush loop's
-        batched decode steps run freely — a long prefill no longer stalls
-        every decoding session for its full length. Safe while checked out:
-        batched steps never write an idle-sentinel lane, and the FIFO queue
-        guarantees the final insert lands before any new tenant's first task
-        even if this session is cancelled mid-chunks (stale content beyond a
-        tenant's position is masked by attention anyway)."""
+        once, run each ``fn(kv_lane, lane_handles) -> (result, kv_lane')`` as
+        its OWN priority-queue task, insert once. Between chunks the flush
+        loop's batched decode steps run freely — a long prefill no longer
+        stalls every decoding session for its full length. Safe while checked
+        out: batched steps never write an idle-sentinel lane, and the FIFO
+        queue guarantees the final insert lands before any new tenant's first
+        task even if this session is cancelled mid-chunks (stale content
+        beyond a tenant's position is masked by attention anyway)."""
         self._check_lane(lane)
         if len(chunk_fns) == 1:
             # short prefills skip the extract/insert round-trips
@@ -387,19 +452,26 @@ class DecodeBatcher:
 
         def extract():
             self._check_lane(lane)  # re-check: a reset may have raced the queue
-            state["kv"] = self._extract_lane(lane)
+            state["temp"] = self._new_temp()
+            state["kv"] = self._extract_lane(lane, state["temp"])
 
         def insert():
             self._check_lane(lane)  # a stale lane's data must not be re-inserted
-            self._insert_lane(lane, state["kv"])
+            self._insert_lane(lane, state["kv"], state["temp"])
 
-        await self.queue.submit(extract, priority=PRIORITY_INFERENCE, size=0)
+        try:
+            await self.queue.submit(extract, priority=PRIORITY_INFERENCE, size=0)
+        except BaseException:
+            # a leader-side failure AFTER the extract broadcast leaves the
+            # workers holding the temp mirror: free it before propagating
+            self._release_temp(state.get("temp"))
+            raise
         results = []
         try:
             for fn in chunk_fns:
                 def run_chunk(fn=fn):
                     self._check_lane(lane)
-                    res, state["kv"] = fn(state["kv"])
+                    res, state["kv"] = fn(state["kv"], state["temp"])
                     self.stats["exclusive_chunks"] = self.stats.get("exclusive_chunks", 0) + 1
                     return res
 
@@ -415,24 +487,44 @@ class DecodeBatcher:
         finally:
             # always check the lane back in (a failed chunk leaves the last
             # consistent kv; the session's host-side position was not advanced)
+            inserted = False
             if "kv" in state:
                 try:
                     await self.queue.submit(insert, priority=PRIORITY_INFERENCE, size=0)
+                    inserted = True
                 except AllocationFailed:
                     pass  # lane invalidated mid-prefill: nothing to check in
                 except BaseException:
                     self._maybe_reset_pool()
                     raise
+                finally:
+                    if not inserted:
+                        # the workers' temp mirror will never be consumed by
+                        # an insert: free it or it leaks a lane-sized buffer
+                        self._release_temp(state.get("temp"))
         return results
 
     async def snapshot_lane(self, lane: int, position: int, b0: int, b1: int):
         """Host copy of blocks [b0, b1) of a lane, sliced to ``position``
-        (KV export/migration for pooled sessions)."""
+        (KV export/migration for pooled sessions). Under lockstep the lane's
+        shards live on every process: a read-only extract registers a temp
+        mirror, the export all_gather runs through it, and the temp is
+        released (never inserted back — nothing was modified)."""
 
         self._check_lane(lane)
 
         def run():
             self._check_lane(lane)  # re-check: a reset may have raced the queue
+            temp = self._new_temp()
+            if temp is not None:
+                kv_lane = self._extract_lane(lane, temp)
+                try:
+                    k, v = self.backend.export_kv(
+                        temp, lambda: kv_lane, b0, b1, position
+                    )
+                    return k, v
+                finally:
+                    self.backend.release_temp(temp[0])
             k_pool, v_pool = self._buffers()
             k, v = self.backend._lane_extract_fn(k_pool, v_pool, np.int32(lane))
             return (

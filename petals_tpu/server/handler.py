@@ -94,14 +94,11 @@ class TransformerHandler:
 
         # Continuous batching (server/batching.py): concurrent single-stream
         # decode sessions on the full span coalesce into one device step.
-        # Off under multi-host lockstep and TP meshes (v1) — those paths pin
-        # their own step shapes.
+        # Composes with TP meshes (the batched program shards like the
+        # single-session one) and with multi-host lockstep (pool + lane ops
+        # broadcast — parallel/multihost.py v3).
         self.batcher = None
-        if (
-            batching
-            and backend.mesh is None
-            and not getattr(backend, "is_lockstep", False)
-        ):
+        if batching:
             from petals_tpu.server.batching import DecodeBatcher
 
             self.batcher = DecodeBatcher(
@@ -296,6 +293,21 @@ class TransformerHandler:
 
         if lane is not None:
             backend0 = self.batcher.backend
+            if getattr(backend0, "is_lockstep", False):
+                # multihost pooled session: broadcast the prefix and let every
+                # process shard its own lane-shaped mirror (v2 import op on
+                # the synthetic lane handle), then check it into the pool
+                def replace_lockstep(kv_lane, lane_handles):
+                    return None, backend0.import_kv(
+                        lane_handles, k_arr, v_arr, new_position,
+                        batch_size, self.batcher.max_length, n_blocks,
+                    )
+
+                # extract=False: the import REPLACES the lane wholesale, so
+                # checking the old content out first would waste a full-lane
+                # device copy on every process
+                await self.batcher.run_exclusive(lane, replace_lockstep, extract=False)
+                return kv
             lane_shape = (
                 n_blocks, batch_size, self.batcher.max_length,
                 backend0.num_kv_heads, backend0.head_dim,
@@ -310,10 +322,10 @@ class TransformerHandler:
             new_k = await asyncio.to_thread(build, k_arr)
             new_v = await asyncio.to_thread(build, v_arr)
 
-            def replace(kv_lane):
+            def replace(kv_lane, lane_handles):
                 return None, (jnp.asarray(new_k), jnp.asarray(new_v))
 
-            await self.batcher.run_exclusive(lane, replace)
+            await self.batcher.run_exclusive(lane, replace, extract=False)
             return kv
 
         k_buf, v_buf = kv
@@ -889,11 +901,12 @@ class TransformerHandler:
                             chunk = exec_hidden[:, off : off + clen]
                             chunk_pos = pos + off
 
-                            def run_chunk(kv_lane, chunk=chunk, chunk_pos=chunk_pos):
+                            def run_chunk(kv_lane, lane_handles, chunk=chunk, chunk_pos=chunk_pos):
                                 with device_annotation("inference_step"):
                                     out, new_kv = backend.inference_step(
                                         chunk, kv_lane, chunk_pos,
                                         active_adapter=active_adapter,
+                                        handles=lane_handles,
                                     )
                                 return np.asarray(out), new_kv
 
@@ -909,11 +922,12 @@ class TransformerHandler:
                     elif lane is not None:
                         # pooled session with deep prompts or explicit
                         # hypo_ids: one atomic exclusive pass on the lane
-                        def run_lane(kv_lane, hidden=hidden, prompts=prompts, hypo_ids=hypo_ids):
+                        def run_lane(kv_lane, lane_handles, hidden=hidden, prompts=prompts, hypo_ids=hypo_ids):
                             with device_annotation("inference_step"):
                                 out, new_kv = backend.inference_step(
                                     hidden, kv_lane, pos, prompts=prompts,
                                     hypo_ids=hypo_ids, active_adapter=active_adapter,
+                                    handles=lane_handles,
                                 )
                             return np.asarray(out), new_kv
 

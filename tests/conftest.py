@@ -15,6 +15,29 @@ import sys
 # (the compilation-cache test opts back in explicitly with its own tmp dir)
 os.environ.setdefault("PETALS_TPU_NO_COMPILATION_CACHE", "1")
 
+# ...but DO share one session-scoped compilation cache across the whole run:
+# the suite compiles the same tiny-model programs hundreds of times (every
+# server fixture re-jits the span step), and the repeated XLA compiles were
+# the long tail of the suite's wall time. The dir is fresh per run (tmp), so
+# hermeticity vs the developer's ~/.cache is preserved. Export
+# PETALS_TPU_TEST_NO_SHARED_JIT_CACHE=1 to measure cold compiles.
+if not os.environ.get("PETALS_TPU_TEST_NO_SHARED_JIT_CACHE"):
+    import atexit
+    import shutil
+    import tempfile
+
+    _jit_cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if not _jit_cache_dir:
+        _jit_cache_dir = tempfile.mkdtemp(prefix="ptu-test-jit-cache-")
+        atexit.register(shutil.rmtree, _jit_cache_dir, ignore_errors=True)
+        # jax's OWN env plumbing (read at import, inherited by subprocess
+        # swarms — multihost/migration/CLI smokes — so their compiles hit the
+        # same cache; PETALS_TPU_NO_COMPILATION_CACHE only stops the server
+        # from configuring ITS default dir, it does not override these)
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = _jit_cache_dir
+        os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+        os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "0"
+
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
@@ -36,6 +59,13 @@ if _smoke_run:
 else:
     jax.config.update("jax_platforms", "cpu")
     assert jax.default_backend() == "cpu", jax.default_backend()
+
+if os.environ.get("JAX_COMPILATION_CACHE_DIR") and not _smoke_run:
+    # cache every program, however small/fast-compiling (explicit config in
+    # case a jax version reads these flags before our env exports landed)
+    jax.config.update("jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 # NOTE: pytest-asyncio is not installed; async tests must drive their own loop
 # via asyncio.run(...) inside a sync test function.

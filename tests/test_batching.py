@@ -330,7 +330,7 @@ def test_batched_decode_bloom_alibi(tmp_path_factory):
     run(main())
 
 
-@pytest.mark.parametrize("quant", ["int8", "int4"])
+@pytest.mark.parametrize("quant", [pytest.param("int8", marks=pytest.mark.slow), "int4"])
 def test_batched_decode_quantized(model_path, quant):
     """The batched program's quant-consts path (StackedQuantLinear views over
     scan consts) must match per-session scalar decode bit-for-bit."""

@@ -8,6 +8,8 @@ import asyncio
 
 import pytest
 
+pytestmark = pytest.mark.slow  # real-process/heavyweight tier (run with -m slow)
+
 import bench
 from petals_tpu.models.llama.config import LlamaBlockConfig
 

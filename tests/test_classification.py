@@ -150,6 +150,7 @@ def test_cls_ptune_training_reduces_loss(cls_swarm):
         model.close()
 
 
+@pytest.mark.slow
 def test_cls_grads_match_local_chain(cls_swarm):
     """Pooled-loss gradients through the swarm == a fully local jax replica
     of embed -> blocks -> norm -> score -> pooled cross-entropy."""

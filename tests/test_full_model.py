@@ -348,6 +348,7 @@ def test_beam_search_eos_and_length_penalty_match_hf(llama_client):
             )
 
 
+@pytest.mark.slow
 def test_beam_search_batched_matches_hf(llama_client):
     """Beam search over batch > 1 (independent hypothesis pools per row,
     KV-lane reorder across the flattened batch*beams lanes)."""
@@ -376,6 +377,7 @@ def test_beam_search_batched_matches_hf(llama_client):
         np.testing.assert_array_equal(ours, expected, err_msg=str(kwargs))
 
 
+@pytest.mark.slow
 def test_eos_padding_and_max_length_match_hf(llama_client):
     """Batched greedy with eos: finished rows emit pad_token_id (HF _sample
     semantics); max_length caps total length in both greedy and beam paths."""
@@ -407,6 +409,7 @@ def test_eos_padding_and_max_length_match_hf(llama_client):
         np.testing.assert_array_equal(ours, expected, err_msg=str(beam_kwargs))
 
 
+@pytest.mark.slow
 def test_num_return_sequences_and_min_new_tokens_match_hf(llama_client):
     """num_return_sequences (ranked beam outputs) and min_new_tokens (EOS ban
     until the minimum) must be token-identical to HF."""

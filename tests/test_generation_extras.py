@@ -100,7 +100,7 @@ def test_beam_session_batch_mismatch_is_clean_error(client):
             model.generate(ids, max_new_tokens=3, num_beams=3)
 
 
-@pytest.mark.parametrize("mode", ["ptune", "deep_ptune"])
+@pytest.mark.parametrize("mode", ["ptune", pytest.param("deep_ptune", marks=pytest.mark.slow)])
 def test_beam_with_prompt_tuning(swarm, mode):
     """Beam search composes with client-held trainable prompts (shallow and
     deep): mechanics + determinism (no HF analogue: HF has no ptune)."""
@@ -255,6 +255,7 @@ def test_sampled_nrs_session_batch_mismatch_is_clean_error(client):
             model.generate(ids, max_new_tokens=2, do_sample=True, num_return_sequences=3)
 
 
+@pytest.mark.slow
 def test_beam_short_session_clamps_instead_of_crashing(client):
     path, model = client
     ids = np.arange(5, dtype=np.int64).reshape(1, 5)

@@ -11,6 +11,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # real-process/heavyweight tier (run with -m slow)
+
 from petals_tpu.client.inference_session import InferenceSession
 from petals_tpu.client.model import AutoDistributedModelForCausalLM
 from tests.test_full_model import SwarmHarness, _hf_greedy

@@ -79,6 +79,7 @@ def test_mixtral_block_exact_match(tmp_path):
         )
 
 
+@pytest.mark.slow
 def test_mixtral_cache_decode(tmp_path):
     path = make_tiny_mixtral(str(tmp_path))
     family, cfg = get_block_config(path)

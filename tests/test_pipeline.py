@@ -83,6 +83,7 @@ def test_pipeline_matches_plain_scan(pp, num_micro):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=0)
 
 
+@pytest.mark.slow
 def test_pipeline_grads_match():
     cfg = tiny_cfg(4)
     params = random_span_params(cfg)

@@ -8,6 +8,8 @@ import asyncio
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # real-process/heavyweight tier (run with -m slow)
+
 jnp = pytest.importorskip("jax.numpy")
 
 from petals_tpu.data_structures import make_uid

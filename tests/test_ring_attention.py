@@ -46,7 +46,7 @@ def test_ring_alibi_matches_reference():
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=3e-5, rtol=1e-5)
 
 
-@pytest.mark.parametrize("window", [8, 17])
+@pytest.mark.parametrize("window", [pytest.param(8, marks=pytest.mark.slow), 17])
 def test_ring_sliding_window_matches_reference(window):
     """Sliding windows apply to GLOBAL positions inside the ring (Mixtral
     long-context sequence parallelism)."""
@@ -63,7 +63,10 @@ def test_ring_sliding_window_matches_reference(window):
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=3e-5, rtol=1e-5)
 
 
-@pytest.mark.parametrize("family_fixture", ["bloom", "falcon", "mixtral"])
+@pytest.mark.parametrize(
+    "family_fixture",
+    ["bloom", pytest.param("falcon", marks=pytest.mark.slow), pytest.param("mixtral", marks=pytest.mark.slow)],
+)
 def test_block_ring_matches_plain(family_fixture, tmp_path):
     """Every family's block must produce identical outputs with and without
     the ring (the sp training path now covers all four families)."""

@@ -24,6 +24,7 @@ def swarm(tmp_path_factory):
     harness.stop()
 
 
+@pytest.mark.slow
 def test_oracle_draft_token_identical_and_fast(swarm):
     """A perfect draft (the same model run locally) accepts everything."""
     path, harness, model = swarm

@@ -112,6 +112,7 @@ def test_ping_aggregator_live():
     run(main())
 
 
+@pytest.mark.slow
 def test_throughput_measure_and_cache(tmp_path):
     import jax.numpy as jnp
 

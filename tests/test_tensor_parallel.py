@@ -18,7 +18,11 @@ from tests.utils import make_tiny_bloom, make_tiny_llama, make_tiny_mixtral
 # devices) — this is the ep coverage VERDICT r1 flagged as spec-only
 @pytest.mark.parametrize(
     "model_maker,tp_size",
-    [(make_tiny_llama, 2), (make_tiny_bloom, 4), (make_tiny_mixtral, 2)],
+    [
+        (make_tiny_llama, 2),
+        pytest.param(make_tiny_bloom, 4, marks=pytest.mark.slow),
+        pytest.param(make_tiny_mixtral, 2, marks=pytest.mark.slow),
+    ],
 )
 def test_tp_matches_single_device(model_maker, tp_size, tmp_path):
     assert len(jax.devices()) >= tp_size, "conftest must provide 8 virtual devices"
@@ -71,7 +75,10 @@ def test_tp_matches_single_device(model_maker, tp_size, tmp_path):
     np.testing.assert_allclose(np.asarray(gt), np.asarray(gp), atol=2e-5, rtol=0)
 
 
-@pytest.mark.parametrize("quant", ["int8", "nf4", "int4"])
+@pytest.mark.parametrize(
+    "quant",
+    [pytest.param("int8", marks=pytest.mark.slow), pytest.param("nf4", marks=pytest.mark.slow), "int4"],
+)
 def test_tp_quantized_matches_single_device(quant, tmp_path):
     """Quant x TP composition (reference convert_block.py:25-73 quantizes after
     its TP wrap): a TP=2 quantized backend must match the single-device

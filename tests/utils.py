@@ -208,3 +208,90 @@ def make_tiny_mistral(tmpdir: str, *, n_layers: int = 4, vocab: int = 128, windo
     path = os.path.join(tmpdir, "tiny-mistral")
     model.save_pretrained(path, safe_serialization=True)
     return path
+
+
+def multihost_child_env(repo_root: str | None = None) -> dict:
+    """Env for multi-host subprocess swarms: CPU-only (any accelerator plugin
+    dir is REPLACED out of PYTHONPATH — plugins force-override JAX_PLATFORMS
+    at import time), one virtual device per process."""
+    root = repo_root or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return {
+        **os.environ,
+        "PYTHONPATH": root,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    }
+
+
+def spawn_multihost_pair(
+    model: str,
+    *,
+    num_blocks: int = 4,
+    leader_args: tuple = (),
+    worker_args: tuple = (),
+    ready_timeout: float = 300.0,
+    env: dict | None = None,
+):
+    """Start a run_server leader + run_worker pair over a 2-process tp mesh
+    and wait for the leader's announce address. Returns (leader_proc,
+    worker_proc, addr); the leader's stdout is drained by a daemon thread
+    after readiness (callers must terminate both). One definition for the
+    multihost tests AND benchmarks — the announce-line protocol lives here."""
+    import socket
+    import subprocess
+    import sys
+    import threading
+    import time
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    env = env or multihost_child_env()
+    span = ["--first_block", "0", "--num_blocks", str(num_blocks),
+            "--coordinator_address", coord, "--num_hosts", "2"]
+    leader = subprocess.Popen(
+        [sys.executable, "-m", "petals_tpu.cli.run_server", model,
+         *span, "--host", "127.0.0.1", *leader_args],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "petals_tpu.cli.run_worker", model,
+         *span, "--host_index", "1", *worker_args],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    addr, lines = None, []
+    t0 = time.time()
+    while time.time() - t0 < ready_timeout:
+        line = leader.stdout.readline()
+        if not line and leader.poll() is not None:
+            break
+        lines.append(line)
+        if "announce address:" in line:
+            addr = line.rsplit("announce address:", 1)[1].strip()
+            break
+    if not addr:
+        for p in (leader, worker):
+            p.kill()
+        raise RuntimeError(
+            "multihost leader never became ready:\n" + "".join(lines[-25:])
+        )
+    for proc in (leader, worker):
+        threading.Thread(
+            target=lambda p=proc: [None for _ in p.stdout], daemon=True
+        ).start()
+    return leader, worker, addr
+
+
+def stop_multihost_pair(leader, worker, timeout: float = 30.0) -> None:
+    import subprocess
+
+    leader.terminate()
+    try:
+        leader.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        leader.kill()
+    try:
+        worker.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        worker.kill()
