@@ -1377,6 +1377,15 @@ def main():
 
     row("multihost_batched_e2e", "multihost batching", multihost_batching_row)
 
+    # prefix-affinity routing under injected ping noise (VERDICT r4 #8): the
+    # adaptive amplitude's convergence/spread sweep, re-measured every round
+    def affinity_noise_row():
+        from benchmarks.affinity_noise import report as affinity_report
+
+        return affinity_report()
+
+    row("prefix_affinity_noise", "affinity noise", affinity_noise_row)
+
     # 405B rehearsal: placement math + single-stream projection from THIS
     # run's measured bandwidths (benchmarks/rehearsal_405b.py; the north-star
     # arithmetic the driver records every round)

@@ -1,0 +1,137 @@
+"""Prefix-affinity routing under realistic RTT noise (VERDICT r4 #8).
+
+The 5 ms affinity amplitude (client/routing/sequence_manager.py
+AFFINITY_JITTER_S) was chosen by argument: it must dominate the NOISE-scale
+cost differences between near-equal replicas or identical prompts scatter
+across caches. This module MEASURES that claim: a loopback swarm of equal
+replicas whose client-side RTTs carry per-peer noise at the ping-EMA scale
+(utils/ping.py: EMA alpha 0.2 over raw WAN jitter), convergence = how often
+repeated routing decisions for the SAME prompt land on the modal replica,
+spread = how many distinct replicas the modal choices of DIFFERENT prompts
+cover. Reported across a raw-jitter sweep so the answer is a curve, not a
+single anecdote.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import time
+from typing import Dict, List
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EMA_ALPHA = 0.2  # utils/ping.py PingAggregator smoothing
+BASE_RTT_S = 0.020  # equal-replica WAN baseline
+
+
+async def _measure_async(
+    sigma_raw_ms: float,
+    *,
+    n_replicas: int = 3,
+    n_prompts: int = 20,
+    n_decisions: int = 15,
+    seed: int = 0,
+) -> Dict:
+    from petals_tpu.client.config import ClientConfig
+    from petals_tpu.client.routing.sequence_manager import RemoteSequenceManager
+    from petals_tpu.data_structures import ServerInfo, ServerState, make_uid
+    from petals_tpu.dht import DHTNode
+    from petals_tpu.utils.dht_utils import declare_active_modules
+
+    boot = await DHTNode.create(maintenance_period=1000)
+    uids = [make_uid("m", i) for i in range(2)]
+    nodes = []
+    for _ in range(n_replicas):
+        node = await DHTNode.create(initial_peers=[boot.own_addr], maintenance_period=1000)
+        info = ServerInfo(
+            ServerState.ONLINE, 10.0, start_block=0, end_block=2, inference_rps=10.0,
+        )
+        await declare_active_modules(node, uids, info, time.time() + 600)
+        nodes.append(node)
+    manager = await RemoteSequenceManager.create(
+        ClientConfig(initial_peers=[boot.own_addr.to_string()], update_period=1000), uids
+    )
+    try:
+        await manager.ensure_ready()
+        rng = np.random.RandomState(seed)
+        ema: Dict = {}
+
+        def tick():
+            """One fresh raw ping sample per replica folded into its EMA —
+            the noise the router actually sees between routing decisions."""
+            for node in nodes:
+                raw = BASE_RTT_S + rng.randn() * sigma_raw_ms * 1e-3
+                prev = ema.get(node.peer_id, BASE_RTT_S)
+                ema[node.peer_id] = (1 - EMA_ALPHA) * prev + EMA_ALPHA * max(raw, 0.0)
+
+        manager.rtt_fn = lambda a, b: ema.get(b, BASE_RTT_S)
+        # the adaptive amplitude sees the TRUE smoothed jitter (in production
+        # PingAggregator.noise_s estimates it; tests/test_sequence_manager.py
+        # covers that estimator against known noise)
+        ema_sigma_s = sigma_raw_ms * 1e-3 * float(np.sqrt(EMA_ALPHA / (2 - EMA_ALPHA)))
+        manager.rtt_noise_fn = lambda: ema_sigma_s
+
+        # settle the EMAs like a long-running client's aggregator would
+        for _ in range(20):
+            tick()
+
+        convergence: List[float] = []
+        modal_peers = set()
+        for prompt in range(n_prompts):
+            affinity_seed = int(rng.randint(0, 2**31))
+            counts: Dict = {}
+            for _ in range(n_decisions):
+                tick()  # pings drift between decisions
+                chain = await manager.make_sequence(affinity_seed=affinity_seed)
+                peer = chain[0].peer_id
+                counts[peer] = counts.get(peer, 0) + 1
+            modal = max(counts, key=counts.get)
+            modal_peers.add(modal)
+            convergence.append(counts[modal] / n_decisions)
+        from petals_tpu.client.routing.sequence_manager import affinity_amplitude
+
+        ema_sigma_ms = sigma_raw_ms * float(np.sqrt(EMA_ALPHA / (2 - EMA_ALPHA)))
+        return {
+            "sigma_raw_ms": sigma_raw_ms,
+            "sigma_ema_ms": round(ema_sigma_ms, 3),
+            "amplitude_ms": round(affinity_amplitude(ema_sigma_ms * 1e-3) * 1e3, 2),
+            "replicas": n_replicas,
+            "prompts": n_prompts,
+            "decisions_per_prompt": n_decisions,
+            "mean_convergence": round(float(np.mean(convergence)), 3),
+            "min_convergence": round(float(np.min(convergence)), 3),
+            "distinct_modal_replicas": len(modal_peers),
+        }
+    finally:
+        await manager.shutdown()
+        for n in nodes + [boot]:
+            await n.shutdown()
+
+
+def measure(sigma_raw_ms: float, **kw) -> Dict:
+    return asyncio.run(_measure_async(sigma_raw_ms, **kw))
+
+
+def report() -> Dict:
+    """The BENCH_DETAILS row: convergence/spread across a raw-jitter sweep.
+    2 ms raw (~0.67 ms EMA-smoothed) is the realistic WAN regime; 6 ms raw
+    (2 ms smoothed) is adversarial. Round-5 finding: the original flat 5 ms
+    amplitude measured only ~85% convergence at the realistic regime, so the
+    amplitude now adapts to the measured noise (sequence_manager.py
+    affinity_amplitude) — the sweep records the adapted behavior."""
+    rows = [measure(s) for s in (0.5, 2.0, 6.0)]
+    return {
+        "adaptive_amplitude": "clip(30 * sigma_ema, 5 ms, 25 ms)",
+        "ema_alpha": EMA_ALPHA,
+        "sweep": rows,
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(report(), indent=2))

@@ -20,9 +20,6 @@ import asyncio
 import os
 import sys
 import tempfile
-import time
-
-import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -33,52 +30,18 @@ PREFILL = 8
 
 
 async def _drive(addr: str, model: str, *, concurrent: bool) -> dict:
-    from transformers import AutoConfig
+    # shared protocol driver (tests/utils.py) — one definition of the
+    # session-open/prefill/coalescing-round wire exchange
+    from tests.utils import drive_coalescing_sessions
 
-    from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
-    from petals_tpu.rpc import RpcClient
-    from petals_tpu.rpc.serialization import deserialize_array, serialize_array
-    from petals_tpu.server.server import default_dht_prefix
-
-    hsz = AutoConfig.from_pretrained(model).hidden_size
-    host, port = addr.rsplit("/", 1)[0].rsplit(":", 1)
-    c = await RpcClient.connect(host, int(port))
-    rng = np.random.RandomState(0)
-    uids = CHAIN_DELIMITER.join(make_uid(default_dht_prefix(model), i) for i in range(4))
-    try:
-        streams = []
-        for _ in range(N_SESSIONS):
-            s = await c.open_stream("ptu.inference")
-            await s.send({"uids": uids, "max_length": PREFILL + N_STEPS + 8, "batch_size": 1})
-            await s.recv(timeout=60)
-            await s.send({"tensors": {"hidden": serialize_array(
-                rng.randn(1, PREFILL, hsz).astype(np.float32) * 0.1)}})
-            await s.recv(timeout=300)
-            streams.append(s)
-        t0 = time.perf_counter()
-        if concurrent:
-            for _ in range(N_STEPS):
-                step = rng.randn(1, 1, hsz).astype(np.float32) * 0.1
-                for s in streams:  # all sends before any recv -> coalescing
-                    await s.send({"tensors": {"hidden": serialize_array(step)}})
-                for s in streams:
-                    deserialize_array((await s.recv(timeout=300))["tensors"]["hidden"])
-        else:
-            for s in streams:
-                for _ in range(N_STEPS):
-                    step = rng.randn(1, 1, hsz).astype(np.float32) * 0.1
-                    await s.send({"tensors": {"hidden": serialize_array(step)}})
-                    deserialize_array((await s.recv(timeout=300))["tensors"]["hidden"])
-        elapsed = time.perf_counter() - t0
-        for s in streams:
-            await s.end()
-        info = await c.call("ptu.info", {}, timeout=30)
-        return {
-            "tok_s": N_SESSIONS * N_STEPS / elapsed,
-            "stats": info.get("continuous_batching") or {},
-        }
-    finally:
-        await c.close()
+    elapsed, info = await drive_coalescing_sessions(
+        addr, model, n_sessions=N_SESSIONS, n_steps=N_STEPS,
+        prefill=PREFILL, concurrent=concurrent, seed=0,
+    )
+    return {
+        "tok_s": N_SESSIONS * N_STEPS / elapsed,
+        "stats": info.get("continuous_batching") or {},
+    }
 
 
 def run_bench(model: str | None = None) -> dict:

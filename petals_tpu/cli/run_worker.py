@@ -33,7 +33,9 @@ def main() -> None:
     parser.add_argument("--first_block", type=int, required=True)
     parser.add_argument("--num_blocks", type=int, required=True)
     parser.add_argument("--num_tp_devices", type=int, default=None,
-                        help="global tp width (default: every device in the group)")
+                        help="global tp width (default: every device in the group / sp)")
+    parser.add_argument("--num_sp_devices", type=int, default=None,
+                        help="sequence-parallel width — MUST match the leader's flag")
     parser.add_argument("--quant_type", default="none",
                         choices=["none", "int8", "nf4", "nf4a", "int4"])
     from petals_tpu.constants import DTYPE_MAP
@@ -87,7 +89,7 @@ def main() -> None:
         load_block(i) for i in range(args.first_block, args.first_block + args.num_blocks)
     ]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
-    mesh = multihost_mesh(args.num_tp_devices)
+    mesh = multihost_mesh(args.num_tp_devices, args.num_sp_devices or 1)
     backend = TransformerBackend(
         family, cfg, stacked,
         first_block=args.first_block,
@@ -109,7 +111,9 @@ def main() -> None:
 
     logger.info(
         f"worker {args.host_index}/{args.num_hosts}: span "
-        f"[{args.first_block}, {args.first_block + args.num_blocks}) over tp={mesh.shape['tp']}"
+        f"[{args.first_block}, {args.first_block + args.num_blocks}) over "
+        f"tp={mesh.shape['tp']}"
+        + (f" x sp={mesh.shape['sp']}" if "sp" in mesh.shape else "")
     )
     LockstepWorker(backend).run()
 

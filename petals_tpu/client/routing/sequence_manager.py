@@ -38,12 +38,21 @@ logger = get_logger(__name__)
 
 CACHE_MISS_PENALTY = 10.0  # seconds added when a server's KV cache can't fit us
 # Prompt-prefix affinity amplitude (see _edge_cost): must dominate
-# noise-level cost differences between near-equal replicas (sub-ms RTT
-# jitter) or identical prompts scatter and never share a prefix cache; must
-# stay below REAL routing signal (tens-of-ms WAN RTT gaps, CACHE_MISS_PENALTY).
-# 5 ms sits between the two — and a prefix-cache hit repays it thousandfold
-# (it skips the whole shared-prefix prefill).
-AFFINITY_JITTER_S = 5e-3
+# noise-level cost differences between near-equal replicas or identical
+# prompts scatter and never share a prefix cache; must stay below REAL
+# routing signal (tens-of-ms WAN RTT gaps, CACHE_MISS_PENALTY).
+#
+# The amplitude ADAPTS to the MEASURED ping noise (round 5; the flat 5 ms
+# constant was measured insufficient — benchmarks/affinity_noise.py: at a
+# realistic 0.67 ms smoothed-ping jitter over 3 replicas, convergence was
+# only ~85%): amplitude = clip(30 * sigma_ema, 5 ms, 25 ms), where
+# sigma_ema comes from the ping aggregator's per-peer deviation tracking
+# (utils/ping.py noise_s). Quiet networks keep the minimal 5 ms bias; noisy
+# networks widen it — exactly when the RTT estimates can't distinguish
+# replicas at that scale anyway, so the larger bias costs nothing real.
+AFFINITY_JITTER_S = 5e-3  # floor (quiet networks)
+AFFINITY_JITTER_MAX_S = 25e-3  # cap: never override a >25 ms-better replica
+AFFINITY_NOISE_MULT = 30.0  # sized by the measured sweep (benchmarks/affinity_noise.py)
 
 
 def _affinity01(seed: int, peer_id) -> float:
@@ -58,7 +67,13 @@ def _affinity01(seed: int, peer_id) -> float:
     return int.from_bytes(h.digest(), "big") / 2**64
 
 
-def _affinity_jitters(seed: Optional[int]):
+def affinity_amplitude(noise_s: float) -> float:
+    """Adaptive amplitude from the measured smoothed-ping jitter (see the
+    constants above)."""
+    return min(max(AFFINITY_NOISE_MULT * noise_s, AFFINITY_JITTER_S), AFFINITY_JITTER_MAX_S)
+
+
+def _affinity_jitters(seed: Optional[int], amplitude: float = AFFINITY_JITTER_S):
     """Per-peer jitter, memoized for one route computation (the Dijkstra
     relaxes each peer many times; the hash depends only on (seed, peer))."""
     if seed is None:
@@ -68,7 +83,7 @@ def _affinity_jitters(seed: Optional[int]):
     def jitter(peer_id) -> float:
         val = cache.get(peer_id)
         if val is None:
-            val = cache[peer_id] = AFFINITY_JITTER_S * _affinity01(seed, peer_id)
+            val = cache[peer_id] = amplitude * _affinity01(seed, peer_id)
         return val
 
     return jitter
@@ -116,6 +131,11 @@ class RemoteSequenceManager:
         else:
             self.ping_aggregator = None
         self.rtt_fn = rtt_fn
+        # measured smoothed-ping jitter, sizing the prefix-affinity amplitude
+        # (affinity_amplitude above); tests/benchmarks override to inject noise
+        self.rtt_noise_fn: Callable[[], float] = (
+            self.ping_aggregator.noise_s if self.ping_aggregator is not None else (lambda: 0.0)
+        )
         self._banned: Dict[PeerID, Tuple[float, int]] = {}  # peer -> (banned_until, streak)
         self._update_lock = asyncio.Lock()
         self._update_task = asyncio.create_task(self._update_loop())
@@ -411,7 +431,7 @@ class RemoteSequenceManager:
         (+ cache-miss penalty), mirroring reference :177-300."""
         import itertools
 
-        jitter = _affinity_jitters(affinity_seed)
+        jitter = _affinity_jitters(affinity_seed, affinity_amplitude(self.rtt_noise_fn()))
         tiebreak = itertools.count()  # heap entries: (cost, counter, block, peer)
         heap: List[Tuple] = [(0.0, next(tiebreak), start, None)]
         best: Dict[Tuple[int, Optional[PeerID]], float] = {(start, None): 0.0}

@@ -29,15 +29,27 @@ def make_mesh(
     return Mesh(grid, tuple(axis_names))
 
 
-def tp_mesh(num_devices: Optional[int] = None) -> Mesh:
-    """1-D tensor-parallel mesh over this host's chips (the intra-server mesh)."""
-    devices = jax.devices()
+def tp_mesh(
+    num_devices: Optional[int] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """1-D tensor-parallel mesh over this host's chips (the intra-server
+    mesh). ``devices`` overrides the pool (e.g. jax.local_devices() when a
+    surviving multi-host leader re-forms locally — jax.devices() would still
+    list the dead members' chips)."""
+    devices = list(devices if devices is not None else jax.devices())
     num_devices = num_devices or len(devices)
     return make_mesh((num_devices,), ("tp",), devices=devices)
 
 
-def serving_mesh(num_tp: int = 1, num_sp: int = 1) -> Mesh:
+def serving_mesh(
+    num_tp: int = 1,
+    num_sp: int = 1,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
     """2-D intra-server mesh: heads/FFN sharded over "tp", long-context
     activations sharded over "sp" (ring attention on the stateless
     forward/backward path)."""
-    return make_mesh((num_tp, num_sp), ("tp", "sp"))
+    return make_mesh((num_tp, num_sp), ("tp", "sp"), devices=devices)

@@ -55,8 +55,13 @@ ordinary lockstep session ops against it, and checks it back in
 (OP_LANE_INSERT) — so chunked prefill, prefix-cache seeding/storing, and KV
 import/export all work on pooled multi-host sessions.
 
-Remaining v1 limits: live rebalancing (a span move would strand the workers'
-shards) and sp meshes (the serving mesh is tp-only across hosts).
+Sequence parallelism crosses hosts too (round 5): the serving mesh can be
+(tp, sp) over the global device set — the q-sharded cached prefill and the
+stateless path's ring attention then run their sp collectives between
+processes, because every process enters the same jitted program anyway.
+
+Remaining v1 limit: live rebalancing (a span move would strand the workers'
+shards).
 """
 
 from __future__ import annotations
@@ -164,18 +169,37 @@ def init_multihost(coordinator_address: str, num_processes: int, process_id: int
     )
 
 
-def multihost_mesh(tp: Optional[int] = None):
-    """tp serving mesh over the GLOBAL device set (all hosts' chips)."""
+def multihost_mesh(tp: Optional[int] = None, sp: int = 1):
+    """Serving mesh over the GLOBAL device set (all hosts' chips): 1-D tp, or
+    2-D (tp, sp) when sequence parallelism is requested — the sp collectives
+    (ring attention / q-sharded cached prefill) then cross the process
+    boundary like any other lockstep compute, because every process enters
+    the same jitted program (ops broadcast via LockstepBackend)."""
     import jax
     from jax.sharding import Mesh
 
     devices = jax.devices()
-    tp = tp or len(devices)
-    if len(devices) < tp:
+    sp = sp or 1
+    if tp is None:
+        tp, rem = divmod(len(devices), sp)
+        if tp == 0:
+            raise ValueError(
+                f"multihost mesh sp={sp} needs at least {sp} devices, "
+                f"{len(devices)} available across {jax.process_count()} processes"
+            )
+        if rem:
+            logger.warning(
+                f"multihost mesh: {len(devices)} devices do not divide sp={sp}; "
+                f"serving on tp={tp} x sp={sp} = {tp * sp} devices, {rem} idle"
+            )
+    need = tp * sp
+    if tp < 1 or len(devices) < need:
         raise ValueError(
-            f"multihost mesh tp={tp} needs {tp} devices, {len(devices)} "
-            f"available across {jax.process_count()} processes"
+            f"multihost mesh tp={tp} x sp={sp} needs {need} devices (tp >= 1), "
+            f"{len(devices)} available across {jax.process_count()} processes"
         )
+    if sp > 1:
+        return Mesh(np.array(devices[:need]).reshape(tp, sp), ("tp", "sp"))
     return Mesh(np.array(devices[:tp]).reshape(tp), ("tp",))
 
 

@@ -406,6 +406,23 @@ class TransformerHandler:
         buffer gets invalidated) — retry on the fresh buffer. The device->host
         copy is 100s of MB for long contexts, so it runs off the event loop:
         other sessions' steps must not stall behind it."""
+        if reg.get("lane") is not None:
+            # pooled session (lockstep included — snapshot_lane routes through
+            # the temp-mirror export there): the lane copy runs on the compute
+            # thread, so it serializes with batched steps — no donation race
+            # to retry. MUST be checked before is_lockstep: pooled sessions
+            # register handles=None, so the private export below would crash.
+            n = reg["end"] - reg["start"]
+            position = reg["position"]
+            k, v = await self.batcher.snapshot_lane(
+                reg["lane"], position, b0 if b0 is not None else 0,
+                b1 if b1 is not None else n,
+            )
+            return {
+                "k": k, "v": v, "position": position,
+                "start": reg["start"], "end": reg["end"],
+                "batch_size": reg["batch_size"], "max_length": reg["max_length"],
+            }
         if getattr(self.backend, "is_lockstep", False):
             # multi-host: every process all_gathers its shards in-program
             # (multihost.py export_kv); buffer fetch + donation retry happen
@@ -419,20 +436,6 @@ class TransformerHandler:
                 b0 if b0 is not None else 0,
                 b1 if b1 is not None else n,
                 position,
-            )
-            return {
-                "k": k, "v": v, "position": position,
-                "start": reg["start"], "end": reg["end"],
-                "batch_size": reg["batch_size"], "max_length": reg["max_length"],
-            }
-        if reg.get("lane") is not None:
-            # pooled session: the lane copy runs on the compute thread, so it
-            # serializes with batched steps — no donation race to retry
-            n = reg["end"] - reg["start"]
-            position = reg["position"]
-            k, v = await self.batcher.snapshot_lane(
-                reg["lane"], position, b0 if b0 is not None else 0,
-                b1 if b1 is not None else n,
             )
             return {
                 "k": k, "v": v, "position": position,

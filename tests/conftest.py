@@ -30,10 +30,10 @@ if not os.environ.get("PETALS_TPU_TEST_NO_SHARED_JIT_CACHE"):
     if not _jit_cache_dir:
         _jit_cache_dir = tempfile.mkdtemp(prefix="ptu-test-jit-cache-")
         atexit.register(shutil.rmtree, _jit_cache_dir, ignore_errors=True)
-        # jax's OWN env plumbing (read at import, inherited by subprocess
-        # swarms — multihost/migration/CLI smokes — so their compiles hit the
-        # same cache; PETALS_TPU_NO_COMPILATION_CACHE only stops the server
-        # from configuring ITS default dir, it does not override these)
+        # jax's OWN env plumbing (read at import). IN-PROCESS ONLY: multihost
+        # subprocess swarms strip these again (tests/utils.multihost_child_env)
+        # — two jax.distributed processes sharing one on-disk cache can wedge
+        # a lockstep group at its first collective.
         os.environ["JAX_COMPILATION_CACHE_DIR"] = _jit_cache_dir
         os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
         os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "0"

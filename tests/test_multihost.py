@@ -39,12 +39,9 @@ def _free_port() -> int:
 
 
 def _mp_env() -> dict:
-    return {
-        **os.environ,
-        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
-    }
+    from tests.utils import multihost_child_env
+
+    return multihost_child_env()
 
 
 _LEADER = r"""
@@ -567,15 +564,17 @@ def test_multihost_server_end_to_end(tmp_path):
             assert pc.get("hit_tokens", 0) >= 128, pc
 
             # --- v2 worker-death, full stack: kill the worker; the next
-            # request must fail CLEANLY (bounded by the collective timeout,
-            # not a hang) and the leader process must survive to be drained
+            # request must either fail CLEANLY (bounded by the collective
+            # timeout, not a hang) or — since round 5's partial re-formation
+            # — succeed against the re-formed single-host leader with the
+            # CORRECT tokens; the leader process must survive either way
             worker.kill()
             worker.wait(timeout=30)
             result = {}
 
             def degraded_generate():
                 try:
-                    client.generate(ids, max_new_tokens=2)
+                    result["out"] = np.asarray(client.generate(ids, max_new_tokens=2))
                     result["error"] = None
                 except Exception as e:
                     result["error"] = e
@@ -587,15 +586,18 @@ def test_multihost_server_end_to_end(tmp_path):
             t.join(timeout=330)
             assert not t.is_alive(), "request on a degraded group hung"
             err = result.get("error")
-            assert err is not None, "request on a degraded group should error"
-            # the error must come from the degradation path, not some
-            # unrelated client bug: group-degraded, banned-servers-missing,
-            # or a step/recv timeout are the legitimate shapes
-            msg = f"{type(err).__name__}: {err}"
-            assert any(
-                key in msg.lower()
-                for key in ("degraded", "missing", "no server", "timeout", "timed out")
-            ), msg
+            if err is None:
+                # the retry outlived re-formation: the answer must be right
+                np.testing.assert_array_equal(result["out"], _hf_greedy(model, ids, 2))
+            else:
+                # the error must come from the degradation path, not some
+                # unrelated client bug: group-degraded, banned-servers-missing,
+                # or a step/recv timeout are the legitimate shapes
+                msg = f"{type(err).__name__}: {err}"
+                assert any(
+                    key in msg.lower()
+                    for key in ("degraded", "missing", "no server", "timeout", "timed out")
+                ), msg
             assert leader.poll() is None, "leader must survive worker death"
         finally:
             client.close()
@@ -674,53 +676,137 @@ def test_multihost_continuous_batching(tmp_path):
         # loop, all 4 decode steps sent before any reply is awaited — while
         # the first step's lockstep device op runs, the rest pend and drain
         # as one >=3-lane batch (thread-per-client generate above can't pin
-        # this down on a single-core machine: the GIL serializes the streams)
+        # this down on a single-core machine: the GIL serializes the streams).
+        # The protocol driver is shared with benchmarks/multihost_batching.py.
         import asyncio as _a
 
-        from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
-        from petals_tpu.rpc import RpcClient
-        from petals_tpu.rpc.serialization import deserialize_array, serialize_array
-        from petals_tpu.server.server import default_dht_prefix
+        from tests.utils import drive_coalescing_sessions
 
-        from transformers import AutoConfig
-
-        host, port = addr.rsplit("/", 1)[0].rsplit(":", 1)
-        hsz = AutoConfig.from_pretrained(model).hidden_size
-        uids = CHAIN_DELIMITER.join(
-            make_uid(default_dht_prefix(model), i) for i in range(4)
-        )
-
-        async def coalesce_probe():
-            c = await RpcClient.connect(host, int(port))
-            try:
-                streams = []
-                srng = np.random.RandomState(3)
-                for _ in range(4):
-                    s = await c.open_stream("ptu.inference")
-                    await s.send({"uids": uids, "max_length": 64, "batch_size": 1})
-                    await s.recv(timeout=60)
-                    await s.send({"tensors": {"hidden": serialize_array(
-                        srng.randn(1, 4, hsz).astype(np.float32) * 0.1)}})
-                    await s.recv(timeout=120)
-                    streams.append(s)
-                for _round in range(6):
-                    step = srng.randn(1, 1, hsz).astype(np.float32) * 0.1
-                    for s in streams:  # all sends before any recv
-                        await s.send({"tensors": {"hidden": serialize_array(step)}})
-                    for s in streams:
-                        out = deserialize_array(
-                            (await s.recv(timeout=120))["tensors"]["hidden"]
-                        )
-                        assert np.isfinite(out).all()
-                for s in streams:
-                    await s.end()
-                return await c.call("ptu.info", {}, timeout=30)
-            finally:
-                await c.close()
-
-        info = _a.run(coalesce_probe())
+        _, info = _a.run(drive_coalescing_sessions(addr, model, concurrent=True))
         stats = info.get("continuous_batching") or {}
         assert stats.get("batched_steps", 0) > 0, stats
         assert stats.get("max_batch", 0) >= 3, stats
+    finally:
+        stop_multihost_pair(leader, worker)
+
+
+def test_multihost_sequence_parallel_end_to_end(tmp_path):
+    """Round-5 (VERDICT #5): the sp axis crosses the process boundary. A
+    2-process mesh with tp=1 x sp=2 serves a span; the q-sharded cached
+    prefill and the stateless forward's ring attention run their sp
+    collectives BETWEEN processes, and generation stays token-identical to
+    HF (incl. a long even-length prompt that engages the sp prefill path)."""
+    from tests.utils import spawn_multihost_pair, stop_multihost_pair
+
+    model = make_tiny_llama(str(tmp_path))
+    sp_args = ("--num_tp_devices", "1", "--num_sp_devices", "2")
+    leader, worker, addr = spawn_multihost_pair(
+        model,
+        # fast announce period: the re-formation phase below is detected on
+        # the announce tick
+        leader_args=("--throughput", "7.0", "--update_period", "3", *sp_args),
+        worker_args=sp_args,
+        ready_timeout=420.0, env=_mp_env(),
+    )
+    try:
+        from petals_tpu.client.model import AutoDistributedModelForCausalLM
+        from tests.test_full_model import _hf_greedy
+
+        client = AutoDistributedModelForCausalLM.from_pretrained(
+            model, initial_peers=[addr]
+        )
+        try:
+            rng = np.random.RandomState(5)
+            # short prompt: decode path over the sp mesh
+            ids = rng.randint(0, 100, (1, 6)).astype(np.int64)
+            np.testing.assert_array_equal(
+                client.generate(ids, max_new_tokens=6), _hf_greedy(model, ids, 6)
+            )
+            # long EVEN prompt: the whole-chunk prefill divides sp=2, so the
+            # q-sharded attention spans both processes
+            long_ids = rng.randint(0, 100, (1, 96)).astype(np.int64)
+            np.testing.assert_array_equal(
+                client.generate(long_ids, max_new_tokens=4),
+                _hf_greedy(model, long_ids, 4),
+            )
+            # stateless forward (training path): ring attention across
+            # processes; finite logits prove the collective ran end-to-end
+            logits = np.asarray(client.forward(long_ids))
+            assert np.isfinite(logits).all()
+
+            # partial re-formation FROM AN SP GROUP: the reform must drop the
+            # group's sp axis (its devices died with the worker) and serve
+            # locally — a reform that rebuilt the old (tp=1, sp=2) mesh over
+            # jax.devices() would hang on the dead member's chip
+            worker.kill()
+            worker.wait(timeout=30)
+            deadline = time.time() + 240
+            out, last_err = None, None
+            while time.time() < deadline:
+                assert leader.poll() is None, "leader process must survive"
+                try:
+                    out = np.asarray(client.generate(ids, max_new_tokens=6))
+                    break
+                except Exception as e:
+                    last_err = e
+                    time.sleep(2.0)
+            assert out is not None, f"serving never resumed after sp-group loss: {last_err!r}"
+            np.testing.assert_array_equal(out, _hf_greedy(model, ids, 6))
+        finally:
+            client.close()
+    finally:
+        stop_multihost_pair(leader, worker)
+
+
+def test_multihost_partial_reformation(tmp_path):
+    """Round-5 (VERDICT #4): kill one worker of a 2-process span — the
+    surviving LEADER re-forms as a single-host server from the checkpoint
+    (same process, same identity, same address) and serving resumes
+    token-identical, with no process restarted. The dead worker's
+    replacement would simply join a future group; nothing else restarts."""
+    from tests.utils import spawn_multihost_pair, stop_multihost_pair
+
+    model = make_tiny_llama(str(tmp_path))
+    leader, worker, addr = spawn_multihost_pair(
+        model,
+        # fast announce period: degradation is detected on the announce tick
+        leader_args=("--throughput", "7.0", "--update_period", "3"),
+        ready_timeout=420.0,
+    )
+    try:
+        from petals_tpu.client.model import AutoDistributedModelForCausalLM
+        from tests.test_full_model import _hf_greedy
+
+        rng = np.random.RandomState(9)
+        ids = rng.randint(0, 100, (1, 6)).astype(np.int64)
+        want = _hf_greedy(model, ids, 5)
+
+        client = AutoDistributedModelForCausalLM.from_pretrained(
+            model, initial_peers=[addr]
+        )
+        try:
+            np.testing.assert_array_equal(client.generate(ids, max_new_tokens=5), want)
+
+            worker.kill()
+            worker.wait(timeout=30)
+
+            # serving must RESUME (leader re-forms single-host); retry until
+            # the re-formed server answers — bounded, and the leader process
+            # must never be replaced
+            deadline = time.time() + 240
+            out, last_err = None, None
+            while time.time() < deadline:
+                assert leader.poll() is None, "leader process must survive"
+                try:
+                    out = np.asarray(client.generate(ids, max_new_tokens=5))
+                    break
+                except Exception as e:  # degradation window: keep retrying
+                    last_err = e
+                    time.sleep(2.0)
+            assert out is not None, f"serving never resumed: {last_err!r}"
+            np.testing.assert_array_equal(out, want)
+            assert leader.poll() is None, "leader must still be the SAME process"
+        finally:
+            client.close()
     finally:
         stop_multihost_pair(leader, worker)

@@ -98,6 +98,7 @@ def test_full_acceptance_no_duplicates(swarm):
     np.testing.assert_array_equal(out, expected)
 
 
+@pytest.mark.slow
 def test_speculative_model_class(swarm):
     """The model-level API (reference DistributedLlamaForSpeculativeGeneration
     analogue) produces the same tokens as plain greedy."""

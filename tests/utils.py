@@ -213,14 +213,28 @@ def make_tiny_mistral(tmpdir: str, *, n_layers: int = 4, vocab: int = 128, windo
 def multihost_child_env(repo_root: str | None = None) -> dict:
     """Env for multi-host subprocess swarms: CPU-only (any accelerator plugin
     dir is REPLACED out of PYTHONPATH — plugins force-override JAX_PLATFORMS
-    at import time), one virtual device per process."""
+    at import time), one virtual device per process.
+
+    The suite's shared jit compilation cache (tests/conftest.py) is STRIPPED:
+    two jax.distributed processes sharing one on-disk cache can wedge a
+    lockstep group at its first collective (observed: a leader hung >300 s in
+    a trivial forward when earlier swarm tests had populated the dir — likely
+    a partially-written entry from a killed worker). Children pay cold
+    compiles; only the in-process suite shares the cache."""
     root = repo_root or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    return {
+    env = {
         **os.environ,
         "PYTHONPATH": root,
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
     }
+    for var in (
+        "JAX_COMPILATION_CACHE_DIR",
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES",
+    ):
+        env.pop(var, None)
+    return env
 
 
 def spawn_multihost_pair(
@@ -234,9 +248,16 @@ def spawn_multihost_pair(
 ):
     """Start a run_server leader + run_worker pair over a 2-process tp mesh
     and wait for the leader's announce address. Returns (leader_proc,
-    worker_proc, addr); the leader's stdout is drained by a daemon thread
-    after readiness (callers must terminate both). One definition for the
-    multihost tests AND benchmarks — the announce-line protocol lives here."""
+    worker_proc, addr); both stdouts are drained by daemon reader threads
+    from the start (callers must terminate both). One definition for the
+    multihost tests AND benchmarks — the announce-line protocol lives here.
+
+    Readiness is watched through a queue fed by the leader's reader thread,
+    so ``ready_timeout`` is enforced even when the leader stops logging
+    without exiting (e.g. blocked in jax.distributed.initialize because the
+    worker died at startup) — a blocking readline would hang past any
+    deadline there."""
+    import queue as _queue
     import socket
     import subprocess
     import sys
@@ -260,26 +281,43 @@ def spawn_multihost_pair(
          *span, "--host_index", "1", *worker_args],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
+    lines_q: "_queue.Queue[str]" = _queue.Queue()
+    ready = threading.Event()  # once set, the reader discards (pure drain) —
+    # enqueueing for the leader's whole life would grow memory unboundedly
+
+    def read_leader():
+        for line in leader.stdout:
+            if not ready.is_set():
+                lines_q.put(line)
+        lines_q.put("")  # EOF sentinel
+
+    threading.Thread(target=read_leader, daemon=True).start()
+    threading.Thread(  # drain from the start: a full pipe deadlocks the child
+        target=lambda: [None for _ in worker.stdout], daemon=True
+    ).start()
+
     addr, lines = None, []
-    t0 = time.time()
-    while time.time() - t0 < ready_timeout:
-        line = leader.stdout.readline()
-        if not line and leader.poll() is not None:
-            break
+    deadline = time.time() + ready_timeout
+    while time.time() < deadline:
+        try:
+            line = lines_q.get(timeout=min(5.0, max(deadline - time.time(), 0.1)))
+        except _queue.Empty:
+            if leader.poll() is not None:
+                break
+            continue
+        if not line:
+            break  # EOF
         lines.append(line)
         if "announce address:" in line:
             addr = line.rsplit("announce address:", 1)[1].strip()
             break
+    ready.set()
     if not addr:
         for p in (leader, worker):
             p.kill()
         raise RuntimeError(
             "multihost leader never became ready:\n" + "".join(lines[-25:])
         )
-    for proc in (leader, worker):
-        threading.Thread(
-            target=lambda p=proc: [None for _ in p.stdout], daemon=True
-        ).start()
     return leader, worker, addr
 
 
@@ -295,3 +333,81 @@ def stop_multihost_pair(leader, worker, timeout: float = 30.0) -> None:
         worker.wait(timeout=timeout)
     except subprocess.TimeoutExpired:
         worker.kill()
+
+
+async def drive_coalescing_sessions(
+    addr: str,
+    model: str,
+    *,
+    num_blocks: int = 4,
+    n_sessions: int = 4,
+    n_steps: int = 6,
+    prefill: int = 4,
+    concurrent: bool = True,
+    seed: int = 3,
+):
+    """Drive N raw RPC decode sessions against a span leader. When
+    ``concurrent``, each round's sends are all issued BEFORE any reply is
+    awaited, so the leader's lane pool genuinely coalesces — the shared
+    protocol driver for the coalescing test and the multihost batching
+    bench. Returns (elapsed_decode_seconds, ptu.info dict)."""
+    import time as _time
+
+    import numpy as np
+    from transformers import AutoConfig
+
+    from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
+    from petals_tpu.rpc import RpcClient
+    from petals_tpu.rpc.serialization import deserialize_array, serialize_array
+    from petals_tpu.server.server import default_dht_prefix
+
+    hsz = AutoConfig.from_pretrained(model).hidden_size
+    host, port = addr.rsplit("/", 1)[0].rsplit(":", 1)
+    uids = CHAIN_DELIMITER.join(
+        make_uid(default_dht_prefix(model), i) for i in range(num_blocks)
+    )
+    rng = np.random.RandomState(seed)
+    c = await RpcClient.connect(host, int(port))
+    try:
+        streams = []
+        for _ in range(n_sessions):
+            s = await c.open_stream("ptu.inference")
+            await s.send({
+                "uids": uids, "max_length": prefill + n_steps + 8, "batch_size": 1,
+            })
+            await s.recv(timeout=60)
+            await s.send({"tensors": {"hidden": serialize_array(
+                rng.randn(1, prefill, hsz).astype(np.float32) * 0.1)}})
+            await s.recv(timeout=300)
+            streams.append(s)
+        # one UNTIMED decode round per mode: the first coalesced step pays
+        # the batched-program XLA compile, and timing it would bias the
+        # batched-vs-serial ratio toward whichever mode ran second
+        warm = rng.randn(1, 1, hsz).astype(np.float32) * 0.1
+        for s in streams:
+            await s.send({"tensors": {"hidden": serialize_array(warm)}})
+        for s in streams:
+            await s.recv(timeout=300)
+        t0 = _time.perf_counter()
+        if concurrent:
+            for _ in range(n_steps):
+                step = rng.randn(1, 1, hsz).astype(np.float32) * 0.1
+                for s in streams:  # all sends before any recv -> coalescing
+                    await s.send({"tensors": {"hidden": serialize_array(step)}})
+                for s in streams:
+                    out = deserialize_array(
+                        (await s.recv(timeout=300))["tensors"]["hidden"]
+                    )
+                    assert np.isfinite(out).all()
+        else:
+            for s in streams:
+                for _ in range(n_steps):
+                    step = rng.randn(1, 1, hsz).astype(np.float32) * 0.1
+                    await s.send({"tensors": {"hidden": serialize_array(step)}})
+                    deserialize_array((await s.recv(timeout=300))["tensors"]["hidden"])
+        elapsed = _time.perf_counter() - t0
+        for s in streams:
+            await s.end()
+        return elapsed, await c.call("ptu.info", {}, timeout=30)
+    finally:
+        await c.close()
