@@ -57,7 +57,7 @@ def _quant_roundtrip(w32, kind):
     return np.asarray(dequantize(q, jnp.float32))
 
 
-def weight_space_table(kinds=("bf16", "int8", "nf4", "nf4a", "int4"), shape=SHAPE_7B_MLP) -> dict:
+def weight_space_table(kinds=("bf16", "int8", "nf4", "nf4a", "nf4a+o", "int4"), shape=SHAPE_7B_MLP) -> dict:
     table = {}
     sets, _ = _weight_sets(shape)
     for dist, w in sets.items():
@@ -77,7 +77,7 @@ def weight_space_table(kinds=("bf16", "int8", "nf4", "nf4a", "int4"), shape=SHAP
 
 
 def activation_space_table(
-    kinds=("bf16", "int8", "nf4", "nf4a", "int4"), seed=1, shape=SHAPE_7B_MLP
+    kinds=("bf16", "int8", "nf4", "nf4a", "nf4a+o", "int4"), seed=1, shape=SHAPE_7B_MLP
 ) -> dict:
     """Output error of x @ w per format over outlier-channel weights, with
     activation outliers either ALIGNED to the weight outlier channels or on
@@ -200,6 +200,7 @@ def quality_report(include_model_tier: bool = True) -> dict:
         # see benchmarks/on_tunnel_revival.sh step 3b.)
         "serving_default": {
             "4bit": "nf4a",
+            "outlier_option": "nf4a+o",  # +0.25 bits, ~+5-6 dB in the outlier-channel regime
             "uniform_option": "int4",
             "quality_option": "int8",
         },

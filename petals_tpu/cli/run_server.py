@@ -35,7 +35,7 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Alternative to first/num: a range like 0:16")
     parser.add_argument("--torch_dtype", "--dtype", dest="dtype", default="bfloat16",
                         choices=[k for k in DTYPE_MAP if k != "auto"], help="Compute dtype")
-    parser.add_argument("--quant_type", default="none", choices=["none", "int8", "nf4", "nf4a", "int4"],
+    parser.add_argument("--quant_type", default="none", choices=["none", "int8", "nf4", "nf4a", "int4", "nf4a+o", "int4+o"],
                         help="Weight quantization (ops/quant.py)")
     parser.add_argument("--coordinator_address", default=None,
                         help="multi-host serving: jax.distributed coordinator (host:port); "

@@ -37,7 +37,7 @@ def main() -> None:
     parser.add_argument("--num_sp_devices", type=int, default=None,
                         help="sequence-parallel width — MUST match the leader's flag")
     parser.add_argument("--quant_type", default="none",
-                        choices=["none", "int8", "nf4", "nf4a", "int4"])
+                        choices=["none", "int8", "nf4", "nf4a", "int4", "nf4a+o", "int4+o"])
     from petals_tpu.constants import DTYPE_MAP
 
     parser.add_argument("--torch_dtype", "--dtype", dest="dtype", default="bfloat16",

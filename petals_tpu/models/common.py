@@ -47,16 +47,19 @@ ACTIVATIONS = {"silu": silu, "gelu_tanh": gelu_tanh, "gelu": jax.nn.gelu}
 
 def mm(x: jnp.ndarray, w) -> jnp.ndarray:
     """Matmul dispatching on dense / quantized / LoRA-wrapped weights."""
-    from petals_tpu.ops.quant import QuantizedLinear, quant_matmul
+    from petals_tpu.ops.quant import (
+        OutlierQuantLinear,
+        QuantizedLinear,
+        StackedQuantLinear,
+        quant_matmul,
+    )
     from petals_tpu.utils.peft import LoraLinear
-
-    from petals_tpu.ops.quant import StackedQuantLinear
 
     if isinstance(w, LoraLinear):
         base = mm(x, w.base)
         delta = (x @ w.lora_a.astype(x.dtype)) @ w.lora_b.astype(x.dtype)
         return base + delta * w.scaling
-    if isinstance(w, (QuantizedLinear, StackedQuantLinear)):
+    if isinstance(w, (QuantizedLinear, StackedQuantLinear, OutlierQuantLinear)):
         return quant_matmul(x, w)
     return x @ w
 

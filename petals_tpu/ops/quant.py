@@ -119,6 +119,87 @@ class QuantizedLinear:
         return self.data.size * self.data.dtype.itemsize + self.scales.size * self.scales.dtype.itemsize
 
 
+OUTLIER_DIVISOR = 64  # outlier channels kept dense: in_features // 64 (~1.6%)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class OutlierQuantLinear:
+    """A packed 4-bit weight plus its outlier INPUT channels kept dense bf16
+    — the LLM.int8 insight applied at 4 bits (reference convert_block.py:87-96
+    keeps int8 outliers above a magnitude threshold): a block containing one
+    huge weight forces its absmax scale up and crushes the other 63 values,
+    and trained transformers concentrate exactly such outliers in a few input
+    channels. The top in/64 channels by magnitude are zeroed in the packed
+    stream and applied as a small dense side matmul x[..., idx] @ w_out —
+    +0.25 bits/param (4.25 -> 4.5), ~+5-6 dB output SNR in the
+    outlier-channel regime (benchmarks/quant_quality.py), and the packed
+    stream's bandwidth story is untouched.
+
+    ``w_out`` stores the RESIDUAL against the packed stream's decode of the
+    zeroed rows, not the raw rows: int4's code 8 decodes a zeroed row to
+    exactly 0, but nf4a's cubic levels have no zero (nearest ±0.036·scale),
+    so adding the raw row on top of the packed matmul would double-count
+    that decode. With the residual, packed + side == dense for ANY base
+    kind, and the matmul and dequantize paths agree by construction.
+
+    ``inner`` is a QuantizedLinear at serve time (or a StackedQuantLinear
+    view inside the backend's scan body — never flattened there)."""
+
+    inner: QuantizedLinear
+    idx: jnp.ndarray  # int32 [k] sorted outlier input-channel indices
+    w_out: jnp.ndarray  # bf16 [k, out] residual outlier rows (see above)
+
+    def tree_flatten(self):
+        return (self.inner, self.idx, self.w_out), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def kind(self) -> str:
+        return f"{self.inner.kind}+o"
+
+    @property
+    def shape(self):
+        return self.inner.shape
+
+    @property
+    def in_features(self) -> int:
+        return self.inner.in_features
+
+    @property
+    def out_features(self) -> int:
+        return self.inner.out_features
+
+    @property
+    def nbytes(self) -> int:
+        return (
+            self.inner.nbytes
+            + self.idx.size * self.idx.dtype.itemsize
+            + self.w_out.size * self.w_out.dtype.itemsize
+        )
+
+
+def quantize_with_outliers(w: jnp.ndarray, base_kind: str) -> OutlierQuantLinear:
+    """4-bit ``base_kind`` with the top in/64 input channels kept dense (as
+    residuals against the packed decode — see OutlierQuantLinear). The
+    residual needs the inner's decoded rows: one transient full dequantize,
+    the same f32-weight-sized transient the encode itself already makes."""
+    w = jnp.asarray(w)
+    n_in, n_out = w.shape
+    k = max(n_in // OUTLIER_DIVISOR, 1)
+    mags = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=1)
+    _, idx = jax.lax.top_k(mags, k)
+    idx = jnp.sort(idx).astype(jnp.int32)
+    main = w.at[idx].set(0)
+    inner = quantize(main, base_kind)
+    decoded_rows = jnp.take(dequantize(inner, jnp.float32), idx, axis=0)
+    residual = jnp.take(w, idx, axis=0).astype(jnp.float32) - decoded_rows
+    return OutlierQuantLinear(inner, idx, residual.astype(jnp.bfloat16))
+
+
 # ----------------------------------------------------------------------------------
 # Quantize
 # ----------------------------------------------------------------------------------
@@ -218,7 +299,9 @@ def quantize_nf4a(w: jnp.ndarray) -> QuantizedLinear:
     return QuantizedLinear("nf4a", packed, scales, n_in, n_out)
 
 
-def quantize(w: jnp.ndarray, kind: str) -> QuantizedLinear:
+def quantize(w: jnp.ndarray, kind: str):
+    if kind.endswith("+o"):
+        return quantize_with_outliers(w, kind[:-2])
     if kind == "int8":
         return quantize_int8(w)
     if kind == "nf4":
@@ -235,8 +318,16 @@ def quantize(w: jnp.ndarray, kind: str) -> QuantizedLinear:
 # ----------------------------------------------------------------------------------
 
 
-def dequantize(q: QuantizedLinear, dtype=jnp.bfloat16) -> jnp.ndarray:
-    """Reference (XLA) dequantization; handles leading stack axes."""
+def dequantize(q, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Reference (XLA) dequantization; handles leading stack axes.
+    OutlierQuantLinear: 2-D only (the stacked path never materializes it)."""
+    if isinstance(q, OutlierQuantLinear):
+        assert q.inner.data.ndim == 2, "outlier dequantize is per-block (2-D)"
+        deq = dequantize(q.inner, jnp.float32)
+        # ADD the residual (w_out is packed-decode-relative): matches the
+        # serving matmul packed + side exactly, for any base kind
+        deq = deq.at[q.idx].add(q.w_out.astype(jnp.float32))
+        return deq.astype(dtype)
     if q.kind == "int8":
         deq = (q.data.astype(jnp.float32) * q.scales[..., None, :]).astype(dtype)
         if deq.shape[-2] != q.in_features:  # stored padding (see quantize_int8)
@@ -265,6 +356,14 @@ def dequantize(q: QuantizedLinear, dtype=jnp.bfloat16) -> jnp.ndarray:
 def quant_matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     """x @ w where w is dense or QuantizedLinear. Differentiable wrt x (weights
     are frozen server-side, like the reference's quantized blocks)."""
+    if isinstance(w, OutlierQuantLinear):
+        # packed main stream + the dense outlier side matmul; the side term
+        # is x's outlier columns against [k, out] — tiny next to the main
+        # stream (k = in/64), and jnp.take/matmul are differentiable wrt x
+        side = (
+            jnp.take(x, w.idx, axis=-1).astype(jnp.bfloat16) @ w.w_out
+        ).astype(x.dtype)
+        return quant_matmul(x, w.inner) + side
     if isinstance(w, StackedQuantLinear):
         # inference-only fast path (backend scan consts + traced block index);
         # all three quant kinds DMA straight from the stacked bytes; any shape
@@ -964,7 +1063,11 @@ def _round_up(x: int, m: int) -> int:
 # Sizing (reference block_utils.py:22-53)
 # ----------------------------------------------------------------------------------
 
-BITS_PER_PARAM = {"none": 16.0, "int8": 8.25, "nf4": 4.25, "nf4a": 4.25, "int4": 4.25}
+BITS_PER_PARAM = {
+    "none": 16.0, "int8": 8.25, "nf4": 4.25, "nf4a": 4.25, "int4": 4.25,
+    # +o: top in/64 input channels kept dense bf16 (16 bits / 64 rows)
+    "nf4a+o": 4.5, "int4+o": 4.5,
+}
 
 
 def quantized_bytes(n_params: int, kind: str) -> int:

@@ -158,8 +158,19 @@ def shard_span_params(params, mesh, family_name: str, cfg):
     """device_put the stacked params with TP shardings over ``mesh``."""
     import jax
 
-    from petals_tpu.ops.quant import QuantizedLinear
+    from petals_tpu.ops.quant import OutlierQuantLinear, QuantizedLinear
 
+    if any(
+        isinstance(v, OutlierQuantLinear)
+        for v in jax.tree_util.tree_leaves(
+            params, is_leaf=lambda x: isinstance(x, OutlierQuantLinear)
+        )
+    ):
+        raise NotImplementedError(
+            "outlier-augmented quantization ('+o' kinds) does not compose "
+            "with tensor-parallel meshes yet — the outlier side arrays have "
+            "no PartitionSpecs; use the base kind (nf4a/int4) under TP"
+        )
     specs = span_param_pspecs(family_name, cfg)
     validate_tp_divisibility(
         params, mesh, specs,

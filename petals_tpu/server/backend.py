@@ -191,16 +191,49 @@ class TransformerBackend:
 
     @staticmethod
     def _split_quant(params):
-        """Partition span params into (dense-for-scan-xs, quant-for-consts).
-        Only span-stacked 2-D weights ([n_blocks, in//2, out]) take the consts
-        path; mixtral's stacked EXPERT leaves are 4-D and their block code
-        slices experts itself — leave them in the scan xs."""
-        from petals_tpu.ops.quant import QuantizedLinear
+        """Partition span params into (dense-for-scan-xs, quant-for-consts,
+        outlier-leaf names). Only span-stacked 2-D weights ([n_blocks, in//2,
+        out]) take the consts path; mixtral's stacked EXPERT leaves are 4-D
+        and their block code slices experts itself — leave them in the scan
+        xs. Outlier-augmented leaves split: the packed inner rides the consts
+        path (kernel DMAs from the stacked bytes), the tiny idx/w_out side
+        arrays ride the scan xs and are re-attached in the body."""
+        from petals_tpu.ops.quant import OutlierQuantLinear, QuantizedLinear
 
         is_q = lambda x: isinstance(x, QuantizedLinear) and x.data.ndim == 3
-        dense = {k: v for k, v in params.items() if not is_q(v)}
-        quant = {k: v for k, v in params.items() if is_q(v)}
-        return dense, quant
+        dense, quant, outlier_names = {}, {}, set()
+        for k, v in params.items():
+            if isinstance(v, OutlierQuantLinear) and v.inner.data.ndim == 3:
+                quant[k] = v.inner
+                outlier_names.add(k)
+                dense[k + "__oidx"] = v.idx  # [n_blocks, k]
+                dense[k + "__ow"] = v.w_out  # [n_blocks, k, out]
+            elif is_q(v):
+                quant[k] = v
+            else:
+                dense[k] = v
+        return dense, quant, outlier_names
+
+    @staticmethod
+    def _reattach_quant(p_block: dict, quant_params: dict, outlier_names, block_idx):
+        """Rebuild this block's quantized leaves inside a scan body: each
+        consts-path weight becomes a StackedQuantLinear view at ``block_idx``,
+        with outlier side arrays (threaded through the scan xs by
+        _split_quant) re-attached. Shared by the session and lane-pool step
+        programs so the re-attach protocol cannot drift between them."""
+        from petals_tpu.ops.quant import OutlierQuantLinear, StackedQuantLinear
+
+        p_block = dict(p_block)
+        for name, q in quant_params.items():
+            sq = StackedQuantLinear(
+                q.kind, q.data, q.scales, block_idx, q.in_features, q.out_features
+            )
+            if name in outlier_names:
+                sq = OutlierQuantLinear(
+                    sq, p_block.pop(name + "__oidx"), p_block.pop(name + "__ow")
+                )
+            p_block[name] = sq
+        return p_block
 
     @functools.cached_property
     def _inference_step_fn(self):
@@ -212,10 +245,9 @@ class TransformerBackend:
         # decode steps (seq == 1) stay tp-only
         sp_size = self.mesh.shape.get("sp", 1) if self.mesh is not None else 1
         supports_sp = family.supports_ring_attention and sp_size > 1
-        from petals_tpu.ops.quant import StackedQuantLinear
-
         split_quant = self._split_quant
         use_quant_consts = self._use_quant_consts
+        reattach = self._reattach_quant
 
         @functools.partial(
             jax.jit,
@@ -246,7 +278,7 @@ class TransformerBackend:
                 prompt_mask = (pos_in_chunk < pre_seq)[None, :, None]
 
             if use_quant_consts:
-                dense_params, quant_params = split_quant(params)
+                dense_params, quant_params, outlier_names = split_quant(params)
                 n = k_stack.shape[0]
                 scan_xs_params = dense_params
                 block_indices = jnp.arange(n, dtype=jnp.int32)
@@ -257,12 +289,7 @@ class TransformerBackend:
             def body(h, xs):
                 p_block, k_block, v_block, prompt, block_idx = xs
                 if use_quant_consts:
-                    p_block = dict(p_block)
-                    for name, q in quant_params.items():
-                        p_block[name] = StackedQuantLinear(
-                            q.kind, q.data, q.scales, block_idx,
-                            q.in_features, q.out_features,
-                        )
+                    p_block = reattach(p_block, quant_params, outlier_names, block_idx)
                 if with_prompts:
                     seq = h.shape[1]
                     pre = prompt.shape[1]
@@ -308,17 +335,16 @@ class TransformerBackend:
         decode steps are seq==1, so no sp handling is needed here."""
         family, cfg = self.family, self.cfg
         tp_mesh = self.mesh
-        from petals_tpu.ops.quant import StackedQuantLinear
-
         split_quant = self._split_quant
         use_quant_consts = self._use_quant_consts
+        reattach = self._reattach_quant
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
         def step(params, k_pool, v_pool, hidden, positions):
             # hidden: [n_lanes, 1, hidden]; positions: [n_lanes] int32
             hidden = hidden.astype(k_pool.dtype)
             if use_quant_consts:
-                dense_params, quant_params = split_quant(params)
+                dense_params, quant_params, outlier_names = split_quant(params)
                 xs_params = dense_params
                 block_indices = jnp.arange(k_pool.shape[0], dtype=jnp.int32)
             else:
@@ -328,12 +354,7 @@ class TransformerBackend:
             def body(h, xs):
                 p_block, k_block, v_block, block_idx = xs
                 if use_quant_consts:
-                    p_block = dict(p_block)
-                    for name, q in quant_params.items():
-                        p_block[name] = StackedQuantLinear(
-                            q.kind, q.data, q.scales, block_idx,
-                            q.in_features, q.out_features,
-                        )
+                    p_block = reattach(p_block, quant_params, outlier_names, block_idx)
                 out, (k_new, v_new) = family.block_apply(
                     p_block, h, (k_block, v_block), positions, cfg,
                     use_flash=False, tp_mesh=tp_mesh,

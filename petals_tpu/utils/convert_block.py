@@ -25,6 +25,11 @@ class QuantType(str, enum.Enum):
     NF4 = "nf4"  # QLoRA-style 4-bit normal float (gather-bound decode on TPU)
     NF4A = "nf4a"  # NF4-fitted cubic levels, gather-free decode: the 4-bit serving default
     INT4 = "int4"  # blockwise affine 4-bit: uniform levels (ops/quant.py)
+    # +o: top in/64 outlier input channels kept dense bf16 (4.5 bits/param;
+    # ~+5-6 dB output SNR in the outlier-channel regime trained transformers
+    # live in — the reference's int8 outlier threshold, applied at 4 bits)
+    NF4A_O = "nf4a+o"
+    INT4_O = "int4+o"
 
 
 # The big matmul weights of each family (norms/biases/router stay dense).
@@ -100,7 +105,10 @@ def convert_block_params(
             out[name] = quantize(jnp.asarray(leaf), quant_type.value)
             n_quantized += 1
         elif name in quantizable and ndim == 3:  # expert stacks [E, in, out]
-            per_expert = [quantize(jnp.asarray(leaf[e]), quant_type.value) for e in range(leaf.shape[0])]
+            # expert stacks use the BASE kind: the mixtral block slices
+            # experts itself and the outlier side-arrays don't ride that path
+            base = quant_type.value[:-2] if quant_type.value.endswith("+o") else quant_type.value
+            per_expert = [quantize(jnp.asarray(leaf[e]), base) for e in range(leaf.shape[0])]
             out[name] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_expert)
             n_quantized += 1
         else:
@@ -128,9 +136,11 @@ def convert_block_params(
 
 
 def block_size_bytes(params: dict) -> int:
+    from petals_tpu.ops.quant import OutlierQuantLinear
+
     total = 0
     for leaf in params.values():
-        if isinstance(leaf, QuantizedLinear):
+        if isinstance(leaf, (QuantizedLinear, OutlierQuantLinear)):
             total += leaf.nbytes
         else:
             total += leaf.size * leaf.dtype.itemsize

@@ -38,7 +38,7 @@ from typing import Optional
 import jax.numpy as jnp
 import numpy as np
 
-from petals_tpu.ops.quant import QuantizedLinear
+from petals_tpu.ops.quant import OutlierQuantLinear, QuantizedLinear
 from petals_tpu.utils.disk_cache import (
     DEFAULT_CACHE_DIR,
     free_disk_space_for,
@@ -119,7 +119,27 @@ def save_quantized_block(
     manifest = {}
     est_bytes = 0
     for name, leaf in params.items():
-        if isinstance(leaf, QuantizedLinear):
+        if isinstance(leaf, OutlierQuantLinear):
+            data, dtag = _to_numpy(leaf.inner.data)
+            scales, stag = _to_numpy(leaf.inner.scales)
+            idx, itag = _to_numpy(leaf.idx)
+            w_out, wtag = _to_numpy(leaf.w_out)
+            arrays[f"q:{name}:data"] = data
+            arrays[f"q:{name}:scales"] = scales
+            arrays[f"o:{name}:idx"] = idx
+            arrays[f"o:{name}:w"] = w_out
+            est_bytes += data.nbytes + scales.nbytes + idx.nbytes + w_out.nbytes
+            manifest[name] = {
+                "quant": leaf.inner.kind,
+                "outlier": True,
+                "in": leaf.inner.in_features,
+                "out": leaf.inner.out_features,
+                "dtag": dtag,
+                "stag": stag,
+                "wtag": wtag,
+                "itag": itag,
+            }
+        elif isinstance(leaf, QuantizedLinear):
             data, dtag = _to_numpy(leaf.data)
             scales, stag = _to_numpy(leaf.scales)
             arrays[f"q:{name}:data"] = data
@@ -178,13 +198,20 @@ def load_quantized_block(path: Path) -> Optional[dict]:
                 params = {}
                 for name, meta in manifest.items():
                     if "quant" in meta:
-                        params[name] = QuantizedLinear(
+                        q = QuantizedLinear(
                             meta["quant"],
                             _from_numpy(z[f"q:{name}:data"], meta["dtag"]),
                             _from_numpy(z[f"q:{name}:scales"], meta["stag"]),
                             meta["in"],
                             meta["out"],
                         )
+                        if meta.get("outlier"):
+                            q = OutlierQuantLinear(
+                                q,
+                                _from_numpy(z[f"o:{name}:idx"], meta["itag"]),
+                                _from_numpy(z[f"o:{name}:w"], meta["wtag"]),
+                            )
+                        params[name] = q
                     else:
                         params[name] = _from_numpy(z[f"d:{name}"], meta["tag"])
         # touch the eviction unit, not the file: free_disk_space_for ranks

@@ -156,7 +156,7 @@ def test_quant_matmul_grad_flows_to_x():
     )
 
 
-@pytest.mark.parametrize("quant", [QuantType.INT8, QuantType.NF4, QuantType.NF4A, QuantType.INT4])
+@pytest.mark.parametrize("quant", [QuantType.INT8, QuantType.NF4, QuantType.NF4A, QuantType.INT4, QuantType.NF4A_O])
 def test_quantized_block_close_to_dense(quant, tmp_path):
     from petals_tpu.server.from_pretrained import get_block_config, load_block_params
     from tests.utils import make_tiny_llama
@@ -171,11 +171,11 @@ def test_quantized_block_close_to_dense(quant, tmp_path):
     dense_out, _ = family.block_apply(params, hidden, None, 0, cfg)
     quant_out, _ = family.block_apply(qparams, hidden, None, 0, cfg)
     err = np.abs(np.asarray(quant_out) - np.asarray(dense_out)).max()
-    bound = {QuantType.NF4: 0.2, QuantType.NF4A: 0.2, QuantType.INT4: 0.3, QuantType.INT8: 0.05}[quant]
+    bound = {QuantType.NF4: 0.2, QuantType.NF4A: 0.2, QuantType.INT4: 0.3, QuantType.INT8: 0.05, QuantType.NF4A_O: 0.2}[quant]
     assert err < bound, f"{quant}: err {err}"
 
 
-@pytest.mark.parametrize("quant", ["nf4", "nf4a", "int4"])
+@pytest.mark.parametrize("quant", ["nf4", "nf4a", "nf4a+o", "int4"])
 def test_quantized_server_generates(quant, tmp_path):
     """4-bit servers serve a session end-to-end (reference CI quantized-server
     coverage); greedy tokens may differ from f32 HF — assert mechanics."""
@@ -315,3 +315,39 @@ def test_nf4a_matches_nf4_quality():
             return 10 * np.log10(1.0 / rel)
 
         assert snr(quantize_nf4a(w)) >= snr(quantize_nf4(w)) - 0.1
+
+
+def test_outlier_quant_recovers_outlier_channels():
+    """'+o': the top input channels by magnitude are exact (dense bf16) and
+    the packed stream's blocks are no longer crushed by them — SNR in the
+    outlier-channel regime beats the plain base kind by several dB, at
+    ~4.5 bits/param."""
+    from petals_tpu.ops.quant import (
+        OUTLIER_DIVISOR,
+        OutlierQuantLinear,
+        quantize,
+    )
+
+    rng = np.random.RandomState(3)
+    w = (rng.randn(512, 256) * 0.02).astype(np.float32)
+    hot = rng.choice(512, size=512 // 128, replace=False)
+    w[hot] *= 25.0  # outlier input channels (LLM.int8 regime)
+
+    def snr(dq):
+        rel = np.square(dq - w).mean() / np.square(w).mean()
+        return 10 * np.log10(1.0 / rel)
+
+    plain = snr(np.asarray(dequantize(quantize(jnp.asarray(w), "nf4a"), jnp.float32)))
+    q = quantize(jnp.asarray(w), "nf4a+o")
+    assert isinstance(q, OutlierQuantLinear) and q.kind == "nf4a+o"
+    assert q.idx.shape == (512 // OUTLIER_DIVISOR,)
+    with_o = snr(np.asarray(dequantize(q, jnp.float32)))
+    assert with_o >= plain + 3.0, (plain, with_o)
+    # every hot channel must be among the kept outliers (exact rows)
+    kept = set(np.asarray(q.idx).tolist())
+    assert set(hot.tolist()) <= kept
+    # matmul path agrees with the dequantized reference
+    x = rng.randn(4, 512).astype(np.float32) * 0.1
+    got = np.asarray(quant_matmul(jnp.asarray(x), q))
+    want = x @ np.asarray(dequantize(q, jnp.float32))
+    np.testing.assert_allclose(got, want, atol=5e-2, rtol=1e-2)

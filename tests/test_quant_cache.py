@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from petals_tpu.ops.quant import QuantizedLinear
+from petals_tpu.ops.quant import OutlierQuantLinear, QuantizedLinear
 from petals_tpu.server.from_pretrained import load_block_params
 from petals_tpu.utils import quant_cache
 from petals_tpu.utils.convert_block import convert_block_params
@@ -17,6 +17,14 @@ def _tree_equal(a: dict, b: dict):
     assert sorted(a) == sorted(b)
     for name in a:
         la, lb = a[name], b[name]
+        if isinstance(la, OutlierQuantLinear):
+            assert isinstance(lb, OutlierQuantLinear)
+            np.testing.assert_array_equal(np.asarray(la.idx), np.asarray(lb.idx))
+            np.testing.assert_array_equal(
+                np.asarray(la.w_out, np.float32), np.asarray(lb.w_out, np.float32)
+            )
+            la, lb = la.inner, lb.inner
+            assert isinstance(lb, QuantizedLinear)
         if isinstance(la, QuantizedLinear):
             assert isinstance(lb, QuantizedLinear)
             assert la.kind == lb.kind
@@ -33,7 +41,7 @@ def _tree_equal(a: dict, b: dict):
             )
 
 
-@pytest.mark.parametrize("quant", ["nf4", "int4", "int8"])
+@pytest.mark.parametrize("quant", ["nf4", "int4", "int8", "nf4a+o"])
 def test_roundtrip_bit_exact(tmp_path, quant):
     model = make_tiny_llama(str(tmp_path / "model"))
     params = convert_block_params(
