@@ -38,6 +38,14 @@ if not os.environ.get("PETALS_TPU_TEST_NO_SHARED_JIT_CACHE"):
         os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
         os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "0"
 
+    # memoize tiny-model builds the same way (tests/utils._model_build_cache):
+    # dozens of module fixtures rebuild identical torch checkpoints at ~1-2 s
+    # each; the cache turns repeats into a copytree
+    if not os.environ.get("PETALS_TPU_TEST_MODEL_CACHE"):
+        _model_cache_dir = tempfile.mkdtemp(prefix="ptu-test-model-cache-")
+        atexit.register(shutil.rmtree, _model_cache_dir, ignore_errors=True)
+        os.environ["PETALS_TPU_TEST_MODEL_CACHE"] = _model_cache_dir
+
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()

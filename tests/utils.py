@@ -1,12 +1,55 @@
 """Tiny random HF checkpoints saved to disk — the test swarm's "models"
 (zero-egress stand-in for the reference CI's bloom-560m / TinyLlama downloads,
-reference .github/workflows/run-tests.yaml:10-20)."""
+reference .github/workflows/run-tests.yaml:10-20).
 
+Builds are memoized per pytest RUN: constructing + saving a torch model costs
+~1-2 s and the suite requests the same handful of configurations from dozens
+of module fixtures. The first build lands in a shared per-run cache dir and
+later requests copy the saved files into the caller's tmpdir (~ms) — callers
+still own a private, mutable checkpoint (several tests edit theirs)."""
+
+import functools
 import os
+import shutil
 
 import torch
 
 
+def _model_build_cache(builder):
+    """Memoize a make_tiny_*(tmpdir, **kw) builder: build once per kwargs
+    into the shared cache, then copy into each caller's tmpdir."""
+
+    @functools.wraps(builder)
+    def wrapped(tmpdir: str, **kwargs) -> str:
+        cache_root = os.environ.get("PETALS_TPU_TEST_MODEL_CACHE")
+        if not cache_root:
+            return builder(tmpdir, **kwargs)
+        key = builder.__name__ + "--" + "-".join(
+            f"{k}={kwargs[k]}" for k in sorted(kwargs)
+        )
+        cached = os.path.join(cache_root, key)
+        if not os.path.isdir(cached):
+            # builders return <tmpdir>/<model-name>; build under a pid-unique
+            # dir and atomically rename onto the key — concurrent processes
+            # (subprocess swarms share the env) may race, and the loser just
+            # keeps the winner's identical bytes (deterministic seeds)
+            build_dir = os.path.join(cache_root, f"{key}.build.{os.getpid()}")
+            built = builder(build_dir, **kwargs)
+            try:
+                os.rename(built, cached)
+            except OSError:
+                pass  # another process won the race
+            shutil.rmtree(build_dir, ignore_errors=True)
+        want = os.path.join(tmpdir, os.path.basename(cached))
+        if not os.path.isdir(want):
+            os.makedirs(tmpdir, exist_ok=True)
+            shutil.copytree(cached, want)
+        return want
+
+    return wrapped
+
+
+@_model_build_cache
 def make_tiny_llama(
     tmpdir: str, *, n_layers: int = 4, vocab: int = 128, biased: bool = False,
     kv_heads: int = 2,
@@ -39,6 +82,7 @@ def make_tiny_llama(
     return path
 
 
+@_model_build_cache
 def make_tiny_llama_cls(
     tmpdir: str, *, n_layers: int = 4, vocab: int = 128, num_labels: int = 3
 ) -> str:
@@ -64,6 +108,7 @@ def make_tiny_llama_cls(
     return path
 
 
+@_model_build_cache
 def make_tiny_bloom_cls(
     tmpdir: str, *, n_layers: int = 3, vocab: int = 128, num_labels: int = 3
 ) -> str:
@@ -85,6 +130,7 @@ def make_tiny_bloom_cls(
     return path
 
 
+@_model_build_cache
 def make_tiny_bloom(tmpdir: str, *, n_layers: int = 3, vocab: int = 128) -> str:
     from transformers import BloomConfig, BloomForCausalLM
 
@@ -103,6 +149,7 @@ def make_tiny_bloom(tmpdir: str, *, n_layers: int = 3, vocab: int = 128) -> str:
     return path
 
 
+@_model_build_cache
 def make_tiny_falcon(tmpdir: str, *, variant: str = "new", n_layers: int = 3, vocab: int = 128) -> str:
     """variant: "new" (40b-style GQA dual-LN), "7b" (MQA parallel), "rw" (MHA alibi serial)."""
     from transformers import FalconConfig, FalconForCausalLM
@@ -138,6 +185,7 @@ def make_tiny_falcon(tmpdir: str, *, variant: str = "new", n_layers: int = 3, vo
     return path
 
 
+@_model_build_cache
 def make_tiny_mixtral(tmpdir: str, *, n_layers: int = 2, vocab: int = 128) -> str:
     from transformers import MixtralConfig, MixtralForCausalLM
 
@@ -160,6 +208,7 @@ def make_tiny_mixtral(tmpdir: str, *, n_layers: int = 2, vocab: int = 128) -> st
     return path
 
 
+@_model_build_cache
 def make_tiny_qwen2(tmpdir: str, *, n_layers: int = 4, vocab: int = 128, tied: bool = True) -> str:
     from transformers import Qwen2Config, Qwen2ForCausalLM
 
@@ -187,6 +236,7 @@ def make_tiny_qwen2(tmpdir: str, *, n_layers: int = 4, vocab: int = 128, tied: b
     return path
 
 
+@_model_build_cache
 def make_tiny_mistral(tmpdir: str, *, n_layers: int = 4, vocab: int = 128, window: int = 6) -> str:
     from transformers import MistralConfig, MistralForCausalLM
 
