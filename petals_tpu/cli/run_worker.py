@@ -85,29 +85,36 @@ def main() -> None:
         )
         return convert_block_params(params, family.name, args.quant_type, fuse=False)
 
-    per_block = [
-        load_block(i) for i in range(args.first_block, args.first_block + args.num_blocks)
-    ]
-    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
     mesh = multihost_mesh(args.num_tp_devices, args.num_sp_devices or 1)
-    backend = TransformerBackend(
-        family, cfg, stacked,
-        first_block=args.first_block,
-        n_blocks=args.num_blocks,
-        memory_cache=MemoryCache(None),
-        compute_dtype=dtype,
-        max_chunk_size_bytes=args.max_chunk_size_bytes,
-        mesh=mesh,
-    )
-    if args.adapters:
-        from petals_tpu.utils.peft import load_adapter, stack_adapter
 
-        block_range = range(args.first_block, args.first_block + args.num_blocks)
-        for path in args.adapters:
-            adapter = load_adapter(path, family.name, block_range=block_range)
-            stacked_a = stack_adapter(adapter, args.first_block, args.num_blocks, dtype)
-            backend.adapters[adapter.name] = (stacked_a, adapter.scaling)
-        logger.info(f"worker hosting adapters: {sorted(backend.adapters)}")
+    def build_backend(first_block: int) -> TransformerBackend:
+        """Initial build AND the live-span-move rebuild (OP_RELOAD_SPAN):
+        adapters re-slice for the new span like the leader's reload does."""
+        per_block = [
+            load_block(i) for i in range(first_block, first_block + args.num_blocks)
+        ]
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_block)
+        backend = TransformerBackend(
+            family, cfg, stacked,
+            first_block=first_block,
+            n_blocks=args.num_blocks,
+            memory_cache=MemoryCache(None),
+            compute_dtype=dtype,
+            max_chunk_size_bytes=args.max_chunk_size_bytes,
+            mesh=mesh,
+        )
+        if args.adapters:
+            from petals_tpu.utils.peft import load_adapter, stack_adapter
+
+            block_range = range(first_block, first_block + args.num_blocks)
+            for path in args.adapters:
+                adapter = load_adapter(path, family.name, block_range=block_range)
+                stacked_a = stack_adapter(adapter, first_block, args.num_blocks, dtype)
+                backend.adapters[adapter.name] = (stacked_a, adapter.scaling)
+            logger.info(f"worker hosting adapters: {sorted(backend.adapters)}")
+        return backend
+
+    backend = build_backend(args.first_block)
 
     logger.info(
         f"worker {args.host_index}/{args.num_hosts}: span "
@@ -115,7 +122,7 @@ def main() -> None:
         f"tp={mesh.shape['tp']}"
         + (f" x sp={mesh.shape['sp']}" if "sp" in mesh.shape else "")
     )
-    LockstepWorker(backend).run()
+    LockstepWorker(backend, rebuild_fn=build_backend).run()
 
 
 if __name__ == "__main__":

@@ -60,8 +60,11 @@ Sequence parallelism crosses hosts too (round 5): the serving mesh can be
 stateless path's ring attention then run their sp collectives between
 processes, because every process enters the same jitted program anyway.
 
-Remaining v1 limit: live rebalancing (a span move would strand the workers'
-shards).
+Live rebalancing works too (v4, round 5): a span move is OP_RELOAD_SPAN —
+leader and workers rebuild from the checkpoint simultaneously (the sharded
+param device_puts pair like at startup, under the broadcast lock), after the
+leader quiesces sessions (park for migration + queue barrier). No process
+restarts; the reference restarts its whole server to move blocks.
 """
 
 from __future__ import annotations
@@ -92,6 +95,13 @@ OP_IMPORT_KV = 7  # v2: seed a KV mirror from an exported prefix
 OP_BATCHED_DECODE = 8
 OP_LANE_EXTRACT = 9
 OP_LANE_INSERT = 10
+# v4 (round 5): LIVE REBALANCING for lockstep groups. A span move is a
+# lockstep op like any other: the leader broadcasts the new first block and
+# every process rebuilds its backend from the checkpoint SIMULTANEOUSLY (the
+# sharded param device_puts are collectives that must pair, exactly like at
+# startup). The leader runs the whole reload while holding the broadcast
+# lock, so no ALLOC/FREE/compute collective can interleave with the rebuild.
+OP_RELOAD_SPAN = 11
 
 _HEADER_LEN = 14
 _FLAG_PROMPTS = 1
@@ -285,10 +295,23 @@ class LockstepBackend(_LockstepMixin):
     # handler gates sub-span wrapping and KV export/import on this
     is_lockstep = True
 
-    def __init__(self, backend, *, span: Tuple[int, int] = None):
+    def __init__(self, backend, *, span: Tuple[int, int] = None, retired_state=None):
         self._backend = backend
         self._span = span or (0, backend.n_blocks)
         self._replicate = self._replicate_fn(backend.mesh)
+        # shared across sub-views: a live span move (reload_span) RETIRES the
+        # old wrapper — sessions that captured it at open must fail their next
+        # op per-request (client failover) instead of broadcasting against
+        # worker mirrors the reload cleared, which would KeyError the worker
+        # loop and degrade the whole group
+        self._retired_state = retired_state if retired_state is not None else {"retired": False}
+
+    def _check_live(self) -> None:
+        if self._retired_state["retired"]:
+            raise RuntimeError(
+                "This span was moved by a live rebalance; the session's server-"
+                "side state is gone — re-open through routing (client failover)"
+            )
 
     def __getattr__(self, name):
         return getattr(self._backend, name)
@@ -296,7 +319,10 @@ class LockstepBackend(_LockstepMixin):
     def sub_view(self, backend_slice, start: int, end: int) -> "LockstepBackend":
         """Lockstep view over a partial chain (handler._sub_backend)."""
         base = self._span[0]
-        return LockstepBackend(backend_slice, span=(base + start, base + end))
+        return LockstepBackend(
+            backend_slice, span=(base + start, base + end),
+            retired_state=self._retired_state,
+        )
 
     def _adapter_code(self, active_adapter) -> int:
         """Adapters cross the control plane as 1-based indices into the SORTED
@@ -317,6 +343,7 @@ class LockstepBackend(_LockstepMixin):
 
     def inference_step(self, hidden, kv, position, *, prompts=None, hypo_ids=None,
                        active_adapter=None, handles=None):
+        self._check_live()
         adapter_code = self._adapter_code(active_adapter)
         batch, seq, _ = hidden.shape
         flags = (_FLAG_PROMPTS if prompts is not None else 0) | (
@@ -347,6 +374,7 @@ class LockstepBackend(_LockstepMixin):
             return self._replicate(out), new_kv
 
     def forward(self, hidden, *, prompts=None, active_adapter=None):
+        self._check_live()
         adapter_code = self._adapter_code(active_adapter)
         batch, seq, _ = hidden.shape
         flags = _FLAG_PROMPTS if prompts is not None else 0
@@ -367,6 +395,7 @@ class LockstepBackend(_LockstepMixin):
             )
 
     def backward(self, hidden, grad_out, *, prompts=None, active_adapter=None):
+        self._check_live()
         adapter_code = self._adapter_code(active_adapter)
         batch, seq, _ = hidden.shape
         flags = _FLAG_PROMPTS if prompts is not None else 0
@@ -400,6 +429,7 @@ class LockstepBackend(_LockstepMixin):
         (server/batching.py flush loop). ``handles`` carries the pool's
         mirror handle; hidden/positions broadcast, every process steps its
         shards of the pool."""
+        self._check_live()
         n_lanes = int(hidden.shape[0])
         with _BCAST_LOCK, _degrade_on_failure():
             _bcast_header([OP_BATCHED_DECODE, int(handles[0]), n_lanes])
@@ -417,6 +447,7 @@ class LockstepBackend(_LockstepMixin):
         session-shaped copy under the synthetic ``temp_handle`` mirror so
         subsequent exclusive ops (inference steps, imports, exports) can
         address it like any session KV."""
+        self._check_live()
         with _BCAST_LOCK, _degrade_on_failure():
             _bcast_header([OP_LANE_EXTRACT, int(pool_handle), int(lane), int(temp_handle)])
             return self._backend._lane_extract_fn(k_pool, v_pool, np.int32(lane))
@@ -424,6 +455,7 @@ class LockstepBackend(_LockstepMixin):
     def lane_insert(self, k_pool, v_pool, kv_lane, lane: int, *, pool_handle: int, temp_handle: int):
         """Check a lane back IN on every process; workers consume (pop) their
         ``temp_handle`` mirror. Returns the leader's new pool buffers."""
+        self._check_live()
         k2, v2 = kv_lane
         with _BCAST_LOCK, _degrade_on_failure():
             _bcast_header([OP_LANE_INSERT, int(pool_handle), int(lane), int(temp_handle)])
@@ -454,6 +486,7 @@ class LockstepBackend(_LockstepMixin):
         collective degrades the group."""
         import time
 
+        self._check_live()
         for attempt in range(40):
             with _BCAST_LOCK:
                 _check_group()
@@ -478,6 +511,7 @@ class LockstepBackend(_LockstepMixin):
         """Seed a session's KV mirror from an exported prefix: the prefix is
         broadcast once and every process materializes its own shards of the
         full buffer. Returns the leader's new (k, v) global arrays."""
+        self._check_live()
         shape = tuple(k_prefix.shape)
         with _BCAST_LOCK, _degrade_on_failure():
             _bcast_header([
@@ -490,6 +524,23 @@ class LockstepBackend(_LockstepMixin):
                 self._backend, k_prefix, v_prefix, position,
                 batch_size, max_length, n_blocks,
             )
+
+    def reload_span(self, new_first_block: int, build_backend) -> "LockstepBackend":
+        """LIVE SPAN MOVE (v4): broadcast the new first block and rebuild
+        leader + workers in lockstep. ``build_backend()`` is the leader's
+        synchronous rebuild (load + convert + shard); it runs UNDER the
+        broadcast lock so its sharded-param collectives pair with the
+        workers' identical rebuild and nothing else can interleave. Callers
+        must have quiesced session compute first (drain + queue barrier) —
+        an op referencing the old span's mirrors after the swap would find
+        nothing. Returns the new leader-side lockstep wrapper."""
+        with _BCAST_LOCK, _degrade_on_failure():
+            self._retired_state["retired"] = True  # fence BEFORE the swap:
+            # a straggler session op must fail per-request, never broadcast
+            # against the mirrors the reload is about to clear
+            _bcast_header([OP_RELOAD_SPAN, int(new_first_block)])
+            backend = build_backend()
+        return LockstepBackend(backend)
 
     def shutdown_workers(self) -> None:
         if _GROUP_STATE["degraded"] is not None:
@@ -549,10 +600,16 @@ class LockstepMemoryCache:
 
 class LockstepWorker:
     """Non-leader process: mirrors allocations and executes the leader's
-    compute ops in lockstep until OP_SHUTDOWN."""
+    compute ops in lockstep until OP_SHUTDOWN.
 
-    def __init__(self, backend):
+    ``rebuild_fn(new_first_block) -> TransformerBackend`` enables live span
+    moves (OP_RELOAD_SPAN): the worker rebuilds its backend from the
+    checkpoint in lockstep with the leader. Without it a reload op degrades
+    the group (restart-to-move, the pre-v4 behavior)."""
+
+    def __init__(self, backend, rebuild_fn=None):
         self.backend = backend
+        self.rebuild_fn = rebuild_fn
         self._kv: Dict[int, Tuple] = {}
         self._subs: Dict[Tuple[int, int], object] = {}
         self._replicate = _LockstepMixin()._replicate_fn(backend.mesh)
@@ -642,6 +699,27 @@ class LockstepWorker:
                 self._kv[mirror] = _stage_kv_mirror(
                     self.backend, k_prefix, v_prefix, position, batch, max_len, n
                 )
+                continue
+            if op == OP_RELOAD_SPAN:
+                # [op, new_first_block]: rebuild for the new span IN LOCKSTEP
+                # with the leader (the sharded param device_puts pair up).
+                # Old session mirrors die with the old span.
+                _, new_first = header[:2]
+                if self.rebuild_fn is None:
+                    raise RuntimeError(
+                        "leader requested a live span move but this worker "
+                        "has no rebuild_fn — restart the group to move spans"
+                    )
+                logger.info(f"multihost worker: live span move to first_block={new_first}")
+                self._kv.clear()
+                self._subs.clear()
+                # release the OLD span's params BEFORE loading the new ones:
+                # keeping both resident would double peak device memory and
+                # OOM moves on exactly the hosts sized to their span
+                self.backend = None
+                self._replicate = None
+                self.backend = self.rebuild_fn(int(new_first))
+                self._replicate = _LockstepMixin()._replicate_fn(self.backend.mesh)
                 continue
             if op == OP_BATCHED_DECODE:
                 # [op, pool_h, n_lanes]: step every lane of the pool mirror

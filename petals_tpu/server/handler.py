@@ -125,6 +125,31 @@ class TransformerHandler:
 
             self.prefix_cache = PrefixCache(prefix_cache_bytes)
 
+    async def swap_backend(self, new_backend) -> None:
+        """Retarget the handler at a freshly built backend (span reload /
+        rebalance). Private sessions opened on the old span keep computing
+        against the old backend object (captured at session open) until they
+        close; POOLED sessions cannot — the lane pool is shared — so the old
+        batcher is closed (its tenants' next step fails loudly and clients
+        failover, the same recovery path as a pool reset) and a fresh pool
+        opens lazily for the new span. Without this swap the old batcher
+        kept serving the NEW span's pooled decode steps with the OLD span's
+        weights — silently wrong outputs after every rebalance."""
+        self.backend = new_backend
+        self._sub_backends = {}
+        if self.batcher is not None:
+            from petals_tpu.server.batching import DecodeBatcher
+
+            old = self.batcher
+            self.batcher = DecodeBatcher(
+                new_backend,
+                self.memory_cache,
+                self.queue,
+                n_lanes=old.n_lanes,
+                max_length=old.max_length,
+            )
+            await old.close()
+
     def register(self, server: RpcServer) -> None:
         server.add_unary_handler("ptu.forward", self.rpc_forward)
         server.add_unary_handler("ptu.backward", self.rpc_backward)
@@ -240,21 +265,26 @@ class TransformerHandler:
         return new_position
 
     @contextlib.asynccontextmanager
-    async def _lane_ctx(self, lane: int):
+    async def _lane_ctx(self, lane: int, batcher):
         """Session-lifetime scope of a borrowed pool lane (yields None in the
-        position of the private path's cache handles)."""
+        position of the private path's cache handles). ``batcher`` is the
+        pool the lane was acquired from, captured at session open — after a
+        live span move self.batcher is a NEW pool whose lane indices alias
+        other tenants, so releasing (or stepping) through it would corrupt
+        them."""
         try:
             yield None
         finally:
-            self.batcher.release_lane(lane)
+            batcher.release_lane(lane)
 
     async def _install_kv_import_pooled(
-        self, step, lane: int, position, *, batch_size: int, n_blocks: int, max_length: int
+        self, step, lane: int, position, *, batch_size: int, n_blocks: int, max_length: int,
+        batcher,
     ) -> int:
         """Seed a pooled session's lane from another server's exported cache
         (validation here; the staging is shared with the prefix-cache hit
         path in _seed_session_kv)."""
-        backend = self.batcher.backend
+        backend = batcher.backend
         if position != 0:
             raise ValueError("kv_import must be the first step of a session")
         new_position = int(step["kv_import"]["position"])
@@ -277,13 +307,13 @@ class TransformerHandler:
         arr_v = await asyncio.to_thread(parse, "v", tensors["v"])
         await self._seed_session_kv(
             lane, None, None, arr_k, arr_v, new_position,
-            batch_size=batch_size, n_blocks=n_blocks,
+            batch_size=batch_size, n_blocks=n_blocks, batcher=batcher,
         )
         return new_position
 
     async def _seed_session_kv(
         self, lane, kv, handles, k_arr, v_arr, new_position: int,
-        *, batch_size: int, n_blocks: int,
+        *, batch_size: int, n_blocks: int, batcher=None,
     ):
         """Install k/v prefix rows [0, new_position) into a FRESH session's
         cache (pooled lane or private buffers) — the prefix-cache hit path.
@@ -292,7 +322,7 @@ class TransformerHandler:
         import jax.numpy as jnp
 
         if lane is not None:
-            backend0 = self.batcher.backend
+            backend0 = batcher.backend
             if getattr(backend0, "is_lockstep", False):
                 # multihost pooled session: broadcast the prefix and let every
                 # process shard its own lane-shaped mirror (v2 import op on
@@ -300,16 +330,16 @@ class TransformerHandler:
                 def replace_lockstep(kv_lane, lane_handles):
                     return None, backend0.import_kv(
                         lane_handles, k_arr, v_arr, new_position,
-                        batch_size, self.batcher.max_length, n_blocks,
+                        batch_size, batcher.max_length, n_blocks,
                     )
 
                 # extract=False: the import REPLACES the lane wholesale, so
                 # checking the old content out first would waste a full-lane
                 # device copy on every process
-                await self.batcher.run_exclusive(lane, replace_lockstep, extract=False)
+                await batcher.run_exclusive(lane, replace_lockstep, extract=False)
                 return kv
             lane_shape = (
-                n_blocks, batch_size, self.batcher.max_length,
+                n_blocks, batch_size, batcher.max_length,
                 backend0.num_kv_heads, backend0.head_dim,
             )
             cache_dtype = jnp.dtype(backend0.cache_dtype)
@@ -325,7 +355,7 @@ class TransformerHandler:
             def replace(kv_lane, lane_handles):
                 return None, (jnp.asarray(new_k), jnp.asarray(new_v))
 
-            await self.batcher.run_exclusive(lane, replace, extract=False)
+            await batcher.run_exclusive(lane, replace, extract=False)
             return kv
 
         k_buf, v_buf = kv
@@ -355,7 +385,8 @@ class TransformerHandler:
         return (new_k, new_v)
 
     async def _store_prefix_async(
-        self, keys, n_hit: int, boundary: int, lane, handles, out_full, n_blocks: int
+        self, keys, n_hit: int, boundary: int, lane, handles, out_full, n_blocks: int,
+        batcher=None,
     ) -> None:
         """Snapshot KV rows [0, boundary) and store the freshly computed
         segments. Runs as a task after the prefill reply; the session loop
@@ -364,7 +395,7 @@ class TransformerHandler:
         rollback later cannot poison the mapping)."""
         try:
             if lane is not None:
-                k, v = await self.batcher.snapshot_lane(lane, boundary, 0, n_blocks)
+                k, v = await batcher.snapshot_lane(lane, boundary, 0, n_blocks)
             elif getattr(self.backend, "is_lockstep", False):
                 # multihost: per-shard all_gather (v2 export op), bounded to
                 # the 128-bucketed boundary inside export_kv
@@ -414,7 +445,7 @@ class TransformerHandler:
             # register handles=None, so the private export below would crash.
             n = reg["end"] - reg["start"]
             position = reg["position"]
-            k, v = await self.batcher.snapshot_lane(
+            k, v = await (reg.get("batcher") or self.batcher).snapshot_lane(
                 reg["lane"], position, b0 if b0 is not None else 0,
                 b1 if b1 is not None else n,
             )
@@ -697,21 +728,26 @@ class TransformerHandler:
 
         # Continuous batching: single-stream full-span sessions borrow a lane
         # of the shared pool and decode coalesced with their neighbors; every
-        # other shape gets the classic private cache.
+        # other shape gets the classic private cache. The batcher is captured
+        # ONCE (like ``backend``): a live span move swaps self.batcher for a
+        # new pool whose lane indices alias other tenants — this session must
+        # keep stepping/releasing through the pool it acquired from (whose
+        # close() fails it loudly into the failover path).
         lane: Optional[int] = None
+        batcher = self.batcher
         if (
-            self.batcher is not None
+            batcher is not None
             and batch_size == 1
             and active_adapter is None
             and start == 0
             and end == self.backend.n_blocks
-            and max_length <= self.batcher.max_length
+            and max_length <= batcher.max_length
         ):
             from petals_tpu.server.memory_cache import AllocationFailed
 
             alloc_timeout = open_msg.get("alloc_timeout")
             try:
-                lane = await self.batcher.acquire_lane(
+                lane = await batcher.acquire_lane(
                     timeout=30.0 if alloc_timeout is None else alloc_timeout
                 )
             except AllocationFailed as e:
@@ -719,7 +755,7 @@ class TransformerHandler:
 
         push_queue: Optional[asyncio.Queue] = None
         if lane is not None:
-            cache_ctx = self._lane_ctx(lane)
+            cache_ctx = self._lane_ctx(lane, batcher)
         else:
             descriptors = backend.cache_descriptors(batch_size, max_length, 0, end - start)
             cache_ctx = self.memory_cache.allocate_cache(
@@ -738,7 +774,7 @@ class TransformerHandler:
                 push_queue = asyncio.Queue(maxsize=64)
                 self._push_queues[session_id] = push_queue
                 reg = {
-                    "handles": handles, "lane": lane, "position": 0,
+                    "handles": handles, "lane": lane, "batcher": batcher, "position": 0,
                     "start": self.backend.first_block + start,
                     "end": self.backend.first_block + end,
                     "batch_size": batch_size, "max_length": max_length,
@@ -792,7 +828,7 @@ class TransformerHandler:
                         position = await self._install_kv_import_pooled(
                             step, lane, position,
                             batch_size=batch_size, n_blocks=end - start,
-                            max_length=max_length,
+                            max_length=max_length, batcher=batcher,
                         )
                     else:
                         position = await self._install_kv_import(
@@ -873,6 +909,7 @@ class TransformerHandler:
                             kv = await self._seed_session_kv(
                                 lane, kv, handles, k_pre, v_pre, hit_len,
                                 batch_size=batch_size, n_blocks=end - start,
+                                batcher=batcher,
                             )
                             exec_hidden = hidden[:, hit_len:]
                             pos = hit_len
@@ -889,7 +926,7 @@ class TransformerHandler:
                         # the continuous-batching hot path: one token, coalesced
                         # with whatever other sessions are stepping right now
                         out = await asyncio.wait_for(
-                            self.batcher.step(lane, hidden, pos), self.step_timeout
+                            batcher.step(lane, hidden, pos), self.step_timeout
                         )
                     elif lane is not None and prompts is None and hypo_ids is None:
                         # pooled long prefill: each chunk is its OWN queue
@@ -899,7 +936,7 @@ class TransformerHandler:
                         chunk_fns = []
                         off = 0
                         for clen in backend.chunk_plan(
-                            batch_size, exec_hidden.shape[1], kv_buf_len=self.batcher.max_length
+                            batch_size, exec_hidden.shape[1], kv_buf_len=batcher.max_length
                         ):
                             chunk = exec_hidden[:, off : off + clen]
                             chunk_pos = pos + off
@@ -916,7 +953,7 @@ class TransformerHandler:
                             chunk_fns.append(run_chunk)
                             off += clen
                         outs = await asyncio.wait_for(
-                            self.batcher.run_exclusive_chunks(
+                            batcher.run_exclusive_chunks(
                                 lane, chunk_fns, size=batch_size * exec_hidden.shape[1]
                             ),
                             self.step_timeout,
@@ -935,7 +972,7 @@ class TransformerHandler:
                             return np.asarray(out), new_kv
 
                         out = await asyncio.wait_for(
-                            self.batcher.run_exclusive(
+                            batcher.run_exclusive(
                                 lane, run_lane, size=batch_size * seq
                             ),
                             self.step_timeout,
@@ -994,6 +1031,7 @@ class TransformerHandler:
                             self._store_prefix_async(
                                 pc_keys, pc_hits, len(pc_keys) * SEGMENT_TOKENS,
                                 lane, handles, np.asarray(out), end - start,
+                                batcher=batcher,
                             )
                         )
                 position += seq

@@ -104,11 +104,6 @@ class Server:
                     "multi-host serving needs an explicit --first_block/--num_blocks "
                     "(workers load the identical span; auto-placement would desync them)"
                 )
-            if mean_balance_check_period:
-                raise ValueError(
-                    "live rebalancing is not supported with multi-host serving "
-                    "(a span move would strand the workers' shards)"
-                )
         self.model_path = model_path
         self.revision = revision
         self.cache_dir = cache_dir
@@ -607,7 +602,9 @@ class Server:
             prefix_share_scope=self.prefix_share_scope,
         )
 
-    def _make_backend(self, stacked, first_block: int) -> TransformerBackend:
+    def _make_raw_backend(self, stacked, first_block: int) -> TransformerBackend:
+        """Backend construction WITHOUT the lockstep wrap (the live span move
+        rebuilds raw backends under the broadcast lock and re-wraps itself)."""
         mesh = None
         tp = self.num_tp_devices or 1
         sp = self.num_sp_devices or 1
@@ -629,7 +626,7 @@ class Server:
             from petals_tpu.parallel.mesh import tp_mesh
 
             mesh = tp_mesh(tp, devices=devices)
-        backend = TransformerBackend(
+        return TransformerBackend(
             self.family,
             self.cfg,
             stacked,
@@ -641,6 +638,9 @@ class Server:
             use_flash=self.use_flash,
             mesh=mesh,
         )
+
+    def _make_backend(self, stacked, first_block: int) -> TransformerBackend:
+        backend = self._make_raw_backend(stacked, first_block)
         if self.num_hosts > 1:
             from petals_tpu.parallel.multihost import LockstepBackend
 
@@ -708,16 +708,55 @@ class Server:
         self._state = ServerState.JOINING  # the announce loop must NOT say ONLINE yet
         await self._announce(ServerState.JOINING)
 
-        stacked = await asyncio.get_running_loop().run_in_executor(
-            None, self._load_span_params, self.first_block, self.num_blocks
-        )
-        # Build a FRESH backend: open sessions keep their reference to the old
-        # one (consistent old-span compute until they close); the constructor
-        # also re-applies TP sharding for mesh servers.
-        self.backend = self._make_backend(stacked, self.first_block)
-        self._install_adapters(self.backend)
-        self.handler.backend = self.backend
-        self.handler._sub_backends = {}
+        if self.num_hosts > 1:
+            # LIVE SPAN MOVE for a lockstep group (round 5; previously moves
+            # required restarting every member). Quiesce first: park live
+            # sessions (their owners migrate via ptu.session_export — the
+            # parked copies are host RAM, they survive the move), refuse new
+            # compute, and barrier the priority queue so every in-flight op's
+            # broadcasts are done. Then one OP_RELOAD_SPAN broadcast rebuilds
+            # leader + workers from the checkpoint SIMULTANEOUSLY — the
+            # sharded-param device_puts are collectives that pair exactly
+            # like at startup, and the broadcast lock (held around the whole
+            # rebuild) keeps any other collective from interleaving.
+            from petals_tpu.server.task_queue import PRIORITY_BARRIER
+
+            if self.handler is None:
+                raise RuntimeError("live span move before the server started serving")
+            try:
+                await self.handler.park_sessions(ttl=60.0)
+                self.handler.draining = True
+                await self.handler.queue.submit(
+                    lambda: None, priority=PRIORITY_BARRIER, size=0
+                )
+
+                def build_raw():
+                    stacked = self._load_span_params(self.first_block, self.num_blocks)
+                    return self._make_raw_backend(stacked, self.first_block)
+
+                old_backend = self.backend
+                self.backend = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: old_backend.reload_span(self.first_block, build_raw)
+                )
+                self._install_adapters(self.backend)
+                await self.handler.swap_backend(self.backend)
+            finally:
+                # NEVER leave the server permanently refusing sessions: if the
+                # move failed post-broadcast the group is degraded and ops
+                # fail through _check_group with a clear error anyway
+                self.handler.draining = False
+        else:
+            stacked = await asyncio.get_running_loop().run_in_executor(
+                None, self._load_span_params, self.first_block, self.num_blocks
+            )
+            # Build a FRESH backend: open PRIVATE sessions keep their reference
+            # to the old one (consistent old-span compute until they close);
+            # pooled sessions are invalidated by the batcher rebuild inside
+            # swap_backend (the shared lane pool cannot serve two spans). The
+            # constructor also re-applies TP sharding for mesh servers.
+            self.backend = self._make_backend(stacked, self.first_block)
+            self._install_adapters(self.backend)
+            await self.handler.swap_backend(self.backend)
         # stale by construction: measured for the OLD span's successor block;
         # the announce loop re-measures for the new span within one period
         self._next_pings = {}

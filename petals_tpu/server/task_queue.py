@@ -26,6 +26,7 @@ logger = get_logger(__name__)
 
 PRIORITY_INFERENCE = 1.0
 PRIORITY_TRAINING = 2.0  # forward/backward (reference task_prioritizer.py:6-20)
+PRIORITY_BARRIER = 10.0  # quiesce sentinel: runs after everything pending
 
 
 class TaskRejected(Exception):

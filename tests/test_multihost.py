@@ -810,3 +810,149 @@ def test_multihost_partial_reformation(tmp_path):
             client.close()
     finally:
         stop_multihost_pair(leader, worker)
+
+
+_LEADER_MOVE = r"""
+import asyncio, os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+model_path, coord, marker_dir = sys.argv[1], sys.argv[2], sys.argv[3]
+
+import numpy as np
+import jax.numpy as jnp
+
+from petals_tpu.server.server import Server
+
+
+async def main():
+    server = Server(
+        model_path, compute_dtype=jnp.float32, use_flash=False,
+        first_block=0, num_blocks=3, throughput=7.0, host="127.0.0.1",
+        coordinator_address=coord, num_hosts=2,
+    )
+    await server.start()
+    print("announce address: " + server.contact_addr.to_string(), flush=True)
+    while not os.path.exists(os.path.join(marker_dir, "move")):
+        await asyncio.sleep(0.2)
+    await server._reload_span(3)
+    print("MOVED", flush=True)
+    open(os.path.join(marker_dir, "moved"), "w").close()
+    while not os.path.exists(os.path.join(marker_dir, "stop")):
+        await asyncio.sleep(0.2)
+    await server.shutdown()
+
+
+asyncio.run(main())
+"""
+
+
+def test_multihost_live_span_move(tmp_path):
+    """Round-5 v4: a lockstep group MOVES its span live — one OP_RELOAD_SPAN
+    broadcast rebuilds leader AND worker from the checkpoint simultaneously
+    (no process restarted), and sessions on the new span are exact against a
+    local reference. The reference restarts its whole server to move blocks
+    (server.py:369-384); pre-v4 lockstep groups had to restart every member."""
+    model = make_tiny_llama(str(tmp_path), n_layers=6)
+    coord = f"127.0.0.1:{_free_port()}"
+    marker_dir = str(tmp_path)
+    env = _mp_env()
+    leader = subprocess.Popen(
+        [sys.executable, "-c", _LEADER_MOVE, model, coord, marker_dir],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "petals_tpu.cli.run_worker", model,
+         "--first_block", "0", "--num_blocks", "3", "--torch_dtype", "float32",
+         "--coordinator_address", coord, "--num_hosts", "2", "--host_index", "1"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        addr, lines = None, []
+        t0 = time.time()
+        while time.time() - t0 < 420:
+            line = leader.stdout.readline()
+            if not line and leader.poll() is not None:
+                break
+            lines.append(line)
+            if "announce address:" in line:
+                addr = line.rsplit("announce address:", 1)[1].strip()
+                break
+        assert addr, "leader never ready:\n" + "".join(lines[-25:])
+        for proc in (leader, worker):
+            threading.Thread(
+                target=lambda p=proc: [None for _ in p.stdout], daemon=True
+            ).start()
+
+        import asyncio as _a
+
+        from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
+        from petals_tpu.rpc import RpcClient
+        from petals_tpu.rpc.serialization import deserialize_array, serialize_array
+        from petals_tpu.server.server import default_dht_prefix
+
+        host, port = addr.rsplit("/", 1)[0].rsplit(":", 1)
+        prefix = default_dht_prefix(model)
+        rng = np.random.RandomState(0)
+        family, cfg = get_block_config(model)
+        h = rng.randn(1, 5, cfg.hidden_size).astype(np.float32) * 0.1
+        step_h = h[:, :1] * 0.5
+
+        async def drive(uids_range):
+            uids = CHAIN_DELIMITER.join(make_uid(prefix, i) for i in uids_range)
+            c = await RpcClient.connect(host, int(port))
+            try:
+                s = await c.open_stream("ptu.inference")
+                await s.send({"uids": uids, "max_length": 64, "batch_size": 1})
+                await s.recv(timeout=60)
+                await s.send({"tensors": {"hidden": serialize_array(h)}})
+                pre = deserialize_array((await s.recv(timeout=300))["tensors"]["hidden"])
+                await s.send({"tensors": {"hidden": serialize_array(step_h)}})
+                dec = deserialize_array((await s.recv(timeout=300))["tensors"]["hidden"])
+                await s.end()
+                return pre, dec
+            finally:
+                await c.close()
+
+        def reference(first):
+            per = [load_block_params(model, i, dtype=jnp.float32) for i in range(first, first + 3)]
+            stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per)
+            ref = TransformerBackend(
+                family, cfg, stacked, first_block=first, n_blocks=3,
+                memory_cache=MemoryCache(None), compute_dtype=jnp.float32, use_flash=False,
+            )
+            kd, vd = ref.cache_descriptors(1, 64, 0, 3)
+            kv = (kd.make_zeros(), vd.make_zeros())
+            pre, kv = ref.inference_step(h, kv, 0)
+            dec, kv = ref.inference_step(step_h, kv, 5)
+            return np.asarray(pre), np.asarray(dec)
+
+        # old span serves correctly
+        pre, dec = _a.run(drive(range(0, 3)))
+        want_pre, want_dec = reference(0)
+        np.testing.assert_allclose(pre, want_pre, atol=2e-4, rtol=0)
+        np.testing.assert_allclose(dec, want_dec, atol=2e-4, rtol=0)
+
+        # trigger the live move to blocks [3, 6)
+        open(os.path.join(marker_dir, "move"), "w").close()
+        t0 = time.time()
+        while not os.path.exists(os.path.join(marker_dir, "moved")):
+            assert time.time() - t0 < 300, "live span move never completed"
+            assert leader.poll() is None, "leader died during the move"
+            assert worker.poll() is None, "worker died during the move"
+            time.sleep(0.2)
+
+        # the SAME processes now serve the new span, exactly
+        pre2, dec2 = _a.run(drive(range(3, 6)))
+        want_pre2, want_dec2 = reference(3)
+        np.testing.assert_allclose(pre2, want_pre2, atol=2e-4, rtol=0)
+        np.testing.assert_allclose(dec2, want_dec2, atol=2e-4, rtol=0)
+        assert leader.poll() is None and worker.poll() is None
+    finally:
+        open(os.path.join(marker_dir, "stop"), "w").close()
+        leader.terminate()
+        worker.terminate()
+        for p in (leader, worker):
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()

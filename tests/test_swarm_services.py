@@ -397,3 +397,59 @@ def test_span_reload_moves_server(tmp_path):
         harness.run(probe())
     finally:
         harness.stop()
+
+
+def test_span_reload_pooled_decode_uses_new_weights(tmp_path):
+    """Regression (round 5): after a span move the handler's BATCHER must be
+    rebuilt — the shared lane pool's batched decode step otherwise kept the
+    OLD span's weights and pooled sessions on the new span silently computed
+    garbage (prefill was correct, decode was not)."""
+    import jax.numpy as jnp
+
+    from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
+    from petals_tpu.rpc import RpcClient
+    from petals_tpu.rpc.serialization import deserialize_array, serialize_array
+    from petals_tpu.server.server import Server, default_dht_prefix
+    from tests.utils import make_tiny_llama
+
+    async def main():
+        path = make_tiny_llama(str(tmp_path), n_layers=6)
+        server = Server(
+            path, compute_dtype=jnp.float32, use_flash=False,
+            first_block=0, num_blocks=3,
+        )
+        await server.start()
+        client = await RpcClient.connect(server.rpc_server.host, server.rpc_server.port)
+        try:
+            prefix = default_dht_prefix(path)
+            rng = np.random.RandomState(0)
+            h = rng.randn(1, 5, server.cfg.hidden_size).astype(np.float32) * 0.1
+            step_h = h[:, :1] * 0.5
+
+            await server._reload_span(3)  # move to blocks [3, 6)
+            uids = CHAIN_DELIMITER.join(make_uid(prefix, i) for i in range(3, 6))
+            s = await client.open_stream("ptu.inference")
+            await s.send({"uids": uids, "max_length": 64, "batch_size": 1})
+            await s.recv(timeout=30)
+            await s.send({"tensors": {"hidden": serialize_array(h)}})
+            pre = deserialize_array((await s.recv(timeout=120))["tensors"]["hidden"])
+            await s.send({"tensors": {"hidden": serialize_array(step_h)}})
+            dec = deserialize_array((await s.recv(timeout=120))["tensors"]["hidden"])
+            await s.end()
+            # the session must have used the POOL (the regression's subject)
+            assert server.handler.batcher is not None
+            assert server.handler.batcher.stats["batched_tokens"] >= 1
+
+            # ground truth: the moved span's blocks, fresh
+            want = server.backend  # the new backend IS blocks [3, 6)
+            kd, vd = want.cache_descriptors(1, 64, 0, 3)
+            kv = (kd.make_zeros(), vd.make_zeros())
+            want_pre, kv = want.inference_step(h, kv, 0)
+            want_dec, kv = want.inference_step(step_h, kv, 5)
+            np.testing.assert_allclose(pre, np.asarray(want_pre), atol=2e-5, rtol=0)
+            np.testing.assert_allclose(dec, np.asarray(want_dec), atol=2e-5, rtol=0)
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(main())
