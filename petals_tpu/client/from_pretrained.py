@@ -32,7 +32,7 @@ def load_client_params(
     # single pass over the checkpoint; client mappings match absolute names
     tensors = _load_tensors_with_prefixes(path, family.hf_client_prefixes, keep_full_names=True)
     params = family.hf_to_client_params(tensors, cfg)
-    return jax.tree_util.tree_map(_caster(dtype), params)
+    return _cast_params(params, dtype, family)
 
 
 def load_cls_client_params(
@@ -54,7 +54,20 @@ def load_cls_client_params(
     )
     tensors = _load_tensors_with_prefixes(path, family.hf_cls_prefixes, keep_full_names=True)
     params = family.hf_to_cls_params(tensors, cfg)
-    return jax.tree_util.tree_map(_caster(dtype), params)
+    return _cast_params(params, dtype, family)
+
+
+def _cast_params(params: dict, dtype, family) -> dict:
+    """Cast float leaves to the serving dtype, preserving the family's
+    cast-exempt leaves (see ModelFamily.cast_exempt)."""
+    import jax
+
+    cast = _caster(dtype)
+    return {
+        name: (jnp.asarray(leaf) if name in getattr(family, "cast_exempt", ())
+               else jax.tree_util.tree_map(cast, leaf))
+        for name, leaf in params.items()
+    }
 
 
 def _caster(dtype):

@@ -42,7 +42,14 @@ def silu(x: jnp.ndarray) -> jnp.ndarray:
     return (xf * jax.nn.sigmoid(xf)).astype(x.dtype)
 
 
-ACTIVATIONS = {"silu": silu, "gelu_tanh": gelu_tanh, "gelu": jax.nn.gelu}
+def gelu_exact(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact (erf) GeLU — HF ACT2FN["gelu"]; jax.nn.gelu defaults to the TANH
+    approximation, which would diverge up to ~1e-2 near |x|~2."""
+    xf = x.astype(jnp.float32)
+    return jax.nn.gelu(xf, approximate=False).astype(x.dtype)
+
+
+ACTIVATIONS = {"silu": silu, "gelu_tanh": gelu_tanh, "gelu": gelu_exact}
 
 
 def mm(x: jnp.ndarray, w) -> jnp.ndarray:

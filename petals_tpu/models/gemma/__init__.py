@@ -1,0 +1,92 @@
+"""Gemma (v1) family — beyond the reference's four families.
+
+Architecturally a llama-style decoder with four deltas, all absorbed without
+a new block implementation:
+
+- RMSNorm computes ``x_normed * (1 + w)`` (zero-centered weights): folded at
+  LOAD time — every norm weight becomes ``1 + w`` in float32, after which the
+  llama block's plain ``x_normed * w`` is bit-equivalent.
+- MLP activation is tanh-approximate GELU: ``hidden_act`` rides the llama
+  block config (models/common.ACTIVATIONS).
+- Embeddings scale by sqrt(hidden_size) on the client
+  (``gemma_client_embed``), matching HF's normalizer.
+- Head is always tied to the embeddings; explicit head_dim (256 on 7B)
+  already rides LlamaBlockConfig.from_hf_config.
+
+Gemma 2 is a DIFFERENT architecture (logit softcapping, alternating sliding
+windows, post-norms) registered under model_type "gemma2" — it is not
+registered here, so loading one fails with an unknown-family error instead
+of silently serving wrong math.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import petals_tpu.models.llama.block as llama_block
+import petals_tpu.models.llama.model as llama_model
+from petals_tpu.models.client_common import (
+    llama_style_client_embed,
+    llama_style_hf_to_client_params,
+    llama_style_hf_to_cls_params,
+)
+from petals_tpu.models.llama.config import LlamaBlockConfig
+from petals_tpu.models.registry import register_family
+
+
+def config_from_hf(hf_config) -> LlamaBlockConfig:
+    return LlamaBlockConfig.from_hf_config(hf_config)
+
+
+def _fold_norm(w) -> np.ndarray:
+    """Gemma RMSNorm: x_normed * (1 + w) — fold the +1 into the stored weight
+    (float32, exact) so the llama block's x_normed * w is equivalent."""
+    return np.asarray(w, np.float32) + 1.0
+
+
+def hf_to_block_params(tensors: dict, cfg: LlamaBlockConfig) -> dict:
+    params = llama_block.hf_to_block_params(tensors, cfg)
+    params["ln1"] = _fold_norm(params["ln1"])
+    params["ln2"] = _fold_norm(params["ln2"])
+    return params
+
+
+def hf_to_client_params(tensors: dict, cfg) -> dict:
+    params = llama_style_hf_to_client_params(tensors, cfg)
+    params["norm"] = _fold_norm(params["norm"])
+    return params
+
+
+def hf_to_cls_params(tensors: dict, cfg) -> dict:
+    # the sequence-classification surface runs the same final norm: fold here
+    # too or cls logits would silently use the zero-centered raw weights
+    params = llama_style_hf_to_cls_params(tensors, cfg)
+    params["norm"] = _fold_norm(params["norm"])
+    return params
+
+
+def client_embed(params: dict, input_ids, cfg):
+    h = llama_style_client_embed(params, input_ids, cfg)
+    # HF casts the sqrt(hidden) normalizer to the embedding dtype first
+    import jax.numpy as jnp
+
+    return h * jnp.asarray(np.sqrt(cfg.hidden_size), h.dtype)
+
+
+FAMILY = register_family(
+    dataclasses.replace(
+        llama_model.FAMILY,
+        name="gemma",
+        config_from_hf=config_from_hf,
+        hf_to_block_params=hf_to_block_params,
+        hf_to_client_params=hf_to_client_params,
+        hf_to_cls_params=hf_to_cls_params,
+        client_embed=client_embed,
+        # the folded (1+w) norms must stay float32 through the serving-dtype
+        # cast: bf16-rounding 1+w loses ~2^-9 per channel that the unfolded
+        # form would not (rms_norm upcasts to f32 anyway, so this is free)
+        cast_exempt=("ln1", "ln2", "norm"),
+    )
+)

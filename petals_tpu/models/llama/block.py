@@ -15,7 +15,7 @@ from typing import Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from petals_tpu.models.common import KVCache, absolute_positions, mm, rms_norm, silu, update_kv_cache
+from petals_tpu.models.common import ACTIVATIONS, KVCache, absolute_positions, mm, rms_norm, update_kv_cache
 from petals_tpu.models.llama.config import LlamaBlockConfig
 from petals_tpu.models.registry import ModelFamily, register_family
 from petals_tpu.ops.attention import attend_maybe_ring
@@ -89,7 +89,7 @@ def block_apply(
         if cfg.mlp_bias:
             gate = gate + params["bg"]
             up = up + params["bu"]
-    mlp = mm(silu(gate) * up, params["wd"])
+    mlp = mm(ACTIVATIONS[cfg.hidden_act](gate) * up, params["wd"])
     if cfg.mlp_bias:
         mlp = mlp + params["bd"]
     hidden_states = residual + mlp

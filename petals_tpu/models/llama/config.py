@@ -10,6 +10,22 @@ import json
 from typing import Optional, Tuple
 
 
+_HF_ACT_NAMES = {
+    "silu": "silu",
+    "gelu_pytorch_tanh": "gelu_tanh",
+    "gelu": "gelu",
+}
+
+
+def _map_hidden_act(hf_name: str) -> str:
+    try:
+        return _HF_ACT_NAMES[hf_name]
+    except KeyError:
+        raise NotImplementedError(
+            f"hidden_act {hf_name!r} is not in models/common.ACTIVATIONS"
+        ) from None
+
+
 @dataclasses.dataclass(frozen=True)
 class LlamaBlockConfig:
     hidden_size: int
@@ -27,6 +43,9 @@ class LlamaBlockConfig:
     mlp_bias: bool = False
     # all-layer sliding window (HF mistral convention); None = full attention
     sliding_window: Optional[int] = None
+    # MLP activation by name (models/common.ACTIVATIONS): llama/qwen2/mistral
+    # use silu; gemma uses tanh-approx gelu
+    hidden_act: str = "silu"
     vocab_size: int = 32000
     tie_word_embeddings: bool = False
 
@@ -55,6 +74,7 @@ class LlamaBlockConfig:
             rope_scaling=rope_scaling,
             attention_bias=getattr(hf_config, "attention_bias", False),
             mlp_bias=getattr(hf_config, "mlp_bias", False),
+            hidden_act=_map_hidden_act(getattr(hf_config, "hidden_act", "silu")),
             vocab_size=hf_config.vocab_size,
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
         )

@@ -28,6 +28,10 @@ class ModelFamily:
     # architecture-keyed tables (quantizable leaves, fuse groups in
     # utils/convert_block.py) resolve without per-alias entries.
     block_arch: str = ""
+    # leaf NAMES whose loaded dtype is preserved by the param casters (e.g.
+    # gemma's (1+w)-folded norms must stay float32 for the fold to be exact
+    # under bf16 serving; rms_norm upcasts anyway, so this is free)
+    cast_exempt: tuple = ()
     # Client-side (embeddings + final norm + LM head), filled by model.py modules:
     hf_client_prefixes: tuple = ()  # checkpoint prefixes of client-held tensors
     hf_to_client_params: Optional[Callable] = None  # (dict, cfg) -> params pytree

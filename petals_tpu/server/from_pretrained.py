@@ -179,7 +179,11 @@ def load_block_params(
 
     params = family.hf_to_block_params(tensors, cfg)
     cast = lambda x: jnp.asarray(x, dtype) if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x)
-    params = jax.tree_util.tree_map(cast, params)
+    params = {
+        name: (jnp.asarray(leaf) if name in family.cast_exempt
+               else jax.tree_util.tree_map(cast, leaf))
+        for name, leaf in params.items()
+    }
     if device is not None:
         params = jax.device_put(params, device)
     return params

@@ -1,7 +1,8 @@
-"""Qwen2 and Mistral end-to-end: token-identical greedy generation through a
-live swarm (the same acceptance bar as the reference's four families). These
-families are BEYOND the reference inventory — llama-style blocks with the
-qwen bias convention (q/k/v-only) and the mistral all-layer sliding window.
+"""Qwen2, Mistral and Gemma end-to-end: token-identical greedy generation
+through a live swarm (the same acceptance bar as the reference's four
+families). These families are BEYOND the reference inventory — llama-style
+blocks with the qwen bias convention (q/k/v-only), the mistral all-layer
+sliding window, and gemma's (1+w)-folded norms / tanh-GELU / scaled embeds.
 """
 
 import jax.numpy as jnp
@@ -10,10 +11,13 @@ import pytest
 
 from petals_tpu.client.model import AutoDistributedModelForCausalLM
 from tests.test_full_model import SwarmHarness, _hf_greedy
-from tests.utils import make_tiny_mistral, make_tiny_qwen2
+from tests.utils import make_tiny_gemma, make_tiny_mistral, make_tiny_qwen2
 
 
-@pytest.mark.parametrize("maker,name", [(make_tiny_qwen2, "qwen2"), (make_tiny_mistral, "mistral")])
+@pytest.mark.parametrize(
+    "maker,name",
+    [(make_tiny_qwen2, "qwen2"), (make_tiny_mistral, "mistral"), (make_tiny_gemma, "gemma")],
+)
 def test_quantization_applies_to_derived_families(tmp_path, maker, name):
     """Families registered under their own model_type but sharing the llama
     block architecture must still quantize: QUANTIZABLE_LEAVES/_FUSE_GROUPS
@@ -38,11 +42,13 @@ def test_quantization_refuses_unknown_architecture():
         convert_block_params({"w_mystery": jnp.ones((8, 8))}, "not-a-family", "nf4")
 
 
-@pytest.fixture(scope="module", params=["qwen2", "mistral"])
+@pytest.fixture(scope="module", params=["qwen2", "mistral", "gemma"])
 def family_swarm(request, tmp_path_factory):
     tmp = str(tmp_path_factory.mktemp("models"))
     if request.param == "qwen2":
         path = make_tiny_qwen2(tmp)
+    elif request.param == "gemma":
+        path = make_tiny_gemma(tmp)
     else:
         # window=6: generation must cross the sliding-window edge mid-stream
         path = make_tiny_mistral(tmp, window=6)
@@ -84,3 +90,19 @@ def test_session_reuse_and_failover_ready(family_swarm):
         np.testing.assert_array_equal(final, expected, err_msg=f"{name} session diverged")
     finally:
         model.close()
+
+
+def test_gemma_norm_fold_survives_bf16_loading(tmp_path):
+    """Gemma's (1+w) norm fold is exact only in float32: the cast_exempt
+    plumbing must keep the folded norms f32 when everything else loads bf16
+    (rms_norm upcasts anyway, so serving numerics see the exact fold)."""
+    from petals_tpu.client.from_pretrained import load_client_params
+    from petals_tpu.server.from_pretrained import load_block_params
+
+    path = make_tiny_gemma(str(tmp_path))
+    params = load_block_params(path, 0, dtype=jnp.bfloat16)
+    assert params["ln1"].dtype == jnp.float32 and params["ln2"].dtype == jnp.float32
+    assert params["wq"].dtype == jnp.bfloat16
+    client = load_client_params(path, dtype=jnp.bfloat16)
+    assert client["norm"].dtype == jnp.float32
+    assert client["embed"].dtype == jnp.bfloat16

@@ -260,6 +260,30 @@ def make_tiny_mistral(tmpdir: str, *, n_layers: int = 4, vocab: int = 128, windo
     return path
 
 
+@_model_build_cache
+def make_tiny_gemma(tmpdir: str, *, n_layers: int = 4, vocab: int = 128) -> str:
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    cfg = GemmaConfig(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=n_layers,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,  # explicit, like the real checkpoints (256 on 7B)
+        max_position_embeddings=256,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        hidden_act="gelu_pytorch_tanh",
+    )
+    torch.manual_seed(7)
+    model = GemmaForCausalLM(cfg).eval()
+    path = os.path.join(tmpdir, "tiny-gemma")
+    model.save_pretrained(path, safe_serialization=True)
+    return path
+
+
 def multihost_child_env(repo_root: str | None = None) -> dict:
     """Env for multi-host subprocess swarms: CPU-only (any accelerator plugin
     dir is REPLACED out of PYTHONPATH — plugins force-override JAX_PLATFORMS
