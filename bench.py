@@ -1336,6 +1336,10 @@ def main():
     # uniform-level option
     row("decode_70b_int4", "70B-shape int4",
         lambda: bench_device_decode(llama70b_cfg(10), quant="int4", label="decode_70b_int4"))
+    # NF4A+O (outlier channels dense): the packed stream + the thin side
+    # matmul — must stay within a few % of plain nf4a
+    row("decode_70b_nf4a_o", "70B-shape nf4a+o",
+        lambda: bench_device_decode(llama70b_cfg(10), quant="nf4a+o", label="decode_70b_nf4a_o"))
     # 8k-context prefill through the flash kernel on 70B-shaped blocks
     row("prefill_8k_flash", "8k flash prefill",
         lambda: bench_flash_prefill(llama70b_cfg(2), 8192))
