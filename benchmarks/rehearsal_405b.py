@@ -42,6 +42,9 @@ KV_BUDGET_TOKENS = 8192  # per-span KV allocation the placement must absorb
 # only the wire RTT below stays assumed.
 HOP_MS_LAN = 2.0
 WIRE_RTT_MS_DCN = 0.5  # assumed intra-pod DCN round trip added to measured hops
+# every 4-bit serving option, serving-default first (one constant so a new
+# quant kind can't end up placed-but-never-projected or vice versa)
+QUANTS = ("nf4a", "nf4a+o", "int4", "nf4")
 
 
 def llama405b_cfg(n_layers: int = 126):
@@ -200,13 +203,14 @@ def project_single_stream(
 def rehearsal_report(bench_details: Optional[dict] = None) -> Dict:
     """The driver-visible artifact: placement + projections, using measured
     bandwidths when a BENCH_DETAILS dict (or file) is available."""
-    report = {"placement": {q: placement_rehearsal(q) for q in ("nf4a", "int4", "nf4")}}
+    report = {"placement": {q: placement_rehearsal(q) for q in QUANTS}}
 
     measured = {}
     overhead_frac = 0.0
     if bench_details:
-        for q in ("nf4a", "int4", "nf4", "bf16"):
-            row = bench_details.get(f"decode_70b_{q}") or {}
+        for q in QUANTS + ("bf16",):
+            # bench row keys are json-identifier-safe: '+' becomes '_'
+            row = bench_details.get(f"decode_70b_{q}".replace("+", "_")) or {}
             if row.get("weight_stream_gb_s"):
                 measured[q] = float(row["weight_stream_gb_s"])
         e2e = bench_details.get("e2e_8xllama7b") or {}
@@ -219,9 +223,7 @@ def rehearsal_report(bench_details: Optional[dict] = None) -> Dict:
             overhead_frac = max(float(e2e["device_step_ms"]) / bound_ms - 1.0, 0.0)
 
     n_int4 = report["placement"]["int4"]["n_per_host"]
-    n_by_quant = {
-        q: report["placement"][q]["n_per_host"] for q in ("nf4a", "int4", "nf4")
-    }
+    n_by_quant = {q: report["placement"][q]["n_per_host"] for q in QUANTS}
 
     # measured per-hop software cost (bench chain_hop row: real RPC chain at
     # hidden=16384) + an assumed DCN wire RTT — replaces the 2.0 ms guess
@@ -237,7 +239,9 @@ def rehearsal_report(bench_details: Optional[dict] = None) -> Dict:
 
     rows = []
     # nf4a first: it is the serving default the north-star claim rides on
-    for q in ("nf4a", "int4", "nf4"):
+    # (nf4a+o: the quality option at 4.5 bits — its span is a block or two
+    # shorter per host, the projection shows what that costs)
+    for q in QUANTS:
         if q in measured:
             row = project_single_stream(
                 measured[q], quant=q, n_per_span=n_by_quant[q],

@@ -77,3 +77,18 @@ def test_report_consumes_measured_bench_rows():
     # measured e2e gap becomes the overhead fraction (device_step vs bound)
     assert 0.5 < by_quant["int4"]["device_overhead_frac"] < 1.2
     assert report["north_star"]["min_chip_gb_s_for_target"] > 0
+
+
+def test_outlier_quant_row_key_translation():
+    """The nf4a+o projection reads its measured bandwidth from the bench row
+    'decode_70b_nf4a_o' ('+' is not json-identifier-safe): a synthetic row
+    must surface as a projection entry, or the quality option silently
+    drops out of the report."""
+    from benchmarks.rehearsal_405b import rehearsal_report
+
+    report = rehearsal_report({
+        "decode_70b_nf4a_o": {"weight_stream_gb_s": 400.0},
+    })
+    rows = [r for r in report["projection"] if r["quant"] == "nf4a+o"]
+    assert rows and rows[0]["chip_gb_s"] == 400.0, report["projection"]
+    assert "nf4a+o" in report["placement"]
