@@ -541,8 +541,12 @@ async def run_e2e_bench():
     # this chip actually achieves it for 7B-sized matmuls. NOTE: the q+k+v sum
     # assumes MHA (wq/wk/wv same output dim) — true for the 7B config this
     # bench hard-codes; a GQA config would need concatenation instead.
+    # weights must ride as jit ARGUMENTS: a closure capture here embeds the
+    # whole span (3.2 GB at 7B shapes) as XLA constants, and lowering a
+    # multi-GB-constant program through the tunnel's remote compile server
+    # takes tens of minutes (this exact hang ate round 4's bench budget)
     @functools.partial(jax.jit, static_argnames=("n",))
-    def chain(v, n):
+    def chain(v, ws, n):
         def body(carry, xs):
             wq, wk, wv, wo, wg, wu, wd = xs
             a = carry @ wq + carry @ wk + carry @ wv  # every weight streamed
@@ -551,20 +555,20 @@ async def run_e2e_bench():
             carry = b @ wd
             return carry * 1e-2, None
 
-        xs = tuple(span_params[nm] for nm in ("wq", "wk", "wv", "wo", "wg", "wu", "wd"))
         carry = v
         for _ in range(n):
-            carry, _ = jax.lax.scan(body, carry, xs)
+            carry, _ = jax.lax.scan(body, carry, ws)
         return carry
 
+    chain_ws = tuple(span_params[nm] for nm in ("wq", "wk", "wv", "wo", "wg", "wu", "wd"))
     x1 = jax.device_put(jnp.asarray(step_hidden[:, 0], dtype))
     t_chain = {}
     for n in (1, 3):
-        hard_sync(chain(x1, n=n))
+        hard_sync(chain(x1, chain_ws, n=n))
         best = float("inf")
         for _ in range(3):
             t0 = time.perf_counter()
-            o = chain(x1, n=n)
+            o = chain(x1, chain_ws, n=n)
             hard_sync(o)
             best = min(best, time.perf_counter() - t0)
         t_chain[n] = best

@@ -81,14 +81,17 @@ def main():
     pos += 2
     hard_sync(out)
 
-    # --- C setup: bare stacked matmul chain (fused 70B shapes)
+    # --- C setup: bare stacked matmul chain (fused 70B shapes). Weights ride
+    # as jit ARGUMENTS — a closure capture embeds the whole span as XLA
+    # constants, and lowering a multi-GB-constant program through the tunnel's
+    # remote compile server takes tens of minutes (hung round 4's bench).
     H, QKV, GU, INTER = cfg.hidden_size, 10240, 57344, cfg.intermediate_size
     import functools
     if quant:
-        leaves = {n: span_params[n] for n in ("wqkv", "wo", "wgu", "wd")}
+        chain_ws = {n: span_params[n] for n in ("wqkv", "wo", "wgu", "wd")}
 
         @functools.partial(jax.jit, static_argnames=('n',))
-        def chain_C(v, n):
+        def chain_C(v, leaves, n):
             def body(v, idx):
                 def sq(q):
                     return Q.StackedQuantLinear(
@@ -103,16 +106,17 @@ def main():
                 v, _ = jax.lax.scan(body, v, jnp.arange(N_BLOCKS, dtype=jnp.int32))
             return v
     else:
+        chain_ws = tuple(span_params[n] for n in ("wq", "wo", "wg", "wd"))
+
         @functools.partial(jax.jit, static_argnames=('n',))
-        def chain_C(v, n):
-            def body(v, xs):
-                wq, wo, wg, wd = xs
+        def chain_C(v, xs, n):
+            def body(v, ws):
+                wq, wo, wg, wd = ws
                 a = v @ wq.reshape(H, -1)
                 v = a[:, :H] @ wo
                 b = (v @ wg)[:, :INTER]
                 v = b @ wd
                 return v * 1e-2, None
-            xs = (span_params["wq"], span_params["wo"], span_params["wg"], span_params["wd"])
             for _ in range(n):
                 v, _ = jax.lax.scan(body, v, xs)
             return v
@@ -121,7 +125,7 @@ def main():
     cn1, cn2 = 1, 3
     # compile
     print("# compiling C...", flush=True)
-    hard_sync(chain_C(x1, n=cn1)); hard_sync(chain_C(x1, n=cn2))
+    hard_sync(chain_C(x1, chain_ws, n=cn1)); hard_sync(chain_C(x1, chain_ws, n=cn2))
     print("# C compiled", flush=True)
 
     tA = tB = float("inf")
@@ -144,7 +148,7 @@ def main():
 
         for n in (cn1, cn2):
             t0 = time.perf_counter()
-            o = chain_C(x1, n=n)
+            o = chain_C(x1, chain_ws, n=n)
             hard_sync(o)
             tC[n] = min(tC[n], time.perf_counter() - t0)
 
