@@ -1135,9 +1135,53 @@ def _run_tpu_smoke(timeout: float = 600.0) -> None:
         pass
 
 
+def _heavy_row_registry():
+    """name -> zero-arg callable for every row that must run in its OWN
+    process. Round-5 on-chip lesson: buffers from a finished row are not
+    reliably reclaimed by the axon tunnel within one process (del + gc +
+    jax.clear_caches() between rows still hit RESOURCE_EXHAUSTED on the 4th
+    ~4 GiB quant row, while a plain alloc/free loop cycles 30 GiB fine), so
+    every multi-GiB row gets a fresh process and therefore a fresh HBM heap.
+    """
+    return {
+        "decode_70b_bf16": lambda: bench_device_decode(
+            llama70b_cfg(6), label="decode_70b_bf16"),
+        "decode_70b_nf4": lambda: bench_device_decode(
+            llama70b_cfg(10), quant="nf4", label="decode_70b_nf4"),
+        "decode_70b_nf4a": lambda: bench_device_decode(
+            llama70b_cfg(10), quant="nf4a", label="decode_70b_nf4a"),
+        "decode_70b_int4": lambda: bench_device_decode(
+            llama70b_cfg(10), quant="int4", label="decode_70b_int4"),
+        "decode_70b_nf4a_o": lambda: bench_device_decode(
+            llama70b_cfg(10), quant="nf4a+o", label="decode_70b_nf4a_o"),
+        "prefill_8k_flash": lambda: bench_flash_prefill(llama70b_cfg(2), 8192),
+        "decode_7b_batched": lambda: bench_batched_decode(llama7b_cfg()),
+        "continuous_batching_e2e": lambda: asyncio.run(
+            run_continuous_batching_bench()),
+        "prefix_cache_ttft": lambda: asyncio.run(run_prefix_cache_bench()),
+        "chain_hop_405b_shapes": lambda: asyncio.run(run_chain_hop_bench()),
+        "quant_quality": lambda: __import__(
+            "benchmarks.quant_quality", fromlist=["quality_report"]
+        ).quality_report(include_model_tier=False),
+        "moe_prefill_2048": bench_moe_dispatch,
+    }
+
+
+def _run_single_row(name: str) -> None:
+    """--row child: run ONE registry row and print its JSON on the LAST
+    stdout line (stderr streams through for progress)."""
+    fn = _heavy_row_registry()[name]
+    result = fn()
+    print(json.dumps(result), flush=True)
+
+
 def main():
     import signal
     import subprocess
+
+    if "--row" in sys.argv:
+        _run_single_row(sys.argv[sys.argv.index("--row") + 1])
+        return
 
     if "--inner" not in sys.argv:
         # Supervise the real benchmark from a jax-free parent: if the
@@ -1217,6 +1261,8 @@ def main():
                 proc = subprocess.run(
                     [sys.executable, os.path.abspath(__file__), "--inner"],
                     stdout=subprocess.PIPE, text=True, timeout=remaining,
+                    env=dict(os.environ,
+                             _PTU_INNER_DEADLINE=str(deadline - reserve)),
                 )
                 child_stdout = proc.stdout or ""
                 error = None if proc.returncode == 0 else f"rc={proc.returncode}"
@@ -1310,6 +1356,47 @@ def main():
             print(f"# {label} failed: {e!r}", file=sys.stderr)
         write_details()
 
+    # heavy on-chip rows run in per-row subprocesses (fresh HBM heap each —
+    # see _heavy_row_registry); the supervisor's deadline hint lets a tight
+    # budget skip the tail gracefully instead of dying mid-row
+    import subprocess
+    inner_deadline = float(os.environ.get("_PTU_INNER_DEADLINE", 0)) or None
+    skipped_for_budget = []
+
+    def row_sub(name, label, timeout=420.0):
+        if inner_deadline is not None:
+            left = inner_deadline - time.time()
+            if left < 90.0:
+                skipped_for_budget.append(name)
+                print(f"# {label} skipped: {left:.0f}s budget left", file=sys.stderr)
+                return
+            # margin so OUR TimeoutExpired fires (and reaps the child) before
+            # the supervisor's kill at the same absolute deadline — a SIGKILLed
+            # inner can't clean up, and an orphaned row child would hold the
+            # single-process chip through the smoke tier
+            timeout = min(timeout, max(left - 20.0, 60.0))
+        # own session: on timeout we kill the whole process GROUP, so a row
+        # child that forked helpers (or wedged mid-DMA) can't outlive us
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--row", name],
+            stdout=subprocess.PIPE, text=True, start_new_session=True,
+        )
+        try:
+            stdout, _ = proc.communicate(timeout=timeout)
+            if proc.returncode != 0:
+                raise RuntimeError(f"rc={proc.returncode}")
+            details[name] = json.loads(stdout.strip().splitlines()[-1])
+            print(f"# {label}: {json.dumps(details[name])}", file=sys.stderr)
+        except Exception as e:
+            import signal as _signal
+            try:
+                os.killpg(proc.pid, _signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+            print(f"# {label} failed: {e!r}", file=sys.stderr)
+        write_details()
+
     e2e = asyncio.run(run_e2e_bench())
     details["e2e_8xllama7b"] = {k: round(v, 3) for k, v in e2e.items()}
     print(f"# e2e 7B-span: {json.dumps(details['e2e_8xllama7b'])}", file=sys.stderr)
@@ -1325,54 +1412,38 @@ def main():
     }), flush=True)
 
     # 70B-shaped bf16 span: 6 blocks = 10.3 GB of weights on the chip
-    row("decode_70b_bf16", "70B-shape bf16",
-        lambda: bench_device_decode(llama70b_cfg(6), label="decode_70b_bf16"))
+    row_sub("decode_70b_bf16", "70B-shape bf16")
     # NF4 70B-shaped span: 10 blocks = 4.6 GB quantized (fused Pallas
     # dequant); stack-time peak is ~2x quantized size + one dense block
-    row("decode_70b_nf4", "70B-shape nf4",
-        lambda: bench_device_decode(llama70b_cfg(10), quant="nf4", label="decode_70b_nf4"))
+    row_sub("decode_70b_nf4", "70B-shape nf4")
     # NF4A (cubic-fitted levels, gather-free decode — ops/quant.py): the
     # 4-bit SERVING DEFAULT; must land in int4's bandwidth class, not NF4's
     # gather-bound ~110 GB/s (the round-5 default-gap gate)
-    row("decode_70b_nf4a", "70B-shape nf4a",
-        lambda: bench_device_decode(llama70b_cfg(10), quant="nf4a", label="decode_70b_nf4a"))
+    row_sub("decode_70b_nf4a", "70B-shape nf4a")
     # INT4 (affine decode - ops/quant.py): same 4.25 bits, 2-op dequant; the
     # uniform-level option
-    row("decode_70b_int4", "70B-shape int4",
-        lambda: bench_device_decode(llama70b_cfg(10), quant="int4", label="decode_70b_int4"))
+    row_sub("decode_70b_int4", "70B-shape int4")
     # NF4A+O (outlier channels dense): the packed stream + the thin side
     # matmul — must stay within a few % of plain nf4a
-    row("decode_70b_nf4a_o", "70B-shape nf4a+o",
-        lambda: bench_device_decode(llama70b_cfg(10), quant="nf4a+o", label="decode_70b_nf4a_o"))
+    row_sub("decode_70b_nf4a_o", "70B-shape nf4a+o")
     # 8k-context prefill through the flash kernel on 70B-shaped blocks
-    row("prefill_8k_flash", "8k flash prefill",
-        lambda: bench_flash_prefill(llama70b_cfg(2), 8192))
+    row_sub("prefill_8k_flash", "8k flash prefill")
     # batched decode throughput on the 7B span (serving-throughput scaling)
-    row("decode_7b_batched", "batched decode",
-        lambda: bench_batched_decode(llama7b_cfg()))
+    row_sub("decode_7b_batched", "batched decode")
     # continuous batching through the full RPC stack: 8 concurrent sessions
     # vs 8 serial (VERDICT r3 #3 bar: >=5x serial aggregate)
-    row("continuous_batching_e2e", "continuous batching",
-        lambda: asyncio.run(run_continuous_batching_bench()))
+    row_sub("continuous_batching_e2e", "continuous batching")
     # prefix-cache TTFT: a shared 512-token prompt's second prefill skips
     # its compute (the reference recomputes every prompt)
-    row("prefix_cache_ttft", "prefix cache",
-        lambda: asyncio.run(run_prefix_cache_bench()))
+    row_sub("prefix_cache_ttft", "prefix cache")
     # measured 405B-chain hop costs (VERDICT r3 #6): 2 span servers of
     # 405B-shaped int4 blocks chained through the real RPC stack with push
-    row("chain_hop_405b_shapes", "405B chain hops",
-        lambda: asyncio.run(run_chain_hop_bench()))
-
+    row_sub("chain_hop_405b_shapes", "405B chain hops", timeout=600.0)
     # quantization quality table (VERDICT r3 #4): weight+activation error at
     # 7B shapes per format, so the serving default is re-derived every run
-    def quality_row():
-        from benchmarks.quant_quality import quality_report
-
-        return quality_report(include_model_tier=False)  # model tier is a CPU test
-
-    row("quant_quality", "quant quality", quality_row)
+    row_sub("quant_quality", "quant quality")
     # sparse vs dense MoE dispatch at prefill (mixtral-8x7B shapes, 1 layer)
-    row("moe_prefill_2048", "moe dispatch", bench_moe_dispatch)
+    row_sub("moe_prefill_2048", "moe dispatch")
 
     # continuous batching UNDER MULTI-HOST LOCKSTEP (round-5 composition):
     # a real 2-process tp span on CPU subprocesses (axon stripped from their
@@ -1403,7 +1474,11 @@ def main():
         return rehearsal_report(details)
 
     row("rehearsal_405b", "405B rehearsal", rehearsal_row)
-    write_details(complete=True)
+    if skipped_for_budget:
+        details["_skipped_for_budget"] = skipped_for_budget
+        write_details(complete=False)
+    else:
+        write_details(complete=True)
 
 
 if __name__ == "__main__":
@@ -1411,8 +1486,10 @@ if __name__ == "__main__":
         main()
     except BaseException as e:
         # the supervisor itself must never die line-less; the inner child
-        # (--inner) is exempt — its parent handles the contract
-        if "--inner" not in sys.argv and not isinstance(e, SystemExit):
+        # (--inner) and per-row children (--row) are exempt — their parent
+        # handles the contract
+        if ("--inner" not in sys.argv and "--row" not in sys.argv
+                and not isinstance(e, SystemExit)):
             sys.stderr.write(f"[bench] supervisor crashed: {e!r}\n")
             _emit_stale_once(f"supervisor crash: {e!r}")
         raise

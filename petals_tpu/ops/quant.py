@@ -182,22 +182,57 @@ class OutlierQuantLinear:
         )
 
 
+@functools.partial(jax.jit, static_argnames=("k",))
+def _outlier_idx(w: jnp.ndarray, k: int) -> jnp.ndarray:
+    mags = jnp.max(jnp.abs(w), axis=1).astype(jnp.float32)
+    _, idx = jax.lax.top_k(mags, k)
+    return jnp.sort(idx).astype(jnp.int32)
+
+
+def _zero_decode_value(kind: str) -> float:
+    """The decoded value of an exactly-zero weight under ``kind``'s encode:
+    zero falls in the bin with #{midpoints < 0} midpoints below it, so its
+    code — and therefore its decode, CODE[c0] * scale — is deterministic.
+    int4 clips/rounds 0 to code 8, which decodes to exactly 0; nf4's level 7
+    IS 0.0; nf4a's symmetric levels have no zero, so c0 = 7 decodes to
+    CODE[7] (~ -0.036 * scale)."""
+    if kind == "int4":
+        return 0.0
+    if kind not in ("nf4", "nf4a"):
+        raise ValueError(
+            f"outlier channels support the blockwise 4-bit kinds, not {kind!r}"
+            " (int8's per-column scales don't fit the residual's block-scale"
+            " indexing, and int8 has no outlier-crushing problem to fix)"
+        )
+    code = NF4_CODE if kind == "nf4" else NF4A_CODE
+    midpoints = (code[:-1] + code[1:]) / 2.0
+    return float(code[int((midpoints < 0.0).sum())])
+
+
+@functools.partial(jax.jit, static_argnames=("z",))
+def _outlier_residual(w, idx, scales, z: float):
+    rows = jnp.take(w, idx, axis=0).astype(jnp.float32)
+    srows = jnp.take(scales, idx // NF4_BLOCK, axis=0).astype(jnp.float32)
+    return (rows - jnp.float32(z) * srows).astype(jnp.bfloat16)
+
+
 def quantize_with_outliers(w: jnp.ndarray, base_kind: str) -> OutlierQuantLinear:
     """4-bit ``base_kind`` with the top in/64 input channels kept dense (as
     residuals against the packed decode — see OutlierQuantLinear). The
-    residual needs the inner's decoded rows: one transient full dequantize,
-    the same f32-weight-sized transient the encode itself already makes."""
+    residual against the zeroed rows' decode is pure arithmetic
+    (_zero_decode_value * the rows' block scales) — the first cut
+    materialized a full dense f32 dequantize for it, and that one eager
+    [in, out] f32 transient (~1 GiB per 70B-shape matmul, on top of the
+    encode's own jit-internal pass) is what pushed 10-block nf4a+o loads
+    over the 16 GiB chip (r5 on-chip OOM)."""
     w = jnp.asarray(w)
     n_in, n_out = w.shape
     k = max(n_in // OUTLIER_DIVISOR, 1)
-    mags = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=1)
-    _, idx = jax.lax.top_k(mags, k)
-    idx = jnp.sort(idx).astype(jnp.int32)
+    idx = _outlier_idx(w, k)
     main = w.at[idx].set(0)
     inner = quantize(main, base_kind)
-    decoded_rows = jnp.take(dequantize(inner, jnp.float32), idx, axis=0)
-    residual = jnp.take(w, idx, axis=0).astype(jnp.float32) - decoded_rows
-    return OutlierQuantLinear(inner, idx, residual.astype(jnp.bfloat16))
+    residual = _outlier_residual(w, idx, inner.scales, _zero_decode_value(base_kind))
+    return OutlierQuantLinear(inner, idx, residual)
 
 
 # ----------------------------------------------------------------------------------
@@ -762,32 +797,39 @@ def _packed4_decode_kernel(
 
     lo, hi = _extract_codes(packed_ref[...])
     dot_dtype = jnp.float32 if dot_in_f32 else jnp.bfloat16
-    c3_lo = c3_hi = None
     if kind == "int4":
         c_lo = lo.astype(dot_dtype)
         c_hi = hi.astype(dot_dtype)
     elif kind == "nf4a":
-        # cubic map via TWO code planes, both built arithmetically (no
-        # gather): out_b = s_b * (A * (x . d) + B * (x . d^3)), d = c - 7.5.
-        # d is a half-integer <= 7.5 (exact in bf16); d^3 <= 421.875 rounds
-        # at bf16's 8-bit mantissa to <= 1 ulp -> level error <= ~1e-3*B,
-        # two decades under the quantization step (same rounding class as
-        # the bf16 value cast every other kind already pays).
+        # ONE-plane cubic decode: 5 f32 VPU ops per element and F32 dots.
+        # v = B * d * (K + d^2), K = A/B — the B fold rides the per-block
+        # scales (64x fewer elements), and skipping the f32->bf16 cast of
+        # the code plane (dot in f32 instead) is the decisive cut. The r5
+        # on-chip variant ladder at 70B-span scale (10 stacked blocks, M=1):
+        # two-plane bf16 dots 235 GB/s, one-plane f32 poly + bf16 cast 298,
+        # full-bf16 chain 171 (Mosaic bf16 elementwise runs ~2x SLOWER than
+        # f32), one-plane f32 poly + f32 dots 398. Per-element VPU op count
+        # x op width is the whole cost model; the tiny [tm,hb]@[hb,tn] M=1
+        # dots are latency-bound and near-free even in f32, so trading two
+        # bf16 dots for two f32 dots to delete one full-width cast wins.
+        # Values are the EXACT f32 cubic (no bf16 level rounding at all) —
+        # strictly closer to NF4A_CODE than the XLA fallback's bf16 cast.
         dl = lo.astype(jnp.float32) - 7.5
         dh = hi.astype(jnp.float32) - 7.5
-        c_lo = dl.astype(dot_dtype)
-        c_hi = dh.astype(dot_dtype)
-        c3_lo = (dl * dl * dl).astype(dot_dtype)
-        c3_hi = (dh * dh * dh).astype(dot_dtype)
+        kk = jnp.float32(NF4A_A / NF4A_B)
+        c_lo = dl * (kk + dl * dl)
+        c_hi = dh * (kk + dh * dh)
     else:
         c_lo = _gather_decode(lo, table_ref).astype(jnp.bfloat16).astype(dot_dtype)
         c_hi = _gather_decode(hi, table_ref).astype(jnp.bfloat16).astype(dot_dtype)
 
     xe = xe_ref[...]
     xo = xo_ref[...]
-    if dot_in_f32:
+    if dot_in_f32 or kind == "nf4a":  # nf4a's code plane stays f32 (see above)
         xe, xo = xe.astype(jnp.float32), xo.astype(jnp.float32)
     scales = scales_ref[...].astype(jnp.float32)  # [nb, tn]
+    if kind == "nf4a":
+        scales = scales * jnp.float32(NF4A_B)  # the kk-fold's B factor
     acc = acc_ref[...]
     for b in range(nb):
         p = jax.lax.dot_general(
@@ -798,16 +840,6 @@ def _packed4_decode_kernel(
             xo[:, b * hb:(b + 1) * hb], c_hi[b * hb:(b + 1) * hb, :],
             (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         )
-        if kind == "nf4a":
-            p3 = jax.lax.dot_general(
-                xe[:, b * hb:(b + 1) * hb], c3_lo[b * hb:(b + 1) * hb, :],
-                (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-            )
-            p3 += jax.lax.dot_general(
-                xo[:, b * hb:(b + 1) * hb], c3_hi[b * hb:(b + 1) * hb, :],
-                (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-            )
-            p = NF4A_A * p + NF4A_B * p3
         acc += p * scales[b:b + 1, :]
     if kind == "int4":
         xs = xs_ref[...].astype(jnp.float32)  # [nb, tm] per-block x sums
