@@ -304,12 +304,35 @@ def _encode_4bit(w: jnp.ndarray, kind: str):
     return packed, scales.astype(jnp.bfloat16)
 
 
+# Encode in column chunks past this size: _encode_4bit's jit materializes an
+# f32 copy of the weight, and at 405B shapes (the fused gate+up is 16384 x
+# 106496 = 1.7G elements) that one ~7 GiB transient — on top of the dense
+# block still resident during load — pushed quantize-at-load over the 16 GiB
+# chip (r5 on-chip OOM in the chain-hop bench; same math applies to real
+# server loads). The encode is exactly column-separable (blocks run along
+# the input axis), so chunking changes no bit of the output.
+_ENCODE_CHUNK_ELEMS = 1 << 28  # f32 transient per chunk <= ~1 GiB
+
+
+def _encode_4bit_chunked(w: jnp.ndarray, kind: str):
+    n_stored, n_out = w.shape
+    if w.size <= _ENCODE_CHUNK_ELEMS:
+        return _encode_4bit(w, kind)
+    cols = max(_ENCODE_CHUNK_ELEMS // n_stored, 1)
+    packed_parts, scale_parts = [], []
+    for j in range(0, n_out, cols):
+        p, s = _encode_4bit(w[:, j:j + cols], kind)
+        packed_parts.append(p)
+        scale_parts.append(s)
+    return jnp.concatenate(packed_parts, axis=1), jnp.concatenate(scale_parts, axis=1)
+
+
 def quantize_nf4(w: jnp.ndarray) -> QuantizedLinear:
     """Blockwise-64 NF4 along the input axis (w: [in, out], in % 64 == 0)."""
     w = jnp.asarray(w)
     n_in, n_out = w.shape
     w, n_stored = _pad_rows(w)
-    packed, scales = _encode_4bit(w, "nf4")
+    packed, scales = _encode_4bit_chunked(w, "nf4")
     return QuantizedLinear("nf4", packed, scales, n_in, n_out)
 
 
@@ -320,7 +343,7 @@ def quantize_int4(w: jnp.ndarray) -> QuantizedLinear:
     w = jnp.asarray(w)
     n_in, n_out = w.shape
     w, n_stored = _pad_rows(w)
-    packed, scales = _encode_4bit(w, "int4")
+    packed, scales = _encode_4bit_chunked(w, "int4")
     return QuantizedLinear("int4", packed, scales, n_in, n_out)
 
 
@@ -330,7 +353,7 @@ def quantize_nf4a(w: jnp.ndarray) -> QuantizedLinear:
     w = jnp.asarray(w)
     n_in, n_out = w.shape
     w, n_stored = _pad_rows(w)
-    packed, scales = _encode_4bit(w, "nf4a")
+    packed, scales = _encode_4bit_chunked(w, "nf4a")
     return QuantizedLinear("nf4a", packed, scales, n_in, n_out)
 
 

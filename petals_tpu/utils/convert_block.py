@@ -99,7 +99,15 @@ def convert_block_params(
     quantizable = QUANTIZABLE_LEAVES.get(arch, set()) | {"wqkv", "wgu"}
     out = {}
     n_quantized = 0
-    for name, leaf in params.items():
+    # consume OUR view of the dict leaf by leaf so each dense weight can be
+    # freed as soon as its quantized form exists — at 405B shapes the dense
+    # block alone is ~6.4 GiB, and holding every dense leaf until the loop
+    # ends (while packed leaves accumulate) is part of what pushed
+    # quantize-at-load past the 16 GiB chip (see _encode_4bit_chunked). Only
+    # helps when the caller drops its own reference, which the load paths do.
+    params = dict(params)
+    for name in list(params):
+        leaf = params.pop(name)
         ndim = getattr(leaf, "ndim", 0)
         if name in quantizable and ndim == 2:
             out[name] = quantize(jnp.asarray(leaf), quant_type.value)
