@@ -239,9 +239,12 @@ def rehearsal_report(bench_details: Optional[dict] = None) -> Dict:
         # hold a 1 ms floor rather than projecting near-free hops
         hop_sw = max(float(chain["hop_software_ms"]), 1.0)
         hop_ms = hop_sw + WIRE_RTT_MS_DCN
+        floored = (
+            " (floored at 1.0 vs measurement noise)"
+            if hop_sw != float(chain["hop_software_ms"]) else ""
+        )
         hop_source = (
-            f"measured software {chain['hop_software_ms']} ms "
-            f"(floored at 1.0 vs measurement noise) "
+            f"measured software {chain['hop_software_ms']} ms{floored} "
             f"+ assumed wire {WIRE_RTT_MS_DCN} ms"
         )
 

@@ -107,6 +107,8 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Lane length in tokens (default: min(inference_max_length, 1024))")
     parser.add_argument("--prefix_cache_bytes", type=int, default=256 * 2**20,
                         help="Host-RAM prompt-prefix cache budget; 0 disables")
+    parser.add_argument("--prefix_device_bytes", type=int, default=256 * 2**20,
+                        help="HBM tier of the prefix cache (device-resident hit seeding); 0 disables")
     parser.add_argument("--prefix_share_scope", choices=["swarm", "peer"], default="swarm",
                         help="'swarm' shares cached prefixes across all clients (fastest; a client "
                              "can time-probe whether a prompt prefix was recently served); 'peer' "
@@ -202,6 +204,7 @@ def main(argv=None) -> None:
         batch_max_length=args.batch_max_length,
         prefix_cache_bytes=args.prefix_cache_bytes,
         prefix_share_scope=args.prefix_share_scope,
+        prefix_device_bytes=args.prefix_device_bytes,
     )
 
     async def run():

@@ -63,6 +63,7 @@ class TransformerHandler:
         batch_max_length: Optional[int] = None,  # pool lane length (tokens)
         prefix_cache_bytes: int = 256 * 2**20,  # 0 disables prefix caching
         prefix_share_scope: str = "swarm",  # "swarm" shares across clients; "peer" salts per client
+        prefix_device_bytes: int = 256 * 2**20,  # HBM tier of the prefix cache; 0 disables
     ):
         self.backend = backend
         self.dht_prefix = dht_prefix
@@ -123,7 +124,9 @@ class TransformerHandler:
         if prefix_cache_bytes > 0:
             from petals_tpu.server.prefix_cache import PrefixCache
 
-            self.prefix_cache = PrefixCache(prefix_cache_bytes)
+            self.prefix_cache = PrefixCache(
+                prefix_cache_bytes, device_max_bytes=prefix_device_bytes
+            )
 
     async def swap_backend(self, new_backend) -> None:
         """Retarget the handler at a freshly built backend (span reload /
@@ -384,6 +387,27 @@ class TransformerHandler:
         self.memory_cache.update_cache(handles[1], new_v)
         return (new_k, new_v)
 
+    def _seed_session_kv_device(self, kv, handles, kd_list, vd_list, new_position: int):
+        """Prefix-hit seeding entirely on device: concatenate the HBM-resident
+        segment slices and write them into fresh zeroed buffers. No
+        host->device transfer — the host staging route uploads the whole
+        max_length-shaped buffer, which on slow links costs as much as the
+        skipped prefill (single-device private sessions only; the device tier
+        is only populated on that path)."""
+        import jax.numpy as jnp
+
+        k_buf, v_buf = kv
+
+        def seed(parts, buf):
+            pref = jnp.concatenate(parts, axis=2).astype(buf.dtype)
+            return jnp.zeros(buf.shape, buf.dtype).at[:, :, :new_position].set(pref)
+
+        new_k = seed(kd_list, k_buf)
+        new_v = seed(vd_list, v_buf)
+        self.memory_cache.update_cache(handles[0], new_k)
+        self.memory_cache.update_cache(handles[1], new_v)
+        return (new_k, new_v)
+
     async def _store_prefix_async(
         self, keys, n_hit: int, boundary: int, lane, handles, out_full, n_blocks: int,
         batcher=None,
@@ -424,8 +448,27 @@ class TransformerHandler:
         from petals_tpu.server.prefix_cache import SEGMENT_TOKENS
 
         L = n_hit * SEGMENT_TOKENS
+        # device tier: single-device private sessions only — lane snapshots
+        # are host-side, lockstep mirrors are per-process shards, and sliced
+        # TP-sharded buffers would pin sharded HBM references of unclear
+        # placement. The slices are lazy device copies of the session's
+        # buffers, so they stay valid after the session's cache is freed.
+        k_dev = v_dev = None
+        if (
+            lane is None
+            and not getattr(self.backend, "is_lockstep", False)
+            and getattr(self.backend, "mesh", None) is None
+            and self.prefix_cache.device_max_bytes > 0
+        ):
+            try:
+                k_buf, v_buf = self.memory_cache.get_buffers(*handles)
+                k_dev = k_buf[:, :, L:boundary]
+                v_dev = v_buf[:, :, L:boundary]
+            except Exception:
+                k_dev = v_dev = None
         self.prefix_cache.put(
-            keys, n_hit, k[:, :, L:], v[:, :, L:], out_full[:, L:boundary]
+            keys, n_hit, k[:, :, L:], v[:, :, L:], out_full[:, L:boundary],
+            k_dev=k_dev, v_dev=v_dev,
         )
 
     async def _snapshot_session(
@@ -903,14 +946,46 @@ class TransformerHandler:
                         if pc_hits:
                             hit_len = pc_hits * SEGMENT_TOKENS
                             pc_entries = self.prefix_cache.get_entries(pc_keys, pc_hits)
-                            k_pre, v_pre, prefix_out = await asyncio.to_thread(
-                                self.prefix_cache.concat_entries, pc_entries
+                            # device-tier refs resolve HERE, on the loop, for
+                            # the same reason the entries do: a concurrent
+                            # eviction pops dict fields, and a held array
+                            # reference survives that where a later lookup
+                            # would not
+                            kd_list = [e.get("kd") for e in pc_entries]
+                            vd_list = [e.get("vd") for e in pc_entries]
+                            use_device = (
+                                lane is None
+                                and not getattr(self.backend, "is_lockstep", False)
+                                # mesh guard mirrors the store path: after a
+                                # swap_backend onto a TP mesh, surviving
+                                # device entries must not seed unsharded
+                                # buffers into a sharded session
+                                and getattr(self.backend, "mesh", None) is None
+                                and all(x is not None for x in kd_list)
                             )
-                            kv = await self._seed_session_kv(
-                                lane, kv, handles, k_pre, v_pre, hit_len,
-                                batch_size=batch_size, n_blocks=end - start,
-                                batcher=batcher,
-                            )
+                            if use_device:
+                                # whole prefix HBM-resident: zero host->device
+                                # traffic; only `out` rides from host RAM
+                                self.prefix_cache.stats["device_hits"] = (
+                                    self.prefix_cache.stats.get("device_hits", 0) + 1
+                                )
+                                prefix_out = await asyncio.to_thread(
+                                    lambda: np.concatenate(
+                                        [e["out"] for e in pc_entries], axis=1
+                                    )
+                                )
+                                kv = self._seed_session_kv_device(
+                                    kv, handles, kd_list, vd_list, hit_len
+                                )
+                            else:
+                                k_pre, v_pre, prefix_out = await asyncio.to_thread(
+                                    self.prefix_cache.concat_entries, pc_entries
+                                )
+                                kv = await self._seed_session_kv(
+                                    lane, kv, handles, k_pre, v_pre, hit_len,
+                                    batch_size=batch_size, n_blocks=end - start,
+                                    batcher=batcher,
+                                )
                             exec_hidden = hidden[:, hit_len:]
                             pos = hit_len
 

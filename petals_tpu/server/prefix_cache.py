@@ -61,12 +61,25 @@ def segment_keys(hidden: np.ndarray, salt: str) -> List[str]:
 
 
 class PrefixCache:
-    """LRU store of per-segment (k, v, out) host arrays, budgeted by bytes."""
+    """LRU store of per-segment (k, v, out) host arrays, budgeted by bytes.
 
-    def __init__(self, max_bytes: int):
+    A second, smaller DEVICE tier (``device_max_bytes``) keeps the most
+    recently stored segments' k/v additionally resident in HBM: a hit whose
+    whole prefix is device-resident seeds the session without any
+    host->device transfer, which is what makes a prefix hit decisively
+    cheaper than the prefill it skips (measured on the axon tunnel: the
+    host-tier hit's KV re-upload cost about as much as the skipped compute
+    — 1.04x TTFT; on local PCIe the transfer is cheaper but still the
+    dominant hit cost at long prefixes). Device entries are an optimization
+    only: eviction drops the HBM reference, the host copy stays, and the
+    seed path falls back to the host staging route."""
+
+    def __init__(self, max_bytes: int, device_max_bytes: int = 0):
         self.max_bytes = max_bytes
+        self.device_max_bytes = device_max_bytes
         self._store: "OrderedDict[str, dict]" = OrderedDict()
         self._bytes = 0
+        self._dev_bytes = 0
         self.stats = {"hits": 0, "misses": 0, "hit_tokens": 0, "stored_segments": 0}
 
     @property
@@ -110,15 +123,27 @@ class PrefixCache:
         """get_entries + concat_entries in one call (single-threaded users)."""
         return self.concat_entries(self.get_entries(keys, n))
 
-    def put(self, keys: Sequence[str], first: int, k: np.ndarray, v: np.ndarray, out: np.ndarray) -> None:
+    def put(
+        self, keys: Sequence[str], first: int,
+        k: np.ndarray, v: np.ndarray, out: np.ndarray,
+        k_dev=None, v_dev=None,
+    ) -> None:
         """Store segments [first, len(keys)) from span-shaped arrays COVERING
         those segments: k/v [n_blocks, 1, tokens, hkv, d] and out
-        [1, tokens, hidden] whose token axis starts at segment ``first``."""
+        [1, tokens, hidden] whose token axis starts at segment ``first``.
+        ``k_dev``/``v_dev``, when given, are the same token range as DEVICE
+        arrays; their per-segment slices populate the device tier."""
         for i, key in enumerate(keys[first:]):
+            t0, t1 = i * SEGMENT_TOKENS, (i + 1) * SEGMENT_TOKENS
             if key in self._store:
                 self._store.move_to_end(key)
+                # a hot entry first stored host-only (pooled/lockstep store,
+                # or after device eviction) gains HBM residency on its next
+                # device-capable store — otherwise popular prefixes would be
+                # locked out of the tier forever while one-offs fill it
+                if t1 <= k.shape[2]:
+                    self._attach_device(self._store[key], k_dev, v_dev, t0, t1)
                 continue
-            t0, t1 = i * SEGMENT_TOKENS, (i + 1) * SEGMENT_TOKENS
             if t1 > k.shape[2]:
                 break
             entry = {
@@ -132,15 +157,46 @@ class PrefixCache:
             while self._bytes + entry_bytes > self.max_bytes and self._store:
                 _, old = self._store.popitem(last=False)
                 self._bytes -= old["bytes"]
+                self._dev_bytes -= old.pop("dev_bytes", 0)
             entry["bytes"] = entry_bytes
+            self._attach_device(entry, k_dev, v_dev, t0, t1)
             self._store[key] = entry
             self._bytes += entry_bytes
             self.stats["stored_segments"] += 1
+
+    def _attach_device(self, entry: dict, k_dev, v_dev, t0: int, t1: int) -> None:
+        """Pin the [t0, t1) token slice of the device arrays onto ``entry``
+        (no-op without device arrays, budget, or when already resident)."""
+        if k_dev is None or self.device_max_bytes <= 0 or "kd" in entry:
+            return
+        kd = k_dev[:, :, t0:t1]
+        vd = v_dev[:, :, t0:t1]
+        dev_bytes = int(kd.nbytes) + int(vd.nbytes)
+        if dev_bytes <= self.device_max_bytes:
+            self._evict_device(self.device_max_bytes - dev_bytes)
+            entry["kd"], entry["vd"] = kd, vd
+            entry["dev_bytes"] = dev_bytes
+            self._dev_bytes += dev_bytes
+
+    def _evict_device(self, target_bytes: int) -> None:
+        """Drop HBM references (oldest first) until the device tier fits
+        ``target_bytes``; host copies stay, so this only downgrades hits."""
+        if self._dev_bytes <= target_bytes:
+            return
+        for entry in list(self._store.values()):
+            if self._dev_bytes <= target_bytes:
+                break
+            dev = entry.pop("dev_bytes", 0)
+            if dev:
+                entry.pop("kd", None)
+                entry.pop("vd", None)
+                self._dev_bytes -= dev
 
     def clear(self) -> None:
         """Drop every entry (stats are kept — they describe the lifetime)."""
         self._store.clear()
         self._bytes = 0
+        self._dev_bytes = 0
 
     def worth_storing(self, keys: Sequence[str], first: int, est_entry_bytes: int) -> bool:
         """Whether a store pass would actually add anything: at least one
@@ -155,5 +211,8 @@ class PrefixCache:
             "segments": len(self._store),
             "bytes": self._bytes,
             "max_bytes": self.max_bytes,
+            "device_segments": sum(1 for e in self._store.values() if "kd" in e),
+            "device_bytes": self._dev_bytes,
+            "device_max_bytes": self.device_max_bytes,
             **self.stats,
         }

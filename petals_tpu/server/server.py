@@ -88,6 +88,7 @@ class Server:
         batch_max_length: Optional[int] = None,  # pool lane length; None: min(inference_max_length, 1024)
         prefix_cache_bytes: int = 256 * 2**20,  # host-RAM prompt-prefix cache; 0 disables
         prefix_share_scope: str = "swarm",  # "peer" isolates the prefix cache per client identity
+        prefix_device_bytes: int = 256 * 2**20,  # HBM tier of the prefix cache; 0 disables
     ):
         self.num_hosts = num_hosts or 1
         self.coordinator_address = coordinator_address
@@ -176,6 +177,7 @@ class Server:
         self.batch_max_length = batch_max_length
         self.prefix_cache_bytes = prefix_cache_bytes
         self.prefix_share_scope = prefix_share_scope
+        self.prefix_device_bytes = prefix_device_bytes
         self.request_timeout = request_timeout
         self.session_timeout = session_timeout
         self.step_timeout = step_timeout
@@ -600,6 +602,7 @@ class Server:
             batch_max_length=batch_max_length,
             prefix_cache_bytes=self.prefix_cache_bytes,
             prefix_share_scope=self.prefix_share_scope,
+            prefix_device_bytes=self.prefix_device_bytes,
         )
 
     def _make_raw_backend(self, stacked, first_block: int) -> TransformerBackend:

@@ -67,16 +67,29 @@ def test_report_consumes_measured_bench_rows():
     details = {
         "decode_70b_int4": {"weight_stream_gb_s": 350.0},
         "decode_70b_nf4": {"weight_stream_gb_s": 110.0},
-        "decode_70b_bf16": {"weight_stream_gb_s": 790.0},
         "e2e_8xllama7b": {"device_step_ms": 7.18, "weight_gb": 3.02},
     }
     report = rehearsal_report(details)
     by_quant = {r["quant"]: r for r in report["projection"] if r["chip_gb_s"] not in (400.0, 790.0)}
     assert by_quant["int4"]["chip_gb_s"] == 350.0
     assert by_quant["nf4"]["chip_gb_s"] == 110.0
-    # measured e2e gap becomes the overhead fraction (device_step vs bound)
-    assert 0.5 < by_quant["int4"]["device_overhead_frac"] < 1.2
+    # NO extra overhead multiplier on measured rows: the decode_70b rates
+    # divide weights by the FULL block step, so block extras are already in
+    # the rate (an e2e-derived multiplier double-counted them, r5)
+    assert by_quant["int4"]["device_overhead_frac"] == 0.0
     assert report["north_star"]["min_chip_gb_s_for_target"] > 0
+
+
+def test_report_floors_measured_hop_against_noise():
+    """The chain row's software-hop derivation subtracts two tunnel-sync-sized
+    measurements; a tiny result must be floored (1 ms) rather than projecting
+    near-free hops, and a solidly-measured hop must pass through unfloored."""
+    base = {"decode_70b_int4": {"weight_stream_gb_s": 350.0}}
+    noisy = rehearsal_report({**base, "chain_hop_405b_shapes": {"hop_software_ms": 0.015}})
+    assert noisy["north_star"]["hop_ms"] == 1.5  # 1.0 floor + 0.5 wire
+    assert "floored" in noisy["north_star"]["hop_source"]
+    solid = rehearsal_report({**base, "chain_hop_405b_shapes": {"hop_software_ms": 3.0}})
+    assert solid["north_star"]["hop_ms"] == 3.5
 
 
 def test_outlier_quant_row_key_translation():

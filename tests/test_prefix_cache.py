@@ -51,6 +51,43 @@ def test_lru_eviction():
     assert cache.current_bytes <= cache.max_bytes
 
 
+def test_device_tier_budget_eviction_and_fallback():
+    """Device-tier refs respect their own byte budget, evict oldest-first
+    without touching the host copies, and a partial device prefix still
+    serves from host (all-or-nothing check is the seeder's, not put's)."""
+    rng = np.random.RandomState(2)
+    seg_kv = rng.randn(2, 1, SEGMENT_TOKENS, 2, 4).astype(np.float32)
+    seg_out = rng.randn(1, SEGMENT_TOKENS, 8).astype(np.float32)
+    dev_seg_bytes = 2 * seg_kv.nbytes
+    cache = PrefixCache(max_bytes=1 << 20, device_max_bytes=2 * dev_seg_bytes + 10)
+    kd = jnp.asarray(seg_kv)
+    for i in range(4):
+        cache.put([f"k{i}"], 0, seg_kv, seg_kv, seg_out, k_dev=kd, v_dev=kd)
+    s = cache.summary()
+    assert s["segments"] == 4  # host tier keeps all
+    assert s["device_segments"] == 2  # device tier holds the newest two
+    assert s["device_bytes"] <= cache.device_max_bytes
+    assert "kd" not in cache._store["k0"] and "kd" in cache._store["k3"]
+    # evicted entries still serve from host
+    k, v, out = cache.get_range(["k0"], 1)
+    np.testing.assert_array_equal(k, seg_kv)
+    # device refs decode to the same values as the host copies
+    np.testing.assert_allclose(np.asarray(cache._store["k3"]["kd"]), seg_kv)
+    # zero budget: no device refs at all
+    c2 = PrefixCache(max_bytes=1 << 20, device_max_bytes=0)
+    c2.put(["a"], 0, seg_kv, seg_kv, seg_out, k_dev=kd, v_dev=kd)
+    assert c2.summary()["device_segments"] == 0
+    # a host-only entry (stored by a pooled/lockstep path) gains device refs
+    # on a later device-capable store of the same key — hot prefixes must not
+    # be locked out of the tier by whoever stored them first
+    c3 = PrefixCache(max_bytes=1 << 20, device_max_bytes=1 << 20)
+    c3.put(["a"], 0, seg_kv, seg_kv, seg_out)
+    assert c3.summary()["device_segments"] == 0
+    c3.put(["a"], 0, seg_kv, seg_kv, seg_out, k_dev=kd, v_dev=kd)
+    assert c3.summary()["device_segments"] == 1
+    assert c3.stats["stored_segments"] == 1  # re-attach is not a new store
+
+
 async def _start_server(model_path, **kwargs):
     server = Server(model_path, compute_dtype=jnp.float32, use_flash=False, **kwargs)
     await server.start()
@@ -102,6 +139,12 @@ def test_shared_prefix_skips_compute_token_identical(model_path, batching):
 
             out2 = await _one_session(client, uids, p2, [step])
             assert pc.stats["hit_tokens"] == 2 * SEGMENT_TOKENS, pc.summary()
+            if not batching:
+                # private single-device sessions must hit the DEVICE tier
+                # (zero host->device seeding); pooled sessions use lanes and
+                # serve from host
+                assert pc.summary()["device_segments"] == 2, pc.summary()
+                assert pc.stats.get("device_hits", 0) == 1, pc.summary()
 
             # ground truth: full uncached compute for session 2
             backend = server.backend
