@@ -10,11 +10,12 @@ export PYTHONPATH=/root/.axon_site:.
 echo "== 1/5 probe =="
 timeout 120 python -c "import jax; assert jax.default_backend() == 'tpu', jax.default_backend(); print('tpu up')" || exit 1
 
-# bench FIRST: BENCH_DETAILS + the metric line are the round's critical
-# artifacts — if the tunnel dies again (or the round ends) mid-queue, they
-# must already be captured; ablations are diagnosis, not evidence of record
+# bench FIRST: metric line + detail rows land incrementally (per-row
+# subprocess isolation), and the supervisor runs the exactness smoke at the
+# end; the inner carries the previous run's smoke verdict forward into the
+# fresh BENCH_DETAILS, so a tunnel death mid-bench cannot erase it
 echo "== 2/5 bench (metric + BENCH_DETAILS + 405B projection + smoke) =="
-timeout 3600 env _PTU_BENCH_TIMEOUT=2400 python bench.py
+timeout 5400 env _PTU_BENCH_TIMEOUT=4200 python bench.py
 
 echo "== 3/5 backend-step ablation (int4; VERDICT weak #2 breakdown) =="
 timeout 1200 python benchmarks/ablate_backend_step.py 2>&1 | grep -v WARNING | tail -6
@@ -90,5 +91,13 @@ print(f"int8 kernel 8192x28672 decode: {sec*1e3:.3f} ms, {gbs:.0f} GB/s ({100*gb
 EOF
 echo "== 5/5 flash head-to-head (ours vs jax official, tile sweep) =="
 timeout 1200 python benchmarks/ablate_flash.py 2>&1 | grep -v WARNING | tail -6
+
+echo "== 5b/5 per-call overhead ablation (nf4a full-row 304 vs pure-span 391 gap) =="
+# one PROCESS per variant: freed multi-GiB buffers are not reliably reclaimed
+# within a process over the tunnel (the bench's per-row-subprocess lesson)
+for v in one four real; do
+  timeout 600 env QUANT_KIND=nf4a python benchmarks/ablate_call_overhead.py "$v" 2>&1 | grep -v WARNING | tail -1
+done
+timeout 600 env QUANT_KIND=int4 python benchmarks/ablate_call_overhead.py one 2>&1 | grep -v WARNING | tail -1
 
 echo "== revival queue done =="

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Probe the axon tunnel every ~3 minutes; the moment it answers, run the
+# revival queue (benchmarks/on_tunnel_revival.sh) once and exit. Detach with:
+#   setsid nohup bash benchmarks/revival_watch.sh > revival_watch.log 2>&1 &
+cd "$(dirname "$0")/.."
+export PYTHONPATH=/root/.axon_site:.
+while true; do
+  if timeout 90 python -c "import jax, numpy as np, jax.numpy as jnp; np.asarray(jnp.ones((2,2)) @ jnp.ones((2,2))); assert jax.default_backend() == 'tpu'" 2>/dev/null; then
+    echo "[watch] tunnel up at $(date -u +%FT%TZ); running revival queue"
+    bash benchmarks/on_tunnel_revival.sh
+    echo "[watch] revival queue done at $(date -u +%FT%TZ)"
+    exit 0
+  fi
+  echo "[watch] tunnel down at $(date -u +%FT%TZ); retrying in 180s"
+  sleep 180
+done
