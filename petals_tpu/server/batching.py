@@ -504,12 +504,20 @@ class DecodeBatcher:
                         self._release_temp(state.get("temp"))
         return results
 
-    async def snapshot_lane(self, lane: int, position: int, b0: int, b1: int):
+    async def snapshot_lane(
+        self, lane: int, position: int, b0: int, b1: int,
+        *, return_device: bool = False,
+    ):
         """Host copy of blocks [b0, b1) of a lane, sliced to ``position``
         (KV export/migration for pooled sessions). Under lockstep the lane's
         shards live on every process: a read-only extract registers a temp
         mirror, the export all_gather runs through it, and the temp is
-        released (never inserted back — nothing was modified)."""
+        released (never inserted back — nothing was modified).
+
+        ``return_device=True`` returns ``(k, v, k_dev, v_dev)`` where the
+        device pair are the same slices still resident in HBM (None under
+        lockstep, whose shards are per-process) — the prefix cache's device
+        tier pins these so a later hit can seed without re-uploading."""
 
         self._check_lane(lane)
 
@@ -522,14 +530,14 @@ class DecodeBatcher:
                     k, v = self.backend.export_kv(
                         temp, lambda: kv_lane, b0, b1, position
                     )
-                    return k, v
+                    return (k, v, None, None) if return_device else (k, v)
                 finally:
                     self.backend.release_temp(temp[0])
             k_pool, v_pool = self._buffers()
             k, v = self.backend._lane_extract_fn(k_pool, v_pool, np.int32(lane))
-            return (
-                np.asarray(k[b0:b1, :, :position]),
-                np.asarray(v[b0:b1, :, :position]),
-            )
+            kd = k[b0:b1, :, :position]
+            vd = v[b0:b1, :, :position]
+            host = (np.asarray(kd), np.asarray(vd))
+            return (*host, kd, vd) if return_device else host
 
         return await self.queue.submit(run, priority=PRIORITY_INFERENCE, size=0)

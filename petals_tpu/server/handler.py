@@ -387,23 +387,49 @@ class TransformerHandler:
         self.memory_cache.update_cache(handles[1], new_v)
         return (new_k, new_v)
 
+    @staticmethod
+    def _build_device_seed(parts, shape, dtype, new_position: int):
+        """Fresh zeroed buffer of ``shape`` with the HBM-resident prefix
+        slices concatenated into rows [0, new_position) — the single seed
+        construction every device-tier path shares."""
+        import jax.numpy as jnp
+
+        pref = jnp.concatenate(parts, axis=2).astype(dtype)
+        return jnp.zeros(shape, dtype).at[:, :, :new_position].set(pref)
+
+    async def _seed_lane_kv_device(
+        self, batcher, lane, kd_list, vd_list, new_position: int,
+        batch_size: int, n_blocks: int,
+    ):
+        """Pooled-lane twin of _seed_session_kv_device: build the lane-shaped
+        buffer on device from the HBM-resident prefix slices and check it in
+        wholesale — the host route builds a max_length-sized zeros array and
+        uploads all of it."""
+        import jax.numpy as jnp
+
+        backend0 = batcher.backend
+        lane_shape = (
+            n_blocks, batch_size, batcher.max_length,
+            backend0.num_kv_heads, backend0.head_dim,
+        )
+        cache_dtype = jnp.dtype(backend0.cache_dtype)
+        new_k = self._build_device_seed(kd_list, lane_shape, cache_dtype, new_position)
+        new_v = self._build_device_seed(vd_list, lane_shape, cache_dtype, new_position)
+
+        def replace(kv_lane, lane_handles):
+            return None, (new_k, new_v)
+
+        await batcher.run_exclusive(lane, replace, extract=False)
+
     def _seed_session_kv_device(self, kv, handles, kd_list, vd_list, new_position: int):
         """Prefix-hit seeding entirely on device: concatenate the HBM-resident
         segment slices and write them into fresh zeroed buffers. No
         host->device transfer — the host staging route uploads the whole
         max_length-shaped buffer, which on slow links costs as much as the
-        skipped prefill (single-device private sessions only; the device tier
-        is only populated on that path)."""
-        import jax.numpy as jnp
-
+        skipped prefill."""
         k_buf, v_buf = kv
-
-        def seed(parts, buf):
-            pref = jnp.concatenate(parts, axis=2).astype(buf.dtype)
-            return jnp.zeros(buf.shape, buf.dtype).at[:, :, :new_position].set(pref)
-
-        new_k = seed(kd_list, k_buf)
-        new_v = seed(vd_list, v_buf)
+        new_k = self._build_device_seed(kd_list, k_buf.shape, k_buf.dtype, new_position)
+        new_v = self._build_device_seed(vd_list, v_buf.shape, v_buf.dtype, new_position)
         self.memory_cache.update_cache(handles[0], new_k)
         self.memory_cache.update_cache(handles[1], new_v)
         return (new_k, new_v)
@@ -417,9 +443,23 @@ class TransformerHandler:
         awaits it before executing any LATER step of the same session, so the
         stored rows always match the content hash (content-addressed: a
         rollback later cannot poison the mapping)."""
+        lane_k_dev = lane_v_dev = None
         try:
             if lane is not None:
-                k, v = await batcher.snapshot_lane(lane, boundary, 0, n_blocks)
+                # guard on the BATCHER's backend: the session captured its
+                # batcher at open, and swap_backend can retarget self.backend
+                # while this snapshot still reads the old pool
+                lane_backend = batcher.backend
+                if (
+                    self.prefix_cache.device_max_bytes > 0
+                    and getattr(lane_backend, "mesh", None) is None
+                    and not getattr(lane_backend, "is_lockstep", False)
+                ):
+                    k, v, lane_k_dev, lane_v_dev = await batcher.snapshot_lane(
+                        lane, boundary, 0, n_blocks, return_device=True
+                    )
+                else:
+                    k, v = await batcher.snapshot_lane(lane, boundary, 0, n_blocks)
             elif getattr(self.backend, "is_lockstep", False):
                 # multihost: per-shard all_gather (v2 export op), bounded to
                 # the 128-bucketed boundary inside export_kv
@@ -454,9 +494,12 @@ class TransformerHandler:
         # placement. The slices are lazy device copies of the session's
         # buffers, so they stay valid after the session's cache is freed.
         k_dev = v_dev = None
-        if (
-            lane is None
-            and not getattr(self.backend, "is_lockstep", False)
+        if lane is not None:
+            if lane_k_dev is not None:
+                k_dev = lane_k_dev[:, :, L:]
+                v_dev = lane_v_dev[:, :, L:]
+        elif (
+            not getattr(self.backend, "is_lockstep", False)
             and getattr(self.backend, "mesh", None) is None
             and self.prefix_cache.device_max_bytes > 0
         ):
@@ -953,14 +996,16 @@ class TransformerHandler:
                             # would not
                             kd_list = [e.get("kd") for e in pc_entries]
                             vd_list = [e.get("vd") for e in pc_entries]
+                            seed_backend = (
+                                batcher.backend if lane is not None else self.backend
+                            )
                             use_device = (
-                                lane is None
-                                and not getattr(self.backend, "is_lockstep", False)
+                                not getattr(seed_backend, "is_lockstep", False)
                                 # mesh guard mirrors the store path: after a
                                 # swap_backend onto a TP mesh, surviving
                                 # device entries must not seed unsharded
                                 # buffers into a sharded session
-                                and getattr(self.backend, "mesh", None) is None
+                                and getattr(seed_backend, "mesh", None) is None
                                 and all(x is not None for x in kd_list)
                             )
                             if use_device:
@@ -974,9 +1019,15 @@ class TransformerHandler:
                                         [e["out"] for e in pc_entries], axis=1
                                     )
                                 )
-                                kv = self._seed_session_kv_device(
-                                    kv, handles, kd_list, vd_list, hit_len
-                                )
+                                if lane is not None:
+                                    await self._seed_lane_kv_device(
+                                        batcher, lane, kd_list, vd_list, hit_len,
+                                        batch_size, end - start,
+                                    )
+                                else:
+                                    kv = self._seed_session_kv_device(
+                                        kv, handles, kd_list, vd_list, hit_len
+                                    )
                             else:
                                 k_pre, v_pre, prefix_out = await asyncio.to_thread(
                                     self.prefix_cache.concat_entries, pc_entries

@@ -139,12 +139,10 @@ def test_shared_prefix_skips_compute_token_identical(model_path, batching):
 
             out2 = await _one_session(client, uids, p2, [step])
             assert pc.stats["hit_tokens"] == 2 * SEGMENT_TOKENS, pc.summary()
-            if not batching:
-                # private single-device sessions must hit the DEVICE tier
-                # (zero host->device seeding); pooled sessions use lanes and
-                # serve from host
-                assert pc.summary()["device_segments"] == 2, pc.summary()
-                assert pc.stats.get("device_hits", 0) == 1, pc.summary()
+            # single-device sessions — private AND pooled-lane — must hit the
+            # DEVICE tier (zero host->device seeding)
+            assert pc.summary()["device_segments"] == 2, pc.summary()
+            assert pc.stats.get("device_hits", 0) == 1, pc.summary()
 
             # ground truth: full uncached compute for session 2
             backend = server.backend
