@@ -60,7 +60,10 @@ def block_apply(
     v = v.reshape(batch, seq, hkv, d)
 
     positions = absolute_positions(position, batch, seq)
-    cos, sin = rotary_tables(positions, d, theta=cfg.rope_theta, rope_scaling=cfg.rope_scaling_dict)
+    cos, sin = rotary_tables(
+        positions, d, theta=cfg.rope_theta, rope_scaling=cfg.rope_scaling_dict,
+        n_valid=n_valid,  # longrope's switch must see the REAL chunk length
+    )
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)
 

@@ -1,0 +1,101 @@
+"""Phi-3 family (8th; beyond the reference's four families).
+
+Architecturally a llama-style decoder (RMSNorm, GQA + full-dim RoPE, SwiGLU,
+silu) with three checkpoint/config deltas:
+
+- q/k/v ship FUSED as ``self_attn.qkv_proj`` and gate/up as
+  ``mlp.gate_up_proj`` (HF Phi3Attention/Phi3MLP); the mapping below splits
+  them back into the llama leaf names — the backend's convert step re-fuses
+  them for serving, so the split costs nothing at runtime.
+- LongRoPE scaling (mini-128k/medium-128k): per-dim short/long extension
+  factors selected by runtime sequence length plus a fixed attention scale —
+  implemented in ops/rotary.rotary_tables ("longrope"); the factor lists are
+  tucked into the hashable rope_scaling tuple together with the TOP-LEVEL
+  HF fields the computation needs (original/max position embeddings — HF
+  reads them from the config object, our block config is self-contained).
+- ``sliding_window`` (mini-4k ships 2047): rides the llama block's
+  mistral-style window support unchanged.
+
+No bias anywhere (qkv/o/mlp all bias=False in HF Phi3), tied embeddings
+ride the llama-style client mapping's tie handling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import petals_tpu.models.llama.model as llama_model
+from petals_tpu.models.llama.block import hf_to_block_params as llama_block_params
+from petals_tpu.models.llama.config import LlamaBlockConfig
+from petals_tpu.models.registry import register_family
+
+
+def config_from_hf(hf_config) -> LlamaBlockConfig:
+    rope_scaling = getattr(hf_config, "rope_scaling", None)
+    sanitized = None
+    if rope_scaling is not None:
+        entries = dict(rope_scaling)
+        rope_type = entries.get("rope_type", entries.get("type"))
+        if rope_type == "longrope":
+            # the longrope computation needs these top-level config fields;
+            # fold them into the (hashable) scaling tuple so the block
+            # config stays self-contained (HF reads them off the config
+            # object: modeling_rope_utils._compute_longrope_parameters)
+            orig = getattr(hf_config, "original_max_position_embeddings", None)
+            if orig:
+                entries["original_max_position_embeddings"] = orig
+                entries["factor"] = hf_config.max_position_embeddings / orig
+            else:
+                entries["original_max_position_embeddings"] = (
+                    hf_config.max_position_embeddings
+                )
+        sanitized = tuple(sorted(
+            (k, tuple(v) if isinstance(v, (list, tuple)) else v)
+            for k, v in entries.items()
+        ))
+    base = LlamaBlockConfig.from_hf_config(
+        _WithoutRopeScaling(hf_config)
+    )
+    return dataclasses.replace(base, rope_scaling=sanitized)
+
+
+class _WithoutRopeScaling:
+    """Attribute view of an HF config with rope_scaling hidden — the base
+    from_hf_config tuple-izes scalar values only; the sanitized (list-safe)
+    tuple is attached afterwards."""
+
+    def __init__(self, hf_config):
+        self._cfg = hf_config
+
+    def __getattr__(self, name):
+        if name == "rope_scaling":
+            return None
+        return getattr(self._cfg, name)
+
+
+def hf_to_block_params(tensors: dict, cfg: LlamaBlockConfig) -> dict:
+    """Split the fused qkv_proj / gate_up_proj rows back into llama leaves
+    (HF stores torch-style [out, in]: q/k/v and gate/up stack along OUT)."""
+    tensors = dict(tensors)
+    qkv = np.asarray(tensors.pop("self_attn.qkv_proj.weight"))
+    nq = cfg.num_attention_heads * cfg.head_dim
+    nkv = cfg.num_key_value_heads * cfg.head_dim
+    tensors["self_attn.q_proj.weight"] = qkv[:nq]
+    tensors["self_attn.k_proj.weight"] = qkv[nq:nq + nkv]
+    tensors["self_attn.v_proj.weight"] = qkv[nq + nkv:nq + 2 * nkv]
+    gu = np.asarray(tensors.pop("mlp.gate_up_proj.weight"))
+    tensors["mlp.gate_proj.weight"] = gu[: cfg.intermediate_size]
+    tensors["mlp.up_proj.weight"] = gu[cfg.intermediate_size:]
+    return llama_block_params(tensors, cfg)
+
+
+FAMILY = register_family(
+    dataclasses.replace(
+        llama_model.FAMILY,
+        name="phi3",
+        config_from_hf=config_from_hf,
+        hf_to_block_params=hf_to_block_params,
+    )
+)

@@ -22,14 +22,18 @@ def rotary_tables(
     theta: float = 10000.0,
     scaling_factor: Optional[float] = None,
     rope_scaling: Optional[dict] = None,
+    n_valid=None,  # real (non-padding) token count of this chunk, [b] or scalar
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Compute cos/sin tables [batch, seq, head_dim] for the given positions.
 
-    ``rope_scaling`` supports HF-style dicts with rope_type "linear" or
-    "llama3" (others raise NotImplementedError). Computation is float32
-    throughout for parity with HF.
+    ``rope_scaling`` supports HF-style dicts with rope_type "linear",
+    "llama3", or "longrope" (others raise NotImplementedError). Computation
+    is float32 throughout for parity with HF. ``n_valid`` only matters to
+    "longrope", whose factor selection depends on the REAL sequence length —
+    padded bucket tails must not count.
     """
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    table_scale = 1.0
 
     if rope_scaling is not None:
         rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
@@ -37,6 +41,10 @@ def rotary_tables(
             inv_freq = inv_freq / rope_scaling["factor"]
         elif rope_type == "llama3":
             inv_freq = _llama3_scale_inv_freq(inv_freq, rope_scaling)
+        elif rope_type == "longrope":
+            inv_freq, table_scale = _longrope_inv_freq(
+                inv_freq, positions, rope_scaling, n_valid
+            )
         elif rope_type in ("default", None):
             pass
         else:
@@ -44,9 +52,56 @@ def rotary_tables(
     elif scaling_factor is not None:
         inv_freq = inv_freq / scaling_factor
 
-    angles = positions.astype(jnp.float32)[..., None] * inv_freq[None, None, :]  # [b, s, d/2]
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [b, s, d/2]
     emb = jnp.concatenate([angles, angles], axis=-1)  # [b, s, d]
-    return jnp.cos(emb), jnp.sin(emb)
+    return jnp.cos(emb) * table_scale, jnp.sin(emb) * table_scale
+
+
+def _longrope_inv_freq(
+    inv_freq: jnp.ndarray, positions: jnp.ndarray, cfg: dict, n_valid=None,
+):
+    """Phi-3 LongRoPE (mirrors HF's _compute_longrope_parameters): per-dim
+    extension factors — ``long_factor`` once the runtime sequence extends
+    past the pretrained window, ``short_factor`` inside it — plus a fixed
+    attention scaling on the tables.
+
+    The selection is PER ROW and uses the real sequence end:
+    - per row: pooled batched decode carries per-lane positions (idle lanes
+      hold the out-of-range sentinel), and one deep lane must not flip a
+      shallow lane's factors;
+    - real end: prefill chunks are padded to power-of-two buckets, and the
+      padded tail must not trip the switch — ``n_valid`` (the chunk's real
+      token count; rows ascend from positions[:, 0]) overrides the padded
+      maximum when given.
+
+    This traces HF's per-forward dynamic re-selection: a CACHED sequence
+    crossing the boundary switches tables for NEW positions only, exactly
+    like HF's cache path (HF's own single full forward over a >window
+    prompt would instead rotate every position with long factors — the same
+    cache-vs-forward quirk HF has; server-side chunked prefill behaves like
+    the cache path). config_from_hf injects ``factor`` and
+    ``original_max_position_embeddings`` from the top-level HF config.
+    Returns (inv_freq [b, 1, d/2], table_scale)."""
+    import math
+
+    short = jnp.asarray(cfg["short_factor"], jnp.float32)
+    long = jnp.asarray(cfg["long_factor"], jnp.float32)
+    orig = float(cfg["original_max_position_embeddings"])
+    factor = float(cfg.get("factor") or 1.0)
+    attention_factor = cfg.get("attention_factor")
+    if attention_factor is None:
+        attention_factor = (
+            1.0 if factor <= 1.0 else math.sqrt(1 + math.log(factor) / math.log(orig))
+        )
+    if n_valid is not None:
+        seq_len = positions[:, 0] + jnp.broadcast_to(
+            jnp.asarray(n_valid, positions.dtype), positions.shape[:1]
+        )
+    else:
+        seq_len = jnp.max(positions, axis=-1) + 1
+    use_long = (seq_len > orig)[:, None, None]  # [b, 1, 1]
+    ext = jnp.where(use_long, long[None, None, :], short[None, None, :])
+    return inv_freq / ext, float(attention_factor)
 
 
 def _llama3_scale_inv_freq(inv_freq: jnp.ndarray, cfg: dict) -> jnp.ndarray:

@@ -11,12 +11,13 @@ import pytest
 
 from petals_tpu.client.model import AutoDistributedModelForCausalLM
 from tests.test_full_model import SwarmHarness, _hf_greedy
-from tests.utils import make_tiny_gemma, make_tiny_mistral, make_tiny_qwen2
+from tests.utils import make_tiny_gemma, make_tiny_mistral, make_tiny_phi3, make_tiny_qwen2
 
 
 @pytest.mark.parametrize(
     "maker,name",
-    [(make_tiny_qwen2, "qwen2"), (make_tiny_mistral, "mistral"), (make_tiny_gemma, "gemma")],
+    [(make_tiny_qwen2, "qwen2"), (make_tiny_mistral, "mistral"), (make_tiny_gemma, "gemma"),
+     (make_tiny_phi3, "phi3")],
 )
 def test_quantization_applies_to_derived_families(tmp_path, maker, name):
     """Families registered under their own model_type but sharing the llama
@@ -42,13 +43,15 @@ def test_quantization_refuses_unknown_architecture():
         convert_block_params({"w_mystery": jnp.ones((8, 8))}, "not-a-family", "nf4")
 
 
-@pytest.fixture(scope="module", params=["qwen2", "mistral", "gemma"])
+@pytest.fixture(scope="module", params=["qwen2", "mistral", "gemma", "phi3"])
 def family_swarm(request, tmp_path_factory):
     tmp = str(tmp_path_factory.mktemp("models"))
     if request.param == "qwen2":
         path = make_tiny_qwen2(tmp)
     elif request.param == "gemma":
         path = make_tiny_gemma(tmp)
+    elif request.param == "phi3":
+        path = make_tiny_phi3(tmp)
     else:
         # window=6: generation must cross the sliding-window edge mid-stream
         path = make_tiny_mistral(tmp, window=6)
@@ -106,3 +109,107 @@ def test_gemma_norm_fold_survives_bf16_loading(tmp_path):
     client = load_client_params(path, dtype=jnp.bfloat16)
     assert client["norm"].dtype == jnp.float32
     assert client["embed"].dtype == jnp.bfloat16
+
+
+def test_phi3_longrope_boundary_crossing(tmp_path):
+    """Cached decode that CROSSES the pretrained window (original 64) must
+    match HF on both sides of the switch: HF re-selects the long extension
+    factors per forward from the runtime length, and the traced jnp.where in
+    ops/rotary._longrope_inv_freq must agree step by step (cached K rows
+    keep their short-factor rotation on both sides — the HF cache quirk this
+    mirrors). Block-level and deterministic: the e2e greedy variant of this
+    test tripped near-tie argmax cascades (1.4e-3 logit margins vs the bf16
+    serving noise), which tests the tiny random model, not the rope."""
+    import jax.numpy as jnp
+    import torch
+    from transformers import DynamicCache, Phi3ForCausalLM
+
+    from petals_tpu.models.registry import get_family
+    from petals_tpu.server.from_pretrained import load_block_params
+
+    path = make_tiny_phi3(str(tmp_path))
+    model = Phi3ForCausalLM.from_pretrained(path).eval()
+    layer = model.model.layers[0]
+    rot = model.model.rotary_emb
+    fam = get_family("phi3")
+    cfg = fam.config_from_hf(model.config)
+    params = load_block_params(path, 0, dtype=jnp.float32)
+
+    rng = np.random.RandomState(0)
+    prefill = rng.randn(1, 62, 64).astype(np.float32) * 0.3
+    steps = [rng.randn(1, 1, 64).astype(np.float32) * 0.3 for _ in range(4)]
+
+    cache = DynamicCache()
+    with torch.no_grad():
+        cos, sin = rot(torch.tensor(prefill), torch.arange(62)[None])
+        layer(torch.tensor(prefill), position_embeddings=(cos, sin),
+              attention_mask=None, past_key_value=cache,
+              cache_position=torch.arange(62))
+    hf_outs = []
+    for i, s in enumerate(steps):
+        p = 62 + i
+        with torch.no_grad():
+            cos, sin = rot(torch.tensor(s), torch.tensor([[p]]))
+            o = layer(torch.tensor(s), position_embeddings=(cos, sin),
+                      attention_mask=None, past_key_value=cache,
+                      cache_position=torch.tensor([p]))
+        hf_outs.append((o[0] if isinstance(o, tuple) else o).numpy())
+
+    kd = jnp.zeros((1, 128, cfg.num_key_value_heads, cfg.head_dim), jnp.float32)
+    kv = (kd, kd)
+    _, kv = fam.block_apply(params, jnp.asarray(prefill), kv, 0, cfg)
+    for i, s in enumerate(steps):
+        p = 62 + i  # seq 63..66 straddles the original_max=64 switch
+        o, kv = fam.block_apply(params, jnp.asarray(s), kv, p, cfg)
+        np.testing.assert_allclose(
+            np.asarray(o), hf_outs[i], atol=1e-5,
+            err_msg=f"phi3 longrope diverged at position {p} (seq {p + 1})",
+        )
+
+
+def test_longrope_per_row_and_padding_selection():
+    """The short/long switch is per ROW and counts only REAL tokens: one
+    deep lane (or the idle-lane sentinel at max_length) must not flip a
+    shallow lane's factors, and a padded bucket tail must not trip the
+    switch (n_valid overrides the padded maximum)."""
+    import jax.numpy as jnp
+
+    from petals_tpu.ops.rotary import rotary_tables
+
+    scaling = {
+        "rope_type": "longrope",
+        "short_factor": tuple(1.0 for _ in range(4)),
+        "long_factor": tuple(4.0 for _ in range(4)),
+        "original_max_position_embeddings": 64,
+        "factor": 4.0,
+    }
+
+    def tables(positions, n_valid=None):
+        return rotary_tables(
+            jnp.asarray(positions, jnp.int32), 8, rope_scaling=dict(scaling),
+            n_valid=n_valid,
+        )
+
+    # batched decode: lane 0 shallow (pos 5), lane 1 deep (pos 100)
+    cos, _ = tables([[5], [100]])
+    cos_short, _ = tables([[5]])
+    cos_long, _ = tables([[100]])
+    np.testing.assert_allclose(np.asarray(cos[0]), np.asarray(cos_short[0]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(cos[1]), np.asarray(cos_long[0]), rtol=1e-6)
+    # the factors actually differ between the regimes (the test has teeth)
+    assert np.abs(np.asarray(cos_short[0]) - np.asarray(cos_long[0])).max() > 1e-3
+
+    # padded prefill chunk: 8 real tokens from position 58 (real end 66 > 64
+    # -> long), padded to 16 rows whose tail reaches position 73
+    padded = [list(range(58, 74))]
+    cos_pad, _ = tables(padded, n_valid=8)
+    cos_ref, _ = tables([[100] + list(range(59, 74))])  # all-long reference angles
+    # row 0 must use LONG factors (real end 66 > 64): compare against the
+    # unambiguous long-regime table at the same position
+    cos_long58, _ = tables([[58, 59]])  # max+1=60 <= 64 -> short; differs
+    assert np.abs(np.asarray(cos_pad[0, 0]) - np.asarray(cos_long58[0, 0])).max() > 1e-3
+    # and with n_valid pushing the real end INSIDE the window, short applies
+    cos_short_nv, _ = tables(padded, n_valid=2)  # real end 60 <= 64
+    np.testing.assert_allclose(
+        np.asarray(cos_short_nv[0, 0]), np.asarray(cos_long58[0, 0]), rtol=1e-6
+    )

@@ -237,6 +237,41 @@ def make_tiny_qwen2(tmpdir: str, *, n_layers: int = 4, vocab: int = 128, tied: b
 
 
 @_model_build_cache
+def make_tiny_phi3(tmpdir: str, *, n_layers: int = 4, vocab: int = 128) -> str:
+    """Phi-3 with LongRoPE: original window 64 << max 256, so tests that run
+    past position 64 exercise the long-factor selection and attention scale
+    exactly where HF switches them."""
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    head_dim = 16
+    cfg = Phi3Config(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=n_layers,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        original_max_position_embeddings=64,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        rope_scaling={
+            "type": "longrope",  # Phi3Config validates exactly this key set
+            "short_factor": [1.0 + 0.05 * i for i in range(head_dim // 2)],
+            "long_factor": [2.0 + 0.3 * i for i in range(head_dim // 2)],
+        },
+        sliding_window=None,
+        tie_word_embeddings=False,
+        pad_token_id=0,  # Phi3Config defaults to 32000, outside the tiny vocab
+    )
+    torch.manual_seed(8)
+    model = Phi3ForCausalLM(cfg).eval()
+    path = os.path.join(tmpdir, "tiny-phi3")
+    model.save_pretrained(path, safe_serialization=True)
+    return path
+
+
+@_model_build_cache
 def make_tiny_mistral(tmpdir: str, *, n_layers: int = 4, vocab: int = 128, window: int = 6) -> str:
     from transformers import MistralConfig, MistralForCausalLM
 
