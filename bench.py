@@ -419,6 +419,111 @@ def bench_flash_prefill(cfg, seq, *, runs=3):
     return result
 
 
+async def run_server_gen_bench(gen_chunk=32, chunks=4):
+    """Server-side (device-resident) greedy generation e2e: the full-span
+    server runs sample->embed->span->sample as ONE jitted scan per chunk and
+    returns token ids — one RPC (and one host<->device sync) per CHUNK
+    instead of per token. Same span/server/wire as the e2e row, so the
+    tok_s ratio is the measured value of the feature."""
+    import jax
+    import jax.numpy as jnp
+
+    from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
+    from petals_tpu.models.registry import get_family
+    from petals_tpu.rpc import RpcClient
+    from petals_tpu.rpc.serialization import serialize_array
+    from petals_tpu.rpc.server import RpcServer
+    from petals_tpu.server.backend import TransformerBackend
+    from petals_tpu.server.handler import TransformerHandler
+    from petals_tpu.server.memory_cache import MemoryCache
+
+    cfg = llama7b_cfg()
+    family = get_family("llama")
+    dtype = jnp.bfloat16
+
+    t0 = time.perf_counter()
+    params = random_params(cfg, N_BLOCKS, dtype)
+    init_s = time.perf_counter() - t0
+    key = jax.random.PRNGKey(7)
+    client_params = {
+        "embed": jax.random.normal(key, (cfg.vocab_size, cfg.hidden_size), jnp.float32) * 0.02,
+        "norm": jnp.ones((cfg.hidden_size,), jnp.float32),
+        "head": jax.random.normal(key, (cfg.hidden_size, cfg.vocab_size), jnp.float32) * 0.02,
+    }
+
+    memory_cache = MemoryCache(2 << 30)
+    backend = TransformerBackend(
+        family, cfg, params,
+        first_block=0, n_blocks=N_BLOCKS,
+        memory_cache=memory_cache, compute_dtype=dtype,
+    )
+    handler = TransformerHandler(
+        backend, dht_prefix="bench", memory_cache=memory_cache, batching=False,
+        server_gen_params=client_params,
+    )
+    server = RpcServer()
+    handler.register(server)
+    await server.start()
+    client = await RpcClient.connect("127.0.0.1", server.port)
+    uids = CHAIN_DELIMITER.join(make_uid("bench", i) for i in range(N_BLOCKS))
+
+    rng = np.random.RandomState(0)
+    prefill = rng.randn(1, PREFILL_TOKENS, cfg.hidden_size).astype(np.float32) * 0.02
+    tok_hidden = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.02
+
+    try:
+        stream = await client.open_stream("ptu.inference")
+        await stream.send({
+            "uids": uids,
+            "max_length": PREFILL_TOKENS + gen_chunk * (chunks + 2) + 8,
+            "batch_size": 1,
+        })
+        await stream.recv(timeout=120)
+
+        # prefill + first chunk (compiles the gen program)
+        t0 = time.perf_counter()
+        await stream.send({
+            "tensors": {"hidden": serialize_array(prefill)}, "gen_tokens": gen_chunk,
+        })
+        reply = await stream.recv(timeout=900)
+        warm_s = time.perf_counter() - t0
+        assert len(reply["tokens"]) == gen_chunk, reply
+
+        chunk_times = []
+        total_tokens = 0
+        for _ in range(chunks):
+            t0 = time.perf_counter()
+            await stream.send({
+                "tensors": {"hidden": serialize_array(tok_hidden)},
+                "gen_tokens": gen_chunk,
+            })
+            reply = await stream.recv(timeout=600)
+            chunk_times.append(time.perf_counter() - t0)
+            total_tokens += len(reply["tokens"])
+        await stream.end()
+    finally:
+        await client.close()
+        await server.stop()
+        handler.shutdown()
+
+    p50_chunk = statistics.median(chunk_times)
+    tok_s = gen_chunk / p50_chunk
+    result = {
+        "label": "e2e_server_gen",
+        "n_blocks": N_BLOCKS,
+        "gen_chunk": gen_chunk,
+        "p50_chunk_ms": round(p50_chunk * 1e3, 1),
+        "ms_per_token": round(p50_chunk / gen_chunk * 1e3, 2),
+        "tok_s": round(tok_s, 2),
+        "warmup_s": round(warm_s, 1),
+        "param_init_s": round(init_s, 1),
+        "tokens": total_tokens,
+    }
+    del params, backend, memory_cache, client_params
+    gc.collect()
+    return result
+
+
 async def run_e2e_bench():
     import jax
     import jax.numpy as jnp
@@ -1173,6 +1278,7 @@ def _heavy_row_registry():
             run_continuous_batching_bench()),
         "prefix_cache_ttft": lambda: asyncio.run(run_prefix_cache_bench()),
         "chain_hop_405b_shapes": lambda: asyncio.run(run_chain_hop_bench()),
+        "e2e_server_gen": lambda: asyncio.run(run_server_gen_bench()),
         "quant_quality": lambda: __import__(
             "benchmarks.quant_quality", fromlist=["quality_report"]
         ).quality_report(include_model_tier=False),
@@ -1459,6 +1565,10 @@ def main():
     # measured 405B-chain hop costs (VERDICT r3 #6): 2 span servers of
     # 405B-shaped int4 blocks chained through the real RPC stack with push
     row_sub("chain_hop_405b_shapes", "405B chain hops", timeout=600.0)
+    # server-side (device-resident) greedy generation: one RPC + one
+    # host<->device sync per 32-token chunk instead of per token — the
+    # round-5 answer to the per-token sync that dominates the e2e row
+    row_sub("e2e_server_gen", "server-side generation", timeout=600.0)
     # quantization quality table (VERDICT r3 #4): weight+activation error at
     # 7B shapes per format, so the serving default is re-derived every run
     row_sub("quant_quality", "quant quality")

@@ -107,6 +107,8 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Lane length in tokens (default: min(inference_max_length, 1024))")
     parser.add_argument("--prefix_cache_bytes", type=int, default=256 * 2**20,
                         help="Host-RAM prompt-prefix cache budget; 0 disables")
+    parser.add_argument("--no_server_side_generation", action="store_true",
+                        help="disable the device-side greedy generation loop on full-span servers")
     parser.add_argument("--prefix_device_bytes", type=int, default=256 * 2**20,
                         help="HBM tier of the prefix cache (device-resident hit seeding); 0 disables")
     parser.add_argument("--prefix_share_scope", choices=["swarm", "peer"], default="swarm",
@@ -205,6 +207,7 @@ def main(argv=None) -> None:
         prefix_cache_bytes=args.prefix_cache_bytes,
         prefix_share_scope=args.prefix_share_scope,
         prefix_device_bytes=args.prefix_device_bytes,
+        server_side_generation=not args.no_server_side_generation,
     )
 
     async def run():

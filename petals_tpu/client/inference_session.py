@@ -15,6 +15,7 @@
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import time
 import uuid
 from typing import List, Optional, Sequence
@@ -142,6 +143,36 @@ class _ServerInferenceSession:
         self.history.append((np.asarray(hidden), None if hypo_ids is None else np.asarray(hypo_ids)))
         return out
 
+    async def step_generate(
+        self, hidden: np.ndarray, n_tokens: int, embed_fn,
+        *, start_from_position: Optional[int] = None, step_id: Optional[str] = None,
+    ) -> np.ndarray:
+        """Feed ``hidden`` and let the server generate ``n_tokens`` greedy
+        tokens device-side (full-span servers with the server_gen capability;
+        see server/backend.py generate_tokens). Returns the token ids
+        [batch, n_tokens]. ``embed_fn(tokens)`` reproduces the embeds the
+        server fed itself — recorded into the replay history so failover
+        onto a server WITHOUT the capability still rebuilds the exact KV."""
+        if start_from_position is not None:
+            self._rollback_history(start_from_position)
+        msg = {
+            "tensors": {"hidden": serialize_array(hidden, self.compression)},
+            "gen_tokens": int(n_tokens),
+        }
+        if step_id is not None:
+            msg["step_id"] = step_id
+        if start_from_position is not None:
+            msg["start_from_position"] = int(start_from_position)
+        await self.stream.send(msg)
+        reply = await self.stream.recv(timeout=self.step_timeout)
+        tokens = np.asarray(reply["tokens"], np.int64)[None]  # [1, n]
+        self.position = reply["position"]
+        self.history.append((np.asarray(hidden), None))
+        if n_tokens > 1:
+            # the server fed tokens[:-1] (the last token is never fed)
+            self.history.append((np.asarray(embed_fn(tokens[:, :-1])), None))
+        return tokens
+
     def _rollback_history(self, new_position: int) -> None:
         self.position = new_position
         kept, total = [], 0
@@ -226,28 +257,7 @@ class InferenceSession:
                 f" exceeds pre-allocated maximum {self.max_length}"
             )
 
-        if not self._sessions:
-            from petals_tpu.server.prefix_cache import SEGMENT_TOKENS
-
-            if (
-                self._affinity_seed is None
-                and self._position == 0
-                and n_input_tokens >= SEGMENT_TOKENS
-            ):
-                # hash the first prefill segment (the unit the server-side
-                # prefix cache stores) so identical prompts route identically
-                import hashlib
-
-                seg = np.ascontiguousarray(np.asarray(hidden)[:, :SEGMENT_TOKENS])
-                self._affinity_seed = int.from_bytes(
-                    hashlib.blake2b(seg.tobytes(), digest_size=8).digest(), "big"
-                )
-            chain = await self.seq_manager.make_sequence(
-                0, self.num_blocks, mode="min_latency",
-                cache_tokens_needed=self.batch_size * self.max_length,
-                affinity_seed=self._affinity_seed,
-            )
-            self._sessions = await self._enter_server_sessions(chain)
+        await self._ensure_route(hidden)
 
         attempt = 0
         block_idx = 0
@@ -302,6 +312,98 @@ class InferenceSession:
             except Exception as e:
                 logger.warning(f"Route upgrade check failed (continuing as-is): {e}")
         return inputs
+
+    async def _ensure_route(self, hidden: np.ndarray) -> None:
+        if self._sessions:
+            return
+        from petals_tpu.server.prefix_cache import SEGMENT_TOKENS
+
+        if (
+            self._affinity_seed is None
+            and self._position == 0
+            and hidden.shape[1] >= SEGMENT_TOKENS
+        ):
+            # hash the first prefill segment (the unit the server-side
+            # prefix cache stores) so identical prompts route identically
+            import hashlib
+
+            seg = np.ascontiguousarray(np.asarray(hidden)[:, :SEGMENT_TOKENS])
+            self._affinity_seed = int.from_bytes(
+                hashlib.blake2b(seg.tobytes(), digest_size=8).digest(), "big"
+            )
+        chain = await self.seq_manager.make_sequence(
+            0, self.num_blocks, mode="min_latency",
+            cache_tokens_needed=self.batch_size * self.max_length,
+            affinity_seed=self._affinity_seed,
+        )
+        self._sessions = await self._enter_server_sessions(chain)
+
+    def server_gen_available(self) -> bool:
+        """Whether the CURRENT route supports the device-side generation
+        loop: exactly one span covering every block, on a server announcing
+        the server_gen capability. Only meaningful after a route exists."""
+        if len(self._sessions) != 1 or self._sessions[0].closed:
+            return False
+        span = self._sessions[0].span
+        return (
+            span.start == 0
+            and span.end == self.num_blocks
+            and bool(getattr(span.server_info, "server_gen", False))
+        )
+
+    async def generate_remote(
+        self, hidden: np.ndarray, n_tokens: int, embed_fn,
+    ) -> Optional[np.ndarray]:
+        """Feed ``hidden`` and have the full-span server generate ``n_tokens``
+        greedy tokens device-side. Returns token ids [batch, n_tokens], or
+        None when the current route cannot do it (caller falls back to the
+        per-token loop). On a mid-generate failure the server sessions are
+        torn down — the server's cache may have advanced past the client's
+        view, and the standard rebuild-and-replay failover (which the
+        recorded embed history feeds) is the one guaranteed-consistent
+        recovery — and None is returned so the caller continues client-side."""
+        assert not self._closed
+        n_input = hidden.shape[1]
+        if self._position + n_input + n_tokens - 1 > self.max_length:
+            return None
+        await self._ensure_route(hidden)
+        if not self.server_gen_available():
+            return None
+        session = self._sessions[0]
+        rollback = self._position if session.position > self._position else None
+        try:
+            tokens = await session.step_generate(
+                np.asarray(hidden), n_tokens, embed_fn,
+                start_from_position=rollback, step_id=uuid.uuid4().hex,
+            )
+        except Exception as e:
+            logger.warning(
+                f"Server-side generation failed (falling back to the "
+                f"per-token path): {e}"
+            )
+            self.seq_manager.on_request_failure(session.span.peer_id)
+            # the server's cache may have advanced past the client's view:
+            # the standard repair (KV export or history replay onto a fresh
+            # chain) is the one guaranteed-consistent recovery — history was
+            # only appended on successful replies, so it matches _position
+            try:
+                await self._repair_chain(0)
+            except Exception as repair_err:
+                # closing the sessions here would discard the only copy of
+                # the replay history while _position > 0 — a later step on a
+                # fresh chain would then run against EMPTY server caches and
+                # silently generate garbage. Fail loudly instead.
+                raise RuntimeError(
+                    "server-side generation failed and the chain could not "
+                    "be repaired; the session cannot continue consistently"
+                ) from repair_err
+            return None
+        self.seq_manager.on_request_success(session.span.peer_id)
+        # advance by what the server ACTUALLY generated — it clamps chunk
+        # lengths to bound its compile cache, and fed got-1 tokens
+        got = tokens.shape[1]
+        self._position += n_input + got - 1
+        return tokens
 
     def _find_session_index(self, block_idx: int) -> Optional[int]:
         for i, session in enumerate(self._sessions):

@@ -302,6 +302,33 @@ class RemoteGenerationMixin:
             if streamer is not None:
                 streamer.put(input_ids)  # HF: the prompt goes first
             hidden = np.asarray(self.embed(new_tokens, with_prompts=session.position == 0))
+
+            # Server-side greedy fast path: a full-span server generates
+            # whole CHUNKS of tokens device-side (one RPC per chunk instead
+            # of one per token — the per-token path pays a full host/device +
+            # network round trip for every token's logits). Pure greedy
+            # only: penalties/processors/criteria need client-side logits.
+            if (
+                not do_sample
+                and logits_processor is None
+                and stopping_criteria is None
+                and (repetition_penalty is None or repetition_penalty == 1.0)
+                and not no_repeat_ngram_size
+                and (min_new_tokens or 0) == 0
+                and prompts is None
+                and batch == 1
+                and hasattr(session, "generate_remote")
+            ):
+                result = self._server_side_greedy(
+                    session, hidden, generated, max_new_tokens,
+                    eos_token_id=eos_token_id, pad_token_id=pad_token_id,
+                    streamer=streamer,
+                )
+                if result is not None:
+                    return result
+                # clean fallback: nothing was consumed server-side, the
+                # per-token loop below re-sends the same prefill
+
             out_hidden = session.step(hidden, prompts=prompts)
             logits = np.asarray(self.lm_logits(out_hidden[:, -1:]))[:, 0]
 
@@ -353,6 +380,75 @@ class RemoteGenerationMixin:
         finally:
             if own_session:
                 session.close()
+
+    _SERVER_GEN_CHUNK = 32  # tokens per server-gen RPC (server may clamp)
+
+    def _server_side_greedy(
+        self, session, hidden, generated, max_new_tokens,
+        *, eos_token_id, pad_token_id, streamer,
+    ):
+        """Greedy generation via the server's device-side loop, in chunks.
+        Returns the final sequence, or None when the route cannot do it AND
+        nothing was consumed (the caller's per-token loop takes over cleanly).
+        A MID-stream failure finishes the remaining tokens with a local
+        per-token loop right here — the fast path has no penalties or
+        processors, so plain argmax is the complete client-side equivalent."""
+
+        def embed_fn(tokens):
+            return np.asarray(self.embed(tokens, with_prompts=False))
+
+        remaining = max_new_tokens
+        first = True
+        pending_hidden = hidden  # unfed input for the next request
+        while remaining > 0:
+            want = min(self._SERVER_GEN_CHUNK, remaining)
+            pos_before = session.position
+            tokens = session.generate_remote(pending_hidden, want, embed_fn)
+            if tokens is None:
+                if first:
+                    return None
+                break  # finish the tail client-side below
+            first = False
+            got = tokens.shape[1]  # server may clamp the chunk
+            if eos_token_id is not None:
+                eos_at = np.flatnonzero(tokens[0] == eos_token_id)
+                if eos_at.size:
+                    j = int(eos_at[0])
+                    tokens = tokens[:, : j + 1]
+                    # roll the server cache back so the eos token is the
+                    # pending-unfed one (the resume convention); the extra
+                    # speculatively fed tokens are dropped like a
+                    # speculative-decoding rejection
+                    session.position = pos_before + pending_hidden.shape[1] + j
+                    remaining = 0
+            generated = np.concatenate([generated, tokens], axis=1)
+            if streamer is not None:
+                streamer.put(np.asarray(tokens[0]))
+            if remaining:
+                remaining -= got
+            if remaining <= 0:
+                if streamer is not None:
+                    streamer.end()
+                return generated
+            # next chunk feeds the pending last token
+            pending_hidden = embed_fn(generated[:, -1:])
+
+        # mid-stream fallback: plain per-token greedy for the tail
+        while remaining > 0:
+            out = session.step(pending_hidden)
+            logits = np.asarray(self.lm_logits(out[:, -1:]))[:, 0]
+            next_token = logits.argmax(-1).astype(generated.dtype)
+            generated = np.concatenate([generated, next_token[:, None]], axis=1)
+            if streamer is not None:
+                streamer.put(np.asarray(next_token))
+            remaining -= 1
+            if eos_token_id is not None and int(next_token[0]) == eos_token_id:
+                break
+            if remaining > 0:
+                pending_hidden = embed_fn(generated[:, -1:])
+        if streamer is not None:
+            streamer.end()
+        return generated
 
     def _beam_search(
         self,

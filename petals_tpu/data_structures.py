@@ -136,6 +136,10 @@ class ServerInfo:
     using_relay: Optional[bool] = None
     cache_tokens_left: Optional[int] = None
     next_pings: Optional[Dict[str, float]] = None  # peer id hex -> RTT seconds
+    # full-span servers that loaded embed/norm/head can run the device-side
+    # greedy generation loop (one RPC returns many tokens; see
+    # server/backend.py generate_tokens)
+    server_gen: Optional[bool] = None
 
     def to_tuple(self) -> Tuple[int, float, dict]:
         extra_info = dataclasses.asdict(self)

@@ -64,6 +64,7 @@ class TransformerHandler:
         prefix_cache_bytes: int = 256 * 2**20,  # 0 disables prefix caching
         prefix_share_scope: str = "swarm",  # "swarm" shares across clients; "peer" salts per client
         prefix_device_bytes: int = 256 * 2**20,  # HBM tier of the prefix cache; 0 disables
+        server_gen_params=None,  # client leaves (embed/norm/head) for device-side generation
     ):
         self.backend = backend
         self.dht_prefix = dht_prefix
@@ -121,6 +122,7 @@ class TransformerHandler:
         # channel an open swarm otherwise accepts (server/prefix_cache.py
         # module docstring spells out the tradeoff)
         self.prefix_share_scope = prefix_share_scope
+        self.server_gen_params = server_gen_params
         if prefix_cache_bytes > 0:
             from petals_tpu.server.prefix_cache import PrefixCache
 
@@ -1161,8 +1163,89 @@ class TransformerHandler:
                             )
                         )
                 position += seq
+                gen_token_list = None
+                gen_n = step.get("gen_tokens")
+                if gen_n:
+                    # clamp to a power of two <= 32: each distinct length is
+                    # its own compiled program, and arbitrary client-chosen
+                    # lengths would be a compile-cache DoS; clients loop on
+                    # the returned count
+                    gen_n = max(1, min(int(gen_n), 32))
+                    gen_n = 1 << (gen_n.bit_length() - 1)
+                    # device-side greedy loop (backend.generate_tokens):
+                    # single-device sessions on a full-span server holding
+                    # the client leaves; clients gate on the server_gen info
+                    # flag, so a violation here is a protocol error, not a
+                    # fallback path
+                    if not (
+                        self.server_gen_params is not None
+                        # the SESSION must cover the whole model: a sub-span
+                        # session would apply the LM head to mid-stack hidden
+                        # states and feed embeddings into the middle of the
+                        # stack — syntactically valid, semantically garbage
+                        and start == 0
+                        and end == self.backend.n_blocks
+                        and not getattr(backend, "is_lockstep", False)
+                        and getattr(backend, "mesh", None) is None
+                        and batch_size == 1
+                        and prompts is None
+                        and hypo_ids is None
+                    ):
+                        raise ValueError(
+                            "server-side generation is not available for this "
+                            "session (requires a whole-model session on a "
+                            "full-span single-device server with client "
+                            "leaves loaded; check the server_gen info flag)"
+                        )
+
+                    if lane is not None:
+                        # pooled session: check the lane out for the whole
+                        # loop (<=32 decode steps — the same monopoly a
+                        # 32-chunk pooled prefill takes via this exact path)
+                        def run_gen_lane(kv_lane, lane_handles, out=out, gen_n=gen_n):
+                            with device_annotation("server_gen"):
+                                tokens, new_kv = backend.generate_tokens(
+                                    self.server_gen_params,
+                                    np.asarray(out)[:, -1:],
+                                    kv_lane, position, gen_n,
+                                    active_adapter=active_adapter,
+                                )
+                            return np.asarray(tokens), new_kv
+
+                        gen_arr = await asyncio.wait_for(
+                            batcher.run_exclusive(
+                                lane, run_gen_lane, size=gen_n
+                            ),
+                            self.step_timeout,
+                        )
+                    else:
+                        def run_gen(kv=kv, out=out, gen_n=gen_n):
+                            with device_annotation("server_gen"):
+                                tokens, new_kv = backend.generate_tokens(
+                                    self.server_gen_params, np.asarray(out)[:, -1:],
+                                    kv, position, gen_n,
+                                    active_adapter=active_adapter,
+                                )
+                            return np.asarray(tokens), new_kv
+
+                        gen_arr, kv = await asyncio.wait_for(
+                            self.queue.submit(
+                                run_gen, priority=PRIORITY_INFERENCE, size=gen_n
+                            ),
+                            self.step_timeout,
+                        )
+                        self.memory_cache.update_cache(handles[0], kv[0])
+                        self.memory_cache.update_cache(handles[1], kv[1])
+                    position += gen_n - 1  # the last token is never fed
+                    gen_token_list = [int(t) for t in gen_arr[0]]
                 if reg is not None:
                     reg["position"] = position
+                if gen_token_list is not None:
+                    # the client computes everything it needs from the token
+                    # ids; skipping the hidden reply saves the prefill-sized
+                    # upload on the wire
+                    yield {"tokens": gen_token_list, "position": position}
+                    continue
                 wire_out = serialize_array(out, reply_comp)
                 if push_to is not None and prompts is None:
                     # can_push = no deep prompts (reference block_functions.py:233).

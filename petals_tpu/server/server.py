@@ -89,6 +89,7 @@ class Server:
         prefix_cache_bytes: int = 256 * 2**20,  # host-RAM prompt-prefix cache; 0 disables
         prefix_share_scope: str = "swarm",  # "peer" isolates the prefix cache per client identity
         prefix_device_bytes: int = 256 * 2**20,  # HBM tier of the prefix cache; 0 disables
+        server_side_generation: bool = True,  # device-side greedy loop on full-span servers
     ):
         self.num_hosts = num_hosts or 1
         self.coordinator_address = coordinator_address
@@ -178,6 +179,7 @@ class Server:
         self.prefix_cache_bytes = prefix_cache_bytes
         self.prefix_share_scope = prefix_share_scope
         self.prefix_device_bytes = prefix_device_bytes
+        self.server_side_generation = server_side_generation
         self.request_timeout = request_timeout
         self.session_timeout = session_timeout
         self.step_timeout = step_timeout
@@ -494,6 +496,10 @@ class Server:
             ),
             cache_tokens_left=cache_tokens_left,
             next_pings=dict(self._next_pings) or None,
+            server_gen=(
+                self.handler.server_gen_params is not None
+                if getattr(self, "handler", None) is not None else None
+            ),
         )
 
     async def _announce(self, state: ServerState, expiration: Optional[float] = None) -> None:
@@ -603,7 +609,35 @@ class Server:
             prefix_cache_bytes=self.prefix_cache_bytes,
             prefix_share_scope=self.prefix_share_scope,
             prefix_device_bytes=self.prefix_device_bytes,
+            server_gen_params=self._load_server_gen_params(),
         )
+
+    def _load_server_gen_params(self):
+        """Client leaves (embed/norm/head) for the device-side greedy
+        generation loop — full-span single-device servers only (the loop
+        reuses the span step fn, which is unsharded on that path). Loaded
+        in f32 so logits match the client's own lm_logits bit-for-bit."""
+        if not self.server_side_generation:
+            return None
+        if (
+            self.num_blocks != self.cfg.num_hidden_layers
+            or self.first_block != 0
+            or self.num_hosts > 1
+            or getattr(self.backend, "mesh", None) is not None
+        ):
+            return None
+        try:
+            from petals_tpu.client.from_pretrained import load_client_params
+
+            params = load_client_params(
+                self.model_path, dtype=jnp.float32,
+                family=self.family, cfg=self.cfg,
+            )
+            logger.info("Server-side generation enabled (client leaves loaded)")
+            return params
+        except Exception as e:
+            logger.warning(f"Server-side generation disabled: {e}")
+            return None
 
     def _make_raw_backend(self, stacked, first_block: int) -> TransformerBackend:
         """Backend construction WITHOUT the lockstep wrap (the live span move
