@@ -303,7 +303,13 @@ class InferenceSession:
                 block_idx = await self._repair_chain(block_idx)
 
         self._position += n_input_tokens
+        await self._maybe_check_route_upgrade()
+        return inputs
 
+    async def _maybe_check_route_upgrade(self) -> None:
+        """Periodic better-chain check, shared by the per-token and
+        server-side-generation paths (a session served entirely by gen RPCs
+        must still migrate onto a faster server that joins mid-stream)."""
         period = self.seq_manager.config.route_upgrade_period
         if period and time.monotonic() - self._last_route_check >= period:
             self._last_route_check = time.monotonic()
@@ -311,7 +317,6 @@ class InferenceSession:
                 await self._maybe_upgrade_route()
             except Exception as e:
                 logger.warning(f"Route upgrade check failed (continuing as-is): {e}")
-        return inputs
 
     async def _ensure_route(self, hidden: np.ndarray) -> None:
         if self._sessions:
@@ -403,6 +408,7 @@ class InferenceSession:
         # lengths to bound its compile cache, and fed got-1 tokens
         got = tokens.shape[1]
         self._position += n_input + got - 1
+        await self._maybe_check_route_upgrade()
         return tokens
 
     def _find_session_index(self, block_idx: int) -> Optional[int]:
