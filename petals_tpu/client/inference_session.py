@@ -638,6 +638,18 @@ class InferenceSession:
         )
         if new_cost > self.seq_manager.config.route_upgrade_threshold * cur_cost:
             return False
+        # capability guard: the latency model scores per-token RPC cost and
+        # is blind to server-side generation, which amortizes the round trip
+        # over whole chunks — migrating a gen-capable session onto a chain
+        # WITHOUT the capability would demote it to the per-token path (a
+        # large net slowdown) after paying a full KV export
+        if self.server_gen_available() and not (
+            len(candidate) == 1
+            and candidate[0].start == 0
+            and candidate[0].end == self.num_blocks
+            and bool(getattr(candidate[0].server_info, "server_gen", False))
+        ):
+            return False
         # history-transfer guard: each candidate span's input history must
         # exist client-side, i.e. its start must be a current session start
         # (otherwise a LATER failover of that span could not replay)

@@ -281,3 +281,52 @@ def test_live_route_upgrade(tmp_path_factory, monkeypatch):
     finally:
         model.close()
         harness.stop()
+
+
+def test_route_upgrade_respects_server_gen_capability(tmp_path_factory):
+    """A session serving via server-side generation must NOT migrate onto a
+    'faster' server that lacks the capability: the latency model scores
+    per-token RPC cost and would demote chunked generation to the per-token
+    path after paying a full KV export."""
+    import jax.numpy as jnp
+
+    from petals_tpu.server.server import Server
+
+    path = make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+    harness = SwarmHarness(
+        path, [dict(first_block=0, num_blocks=4, throughput=1.0)]  # gen-capable
+    ).start()
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers, min_backoff=0.1,
+        route_upgrade_period=0.01,
+    )
+    try:
+        rng = np.random.RandomState(6)
+        input_ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+        expected = _hf_greedy(path, input_ids, 6)
+
+        with model.remote.inference_session(max_length=16, batch_size=1) as session:
+            first = model.generate(input_ids, max_new_tokens=3, session=session)
+            gen_peer = harness.servers[0].dht.peer_id
+            assert session._session.server_gen_available()
+
+            async def add_fast_without_gen():
+                server = Server(
+                    path, initial_peers=[harness.bootstrap.own_addr],
+                    compute_dtype=jnp.float32, use_flash=False,
+                    first_block=0, num_blocks=4, throughput=1000.0,
+                    server_side_generation=False,
+                )
+                await server.start()
+                harness.servers.append(server)
+
+            harness.run(add_fast_without_gen())
+
+            final = model.generate(first, max_new_tokens=3, session=session)
+            np.testing.assert_array_equal(final, expected)
+            assert session._session._sessions[0].span.peer_id == gen_peer, (
+                "gen-capable session migrated onto a capability-less server"
+            )
+    finally:
+        model.close()
+        harness.stop()
