@@ -76,9 +76,11 @@ def test_e2e_bench_machinery(tiny_cfg, monkeypatch):
     assert r["tok_s"] > 0
 
 
-def _run_bench_supervisor(tmp_path, *, budget="8", sig=None, wait=120):
+def _run_bench_supervisor(tmp_path, *, budget="8", sig=None, wait=120, smoke_pass=False):
     """Run bench.py's SUPERVISOR in a scratch dir with a stale LKG planted and
-    the backend unavailable (CPU); returns (stdout, rc, details)."""
+    the backend unavailable (CPU); returns (stdout, rc, details).
+    ``smoke_pass=True`` plants a previous genuine smoke PASS (and gives the
+    probe-retry ladder enough budget to reach the smoke attempt)."""
     import json
     import os
     import shutil
@@ -91,10 +93,13 @@ def _run_bench_supervisor(tmp_path, *, budget="8", sig=None, wait=120):
         "measured_at": "2026-01-01T00:00:00Z",
         "metric_line": {"metric": "m", "value": 1.23, "unit": "tok/s", "vs_baseline": 0.2},
     }))
-    (tmp_path / "BENCH_DETAILS.json").write_text(json.dumps({
+    planted = {
         "_bench_run": {"stale": False, "complete": True, "measured_at": "x"},
         "some_row": {"v": 1},
-    }))
+    }
+    if smoke_pass:
+        planted["tpu_exactness_smoke"] = {"passed": True, "summary": "5 passed"}
+    (tmp_path / "BENCH_DETAILS.json").write_text(json.dumps(planted))
     env = {
         **os.environ, "_PTU_BENCH_TIMEOUT": budget, "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": repo,
@@ -142,6 +147,26 @@ def test_bench_supervisor_emits_one_stale_line_on_outage(tmp_path):
     assert rc == 0
     run = details["_bench_run"]
     assert run["stale"] is True and run.get("complete") is True, run
+
+
+def test_outage_smoke_attempt_does_not_downgrade_a_real_pass(tmp_path):
+    """An outage run's smoke attempt necessarily fails (no chip) — it must
+    KEEP a previous genuine PASS verdict, recording the failed attempt
+    beside it, instead of overwriting the artifact with FAIL (the
+    dress-rehearsal bug found on the actual outage day of round 5)."""
+    import json
+
+    # budget must be big enough that the supervisor reaches the smoke
+    # attempt after the probe ladder (reserve = budget/4 must exceed the
+    # 30 s smoke floor)
+    out, rc, details = _run_bench_supervisor(
+        tmp_path, budget="150", smoke_pass=True, wait=220
+    )
+    assert rc == 0 and len(_metric_lines(out)) == 1
+    smoke = details["tpu_exactness_smoke"]
+    assert smoke["passed"] is True, smoke
+    assert smoke.get("carried_from_previous_run") is True
+    assert "failed_attempt" in smoke, smoke
 
 
 def test_bench_supervisor_sigterm_still_emits_the_line(tmp_path):
