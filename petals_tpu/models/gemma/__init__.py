@@ -14,9 +14,7 @@ a new block implementation:
   already rides LlamaBlockConfig.from_hf_config.
 
 Gemma 2 is a DIFFERENT architecture (logit softcapping, alternating sliding
-windows, post-norms) registered under model_type "gemma2" — it is not
-registered here, so loading one fails with an unknown-family error instead
-of silently serving wrong math.
+windows, post-norms): it has its own block implementation in models/gemma2.
 """
 
 from __future__ import annotations

@@ -1,0 +1,59 @@
+"""Gemma-2 block config (frozen, hashable — a static argument to jitted
+functions, like LlamaBlockConfig)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Gemma2BlockConfig:
+    hidden_size: int
+    num_attention_heads: int
+    num_key_value_heads: int
+    head_dim: int
+    intermediate_size: int
+    num_hidden_layers: int
+    rms_norm_eps: float
+    rope_theta: float = 10000.0
+    sliding_window: Optional[int] = None
+    # "sliding_attention" | "full_attention" per layer (HF layer_types)
+    layer_types: Tuple[str, ...] = ()
+    attn_logit_softcapping: Optional[float] = None
+    final_logit_softcapping: Optional[float] = None
+    query_pre_attn_scalar: float = 256.0
+    hidden_act: str = "gelu_tanh"
+    vocab_size: int = 256000
+    tie_word_embeddings: bool = True
+
+    @classmethod
+    def from_hf_config(cls, hf_config) -> "Gemma2BlockConfig":
+        layer_types = getattr(hf_config, "layer_types", None)
+        if not layer_types:
+            # older configs: gemma-2's convention is sliding on even layers
+            layer_types = tuple(
+                "sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(hf_config.num_hidden_layers)
+            )
+        return cls(
+            hidden_size=hf_config.hidden_size,
+            num_attention_heads=hf_config.num_attention_heads,
+            num_key_value_heads=hf_config.num_key_value_heads,
+            head_dim=getattr(hf_config, "head_dim", None)
+            or hf_config.hidden_size // hf_config.num_attention_heads,
+            intermediate_size=hf_config.intermediate_size,
+            num_hidden_layers=hf_config.num_hidden_layers,
+            rms_norm_eps=hf_config.rms_norm_eps,
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            sliding_window=getattr(hf_config, "sliding_window", None),
+            layer_types=tuple(layer_types),
+            attn_logit_softcapping=getattr(hf_config, "attn_logit_softcapping", None),
+            final_logit_softcapping=getattr(hf_config, "final_logit_softcapping", None),
+            query_pre_attn_scalar=float(
+                getattr(hf_config, "query_pre_attn_scalar", 256)
+            ),
+            hidden_act="gelu_tanh",
+            vocab_size=hf_config.vocab_size,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+        )

@@ -96,6 +96,7 @@ def attend(
     causal: bool = True,
     use_flash: bool = False,
     tp_mesh=None,
+    logit_softcap: Optional[float] = None,  # forces the XLA path (no flash rule)
 ) -> jnp.ndarray:
     """Multi-head attention with causal masking over a prefix-valid KV buffer.
 
@@ -119,7 +120,7 @@ def attend(
         getattr(jnp.asarray(q_offset), "ndim", 0) > 0
         or (kv_length is not None and getattr(jnp.asarray(kv_length), "ndim", 0) > 0)
     )
-    if use_flash and causal and not vector_pos:
+    if use_flash and causal and not vector_pos and logit_softcap is None:
         from petals_tpu.ops.flash_attention import flash_attend, flash_supported
 
         if flash_supported(q, k, v, sliding_window=sliding_window):
@@ -150,6 +151,7 @@ def attend(
         sliding_window=sliding_window,
         scale=scale,
         causal=causal,
+        logit_softcap=logit_softcap,
     )
 
 
@@ -222,6 +224,7 @@ def attend_reference(
     sliding_window: Optional[int] = None,
     scale: Optional[float] = None,
     causal: bool = True,
+    logit_softcap: Optional[float] = None,  # gemma-2: tanh(l/cap)*cap pre-mask
 ) -> jnp.ndarray:
     batch, q_len, num_q_heads, head_dim = q.shape
     _, kv_buf_len, num_kv_heads, _ = k.shape
@@ -240,6 +243,9 @@ def attend_reference(
     qg = qf.reshape(batch, q_len, num_kv_heads, group, head_dim)
     logits = jnp.einsum("bqkgd,bskd->bkgqs", qg, kf) * scale
     logits = logits.reshape(batch, num_q_heads, q_len, kv_buf_len)
+
+    if logit_softcap is not None:
+        logits = jnp.tanh(logits / logit_softcap) * logit_softcap
 
     kv_pos = jnp.arange(kv_buf_len, dtype=jnp.int32)
     if alibi_slopes is not None:

@@ -177,7 +177,14 @@ def load_block_params(
             f"{[p.format(i=block_index) for p in family.hf_block_prefixes]}"
         )
 
-    params = family.hf_to_block_params(tensors, cfg)
+    import inspect
+
+    if "block_index" in inspect.signature(family.hf_to_block_params).parameters:
+        # per-layer-heterogeneous architectures (gemma2's alternating
+        # windows) need to know WHICH block they are mapping
+        params = family.hf_to_block_params(tensors, cfg, block_index=block_index)
+    else:
+        params = family.hf_to_block_params(tensors, cfg)
     cast = lambda x: jnp.asarray(x, dtype) if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x)
     params = {
         name: (jnp.asarray(leaf) if name in family.cast_exempt

@@ -126,7 +126,20 @@ def measure_compute_rps(
     params = {}
     for name, sds in sorted(shapes.items()):
         key, sub = jax.random.split(key)
-        params[name] = jax.random.normal(sub, sds.shape, compute_dtype) * 0.02
+        if jnp.issubdtype(sds.dtype, jnp.floating):
+            params[name] = jax.random.normal(sub, sds.shape, sds.dtype) * 0.02
+        else:
+            # integer leaves (gemma2's per-block attn_window) must keep their
+            # declared dtype — float noise would cast to a wrong config
+            params[name] = jnp.zeros(sds.shape, sds.dtype)
+    if "attn_window" in params and getattr(cfg, "layer_types", None):
+        # probe block 0's REAL attention pattern: the advertised rps must
+        # describe the path that serves (sliding layers cost less than full)
+        window = (
+            cfg.sliding_window
+            if cfg.layer_types[0] == "sliding_attention" else 0
+        )
+        params["attn_window"] = jnp.asarray(window or 0, jnp.int32)
     if str(quant_type) != "none":
         from petals_tpu.utils.convert_block import convert_block_params
 

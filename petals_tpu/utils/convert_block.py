@@ -40,6 +40,7 @@ QUANTIZABLE_LEAVES: Dict[str, Set[str]] = {
     # expert stacks (w1/w2/w3) carry >90% of Mixtral's params — quantized
     # per-expert (3-D leaves), unlike the reference which also quantizes them
     "mixtral": {"wq", "wk", "wv", "wo", "w1", "w2", "w3"},
+    "gemma2": {"wq", "wk", "wv", "wo", "wg", "wu", "wd"},
 }
 
 
@@ -51,6 +52,10 @@ QUANTIZABLE_LEAVES: Dict[str, Set[str]] = {
 # quantizing separately. Biases (qwen2) fuse alongside.
 _FUSE_GROUPS: Dict[str, tuple] = {
     "llama": (
+        ("wqkv", ("wq", "wk", "wv"), "bqkv", ("bq", "bk", "bv")),
+        ("wgu", ("wg", "wu"), "bgu", ("bg", "bu")),
+    ),
+    "gemma2": (
         ("wqkv", ("wq", "wk", "wv"), "bqkv", ("bq", "bk", "bv")),
         ("wgu", ("wg", "wu"), "bgu", ("bg", "bu")),
     ),
@@ -99,6 +104,7 @@ def convert_block_params(
     quantizable = QUANTIZABLE_LEAVES.get(arch, set()) | {"wqkv", "wgu"}
     out = {}
     n_quantized = 0
+    leaf_names = sorted(params)  # the pop-loop empties params; keep for errors
     # consume OUR view of the dict leaf by leaf so each dense weight can be
     # freed as soon as its quantized form exists — at 405B shapes the dense
     # block alone is ~6.4 GiB, and holding every dense leaf until the loop
@@ -138,7 +144,7 @@ def convert_block_params(
         )
         raise ValueError(
             f"quant_type={quant_type.value!r} requested but no quantizable "
-            f"leaves matched for {detail} (leaves: {sorted(params)}); {hint}"
+            f"leaves matched for {detail} (leaves: {leaf_names}); {hint}"
         )
     return out
 

@@ -11,13 +11,13 @@ import pytest
 
 from petals_tpu.client.model import AutoDistributedModelForCausalLM
 from tests.test_full_model import SwarmHarness, _hf_greedy
-from tests.utils import make_tiny_gemma, make_tiny_mistral, make_tiny_phi3, make_tiny_qwen2
+from tests.utils import make_tiny_gemma, make_tiny_gemma2, make_tiny_mistral, make_tiny_phi3, make_tiny_qwen2
 
 
 @pytest.mark.parametrize(
     "maker,name",
     [(make_tiny_qwen2, "qwen2"), (make_tiny_mistral, "mistral"), (make_tiny_gemma, "gemma"),
-     (make_tiny_phi3, "phi3")],
+     (make_tiny_phi3, "phi3"), (make_tiny_gemma2, "gemma2")],
 )
 def test_quantization_applies_to_derived_families(tmp_path, maker, name):
     """Families registered under their own model_type but sharing the llama
@@ -213,3 +213,83 @@ def test_longrope_per_row_and_padding_selection():
     np.testing.assert_allclose(
         np.asarray(cos_short_nv[0, 0]), np.asarray(cos_long58[0, 0]), rtol=1e-6
     )
+
+
+def test_gemma2_block_exact_and_e2e(tmp_path):
+    """Gemma-2 (9th family, own block architecture): per-layer alternating
+    sliding/full attention, attention-logit soft-capping, four folded
+    post-norms, query_pre_attn_scalar scaling, final-logit soft-capping.
+    Full-pipeline cached decode (embed -> 4 blocks -> norm+head) must match
+    HF logits step by step past the window edge — driving the MODEL, not
+    naked layers, because HF implements the sliding window in the
+    model-level mask preparation — and swarm generation must be
+    token-identical."""
+    import jax.numpy as jnp
+    import torch
+    from transformers import Gemma2ForCausalLM
+
+    from petals_tpu.models.registry import get_family
+    from petals_tpu.client.from_pretrained import load_client_params
+    from petals_tpu.server.from_pretrained import load_block_params
+    from tests.utils import make_tiny_gemma2
+
+    path = make_tiny_gemma2(str(tmp_path))
+    model = Gemma2ForCausalLM.from_pretrained(path, attn_implementation="eager").eval()
+    fam = get_family("gemma2")
+    cfg = fam.config_from_hf(model.config)
+    assert cfg.layer_types[0] == "sliding_attention"
+    assert cfg.layer_types[1] == "full_attention"
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 100, (1, 15)).astype(np.int64)  # 12 prefill + 3 steps
+
+    with torch.no_grad():
+        hf_logits = model(torch.from_numpy(ids)).logits.numpy()
+
+    blocks = [load_block_params(path, i, dtype=jnp.float32) for i in range(4)]
+    assert int(blocks[0]["attn_window"]) == 6 and int(blocks[1]["attn_window"]) == 0
+    client = load_client_params(path, dtype=jnp.float32, family=fam, cfg=cfg)
+
+    def ours_logits(token_ids, kvs, position):
+        h = fam.client_embed(client, jnp.asarray(token_ids), cfg)
+        new_kvs = []
+        for p, kv in zip(blocks, kvs):
+            h, kv = fam.block_apply(p, h, kv, position, cfg)
+            new_kvs.append(kv)
+        return np.asarray(fam.client_head(client, h, cfg)), new_kvs
+
+    kd = jnp.zeros((1, 32, cfg.num_key_value_heads, cfg.head_dim), jnp.float32)
+    kvs = [(kd, kd)] * 4
+    out, kvs = ours_logits(ids[:, :12], kvs, 0)  # prefill crosses window 6
+    np.testing.assert_allclose(out, hf_logits[:, :12], atol=2e-4, rtol=0,
+                               err_msg="gemma2 prefill logits diverged")
+    for i in range(3):  # cached decode on both layer types
+        out, kvs = ours_logits(ids[:, 12 + i : 13 + i], kvs, 12 + i)
+        np.testing.assert_allclose(
+            out[:, 0], hf_logits[:, 12 + i], atol=2e-4, rtol=0,
+            err_msg=f"gemma2 decode logits diverged at position {12 + i}",
+        )
+
+    # e2e: greedy through a live swarm, token-identical (crosses window 6)
+    harness = SwarmHarness(
+        path, [dict(first_block=0, num_blocks=2), dict(first_block=2, num_blocks=2)]
+    ).start()
+    try:
+        client_model = AutoDistributedModelForCausalLM.from_pretrained(
+            path, initial_peers=harness.initial_peers
+        )
+        try:
+            input_ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+            # expected from the EAGER model: the default (sdpa) attention
+            # silently drops attn_logit_softcapping, so _hf_greedy would
+            # validate against softcap-free math
+            with torch.no_grad():
+                expected = model.generate(
+                    torch.from_numpy(input_ids), max_new_tokens=8, do_sample=False
+                ).numpy()
+            out = client_model.generate(input_ids, max_new_tokens=8)
+            np.testing.assert_array_equal(out, expected, err_msg="gemma2 e2e diverged")
+        finally:
+            client_model.close()
+    finally:
+        harness.stop()

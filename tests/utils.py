@@ -237,6 +237,38 @@ def make_tiny_qwen2(tmpdir: str, *, n_layers: int = 4, vocab: int = 128, tied: b
 
 
 @_model_build_cache
+def make_tiny_gemma2(tmpdir: str, *, n_layers: int = 4, vocab: int = 128) -> str:
+    """Gemma-2: alternating sliding/full attention (window 6 so tests cross
+    the window edge), attention + final logit soft-capping, four post-norms,
+    tied head."""
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    cfg = Gemma2Config(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=n_layers,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        sliding_window=6,
+        attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0,
+        query_pre_attn_scalar=16,
+        tie_word_embeddings=True,
+        attn_implementation="eager",  # softcapping requires the eager path
+    )
+    torch.manual_seed(9)
+    model = Gemma2ForCausalLM(cfg).eval()
+    path = os.path.join(tmpdir, "tiny-gemma2")
+    model.save_pretrained(path, safe_serialization=True)
+    return path
+
+
+@_model_build_cache
 def make_tiny_phi3(tmpdir: str, *, n_layers: int = 4, vocab: int = 128) -> str:
     """Phi-3 with LongRoPE: original window 64 << max 256, so tests that run
     past position 64 exercise the long-factor selection and attention scale
