@@ -180,3 +180,46 @@ def test_private_session_path(tmp_path_factory):
             model.close()
     finally:
         harness.stop()
+
+
+def test_prefix_hit_then_server_gen(full_span_swarm, monkeypatch):
+    """A session whose prefill HITS the prefix cache (device tier) and then
+    generates server-side in the same RPC: the seeded KV plus the gen loop
+    must stay token-identical to HF. Covers the handler's out-concat +
+    position accounting when gen_tokens follows a partially-cached prefill.
+    Both halves are asserted to actually run: the device-tier hit
+    (device_hits delta) and the gen fast path (spy returns non-None)."""
+    path, harness = full_span_swarm
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers
+    )
+    try:
+        from petals_tpu.server.prefix_cache import SEGMENT_TOKENS
+
+        served = {"n": 0}
+        orig = type(model)._server_side_greedy
+
+        def spy(self, *a, **kw):
+            out = orig(self, *a, **kw)
+            if out is not None:
+                served["n"] += 1
+            return out
+
+        monkeypatch.setattr(type(model), "_server_side_greedy", spy)
+
+        rng = np.random.RandomState(7)
+        # prompt long enough to span a full cached segment plus a tail
+        ids = rng.randint(0, 100, (1, SEGMENT_TOKENS + 9)).astype(np.int64)
+        expected = _hf_greedy(path, ids, 6)
+        out1 = model.generate(ids, max_new_tokens=6)  # populates the cache
+        np.testing.assert_array_equal(out1, expected)
+        pc = harness.servers[0].handler.prefix_cache
+        hits_before = pc.stats["hits"]
+        dev_hits_before = pc.stats.get("device_hits", 0)
+        out2 = model.generate(ids, max_new_tokens=6)  # hits, then gens
+        np.testing.assert_array_equal(out2, expected)
+        assert pc.stats["hits"] > hits_before, pc.summary()
+        assert pc.stats.get("device_hits", 0) > dev_hits_before, pc.summary()
+        assert served["n"] == 2, served  # the fast path served BOTH generates
+    finally:
+        model.close()
