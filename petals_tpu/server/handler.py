@@ -1173,10 +1173,11 @@ class TransformerHandler:
                     gen_n = max(1, min(int(gen_n), 32))
                     gen_n = 1 << (gen_n.bit_length() - 1)
                     # device-side greedy loop (backend.generate_tokens):
-                    # single-device sessions on a full-span server holding
-                    # the client leaves; clients gate on the server_gen info
-                    # flag, so a violation here is a protocol error, not a
-                    # fallback path
+                    # single-HOST sessions (plain or TP/SP mesh — GSPMD
+                    # partitions the whole scan) on a full-span server
+                    # holding the client leaves; clients gate on the
+                    # server_gen info flag, so a violation here is a
+                    # protocol error, not a fallback path
                     if not (
                         self.server_gen_params is not None
                         # the SESSION must cover the whole model: a sub-span
@@ -1186,7 +1187,6 @@ class TransformerHandler:
                         and start == 0
                         and end == self.backend.n_blocks
                         and not getattr(backend, "is_lockstep", False)
-                        and getattr(backend, "mesh", None) is None
                         and batch_size == 1
                         and prompts is None
                         and hypo_ids is None
@@ -1194,7 +1194,7 @@ class TransformerHandler:
                         raise ValueError(
                             "server-side generation is not available for this "
                             "session (requires a whole-model session on a "
-                            "full-span single-device server with client "
+                            "full-span single-host server with client "
                             "leaves loaded; check the server_gen info flag)"
                         )
 

@@ -614,8 +614,10 @@ class Server:
 
     def _load_server_gen_params(self):
         """Client leaves (embed/norm/head) for the device-side greedy
-        generation loop — full-span single-device servers only (the loop
-        reuses the span step fn, which is unsharded on that path). Loaded
+        generation loop — full-span servers, single-host (TP meshes
+        included: the loop reuses the span step fn, GSPMD partitions the
+        whole scan, and the replicated head/embed ride along; lockstep
+        groups stay excluded — the loop would need broadcast ops). Loaded
         in f32 so logits match the client's own lm_logits bit-for-bit."""
         if not self.server_side_generation:
             return None
@@ -623,7 +625,6 @@ class Server:
             self.num_blocks != self.cfg.num_hidden_layers
             or self.first_block != 0
             or self.num_hosts > 1
-            or getattr(self.backend, "mesh", None) is not None
         ):
             return None
         try:

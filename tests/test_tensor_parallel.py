@@ -427,10 +427,25 @@ def test_tp_server_end_to_end(tmp_path):
             path, initial_peers=harness.initial_peers
         )
         try:
+            # the TP server announces server_gen (round 5): this generate
+            # rides the device-side loop, GSPMD-partitioned over the mesh —
+            # assert the fast path really served, not a silent fallback
+            served = {"n": 0}
+            orig = type(model)._server_side_greedy
+
+            def spy(self, *a, **kw):
+                out = orig(self, *a, **kw)
+                if out is not None:
+                    served["n"] += 1
+                return out
+
+            import unittest.mock as _mock
             rng = np.random.RandomState(0)
             ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
-            ours = model.generate(ids, max_new_tokens=4)
+            with _mock.patch.object(type(model), "_server_side_greedy", spy):
+                ours = model.generate(ids, max_new_tokens=4)
             np.testing.assert_array_equal(ours, _hf_greedy(path, ids, 4))
+            assert served["n"] == 1, "TP server-gen fast path did not serve"
         finally:
             model.close()
     finally:
