@@ -168,7 +168,7 @@ class _ServerInferenceSession:
         tokens = np.asarray(reply["tokens"], np.int64)[None]  # [1, n]
         self.position = reply["position"]
         self.history.append((np.asarray(hidden), None))
-        if n_tokens > 1:
+        if tokens.shape[1] > 1:  # the returned count governs — servers clamp
             # the server fed tokens[:-1] (the last token is never fed)
             self.history.append((np.asarray(embed_fn(tokens[:, :-1])), None))
         return tokens
@@ -343,18 +343,24 @@ class InferenceSession:
         )
         self._sessions = await self._enter_server_sessions(chain)
 
-    def server_gen_available(self) -> bool:
-        """Whether the CURRENT route supports the device-side generation
-        loop: exactly one span covering every block, on a server announcing
-        the server_gen capability. Only meaningful after a route exists."""
-        if len(self._sessions) != 1 or self._sessions[0].closed:
+    def _spans_support_server_gen(self, spans) -> bool:
+        """One span covering every block, announcing the server_gen
+        capability — the shape the device-side generation loop needs."""
+        if len(spans) != 1:
             return False
-        span = self._sessions[0].span
+        span = spans[0]
         return (
             span.start == 0
             and span.end == self.num_blocks
             and bool(getattr(span.server_info, "server_gen", False))
         )
+
+    def server_gen_available(self) -> bool:
+        """Whether the CURRENT route supports the device-side generation
+        loop. Only meaningful after a route exists."""
+        if len(self._sessions) != 1 or self._sessions[0].closed:
+            return False
+        return self._spans_support_server_gen([s.span for s in self._sessions])
 
     async def generate_remote(
         self, hidden: np.ndarray, n_tokens: int, embed_fn,
@@ -643,12 +649,7 @@ class InferenceSession:
         # over whole chunks — migrating a gen-capable session onto a chain
         # WITHOUT the capability would demote it to the per-token path (a
         # large net slowdown) after paying a full KV export
-        if self.server_gen_available() and not (
-            len(candidate) == 1
-            and candidate[0].start == 0
-            and candidate[0].end == self.num_blocks
-            and bool(getattr(candidate[0].server_info, "server_gen", False))
-        ):
+        if self.server_gen_available() and not self._spans_support_server_gen(candidate):
             return False
         # history-transfer guard: each candidate span's input history must
         # exist client-side, i.e. its start must be a current session start

@@ -1206,7 +1206,9 @@ class TransformerHandler:
                             with device_annotation("server_gen"):
                                 tokens, new_kv = backend.generate_tokens(
                                     self.server_gen_params,
-                                    np.asarray(out)[:, -1:],
+                                    # slice BEFORE np.asarray: out may be a
+                                    # device array holding the whole prefill
+                                    np.asarray(out[:, -1:]),
                                     kv_lane, position, gen_n,
                                     active_adapter=active_adapter,
                                 )
@@ -1222,7 +1224,7 @@ class TransformerHandler:
                         def run_gen(kv=kv, out=out, gen_n=gen_n):
                             with device_annotation("server_gen"):
                                 tokens, new_kv = backend.generate_tokens(
-                                    self.server_gen_params, np.asarray(out)[:, -1:],
+                                    self.server_gen_params, np.asarray(out[:, -1:]),
                                     kv, position, gen_n,
                                     active_adapter=active_adapter,
                                 )
