@@ -146,19 +146,24 @@ class _ServerInferenceSession:
     async def step_generate(
         self, hidden: np.ndarray, n_tokens: int, embed_fn,
         *, start_from_position: Optional[int] = None, step_id: Optional[str] = None,
+        sampling: Optional[dict] = None,
     ) -> np.ndarray:
-        """Feed ``hidden`` and let the server generate ``n_tokens`` greedy
-        tokens device-side (full-span servers with the server_gen capability;
-        see server/backend.py generate_tokens). Returns the token ids
-        [batch, n_tokens]. ``embed_fn(tokens)`` reproduces the embeds the
-        server fed itself — recorded into the replay history so failover
-        onto a server WITHOUT the capability still rebuilds the exact KV."""
+        """Feed ``hidden`` and let the server generate ``n_tokens`` tokens
+        device-side (full-span servers with the server_gen capability; see
+        server/backend.py generate_tokens) — greedy, or sampled when a
+        ``sampling`` dict (rpc/protocol.py gen_sampling schema) is given.
+        Returns the token ids [batch, n_tokens]. ``embed_fn(tokens)``
+        reproduces the embeds the server fed itself — recorded into the
+        replay history so failover onto a server WITHOUT the capability
+        still rebuilds the exact KV."""
         if start_from_position is not None:
             self._rollback_history(start_from_position)
         msg = {
             "tensors": {"hidden": serialize_array(hidden, self.compression)},
             "gen_tokens": int(n_tokens),
         }
+        if sampling is not None:
+            msg["gen_sampling"] = sampling
         if step_id is not None:
             msg["step_id"] = step_id
         if start_from_position is not None:
@@ -343,42 +348,49 @@ class InferenceSession:
         )
         self._sessions = await self._enter_server_sessions(chain)
 
-    def _spans_support_server_gen(self, spans) -> bool:
-        """One span covering every block, announcing the server_gen
-        capability — the shape the device-side generation loop needs."""
+    def _spans_support_server_gen(self, spans, sampling: bool = False) -> bool:
+        """One span covering every block, announcing the server_gen (or, for
+        ``sampling``, server_gen_sampling) capability — the shape the
+        device-side generation loop needs."""
         if len(spans) != 1:
             return False
         span = spans[0]
+        flag = "server_gen_sampling" if sampling else "server_gen"
         return (
             span.start == 0
             and span.end == self.num_blocks
-            and bool(getattr(span.server_info, "server_gen", False))
+            and bool(getattr(span.server_info, flag, False))
         )
 
-    def server_gen_available(self) -> bool:
+    def server_gen_available(self, sampling: bool = False) -> bool:
         """Whether the CURRENT route supports the device-side generation
         loop. Only meaningful after a route exists."""
         if len(self._sessions) != 1 or self._sessions[0].closed:
             return False
-        return self._spans_support_server_gen([s.span for s in self._sessions])
+        return self._spans_support_server_gen(
+            [s.span for s in self._sessions], sampling=sampling
+        )
 
     async def generate_remote(
         self, hidden: np.ndarray, n_tokens: int, embed_fn,
+        sampling: Optional[dict] = None,
     ) -> Optional[np.ndarray]:
         """Feed ``hidden`` and have the full-span server generate ``n_tokens``
-        greedy tokens device-side. Returns token ids [batch, n_tokens], or
-        None when the current route cannot do it (caller falls back to the
-        per-token loop). On a mid-generate failure the server sessions are
-        torn down — the server's cache may have advanced past the client's
-        view, and the standard rebuild-and-replay failover (which the
-        recorded embed history feeds) is the one guaranteed-consistent
-        recovery — and None is returned so the caller continues client-side."""
+        tokens device-side — greedy, or via the server's on-device sampling
+        pipeline when a ``sampling`` dict (rpc/protocol.py gen_sampling
+        schema) is given. Returns token ids [batch, n_tokens], or None when
+        the current route cannot do it (caller falls back to the per-token
+        loop). On a mid-generate failure the server sessions are torn down —
+        the server's cache may have advanced past the client's view, and the
+        standard rebuild-and-replay failover (which the recorded embed
+        history feeds) is the one guaranteed-consistent recovery — and None
+        is returned so the caller continues client-side."""
         assert not self._closed
         n_input = hidden.shape[1]
         if self._position + n_input + n_tokens - 1 > self.max_length:
             return None
         await self._ensure_route(hidden)
-        if not self.server_gen_available():
+        if not self.server_gen_available(sampling=sampling is not None):
             return None
         session = self._sessions[0]
         rollback = self._position if session.position > self._position else None
@@ -386,6 +398,7 @@ class InferenceSession:
             tokens = await session.step_generate(
                 np.asarray(hidden), n_tokens, embed_fn,
                 start_from_position=rollback, step_id=uuid.uuid4().hex,
+                sampling=sampling,
             )
         except Exception as e:
             logger.warning(

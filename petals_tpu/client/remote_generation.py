@@ -17,6 +17,16 @@ from petals_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
+def uniform_for_draw(seed: int, draw_index: int) -> float:
+    """The server-gen PRNG contract (rpc/protocol.py): draw ``i`` of a stream
+    seeded ``s`` is uniform(fold_in(PRNGKey(s), i)). Threefry is
+    platform-deterministic, so replaying the stream client-side reproduces
+    the server's sampled tokens exactly (via the shared inverse-CDF draw) —
+    the basis of both mid-stream fallback and the parity tests."""
+    key = jax.random.fold_in(jax.random.PRNGKey(int(seed)), int(draw_index))
+    return float(jax.random.uniform(key))
+
+
 def sample_next_token(
     logits: np.ndarray,  # [batch, vocab] float32
     *,
@@ -25,14 +35,25 @@ def sample_next_token(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     rng: Optional[np.random.RandomState] = None,
+    rng_key: Optional[tuple] = None,  # (seed, draw_index): server-gen stream
 ) -> np.ndarray:
+    """Pick the next token per row. ``rng_key`` replays the deterministic
+    server-gen stream (see uniform_for_draw) by inverse-CDF instead of
+    drawing from ``rng`` — server-gen streams are single-row (batch == 1),
+    so every row shares the draw index, exactly like the device pipeline."""
     if not do_sample or temperature == 0.0:  # temperature->0 is greedy by convention
         return logits.argmax(axis=-1)
 
-    rng = rng or np.random
     logits = _warp_scores(logits, temperature=temperature, top_k=top_k, top_p=top_p)
     probs = _softmax(logits)
     out = np.empty(logits.shape[0], dtype=np.int64)
+    if rng_key is not None:
+        seed, draw_index = rng_key
+        u = uniform_for_draw(seed, draw_index)
+        for i in range(probs.shape[0]):
+            out[i] = min(int((probs[i].cumsum() < u).sum()), probs.shape[-1] - 1)
+        return out
+    rng = rng or np.random
     for i in range(logits.shape[0]):
         out[i] = rng.choice(probs.shape[-1], p=probs[i])
     return out
@@ -303,22 +324,25 @@ class RemoteGenerationMixin:
                 streamer.put(input_ids)  # HF: the prompt goes first
             hidden = np.asarray(self.embed(new_tokens, with_prompts=session.position == 0))
 
-            # Server-side greedy fast path: a full-span server generates
-            # whole CHUNKS of tokens device-side (one RPC per chunk instead
-            # of one per token — the per-token path pays a full host/device +
-            # network round trip for every token's logits). Pure greedy
-            # only: penalties/processors/criteria need client-side logits.
-            if (
-                not do_sample
-                and logits_processor is None
+            # Server-side fast paths: a full-span server generates whole
+            # CHUNKS of tokens device-side (one RPC per chunk instead of one
+            # per token — the per-token path pays a full host/device +
+            # network round trip for every token's logits). Custom
+            # processors/criteria/ngram-bans still need client-side logits;
+            # temperature/top-k/top-p/repetition-penalty compile into the
+            # server's decode loop (the gen_sampling request field).
+            fastpath_ok = (
+                logits_processor is None
                 and stopping_criteria is None
-                and (repetition_penalty is None or repetition_penalty == 1.0)
                 and not no_repeat_ngram_size
                 and (min_new_tokens or 0) == 0
                 and prompts is None
                 and batch == 1
                 and hasattr(session, "generate_remote")
-            ):
+            )
+            rep = 1.0 if repetition_penalty is None else float(repetition_penalty)
+            wants_sampling = do_sample and temperature != 0.0
+            if fastpath_ok and not wants_sampling and rep == 1.0:
                 result = self._server_side_greedy(
                     session, hidden, generated, max_new_tokens,
                     eos_token_id=eos_token_id, pad_token_id=pad_token_id,
@@ -328,6 +352,27 @@ class RemoteGenerationMixin:
                     return result
                 # clean fallback: nothing was consumed server-side, the
                 # per-token loop below re-sends the same prefill
+            elif fastpath_ok:
+                # sampling (or greedy-with-penalty) via the server's on-device
+                # warp pipeline. The wire seed IS the user's seed, so a fixed
+                # seed is reproducible end-to-end; an unseeded call draws a
+                # random one. NOTE the stream deliberately differs from the
+                # classic per-token path's np.RandomState stream — within the
+                # fast path it is deterministic and replayable (see
+                # uniform_for_draw), which is what mid-stream fallback needs.
+                wire_seed = (
+                    int(seed) % (1 << 31) if seed is not None
+                    else int(rng.randint(1 << 31))
+                )
+                result = self._server_side_sample(
+                    session, hidden, generated, max_new_tokens,
+                    do_sample=wants_sampling, temperature=temperature,
+                    top_k=top_k, top_p=top_p, repetition_penalty=rep,
+                    wire_seed=wire_seed, eos_token_id=eos_token_id,
+                    pad_token_id=pad_token_id, streamer=streamer,
+                )
+                if result is not None:
+                    return result
 
             out_hidden = session.step(hidden, prompts=prompts)
             logits = np.asarray(self.lm_logits(out_hidden[:, -1:]))[:, 0]
@@ -438,6 +483,100 @@ class RemoteGenerationMixin:
             out = session.step(pending_hidden)
             logits = np.asarray(self.lm_logits(out[:, -1:]))[:, 0]
             next_token = logits.argmax(-1).astype(generated.dtype)
+            generated = np.concatenate([generated, next_token[:, None]], axis=1)
+            if streamer is not None:
+                streamer.put(np.asarray(next_token))
+            remaining -= 1
+            if eos_token_id is not None and int(next_token[0]) == eos_token_id:
+                break
+            if remaining > 0:
+                pending_hidden = embed_fn(generated[:, -1:])
+        if streamer is not None:
+            streamer.end()
+        return generated
+
+    def _server_side_sample(
+        self, session, hidden, generated, max_new_tokens,
+        *, do_sample, temperature, top_k, top_p, repetition_penalty,
+        wire_seed, eos_token_id, pad_token_id, streamer,
+    ):
+        """Sampling (or greedy-with-repetition-penalty) via the server's
+        on-device warp pipeline, in chunks — the _server_side_greedy protocol
+        plus a ``gen_sampling`` request field. The PRNG schedule is stateless
+        (draw i <- fold_in(PRNGKey(wire_seed), i)), so ``draws`` — the count
+        of tokens sampled so far — is shipped as each chunk's ``offset`` and
+        a MID-stream failure finishes the tail client-side on the exact same
+        stream (sample_next_token's rng_key replay), token-identically.
+        Returns the final sequence, or None when the route cannot serve it
+        and nothing was consumed."""
+
+        def embed_fn(tokens):
+            return np.asarray(self.embed(tokens, with_prompts=False))
+
+        rep = float(repetition_penalty)
+        base = {
+            "do_sample": bool(do_sample),
+            "temperature": float(temperature),
+            "top_k": int(top_k or 0),
+            "top_p": float(top_p) if top_p is not None else 1.0,
+            "repetition_penalty": rep,
+            "seed": int(wire_seed),
+        }
+        draws = 0  # tokens sampled so far == next draw index
+        remaining = max_new_tokens
+        first = True
+        pending_hidden = hidden  # unfed input for the next request
+        while remaining > 0:
+            want = min(self._SERVER_GEN_CHUNK, remaining)
+            pos_before = session.position
+            sampling = dict(base, offset=draws)
+            if rep != 1.0:
+                # the penalty's seen-set snapshot; mid-chunk updates (tokens
+                # sampled within the chunk) happen server-side
+                sampling["context"] = [int(t) for t in generated[0]]
+            tokens = session.generate_remote(
+                pending_hidden, want, embed_fn, sampling=sampling
+            )
+            if tokens is None:
+                if first:
+                    return None
+                break  # finish the tail client-side below
+            first = False
+            got = tokens.shape[1]  # server may clamp the chunk
+            draws += got
+            if eos_token_id is not None:
+                eos_at = np.flatnonzero(tokens[0] == eos_token_id)
+                if eos_at.size:
+                    j = int(eos_at[0])
+                    tokens = tokens[:, : j + 1]
+                    # roll the server cache back so the eos token is the
+                    # pending-unfed one (the resume convention, exactly as
+                    # in the greedy fast path)
+                    session.position = pos_before + pending_hidden.shape[1] + j
+                    remaining = 0
+            generated = np.concatenate([generated, tokens], axis=1)
+            if streamer is not None:
+                streamer.put(np.asarray(tokens[0]))
+            if remaining:
+                remaining -= got
+            if remaining <= 0:
+                if streamer is not None:
+                    streamer.end()
+                return generated
+            # next chunk feeds the pending last token
+            pending_hidden = embed_fn(generated[:, -1:])
+
+        # mid-stream fallback: per-token sampling REPLAYING the same
+        # deterministic stream the server would have drawn from
+        while remaining > 0:
+            out = session.step(pending_hidden)
+            logits = np.asarray(self.lm_logits(out[:, -1:]))[:, 0]
+            scores = apply_repetition_penalty(logits, generated, rep)
+            next_token = sample_next_token(
+                scores, do_sample=do_sample, temperature=temperature,
+                top_k=top_k, top_p=top_p, rng_key=(wire_seed, draws),
+            ).astype(generated.dtype)
+            draws += 1
             generated = np.concatenate([generated, next_token[:, None]], axis=1)
             if streamer is not None:
                 streamer.put(np.asarray(next_token))

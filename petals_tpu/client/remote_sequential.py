@@ -120,9 +120,12 @@ class SyncInferenceSession:
     def step(self, hidden: np.ndarray, **kwargs) -> np.ndarray:
         return self._runtime.run(self._session.step(np.asarray(hidden), **kwargs))
 
-    def generate_remote(self, hidden: np.ndarray, n_tokens: int, embed_fn):
+    def generate_remote(self, hidden: np.ndarray, n_tokens: int, embed_fn,
+                        sampling=None):
         return self._runtime.run(
-            self._session.generate_remote(np.asarray(hidden), n_tokens, embed_fn)
+            self._session.generate_remote(
+                np.asarray(hidden), n_tokens, embed_fn, sampling=sampling
+            )
         )
 
     @property

@@ -140,6 +140,11 @@ class ServerInfo:
     # greedy generation loop (one RPC returns many tokens; see
     # server/backend.py generate_tokens)
     server_gen: Optional[bool] = None
+    # ...and, when set, the on-device sampling variant too (temperature /
+    # top-k / top-p / repetition penalty with a negotiated PRNG seed — the
+    # "gen_sampling" request field; see rpc/protocol.validate_gen_sampling).
+    # Separate flag so old clients on mixed swarms keep gating correctly.
+    server_gen_sampling: Optional[bool] = None
 
     def to_tuple(self) -> Tuple[int, float, dict]:
         extra_info = dataclasses.asdict(self)

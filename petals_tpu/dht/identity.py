@@ -20,11 +20,18 @@ import hashlib
 import json
 from typing import Any, Optional
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+except ModuleNotFoundError:  # hosts without `cryptography`: RFC 8032 in Python
+    from petals_tpu.dht._ed25519_fallback import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+        InvalidSignature,
+    )
 
 from petals_tpu.data_structures import PeerID
 

@@ -31,6 +31,7 @@ def block_apply(
     *,
     use_flash: bool = False,
     n_valid=None,  # dynamic count of real (non-padding) tokens in this chunk
+    n_total=None,  # final sequence length when known up front (longrope factor selection)
     ring_mesh=None,  # "sp" mesh: ring attention (stateless path) or q-sharded prefill (cached)
     tp_mesh=None,  # serving path: run the flash kernel per TP head-shard
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
@@ -63,6 +64,7 @@ def block_apply(
     cos, sin = rotary_tables(
         positions, d, theta=cfg.rope_theta, rope_scaling=cfg.rope_scaling_dict,
         n_valid=n_valid,  # longrope's switch must see the REAL chunk length
+        n_total=n_total,  # ...or the full prompt length when it is known up front
     )
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)

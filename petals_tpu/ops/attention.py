@@ -41,8 +41,9 @@ def _attend_sharded(
     ``shard_seq`` the QUERY sequence additionally shards over the "sp" axis —
     the KV-cached prefill path, where each device attends its query shard
     against the replicated cache with a rank-adjusted ``q_offset``."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from petals_tpu.ops.shmap import shard_map_no_check
 
     head_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
     seq_axis = "sp" if shard_seq else None
@@ -70,12 +71,11 @@ def _attend_sharded(
             use_flash=use_flash,  # per-device: the Mosaic kernel needs no GSPMD rule here
         )
 
-    fn = shard_map(
+    fn = shard_map_no_check(
         per_shard,
         mesh=mesh,
         in_specs=(qspec, kvspec, kvspec, P(), P(), P(head_axis)),
         out_specs=qspec,
-        check_vma=False,
     )
     return fn(
         q, k, v,

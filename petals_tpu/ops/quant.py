@@ -44,6 +44,9 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax<0.5 names this TPUCompilerParams; alias locally, never patch jax
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 NF4_BLOCK = 64
 _TK = 1024  # Pallas input-axis pad unit / fallback k-tile (packed rows: 512)
 _TK_WIDE = 2048  # preferred k-tile: measured 807 GB/s decode-free vs 475 at 1024
@@ -669,7 +672,7 @@ def _quant_pallas_call(
     span-stacked variants."""
     common = dict(
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

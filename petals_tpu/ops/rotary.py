@@ -23,14 +23,17 @@ def rotary_tables(
     scaling_factor: Optional[float] = None,
     rope_scaling: Optional[dict] = None,
     n_valid=None,  # real (non-padding) token count of this chunk, [b] or scalar
+    n_total=None,  # FINAL sequence length when known up front (chunked prefill)
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Compute cos/sin tables [batch, seq, head_dim] for the given positions.
 
     ``rope_scaling`` supports HF-style dicts with rope_type "linear",
     "llama3", or "longrope" (others raise NotImplementedError). Computation
-    is float32 throughout for parity with HF. ``n_valid`` only matters to
-    "longrope", whose factor selection depends on the REAL sequence length —
-    padded bucket tails must not count.
+    is float32 throughout for parity with HF. ``n_valid``/``n_total`` only
+    matter to "longrope", whose factor selection depends on the REAL
+    sequence length — padded bucket tails must not count, and a chunked
+    prefill whose final length is already known must select from THAT
+    length (``n_total``) so every chunk matches HF's single full forward.
     """
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     table_scale = 1.0
@@ -43,7 +46,7 @@ def rotary_tables(
             inv_freq = _llama3_scale_inv_freq(inv_freq, rope_scaling)
         elif rope_type == "longrope":
             inv_freq, table_scale = _longrope_inv_freq(
-                inv_freq, positions, rope_scaling, n_valid
+                inv_freq, positions, rope_scaling, n_valid, n_total
             )
         elif rope_type in ("default", None):
             pass
@@ -59,6 +62,7 @@ def rotary_tables(
 
 def _longrope_inv_freq(
     inv_freq: jnp.ndarray, positions: jnp.ndarray, cfg: dict, n_valid=None,
+    n_total=None,
 ):
     """Phi-3 LongRoPE (mirrors HF's _compute_longrope_parameters): per-dim
     extension factors — ``long_factor`` once the runtime sequence extends
@@ -74,12 +78,16 @@ def _longrope_inv_freq(
       token count; rows ascend from positions[:, 0]) overrides the padded
       maximum when given.
 
-    This traces HF's per-forward dynamic re-selection: a CACHED sequence
-    crossing the boundary switches tables for NEW positions only, exactly
-    like HF's cache path (HF's own single full forward over a >window
-    prompt would instead rotate every position with long factors — the same
-    cache-vs-forward quirk HF has; server-side chunked prefill behaves like
-    the cache path). config_from_hf injects ``factor`` and
+    When the FINAL prompt length is already known (``n_total``, e.g. a
+    chunked server-side prefill of a fully materialized prompt), it
+    overrides both branches below: every chunk selects factors from the
+    final length, matching HF's single full forward over the whole prompt.
+    Without ``n_total`` this traces HF's per-forward dynamic re-selection
+    instead: a CACHED sequence crossing the boundary switches tables for
+    NEW positions only, exactly like HF's cache path (the remaining
+    cache-vs-forward quirk is confined to sequences that only cross the
+    boundary during cached decode — the same quirk HF has).
+    config_from_hf injects ``factor`` and
     ``original_max_position_embeddings`` from the top-level HF config.
     Returns (inv_freq [b, 1, d/2], table_scale)."""
     import math
@@ -93,7 +101,11 @@ def _longrope_inv_freq(
         attention_factor = (
             1.0 if factor <= 1.0 else math.sqrt(1 + math.log(factor) / math.log(orig))
         )
-    if n_valid is not None:
+    if n_total is not None:
+        seq_len = jnp.broadcast_to(
+            jnp.asarray(n_total, positions.dtype), positions.shape[:1]
+        )
+    elif n_valid is not None:
         seq_len = positions[:, 0] + jnp.broadcast_to(
             jnp.asarray(n_valid, positions.dtype), positions.shape[:1]
         )

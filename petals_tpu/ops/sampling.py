@@ -1,0 +1,139 @@
+"""On-device token sampling for server-side generation.
+
+Mirrors the client's numpy pipeline (`client/remote_generation.py`:
+``apply_repetition_penalty`` -> ``_warp_scores`` -> softmax -> draw) in jnp so
+the warping compiles straight into the decode loop.  Everything is written for
+a per-row parameter VECTOR so a single compiled program can serve a pool of
+lanes with heterogeneous sampling settings:
+
+- ``do_sample``            [b] bool   — False rows take the greedy argmax
+- ``temperature``          [b] f32    — 1.0 disables
+- ``top_k``                [b] i32    — 0 disables
+- ``top_p``                [b] f32    — 1.0 disables
+- ``repetition_penalty``   [b] f32    — 1.0 disables
+- ``seen_mask``            [b, vocab] bool — tokens the penalty applies to
+- ``seeds`` / ``draw_idx`` [b] i32    — PRNG schedule, see below
+
+Reproducibility contract: draw ``i`` of a session seeded with ``s`` uses
+``jax.random.uniform(jax.random.fold_in(jax.random.PRNGKey(s), i))``.  Threefry
+is platform-deterministic, so a client can replay the identical uniform stream
+(``client/remote_generation.py::uniform_for_draw``) and re-derive every token
+via inverse-CDF — that is what makes mid-stream fallback from server-side
+sampling to client-side sampling seamless, and what the parity tests assert.
+
+The warp order matches the client exactly: repetition penalty -> temperature
+-> top-k -> top-p -> softmax -> inverse-CDF draw.  The client emulation runs
+in float64 while this runs in float32; with a shared uniform they can only
+disagree on exact floating-point ties, which are deterministic under a fixed
+seed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG_INF = float("-inf")
+
+
+def penalize_repetition(logits: jnp.ndarray, seen_mask: jnp.ndarray,
+                        penalty: jnp.ndarray) -> jnp.ndarray:
+    """HF-style repetition penalty: seen & positive -> score/penalty, seen &
+    non-positive -> score*penalty. ``penalty`` is per-row [b]; rows with 1.0
+    are exact no-ops."""
+    pen = penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / pen, logits * pen)
+    return jnp.where(seen_mask, penalized, logits)
+
+
+def warp_logits(scores: jnp.ndarray, temperature: jnp.ndarray,
+                top_k: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """temperature -> top-k -> top-p, each per-row and independently
+    disableable (1.0 / 0 / 1.0), same order as the client's _warp_scores."""
+    vocab = scores.shape[-1]
+    scores = scores / temperature[:, None]
+
+    # top-k: keep the k highest scores per row (k == 0 -> off)
+    sorted_desc = jnp.sort(scores, axis=-1)[:, ::-1]
+    kth_idx = jnp.clip(top_k - 1, 0, vocab - 1)
+    kth = jnp.take_along_axis(sorted_desc, kth_idx[:, None], axis=-1)
+    k_mask = (top_k > 0)[:, None] & (scores < kth)
+    scores = jnp.where(k_mask, _NEG_INF, scores)
+
+    # top-p nucleus: drop tokens beyond the cumulative-probability cutoff,
+    # always keeping the most probable token (cum - prob > p can never hit
+    # the first sorted entry)
+    order = jnp.argsort(-scores, axis=-1)
+    ss = jnp.take_along_axis(scores, order, axis=-1)
+    probs = jax.nn.softmax(ss, axis=-1)
+    cut = (jnp.cumsum(probs, axis=-1) - probs) > top_p[:, None]
+    ss = jnp.where(cut, _NEG_INF, ss)
+    rows = jnp.arange(scores.shape[0])[:, None]
+    restored = jnp.full_like(scores, _NEG_INF).at[rows, order].set(ss)
+    return jnp.where((top_p < 1.0)[:, None], restored, scores)
+
+
+def sample_tokens(logits: jnp.ndarray, *, do_sample: jnp.ndarray,
+                  temperature: jnp.ndarray, top_k: jnp.ndarray,
+                  top_p: jnp.ndarray, repetition_penalty: jnp.ndarray,
+                  seen_mask: jnp.ndarray, seeds: jnp.ndarray,
+                  draw_idx: jnp.ndarray) -> jnp.ndarray:
+    """Pick the next token per row [b, vocab] -> [b] int32.
+
+    Greedy rows take argmax of the PENALIZED logits (penalty 1.0 -> raw
+    argmax, bit-identical to the plain greedy path); sampling rows draw by
+    inverse-CDF against the session's deterministic uniform stream."""
+    logits = logits.astype(jnp.float32)
+    penalized = penalize_repetition(logits, seen_mask, repetition_penalty)
+    greedy = jnp.argmax(penalized, axis=-1).astype(jnp.int32)
+
+    warped = warp_logits(penalized, temperature, top_k, top_p)
+    probs = jax.nn.softmax(warped, axis=-1)
+    cdf = jnp.cumsum(probs, axis=-1)
+    u = jax.vmap(
+        lambda s, i: jax.random.uniform(
+            jax.random.fold_in(jax.random.PRNGKey(s), i))
+    )(seeds, draw_idx)
+    drawn = jnp.minimum(
+        jnp.sum((cdf < u[:, None]).astype(jnp.int32), axis=-1),
+        logits.shape[-1] - 1,
+    ).astype(jnp.int32)
+    return jnp.where(do_sample, drawn, greedy)
+
+
+def sampling_vectors(batch: int, vocab: int,
+                     sampling: Optional[dict] = None,
+                     *, offset_override: Optional[int] = None) -> dict:
+    """Host-side helper: build the full per-row parameter set for a batch
+    where every row shares one ``sampling`` dict (or no sampling at all).
+    Inactive/greedy defaults are exact no-ops for every warp stage."""
+    vec = {
+        "do_sample": np.zeros((batch,), bool),
+        "temperature": np.ones((batch,), np.float32),
+        "top_k": np.zeros((batch,), np.int32),
+        "top_p": np.ones((batch,), np.float32),
+        "repetition_penalty": np.ones((batch,), np.float32),
+        "seen_mask": np.zeros((batch, vocab), bool),
+        "seeds": np.zeros((batch,), np.int32),
+        "draw_idx": np.zeros((batch,), np.int32),
+    }
+    if sampling is None:
+        return vec
+    vec["do_sample"][:] = bool(sampling.get("do_sample", False))
+    vec["temperature"][:] = float(sampling.get("temperature", 1.0))
+    vec["top_k"][:] = int(sampling.get("top_k", 0) or 0)
+    vec["top_p"][:] = float(sampling.get("top_p", 1.0) or 1.0)
+    rep = float(sampling.get("repetition_penalty", 1.0) or 1.0)
+    vec["repetition_penalty"][:] = rep
+    vec["seeds"][:] = int(sampling.get("seed", 0))
+    offset = int(sampling.get("offset", 0))
+    vec["draw_idx"][:] = offset if offset_override is None else offset_override
+    if rep != 1.0:
+        for tok in sampling.get("context") or ():
+            t = int(tok)
+            if 0 <= t < vocab:
+                vec["seen_mask"][:, t] = True
+    return vec

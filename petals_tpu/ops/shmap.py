@@ -1,0 +1,33 @@
+"""shard_map across jax versions.
+
+jax moved ``shard_map`` out of ``jax.experimental`` (>=0.6) and renamed its
+replication-check knob ``check_rep`` -> ``check_vma`` along the way. Every
+kernel wrapper in ops/ needs the check OFF (the Mosaic custom calls inside
+have no replication rule), so the one compat decision lives here.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+
+def axis_size(axis_name):
+    """Static size of a mapped axis, from inside shard_map."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)  # constant-folds on jax<0.6
+
+
+def shard_map_no_check(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map
+    except ImportError:  # jax<0.6 keeps it under experimental
+        from jax.experimental.shard_map import shard_map
+
+    if "check_vma" in inspect.signature(shard_map).parameters:
+        kw = {"check_vma": False}
+    else:
+        kw = {"check_rep": False}
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

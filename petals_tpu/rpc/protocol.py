@@ -14,13 +14,28 @@ calls; each call has a connection-local id. Message kinds:
 
 Frames: 4-byte big-endian length + msgpack body. Payload tensors ride as
 msgpack bin (see rpc/serialization.py).
+
+Server-side generation rides the ``inference`` stream: a step item may carry
+``"gen_tokens": n`` (generate n tokens on device from the step's output) and,
+optionally, ``"gen_sampling"``, a dict validated by
+:func:`validate_gen_sampling`:
+
+  {"do_sample": bool, "temperature": f>0, "top_k": int>=0 (0=off),
+   "top_p": f in (0,1] (1=off), "repetition_penalty": f>0 (1=off),
+   "seed": int in [0, 2^31), "offset": int>=0, "context": [int token ids]?}
+
+The PRNG contract is stateless: draw ``i`` of a stream seeded ``s`` uses
+uniform(fold_in(PRNGKey(s), i)); ``offset`` is the first draw index of this
+request, so a client can resume or replay the stream mid-generation.
+``context`` (previously seen token ids) is only consulted when
+repetition_penalty != 1.
 """
 
 from __future__ import annotations
 
 import asyncio
 import struct
-from typing import Any
+from typing import Any, Optional
 
 import msgpack
 
@@ -49,3 +64,43 @@ async def write_frame(writer: asyncio.StreamWriter, message: Any, lock: asyncio.
     async with lock:  # interleaving-safe: one frame at a time per connection
         writer.write(frame)
         await writer.drain()
+
+
+def validate_gen_sampling(payload: Any) -> Optional[dict]:
+    """Normalize and validate a step item's ``gen_sampling`` dict (schema in
+    the module docstring). Returns a clean dict with every field present, or
+    None for a None payload. Raises ValueError on anything malformed — the
+    handler turns that into a protocol error before touching the device."""
+    if payload is None:
+        return None
+    if not isinstance(payload, dict):
+        raise ValueError(f"gen_sampling must be a dict, got {type(payload).__name__}")
+    out = {
+        "do_sample": bool(payload.get("do_sample", False)),
+        "temperature": float(payload.get("temperature", 1.0)),
+        "top_k": int(payload.get("top_k", 0) or 0),
+        "top_p": float(payload.get("top_p", 1.0) if payload.get("top_p") is not None else 1.0),
+        "repetition_penalty": float(payload.get("repetition_penalty", 1.0) or 1.0),
+        "seed": int(payload.get("seed", 0)),
+        "offset": int(payload.get("offset", 0)),
+    }
+    if not out["temperature"] > 0:
+        raise ValueError(f"gen_sampling.temperature must be > 0, got {out['temperature']}")
+    if out["top_k"] < 0:
+        raise ValueError(f"gen_sampling.top_k must be >= 0, got {out['top_k']}")
+    if not 0 < out["top_p"] <= 1:
+        raise ValueError(f"gen_sampling.top_p must be in (0, 1], got {out['top_p']}")
+    if not out["repetition_penalty"] > 0:
+        raise ValueError(
+            f"gen_sampling.repetition_penalty must be > 0, got {out['repetition_penalty']}"
+        )
+    if not 0 <= out["seed"] < 1 << 31:
+        raise ValueError(f"gen_sampling.seed must be in [0, 2^31), got {out['seed']}")
+    if out["offset"] < 0:
+        raise ValueError(f"gen_sampling.offset must be >= 0, got {out['offset']}")
+    context = payload.get("context")
+    if context is not None:
+        if not isinstance(context, (list, tuple)):
+            raise ValueError("gen_sampling.context must be a list of token ids")
+        out["context"] = [int(t) for t in context]
+    return out

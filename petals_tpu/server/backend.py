@@ -26,6 +26,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import functools
+import inspect
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
@@ -33,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from petals_tpu.models.registry import ModelFamily
+from petals_tpu.ops.sampling import sample_tokens, sampling_vectors
 from petals_tpu.server.memory_cache import MemoryCache, TensorDescriptor
 from petals_tpu.utils.logging import get_logger
 
@@ -248,13 +250,17 @@ class TransformerBackend:
         split_quant = self._split_quant
         use_quant_consts = self._use_quant_consts
         reattach = self._reattach_quant
+        # longrope (phi3) selects rotary factors from the FINAL sequence
+        # length; only families whose block accepts it get the extra operand
+        takes_n_total = "n_total" in inspect.signature(family.block_apply).parameters
 
         @functools.partial(
             jax.jit,
             static_argnames=("with_prompts", "with_hypo", "padded"),
             donate_argnums=(1, 2),
         )
-        def step(params, k_stack, v_stack, hidden, position, n_valid, prompts, hypo_ids,
+        def step(params, k_stack, v_stack, hidden, position, n_valid, n_total,
+                 prompts, hypo_ids,
                  *, with_prompts: bool, with_hypo: bool, padded: bool):
             hidden = hidden.astype(k_stack.dtype)
             use_sp = supports_sp and hidden.shape[1] > 1 and hidden.shape[1] % sp_size == 0
@@ -302,6 +308,8 @@ class TransformerBackend:
                     if family.supports_ring_attention
                     else {}
                 )
+                if takes_n_total:
+                    extra["n_total"] = n_total
                 out, (k_new, v_new) = family.block_apply(
                     p_block, h, (k_block, v_block), position, cfg,
                     use_flash=use_flash, n_valid=n_valid if padded else None,
@@ -513,7 +521,7 @@ class TransformerBackend:
                 h_in = client_embed(client_params, tok[:, None], cfg)
                 out, k_stack, v_stack = step_fn(
                     span_params, k_stack, v_stack, h_in, pos, jnp.int32(1),
-                    dummy_prompts, dummy_hypo,
+                    pos + 1, dummy_prompts, dummy_hypo,
                     with_prompts=False, with_hypo=False, padded=False,
                 )
                 nt = sample(out)
@@ -530,13 +538,77 @@ class TransformerBackend:
 
         return gen
 
+    @functools.cached_property
+    def _server_gen_sampled_fn(self):
+        """Sampling twin of ``_server_gen_fn``: the same sample -> embed ->
+        span-scan loop with the ops/sampling warp pipeline (repetition
+        penalty -> temperature -> top-k -> top-p -> inverse-CDF draw)
+        compiled into each iteration. The PRNG schedule is stateless —
+        draw ``i`` uses fold_in(PRNGKey(seed), i) — so the client can
+        replay the uniform stream for mid-stream fallback, and a fixed
+        seed is bit-reproducible across runs. The greedy fn stays separate
+        and untouched: greedy sessions keep their existing (already
+        compiled) executable and never pay for the warp stages."""
+        family, cfg = self.family, self.cfg
+        step_fn = self._inference_step_fn
+        client_embed, client_head = family.client_embed, family.client_head
+
+        @functools.partial(
+            jax.jit, static_argnames=("n_tokens",), donate_argnums=(2, 3)
+        )
+        def gen(span_params, client_params, k_stack, v_stack, last_hidden,
+                position, dummy_prompts, dummy_hypo, do_sample, temperature,
+                top_k, top_p, rep_penalty, seeds, draw0, seen0,
+                *, n_tokens: int):
+            batch = seen0.shape[0]
+
+            def sample(h, seen, idx):
+                logits = client_head(client_params, h[:, -1:], cfg)[:, -1, :]
+                return sample_tokens(
+                    logits, do_sample=do_sample, temperature=temperature,
+                    top_k=top_k, top_p=top_p, repetition_penalty=rep_penalty,
+                    seen_mask=seen, seeds=seeds, draw_idx=idx,
+                )
+
+            def mark(seen, tok):
+                return seen.at[jnp.arange(batch), tok].set(True)
+
+            t0 = sample(last_hidden, seen0, draw0)
+
+            def body(carry, _):
+                tok, k_stack, v_stack, pos, seen, idx = carry
+                seen = mark(seen, tok)
+                h_in = client_embed(client_params, tok[:, None], cfg)
+                out, k_stack, v_stack = step_fn(
+                    span_params, k_stack, v_stack, h_in, pos, jnp.int32(1),
+                    pos + 1, dummy_prompts, dummy_hypo,
+                    with_prompts=False, with_hypo=False, padded=False,
+                )
+                nt = sample(out, seen, idx)
+                return (nt, k_stack, v_stack, pos + 1, seen, idx + 1), nt
+
+            (_, k_stack, v_stack, _, _, _), toks = jax.lax.scan(
+                body,
+                (t0, k_stack, v_stack, jnp.asarray(position, jnp.int32),
+                 seen0, draw0 + 1),
+                None,
+                length=n_tokens - 1,
+            )
+            tokens = jnp.concatenate([t0[None], toks], axis=0)  # [n, b]
+            return tokens.T, k_stack, v_stack
+
+        return gen
+
     def generate_tokens(
         self, client_params, last_hidden, kv, position: int, n_tokens: int,
         *, active_adapter: Optional[str] = None,
+        sampling: Optional[dict] = None,
     ):
-        """Greedily generate ``n_tokens`` on device from ``last_hidden`` (the
-        span output of the last fed token). Feeds n_tokens - 1 tokens into
-        the cache (the final token stays unfed, client-loop convention).
+        """Generate ``n_tokens`` on device from ``last_hidden`` (the span
+        output of the last fed token) — greedy by default, sampled when a
+        validated ``sampling`` dict (rpc/protocol.validate_gen_sampling
+        schema) is given. Feeds n_tokens - 1 tokens into the cache (the
+        final token stays unfed, client-loop convention).
         Returns (tokens [batch, n_tokens] int32, (k_stack, v_stack))."""
         assert client_params is not None
         k_stack, v_stack = kv
@@ -552,12 +624,152 @@ class TransformerBackend:
         )
         dummy_h = self._dummy_operand((batch,), jnp.int32)
         with self._quant_ctx():
-            tokens, k_stack, v_stack = self._server_gen_fn(
-                span_params, client_params, k_stack, v_stack,
-                jnp.asarray(last_hidden), np.int32(position), dummy_p, dummy_h,
-                n_tokens=int(n_tokens),
-            )
+            if sampling is None:
+                tokens, k_stack, v_stack = self._server_gen_fn(
+                    span_params, client_params, k_stack, v_stack,
+                    jnp.asarray(last_hidden), np.int32(position), dummy_p,
+                    dummy_h, n_tokens=int(n_tokens),
+                )
+            else:
+                vec = sampling_vectors(batch, self.cfg.vocab_size, sampling)
+                tokens, k_stack, v_stack = self._server_gen_sampled_fn(
+                    span_params, client_params, k_stack, v_stack,
+                    jnp.asarray(last_hidden), np.int32(position), dummy_p,
+                    dummy_h, vec["do_sample"], vec["temperature"],
+                    vec["top_k"], vec["top_p"], vec["repetition_penalty"],
+                    vec["seeds"], vec["draw_idx"], vec["seen_mask"],
+                    n_tokens=int(n_tokens),
+                )
         return tokens, (k_stack, v_stack)
+
+    @functools.cached_property
+    def _sample_hidden_fn(self):
+        """Head + sample from a last-hidden, jitted: the lane-pool gen
+        bootstrap (t0 comes from the caller's prefill/step output before the
+        pooled per-token loop takes over)."""
+        family, cfg = self.family, self.cfg
+        client_head = family.client_head
+
+        @jax.jit
+        def f(client_params, last_hidden, do_sample, temperature, top_k,
+              top_p, rep_penalty, seen, seeds, draw_idx):
+            logits = client_head(client_params, last_hidden[:, -1:], cfg)[:, -1, :]
+            return sample_tokens(
+                logits, do_sample=do_sample, temperature=temperature,
+                top_k=top_k, top_p=top_p, repetition_penalty=rep_penalty,
+                seen_mask=seen, seeds=seeds, draw_idx=draw_idx,
+            )
+
+        return f
+
+    def sample_from_hidden(self, client_params, last_hidden,
+                           sampling: Optional[dict] = None) -> np.ndarray:
+        """Pick the next token(s) [batch] int32 from a span output — greedy
+        unless a validated ``sampling`` dict is given."""
+        assert client_params is not None
+        batch = last_hidden.shape[0]
+        vec = sampling_vectors(batch, self.cfg.vocab_size, sampling)
+        with self._quant_ctx():
+            tok = self._sample_hidden_fn(
+                client_params, jnp.asarray(last_hidden), vec["do_sample"],
+                vec["temperature"], vec["top_k"], vec["top_p"],
+                vec["repetition_penalty"], vec["seen_mask"], vec["seeds"],
+                vec["draw_idx"],
+            )
+        return np.asarray(tok)
+
+    @functools.cached_property
+    def _batched_gen_decode_fn(self):
+        """One decode step over the whole lane pool with the client leaves in
+        the loop: gen lanes feed their previous TOKEN (embedded on device)
+        while plain decode lanes feed their client-provided hidden, the pool
+        scan advances every lane at its own position, and the head + sampling
+        pipeline picks each gen lane's next token — N server-gen sessions at
+        different depths advance in ONE compiled program per token, sharing
+        the step with ordinary per-token traffic. Per-lane sampling vectors
+        let greedy and sampling sessions coexist in the same step."""
+        family, cfg = self.family, self.cfg
+        tp_mesh = self.mesh
+        split_quant = self._split_quant
+        use_quant_consts = self._use_quant_consts
+        reattach = self._reattach_quant
+        client_embed, client_head = family.client_embed, family.client_head
+
+        @functools.partial(jax.jit, donate_argnums=(2, 3))
+        def step(params, client_params, k_pool, v_pool, hidden, tokens,
+                 use_token, positions, do_sample, temperature, top_k, top_p,
+                 rep_penalty, seeds, draw_idx, seen_mask):
+            # hidden: [n_lanes, 1, hidden]; tokens/use_token/positions: [n_lanes]
+            emb = client_embed(client_params, tokens[:, None], cfg)
+            hidden = jnp.where(
+                use_token[:, None, None],
+                emb.astype(k_pool.dtype),
+                hidden.astype(k_pool.dtype),
+            )
+            if use_quant_consts:
+                dense_params, quant_params, outlier_names = split_quant(params)
+                xs_params = dense_params
+                block_indices = jnp.arange(k_pool.shape[0], dtype=jnp.int32)
+            else:
+                xs_params = params
+                block_indices = jnp.zeros((k_pool.shape[0],), jnp.int32)  # unused
+
+            def body(h, xs):
+                p_block, k_block, v_block, block_idx = xs
+                if use_quant_consts:
+                    p_block = reattach(p_block, quant_params, outlier_names, block_idx)
+                out, (k_new, v_new) = family.block_apply(
+                    p_block, h, (k_block, v_block), positions, cfg,
+                    use_flash=False, tp_mesh=tp_mesh,
+                )
+                return out, (k_new, v_new)
+
+            hidden, (k_pool, v_pool) = jax.lax.scan(
+                body, hidden, (xs_params, k_pool, v_pool, block_indices)
+            )
+            logits = client_head(client_params, hidden, cfg)[:, -1, :]
+            next_tok = sample_tokens(
+                logits, do_sample=do_sample, temperature=temperature,
+                top_k=top_k, top_p=top_p, repetition_penalty=rep_penalty,
+                seen_mask=seen_mask, seeds=seeds, draw_idx=draw_idx,
+            )
+            return hidden, next_tok, k_pool, v_pool
+
+        return step
+
+    def batched_gen_decode_step(self, client_params, hidden, tokens,
+                                use_token, pool_kv, positions, *,
+                                sampling_vecs, handles=None):
+        """One coalesced decode+generate step over the whole lane pool.
+
+        Args:
+          client_params: the full-model client leaves (embed + head).
+          hidden: [n_lanes, 1, hidden] — plain decode lanes' inputs (idle and
+            gen lanes: any finite filler).
+          tokens: int32 [n_lanes] — gen lanes' previous token (others: 0).
+          use_token: bool [n_lanes] — True where the embedded token (not
+            ``hidden``) is this lane's step input.
+          pool_kv / positions: as in batched_decode_step (idle sentinel =
+            pool length).
+          sampling_vecs: per-lane parameter dict (ops/sampling.sampling_vectors
+            layout: do_sample/temperature/top_k/top_p/repetition_penalty/
+            seen_mask/seeds/draw_idx).
+        Returns (hidden_out, next_tokens [n_lanes] i32, (k_pool, v_pool)).
+        """
+        k_pool, v_pool = pool_kv
+        if not isinstance(hidden, jax.Array):
+            hidden = np.ascontiguousarray(hidden)
+        v = sampling_vecs
+        with self._quant_ctx():
+            out, toks, k_pool, v_pool = self._batched_gen_decode_fn(
+                self.params, client_params, k_pool, v_pool, hidden,
+                np.asarray(tokens, np.int32), np.asarray(use_token, bool),
+                np.asarray(positions, np.int32), v["do_sample"],
+                v["temperature"], v["top_k"], v["top_p"],
+                v["repetition_penalty"], v["seeds"], v["draw_idx"],
+                v["seen_mask"],
+            )
+        return out, toks, (k_pool, v_pool)
 
     # ------------------------------------------------------------- public API
 
@@ -590,11 +802,16 @@ class TransformerBackend:
         span_params = self.params_for(active_adapter)
         outputs = []
         offset = 0
+        # The final sequence length after this step is known up front: thread
+        # it through so longrope (phi3) selects rotary factors from it in
+        # EVERY chunk — a chunked prefill then matches HF's single full
+        # forward instead of flipping factors mid-prompt.
+        n_total = position + total_seq
         for chunk_len in self.chunk_plan(batch, total_seq, kv_buf_len=max_length):
             chunk = hidden[:, offset : offset + chunk_len]
             out, k_stack, v_stack = self._step_once(
                 span_params, chunk, k_stack, v_stack, position + offset, prompts,
-                hypo_ids if offset == 0 else None,
+                hypo_ids if offset == 0 else None, n_total=n_total,
             )
             outputs.append(out)
             offset += chunk_len
@@ -602,9 +819,12 @@ class TransformerBackend:
         result = outputs[0] if len(outputs) == 1 else jnp.concatenate(outputs, axis=1)
         return result, (k_stack, v_stack)
 
-    def _step_once(self, span_params, chunk, k_stack, v_stack, position, prompts, hypo_ids):
+    def _step_once(self, span_params, chunk, k_stack, v_stack, position, prompts,
+                   hypo_ids, n_total=None):
         batch, seq, _ = chunk.shape
         n_valid = seq
+        if n_total is None:
+            n_total = position + seq
         if seq == 1:
             padded, is_padded = chunk, False
         else:
@@ -640,6 +860,7 @@ class TransformerBackend:
                 padded,
                 np.int32(position),
                 np.int32(n_valid),
+                np.int32(n_total),
                 prompts_arr,
                 hypo_arr,
                 with_prompts=with_prompts,

@@ -35,17 +35,42 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import dataclasses
 import itertools
 import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from petals_tpu.ops.sampling import sampling_vectors
 from petals_tpu.server.memory_cache import AllocationFailed, MemoryCache
 from petals_tpu.server.task_queue import PRIORITY_INFERENCE, PriorityTaskQueue
 from petals_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class _LaneGenState:
+    """Host-side bookkeeping for one lane mid server-side generation: the
+    flush loop advances every registered lane by one token per batched step
+    (feeding ``token`` at ``position``) until ``remaining`` hits zero, then
+    resolves ``future`` with the collected stream."""
+
+    future: asyncio.Future
+    generation: int
+    token: int  # last sampled token — fed on the next step
+    position: int  # cache write position for that next step
+    remaining: int  # decode steps left (n_tokens - 1 at start)
+    collected: List[int]
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    repetition_penalty: float = 1.0
+    seed: int = 0
+    draw_idx: int = 0
+    seen: Optional[np.ndarray] = None  # [vocab] bool; only when penalty active
 
 
 class DecodeBatcher:
@@ -60,6 +85,7 @@ class DecodeBatcher:
         n_lanes: int = 8,
         max_length: int = 1024,
         alloc_timeout: Optional[float] = None,
+        gen_params=None,  # full-model client leaves: enables pooled server-gen
     ):
         self.backend = backend
         self.memory_cache = memory_cache
@@ -67,6 +93,11 @@ class DecodeBatcher:
         self.n_lanes = n_lanes
         self.max_length = max_length
         self.alloc_timeout = alloc_timeout
+        self.gen_params = gen_params
+        # lanes currently running server-side generation: advanced one token
+        # per flush-loop iteration alongside (and batched WITH) ordinary
+        # per-token decode traffic
+        self._gen_states: Dict[int, _LaneGenState] = {}
 
         self._pool_stack: Optional[contextlib.AsyncExitStack] = None
         self._handles = None
@@ -93,7 +124,10 @@ class DecodeBatcher:
         self._lockstep = bool(getattr(backend, "is_lockstep", False))
         self._temp_ids = itertools.count(-2, -1)
         # observability + tests: how many device steps served how many tokens
-        self.stats = {"batched_steps": 0, "batched_tokens": 0, "max_batch": 0}
+        self.stats = {
+            "batched_steps": 0, "batched_tokens": 0, "max_batch": 0,
+            "gen_steps": 0, "gen_lane_tokens": 0, "max_gen_lanes": 0,
+        }
 
     # ------------------------------------------------------------------ pool
 
@@ -144,6 +178,10 @@ class DecodeBatcher:
             if not fut.done():
                 fut.set_exception(AllocationFailed("Batcher is shutting down"))
         self._lane_waiters.clear()
+        for st in self._gen_states.values():
+            if not st.future.done():
+                st.future.set_exception(AllocationFailed("Batcher is shutting down"))
+        self._gen_states.clear()
         if self._pool_stack is not None:
             await self._pool_stack.aclose()
             self._pool_stack = None
@@ -207,6 +245,11 @@ class DecodeBatcher:
             else:
                 kept.append(entry)
         self._pending = kept
+        # likewise a mid-generation release: fail the stream so the handler
+        # never resolves it against a lane now owned by someone else
+        st = self._gen_states.pop(lane, None)
+        if st is not None and not st.future.done():
+            st.future.set_exception(AllocationFailed("Lane released mid-step"))
         self._lane_generation.pop(lane, None)
         # hand straight to the next waiter, else back to the free list; the
         # new session overwrites the lane from position 0, so no zeroing
@@ -237,7 +280,7 @@ class DecodeBatcher:
         return await fut
 
     async def _flush_loop(self) -> None:
-        while self._pending:
+        while self._pending or self._gen_states:
             batch, self._pending = self._pending, []
             # entries enqueued before a pool reset must fail loudly — running
             # them against the rematerialized (zeroed) pool would be the
@@ -249,21 +292,138 @@ class DecodeBatcher:
                     fut.set_exception(AllocationFailed(
                         "Lane pool was reset while this step was pending"
                     ))
-            if not batch:
+            # same staleness rule for mid-generation lanes
+            for lane, st in list(self._gen_states.items()):
+                if st.generation != self._generation:
+                    del self._gen_states[lane]
+                    if not st.future.done():
+                        st.future.set_exception(AllocationFailed(
+                            "Lane pool was reset while this step was pending"
+                        ))
+            gen_states = dict(self._gen_states)
+            if not batch and not gen_states:
                 continue
             try:
-                out = await self.queue.submit(
-                    self._run_batch, batch, priority=PRIORITY_INFERENCE, size=len(batch)
-                )
+                if gen_states:
+                    out, toks = await self.queue.submit(
+                        self._run_batch_gen, batch, gen_states,
+                        priority=PRIORITY_INFERENCE,
+                        size=len(batch) + len(gen_states),
+                    )
+                else:
+                    out = await self.queue.submit(
+                        self._run_batch, batch, priority=PRIORITY_INFERENCE,
+                        size=len(batch),
+                    )
+                    toks = None
             except BaseException as e:  # noqa: BLE001 — deliver to every waiter
                 for *_, fut, _gen in batch:
                     if not fut.done():
                         fut.set_exception(e)
+                for lane, st in gen_states.items():
+                    if self._gen_states.get(lane) is st:
+                        del self._gen_states[lane]
+                    if not st.future.done():
+                        st.future.set_exception(e)
                 self._maybe_reset_pool()
                 continue
             for lane, _, _, fut, _gen in batch:
                 if not fut.done():
                     fut.set_result(out[lane : lane + 1])
+            if toks is None:
+                continue
+            # per-lane post-step bookkeeping (event-loop side, no races with
+            # the compute thread): collect the sampled token, advance the
+            # feed/draw cursors, and resolve finished streams
+            for lane, st in gen_states.items():
+                if self._gen_states.get(lane) is not st:
+                    continue  # released/cancelled while the step ran
+                tok = int(toks[lane])
+                st.collected.append(tok)
+                st.token = tok
+                st.position += 1
+                st.draw_idx += 1
+                if st.seen is not None and 0 <= tok < st.seen.shape[0]:
+                    st.seen[tok] = True
+                st.remaining -= 1
+                if st.remaining <= 0:
+                    del self._gen_states[lane]
+                    if not st.future.done():
+                        st.future.set_result(
+                            np.asarray([st.collected], np.int32)
+                        )
+
+    async def generate_lane(
+        self, lane: int, last_hidden: np.ndarray, position: int,
+        n_tokens: int, sampling: Optional[dict] = None,
+    ) -> np.ndarray:
+        """Server-side generation ON the pooled lane: sample ``n_tokens``
+        starting from ``last_hidden`` (the span output of the last fed
+        token), feeding n_tokens - 1 of them into the lane's KV starting at
+        ``position`` (the final token stays unfed — the session resume
+        convention shared with backend.generate_tokens). Unlike the old
+        run_exclusive monopoly, the per-token loop lives in the flush loop:
+        every step batches THIS lane with every other generating lane and any
+        ordinary decode traffic into one compiled program.
+
+        ``sampling`` is a validated rpc/protocol.validate_gen_sampling dict
+        (None -> greedy). Returns tokens [1, n_tokens] int32."""
+        if self.gen_params is None:
+            raise RuntimeError("This batcher has no client leaves loaded for server-gen")
+        self._check_lane(lane)
+        if position + n_tokens - 1 > self.max_length:
+            raise ValueError(
+                f"Generating {n_tokens} tokens at position {position} overflows "
+                f"the lane buffer ({self.max_length} tokens)"
+            )
+
+        # bootstrap: t0 comes from the caller's hidden, not a pool step —
+        # submitted through the queue so it serializes with batched steps
+        def boot():
+            self._check_lane(lane)
+            return self.backend.sample_from_hidden(
+                self.gen_params, last_hidden, sampling
+            )
+
+        t0 = int((await self.queue.submit(
+            boot, priority=PRIORITY_INFERENCE, size=1
+        ))[0])
+        if n_tokens <= 1:
+            return np.asarray([[t0]], np.int32)
+
+        st = _LaneGenState(
+            future=asyncio.get_running_loop().create_future(),
+            generation=self._lane_generation[lane],
+            token=t0, position=int(position), remaining=int(n_tokens) - 1,
+            collected=[t0],
+        )
+        if sampling is not None:
+            st.do_sample = bool(sampling.get("do_sample", False))
+            st.temperature = float(sampling.get("temperature", 1.0))
+            st.top_k = int(sampling.get("top_k", 0) or 0)
+            st.top_p = float(sampling.get("top_p", 1.0) or 1.0)
+            st.repetition_penalty = float(
+                sampling.get("repetition_penalty", 1.0) or 1.0
+            )
+            st.seed = int(sampling.get("seed", 0))
+            st.draw_idx = int(sampling.get("offset", 0)) + 1
+            if st.repetition_penalty != 1.0:
+                vocab = self.backend.cfg.vocab_size
+                seen = np.zeros((vocab,), bool)
+                for t in sampling.get("context") or ():
+                    if 0 <= int(t) < vocab:
+                        seen[int(t)] = True
+                if 0 <= t0 < vocab:
+                    seen[t0] = True
+                st.seen = seen
+        self._gen_states[lane] = st
+        if self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.create_task(self._flush_loop())
+        try:
+            return await st.future
+        finally:
+            if self._gen_states.get(lane) is st:
+                del self._gen_states[lane]
 
     def _maybe_reset_pool(self) -> None:
         """A failed batched step may have CONSUMED the donated pool buffers.
@@ -339,6 +499,65 @@ class DecodeBatcher:
         self.stats["batched_tokens"] += len(batch)
         self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
         return host_out
+
+    def _run_batch_gen(self, batch, gen_states) -> Tuple[np.ndarray, np.ndarray]:
+        """Compute-thread body: one jitted step advancing every pending decode
+        lane AND every generating lane together (the client leaves embed the
+        gen lanes' tokens and sample their next ones on device)."""
+        expected = (
+            batch[0][4] if batch
+            else next(iter(gen_states.values())).generation
+        )
+        if expected != self._generation or any(
+            st.generation != self._generation for st in gen_states.values()
+        ):
+            raise AllocationFailed("Lane pool was reset before this batched step ran")
+        hsz = self.backend.hidden_size
+        hidden = np.zeros((self.n_lanes, 1, hsz), np.float32)
+        positions = np.full((self.n_lanes,), self.max_length, np.int32)  # idle sentinel
+        tokens = np.zeros((self.n_lanes,), np.int32)
+        use_token = np.zeros((self.n_lanes,), bool)
+        vecs = sampling_vectors(self.n_lanes, self.backend.cfg.vocab_size)
+        for lane, h, pos, _fut, _gen in batch:
+            hidden[lane] = np.asarray(h, np.float32).reshape(1, hsz)
+            positions[lane] = pos
+        for lane, st in gen_states.items():
+            tokens[lane] = st.token
+            use_token[lane] = True
+            positions[lane] = st.position
+            vecs["do_sample"][lane] = st.do_sample
+            vecs["temperature"][lane] = st.temperature
+            vecs["top_k"][lane] = st.top_k
+            vecs["top_p"][lane] = st.top_p
+            vecs["repetition_penalty"][lane] = st.repetition_penalty
+            vecs["seeds"][lane] = st.seed
+            vecs["draw_idx"][lane] = st.draw_idx
+            if st.seen is not None:
+                vecs["seen_mask"][lane] = st.seen
+        k_pool, v_pool = self._buffers()
+        out, toks, (k_pool, v_pool) = self.backend.batched_gen_decode_step(
+            self.gen_params, hidden, tokens, use_token, (k_pool, v_pool),
+            positions, sampling_vecs=vecs, handles=self._handles,
+        )
+        host_out = np.asarray(out)  # device sync: the step has fully executed
+        host_toks = np.asarray(toks)
+        with self._reset_lock:
+            if expected != self._generation:
+                # see _run_batch: checked atomically with the swap so a reset
+                # landing mid-step leaves the freshly zeroed pool in place
+                raise AllocationFailed("Lane pool was reset while this batched step ran")
+            self._update(k_pool, v_pool)
+        self.stats["batched_steps"] += 1
+        self.stats["batched_tokens"] += len(batch) + len(gen_states)
+        self.stats["max_batch"] = max(
+            self.stats["max_batch"], len(batch) + len(gen_states)
+        )
+        self.stats["gen_steps"] += 1
+        self.stats["gen_lane_tokens"] += len(gen_states)
+        self.stats["max_gen_lanes"] = max(
+            self.stats["max_gen_lanes"], len(gen_states)
+        )
+        return host_out, host_toks
 
     # ------------------------------------------------------- non-batchable ops
 

@@ -28,6 +28,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from petals_tpu.data_structures import CHAIN_DELIMITER, ModuleUID, parse_uid
+from petals_tpu.rpc.protocol import validate_gen_sampling
 from petals_tpu.rpc.serialization import deserialize_array, serialize_array, CompressionType
 from petals_tpu.rpc.server import RpcContext, RpcServer
 from petals_tpu.server.backend import TransformerBackend
@@ -109,6 +110,7 @@ class TransformerHandler:
                 self.queue,
                 n_lanes=batch_lanes,
                 max_length=batch_max_length or inference_max_length or 1024,
+                gen_params=server_gen_params,
             )
 
         # Content-addressed prefix cache (server/prefix_cache.py): sessions
@@ -152,6 +154,7 @@ class TransformerHandler:
                 self.queue,
                 n_lanes=old.n_lanes,
                 max_length=old.max_length,
+                gen_params=self.server_gen_params,
             )
             await old.close()
 
@@ -1172,12 +1175,16 @@ class TransformerHandler:
                     # the returned count
                     gen_n = max(1, min(int(gen_n), 32))
                     gen_n = 1 << (gen_n.bit_length() - 1)
-                    # device-side greedy loop (backend.generate_tokens):
-                    # single-HOST sessions (plain or TP/SP mesh — GSPMD
-                    # partitions the whole scan) on a full-span server
-                    # holding the client leaves; clients gate on the
-                    # server_gen info flag, so a violation here is a
-                    # protocol error, not a fallback path
+                    # on-device sampling params (None -> greedy); malformed
+                    # dicts become protocol errors before touching the device
+                    gen_sampling = validate_gen_sampling(step.get("gen_sampling"))
+                    # device-side generation loop (backend.generate_tokens /
+                    # batching.generate_lane): single-HOST sessions (plain or
+                    # TP/SP mesh — GSPMD partitions the whole scan) on a
+                    # full-span server holding the client leaves; clients
+                    # gate on the server_gen / server_gen_sampling info
+                    # flags, so a violation here is a protocol error, not a
+                    # fallback path
                     if not (
                         self.server_gen_params is not None
                         # the SESSION must cover the whole model: a sub-span
@@ -1197,36 +1204,39 @@ class TransformerHandler:
                             "full-span single-host server with client "
                             "leaves loaded; check the server_gen info flag)"
                         )
+                    # the SESSION's negotiated budget caps generation just
+                    # like a regular step: the lane/cache buffer may be
+                    # larger than what this session negotiated at open
+                    if position + gen_n - 1 > max_length:
+                        raise ValueError(
+                            f"Generating {gen_n} tokens at position {position} "
+                            f"exceeds max_length {max_length}"
+                        )
 
                     if lane is not None:
-                        # pooled session: check the lane out for the whole
-                        # loop (<=32 decode steps — the same monopoly a
-                        # 32-chunk pooled prefill takes via this exact path)
-                        def run_gen_lane(kv_lane, lane_handles, out=out, gen_n=gen_n):
-                            with device_annotation("server_gen"):
-                                tokens, new_kv = backend.generate_tokens(
-                                    self.server_gen_params,
-                                    # slice BEFORE np.asarray: out may be a
-                                    # device array holding the whole prefill
-                                    np.asarray(out[:, -1:]),
-                                    kv_lane, position, gen_n,
-                                    active_adapter=active_adapter,
-                                )
-                            return np.asarray(tokens), new_kv
-
+                        # pooled session: the gen loop runs INSIDE the flush
+                        # loop — each of the <=32 decode steps batches this
+                        # lane with every other generating lane and ordinary
+                        # decode traffic into one compiled program (no more
+                        # exclusive-checkout monopoly)
                         gen_arr = await asyncio.wait_for(
-                            batcher.run_exclusive(
-                                lane, run_gen_lane, size=gen_n
+                            batcher.generate_lane(
+                                # slice BEFORE np.asarray: out may be a
+                                # device array holding the whole prefill
+                                lane, np.asarray(out[:, -1:]), position,
+                                gen_n, sampling=gen_sampling,
                             ),
                             self.step_timeout,
                         )
                     else:
-                        def run_gen(kv=kv, out=out, gen_n=gen_n):
+                        def run_gen(kv=kv, out=out, gen_n=gen_n,
+                                    gen_sampling=gen_sampling):
                             with device_annotation("server_gen"):
                                 tokens, new_kv = backend.generate_tokens(
                                     self.server_gen_params, np.asarray(out[:, -1:]),
                                     kv, position, gen_n,
                                     active_adapter=active_adapter,
+                                    sampling=gen_sampling,
                                 )
                             return np.asarray(tokens), new_kv
 

@@ -123,6 +123,15 @@ class Server:
             # default KV budget: 15% of device memory (reference reserves an
             # attn-cache fraction before packing blocks, server.py:275-326)
             attn_cache_bytes = int(memory * 0.15) if memory else 2 << 30
+            # the prefix cache's HBM tier lives OUTSIDE MemoryCache's budget
+            # (pinned device slices, prefix_cache.py): carve it out of the
+            # auto-sized KV budget or the default-on device tier tips an
+            # auto-sized server into on-chip OOM; floored so a huge
+            # prefix_device_bytes cannot starve serving entirely
+            if prefix_device_bytes > 0:
+                attn_cache_bytes = max(
+                    attn_cache_bytes - prefix_device_bytes, attn_cache_bytes // 4
+                )
         if num_blocks is None:
             if first_block is not None:
                 num_blocks = total - first_block
@@ -497,6 +506,12 @@ class Server:
             cache_tokens_left=cache_tokens_left,
             next_pings=dict(self._next_pings) or None,
             server_gen=(
+                self.handler.server_gen_params is not None
+                if getattr(self, "handler", None) is not None else None
+            ),
+            # sampling rides the same device-gen machinery: any server that
+            # can gen greedily can warp + sample on device too
+            server_gen_sampling=(
                 self.handler.server_gen_params is not None
                 if getattr(self, "handler", None) is not None else None
             ),

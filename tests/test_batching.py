@@ -398,13 +398,21 @@ def test_lane_lifecycle_races(model_path):
             await batcher.ensure_open()
             lanes = [await batcher.acquire_lane() for _ in range(2)]
 
-            # (a) waiter resolved then cancelled before resuming
+            # (a) waiter resolved then cancelled before resuming. On py>=3.12
+            # wait_for propagates the cancel and acquire_lane must put the
+            # lane back itself; py<3.12 wait_for swallows a cancel that lands
+            # after the future resolved and hands the lane over — then WE hold
+            # it and must release. Either way the pool must not shrink.
             waiter = asyncio.create_task(batcher.acquire_lane(timeout=5))
             await asyncio.sleep(0)  # waiter is now parked in _lane_waiters
             batcher.release_lane(lanes[0])  # resolves the waiter's future
             waiter.cancel()
-            with pytest.raises(asyncio.CancelledError):
-                await waiter
+            try:
+                handed_over = await waiter
+            except asyncio.CancelledError:
+                handed_over = None
+            if handed_over is not None:
+                batcher.release_lane(handed_over)
             assert len(batcher._free_lanes) == 1, "lane leaked on cancel race"
 
             # (b) stale pending step purged on release
@@ -484,6 +492,168 @@ def test_pool_reset_after_consumed_buffers(model_path):
             await server.shutdown()
 
     run(main())
+
+
+def test_concurrent_server_gen_lanes(model_path):
+    """>=3 concurrent server-gen sessions advance through the SHARED lane
+    pool — each token is one compiled program over every generating lane
+    (plus any ordinary decode traffic) with a per-lane position vector —
+    token-identical to HF, with per-lane stop/length bookkeeping (each
+    session asks for a different token count and leaves the pool alone)."""
+    import jax.numpy as jnp
+
+    from petals_tpu.client.from_pretrained import load_client_params
+    from petals_tpu.server.from_pretrained import get_block_config
+    from tests.test_full_model import _hf_greedy
+
+    family, cfg = get_block_config(model_path)
+    client_params = load_client_params(model_path, dtype=jnp.float32)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 100, (1, 3 + 3 * i)).astype(np.int64) for i in range(3)]
+    gen_lens = [8, 16, 32]  # different depths AND different stop steps
+    expected = [_hf_greedy(model_path, p, n) for p, n in zip(prompts, gen_lens)]
+
+    async def main():
+        server, client = await _start_server(model_path, batching=True)
+        try:
+            prefix = default_dht_prefix(model_path)
+            uids = CHAIN_DELIMITER.join(
+                make_uid(prefix, i) for i in range(cfg.num_hidden_layers)
+            )
+            barrier = asyncio.Event()
+
+            async def drive(prompt, n):
+                emb = np.asarray(
+                    family.client_embed(client_params, jnp.asarray(prompt), cfg),
+                    np.float32,
+                )
+                stream = await client.open_stream("ptu.inference")
+                await stream.send({"uids": uids, "max_length": 64, "batch_size": 1})
+                await stream.recv(timeout=60)
+                await barrier.wait()
+                await stream.send({
+                    "tensors": {"hidden": serialize_array(emb)}, "gen_tokens": n,
+                })
+                reply = await stream.recv(timeout=300)
+                await stream.end()
+                return reply["tokens"]
+
+            tasks = [
+                asyncio.create_task(drive(p, n))
+                for p, n in zip(prompts, gen_lens)
+            ]
+            await asyncio.sleep(0.1)
+            barrier.set()
+            results = await asyncio.gather(*tasks)
+            stats = dict(server.handler.batcher.stats)
+            return results, stats
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    results, stats = run(main())
+    for toks, p, n, want in zip(results, prompts, gen_lens, expected):
+        np.testing.assert_array_equal(
+            np.asarray(toks), want[0, p.shape[1]:],
+            err_msg=f"lane with prefill {p.shape[1]}, gen {n}",
+        )
+    assert stats["gen_steps"] > 0, stats
+    assert stats["max_gen_lanes"] >= 3, f"gen lanes never coalesced: {stats}"
+    # n_tokens - 1 pooled steps per lane (t0 comes from the bootstrap sample)
+    assert stats["gen_lane_tokens"] >= sum(n - 1 for n in gen_lens), stats
+
+
+def test_pooled_server_gen_sampling_matches_private_path(model_path):
+    """A SAMPLING server-gen session on the pooled lanes — running alongside
+    an ordinary decode session, so the combined gen+decode program is what
+    actually executes — must produce the same tokens as the private-path
+    compiled scan (backend.generate_tokens) under the same seed, and the
+    decode neighbor must be unaffected."""
+    import jax.numpy as jnp
+
+    from petals_tpu.client.from_pretrained import load_client_params
+    from petals_tpu.rpc.protocol import validate_gen_sampling
+    from petals_tpu.server.from_pretrained import get_block_config
+
+    family, cfg = get_block_config(model_path)
+    client_params = load_client_params(model_path, dtype=jnp.float32)
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(0, 100, (1, 6)).astype(np.int64)
+    gen_n = 16
+    sampling = {
+        "do_sample": True, "temperature": 0.8, "top_k": 10, "top_p": 0.9,
+        "repetition_penalty": 1.3, "seed": 42, "offset": 0,
+        "context": [int(t) for t in prompt[0]],
+    }
+
+    async def main():
+        server, client = await _start_server(model_path, batching=True)
+        try:
+            prefix = default_dht_prefix(model_path)
+            uids = CHAIN_DELIMITER.join(
+                make_uid(prefix, i) for i in range(cfg.num_hidden_layers)
+            )
+            emb = np.asarray(
+                family.client_embed(client_params, jnp.asarray(prompt), cfg),
+                np.float32,
+            )
+            barrier = asyncio.Event()
+
+            async def drive_gen():
+                stream = await client.open_stream("ptu.inference")
+                await stream.send({"uids": uids, "max_length": 64, "batch_size": 1})
+                await stream.recv(timeout=60)
+                await barrier.wait()
+                await stream.send({
+                    "tensors": {"hidden": serialize_array(emb)},
+                    "gen_tokens": gen_n, "gen_sampling": sampling,
+                })
+                reply = await stream.recv(timeout=300)
+                await stream.end()
+                return reply["tokens"]
+
+            decode_plan = _session_plan(cfg, 1, n_steps=8, prefill_len=3)
+            gen_task = asyncio.create_task(drive_gen())
+            dec_task = asyncio.create_task(
+                _drive_session(client, uids, *decode_plan, start_barrier=barrier)
+            )
+            await asyncio.sleep(0.1)
+            barrier.set()
+            toks, decode_out = await asyncio.gather(gen_task, dec_task)
+            stats = dict(server.handler.batcher.stats)
+
+            # ground truth AFTER the pooled traffic drained: the private-path
+            # scan from the same prefill and the same validated sampling dict
+            backend = server.backend
+            kd, vd = backend.cache_descriptors(1, 64, 0, backend.n_blocks)
+            kv = (kd.make_zeros(), vd.make_zeros())
+            out, kv = backend.inference_step(emb, kv, 0)
+            want_toks, _ = backend.generate_tokens(
+                server.handler.server_gen_params, np.asarray(out[:, -1:]), kv,
+                prompt.shape[1], gen_n, sampling=validate_gen_sampling(sampling),
+            )
+            want_decode = []
+            kv = (kd.make_zeros(), vd.make_zeros())
+            prefill, steps = decode_plan
+            want, kv = backend.inference_step(prefill, kv, 0)
+            want_decode.append(np.asarray(want))
+            pos = prefill.shape[1]
+            for h in steps:
+                want, kv = backend.inference_step(h, kv, pos)
+                pos += 1
+                want_decode.append(np.asarray(want))
+            return toks, decode_out, np.asarray(want_toks), want_decode, stats
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    toks, decode_out, want_toks, want_decode, stats = run(main())
+    np.testing.assert_array_equal(np.asarray(toks), want_toks[0])
+    for i, (got, want) in enumerate(zip(decode_out, want_decode)):
+        np.testing.assert_allclose(
+            got, want, atol=2e-5, rtol=0, err_msg=f"decode neighbor output {i}"
+        )
+    assert stats["gen_steps"] > 0, stats
 
 
 def test_pooled_session_rollback(model_path):

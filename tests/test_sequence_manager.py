@@ -81,13 +81,12 @@ def test_prefix_affinity_breaks_ties_deterministically():
             # constant RTT: live ping jitter must not decide this test
             manager.rtt_fn = lambda a, b: 0.01
             # same seed -> same replica, across many route computations
-            picks = {
-                seed: {
+            picks = {}
+            for seed in range(16):  # nested async comprehension needs py>=3.11
+                picks[seed] = {
                     (await manager.make_sequence(affinity_seed=seed))[0].peer_id
                     for _ in range(5)
                 }
-                for seed in range(16)
-            }
             assert all(len(p) == 1 for p in picks.values()), picks
             # enough seeds reach both replicas (load still spreads); peer ids
             # are random per run, so 16 seeds make a miss ~2^-15

@@ -38,6 +38,9 @@ def test_capability_announced(full_span_swarm):
         "petals_tpu.data_structures", fromlist=["ServerState"]
     ).ServerState.ONLINE)
     assert info.server_gen is True
+    # the on-device sampling variant has its own flag (old servers on mixed
+    # swarms announce server_gen only; clients gate per-request kind)
+    assert info.server_gen_sampling is True
 
 
 def test_greedy_token_identical_and_uses_fast_path(full_span_swarm, monkeypatch):
@@ -86,24 +89,132 @@ def test_chunked_generation_and_session_resume(full_span_swarm):
         model.close()
 
 
-def test_sampling_and_processors_use_classic_path(full_span_swarm, monkeypatch):
-    """do_sample / logits_processor requests must NOT ride the fast path
-    (they need client-side logits), and must still work."""
+def test_processors_use_classic_path(full_span_swarm, monkeypatch):
+    """Custom logits_processor / stopping_criteria requests must NOT ride
+    either fast path (they need client-side logits every token), and must
+    still work. Plain sampling has its own fast path now
+    (test_sampling_token_identical_to_client_stream)."""
     path, harness = full_span_swarm
     model = AutoDistributedModelForCausalLM.from_pretrained(
         path, initial_peers=harness.initial_peers
     )
     try:
-        def boom(self, *a, **kw):  # fast path must not be entered at all
-            raise AssertionError("fast path used for a sampling request")
+        def boom(self, *a, **kw):  # fast paths must not be entered at all
+            raise AssertionError("fast path used for a processor request")
 
         monkeypatch.setattr(type(model), "_server_side_greedy", boom)
+        monkeypatch.setattr(type(model), "_server_side_sample", boom)
         rng = np.random.RandomState(2)
         input_ids = rng.randint(0, 100, (1, 4)).astype(np.int64)
         out = model.generate(
-            input_ids, max_new_tokens=4, do_sample=True, temperature=0.8, seed=7
+            input_ids, max_new_tokens=4, do_sample=True, temperature=0.8, seed=7,
+            logits_processor=[lambda ids, scores: scores],
         )
         assert out.shape == (1, 8)
+    finally:
+        model.close()
+
+
+def test_sampling_token_identical_to_client_stream(full_span_swarm, monkeypatch):
+    """The on-device warp pipeline under a fixed seed must be token-identical
+    to the CLIENT's own pipeline (apply_repetition_penalty + sample_next_token)
+    replaying the same stateless PRNG stream — the exact equivalence that
+    makes mid-stream fallback seamless — and reproducible across calls.
+    Covers sampling, sampling + top-p + repetition penalty, and
+    greedy-with-penalty (which rides the same gen_sampling path)."""
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    from petals_tpu.client.remote_generation import (
+        apply_repetition_penalty,
+        sample_next_token,
+    )
+
+    path, harness = full_span_swarm
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers
+    )
+    hf = AutoModelForCausalLM.from_pretrained(path, dtype=torch.float32).eval()
+    try:
+        served = {"n": 0}
+        orig = type(model)._server_side_sample
+
+        def spy(self, *a, **kw):
+            out = orig(self, *a, **kw)
+            if out is not None:
+                served["n"] += 1
+            return out
+
+        monkeypatch.setattr(type(model), "_server_side_sample", spy)
+        rng = np.random.RandomState(6)
+        input_ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+        cases = [
+            dict(do_sample=True, temperature=0.8, top_k=10, seed=1234),
+            dict(do_sample=True, temperature=0.9, top_p=0.9,
+                 repetition_penalty=1.5, seed=99),
+            dict(repetition_penalty=1.8),  # greedy-with-penalty, same path
+        ]
+        for case in cases:
+            out = model.generate(input_ids, max_new_tokens=10, **case)
+            # expected stream: HF logits through the client's own warp
+            # pipeline, replaying the wire PRNG stream draw by draw
+            generated = input_ids
+            seed = case.get("seed")
+            for i in range(10):
+                with torch.no_grad():
+                    logits = (
+                        hf(torch.from_numpy(generated)).logits[:, -1].numpy()
+                    ).astype(np.float32)
+                scores = apply_repetition_penalty(
+                    logits, generated, case.get("repetition_penalty", 1.0)
+                )
+                tok = sample_next_token(
+                    scores,
+                    do_sample=case.get("do_sample", False),
+                    temperature=case.get("temperature", 1.0),
+                    top_k=case.get("top_k"),
+                    top_p=case.get("top_p"),
+                    rng_key=(seed % (1 << 31), i) if seed is not None else None,
+                )
+                generated = np.concatenate(
+                    [generated, tok[:, None].astype(np.int64)], axis=1
+                )
+            np.testing.assert_array_equal(out, generated, err_msg=str(case))
+            again = model.generate(input_ids, max_new_tokens=10, **case)
+            np.testing.assert_array_equal(
+                out, again, err_msg=f"not reproducible: {case}"
+            )
+        assert served["n"] == 2 * len(cases), (
+            "the sampling fast path did not serve every generate()"
+        )
+    finally:
+        model.close()
+
+
+def test_sampling_eos_mid_chunk_rolls_back(full_span_swarm):
+    """EOS landing mid-chunk on the SAMPLING fast path: the speculatively-fed
+    tokens roll back exactly like the greedy path, and a follow-up call on
+    the session resumes coherently."""
+    path, harness = full_span_swarm
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers
+    )
+    try:
+        rng = np.random.RandomState(9)
+        input_ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+        kwargs = dict(do_sample=True, temperature=0.8, top_k=20, seed=31)
+        probe = model.generate(input_ids, max_new_tokens=8, **kwargs)
+        eos = int(probe[0, 5 + 2])  # whatever this seed emits at step 3
+        stop = np.flatnonzero(probe[0, 5:] == eos)
+        expected = probe[:, : 5 + int(stop[0]) + 1]
+        out = model.generate(input_ids, max_new_tokens=8, eos_token_id=eos, **kwargs)
+        np.testing.assert_array_equal(out, expected)
+        # session stays coherent after the early stop: greedy resume matches
+        # a straight-through greedy run
+        with model.inference_session(max_length=64):
+            out2 = model.generate(input_ids, max_new_tokens=4)
+            out3 = model.generate(out2, max_new_tokens=3)
+        np.testing.assert_array_equal(out3, _hf_greedy(path, input_ids, 7))
     finally:
         model.close()
 
@@ -125,6 +236,14 @@ def test_multi_span_route_falls_back(tmp_path_factory):
             expected = _hf_greedy(path, input_ids, 8)
             out = model.generate(input_ids, max_new_tokens=8)
             np.testing.assert_array_equal(out, expected)
+            # the SAMPLING fast path also declines multi-span routes (no
+            # server_gen_sampling span) and the classic loop serves it,
+            # seed-reproducibly
+            kwargs = dict(do_sample=True, temperature=0.8, seed=11)
+            a = model.generate(input_ids, max_new_tokens=6, **kwargs)
+            b = model.generate(input_ids, max_new_tokens=6, **kwargs)
+            np.testing.assert_array_equal(a, b)
+            assert a.shape == (1, 12)
         finally:
             model.close()
     finally:

@@ -12,6 +12,10 @@ or directly:
     PETALS_TPU_SMOKE=1 PYTHONPATH=/root/.axon_site:. \
         python -m pytest tests/test_tpu_smoke.py -q
 
+bench.py runs only the ``smoke_fast``-marked kernel tests (they fit the
+~150 s probe window left after the bench rows); the heavy whole-backend
+comparison below is full-tier only.
+
 Skipped entirely unless the default backend is a real TPU.
 """
 
@@ -41,6 +45,7 @@ def _rel_err(got, want):
     return float(np.abs(got - want).max() / denom)
 
 
+@pytest.mark.smoke_fast
 def test_flash_attention_matches_xla_reference(tpu):
     import jax
     import jax.numpy as jnp
@@ -74,6 +79,7 @@ def test_flash_attention_matches_xla_reference(tpu):
         assert err < 2e-2, f"flash mismatch {err} at {(q_len, kv_len, hq, hkv, window, alibi)}"
 
 
+@pytest.mark.smoke_fast
 def test_int8_kernel_matches_dequant_matmul(tpu):
     import jax
     import jax.numpy as jnp
@@ -91,6 +97,7 @@ def test_int8_kernel_matches_dequant_matmul(tpu):
         assert err < 2e-2, f"int8 single M={m}: {err}"
 
 
+@pytest.mark.smoke_fast
 @pytest.mark.parametrize("kind", ["nf4", "int4"])
 def test_packed4_kernels_match_dequant_matmul(tpu, kind):
     import jax
