@@ -1103,9 +1103,12 @@ def _record_last_known_good(metric_line: dict) -> None:
         pass
 
 
-def _stale_metric_line(error: str) -> dict:
+def _stale_metric_line(error: str, probe_attempts: int = 0) -> dict:
     """The line to emit when every attempt failed: last-known-good + an
-    explicit ``stale`` marker, or a zero record if no LKG exists yet."""
+    explicit ``stale`` marker, or a zero record if no LKG exists yet.
+    ``probe_attempts`` records how many backend probes ran before giving up,
+    so a stale row from a dead tunnel (probes exhausted fast) reads
+    differently from one where the bench itself failed (probes passed)."""
     try:
         with open(LKG_PATH) as f:
             lkg = json.load(f)
@@ -1113,6 +1116,7 @@ def _stale_metric_line(error: str) -> dict:
         out["stale"] = True
         out["stale_measured_at"] = lkg.get("measured_at")
         out["error"] = error
+        out["probe_attempts"] = probe_attempts
         return out
     except (OSError, ValueError, KeyError, TypeError):
         return {
@@ -1121,6 +1125,7 @@ def _stale_metric_line(error: str) -> dict:
             "unit": "tok/s",
             "vs_baseline": 0.0,
             "error": error,
+            "probe_attempts": probe_attempts,
         }
 
 
@@ -1182,13 +1187,13 @@ def _mark_details_partial(error: str) -> None:
 _EMITTED = {"line": False}  # has a metric line gone to stdout yet (supervisor)
 
 
-def _emit_stale_once(error: str) -> None:
+def _emit_stale_once(error: str, probe_attempts: int = 0) -> None:
     """Publish the stale-marked LKG line, at most once per process — the
     shared last-resort emitter for the failure, signal, and crash paths."""
     if _EMITTED["line"]:
         return
     _EMITTED["line"] = True
-    print(json.dumps(_stale_metric_line(error)), flush=True)
+    print(json.dumps(_stale_metric_line(error, probe_attempts)), flush=True)
     _mark_details_stale(error)
 
 
@@ -1302,6 +1307,9 @@ def _heavy_row_registry():
         "e2e_server_gen_sampling": lambda: __import__(
             "benchmarks.bench_server_gen_sampling", fromlist=["run_bench"]
         ).run_bench(),
+        "e2e_paged_decode": lambda: __import__(
+            "benchmarks.bench_paged_decode", fromlist=["run_bench"]
+        ).run_bench(),
         "quant_quality": lambda: __import__(
             "benchmarks.quant_quality", fromlist=["quality_report"]
         ).quality_report(include_model_tier=False),
@@ -1359,10 +1367,12 @@ def main():
         signal.signal(signal.SIGTERM, _flush_and_exit)
 
         # Outage resilience (the tunnel is known to flake for hours at a
-        # time): probe the backend first; while it is down, retry with
-        # backoff inside the budget instead of failing on the first attempt,
-        # and if every attempt fails, the provisional line above already
-        # reported last-known-good with an explicit ``stale: true`` marker.
+        # time): probe the backend first. Round-5 lesson: the open-ended
+        # probe-retry ladder burned 6+ minutes of the budget on a tunnel that
+        # never came back, starving the smoke tier — so probes are now capped
+        # at MAX_PROBE_ATTEMPTS and a dead tunnel emits the stale row
+        # IMMEDIATELY (with ``probe_attempts`` on the record), leaving the
+        # rest of the budget to the smoke tier and the detail bookkeeping.
         # The driver's kill timer is UNKNOWN: assume the minimum plausible
         # budget (round 4 proved 2400 s outlives it) — overshooting now only
         # costs detail rows, never the metric line, but staying inside the
@@ -1375,17 +1385,24 @@ def main():
         reserve = min(240.0, budget / 4)
         floor = min(120.0, budget / 8)  # min useful time for an attempt
         child_stdout, metric_line, error, backoff = "", None, None, 30.0
-        inner_attempts, max_inner_attempts = 0, 3  # a healthy probe + failing
+        inner_attempts, max_inner_attempts = 0, 2  # a healthy probe + failing
         # bench means a bench bug, not an outage: don't burn the budget on it
+        probe_attempts, max_probe_attempts = 0, 2  # dead tunnel: fail FAST
         while True:
             remaining = deadline - reserve - time.time()
             if remaining <= floor:
                 error = error or "budget exhausted before a healthy attempt"
                 break
+            probe_attempts += 1
             if not _probe_backend(min(150.0, remaining)):
                 # don't clobber a previous inner attempt's error: 'rc=1 on a
                 # healthy probe' is the bench-bug signal, worth surfacing
                 error = error or "backend probe failed (accelerator tunnel down?)"
+                if probe_attempts >= max_probe_attempts:
+                    sys.stderr.write(
+                        f"[bench] backend unavailable after {probe_attempts} "
+                        "probes; emitting stale row now\n")
+                    break
                 wait = min(backoff, max(deadline - reserve - time.time(), 0))
                 if wait <= 0:
                     break
@@ -1440,7 +1457,7 @@ def main():
                 sys.stderr.write(f"[bench] run incomplete after metric: {error}\n")
                 _mark_details_partial(error)
         else:
-            _emit_stale_once(error or "no metric line")
+            _emit_stale_once(error or "no metric line", probe_attempts)
         # On-TPU exactness smoke (tests/test_tpu_smoke.py): runs HERE in the
         # jax-free supervisor AFTER the inner bench exits — the chip is
         # single-process, so a smoke child spawned while the inner holds the
@@ -1605,6 +1622,10 @@ def main():
     # sessions coalesced per token on the shared lane pool (this round's
     # tentpole): aggregate tok/s + max_gen_lanes is the multi-tenant value
     row_sub("e2e_server_gen_sampling", "pooled server-gen sampling", timeout=600.0)
+    # paged KV vs dense lane pool at a fixed cache byte budget (this round's
+    # tentpole): sessions admitted (expected ~max_length/session_tokens x)
+    # plus single-stream decode parity on the identity fast path
+    row_sub("e2e_paged_decode", "paged KV decode", timeout=600.0)
     # quantization quality table (VERDICT r3 #4): weight+activation error at
     # 7B shapes per format, so the serving default is re-derived every run
     row_sub("quant_quality", "quant quality")

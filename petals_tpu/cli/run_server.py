@@ -105,6 +105,14 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Continuous-batching lane count (default: auto-size to the cache budget, <=8)")
     parser.add_argument("--batch_max_length", type=int, default=None,
                         help="Lane length in tokens (default: min(inference_max_length, 1024))")
+    parser.add_argument("--page_size", type=int, default=64,
+                        help="Paged KV cache: tokens per page (sessions grow page-by-page, so "
+                             "admission costs one page instead of batch_max_length tokens); "
+                             "0 reverts to the dense per-lane pool")
+    parser.add_argument("--n_pages", type=int, default=None,
+                        help="Paged KV pool size in pages (default: batch_lanes * pages-per-lane, "
+                             "i.e. no oversubscription; raise to admit more sessions than lanes "
+                             "could hold at full length)")
     parser.add_argument("--prefix_cache_bytes", type=int, default=256 * 2**20,
                         help="Host-RAM prompt-prefix cache budget; 0 disables")
     parser.add_argument("--no_server_side_generation", action="store_true",
@@ -204,6 +212,8 @@ def main(argv=None) -> None:
         batching=not args.no_batching,
         batch_lanes=args.batch_lanes,
         batch_max_length=args.batch_max_length,
+        page_size=args.page_size,
+        n_pages=args.n_pages,
         prefix_cache_bytes=args.prefix_cache_bytes,
         prefix_share_scope=args.prefix_share_scope,
         prefix_device_bytes=args.prefix_device_bytes,

@@ -1,0 +1,140 @@
+"""Paged KV-cache plumbing: page pools, block tables, and the ragged
+paged-attention decode path ("Ragged Paged Attention", arXiv:2604.15464 —
+the TPU-native rendition of vLLM's PagedAttention layout).
+
+Layout contract (per span block):
+
+- page pool      [n_pages, page_size, kv_heads, head_dim] x2 (k, v) — ONE
+  shared slab in HBM, budgeted through MemoryCache like the dense lane pool.
+- block table    [n_lanes, max_pages] int32 — page index per (lane, slot);
+  ``-1`` marks an unallocated slot. ``max_pages * page_size == max_length``
+  (the batcher rounds max_length up to a page multiple).
+- ragged lengths per lane ride the existing position vector: attention masks
+  with ``kv_length = position + 1``, so whatever garbage the gather pulls
+  from unallocated/stale pages is multiplied by an exact 0.0 mask weight
+  (ops/attention.py attend_reference) and contributes nothing. Pool content
+  is always finite (zero-init, only ever written with computed values), so
+  paged decode is numerically IDENTICAL to the dense path.
+
+XLA-first design: no dynamic shapes anywhere. The gather materializes a
+transient dense [n_lanes, max_length, ...] view inside the step program (the
+same tensor the dense path reads), the model family's block code runs
+unchanged on it, and only the written token rows are scattered back into the
+pool. Sessions joining/leaving mutate TABLE VALUES, never shapes — one
+compiled program, no recompiles, which is the whole reason the dense lane
+pool existed (server/batching.py module docstring). When every table row is
+the identity mapping (lane i owns pages [i*max_pages, (i+1)*max_pages)), the
+gather/scatter collapse to reshapes and the step IS the dense program —
+bit-exact, and the allocator prefers identity pages so the fast path is the
+common case at the default (non-oversubscribed) pool size.
+
+Scatter safety: invalid writes (idle-lane sentinel position, unallocated
+slot) are routed to flat index ``n_pages * page_size`` — one past the pool —
+and dropped by ``mode="drop"``, mirroring the dense path's out-of-range
+sentinel convention (models/common.py update_kv_cache).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from petals_tpu.ops.attention import attend_reference
+
+
+def max_pages_for(max_length: int, page_size: int) -> int:
+    """Table slots per lane: max_length rounded UP to whole pages."""
+    return -(-int(max_length) // int(page_size))
+
+
+def identity_tables(n_lanes: int, max_pages: int) -> np.ndarray:
+    """The contiguous layout: lane i owns pages [i*max_pages, (i+1)*max_pages)."""
+    return np.arange(n_lanes * max_pages, dtype=np.int32).reshape(n_lanes, max_pages)
+
+
+def tables_are_contiguous(tables: np.ndarray, n_pages: int) -> bool:
+    """Host-side fast-path check: every ALLOCATED slot holds its identity
+    page (unallocated ``-1`` slots are fine — the dense program never reads
+    them unmasked nor writes them, see module docstring). Only possible when
+    the pool is exactly lane-sized."""
+    n_lanes, max_pages = tables.shape
+    if n_pages != n_lanes * max_pages:
+        return False
+    ident = np.arange(n_lanes * max_pages, dtype=np.int32).reshape(n_lanes, max_pages)
+    return bool(np.all((tables == ident) | (tables < 0)))
+
+
+def gather_pages(pool: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
+    """Materialize the dense per-lane view of one block's page pool.
+
+    pool [n_pages, page_size, hkv, d] + tables [n_lanes, max_pages] ->
+    [n_lanes, max_pages * page_size, hkv, d]. Unallocated slots (-1) clip to
+    page 0: garbage content, but every read of it is masked (ragged
+    kv_length) and every write to it is dropped (scatter)."""
+    n_pages, page_size = pool.shape[0], pool.shape[1]
+    n_lanes, max_pages = tables.shape
+    safe = jnp.clip(tables.reshape(-1), 0, n_pages - 1)
+    pages = jnp.take(pool, safe, axis=0)  # [n_lanes*max_pages, ps, hkv, d]
+    return pages.reshape(n_lanes, max_pages * page_size, *pool.shape[2:])
+
+
+def scatter_token_rows(
+    pool: jnp.ndarray, rows: jnp.ndarray, tables: jnp.ndarray, positions: jnp.ndarray
+) -> jnp.ndarray:
+    """Write each lane's freshly computed token row into its page.
+
+    pool [n_pages, ps, hkv, d]; rows [n_lanes, hkv, d]; positions [n_lanes]
+    (idle sentinel = max_length). Invalid lanes (sentinel position or
+    unallocated slot) route to the one-past-the-end flat index and drop."""
+    n_pages, page_size = pool.shape[0], pool.shape[1]
+    max_pages = tables.shape[1]
+    slot = positions // page_size
+    in_range = (positions >= 0) & (slot < max_pages)
+    slot_c = jnp.clip(slot, 0, max_pages - 1)
+    page = jnp.take_along_axis(tables, slot_c[:, None], axis=1)[:, 0]
+    valid = in_range & (page >= 0)
+    flat_idx = jnp.where(valid, page * page_size + positions % page_size, n_pages * page_size)
+    flat = pool.reshape(n_pages * page_size, *pool.shape[2:])
+    flat = flat.at[flat_idx].set(rows.astype(pool.dtype), mode="drop")
+    return flat.reshape(pool.shape)
+
+
+def scatter_lane_pages(
+    pool: jnp.ndarray, lane_pages: jnp.ndarray, table_row: jnp.ndarray
+) -> jnp.ndarray:
+    """Write a whole lane-shaped buffer back into its pages (the exclusive-op
+    check-in: prefill chunks, prefix seeding). lane_pages [max_pages, ps,
+    hkv, d]; unallocated slots (-1) drop. Shared (copy-on-write) pages in
+    the row receive exactly the bytes that were gathered out of them — the
+    write range itself was made exclusive by prepare_write first."""
+    n_pages = pool.shape[0]
+    safe = jnp.where(table_row >= 0, table_row, n_pages)
+    return pool.at[safe].set(lane_pages.astype(pool.dtype), mode="drop")
+
+
+def paged_attend(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    tables: jnp.ndarray,
+    positions: jnp.ndarray,
+    *,
+    alibi_slopes: Optional[jnp.ndarray] = None,
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Standalone ragged paged-attention decode reference: gather each lane's
+    pages into a dense view and attend with per-lane ragged lengths
+    (kv_length = position + 1). q [n_lanes, 1, hq, d]; k/v_pool [n_pages,
+    ps, hkv, d]; tables [n_lanes, max_pages]; positions [n_lanes] int32.
+    The production decode step fuses this same gather in front of the model
+    family's block code (server/backend.py _paged_decode_fn); this entry
+    point is the kernel-level contract the parity tests pin down."""
+    k = gather_pages(k_pool, tables)
+    v = gather_pages(v_pool, tables)
+    pos = jnp.asarray(positions, jnp.int32)
+    return attend_reference(
+        q, k, v, q_offset=pos, kv_length=pos + q.shape[1],
+        alibi_slopes=alibi_slopes, sliding_window=sliding_window,
+    )

@@ -136,6 +136,18 @@ class TransformerBackend:
             TensorDescriptor(shape, self.cache_dtype, sharding),
         )
 
+    def paged_cache_descriptors(self, n_pages: int, page_size: int, start: int, end: int):
+        """(k, v) descriptors for the PAGED pool of blocks [start, end):
+        [n, n_pages, page_size, hkv, d] per tensor. The paged path is gated
+        to mesh-less single-host servers (server/batching.py), so no
+        sharding rides these."""
+        n = end - start
+        shape = (n, n_pages, page_size, self.num_kv_heads, self.head_dim)
+        return (
+            TensorDescriptor(shape, self.cache_dtype),
+            TensorDescriptor(shape, self.cache_dtype),
+        )
+
     def cache_bytes_per_token(self) -> int:
         return (
             2
@@ -394,6 +406,257 @@ class TransformerBackend:
                 self.params, k_pool, v_pool, hidden, np.asarray(positions, np.int32)
             )
         return out, (k_pool, v_pool)
+
+    @functools.cached_property
+    def _paged_decode_fn(self):
+        """Paged twin of ``_batched_decode_fn``: the pool is page-granular
+        ([n_blocks, n_pages, page_size, hkv, d]) and each lane's view of it
+        is assembled by a block-table gather INSIDE the step program, so the
+        model family's block code runs unchanged on a dense [n_lanes,
+        max_length, hkv, d] tensor with the same per-lane position vector.
+        After the block writes its new token rows, only those rows are
+        scattered back into the pool (invalid lanes drop — ops/
+        paged_attention.py). ``contiguous`` is a STATIC flag: when every
+        table row is the identity mapping, gather and scatter collapse to
+        reshapes and the compiled program is the dense one — bit-exact."""
+        family, cfg = self.family, self.cfg
+        split_quant = self._split_quant
+        use_quant_consts = self._use_quant_consts
+        reattach = self._reattach_quant
+
+        from petals_tpu.ops.paged_attention import gather_pages, scatter_token_rows
+
+        @functools.partial(
+            jax.jit, static_argnames=("contiguous",), donate_argnums=(1, 2)
+        )
+        def step(params, k_pool, v_pool, hidden, positions, tables, *, contiguous: bool):
+            # hidden: [n_lanes, 1, hidden]; positions: [n_lanes] int32;
+            # tables: [n_lanes, max_pages] int32 (-1 = unallocated slot)
+            n_lanes, max_pages = tables.shape
+            page_size = k_pool.shape[2]
+            max_len = max_pages * page_size
+            hidden = hidden.astype(k_pool.dtype)
+            if use_quant_consts:
+                dense_params, quant_params, outlier_names = split_quant(params)
+                xs_params = dense_params
+                block_indices = jnp.arange(k_pool.shape[0], dtype=jnp.int32)
+            else:
+                xs_params = params
+                block_indices = jnp.zeros((k_pool.shape[0],), jnp.int32)  # unused
+
+            def body(h, xs):
+                p_block, k_blk, v_blk, block_idx = xs
+                if use_quant_consts:
+                    p_block = reattach(p_block, quant_params, outlier_names, block_idx)
+                if contiguous:
+                    k_dense = k_blk.reshape(n_lanes, max_len, *k_blk.shape[2:])
+                    v_dense = v_blk.reshape(n_lanes, max_len, *v_blk.shape[2:])
+                else:
+                    k_dense = gather_pages(k_blk, tables)
+                    v_dense = gather_pages(v_blk, tables)
+                out, (k_new, v_new) = family.block_apply(
+                    p_block, h, (k_dense, v_dense), positions, cfg,
+                    use_flash=False, tp_mesh=None,
+                )
+                if contiguous:
+                    k_blk = k_new.reshape(k_blk.shape)
+                    v_blk = v_new.reshape(v_blk.shape)
+                else:
+                    lanes = jnp.arange(n_lanes, dtype=jnp.int32)
+                    row = jnp.clip(positions, 0, max_len - 1)
+                    k_blk = scatter_token_rows(k_blk, k_new[lanes, row], tables, positions)
+                    v_blk = scatter_token_rows(v_blk, v_new[lanes, row], tables, positions)
+                return out, (k_blk, v_blk)
+
+            hidden, (k_pool, v_pool) = jax.lax.scan(
+                body, hidden, (xs_params, k_pool, v_pool, block_indices)
+            )
+            return hidden, k_pool, v_pool
+
+        return step
+
+    def paged_decode_step(self, hidden, pool_kv, positions, tables,
+                          handles=None, contiguous=None):
+        """One coalesced decode step over the whole lane pool, PAGED layout.
+
+        Args:
+          hidden: [n_lanes, 1, hidden] (idle lanes: any finite filler).
+          pool_kv: (k, v) page pools [n_blocks, n_pages, page_size, hkv, d].
+          positions: int32 [n_lanes]; idle sentinel = max_pages * page_size.
+          tables: int32 [n_lanes, max_pages] block tables (-1 unallocated).
+          contiguous: identity-layout fast path; detected from the tables
+            when None (host-side, cheap — the tables are a few hundred ints).
+        """
+        from petals_tpu.ops.paged_attention import tables_are_contiguous
+
+        k_pool, v_pool = pool_kv
+        tables = np.asarray(tables, np.int32)
+        if contiguous is None:
+            contiguous = tables_are_contiguous(tables, k_pool.shape[1])
+        if not isinstance(hidden, jax.Array):
+            hidden = np.ascontiguousarray(hidden)
+        with self._quant_ctx():
+            out, k_pool, v_pool = self._paged_decode_fn(
+                self.params, k_pool, v_pool, hidden,
+                np.asarray(positions, np.int32), tables,
+                contiguous=bool(contiguous),
+            )
+        return out, (k_pool, v_pool)
+
+    @functools.cached_property
+    def _paged_gen_decode_fn(self):
+        """Paged twin of ``_batched_gen_decode_fn``: the pooled server-gen
+        step (client leaves in the loop) over the page-granular pool. Same
+        gather/scatter sandwich as ``_paged_decode_fn``."""
+        family, cfg = self.family, self.cfg
+        split_quant = self._split_quant
+        use_quant_consts = self._use_quant_consts
+        reattach = self._reattach_quant
+        client_embed, client_head = family.client_embed, family.client_head
+
+        from petals_tpu.ops.paged_attention import gather_pages, scatter_token_rows
+
+        @functools.partial(
+            jax.jit, static_argnames=("contiguous",), donate_argnums=(2, 3)
+        )
+        def step(params, client_params, k_pool, v_pool, hidden, tokens,
+                 use_token, positions, do_sample, temperature, top_k, top_p,
+                 rep_penalty, seeds, draw_idx, seen_mask, tables,
+                 *, contiguous: bool):
+            n_lanes, max_pages = tables.shape
+            page_size = k_pool.shape[2]
+            max_len = max_pages * page_size
+            emb = client_embed(client_params, tokens[:, None], cfg)
+            hidden = jnp.where(
+                use_token[:, None, None],
+                emb.astype(k_pool.dtype),
+                hidden.astype(k_pool.dtype),
+            )
+            if use_quant_consts:
+                dense_params, quant_params, outlier_names = split_quant(params)
+                xs_params = dense_params
+                block_indices = jnp.arange(k_pool.shape[0], dtype=jnp.int32)
+            else:
+                xs_params = params
+                block_indices = jnp.zeros((k_pool.shape[0],), jnp.int32)  # unused
+
+            def body(h, xs):
+                p_block, k_blk, v_blk, block_idx = xs
+                if use_quant_consts:
+                    p_block = reattach(p_block, quant_params, outlier_names, block_idx)
+                if contiguous:
+                    k_dense = k_blk.reshape(n_lanes, max_len, *k_blk.shape[2:])
+                    v_dense = v_blk.reshape(n_lanes, max_len, *v_blk.shape[2:])
+                else:
+                    k_dense = gather_pages(k_blk, tables)
+                    v_dense = gather_pages(v_blk, tables)
+                out, (k_new, v_new) = family.block_apply(
+                    p_block, h, (k_dense, v_dense), positions, cfg,
+                    use_flash=False, tp_mesh=None,
+                )
+                if contiguous:
+                    k_blk = k_new.reshape(k_blk.shape)
+                    v_blk = v_new.reshape(v_blk.shape)
+                else:
+                    lanes = jnp.arange(n_lanes, dtype=jnp.int32)
+                    row = jnp.clip(positions, 0, max_len - 1)
+                    k_blk = scatter_token_rows(k_blk, k_new[lanes, row], tables, positions)
+                    v_blk = scatter_token_rows(v_blk, v_new[lanes, row], tables, positions)
+                return out, (k_blk, v_blk)
+
+            hidden, (k_pool, v_pool) = jax.lax.scan(
+                body, hidden, (xs_params, k_pool, v_pool, block_indices)
+            )
+            logits = client_head(client_params, hidden, cfg)[:, -1, :]
+            next_tok = sample_tokens(
+                logits, do_sample=do_sample, temperature=temperature,
+                top_k=top_k, top_p=top_p, repetition_penalty=rep_penalty,
+                seen_mask=seen_mask, seeds=seeds, draw_idx=draw_idx,
+            )
+            return hidden, next_tok, k_pool, v_pool
+
+        return step
+
+    def paged_gen_decode_step(self, client_params, hidden, tokens, use_token,
+                              pool_kv, positions, tables, *, sampling_vecs,
+                              handles=None, contiguous=None):
+        """Paged twin of ``batched_gen_decode_step`` (same argument contract
+        plus the block tables)."""
+        from petals_tpu.ops.paged_attention import tables_are_contiguous
+
+        k_pool, v_pool = pool_kv
+        tables = np.asarray(tables, np.int32)
+        if contiguous is None:
+            contiguous = tables_are_contiguous(tables, k_pool.shape[1])
+        if not isinstance(hidden, jax.Array):
+            hidden = np.ascontiguousarray(hidden)
+        v = sampling_vecs
+        with self._quant_ctx():
+            out, toks, k_pool, v_pool = self._paged_gen_decode_fn(
+                self.params, client_params, k_pool, v_pool, hidden,
+                np.asarray(tokens, np.int32), np.asarray(use_token, bool),
+                np.asarray(positions, np.int32), v["do_sample"],
+                v["temperature"], v["top_k"], v["top_p"],
+                v["repetition_penalty"], v["seeds"], v["draw_idx"],
+                v["seen_mask"], tables, contiguous=bool(contiguous),
+            )
+        return out, toks, (k_pool, v_pool)
+
+    @functools.cached_property
+    def _paged_lane_gather_fn(self):
+        """Assemble one lane's dense session-shaped view [n_blocks, 1,
+        max_len, hkv, d] from its block-table row — the paged stand-in for
+        ``_lane_extract_fn`` (exclusive ops: chunked prefill, kv export).
+        Content of unallocated slots is masked garbage, exactly like the
+        in-step gather."""
+
+        @jax.jit
+        def f(k_pool, v_pool, table_row):
+            n_blocks, n_pages, page_size = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
+            max_pages = table_row.shape[0]
+            safe = jnp.clip(table_row, 0, n_pages - 1)
+            k = jnp.take(k_pool, safe, axis=1)  # [n_blocks, max_pages, ps, hkv, d]
+            v = jnp.take(v_pool, safe, axis=1)
+            shape = (n_blocks, 1, max_pages * page_size, *k_pool.shape[3:])
+            return k.reshape(shape), v.reshape(shape)
+
+        return f
+
+    @functools.cached_property
+    def _paged_lane_scatter_fn(self):
+        """Write a session-shaped lane buffer back into its pages — the paged
+        stand-in for ``_lane_insert_fn`` (prefill lands its KV directly in
+        the pages; unallocated slots drop)."""
+        from petals_tpu.ops.paged_attention import scatter_lane_pages
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def f(k_pool, v_pool, k, v, table_row):
+            n_blocks, n_pages, page_size = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
+            max_pages = table_row.shape[0]
+            pages_shape = (n_blocks, max_pages, page_size, *k_pool.shape[3:])
+            k_pages = k.reshape(pages_shape)
+            v_pages = v.reshape(pages_shape)
+            safe = jnp.where(table_row >= 0, table_row, n_pages)
+            k_pool = k_pool.at[:, safe].set(k_pages.astype(k_pool.dtype), mode="drop")
+            v_pool = v_pool.at[:, safe].set(v_pages.astype(v_pool.dtype), mode="drop")
+            return k_pool, v_pool
+
+        return f
+
+    @functools.cached_property
+    def _copy_page_fn(self):
+        """Duplicate one page across all blocks of the pool (the copy-on-write
+        fork: a shared page must be copied before a lane writes into it)."""
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def f(k_pool, v_pool, src, dst):
+            k_page = jax.lax.dynamic_slice_in_dim(k_pool, src, 1, axis=1)
+            v_page = jax.lax.dynamic_slice_in_dim(v_pool, src, 1, axis=1)
+            k_pool = jax.lax.dynamic_update_slice_in_dim(k_pool, k_page, dst, axis=1)
+            v_pool = jax.lax.dynamic_update_slice_in_dim(v_pool, v_page, dst, axis=1)
+            return k_pool, v_pool
+
+        return f
 
     @functools.cached_property
     def _lane_extract_fn(self):

@@ -38,12 +38,13 @@ import contextlib
 import dataclasses
 import itertools
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from petals_tpu.ops.sampling import sampling_vectors
-from petals_tpu.server.memory_cache import AllocationFailed, MemoryCache
+from petals_tpu.server.memory_cache import AllocationFailed, MemoryCache, PageAllocator
 from petals_tpu.server.task_queue import PRIORITY_INFERENCE, PriorityTaskQueue
 from petals_tpu.utils.logging import get_logger
 
@@ -86,6 +87,8 @@ class DecodeBatcher:
         max_length: int = 1024,
         alloc_timeout: Optional[float] = None,
         gen_params=None,  # full-model client leaves: enables pooled server-gen
+        page_size: Optional[int] = None,  # None/0 -> dense lane pool (legacy)
+        n_pages: Optional[int] = None,  # default: n_lanes * max_pages (no oversub)
     ):
         self.backend = backend
         self.memory_cache = memory_cache
@@ -94,6 +97,31 @@ class DecodeBatcher:
         self.max_length = max_length
         self.alloc_timeout = alloc_timeout
         self.gen_params = gen_params
+        # paged KV mode: the pool becomes [n_blocks, n_pages, page_size, ...]
+        # and lanes address it through per-lane block tables. Gated off under
+        # lockstep (the paged programs are single-host) and TP meshes (the
+        # page axis is unsharded); those keep the dense lane pool.
+        lockstep = bool(getattr(backend, "is_lockstep", False))
+        if page_size and not lockstep and getattr(backend, "mesh", None) is None:
+            self.page_size: Optional[int] = int(page_size)
+            # round the lane capacity UP to whole pages so tables tile exactly
+            self.max_length = -(-int(max_length) // self.page_size) * self.page_size
+            self.max_pages = self.max_length // self.page_size
+            self.n_pages = int(n_pages) if n_pages else self.n_lanes * self.max_pages
+            if self.n_pages < self.max_pages:
+                raise ValueError(
+                    f"n_pages={self.n_pages} cannot hold even one full lane "
+                    f"({self.max_pages} pages of {self.page_size} tokens)"
+                )
+        else:
+            self.page_size = None
+            self.max_pages = 0
+            self.n_pages = 0
+        self._pages: Optional[PageAllocator] = None
+        self._tables: Optional[np.ndarray] = None  # [n_lanes, max_pages] int32, -1 = unallocated
+        # bumped on every pool reset: prefix-cache page pins carry the epoch
+        # they were taken under so stale pins never decref a rebuilt allocator
+        self._page_epoch = 0
         # lanes currently running server-side generation: advanced one token
         # per flush-loop iteration alongside (and batched WITH) ordinary
         # per-token decode traffic
@@ -149,9 +177,14 @@ class DecodeBatcher:
             # sharded descriptors, and materialization is a collective every
             # process must enter with the SAME specs (an unsharded leader
             # pool would deadlock the group at open)
-            kd, vd = self.backend.cache_descriptors(
-                self.n_lanes, self.max_length, 0, self.backend.n_blocks
-            )
+            if self.page_size is not None:
+                kd, vd = self.backend.paged_cache_descriptors(
+                    self.n_pages, self.page_size, 0, self.backend.n_blocks
+                )
+            else:
+                kd, vd = self.backend.cache_descriptors(
+                    self.n_lanes, self.max_length, 0, self.backend.n_blocks
+                )
             stack = contextlib.AsyncExitStack()
             try:
                 handles = await stack.enter_async_context(
@@ -166,11 +199,21 @@ class DecodeBatcher:
             self._pool_stack = stack
             self._handles = handles
             self._free_lanes = list(range(self.n_lanes))
-            logger.info(
-                f"Continuous-batching pool open: {self.n_lanes} lanes x "
-                f"{self.max_length} tokens for blocks "
-                f"[{self.backend.first_block}, {self.backend.first_block + self.backend.n_blocks})"
-            )
+            if self.page_size is not None:
+                self._pages = PageAllocator(self.n_pages)
+                self._tables = np.full((self.n_lanes, self.max_pages), -1, np.int32)
+                logger.info(
+                    f"Paged-batching pool open: {self.n_pages} pages x "
+                    f"{self.page_size} tokens ({self.n_lanes} lanes x "
+                    f"{self.max_pages} table slots) for blocks "
+                    f"[{self.backend.first_block}, {self.backend.first_block + self.backend.n_blocks})"
+                )
+            else:
+                logger.info(
+                    f"Continuous-batching pool open: {self.n_lanes} lanes x "
+                    f"{self.max_length} tokens for blocks "
+                    f"[{self.backend.first_block}, {self.backend.first_block + self.backend.n_blocks})"
+                )
 
     async def close(self) -> None:
         self._closed = True
@@ -200,12 +243,28 @@ class DecodeBatcher:
         """Borrow a lane; queues (FIFO) when all lanes are taken — the
         allocation-pressure behavior of MemoryCache, at lane granularity.
         ``timeout`` bounds the WHOLE acquisition including first-use pool
-        allocation, so session opens can fall back to a private cache."""
+        allocation, so session opens can fall back to a private cache.
+
+        Paged mode: admission additionally claims ONE page (not max_length
+        tokens) — the lane grows page-by-page via prepare_write, and a full
+        page pool exerts the same waiter backpressure as a full lane list."""
+        lane = await self._acquire_lane(timeout=timeout)
+        if self.page_size is not None:
+            try:
+                await self.prepare_write(lane, 0, 1, timeout=timeout)
+            except BaseException:
+                self.release_lane(lane)
+                raise
+        return lane
+
+    async def _acquire_lane(self, timeout: Optional[float] = None) -> int:
         await self.ensure_open(timeout=timeout)
         if self._closed:
             raise AllocationFailed("Batcher is closed")
         if self._free_lanes:
-            lane = self._free_lanes.pop()
+            # FIFO like the waiter queue: least-recently-released lane first,
+            # so reuse is fair and page-table churn stays predictable
+            lane = self._free_lanes.pop(0)
             self._lane_generation[lane] = self._generation
             return lane
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -251,6 +310,15 @@ class DecodeBatcher:
         if st is not None and not st.future.done():
             st.future.set_exception(AllocationFailed("Lane released mid-step"))
         self._lane_generation.pop(lane, None)
+        # paged mode: drop this lane's table references — pages whose refcount
+        # hits zero (no prefix-cache pin) return to the pool and wake any
+        # prepare_write waiters blocked on an exhausted pool
+        if self.page_size is not None and self._tables is not None:
+            row = self._tables[lane]
+            for slot in range(self.max_pages):
+                if row[slot] >= 0:
+                    self._pages.decref(int(row[slot]))
+            row[:] = -1
         # hand straight to the next waiter, else back to the free list; the
         # new session overwrites the lane from position 0, so no zeroing
         while self._lane_waiters:
@@ -259,6 +327,146 @@ class DecodeBatcher:
                 fut.set_result(lane)
                 return
         self._free_lanes.append(lane)
+
+    # ------------------------------------------------------------------ pages
+
+    async def prepare_write(
+        self, lane: int, t0: int, t1: int, timeout: Optional[float] = None
+    ) -> None:
+        """Make token range [t0, t1) of ``lane`` writable: allocate missing
+        pages on demand and copy-on-write-fork any page shared with the
+        prefix cache (refs > 1). Blocks on an exhausted pool until a page
+        frees (release_lane / prefix-cache eviction), raising
+        AllocationFailed at ``timeout`` — MemoryCache's backpressure
+        contract at page grain. No-op in dense mode."""
+        if self.page_size is None or t1 <= t0:
+            return
+        self._check_lane(lane)
+        if t1 > self.max_length:
+            raise ValueError(
+                f"Write range [{t0}, {t1}) overflows the lane buffer "
+                f"({self.max_length} tokens)"
+            )
+        alloc = self._pages
+        # identity preference keeps tables contiguous at the default pool
+        # size, so decode stays on the reshape (dense-program) fast path
+        identity_base = (
+            lane * self.max_pages
+            if self.n_pages == self.n_lanes * self.max_pages else None
+        )
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for slot in range(t0 // self.page_size, (t1 - 1) // self.page_size + 1):
+            cur = int(self._tables[lane, slot])
+            if cur >= 0 and alloc.refs[cur] == 1:
+                continue  # already exclusively owned
+            preferred = None if identity_base is None else identity_base + slot
+            while True:
+                page = alloc.try_alloc(preferred=preferred)
+                if page is not None:
+                    break
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise AllocationFailed(
+                        f"No free KV page within {timeout} s "
+                        f"({self.n_pages} pages in use)"
+                    )
+                alloc.freed_event.clear()
+                try:
+                    await asyncio.wait_for(alloc.freed_event.wait(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    pass  # loop once more to produce the AllocationFailed message
+                if self._pages is not alloc:
+                    raise AllocationFailed(
+                        "Lane pool was reset while waiting for a free page"
+                    )
+                self._check_lane(lane)
+            try:
+                if cur >= 0:
+                    # shared page: fork it on the compute thread (serialized
+                    # with batched steps by the queue), then drop our shared ref
+                    await self.queue.submit(
+                        self._copy_page, cur, page,
+                        priority=PRIORITY_INFERENCE, size=0,
+                    )
+                    alloc.stats["forked"] += 1
+                    self._check_lane(lane)
+                    alloc.decref(cur)
+            except BaseException:
+                if self._pages is alloc:
+                    alloc.decref(page)  # never reached the table: hand it back
+                raise
+            self._tables[lane, slot] = page
+
+    def _copy_page(self, src: int, dst: int) -> None:
+        """Compute-thread body: device copy of one page (all blocks) — the
+        copy-on-write fork. Donating, so swapped under the reset lock like
+        every other pool-touching op."""
+        with self._reset_lock:
+            k_pool, v_pool = self._buffers()
+            k_pool, v_pool = self.backend._copy_page_fn(
+                k_pool, v_pool, np.int32(src), np.int32(dst)
+            )
+            self._update(k_pool, v_pool)
+
+    @property
+    def page_epoch(self) -> int:
+        return self._page_epoch
+
+    def pin_lane_pages(self, lane: int, t0: int, t1: int) -> Optional[List[int]]:
+        """Take a reference on the pages backing token range [t0, t1) of
+        ``lane`` (page-aligned) so the prefix cache can share them after the
+        lane is released. Returns the page list, or None when the range is
+        not fully resident (or not paged). Pair with unpin_pages."""
+        if self.page_size is None or self._tables is None:
+            return None
+        assert t0 % self.page_size == 0 and t1 % self.page_size == 0, (t0, t1)
+        row = self._tables[lane]
+        pages = []
+        for slot in range(t0 // self.page_size, t1 // self.page_size):
+            page = int(row[slot])
+            if page < 0:
+                return None
+            pages.append(page)
+        for page in pages:
+            self._pages.incref(page)
+        return pages
+
+    def unpin_pages(self, pages: Sequence[int], epoch: int) -> None:
+        """Drop prefix-cache references taken by pin_lane_pages. Ignores pins
+        from a previous epoch: the reset rebuilt the allocator, so those
+        pages no longer exist to decref."""
+        if self.page_size is None or self._pages is None or epoch != self._page_epoch:
+            return
+        for page in pages:
+            self._pages.decref(int(page))
+
+    def adopt_pages(self, lane: int, pages: Sequence[int]) -> None:
+        """Point ``lane``'s first len(pages) table slots at already-resident
+        (prefix-cache-pinned) pages — a cache hit that copies ZERO bytes.
+        The lane holds them read-shared; its first write past the prefix
+        forks via prepare_write."""
+        assert self.page_size is not None and self._tables is not None
+        assert len(pages) <= self.max_pages
+        row = self._tables[lane]
+        for slot, page in enumerate(pages):
+            cur = int(row[slot])
+            self._pages.incref(int(page))
+            if cur >= 0:
+                self._pages.decref(cur)
+            row[slot] = int(page)
+
+    def paged_summary(self) -> Optional[dict]:
+        """Observability: pool occupancy + allocator counters (rpc_info)."""
+        if self.page_size is None:
+            return None
+        alloc = self._pages
+        return {
+            "page_size": self.page_size,
+            "n_pages": self.n_pages,
+            "page_epoch": self._page_epoch,
+            "pages_free": alloc.n_free if alloc is not None else self.n_pages,
+            **({f"pages_{k}": v for k, v in alloc.stats.items()} if alloc else {}),
+        }
 
     # ------------------------------------------------------------------ stepping
 
@@ -273,6 +481,10 @@ class DecodeBatcher:
         """One decode token for ``lane`` (hidden [1, 1, hidden]); coalesced
         with whatever other lanes are pending by the time the device is free."""
         self._check_lane(lane)
+        if self.page_size is not None:
+            # grow the lane to cover this token BEFORE the device step —
+            # allocation can await a freed page; the step itself never blocks
+            await self.prepare_write(lane, int(position), int(position) + 1)
         fut = asyncio.get_running_loop().create_future()
         self._pending.append((lane, hidden, int(position), fut, self._generation))
         if self._flush_task is None or self._flush_task.done():
@@ -376,6 +588,10 @@ class DecodeBatcher:
                 f"Generating {n_tokens} tokens at position {position} overflows "
                 f"the lane buffer ({self.max_length} tokens)"
             )
+        if self.page_size is not None and n_tokens > 1:
+            # reserve the whole stream's pages up front: the flush loop can't
+            # await page allocation mid-generation
+            await self.prepare_write(lane, int(position), int(position) + int(n_tokens) - 1)
 
         # bootstrap: t0 comes from the caller's hidden, not a pool step —
         # submitted through the queue so it serializes with batched steps
@@ -460,6 +676,18 @@ class DecodeBatcher:
         )
         with self._reset_lock:
             self._generation += 1
+            if self.page_size is not None:
+                # every table reference died with the lanes; rebuild the
+                # allocator and bump the epoch so prefix-cache pins taken
+                # against the old pool become no-op unpins
+                self._page_epoch += 1
+                if self._pages is not None:
+                    # wake prepare_write waiters parked on the dead allocator
+                    # so they observe the swap and fail loudly
+                    self._pages.freed_event.set()
+                self._pages = PageAllocator(self.n_pages)
+                if self._tables is not None:
+                    self._tables[:] = -1
             for handle in self._handles or ():
                 try:
                     self.memory_cache.reset_buffer(handle)
@@ -481,9 +709,18 @@ class DecodeBatcher:
             hidden[lane] = np.asarray(h, np.float32).reshape(1, hsz)
             positions[lane] = pos
         k_pool, v_pool = self._buffers()
-        out, (k_pool, v_pool) = self.backend.batched_decode_step(
-            hidden, (k_pool, v_pool), positions, handles=self._handles
-        )
+        if self.page_size is not None:
+            # snapshot the tables: the event loop may grow OTHER lanes while
+            # this step runs, but never slots this step reads unmasked or
+            # writes (prepare_write ran before each entry was enqueued)
+            out, (k_pool, v_pool) = self.backend.paged_decode_step(
+                hidden, (k_pool, v_pool), positions, self._tables.copy(),
+                handles=self._handles,
+            )
+        else:
+            out, (k_pool, v_pool) = self.backend.batched_decode_step(
+                hidden, (k_pool, v_pool), positions, handles=self._handles
+            )
         host_out = np.asarray(out)  # device sync: the step has fully executed
         with self._reset_lock:
             if batch and batch[0][4] != self._generation:
@@ -535,10 +772,17 @@ class DecodeBatcher:
             if st.seen is not None:
                 vecs["seen_mask"][lane] = st.seen
         k_pool, v_pool = self._buffers()
-        out, toks, (k_pool, v_pool) = self.backend.batched_gen_decode_step(
-            self.gen_params, hidden, tokens, use_token, (k_pool, v_pool),
-            positions, sampling_vecs=vecs, handles=self._handles,
-        )
+        if self.page_size is not None:
+            out, toks, (k_pool, v_pool) = self.backend.paged_gen_decode_step(
+                self.gen_params, hidden, tokens, use_token, (k_pool, v_pool),
+                positions, self._tables.copy(), sampling_vecs=vecs,
+                handles=self._handles,
+            )
+        else:
+            out, toks, (k_pool, v_pool) = self.backend.batched_gen_decode_step(
+                self.gen_params, hidden, tokens, use_token, (k_pool, v_pool),
+                positions, sampling_vecs=vecs, handles=self._handles,
+            )
         host_out = np.asarray(out)  # device sync: the step has fully executed
         host_toks = np.asarray(toks)
         with self._reset_lock:
@@ -580,6 +824,13 @@ class DecodeBatcher:
                 k_pool, v_pool, lane,
                 pool_handle=self._handles[0], temp_handle=temp[0],
             )
+        if self.page_size is not None:
+            # gather the lane's pages into the session-shaped dense view the
+            # exclusive fns (prefill, kv import) expect — same layout as the
+            # dense pool's lane, so those fns are mode-oblivious
+            return self.backend._paged_lane_gather_fn(
+                k_pool, v_pool, self._tables[lane].copy()
+            )
         return self.backend._lane_extract_fn(k_pool, v_pool, np.int32(lane))
 
     def _insert_lane(self, lane: int, kv_lane, temp: Optional[tuple] = None) -> None:
@@ -599,6 +850,13 @@ class DecodeBatcher:
                     k_pool, v_pool, (k2, v2), lane,
                     pool_handle=self._handles[0], temp_handle=temp[0],
                 )
+            elif self.page_size is not None:
+                # scatter the dense lane view back through the block table;
+                # unallocated (-1) slots drop, so content past the session's
+                # resident pages never lands anywhere
+                k_pool, v_pool = self.backend._paged_lane_scatter_fn(
+                    k_pool, v_pool, k2, v2, self._tables[lane].copy()
+                )
             else:
                 k_pool, v_pool = self.backend._lane_insert_fn(
                     k_pool, v_pool, k2, v2, np.int32(lane)
@@ -617,7 +875,10 @@ class DecodeBatcher:
         except Exception:
             pass  # degraded group: the mirrors died with the workers
 
-    async def run_exclusive(self, lane: int, fn, *, size: int = 0, extract: bool = True):
+    async def run_exclusive(
+        self, lane: int, fn, *, size: int = 0, extract: bool = True,
+        write_range: Optional[Tuple[int, int]] = None,
+    ):
         """Run ``fn(kv_lane, lane_handles) -> (result, kv_lane')`` with the
         lane extracted into session-shaped buffers, then insert the updated
         lane back — all in ONE atomic queue task. Used for KV import and any
@@ -627,9 +888,14 @@ class DecodeBatcher:
         (e.g. ``backend.inference_step(..., handles=lane_handles)``).
         ``extract=False`` skips the checkout (fn receives kv_lane=None) for
         ops that wholesale REPLACE the lane (prefix seed, kv import) — under
-        lockstep that saves every process a full-lane device copy."""
+        lockstep that saves every process a full-lane device copy.
+        ``write_range=(t0, t1)`` declares the token range the fn writes:
+        paged mode allocates/forks those pages up front (prepare_write) so
+        the check-in scatter has somewhere to land."""
 
         self._check_lane(lane)
+        if self.page_size is not None and write_range is not None:
+            await self.prepare_write(lane, int(write_range[0]), int(write_range[1]))
 
         def run():
             self._check_lane(lane)  # re-check: a reset may have raced the queue
@@ -653,7 +919,10 @@ class DecodeBatcher:
             self._maybe_reset_pool()
             raise
 
-    async def run_exclusive_chunks(self, lane: int, chunk_fns, *, size: int = 0):
+    async def run_exclusive_chunks(
+        self, lane: int, chunk_fns, *, size: int = 0,
+        write_range: Optional[Tuple[int, int]] = None,
+    ):
         """Chunked-prefill interleaving (Sarathi-style): extract the lane
         once, run each ``fn(kv_lane, lane_handles) -> (result, kv_lane')`` as
         its OWN priority-queue task, insert once. Between chunks the flush
@@ -664,6 +933,8 @@ class DecodeBatcher:
         task even if this session is cancelled mid-chunks (stale content
         beyond a tenant's position is masked by attention anyway)."""
         self._check_lane(lane)
+        if self.page_size is not None and write_range is not None:
+            await self.prepare_write(lane, int(write_range[0]), int(write_range[1]))
         if len(chunk_fns) == 1:
             # short prefills skip the extract/insert round-trips
             return [await self.run_exclusive(lane, chunk_fns[0], size=size)]
@@ -753,7 +1024,12 @@ class DecodeBatcher:
                 finally:
                     self.backend.release_temp(temp[0])
             k_pool, v_pool = self._buffers()
-            k, v = self.backend._lane_extract_fn(k_pool, v_pool, np.int32(lane))
+            if self.page_size is not None:
+                k, v = self.backend._paged_lane_gather_fn(
+                    k_pool, v_pool, self._tables[lane].copy()
+                )
+            else:
+                k, v = self.backend._lane_extract_fn(k_pool, v_pool, np.int32(lane))
             kd = k[b0:b1, :, :position]
             vd = v[b0:b1, :, :position]
             host = (np.asarray(kd), np.asarray(vd))

@@ -62,6 +62,8 @@ class TransformerHandler:
         batching: bool = True,  # continuous batching across decode sessions
         batch_lanes: int = 8,
         batch_max_length: Optional[int] = None,  # pool lane length (tokens)
+        page_size: Optional[int] = None,  # paged KV: tokens per page; None/0 = dense pool
+        n_pages: Optional[int] = None,  # paged KV pool size; None = lanes * max_pages
         prefix_cache_bytes: int = 256 * 2**20,  # 0 disables prefix caching
         prefix_share_scope: str = "swarm",  # "swarm" shares across clients; "peer" salts per client
         prefix_device_bytes: int = 256 * 2**20,  # HBM tier of the prefix cache; 0 disables
@@ -111,6 +113,8 @@ class TransformerHandler:
                 n_lanes=batch_lanes,
                 max_length=batch_max_length or inference_max_length or 1024,
                 gen_params=server_gen_params,
+                page_size=page_size,
+                n_pages=n_pages,
             )
 
         # Content-addressed prefix cache (server/prefix_cache.py): sessions
@@ -131,6 +135,20 @@ class TransformerHandler:
             self.prefix_cache = PrefixCache(
                 prefix_cache_bytes, device_max_bytes=prefix_device_bytes
             )
+        if (
+            self.prefix_cache is not None
+            and self.batcher is not None
+            and self.batcher.page_size is not None
+        ):
+            from petals_tpu.server.prefix_cache import SEGMENT_TOKENS
+
+            # page-granular prefix sharing slices pinned page runs at segment
+            # boundaries, so segments must tile exactly into pages
+            if SEGMENT_TOKENS % self.batcher.page_size != 0:
+                raise ValueError(
+                    f"page_size={self.batcher.page_size} must divide the prefix-cache "
+                    f"segment size ({SEGMENT_TOKENS} tokens)"
+                )
 
     async def swap_backend(self, new_backend) -> None:
         """Retarget the handler at a freshly built backend (span reload /
@@ -155,6 +173,8 @@ class TransformerHandler:
                 n_lanes=old.n_lanes,
                 max_length=old.max_length,
                 gen_params=self.server_gen_params,
+                page_size=old.page_size,
+                n_pages=old.n_pages or None,
             )
             await old.close()
 
@@ -363,7 +383,10 @@ class TransformerHandler:
             def replace(kv_lane, lane_handles):
                 return None, (jnp.asarray(new_k), jnp.asarray(new_v))
 
-            await batcher.run_exclusive(lane, replace, extract=False)
+            # paged lanes must own pages for the seeded rows before check-in
+            await batcher.run_exclusive(
+                lane, replace, extract=False, write_range=(0, new_position)
+            )
             return kv
 
         k_buf, v_buf = kv
@@ -424,7 +447,9 @@ class TransformerHandler:
         def replace(kv_lane, lane_handles):
             return None, (new_k, new_v)
 
-        await batcher.run_exclusive(lane, replace, extract=False)
+        await batcher.run_exclusive(
+            lane, replace, extract=False, write_range=(0, new_position)
+        )
 
     def _seed_session_kv_device(self, kv, handles, kd_list, vd_list, new_position: int):
         """Prefix-hit seeding entirely on device: concatenate the HBM-resident
@@ -448,15 +473,30 @@ class TransformerHandler:
         awaits it before executing any LATER step of the same session, so the
         stored rows always match the content hash (content-addressed: a
         rollback later cannot poison the mapping)."""
+        from petals_tpu.server.prefix_cache import SEGMENT_TOKENS
+
+        L = n_hit * SEGMENT_TOKENS
         lane_k_dev = lane_v_dev = None
+        lane_pages = None
+        lane_pages_epoch = 0
         try:
             if lane is not None:
                 # guard on the BATCHER's backend: the session captured its
                 # batcher at open, and swap_backend can retarget self.backend
                 # while this snapshot still reads the old pool
                 lane_backend = batcher.backend
+                if batcher.page_size is not None:
+                    # page tier: pin the freshly computed segments' pages so a
+                    # later hit adopts them in place of any KV re-upload; only
+                    # whole stored segments pin (both bounds page-aligned
+                    # because page_size divides SEGMENT_TOKENS)
+                    seg_end = (boundary // SEGMENT_TOKENS) * SEGMENT_TOKENS
+                    if seg_end > L:
+                        lane_pages_epoch = batcher.page_epoch
+                        lane_pages = batcher.pin_lane_pages(lane, L, seg_end)
                 if (
                     self.prefix_cache.device_max_bytes > 0
+                    and batcher.page_size is None
                     and getattr(lane_backend, "mesh", None) is None
                     and not getattr(lane_backend, "is_lockstep", False)
                 ):
@@ -489,10 +529,9 @@ class TransformerHandler:
                             return
                         await asyncio.sleep(0.05)
         except Exception:
+            if lane_pages:
+                batcher.unpin_pages(lane_pages, lane_pages_epoch)
             return  # storing is best-effort; the session must never notice
-        from petals_tpu.server.prefix_cache import SEGMENT_TOKENS
-
-        L = n_hit * SEGMENT_TOKENS
         # device tier: single-device private sessions only — lane snapshots
         # are host-side, lockstep mirrors are per-process shards, and sliced
         # TP-sharded buffers would pin sharded HBM references of unclear
@@ -517,6 +556,8 @@ class TransformerHandler:
         self.prefix_cache.put(
             keys, n_hit, k[:, :, L:], v[:, :, L:], out_full[:, L:boundary],
             k_dev=k_dev, v_dev=v_dev,
+            pages=lane_pages, pages_pool=batcher if lane_pages else None,
+            pages_epoch=lane_pages_epoch,
         )
 
     async def _snapshot_session(
@@ -785,6 +826,9 @@ class TransformerHandler:
                 "max_length": self.batcher.max_length,
                 **self.batcher.stats,
             }
+            paged = self.batcher.paged_summary()
+            if paged is not None:
+                info["continuous_batching"]["paged"] = paged
         if self.prefix_cache is not None:
             info["prefix_cache"] = self.prefix_cache.summary()
         return info
@@ -1004,8 +1048,37 @@ class TransformerHandler:
                             seed_backend = (
                                 batcher.backend if lane is not None else self.backend
                             )
+                            # page tier first: a pooled lane whose WHOLE hit
+                            # prefix is still page-resident in THIS batcher's
+                            # pool (same epoch — pins die on reset) adopts the
+                            # pages by table reference: zero bytes copied,
+                            # copy-on-write protects the shared rows
+                            paged_adopted = False
+                            if lane is not None and batcher.page_size is not None:
+                                spp = SEGMENT_TOKENS // batcher.page_size
+                                if all(
+                                    e.get("pages") is not None
+                                    and e.get("pages_pool") is batcher
+                                    and e.get("pages_epoch") == batcher.page_epoch
+                                    and len(e["pages"]) == spp
+                                    for e in pc_entries
+                                ):
+                                    batcher.adopt_pages(
+                                        lane,
+                                        [p for e in pc_entries for p in e["pages"]],
+                                    )
+                                    self.prefix_cache.stats["page_hits"] = (
+                                        self.prefix_cache.stats.get("page_hits", 0) + 1
+                                    )
+                                    prefix_out = await asyncio.to_thread(
+                                        lambda: np.concatenate(
+                                            [e["out"] for e in pc_entries], axis=1
+                                        )
+                                    )
+                                    paged_adopted = True
                             use_device = (
-                                not getattr(seed_backend, "is_lockstep", False)
+                                not paged_adopted
+                                and not getattr(seed_backend, "is_lockstep", False)
                                 # mesh guard mirrors the store path: after a
                                 # swap_backend onto a TP mesh, surviving
                                 # device entries must not seed unsharded
@@ -1013,7 +1086,9 @@ class TransformerHandler:
                                 and getattr(seed_backend, "mesh", None) is None
                                 and all(x is not None for x in kd_list)
                             )
-                            if use_device:
+                            if paged_adopted:
+                                pass  # the block table IS the seed
+                            elif use_device:
                                 # whole prefix HBM-resident: zero host->device
                                 # traffic; only `out` rides from host RAM
                                 self.prefix_cache.stats["device_hits"] = (
@@ -1085,7 +1160,8 @@ class TransformerHandler:
                             off += clen
                         outs = await asyncio.wait_for(
                             batcher.run_exclusive_chunks(
-                                lane, chunk_fns, size=batch_size * exec_hidden.shape[1]
+                                lane, chunk_fns, size=batch_size * exec_hidden.shape[1],
+                                write_range=(pos, pos + exec_hidden.shape[1]),
                             ),
                             self.step_timeout,
                         )
@@ -1104,7 +1180,8 @@ class TransformerHandler:
 
                         out = await asyncio.wait_for(
                             batcher.run_exclusive(
-                                lane, run_lane, size=batch_size * seq
+                                lane, run_lane, size=batch_size * seq,
+                                write_range=(pos, pos + seq),
                             ),
                             self.step_timeout,
                         )

@@ -22,6 +22,7 @@ processes.
 from __future__ import annotations
 
 import asyncio
+import collections
 import contextlib
 import dataclasses
 import time
@@ -56,6 +57,68 @@ class TensorDescriptor:
         if self.sharding is not None:
             return jax.device_put(arr, self.sharding)
         return jax.device_put(arr, device) if device is not None else arr
+
+
+class PageAllocator:
+    """Page-grain free list + refcounts over ONE preallocated page pool.
+
+    The paged KV cache (server/batching.py) budgets its whole page pool
+    through MemoryCache ONCE at open; this allocator then hands out page
+    INDICES on demand — admission costs one page, not max_length tokens, and
+    lanes grow page-by-page. Refcounts make pages shareable: a block-table
+    reference and a prefix-cache pin each count one, and a page with
+    ``refs > 1`` must be forked (copy-on-write) before any write.
+
+    Synchronous core, asyncio signalling: every mutation happens on the
+    event loop (the batcher's table/refcount bookkeeping is loop-side, like
+    its lane lists), and ``freed_event`` wakes allocation waiters when a
+    page returns — the MemoryCache backpressure contract, at page grain.
+    """
+
+    def __init__(self, n_pages: int):
+        assert n_pages > 0
+        self.n_pages = int(n_pages)
+        self._free = collections.deque(range(self.n_pages))
+        self._free_set = set(range(self.n_pages))
+        self.refs = np.zeros((self.n_pages,), np.int32)
+        self.freed_event = asyncio.Event()
+        self.stats = {"allocated": 0, "forked": 0, "freed": 0}
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def try_alloc(self, preferred: Optional[int] = None) -> Optional[int]:
+        """Take a free page (refs=1) or None when the pool is exhausted.
+        ``preferred`` is taken when free — the batcher asks for the identity
+        page so tables stay contiguous and decode keeps the dense-program
+        fast path (ops/paged_attention.py tables_are_contiguous)."""
+        if not self._free:
+            return None
+        if preferred is not None and preferred in self._free_set:
+            self._free.remove(preferred)
+            page = preferred
+        else:
+            page = self._free.popleft()
+        self._free_set.discard(page)
+        self.refs[page] = 1
+        self.stats["allocated"] += 1
+        return page
+
+    def incref(self, page: int) -> None:
+        assert self.refs[page] > 0, f"incref of free page {page}"
+        self.refs[page] += 1
+
+    def decref(self, page: int) -> None:
+        """Drop one reference; a page at zero returns to the free list (FIFO)
+        and wakes allocation waiters."""
+        assert self.refs[page] > 0, f"decref of free page {page}"
+        self.refs[page] -= 1
+        if self.refs[page] == 0 and page not in self._free_set:
+            self._free.append(page)
+            self._free_set.add(page)
+            self.stats["freed"] += 1
+            self.freed_event.set()
 
 
 class MemoryCache:

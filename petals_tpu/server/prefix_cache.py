@@ -127,14 +127,33 @@ class PrefixCache:
         self, keys: Sequence[str], first: int,
         k: np.ndarray, v: np.ndarray, out: np.ndarray,
         k_dev=None, v_dev=None,
+        pages: Optional[Sequence[int]] = None, pages_pool=None, pages_epoch: int = 0,
     ) -> None:
         """Store segments [first, len(keys)) from span-shaped arrays COVERING
         those segments: k/v [n_blocks, 1, tokens, hkv, d] and out
         [1, tokens, hidden] whose token axis starts at segment ``first``.
         ``k_dev``/``v_dev``, when given, are the same token range as DEVICE
-        arrays; their per-segment slices populate the device tier."""
+        arrays; their per-segment slices populate the device tier.
+
+        ``pages``/``pages_pool``/``pages_epoch``: page-granular sharing for a
+        paged batcher. ``pages`` are PINNED page indices (pin_lane_pages)
+        covering the same token range; each segment's slice rides on its
+        entry so a later hit can adopt_pages the prefix with zero copies.
+        Ownership transfers to the cache here: every incoming page reference
+        is either attached to an entry or unpinned before put returns, and
+        attached pins are unpinned on eviction/clear — copy-on-write in the
+        batcher keeps pinned pages immutable while referenced."""
+        spp = 0
+        if pages is not None and pages_pool is not None and pages_pool.page_size:
+            spp = SEGMENT_TOKENS // pages_pool.page_size  # pages per segment
+
+        def unpin_from(seg: int) -> None:
+            if spp and pages[seg * spp:]:
+                pages_pool.unpin_pages(pages[seg * spp:], pages_epoch)
+
         for i, key in enumerate(keys[first:]):
             t0, t1 = i * SEGMENT_TOKENS, (i + 1) * SEGMENT_TOKENS
+            seg_pages = list(pages[i * spp : (i + 1) * spp]) if spp else None
             if key in self._store:
                 self._store.move_to_end(key)
                 # a hot entry first stored host-only (pooled/lockstep store,
@@ -143,8 +162,13 @@ class PrefixCache:
                 # locked out of the tier forever while one-offs fill it
                 if t1 <= k.shape[2]:
                     self._attach_device(self._store[key], k_dev, v_dev, t0, t1)
+                if seg_pages and not self._attach_pages(
+                    self._store[key], seg_pages, pages_pool, pages_epoch
+                ):
+                    pages_pool.unpin_pages(seg_pages, pages_epoch)
                 continue
             if t1 > k.shape[2]:
+                unpin_from(i)
                 break
             entry = {
                 "k": np.ascontiguousarray(k[:, :, t0:t1]),
@@ -153,13 +177,17 @@ class PrefixCache:
             }
             entry_bytes = sum(a.nbytes for a in entry.values())
             if entry_bytes > self.max_bytes:
+                unpin_from(i)
                 return  # a single segment over budget: nothing fits
             while self._bytes + entry_bytes > self.max_bytes and self._store:
                 _, old = self._store.popitem(last=False)
                 self._bytes -= old["bytes"]
                 self._dev_bytes -= old.pop("dev_bytes", 0)
+                self._unpin_entry(old)
             entry["bytes"] = entry_bytes
             self._attach_device(entry, k_dev, v_dev, t0, t1)
+            if seg_pages:
+                self._attach_pages(entry, seg_pages, pages_pool, pages_epoch)
             self._store[key] = entry
             self._bytes += entry_bytes
             self.stats["stored_segments"] += 1
@@ -178,6 +206,31 @@ class PrefixCache:
             entry["dev_bytes"] = dev_bytes
             self._dev_bytes += dev_bytes
 
+    def _attach_pages(self, entry: dict, seg_pages, pool, epoch: int) -> bool:
+        """Attach a pinned page run to ``entry`` (paged tier). Replaces a
+        stale-epoch run; returns False when the entry already holds a live
+        one (caller unpins the incoming duplicate)."""
+        if "pages" in entry:
+            if entry.get("pages_epoch") == getattr(pool, "page_epoch", -1):
+                return False
+            self._unpin_entry(entry)  # stale epoch: pins died with the pool
+        entry["pages"] = list(seg_pages)
+        entry["pages_pool"] = pool
+        entry["pages_epoch"] = epoch
+        return True
+
+    def _unpin_entry(self, entry: dict) -> None:
+        """Release an entry's page pins back to its batcher (eviction/clear).
+        Best-effort: a reset batcher ignores stale-epoch unpins."""
+        pages = entry.pop("pages", None)
+        pool = entry.pop("pages_pool", None)
+        epoch = entry.pop("pages_epoch", 0)
+        if pages and pool is not None:
+            try:
+                pool.unpin_pages(pages, epoch)
+            except Exception:
+                pass  # racing batcher close/reset: the pool is gone anyway
+
     def _evict_device(self, target_bytes: int) -> None:
         """Drop HBM references (oldest first) until the device tier fits
         ``target_bytes``; host copies stay, so this only downgrades hits."""
@@ -194,6 +247,8 @@ class PrefixCache:
 
     def clear(self) -> None:
         """Drop every entry (stats are kept — they describe the lifetime)."""
+        for entry in self._store.values():
+            self._unpin_entry(entry)
         self._store.clear()
         self._bytes = 0
         self._dev_bytes = 0
@@ -214,5 +269,6 @@ class PrefixCache:
             "device_segments": sum(1 for e in self._store.values() if "kd" in e),
             "device_bytes": self._dev_bytes,
             "device_max_bytes": self.device_max_bytes,
+            "page_segments": sum(1 for e in self._store.values() if "pages" in e),
             **self.stats,
         }

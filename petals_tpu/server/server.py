@@ -86,6 +86,8 @@ class Server:
         batching: bool = True,  # continuous batching of concurrent decode sessions
         batch_lanes: Optional[int] = None,  # None: auto-size to the cache budget (<=8)
         batch_max_length: Optional[int] = None,  # pool lane length; None: min(inference_max_length, 1024)
+        page_size: int = 64,  # paged KV: tokens per page; 0 = dense lane pool
+        n_pages: Optional[int] = None,  # paged KV pool size; None = lanes * pages-per-lane
         prefix_cache_bytes: int = 256 * 2**20,  # host-RAM prompt-prefix cache; 0 disables
         prefix_share_scope: str = "swarm",  # "peer" isolates the prefix cache per client identity
         prefix_device_bytes: int = 256 * 2**20,  # HBM tier of the prefix cache; 0 disables
@@ -185,6 +187,8 @@ class Server:
         self.batching = batching
         self.batch_lanes = batch_lanes
         self.batch_max_length = batch_max_length
+        self.page_size = page_size
+        self.n_pages = n_pages
         self.prefix_cache_bytes = prefix_cache_bytes
         self.prefix_share_scope = prefix_share_scope
         self.prefix_device_bytes = prefix_device_bytes
@@ -621,6 +625,8 @@ class Server:
             batching=self.batching and batch_lanes >= 2,
             batch_lanes=batch_lanes,
             batch_max_length=batch_max_length,
+            page_size=self.page_size or None,
+            n_pages=self.n_pages,
             prefix_cache_bytes=self.prefix_cache_bytes,
             prefix_share_scope=self.prefix_share_scope,
             prefix_device_bytes=self.prefix_device_bytes,

@@ -24,7 +24,10 @@ import os
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.tpu
+# per-test wall-clock caps (pytest-timeout; inert without the plugin): the
+# round-5 probe window is ~150 s TOTAL, so one wedged kernel must fail fast
+# instead of eating the whole tier
+pytestmark = [pytest.mark.tpu, pytest.mark.timeout(120)]
 
 
 @pytest.fixture(scope="module")
@@ -86,11 +89,14 @@ def test_int8_kernel_matches_dequant_matmul(tpu):
 
     from petals_tpu.ops import quant as Q
 
+    # 2048x4096 (128-aligned) instead of the 7B-shaped 4096x11008: same
+    # kernel tiles, ~5x less chip time — the full-shape run lives in the
+    # bench rows; this tier only needs Mosaic-vs-XLA exactness
     key = jax.random.PRNGKey(3)
-    w = jax.random.normal(key, (4096, 11008), jnp.bfloat16) * 0.02
+    w = jax.random.normal(key, (2048, 4096), jnp.bfloat16) * 0.02
     q = Q.quantize(w, "int8")
     for m in (1, 200):
-        x = jax.random.normal(jax.random.fold_in(key, m), (m, 4096), jnp.bfloat16) * 0.1
+        x = jax.random.normal(jax.random.fold_in(key, m), (m, 2048), jnp.bfloat16) * 0.1
         want = (x @ Q.dequantize(q, jnp.bfloat16)).astype(jnp.float32)
         got = Q.int8_matmul_pallas(x, q)
         err = _rel_err(got, want)
@@ -106,10 +112,10 @@ def test_packed4_kernels_match_dequant_matmul(tpu, kind):
     from petals_tpu.ops import quant as Q
 
     key = jax.random.PRNGKey(7)
-    w = jax.random.normal(key, (4096, 11008), jnp.bfloat16) * 0.02
+    w = jax.random.normal(key, (2048, 4096), jnp.bfloat16) * 0.02
     q = Q.quantize(w, kind)
     for m in (1, 200):  # decode kernel and prefill kernel
-        x = jax.random.normal(jax.random.fold_in(key, m), (m, 4096), jnp.bfloat16) * 0.1
+        x = jax.random.normal(jax.random.fold_in(key, m), (m, 2048), jnp.bfloat16) * 0.1
         want = (x @ Q.dequantize(q, jnp.bfloat16)).astype(jnp.float32)
         got = Q.packed4_matmul_pallas(x, q)
         err = _rel_err(got, want)
@@ -119,13 +125,14 @@ def test_packed4_kernels_match_dequant_matmul(tpu, kind):
             jnp.stack([q.data * 0, q.data]),
             jnp.stack([q.scales, q.scales]),
             jnp.int32(1),
+            2048,
             4096,
-            11008,
         )
         errs = _rel_err(Q.packed4_matmul_pallas_stacked(x, sq), want)
         assert errs < 2e-2, f"{kind} stacked M={m}: {errs}"
 
 
+@pytest.mark.timeout(300)  # two backend builds: the heavy full-tier-only test
 def test_backend_inference_step_matches_xla_paths(tpu):
     """One quantized span decode step on the chip: the production path (Pallas
     kernels + flash) vs everything forced onto the XLA reference paths."""
