@@ -1310,6 +1310,9 @@ def _heavy_row_registry():
         "e2e_paged_decode": lambda: __import__(
             "benchmarks.bench_paged_decode", fromlist=["run_bench"]
         ).run_bench(),
+        "e2e_mixed_prefill_decode": lambda: __import__(
+            "benchmarks.bench_mixed_prefill_decode", fromlist=["run_bench"]
+        ).run_bench(),
         "quant_quality": lambda: __import__(
             "benchmarks.quant_quality", fromlist=["quality_report"]
         ).quality_report(include_model_tier=False),
@@ -1626,6 +1629,10 @@ def main():
     # tentpole): sessions admitted (expected ~max_length/session_tokens x)
     # plus single-stream decode parity on the identity fast path
     row_sub("e2e_paged_decode", "paged KV decode", timeout=600.0)
+    # decode tok/s retention while a 2k prefill is in flight, mixed step vs
+    # the exclusive-chunk path (this round's tentpole): retention_mixed is
+    # the decode-never-starves number, >= 0.70 is the acceptance bar on chip
+    row_sub("e2e_mixed_prefill_decode", "mixed prefill+decode", timeout=600.0)
     # quantization quality table (VERDICT r3 #4): weight+activation error at
     # 7B shapes per format, so the serving default is re-derived every run
     row_sub("quant_quality", "quant quality")

@@ -113,6 +113,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Paged KV pool size in pages (default: batch_lanes * pages-per-lane, "
                              "i.e. no oversubscription; raise to admit more sessions than lanes "
                              "could hold at full length)")
+    parser.add_argument("--prefill_token_budget", type=int, default=512,
+                        help="Max prefill-chunk tokens folded into each mixed batched step "
+                             "(paged lanes only: prefills share the step with decode lanes "
+                             "instead of stalling them; halved under decode pressure)")
     parser.add_argument("--prefix_cache_bytes", type=int, default=256 * 2**20,
                         help="Host-RAM prompt-prefix cache budget; 0 disables")
     parser.add_argument("--no_server_side_generation", action="store_true",
@@ -214,6 +218,7 @@ def main(argv=None) -> None:
         batch_max_length=args.batch_max_length,
         page_size=args.page_size,
         n_pages=args.n_pages,
+        prefill_token_budget=args.prefill_token_budget,
         prefix_cache_bytes=args.prefix_cache_bytes,
         prefix_share_scope=args.prefix_share_scope,
         prefix_device_bytes=args.prefix_device_bytes,

@@ -101,6 +101,29 @@ def scatter_token_rows(
     return flat.reshape(pool.shape)
 
 
+def scatter_chunk_rows(
+    pool: jnp.ndarray, rows: jnp.ndarray, table_row: jnp.ndarray, positions: jnp.ndarray
+) -> jnp.ndarray:
+    """Write a prefill chunk's freshly computed KV rows into ONE lane's pages.
+
+    pool [n_pages, ps, hkv, d]; rows [chunk, hkv, d]; table_row [max_pages];
+    positions [chunk] int32 (absolute token positions; padded rows carry the
+    idle sentinel >= max_pages*ps). Invalid rows (sentinel position or
+    unallocated slot) route to the one-past-the-end flat index and drop —
+    the same convention as scatter_token_rows, just many rows into one lane."""
+    n_pages, page_size = pool.shape[0], pool.shape[1]
+    max_pages = table_row.shape[0]
+    slot = positions // page_size
+    in_range = (positions >= 0) & (slot < max_pages)
+    slot_c = jnp.clip(slot, 0, max_pages - 1)
+    page = jnp.take(table_row, slot_c)
+    valid = in_range & (page >= 0)
+    flat_idx = jnp.where(valid, page * page_size + positions % page_size, n_pages * page_size)
+    flat = pool.reshape(n_pages * page_size, *pool.shape[2:])
+    flat = flat.at[flat_idx].set(rows.astype(pool.dtype), mode="drop")
+    return flat.reshape(pool.shape)
+
+
 def scatter_lane_pages(
     pool: jnp.ndarray, lane_pages: jnp.ndarray, table_row: jnp.ndarray
 ) -> jnp.ndarray:
@@ -136,5 +159,37 @@ def paged_attend(
     pos = jnp.asarray(positions, jnp.int32)
     return attend_reference(
         q, k, v, q_offset=pos, kv_length=pos + q.shape[1],
+        alibi_slopes=alibi_slopes, sliding_window=sliding_window,
+    )
+
+
+def paged_prefill_attend(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    table_row: jnp.ndarray,
+    chunk_pos: jnp.ndarray,
+    n_valid: jnp.ndarray,
+    *,
+    alibi_slopes: Optional[jnp.ndarray] = None,
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Standalone ragged paged-PREFILL reference: causal attention for one
+    lane's variable-length chunk against that lane's block table, with the
+    chunk's KV already scattered into the pages (scatter_chunk_rows).
+
+    q [1, chunk, hq, d] (padded to a bucket); table_row [max_pages];
+    chunk_pos scalar int32 (absolute position of the chunk's first token);
+    n_valid scalar int32 (real tokens in the chunk; padded tail is masked
+    out via kv_length and produces garbage-but-unread outputs). The
+    production mixed step fuses this gather in front of the model family's
+    block code (server/backend.py _paged_mixed_step_fn); this entry point is
+    the kernel-level contract the mixed parity tests pin down."""
+    k = gather_pages(k_pool, table_row[None])
+    v = gather_pages(v_pool, table_row[None])
+    pos = jnp.asarray(chunk_pos, jnp.int32).reshape(1)
+    kv_len = pos + jnp.asarray(n_valid, jnp.int32).reshape(1)
+    return attend_reference(
+        q, k, v, q_offset=pos, kv_length=kv_len,
         alibi_slopes=alibi_slopes, sliding_window=sliding_window,
     )

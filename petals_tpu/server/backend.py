@@ -603,6 +603,171 @@ class TransformerBackend:
         return out, toks, (k_pool, v_pool)
 
     @functools.cached_property
+    def _paged_mixed_step_fn(self):
+        """Mixed prefill+decode step — the unified continuous-batching
+        program ("Ragged Paged Attention" folding, PAPERS.md): every decode
+        lane advances one token AND one lane runs a bucketed prefill chunk,
+        in a single jitted scan over the page pool. The decode half is
+        ``_paged_decode_fn``'s body verbatim; the prefill half gathers the
+        chunk lane's dense view from the pages, runs the SAME block compute
+        as the exclusive path (``_inference_step_fn`` at batch=1: scalar
+        position, bucket-padded chunk with n_valid scatter-drop, n_total for
+        longrope), and scatters only the chunk's freshly written KV rows back
+        — no lane extract/insert round-trip, so concurrent decode never
+        stalls behind a prefill. Decode runs first in each block body because
+        the contiguous fast path rewrites the whole pool by reshape; lanes'
+        pages are disjoint (the prefill lane's decode position is the idle
+        sentinel, so its decode-side write drops), so ordering is otherwise
+        immaterial."""
+        family, cfg = self.family, self.cfg
+        split_quant = self._split_quant
+        use_quant_consts = self._use_quant_consts
+        reattach = self._reattach_quant
+        takes_n_total = "n_total" in inspect.signature(family.block_apply).parameters
+
+        from petals_tpu.ops.paged_attention import (
+            gather_pages,
+            scatter_chunk_rows,
+            scatter_token_rows,
+        )
+
+        @functools.partial(
+            jax.jit, static_argnames=("contiguous",), donate_argnums=(1, 2)
+        )
+        def step(params, k_pool, v_pool, hidden, positions, tables,
+                 chunk_hidden, chunk_lane, chunk_pos, chunk_n_valid,
+                 chunk_n_total, *, contiguous: bool):
+            # hidden: [n_lanes, 1, hidden]; positions: [n_lanes] int32 (idle
+            # sentinel = max_len); chunk_hidden: [1, B, hidden] (B = static
+            # bucket); chunk_lane/chunk_pos/chunk_n_valid/chunk_n_total:
+            # int32 scalars describing the ONE prefill chunk riding this step
+            n_lanes, max_pages = tables.shape
+            page_size = k_pool.shape[2]
+            max_len = max_pages * page_size
+            B = chunk_hidden.shape[1]
+            hidden = hidden.astype(k_pool.dtype)
+            chunk_hidden = chunk_hidden.astype(k_pool.dtype)
+            table_row = jnp.take(tables, chunk_lane, axis=0)  # [max_pages]
+            offs = jnp.arange(B, dtype=jnp.int32)
+            # rows to read back out of the updated lane view (clip keeps the
+            # take in-bounds for the padded tail; those rows drop anyway)
+            chunk_rows = jnp.clip(chunk_pos + offs, 0, max_len - 1)
+            # rows to write into the pages: padded tail -> sentinel -> drop
+            chunk_write = jnp.where(offs < chunk_n_valid, chunk_pos + offs, max_len)
+            if use_quant_consts:
+                dense_params, quant_params, outlier_names = split_quant(params)
+                xs_params = dense_params
+                block_indices = jnp.arange(k_pool.shape[0], dtype=jnp.int32)
+            else:
+                xs_params = params
+                block_indices = jnp.zeros((k_pool.shape[0],), jnp.int32)  # unused
+
+            def body(carry, xs):
+                h_dec, h_pf = carry
+                p_block, k_blk, v_blk, block_idx = xs
+                if use_quant_consts:
+                    p_block = reattach(p_block, quant_params, outlier_names, block_idx)
+                # --- decode half (== _paged_decode_fn body)
+                if contiguous:
+                    k_dense = k_blk.reshape(n_lanes, max_len, *k_blk.shape[2:])
+                    v_dense = v_blk.reshape(n_lanes, max_len, *v_blk.shape[2:])
+                else:
+                    k_dense = gather_pages(k_blk, tables)
+                    v_dense = gather_pages(v_blk, tables)
+                out_dec, (k_new, v_new) = family.block_apply(
+                    p_block, h_dec, (k_dense, v_dense), positions, cfg,
+                    use_flash=False, tp_mesh=None,
+                )
+                if contiguous:
+                    k_blk = k_new.reshape(k_blk.shape)
+                    v_blk = v_new.reshape(v_blk.shape)
+                else:
+                    lanes = jnp.arange(n_lanes, dtype=jnp.int32)
+                    row = jnp.clip(positions, 0, max_len - 1)
+                    k_blk = scatter_token_rows(k_blk, k_new[lanes, row], tables, positions)
+                    v_blk = scatter_token_rows(v_blk, v_new[lanes, row], tables, positions)
+                # --- prefill half: dense lane view -> block compute -> the
+                # chunk's rows scatter back through the lane's table row
+                k_lane = gather_pages(k_blk, table_row[None])
+                v_lane = gather_pages(v_blk, table_row[None])
+                extra = {"n_total": chunk_n_total} if takes_n_total else {}
+                out_pf, (k_all, v_all) = family.block_apply(
+                    p_block, h_pf, (k_lane, v_lane), chunk_pos, cfg,
+                    use_flash=False, n_valid=chunk_n_valid, tp_mesh=None, **extra,
+                )
+                k_blk = scatter_chunk_rows(
+                    k_blk, jnp.take(k_all[0], chunk_rows, axis=0), table_row, chunk_write
+                )
+                v_blk = scatter_chunk_rows(
+                    v_blk, jnp.take(v_all[0], chunk_rows, axis=0), table_row, chunk_write
+                )
+                return (out_dec, out_pf), (k_blk, v_blk)
+
+            (hidden, chunk_out), (k_pool, v_pool) = jax.lax.scan(
+                body, (hidden, chunk_hidden),
+                (xs_params, k_pool, v_pool, block_indices),
+            )
+            return hidden, chunk_out, k_pool, v_pool
+
+        return step
+
+    def paged_mixed_step(self, hidden, pool_kv, positions, tables,
+                         chunk_hidden, chunk_lane, chunk_pos, *,
+                         n_total=None, handles=None, contiguous=None):
+        """One coalesced mixed step: every decode lane (1 token each) plus
+        ONE prefill chunk for ``chunk_lane``, in a single jitted program.
+
+        Args:
+          hidden: [n_lanes, 1, hidden] (idle lanes: any finite filler).
+          pool_kv: (k, v) page pools [n_blocks, n_pages, page_size, hkv, d].
+          positions: int32 [n_lanes]; idle sentinel = max_pages * page_size.
+            The chunk lane must carry the sentinel here — its tokens ride the
+            prefill half, not the decode half.
+          tables: int32 [n_lanes, max_pages] block tables (-1 unallocated).
+          chunk_hidden: [1, seq, hidden], unpadded; bucket padding (and the
+            matching n_valid) happens here so callers stay shape-oblivious.
+          chunk_lane / chunk_pos: which lane, and the chunk's first absolute
+            token position.
+          n_total: final sequence length when known up front (longrope factor
+            selection — same contract as inference_step); defaults to
+            chunk_pos + seq.
+
+        Returns (decode_out [n_lanes, 1, h], chunk_out [1, seq, h], pool_kv).
+        """
+        from petals_tpu.ops.paged_attention import tables_are_contiguous
+
+        k_pool, v_pool = pool_kv
+        tables = np.asarray(tables, np.int32)
+        if contiguous is None:
+            contiguous = tables_are_contiguous(tables, k_pool.shape[1])
+        if not isinstance(hidden, jax.Array):
+            hidden = np.ascontiguousarray(hidden)
+        seq = chunk_hidden.shape[1]
+        bucket = bucket_length(seq)
+        if not isinstance(chunk_hidden, jax.Array):
+            chunk_hidden = np.ascontiguousarray(chunk_hidden)
+            if bucket != seq:
+                chunk_hidden = np.pad(
+                    chunk_hidden, ((0, 0), (0, bucket - seq), (0, 0))
+                )
+        elif bucket != seq:
+            chunk_hidden = jnp.pad(
+                chunk_hidden, ((0, 0), (0, bucket - seq), (0, 0))
+            )
+        if n_total is None:
+            n_total = int(chunk_pos) + seq
+        with self._quant_ctx():
+            out, chunk_out, k_pool, v_pool = self._paged_mixed_step_fn(
+                self.params, k_pool, v_pool, hidden,
+                np.asarray(positions, np.int32), tables, chunk_hidden,
+                np.int32(chunk_lane), np.int32(chunk_pos), np.int32(seq),
+                np.int32(n_total), contiguous=bool(contiguous),
+            )
+        if chunk_out.shape[1] != seq:
+            chunk_out = chunk_out[:, :seq]
+        return out, chunk_out, (k_pool, v_pool)
+
+    @functools.cached_property
     def _paged_lane_gather_fn(self):
         """Assemble one lane's dense session-shaped view [n_blocks, 1,
         max_len, hkv, d] from its block-table row — the paged stand-in for
@@ -1141,11 +1306,18 @@ class TransformerBackend:
             arr = self._dummy_operands[key] = jnp.zeros(shape, dtype)
         return arr
 
-    def chunk_plan(self, batch: int, total_seq: int, kv_buf_len: int = None) -> Sequence[int]:
+    def chunk_plan(self, batch: int, total_seq: int, kv_buf_len: int = None,
+                   page_size: int = None, start: int = 0) -> Sequence[int]:
         """Split a long prefill so each chunk's attention footprint stays under
         max_chunk_size_bytes (reference backend.py:126-152 semantics). Public:
         the continuous batcher plans queue-task boundaries with it, so the
-        chunk policy lives here in exactly one place."""
+        chunk policy lives here in exactly one place.
+
+        ``page_size`` (paged lanes): chunk ENDS are aligned to absolute page
+        boundaries — each chunk's KV scatter is whole-page writes, with a
+        partial tail page only on the final chunk. ``start`` is the absolute
+        position of the first token (alignment is in absolute positions, so
+        an unaligned start self-corrects after the first chunk)."""
         if total_seq <= 1:
             return [total_seq]
         # The linear sizing below is only sound when the flash kernel will
@@ -1172,10 +1344,20 @@ class TransformerBackend:
             max_chunk = max(self.max_chunk_size_bytes // denom, 1)
         chunks = []
         remaining = total_seq
+        pos = int(start)
         while remaining > 0:
             step = min(max_chunk, remaining)
+            if page_size and step < remaining:
+                # align this chunk's end DOWN to an absolute page boundary
+                # (whole-page scatters); keep the unaligned step when the
+                # boundary is out of reach (max_chunk < one page span)
+                end = pos + step
+                aligned = end - end % page_size
+                if aligned > pos:
+                    step = aligned - pos
             chunks.append(step)
             remaining -= step
+            pos += step
         return chunks
 
     def forward(

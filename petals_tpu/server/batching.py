@@ -74,6 +74,26 @@ class _LaneGenState:
     seen: Optional[np.ndarray] = None  # [vocab] bool; only when penalty active
 
 
+@dataclasses.dataclass
+class _LanePrefillState:
+    """Host-side bookkeeping for one lane's admitted prefill: the flush loop
+    feeds one bucketed chunk per mixed step (round-robin across admitted
+    prefills, bounded by the per-tick token budget) until ``offset`` reaches
+    the full length, then resolves ``future`` with the concatenated span
+    outputs. Pages for the WHOLE range were prepared at admission, so the
+    flush loop never blocks on allocation mid-prefill."""
+
+    future: asyncio.Future
+    generation: int
+    lane: int
+    hidden: np.ndarray  # [1, total, hidden] host-side
+    position: int  # absolute position of the next unfed token
+    offset: int  # tokens already fed
+    cap: int  # per-step chunk cap (chunk_plan byte sizing)
+    n_total: int  # final sequence length (longrope factor selection)
+    outs: List[np.ndarray]
+
+
 class DecodeBatcher:
     """Shared-pool continuous batcher for one backend (one span of blocks)."""
 
@@ -89,6 +109,7 @@ class DecodeBatcher:
         gen_params=None,  # full-model client leaves: enables pooled server-gen
         page_size: Optional[int] = None,  # None/0 -> dense lane pool (legacy)
         n_pages: Optional[int] = None,  # default: n_lanes * max_pages (no oversub)
+        prefill_token_budget: int = 512,  # max prefill-chunk tokens per mixed step
     ):
         self.backend = backend
         self.memory_cache = memory_cache
@@ -126,6 +147,11 @@ class DecodeBatcher:
         # per flush-loop iteration alongside (and batched WITH) ordinary
         # per-token decode traffic
         self._gen_states: Dict[int, _LaneGenState] = {}
+        # paged-lane prefills admitted into the MIXED step (prefill_lane):
+        # one bucketed chunk rides each flush tick, round-robin, so decode
+        # lanes keep stepping while prefills stream in
+        self._prefill_queue: List[_LanePrefillState] = []
+        self.prefill_token_budget = max(int(prefill_token_budget), 1)
 
         self._pool_stack: Optional[contextlib.AsyncExitStack] = None
         self._handles = None
@@ -151,10 +177,15 @@ class DecodeBatcher:
         # MemoryCache's non-negative handles)
         self._lockstep = bool(getattr(backend, "is_lockstep", False))
         self._temp_ids = itertools.count(-2, -1)
-        # observability + tests: how many device steps served how many tokens
+        # observability + tests: how many device steps served how many tokens.
+        # EVERY key is pre-initialized — rpc_info spreads this dict into the
+        # health summary, and lazily created keys made the schema depend on
+        # which code paths had run
         self.stats = {
             "batched_steps": 0, "batched_tokens": 0, "max_batch": 0,
             "gen_steps": 0, "gen_lane_tokens": 0, "max_gen_lanes": 0,
+            "exclusive_chunks": 0, "prefill_tokens": 0, "mixed_steps": 0,
+            "max_prefill_tokens_per_step": 0,
         }
 
     # ------------------------------------------------------------------ pool
@@ -225,6 +256,10 @@ class DecodeBatcher:
             if not st.future.done():
                 st.future.set_exception(AllocationFailed("Batcher is shutting down"))
         self._gen_states.clear()
+        for pst in self._prefill_queue:
+            if not pst.future.done():
+                pst.future.set_exception(AllocationFailed("Batcher is shutting down"))
+        self._prefill_queue.clear()
         if self._pool_stack is not None:
             await self._pool_stack.aclose()
             self._pool_stack = None
@@ -309,6 +344,12 @@ class DecodeBatcher:
         st = self._gen_states.pop(lane, None)
         if st is not None and not st.future.done():
             st.future.set_exception(AllocationFailed("Lane released mid-step"))
+        # ...and a mid-prefill release: the remaining chunks must never run
+        # against a lane now owned by someone else
+        for pst in [p for p in self._prefill_queue if p.lane == lane]:
+            self._prefill_queue.remove(pst)
+            if not pst.future.done():
+                pst.future.set_exception(AllocationFailed("Lane released mid-step"))
         self._lane_generation.pop(lane, None)
         # paged mode: drop this lane's table references — pages whose refcount
         # hits zero (no prefix-cache pin) return to the pool and wake any
@@ -492,7 +533,7 @@ class DecodeBatcher:
         return await fut
 
     async def _flush_loop(self) -> None:
-        while self._pending or self._gen_states:
+        while self._pending or self._gen_states or self._prefill_queue:
             batch, self._pending = self._pending, []
             # entries enqueued before a pool reset must fail loudly — running
             # them against the rematerialized (zeroed) pool would be the
@@ -512,22 +553,43 @@ class DecodeBatcher:
                         st.future.set_exception(AllocationFailed(
                             "Lane pool was reset while this step was pending"
                         ))
+            # ...and for admitted prefills
+            for pst in [p for p in self._prefill_queue if p.generation != self._generation]:
+                self._prefill_queue.remove(pst)
+                if not pst.future.done():
+                    pst.future.set_exception(AllocationFailed(
+                        "Lane pool was reset while this step was pending"
+                    ))
             gen_states = dict(self._gen_states)
-            if not batch and not gen_states:
+            pf = self._next_prefill_chunk(len(batch) + len(gen_states))
+            if not batch and not gen_states and pf is None:
                 continue
             try:
+                toks = chunk_out = None
                 if gen_states:
                     out, toks = await self.queue.submit(
                         self._run_batch_gen, batch, gen_states,
                         priority=PRIORITY_INFERENCE,
                         size=len(batch) + len(gen_states),
                     )
+                    if pf is not None:
+                        # the gen program has no prefill half: the chunk rides
+                        # its own mixed step this tick (decode entries already
+                        # ran above, so neither side starves the other)
+                        _, chunk_out = await self.queue.submit(
+                            self._run_batch_mixed, [], pf,
+                            priority=PRIORITY_INFERENCE, size=pf[1],
+                        )
+                elif pf is not None:
+                    out, chunk_out = await self.queue.submit(
+                        self._run_batch_mixed, batch, pf,
+                        priority=PRIORITY_INFERENCE, size=len(batch) + pf[1],
+                    )
                 else:
                     out = await self.queue.submit(
                         self._run_batch, batch, priority=PRIORITY_INFERENCE,
                         size=len(batch),
                     )
-                    toks = None
             except BaseException as e:  # noqa: BLE001 — deliver to every waiter
                 for *_, fut, _gen in batch:
                     if not fut.done():
@@ -537,11 +599,19 @@ class DecodeBatcher:
                         del self._gen_states[lane]
                     if not st.future.done():
                         st.future.set_exception(e)
+                if pf is not None:
+                    pst = pf[0]
+                    if pst in self._prefill_queue:
+                        self._prefill_queue.remove(pst)
+                    if not pst.future.done():
+                        pst.future.set_exception(e)
                 self._maybe_reset_pool()
                 continue
             for lane, _, _, fut, _gen in batch:
                 if not fut.done():
                     fut.set_result(out[lane : lane + 1])
+            if pf is not None and chunk_out is not None:
+                self._advance_prefill(pf[0], pf[1], chunk_out)
             if toks is None:
                 continue
             # per-lane post-step bookkeeping (event-loop side, no races with
@@ -564,6 +634,101 @@ class DecodeBatcher:
                         st.future.set_result(
                             np.asarray([st.collected], np.int32)
                         )
+
+    def _prefill_budget(self, n_decode: int) -> int:
+        """Per-tick fairness: the prefill token budget shrinks under decode
+        pressure (more than half the lanes actively stepping), but never
+        below one page — prefills always make progress, and decode lanes
+        never wait on more than one bounded chunk per tick."""
+        budget = self.prefill_token_budget
+        if n_decode > max(1, self.n_lanes // 2):
+            budget = max(self.page_size or 1, budget // 2)
+        return budget
+
+    def _next_prefill_chunk(self, n_decode: int) -> Optional[tuple]:
+        """Pick the chunk riding this tick: the queue head's next ``take``
+        tokens, capped by the byte-sized chunk cap and the fairness budget,
+        with the chunk END aligned to an absolute page boundary unless it is
+        the prefill's final chunk (whole-page scatters — satellite of
+        backend.chunk_plan's page alignment). Returns (state, take) or None."""
+        if not self._prefill_queue:
+            return None
+        st = self._prefill_queue[0]
+        remaining = st.hidden.shape[1] - st.offset
+        take = min(remaining, st.cap, self._prefill_budget(n_decode))
+        if self.page_size and take < remaining:
+            end = st.position + take
+            aligned = end - end % self.page_size
+            if aligned > st.position:
+                take = aligned - st.position
+        return st, max(int(take), 1)
+
+    def _advance_prefill(self, st: _LanePrefillState, take: int, chunk_out) -> None:
+        """Post-step bookkeeping (event-loop side): collect the chunk's span
+        output, advance the cursor, resolve finished prefills, and rotate the
+        queue so concurrent prefills share the budget round-robin."""
+        if st not in self._prefill_queue:
+            return  # released/cancelled while the step ran
+        st.outs.append(np.asarray(chunk_out))
+        st.offset += take
+        st.position += take
+        if st.offset >= st.hidden.shape[1]:
+            self._prefill_queue.remove(st)
+            if not st.future.done():
+                out = (
+                    st.outs[0] if len(st.outs) == 1
+                    else np.concatenate(st.outs, axis=1)
+                )
+                st.future.set_result(out)
+        elif len(self._prefill_queue) > 1:
+            self._prefill_queue.append(self._prefill_queue.pop(0))
+
+    async def prefill_lane(
+        self, lane: int, hidden: np.ndarray, position: int
+    ) -> np.ndarray:
+        """Admit a multi-token prefill (hidden [1, seq, hidden]) for a PAGED
+        lane into the mixed-step queue: pages for the whole range are
+        allocated up front (this await is the only blocking point), then the
+        flush loop feeds one bucketed, page-aligned chunk per tick alongside
+        every pending decode lane — one jitted program per tick, no lane
+        extract/insert, no stop-the-world chunks (contrast
+        run_exclusive_chunks, which remains the dense-pool fallback).
+        Returns the span output for the whole range, token-identical to the
+        exclusive path."""
+        if self.page_size is None:
+            raise RuntimeError("prefill_lane requires the paged lane pool")
+        self._check_lane(lane)
+        total = int(hidden.shape[1])
+        position = int(position)
+        if position + total > self.max_length:
+            raise ValueError(
+                f"Prefill of {total} tokens at position {position} overflows "
+                f"the lane buffer ({self.max_length} tokens)"
+            )
+        await self.prepare_write(lane, position, position + total)
+        plan = self.backend.chunk_plan(
+            1, total, kv_buf_len=self.max_length,
+            page_size=self.page_size, start=position,
+        )
+        st = _LanePrefillState(
+            future=asyncio.get_running_loop().create_future(),
+            generation=self._lane_generation[lane],
+            lane=lane,
+            hidden=np.ascontiguousarray(np.asarray(hidden, np.float32)),
+            position=position,
+            offset=0,
+            cap=int(max(plan)),
+            n_total=position + total,
+            outs=[],
+        )
+        self._prefill_queue.append(st)
+        if self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.create_task(self._flush_loop())
+        try:
+            return await st.future
+        finally:
+            if st in self._prefill_queue:
+                self._prefill_queue.remove(st)
 
     async def generate_lane(
         self, lane: int, last_hidden: np.ndarray, position: int,
@@ -736,6 +901,46 @@ class DecodeBatcher:
         self.stats["batched_tokens"] += len(batch)
         self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
         return host_out
+
+    def _run_batch_mixed(self, batch, pf) -> Tuple[np.ndarray, np.ndarray]:
+        """Compute-thread body: ONE jitted step advancing every pending
+        decode lane AND one prefill chunk together (backend.paged_mixed_step).
+        The prefill lane rides the decode half at the idle sentinel, so its
+        decode-side write drops; its tokens ride the prefill half."""
+        st, take = pf
+        expected = batch[0][4] if batch else st.generation
+        if expected != self._generation or st.generation != self._generation:
+            raise AllocationFailed("Lane pool was reset before this batched step ran")
+        hsz = self.backend.hidden_size
+        hidden = np.zeros((self.n_lanes, 1, hsz), np.float32)
+        positions = np.full((self.n_lanes,), self.max_length, np.int32)  # idle sentinel
+        for lane, h, pos, _fut, _gen in batch:
+            hidden[lane] = np.asarray(h, np.float32).reshape(1, hsz)
+            positions[lane] = pos
+        chunk = st.hidden[:, st.offset : st.offset + take]
+        k_pool, v_pool = self._buffers()
+        out, chunk_out, (k_pool, v_pool) = self.backend.paged_mixed_step(
+            hidden, (k_pool, v_pool), positions, self._tables.copy(),
+            chunk, st.lane, st.position, n_total=st.n_total,
+            handles=self._handles,
+        )
+        host_out = np.asarray(out)  # device sync: the step has fully executed
+        host_chunk = np.asarray(chunk_out)
+        with self._reset_lock:
+            if expected != self._generation:
+                # see _run_batch: checked atomically with the swap so a reset
+                # landing mid-step leaves the freshly zeroed pool in place
+                raise AllocationFailed("Lane pool was reset while this batched step ran")
+            self._update(k_pool, v_pool)
+        self.stats["batched_steps"] += 1
+        self.stats["batched_tokens"] += len(batch)
+        self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
+        self.stats["mixed_steps"] += 1
+        self.stats["prefill_tokens"] += take
+        self.stats["max_prefill_tokens_per_step"] = max(
+            self.stats["max_prefill_tokens_per_step"], take
+        )
+        return host_out, host_chunk
 
     def _run_batch_gen(self, batch, gen_states) -> Tuple[np.ndarray, np.ndarray]:
         """Compute-thread body: one jitted step advancing every pending decode
@@ -962,7 +1167,7 @@ class DecodeBatcher:
                 def run_chunk(fn=fn):
                     self._check_lane(lane)
                     res, state["kv"] = fn(state["kv"], state["temp"])
-                    self.stats["exclusive_chunks"] = self.stats.get("exclusive_chunks", 0) + 1
+                    self.stats["exclusive_chunks"] += 1
                     return res
 
                 try:

@@ -64,6 +64,7 @@ class TransformerHandler:
         batch_max_length: Optional[int] = None,  # pool lane length (tokens)
         page_size: Optional[int] = None,  # paged KV: tokens per page; None/0 = dense pool
         n_pages: Optional[int] = None,  # paged KV pool size; None = lanes * max_pages
+        prefill_token_budget: int = 512,  # prefill tokens per mixed batched step
         prefix_cache_bytes: int = 256 * 2**20,  # 0 disables prefix caching
         prefix_share_scope: str = "swarm",  # "swarm" shares across clients; "peer" salts per client
         prefix_device_bytes: int = 256 * 2**20,  # HBM tier of the prefix cache; 0 disables
@@ -115,6 +116,7 @@ class TransformerHandler:
                 gen_params=server_gen_params,
                 page_size=page_size,
                 n_pages=n_pages,
+                prefill_token_budget=prefill_token_budget,
             )
 
         # Content-addressed prefix cache (server/prefix_cache.py): sessions
@@ -175,6 +177,7 @@ class TransformerHandler:
                 gen_params=self.server_gen_params,
                 page_size=old.page_size,
                 n_pages=old.n_pages or None,
+                prefill_token_budget=old.prefill_token_budget,
             )
             await old.close()
 
@@ -824,6 +827,7 @@ class TransformerHandler:
             info["continuous_batching"] = {
                 "lanes": self.batcher.n_lanes,
                 "max_length": self.batcher.max_length,
+                "prefill_token_budget": self.batcher.prefill_token_budget,
                 **self.batcher.stats,
             }
             paged = self.batcher.paged_summary()
@@ -1134,11 +1138,26 @@ class TransformerHandler:
                         out = await asyncio.wait_for(
                             batcher.step(lane, hidden, pos), self.step_timeout
                         )
+                    elif (
+                        lane is not None and prompts is None and hypo_ids is None
+                        and batcher.page_size is not None
+                    ):
+                        # paged-lane prefill: admitted into the MIXED step —
+                        # each tick advances every decoding lane AND one
+                        # bucketed chunk of this prefill in ONE jitted
+                        # program over the page pool (no lane extract/insert,
+                        # no stop-the-world chunks)
+                        out = await asyncio.wait_for(
+                            batcher.prefill_lane(lane, exec_hidden, pos),
+                            self.step_timeout,
+                        )
                     elif lane is not None and prompts is None and hypo_ids is None:
-                        # pooled long prefill: each chunk is its OWN queue
-                        # task, so other sessions' batched decode steps
-                        # interleave between chunks instead of stalling for
-                        # the whole prefill (Sarathi-style)
+                        # pooled long prefill on the DENSE pool (and the
+                        # TP/lockstep spans, which gate paged mode off): each
+                        # chunk is its OWN queue task, so other sessions'
+                        # batched decode steps interleave between chunks
+                        # instead of stalling for the whole prefill
+                        # (Sarathi-style)
                         chunk_fns = []
                         off = 0
                         for clen in backend.chunk_plan(

@@ -88,6 +88,7 @@ class Server:
         batch_max_length: Optional[int] = None,  # pool lane length; None: min(inference_max_length, 1024)
         page_size: int = 64,  # paged KV: tokens per page; 0 = dense lane pool
         n_pages: Optional[int] = None,  # paged KV pool size; None = lanes * pages-per-lane
+        prefill_token_budget: int = 512,  # prefill tokens folded into each mixed batched step
         prefix_cache_bytes: int = 256 * 2**20,  # host-RAM prompt-prefix cache; 0 disables
         prefix_share_scope: str = "swarm",  # "peer" isolates the prefix cache per client identity
         prefix_device_bytes: int = 256 * 2**20,  # HBM tier of the prefix cache; 0 disables
@@ -189,6 +190,7 @@ class Server:
         self.batch_max_length = batch_max_length
         self.page_size = page_size
         self.n_pages = n_pages
+        self.prefill_token_budget = prefill_token_budget
         self.prefix_cache_bytes = prefix_cache_bytes
         self.prefix_share_scope = prefix_share_scope
         self.prefix_device_bytes = prefix_device_bytes
@@ -627,6 +629,7 @@ class Server:
             batch_max_length=batch_max_length,
             page_size=self.page_size or None,
             n_pages=self.n_pages,
+            prefill_token_budget=self.prefill_token_budget,
             prefix_cache_bytes=self.prefix_cache_bytes,
             prefix_share_scope=self.prefix_share_scope,
             prefix_device_bytes=self.prefix_device_bytes,

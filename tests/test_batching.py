@@ -201,11 +201,14 @@ def test_lane_pressure_fallback(model_path):
 def test_prefill_interleaves_with_decode(model_path):
     """Sarathi-style chunked-prefill interleaving: a long prefill runs as one
     queue task per chunk, so a concurrent session's decode steps complete
-    BETWEEN chunks instead of stalling for the whole prefill."""
+    BETWEEN chunks instead of stalling for the whole prefill. Pinned to the
+    dense lane pool (page_size=0): paged lanes route prefills through the
+    mixed batched step instead (tests/test_mixed_batching.py covers it),
+    and this exclusive-chunk path is their dense/TP/lockstep fallback."""
 
     async def main():
         server, client = await _start_server(
-            model_path, batching=True, max_chunk_size_bytes=4096,
+            model_path, batching=True, max_chunk_size_bytes=4096, page_size=0,
         )
         try:
             cfg = server.cfg
