@@ -1313,6 +1313,9 @@ def _heavy_row_registry():
         "e2e_mixed_prefill_decode": lambda: __import__(
             "benchmarks.bench_mixed_prefill_decode", fromlist=["run_bench"]
         ).run_bench(),
+        "e2e_preemption_oversubscription": lambda: __import__(
+            "benchmarks.bench_preemption", fromlist=["run_bench"]
+        ).run_bench(),
         "quant_quality": lambda: __import__(
             "benchmarks.quant_quality", fromlist=["quality_report"]
         ).quality_report(include_model_tier=False),

@@ -117,6 +117,16 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Max prefill-chunk tokens folded into each mixed batched step "
                              "(paged lanes only: prefills share the step with decode lanes "
                              "instead of stalling them; halved under decode pressure)")
+    parser.add_argument("--swap_host_bytes", type=int, default=0,
+                        help="Host-RAM KV swap tier for session preemption (paged lanes only): "
+                             "on pool exhaustion an idle victim session's pages are copied to "
+                             "host RAM and freed, then transparently swapped back in on its "
+                             "next step; 0 disables (full pool keeps the fail-at-timeout "
+                             "backpressure behavior)")
+    parser.add_argument("--preemption_policy", choices=["lru", "largest", "off"], default="lru",
+                        help="Victim choice on pool exhaustion: 'lru' = lowest priority class "
+                             "then least-recently-stepped; 'largest' = lowest class then most "
+                             "pages held; 'off' disables preemption")
     parser.add_argument("--prefix_cache_bytes", type=int, default=256 * 2**20,
                         help="Host-RAM prompt-prefix cache budget; 0 disables")
     parser.add_argument("--no_server_side_generation", action="store_true",
@@ -219,6 +229,8 @@ def main(argv=None) -> None:
         page_size=args.page_size,
         n_pages=args.n_pages,
         prefill_token_budget=args.prefill_token_budget,
+        swap_host_bytes=args.swap_host_bytes,
+        preemption_policy=args.preemption_policy,
         prefix_cache_bytes=args.prefix_cache_bytes,
         prefix_share_scope=args.prefix_share_scope,
         prefix_device_bytes=args.prefix_device_bytes,

@@ -31,6 +31,12 @@ class ClientConfig:
 
     use_server_to_server: bool = True  # direct server->server activation push
 
+    # optional scheduling-priority hint ("high" | "normal" | "low") sent in
+    # the session-open message; servers running the session scheduler admit
+    # higher classes first and preempt lower ones under memory pressure.
+    # None sends no hint (the server treats the session as "normal").
+    session_priority: Optional[str] = None
+
     # wire compression for activations we SEND and the compression we REQUEST
     # for server replies ("none" | "float16" | "bfloat16" | "qint8");
     # reference clients negotiate this per request (handler.py:411-432)
@@ -53,3 +59,7 @@ class ClientConfig:
         from petals_tpu.rpc.serialization import CompressionType
 
         CompressionType(self.compression)  # fail at construction, not mid-session
+        if self.session_priority is not None:
+            from petals_tpu.data_structures import parse_session_priority
+
+            parse_session_priority(self.session_priority)  # same: fail early

@@ -90,6 +90,11 @@ class _ServerInferenceSession:
             open_msg["session_id"] = session_id
         if push_to:
             open_msg["push_to"] = push_to
+        # optional scheduling-priority hint; absent -> the server's default
+        # ("normal"), so old servers and default configs behave identically
+        priority = getattr(seq_manager.config, "session_priority", None)
+        if priority is not None:
+            open_msg["priority"] = priority
         await stream.send(open_msg)
         ack = await stream.recv(timeout=step_timeout)
         assert ack.get("session_open"), f"Unexpected open reply: {ack}"

@@ -100,6 +100,39 @@ class PeerID:
 
 
 # --------------------------------------------------------------------------------------
+# Session priority classes (server/scheduler.py admission + preemption)
+# --------------------------------------------------------------------------------------
+
+# Lower value = more important. Travels as the optional "priority" field of the
+# inference session-open message; servers without a scheduler ignore it.
+SESSION_PRIORITY_HIGH = 0
+SESSION_PRIORITY_NORMAL = 1
+SESSION_PRIORITY_LOW = 2
+SESSION_PRIORITIES: Dict[str, int] = {
+    "high": SESSION_PRIORITY_HIGH,
+    "normal": SESSION_PRIORITY_NORMAL,
+    "low": SESSION_PRIORITY_LOW,
+}
+
+
+def parse_session_priority(value: Any, default: int = SESSION_PRIORITY_NORMAL) -> int:
+    """Normalize a client-supplied priority hint ("high"/"normal"/"low" or an
+    int) to a priority class; absent -> ``default`` (current behavior)."""
+    if value is None:
+        return default
+    if isinstance(value, bool):
+        raise ValueError(f"Invalid session priority {value!r}")
+    if isinstance(value, int):
+        return min(max(value, SESSION_PRIORITY_HIGH), SESSION_PRIORITY_LOW)
+    if isinstance(value, str) and value.lower() in SESSION_PRIORITIES:
+        return SESSION_PRIORITIES[value.lower()]
+    raise ValueError(
+        f"Invalid session priority {value!r} (expected one of "
+        f"{sorted(SESSION_PRIORITIES)} or an integer class)"
+    )
+
+
+# --------------------------------------------------------------------------------------
 # Server records (reference data_structures.py:33-104)
 # --------------------------------------------------------------------------------------
 
@@ -145,6 +178,11 @@ class ServerInfo:
     # "gen_sampling" request field; see rpc/protocol.validate_gen_sampling).
     # Separate flag so old clients on mixed swarms keep gating correctly.
     server_gen_sampling: Optional[bool] = None
+    # lane-pool / scheduler occupancy (busy lanes, free pages, suspended
+    # sessions, swap bytes, preemption count — server/batching.py
+    # occupancy_info) so clients and the health monitor can route around
+    # loaded servers; None on servers without continuous batching
+    pool: Optional[Dict[str, Any]] = None
 
     def to_tuple(self) -> Tuple[int, float, dict]:
         extra_info = dataclasses.asdict(self)

@@ -809,6 +809,37 @@ class TransformerBackend:
         return f
 
     @functools.cached_property
+    def _swap_out_pages_fn(self):
+        """Gather an explicit page list out of the pool as [n_blocks, n_slots,
+        page_size, hkv, d] pairs, bound for the host swap tier (scheduler
+        preemption). Non-donating: the pool stays live — the pages are only
+        FREED once the host copy has landed (server/batching.py
+        _swap_out_lane validates the lane generation first)."""
+
+        @jax.jit
+        def f(k_pool, v_pool, pages):
+            return jnp.take(k_pool, pages, axis=1), jnp.take(v_pool, pages, axis=1)
+
+        return f
+
+    @functools.cached_property
+    def _swap_in_pages_fn(self):
+        """Scatter swapped-out page contents back into the pool on a FRESH
+        page list (block tables make relocation free). The donating twin of
+        ``_swap_out_pages_fn``; negative entries drop, mirroring
+        ``_paged_lane_scatter_fn``."""
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def f(k_pool, v_pool, k_pages, v_pages, pages):
+            n_pages = k_pool.shape[1]
+            safe = jnp.where(pages >= 0, pages, n_pages)
+            k_pool = k_pool.at[:, safe].set(k_pages.astype(k_pool.dtype), mode="drop")
+            v_pool = v_pool.at[:, safe].set(v_pages.astype(v_pool.dtype), mode="drop")
+            return k_pool, v_pool
+
+        return f
+
+    @functools.cached_property
     def _copy_page_fn(self):
         """Duplicate one page across all blocks of the pool (the copy-on-write
         fork: a shared page must be copied before a lane writes into it)."""

@@ -43,8 +43,15 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from petals_tpu.data_structures import SESSION_PRIORITY_NORMAL
 from petals_tpu.ops.sampling import sampling_vectors
-from petals_tpu.server.memory_cache import AllocationFailed, MemoryCache, PageAllocator
+from petals_tpu.server.memory_cache import (
+    AllocationFailed,
+    HostSwapPool,
+    MemoryCache,
+    PageAllocator,
+)
+from petals_tpu.server.scheduler import SessionScheduler, SwapEntry
 from petals_tpu.server.task_queue import PRIORITY_INFERENCE, PriorityTaskQueue
 from petals_tpu.utils.logging import get_logger
 
@@ -94,6 +101,18 @@ class _LanePrefillState:
     outs: List[np.ndarray]
 
 
+@dataclasses.dataclass
+class _LaneWaiter:
+    """One parked acquire_lane caller. Admission order is a POLICY decision
+    (scheduler.pick_waiter): priority class first, then per-peer fair share,
+    then ``seq`` — which alone reproduces the old FIFO at default priority."""
+
+    fut: asyncio.Future
+    priority: int
+    peer_id: Optional[str]
+    seq: int
+
+
 class DecodeBatcher:
     """Shared-pool continuous batcher for one backend (one span of blocks)."""
 
@@ -110,6 +129,8 @@ class DecodeBatcher:
         page_size: Optional[int] = None,  # None/0 -> dense lane pool (legacy)
         n_pages: Optional[int] = None,  # default: n_lanes * max_pages (no oversub)
         prefill_token_budget: int = 512,  # max prefill-chunk tokens per mixed step
+        swap_host_bytes: int = 0,  # host-RAM KV swap tier; 0 -> no preemption
+        preemption_policy: str = "lru",  # lru | largest | off
     ):
         self.backend = backend
         self.memory_cache = memory_cache
@@ -166,8 +187,27 @@ class DecodeBatcher:
         self._reset_lock = threading.Lock()
         self._lane_generation: Dict[int, int] = {}
         self._free_lanes: List[int] = []
-        self._lane_waiters: List[asyncio.Future] = []
+        self._lane_waiters: List[_LaneWaiter] = []
+        self._waiter_seq = itertools.count()
         self._pending: List[tuple] = []  # (lane, hidden, position, future, generation)
+        # session scheduler: priority + per-peer fair-share admission, and (in
+        # paged mode with swap_host_bytes > 0) preemption of idle victim lanes
+        # to the host-RAM swap tier on pool exhaustion. With the default
+        # swap_host_bytes=0 no lane ever suspends and a full pool keeps the
+        # exact waiter-backpressure/AllocationFailed behavior of PR 2.
+        self.swap_pool = HostSwapPool(int(swap_host_bytes or 0))
+        self._scheduler = SessionScheduler(
+            self.swap_pool, policy=preemption_policy, pages_fn=self._lane_pages
+        )
+        # per-lane asyncio locks serializing swap-out against swap-in, and an
+        # in-flight op counter making lanes with ANY active work unpreemptable
+        self._lane_locks: Dict[int, asyncio.Lock] = {}
+        self._inflight: Dict[int, int] = {}
+        # swap-ins serialize through this fair (FIFO-wakeup) lock: N resumers
+        # racing _alloc_pages would each grab pages the others need and an
+        # unlucky one could starve past its timeout; one-at-a-time, the head
+        # gets every freed page and provably drains the queue
+        self._swap_in_turnstile = asyncio.Lock()
         self._flush_task: Optional[asyncio.Task] = None
         self._open_lock = asyncio.Lock()
         self._closed = False
@@ -248,10 +288,11 @@ class DecodeBatcher:
 
     async def close(self) -> None:
         self._closed = True
-        for fut in self._lane_waiters:
-            if not fut.done():
-                fut.set_exception(AllocationFailed("Batcher is shutting down"))
+        for w in self._lane_waiters:
+            if not w.fut.done():
+                w.fut.set_exception(AllocationFailed("Batcher is shutting down"))
         self._lane_waiters.clear()
+        self._scheduler.reset()  # drop swap entries, release their host bytes
         for st in self._gen_states.values():
             if not st.future.done():
                 st.future.set_exception(AllocationFailed("Batcher is shutting down"))
@@ -274,16 +315,26 @@ class DecodeBatcher:
 
     # ------------------------------------------------------------------ lanes
 
-    async def acquire_lane(self, timeout: Optional[float] = None) -> int:
-        """Borrow a lane; queues (FIFO) when all lanes are taken — the
-        allocation-pressure behavior of MemoryCache, at lane granularity.
-        ``timeout`` bounds the WHOLE acquisition including first-use pool
-        allocation, so session opens can fall back to a private cache.
+    async def acquire_lane(
+        self,
+        timeout: Optional[float] = None,
+        *,
+        priority: int = SESSION_PRIORITY_NORMAL,
+        peer_id: Optional[str] = None,
+    ) -> int:
+        """Borrow a lane; queues when all lanes are taken — the allocation-
+        pressure behavior of MemoryCache, at lane granularity. Parked callers
+        are admitted by priority class, then per-peer fair share, then FIFO
+        (scheduler.pick_waiter); at default priority that is exactly the old
+        FIFO. ``timeout`` bounds the WHOLE acquisition including first-use
+        pool allocation, so session opens can fall back to a private cache.
 
         Paged mode: admission additionally claims ONE page (not max_length
         tokens) — the lane grows page-by-page via prepare_write, and a full
-        page pool exerts the same waiter backpressure as a full lane list."""
-        lane = await self._acquire_lane(timeout=timeout)
+        page pool exerts the same waiter backpressure as a full lane list
+        (preempting an idle victim first when the swap tier is enabled)."""
+        lane = await self._acquire_lane(timeout=timeout, priority=priority, peer_id=peer_id)
+        self._scheduler.register(lane, peer_id, int(priority))
         if self.page_size is not None:
             try:
                 await self.prepare_write(lane, 0, 1, timeout=timeout)
@@ -292,7 +343,12 @@ class DecodeBatcher:
                 raise
         return lane
 
-    async def _acquire_lane(self, timeout: Optional[float] = None) -> int:
+    async def _acquire_lane(
+        self,
+        timeout: Optional[float] = None,
+        priority: int = SESSION_PRIORITY_NORMAL,
+        peer_id: Optional[str] = None,
+    ) -> int:
         await self.ensure_open(timeout=timeout)
         if self._closed:
             raise AllocationFailed("Batcher is closed")
@@ -302,8 +358,14 @@ class DecodeBatcher:
             lane = self._free_lanes.pop(0)
             self._lane_generation[lane] = self._generation
             return lane
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._lane_waiters.append(fut)
+        waiter = _LaneWaiter(
+            fut=asyncio.get_running_loop().create_future(),
+            priority=int(priority),
+            peer_id=peer_id,
+            seq=next(self._waiter_seq),
+        )
+        fut = waiter.fut
+        self._lane_waiters.append(waiter)
         try:
             lane = await asyncio.wait_for(fut, timeout)
             self._lane_generation[lane] = self._generation
@@ -314,8 +376,7 @@ class DecodeBatcher:
                 self._lane_generation[lane] = self._generation
                 return lane
             raise AllocationFailed(
-                f"No free decode lane within {timeout} s "
-                f"({self.n_lanes} lanes busy, {len(self._lane_waiters)} waiters)"
+                f"No free decode lane within {timeout} s ({self._occupancy()})"
             )
         except BaseException:
             # cancelled after release_lane already handed us the lane: put it
@@ -324,8 +385,8 @@ class DecodeBatcher:
                 self.release_lane(fut.result())
             raise
         finally:
-            if fut in self._lane_waiters:
-                self._lane_waiters.remove(fut)
+            if waiter in self._lane_waiters:
+                self._lane_waiters.remove(waiter)
 
     def release_lane(self, lane: int) -> None:
         # a timed-out/cancelled session may have left a step queued: purge it,
@@ -351,6 +412,10 @@ class DecodeBatcher:
             if not pst.future.done():
                 pst.future.set_exception(AllocationFailed("Lane released mid-step"))
         self._lane_generation.pop(lane, None)
+        # drop the scheduler slot: a suspended lane's host swap bytes free
+        # here, and a swap-out racing this release aborts on its post-gather
+        # validation (the slot object it captured is no longer registered)
+        self._scheduler.unregister(lane)
         # paged mode: drop this lane's table references — pages whose refcount
         # hits zero (no prefix-cache pin) return to the pool and wake any
         # prepare_write waiters blocked on an exhausted pool
@@ -360,12 +425,17 @@ class DecodeBatcher:
                 if row[slot] >= 0:
                     self._pages.decref(int(row[slot]))
             row[:] = -1
-        # hand straight to the next waiter, else back to the free list; the
+        # hand straight to the best-placed waiter (priority class, then
+        # per-peer fair share, then FIFO), else back to the free list; the
         # new session overwrites the lane from position 0, so no zeroing
         while self._lane_waiters:
-            fut = self._lane_waiters.pop(0)
-            if not fut.done():
-                fut.set_result(lane)
+            w = self._scheduler.pick_waiter(self._lane_waiters)
+            if w is None:
+                self._lane_waiters.clear()  # every parked future already dead
+                break
+            self._lane_waiters.remove(w)
+            if not w.fut.done():
+                w.fut.set_result(lane)
                 return
         self._free_lanes.append(lane)
 
@@ -405,15 +475,29 @@ class DecodeBatcher:
                 page = alloc.try_alloc(preferred=preferred)
                 if page is not None:
                     break
+                # pool exhausted: before parking on freed_event, try to swap
+                # an idle victim lane out to host RAM (no-op when the swap
+                # tier is disabled — the PR2 backpressure path is unchanged)
+                if await self._try_preempt(exclude=lane):
+                    if self._pages is not alloc:
+                        raise AllocationFailed(
+                            "Lane pool was reset while waiting for a free page"
+                        )
+                    self._check_lane(lane)
+                    continue
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     raise AllocationFailed(
-                        f"No free KV page within {timeout} s "
-                        f"({self.n_pages} pages in use)"
+                        f"No free KV page within {timeout} s ({self._occupancy()})"
                     )
                 alloc.freed_event.clear()
+                wait = remaining
+                if self.swap_pool.max_size_bytes > 0 and self._scheduler.policy != "off":
+                    # a victim can become IDLE without any page freeing, so
+                    # freed_event alone would never retry preemption: poll
+                    wait = 0.05 if wait is None else min(wait, 0.05)
                 try:
-                    await asyncio.wait_for(alloc.freed_event.wait(), timeout=remaining)
+                    await asyncio.wait_for(alloc.freed_event.wait(), timeout=wait)
                 except asyncio.TimeoutError:
                     pass  # loop once more to produce the AllocationFailed message
                 if self._pages is not alloc:
@@ -509,6 +593,332 @@ class DecodeBatcher:
             **({f"pages_{k}": v for k, v in alloc.stats.items()} if alloc else {}),
         }
 
+    # -------------------------------------------------------- preemption / swap
+
+    def _lane_pages(self, lane: int) -> int:
+        """Resident page count of a lane (scheduler pages_fn: victim sizing
+        and fair-share accounting)."""
+        if self._tables is None:
+            return 0
+        return int((self._tables[lane] >= 0).sum())
+
+    def _page_nbytes(self) -> int:
+        return self.backend.cache_bytes_per_token() * self.page_size
+
+    def _lane_lock(self, lane: int) -> asyncio.Lock:
+        lock = self._lane_locks.get(lane)
+        if lock is None:
+            lock = self._lane_locks[lane] = asyncio.Lock()
+        return lock
+
+    @contextlib.asynccontextmanager
+    async def _lane_busy(self, lane: int):
+        """Guard every lane-touching op: a suspended lane transparently swaps
+        back in first, then the in-flight counter marks the lane unpreemptable
+        for the op's duration. No await between the resident check returning
+        and the increment, so the pair is atomic on the event loop."""
+        await self._ensure_resident(lane)
+        self._inflight[lane] = self._inflight.get(lane, 0) + 1
+        self._scheduler.touch(lane)
+        try:
+            yield
+        finally:
+            self._inflight[lane] -= 1
+            # a step boundary IS the preemption opportunity: when decode is
+            # compute-bound, lanes are idle only in the sliver between ops,
+            # which timer polls almost always miss — wake page waiters now
+            # so they re-attempt victim selection while this lane is idle
+            if (
+                self._inflight[lane] == 0
+                and self._pages is not None
+                and self.swap_pool.max_size_bytes > 0
+            ):
+                self._pages.freed_event.set()
+
+    def _lane_idle(self, lane: int, *, ignore_lock: bool = False) -> bool:
+        """A lane is preemptable only while NOTHING is touching it: no step
+        pending or in flight, no server-gen or prefill stream, no exclusive
+        op, no swap already in progress — and some pages actually resident
+        to reclaim. ``ignore_lock`` is for the re-check inside
+        _swap_out_lane, which holds the lane lock itself."""
+        if self._lane_generation.get(lane) != self._generation:
+            return False
+        if self._inflight.get(lane, 0) > 0:
+            return False
+        if lane in self._gen_states:
+            return False
+        if any(p.lane == lane for p in self._prefill_queue):
+            return False
+        if any(e[0] == lane for e in self._pending):
+            return False
+        if not ignore_lock:
+            lock = self._lane_locks.get(lane)
+            if lock is not None and lock.locked():
+                return False
+        return self._lane_pages(lane) > 0
+
+    async def _try_preempt(self, exclude: int) -> bool:
+        """Pool exhausted: try to swap ONE idle victim lane out to host RAM.
+        Returns True when a victim's pages were freed (the caller retries
+        allocation immediately); False means no preemptable victim — fall
+        back to waiting on freed_event, the old backpressure path. Victims
+        must be of equal-or-lower priority than the requester."""
+        sched = self._scheduler
+        if (
+            self.page_size is None
+            or sched.policy == "off"
+            or self.swap_pool.max_size_bytes <= 0
+        ):
+            return False
+        req = sched.lanes.get(exclude)
+        max_priority = req.priority if req is not None else None
+        candidates = [
+            l for l in list(self._lane_generation)
+            if l != exclude and self._lane_idle(l)
+        ]
+        victim = sched.pick_victim(candidates, max_priority=max_priority)
+        if victim is None:
+            return False
+        return await self._swap_out_lane(victim)
+
+    async def _swap_out_lane(self, lane: int) -> bool:
+        """Suspend ``lane``: gather its resident pages on device, copy them to
+        the host swap pool, then free the pages (waking allocation waiters).
+        The block-table row is cleared; swap-in may later land the content on
+        entirely different physical pages. Aborts harmlessly (False) if the
+        lane's state moved while the gather ran — release_lane, a pool reset,
+        or a racing op all invalidate the snapshot."""
+        sched = self._scheduler
+        slot = sched.lanes.get(lane)
+        if slot is None or slot.swap is not None or slot.suspending:
+            return False
+        lock = self._lane_lock(lane)
+        if lock.locked():
+            return False
+        async with lock:
+            if not self._lane_idle(lane, ignore_lock=True):
+                return False
+            if sched.lanes.get(lane) is not slot or slot.swap is not None:
+                return False
+            alloc = self._pages
+            gen = self._lane_generation.get(lane)
+            row = self._tables[lane]
+            slots = np.flatnonzero(row >= 0).astype(np.int32)
+            if slots.size == 0:
+                return False
+            pages = row[slots].astype(np.int32).copy()
+            nbytes = int(slots.size) * self._page_nbytes()
+            if not self.swap_pool.try_reserve(nbytes):
+                return False  # swap tier full: this victim is not preemptable
+            slot.suspending = True
+            try:
+                k_host, v_host = await self.queue.submit(
+                    self._swap_out_device, pages,
+                    priority=PRIORITY_INFERENCE, size=0,
+                )
+            except asyncio.CancelledError:
+                self.swap_pool.free(nbytes)
+                slot.suspending = False
+                sched.stats["swap_aborted"] += 1
+                raise
+            except Exception:
+                # the gather is non-donating, so the pool is intact; degrade
+                # to the plain backpressure path rather than failing the
+                # REQUESTER for the victim's trouble
+                self.swap_pool.free(nbytes)
+                slot.suspending = False
+                sched.stats["swap_aborted"] += 1
+                return False
+            # validate nothing moved while the gather ran; only now (host
+            # copy landed, snapshot still true) do the pages actually free
+            if (
+                sched.lanes.get(lane) is not slot
+                or self._pages is not alloc
+                or self._lane_generation.get(lane) != gen
+                or gen != self._generation
+                or not np.array_equal(self._tables[lane][slots], pages)
+            ):
+                self.swap_pool.free(nbytes)
+                slot.suspending = False
+                sched.stats["swap_aborted"] += 1
+                return False
+            for page in pages:
+                alloc.decref(int(page))
+            self._tables[lane, slots] = -1
+            slot.swap = SwapEntry(
+                k=k_host, v=v_host, slots=slots, nbytes=nbytes, generation=gen
+            )
+            slot.suspending = False
+            sched.stats["preemptions"] += 1
+            sched.stats["swap_outs"] += 1
+            logger.debug(
+                f"Preempted lane {lane}: {slots.size} pages -> host swap "
+                f"({self.swap_pool.bytes_in_use}/{self.swap_pool.max_size_bytes} B used)"
+            )
+            return True
+
+    def _swap_out_device(self, pages: np.ndarray):
+        """Compute-thread body: gather the victim's pages and land them in
+        host RAM. Non-donating — the pool stays live; the pages only free
+        once the event loop validates and commits the suspend."""
+        with self._reset_lock:
+            k_pool, v_pool = self._buffers()
+            k, v = self.backend._swap_out_pages_fn(k_pool, v_pool, pages)
+            return np.asarray(k), np.asarray(v)
+
+    async def _ensure_resident(self, lane: int) -> None:
+        """Transparent resume: if ``lane`` is suspended (or a suspend is in
+        flight — the lock serializes us behind it), swap its KV back in
+        before the caller's op proceeds."""
+        sched = self._scheduler
+        slot = sched.lanes.get(lane)
+        if slot is None or (slot.swap is None and not slot.suspending):
+            return
+        async with self._lane_lock(lane):
+            slot = sched.lanes.get(lane)
+            if slot is None or slot.swap is None:
+                return  # suspend aborted, or lane released meanwhile
+            await self._swap_in(lane, slot)
+
+    async def _swap_in(self, lane: int, slot) -> None:
+        """Resume a suspended lane (lane lock held): allocate fresh pages
+        (all-or-nothing, preempting others if needed), scatter the host copy
+        back into the pool, and restore the block-table row — onto possibly
+        different physical pages than before."""
+        sched = self._scheduler
+        entry = slot.swap
+        self._check_lane(lane)
+        # only the ALLOCATION is serialized: once this resumer holds its
+        # pages the next one can start negotiating for pages while our
+        # scatter runs on the compute queue — the turnstile exists to stop
+        # concurrent allocators hoarding partial page sets, not to make
+        # swap-ins take turns at the device
+        async with self._swap_in_turnstile:
+            pages = await self._alloc_pages(lane, entry.slots)
+        alloc = self._pages
+        pages_arr = np.asarray(pages, np.int32)
+        try:
+            await self.queue.submit(
+                self._swap_in_device, lane, entry, pages_arr,
+                priority=PRIORITY_INFERENCE, size=0,
+            )
+        except BaseException:
+            if self._pages is alloc:
+                for page in pages:
+                    alloc.decref(int(page))
+            self._maybe_reset_pool()  # the scatter donates the pool buffers
+            raise
+        self._tables[lane, entry.slots] = pages_arr
+        slot.swap = None
+        slot.resumed_at = time.monotonic()
+        self.swap_pool.free(entry.nbytes)
+        sched.stats["swap_ins"] += 1
+        logger.debug(f"Resumed lane {lane}: {entry.slots.size} pages swapped in")
+
+    def _swap_in_device(self, lane: int, entry, pages: np.ndarray) -> None:
+        """Compute-thread body: scatter a swap entry's KV onto fresh pages.
+        Donating, so the generation check rides INSIDE the reset lock — the
+        same TOCTOU rule as _insert_lane."""
+        with self._reset_lock:
+            self._check_lane(lane)
+            if entry.generation != self._generation:
+                raise AllocationFailed(
+                    "Lane pool was reset while this session was swapped out"
+                )
+            k_pool, v_pool = self._buffers()
+            k_pool, v_pool = self.backend._swap_in_pages_fn(
+                k_pool, v_pool, entry.k, entry.v, pages
+            )
+            self._update(k_pool, v_pool)
+
+    async def _alloc_pages(self, lane: int, slots: np.ndarray) -> List[int]:
+        """All-or-nothing page allocation for a swap-in: take len(slots)
+        pages only once that many are simultaneously free — two resuming
+        lanes each holding a partial set would deadlock — preempting other
+        lanes when the pool is short. Identity slots are preferred so a
+        resumed lane can regain the contiguous fast path when its old pages
+        happen to be free."""
+        alloc = self._pages
+        n = int(len(slots))
+        identity_base = (
+            lane * self.max_pages
+            if self.n_pages == self.n_lanes * self.max_pages else None
+        )
+        timeout = 30.0 if self.alloc_timeout is None else self.alloc_timeout
+        deadline = time.monotonic() + timeout
+        while True:
+            if self._pages is not alloc:
+                raise AllocationFailed("Lane pool was reset while waiting for a free page")
+            self._check_lane(lane)
+            if alloc.n_free >= n:
+                pages = []
+                for slot in slots:
+                    preferred = None if identity_base is None else identity_base + int(slot)
+                    page = alloc.try_alloc(preferred=preferred)
+                    assert page is not None, "n_free lied: allocator invariant broken"
+                    pages.append(page)
+                return pages
+            if await self._try_preempt(exclude=lane):
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise AllocationFailed(
+                    f"No free KV page for swap-in within {timeout} s ({self._occupancy()})"
+                )
+            alloc.freed_event.clear()
+            try:
+                # bounded wait (not remaining): see prepare_write — preemption
+                # must re-attempt when a victim merely becomes idle
+                await asyncio.wait_for(
+                    alloc.freed_event.wait(), timeout=min(remaining, 0.05)
+                )
+            except asyncio.TimeoutError:
+                pass  # loop once more to produce the AllocationFailed message
+
+    # -------------------------------------------------------- observability
+
+    def _occupancy(self) -> str:
+        """Human-readable pool occupancy for AllocationFailed messages: lane
+        and page counts, per-lane page holdings, and swap-tier usage — so a
+        rejected client (and the operator reading its logs) can see WHY."""
+        busy = (self.n_lanes - len(self._free_lanes)) if self.is_open else 0
+        parts = [
+            f"{busy}/{self.n_lanes} lanes busy",
+            f"{len(self._lane_waiters)} waiters",
+        ]
+        if self.page_size is not None and self._pages is not None:
+            parts.append(f"{self._pages.n_free}/{self.n_pages} pages free")
+            if self._tables is not None and self._lane_generation:
+                held = ", ".join(
+                    f"lane {l}: {self._lane_pages(l)}"
+                    for l in sorted(self._lane_generation)
+                )
+                parts.append(f"pages held: [{held}]")
+        if self.swap_pool.max_size_bytes > 0:
+            parts.append(
+                f"{self._scheduler.suspended_count} suspended, swap "
+                f"{self.swap_pool.bytes_in_use}/{self.swap_pool.max_size_bytes} B"
+            )
+        return "; ".join(parts)
+
+    def occupancy_info(self) -> dict:
+        """Machine-readable pool/scheduler occupancy (ServerInfo.pool,
+        rpc_info, run_health): enough for a client to route around a loaded
+        server — busy lanes, free pages, suspended sessions, swap bytes,
+        preemption count."""
+        info = {
+            "lanes": self.n_lanes,
+            "busy_lanes": (self.n_lanes - len(self._free_lanes)) if self.is_open else 0,
+            "lane_waiters": len(self._lane_waiters),
+        }
+        if self.page_size is not None:
+            info["n_pages"] = self.n_pages
+            info["pages_free"] = (
+                self._pages.n_free if self._pages is not None else self.n_pages
+            )
+        info.update(self._scheduler.summary())
+        return info
+
     # ------------------------------------------------------------------ stepping
 
     def _check_lane(self, lane: int) -> None:
@@ -520,17 +930,25 @@ class DecodeBatcher:
 
     async def step(self, lane: int, hidden: np.ndarray, position: int) -> np.ndarray:
         """One decode token for ``lane`` (hidden [1, 1, hidden]); coalesced
-        with whatever other lanes are pending by the time the device is free."""
-        self._check_lane(lane)
-        if self.page_size is not None:
-            # grow the lane to cover this token BEFORE the device step —
-            # allocation can await a freed page; the step itself never blocks
-            await self.prepare_write(lane, int(position), int(position) + 1)
-        fut = asyncio.get_running_loop().create_future()
-        self._pending.append((lane, hidden, int(position), fut, self._generation))
-        if self._flush_task is None or self._flush_task.done():
-            self._flush_task = asyncio.create_task(self._flush_loop())
-        return await fut
+        with whatever other lanes are pending by the time the device is free.
+        A preempted (swapped-out) lane transparently swaps back in first."""
+        async with self._lane_busy(lane):
+            self._check_lane(lane)
+            if self.page_size is not None:
+                # grow the lane to cover this token BEFORE the device step —
+                # allocation can await a freed page; the step itself never
+                # blocks. alloc_timeout bounds the wait: without it, N
+                # sessions each needing one more page from an exhausted pool
+                # (and none willing to release) deadlock forever
+                await self.prepare_write(
+                    lane, int(position), int(position) + 1,
+                    timeout=self.alloc_timeout,
+                )
+            fut = asyncio.get_running_loop().create_future()
+            self._pending.append((lane, hidden, int(position), fut, self._generation))
+            if self._flush_task is None or self._flush_task.done():
+                self._flush_task = asyncio.create_task(self._flush_loop())
+            return await fut
 
     async def _flush_loop(self) -> None:
         while self._pending or self._gen_states or self._prefill_queue:
@@ -697,38 +1115,41 @@ class DecodeBatcher:
         exclusive path."""
         if self.page_size is None:
             raise RuntimeError("prefill_lane requires the paged lane pool")
-        self._check_lane(lane)
-        total = int(hidden.shape[1])
-        position = int(position)
-        if position + total > self.max_length:
-            raise ValueError(
-                f"Prefill of {total} tokens at position {position} overflows "
-                f"the lane buffer ({self.max_length} tokens)"
+        async with self._lane_busy(lane):
+            self._check_lane(lane)
+            total = int(hidden.shape[1])
+            position = int(position)
+            if position + total > self.max_length:
+                raise ValueError(
+                    f"Prefill of {total} tokens at position {position} overflows "
+                    f"the lane buffer ({self.max_length} tokens)"
+                )
+            await self.prepare_write(
+                lane, position, position + total, timeout=self.alloc_timeout
             )
-        await self.prepare_write(lane, position, position + total)
-        plan = self.backend.chunk_plan(
-            1, total, kv_buf_len=self.max_length,
-            page_size=self.page_size, start=position,
-        )
-        st = _LanePrefillState(
-            future=asyncio.get_running_loop().create_future(),
-            generation=self._lane_generation[lane],
-            lane=lane,
-            hidden=np.ascontiguousarray(np.asarray(hidden, np.float32)),
-            position=position,
-            offset=0,
-            cap=int(max(plan)),
-            n_total=position + total,
-            outs=[],
-        )
-        self._prefill_queue.append(st)
-        if self._flush_task is None or self._flush_task.done():
-            self._flush_task = asyncio.create_task(self._flush_loop())
-        try:
-            return await st.future
-        finally:
-            if st in self._prefill_queue:
-                self._prefill_queue.remove(st)
+            plan = self.backend.chunk_plan(
+                1, total, kv_buf_len=self.max_length,
+                page_size=self.page_size, start=position,
+            )
+            st = _LanePrefillState(
+                future=asyncio.get_running_loop().create_future(),
+                generation=self._lane_generation[lane],
+                lane=lane,
+                hidden=np.ascontiguousarray(np.asarray(hidden, np.float32)),
+                position=position,
+                offset=0,
+                cap=int(max(plan)),
+                n_total=position + total,
+                outs=[],
+            )
+            self._prefill_queue.append(st)
+            if self._flush_task is None or self._flush_task.done():
+                self._flush_task = asyncio.create_task(self._flush_loop())
+            try:
+                return await st.future
+            finally:
+                if st in self._prefill_queue:
+                    self._prefill_queue.remove(st)
 
     async def generate_lane(
         self, lane: int, last_hidden: np.ndarray, position: int,
@@ -747,64 +1168,65 @@ class DecodeBatcher:
         (None -> greedy). Returns tokens [1, n_tokens] int32."""
         if self.gen_params is None:
             raise RuntimeError("This batcher has no client leaves loaded for server-gen")
-        self._check_lane(lane)
-        if position + n_tokens - 1 > self.max_length:
-            raise ValueError(
-                f"Generating {n_tokens} tokens at position {position} overflows "
-                f"the lane buffer ({self.max_length} tokens)"
-            )
-        if self.page_size is not None and n_tokens > 1:
-            # reserve the whole stream's pages up front: the flush loop can't
-            # await page allocation mid-generation
-            await self.prepare_write(lane, int(position), int(position) + int(n_tokens) - 1)
-
-        # bootstrap: t0 comes from the caller's hidden, not a pool step —
-        # submitted through the queue so it serializes with batched steps
-        def boot():
+        async with self._lane_busy(lane):
             self._check_lane(lane)
-            return self.backend.sample_from_hidden(
-                self.gen_params, last_hidden, sampling
-            )
+            if position + n_tokens - 1 > self.max_length:
+                raise ValueError(
+                    f"Generating {n_tokens} tokens at position {position} overflows "
+                    f"the lane buffer ({self.max_length} tokens)"
+                )
+            if self.page_size is not None and n_tokens > 1:
+                # reserve the whole stream's pages up front: the flush loop can't
+                # await page allocation mid-generation
+                await self.prepare_write(lane, int(position), int(position) + int(n_tokens) - 1)
 
-        t0 = int((await self.queue.submit(
-            boot, priority=PRIORITY_INFERENCE, size=1
-        ))[0])
-        if n_tokens <= 1:
-            return np.asarray([[t0]], np.int32)
+            # bootstrap: t0 comes from the caller's hidden, not a pool step —
+            # submitted through the queue so it serializes with batched steps
+            def boot():
+                self._check_lane(lane)
+                return self.backend.sample_from_hidden(
+                    self.gen_params, last_hidden, sampling
+                )
 
-        st = _LaneGenState(
-            future=asyncio.get_running_loop().create_future(),
-            generation=self._lane_generation[lane],
-            token=t0, position=int(position), remaining=int(n_tokens) - 1,
-            collected=[t0],
-        )
-        if sampling is not None:
-            st.do_sample = bool(sampling.get("do_sample", False))
-            st.temperature = float(sampling.get("temperature", 1.0))
-            st.top_k = int(sampling.get("top_k", 0) or 0)
-            st.top_p = float(sampling.get("top_p", 1.0) or 1.0)
-            st.repetition_penalty = float(
-                sampling.get("repetition_penalty", 1.0) or 1.0
+            t0 = int((await self.queue.submit(
+                boot, priority=PRIORITY_INFERENCE, size=1
+            ))[0])
+            if n_tokens <= 1:
+                return np.asarray([[t0]], np.int32)
+
+            st = _LaneGenState(
+                future=asyncio.get_running_loop().create_future(),
+                generation=self._lane_generation[lane],
+                token=t0, position=int(position), remaining=int(n_tokens) - 1,
+                collected=[t0],
             )
-            st.seed = int(sampling.get("seed", 0))
-            st.draw_idx = int(sampling.get("offset", 0)) + 1
-            if st.repetition_penalty != 1.0:
-                vocab = self.backend.cfg.vocab_size
-                seen = np.zeros((vocab,), bool)
-                for t in sampling.get("context") or ():
-                    if 0 <= int(t) < vocab:
-                        seen[int(t)] = True
-                if 0 <= t0 < vocab:
-                    seen[t0] = True
-                st.seen = seen
-        self._gen_states[lane] = st
-        if self._flush_task is None or self._flush_task.done():
-            self._flush_task = asyncio.create_task(self._flush_loop())
-        try:
-            return await st.future
-        finally:
-            if self._gen_states.get(lane) is st:
-                del self._gen_states[lane]
+            if sampling is not None:
+                st.do_sample = bool(sampling.get("do_sample", False))
+                st.temperature = float(sampling.get("temperature", 1.0))
+                st.top_k = int(sampling.get("top_k", 0) or 0)
+                st.top_p = float(sampling.get("top_p", 1.0) or 1.0)
+                st.repetition_penalty = float(
+                    sampling.get("repetition_penalty", 1.0) or 1.0
+                )
+                st.seed = int(sampling.get("seed", 0))
+                st.draw_idx = int(sampling.get("offset", 0)) + 1
+                if st.repetition_penalty != 1.0:
+                    vocab = self.backend.cfg.vocab_size
+                    seen = np.zeros((vocab,), bool)
+                    for t in sampling.get("context") or ():
+                        if 0 <= int(t) < vocab:
+                            seen[int(t)] = True
+                    if 0 <= t0 < vocab:
+                        seen[t0] = True
+                    st.seen = seen
+            self._gen_states[lane] = st
+            if self._flush_task is None or self._flush_task.done():
+                self._flush_task = asyncio.create_task(self._flush_loop())
+            try:
+                return await st.future
+            finally:
+                if self._gen_states.get(lane) is st:
+                    del self._gen_states[lane]
 
     def _maybe_reset_pool(self) -> None:
         """A failed batched step may have CONSUMED the donated pool buffers.
@@ -844,7 +1266,10 @@ class DecodeBatcher:
             if self.page_size is not None:
                 # every table reference died with the lanes; rebuild the
                 # allocator and bump the epoch so prefix-cache pins taken
-                # against the old pool become no-op unpins
+                # against the old pool become no-op unpins. Swap entries
+                # target the dead generation too: drop them, freeing their
+                # host bytes — suspended sessions fail loudly via _check_lane
+                self._scheduler.reset()
                 self._page_epoch += 1
                 if self._pages is not None:
                     # wake prepare_write waiters parked on the dead allocator
@@ -1098,31 +1523,32 @@ class DecodeBatcher:
         paged mode allocates/forks those pages up front (prepare_write) so
         the check-in scatter has somewhere to land."""
 
-        self._check_lane(lane)
-        if self.page_size is not None and write_range is not None:
-            await self.prepare_write(lane, int(write_range[0]), int(write_range[1]))
+        async with self._lane_busy(lane):
+            self._check_lane(lane)
+            if self.page_size is not None and write_range is not None:
+                await self.prepare_write(lane, int(write_range[0]), int(write_range[1]))
 
-        def run():
-            self._check_lane(lane)  # re-check: a reset may have raced the queue
-            temp = self._new_temp()
+            def run():
+                self._check_lane(lane)  # re-check: a reset may have raced the queue
+                temp = self._new_temp()
+                try:
+                    kv_lane = self._extract_lane(lane, temp) if extract else None
+                    result, kv_lane = fn(kv_lane, temp)
+                    self._insert_lane(lane, kv_lane, temp)
+                except BaseException:
+                    self._release_temp(temp)
+                    raise
+                return result
+
             try:
-                kv_lane = self._extract_lane(lane, temp) if extract else None
-                result, kv_lane = fn(kv_lane, temp)
-                self._insert_lane(lane, kv_lane, temp)
-            except BaseException:
-                self._release_temp(temp)
+                return await self.queue.submit(run, priority=PRIORITY_INFERENCE, size=size)
+            except AllocationFailed:
                 raise
-            return result
-
-        try:
-            return await self.queue.submit(run, priority=PRIORITY_INFERENCE, size=size)
-        except AllocationFailed:
-            raise
-        except BaseException:
-            # exclusive ops donate the pool buffers too (_lane_insert_fn):
-            # a failure here can consume them just like a batched step
-            self._maybe_reset_pool()
-            raise
+            except BaseException:
+                # exclusive ops donate the pool buffers too (_lane_insert_fn):
+                # a failure here can consume them just like a batched step
+                self._maybe_reset_pool()
+                raise
 
     async def run_exclusive_chunks(
         self, lane: int, chunk_fns, *, size: int = 0,
@@ -1137,6 +1563,15 @@ class DecodeBatcher:
         queue guarantees the final insert lands before any new tenant's first
         task even if this session is cancelled mid-chunks (stale content
         beyond a tenant's position is masked by attention anyway)."""
+        async with self._lane_busy(lane):
+            return await self._run_exclusive_chunks(
+                lane, chunk_fns, size=size, write_range=write_range
+            )
+
+    async def _run_exclusive_chunks(
+        self, lane: int, chunk_fns, *, size: int = 0,
+        write_range: Optional[Tuple[int, int]] = None,
+    ):
         self._check_lane(lane)
         if self.page_size is not None and write_range is not None:
             await self.prepare_write(lane, int(write_range[0]), int(write_range[1]))
@@ -1240,4 +1675,5 @@ class DecodeBatcher:
             host = (np.asarray(kd), np.asarray(vd))
             return (*host, kd, vd) if return_device else host
 
-        return await self.queue.submit(run, priority=PRIORITY_INFERENCE, size=0)
+        async with self._lane_busy(lane):
+            return await self.queue.submit(run, priority=PRIORITY_INFERENCE, size=0)

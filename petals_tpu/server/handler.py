@@ -65,6 +65,8 @@ class TransformerHandler:
         page_size: Optional[int] = None,  # paged KV: tokens per page; None/0 = dense pool
         n_pages: Optional[int] = None,  # paged KV pool size; None = lanes * max_pages
         prefill_token_budget: int = 512,  # prefill tokens per mixed batched step
+        swap_host_bytes: int = 0,  # host-RAM KV swap tier for preemption; 0 disables
+        preemption_policy: str = "lru",  # victim choice: lru | largest | off
         prefix_cache_bytes: int = 256 * 2**20,  # 0 disables prefix caching
         prefix_share_scope: str = "swarm",  # "swarm" shares across clients; "peer" salts per client
         prefix_device_bytes: int = 256 * 2**20,  # HBM tier of the prefix cache; 0 disables
@@ -117,6 +119,8 @@ class TransformerHandler:
                 page_size=page_size,
                 n_pages=n_pages,
                 prefill_token_budget=prefill_token_budget,
+                swap_host_bytes=swap_host_bytes,
+                preemption_policy=preemption_policy,
             )
 
         # Content-addressed prefix cache (server/prefix_cache.py): sessions
@@ -178,6 +182,8 @@ class TransformerHandler:
                 page_size=old.page_size,
                 n_pages=old.n_pages or None,
                 prefill_token_budget=old.prefill_token_budget,
+                swap_host_bytes=old.swap_pool.max_size_bytes,
+                preemption_policy=old._scheduler.policy,
             )
             await old.close()
 
@@ -833,6 +839,10 @@ class TransformerHandler:
             paged = self.batcher.paged_summary()
             if paged is not None:
                 info["continuous_batching"]["paged"] = paged
+            # scheduler occupancy (busy lanes, free pages, suspended sessions,
+            # swap bytes, preemptions): lets clients route around loaded
+            # servers — the same dict rides ServerInfo.pool on the DHT
+            info["pool"] = self.batcher.occupancy_info()
         if self.prefix_cache is not None:
             info["prefix_cache"] = self.prefix_cache.summary()
         return info
@@ -882,12 +892,20 @@ class TransformerHandler:
             and end == self.backend.n_blocks
             and max_length <= batcher.max_length
         ):
+            from petals_tpu.data_structures import parse_session_priority
             from petals_tpu.server.memory_cache import AllocationFailed
 
             alloc_timeout = open_msg.get("alloc_timeout")
+            # optional client priority hint ("high"/"normal"/"low" or an int
+            # class); absent -> normal, i.e. exactly the pre-hint behavior.
+            # The authenticated peer id feeds per-peer fair-share admission.
+            priority = parse_session_priority(open_msg.get("priority"))
+            peer = getattr(ctx, "remote_peer_id", None)
             try:
                 lane = await batcher.acquire_lane(
-                    timeout=30.0 if alloc_timeout is None else alloc_timeout
+                    timeout=30.0 if alloc_timeout is None else alloc_timeout,
+                    priority=priority,
+                    peer_id=peer.to_string() if peer is not None else None,
                 )
             except AllocationFailed as e:
                 logger.debug(f"No decode lane ({e}); serving with a private cache")

@@ -121,6 +121,57 @@ class PageAllocator:
             self.freed_event.set()
 
 
+class HostSwapPool:
+    """Byte-budgeted accounting for the host-RAM KV swap tier.
+
+    When the page pool is exhausted, the session scheduler
+    (server/scheduler.py) preempts a victim lane: its resident pages are
+    gathered on device and copied to host RAM, its pool pages freed, and the
+    content scattered back onto (possibly different) pages when the session
+    next steps. This class only accounts the bytes — the arrays themselves
+    ride inside the scheduler's swap entries, so the budget bounds how much
+    host RAM preemption may pin. ``try_reserve`` is all-or-nothing: a victim
+    whose KV does not fit is simply not preemptable, and the caller falls
+    back to ordinary waiter backpressure.
+
+    The copies land in ordinary (pageable) numpy memory; on TPU runtimes the
+    device->host transfer is staged through the runtime's pinned buffers, and
+    a future upgrade can place the pool in the ``pinned_host`` memory space
+    once the jax version floor allows it.
+    """
+
+    def __init__(self, max_size_bytes: int):
+        assert max_size_bytes >= 0
+        self.max_size_bytes = int(max_size_bytes)
+        self._bytes_in_use = 0
+        self.stats = {"reserved": 0, "rejected": 0, "peak_bytes": 0}
+
+    @property
+    def bytes_in_use(self) -> int:
+        return self._bytes_in_use
+
+    @property
+    def bytes_left(self) -> int:
+        return self.max_size_bytes - self._bytes_in_use
+
+    def try_reserve(self, nbytes: int) -> bool:
+        """Reserve ``nbytes`` for one swap entry, or False when it would
+        overflow the budget (the entry's victim stays resident)."""
+        nbytes = int(nbytes)
+        assert nbytes >= 0
+        if nbytes > self.bytes_left:
+            self.stats["rejected"] += 1
+            return False
+        self._bytes_in_use += nbytes
+        self.stats["reserved"] += 1
+        self.stats["peak_bytes"] = max(self.stats["peak_bytes"], self._bytes_in_use)
+        return True
+
+    def free(self, nbytes: int) -> None:
+        self._bytes_in_use -= int(nbytes)
+        assert self._bytes_in_use >= 0, "swap-pool accounting went negative"
+
+
 class MemoryCache:
     """Budgeted handle-based allocator for session KV buffers in HBM."""
 

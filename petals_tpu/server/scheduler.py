@@ -1,0 +1,234 @@
+"""Preemptive session scheduler: arbitration of the paged KV pool.
+
+Petals' public-swarm premise is bursty demand from many independent clients,
+yet before this subsystem a full page pool ended a session hard: admission
+and prepare_write parked the caller on a waiter and raised AllocationFailed
+at timeout. The scheduler converts that central failure mode into a
+scheduling decision, in two layers:
+
+- **Admission** (acquire_lane): lane waiters are ordered by priority class
+  (session-open "priority" hint: high/normal/low, default normal), ties
+  broken by per-peer fair share — among equal-priority waiters the peer
+  holding the FEWEST lanes is admitted first, so one chatty client cannot
+  monopolize the pool — then FIFO.
+
+- **Preemption** (prepare_write / swap-in on pool exhaustion): instead of
+  only waiting for a page to free, the batcher asks the scheduler for a
+  victim — an IDLE resident lane of equal-or-lower priority, lowest priority
+  class first, least-recently-stepped within a class ("lru" policy; "largest"
+  prefers the lane holding the most pages; "off" disables preemption). The
+  victim's pages are gathered on device, copied to the host-RAM swap tier
+  (memory_cache.HostSwapPool budget), and freed — waking the waiters. When
+  the victim's session next steps, the batcher transparently swaps it back
+  in onto whatever pages are then free (block tables make relocation free),
+  so oversubscribed sessions stall briefly instead of dying.
+
+This module holds POLICY and accounting only (victim ordering, fair share,
+swap-entry bookkeeping, stats); the MECHANICS — device gather/scatter, table
+mutation, page refcounts, the suspend/resume locking — live in
+server/batching.py, which owns those structures. The dense lane pool and
+TP/lockstep spans keep priority/fair-share ADMISSION but never preempt:
+their pool exhaustion stays on the old waiter backpressure path (paged mode
+is gated off there too, so there are no relocatable pages to swap).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, Iterable, Optional, Sequence
+
+import numpy as np
+
+from petals_tpu.data_structures import SESSION_PRIORITY_NORMAL
+from petals_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+PREEMPTION_POLICIES = ("lru", "largest", "off")
+
+
+@dataclasses.dataclass
+class SwapEntry:
+    """One suspended lane's KV, resident in host RAM.
+
+    ``k``/``v`` are [n_blocks, n_slots, page_size, hkv, d] host arrays
+    holding exactly the pages that were resident at suspend time; ``slots``
+    records WHICH table slots they back, so swap-in can restore the row onto
+    fresh physical pages. ``generation`` pins the entry to the pool
+    generation it was taken under — a pool reset invalidates it."""
+
+    k: np.ndarray
+    v: np.ndarray
+    slots: np.ndarray  # [n_slots] int32 table-slot indices
+    nbytes: int  # bytes reserved in the HostSwapPool
+    generation: int
+
+
+@dataclasses.dataclass
+class SessionSlot:
+    """Scheduler-side state of one admitted lane."""
+
+    lane: int
+    peer_id: Optional[str]
+    priority: int  # SESSION_PRIORITY_*: lower value = more important
+    last_step: int = 0  # scheduler clock tick of the most recent step
+    swap: Optional[SwapEntry] = None  # non-None while suspended
+    suspending: bool = False  # swap-out in flight (device gather queued)
+    resumed_at: float = 0.0  # time.monotonic() of the last swap-in
+
+    @property
+    def suspended(self) -> bool:
+        return self.swap is not None
+
+
+class SessionScheduler:
+    """Priority + fair-share arbitration of lanes and pages across sessions."""
+
+    def __init__(
+        self,
+        swap_pool,  # memory_cache.HostSwapPool
+        *,
+        policy: str = "lru",
+        pages_fn: Optional[Callable[[int], int]] = None,
+        resume_quantum_s: float = 0.5,
+    ):
+        if policy not in PREEMPTION_POLICIES:
+            raise ValueError(
+                f"preemption_policy must be one of {PREEMPTION_POLICIES}, got {policy!r}"
+            )
+        self.swap_pool = swap_pool
+        self.policy = policy
+        # minimum residency after a resume (an OS timeslice, in effect):
+        # without it, a just-swapped-in lane is re-victimized in the sliver
+        # between its next two steps and the pool degenerates into swap
+        # ping-pong — measured 5x more preemptions than burst boundaries
+        # warrant under an oversubscribed interactive load
+        self.resume_quantum_s = resume_quantum_s
+        # resident page count of a lane ("largest" victim ordering + fair-share
+        # page accounting); the batcher wires its block tables in, unit tests
+        # wire a dict — the scheduler never reaches into batcher internals
+        self.pages_fn = pages_fn or (lambda lane: 0)
+        self.lanes: Dict[int, SessionSlot] = {}
+        self._clock = 0
+        # every key pre-initialized, like DecodeBatcher.stats: rpc_info spreads
+        # this dict and the schema must not depend on which paths have run
+        self.stats = {
+            "preemptions": 0,
+            "swap_outs": 0,
+            "swap_ins": 0,
+            "swap_aborted": 0,
+            "swap_dropped_on_reset": 0,
+        }
+
+    # ------------------------------------------------------------- lifecycle
+
+    def register(self, lane: int, peer_id: Optional[str], priority: int) -> SessionSlot:
+        self._clock += 1
+        slot = SessionSlot(
+            lane=lane, peer_id=peer_id, priority=int(priority), last_step=self._clock
+        )
+        self.lanes[lane] = slot
+        return slot
+
+    def unregister(self, lane: int) -> None:
+        slot = self.lanes.pop(lane, None)
+        if slot is not None and slot.swap is not None:
+            self.swap_pool.free(slot.swap.nbytes)
+            slot.swap = None
+
+    def touch(self, lane: int) -> None:
+        slot = self.lanes.get(lane)
+        if slot is not None:
+            self._clock += 1
+            slot.last_step = self._clock
+
+    def reset(self) -> None:
+        """Pool reset: every swap entry's content targets a dead generation —
+        drop them (freeing swap bytes) so suspended sessions fail loudly
+        through the normal lane-generation check instead of scattering stale
+        KV into the rebuilt pool."""
+        for slot in self.lanes.values():
+            slot.suspending = False
+            if slot.swap is not None:
+                self.swap_pool.free(slot.swap.nbytes)
+                slot.swap = None
+                self.stats["swap_dropped_on_reset"] += 1
+
+    # ------------------------------------------------------------ admission
+
+    def peer_lanes_held(self, peer_id: Optional[str]) -> int:
+        return sum(1 for s in self.lanes.values() if s.peer_id == peer_id)
+
+    def peer_pages_held(self, peer_id: Optional[str]) -> int:
+        return sum(
+            self.pages_fn(s.lane) for s in self.lanes.values() if s.peer_id == peer_id
+        )
+
+    def pick_waiter(self, waiters: Sequence) -> Optional[object]:
+        """Admission order for lane waiters: highest priority class first,
+        then the peer holding the fewest lanes (fair share), then FIFO.
+        ``waiters`` entries expose .priority, .peer_id, .seq (batching.py
+        _LaneWaiter); returns the entry to admit, or None when empty."""
+        live = [w for w in waiters if not w.fut.done()]
+        if not live:
+            return None
+        return min(
+            live, key=lambda w: (w.priority, self.peer_lanes_held(w.peer_id), w.seq)
+        )
+
+    # ------------------------------------------------------------ preemption
+
+    def pick_victim(
+        self, candidates: Iterable[int], *, max_priority: Optional[int] = None
+    ) -> Optional[int]:
+        """Choose the lane to preempt among ``candidates`` (already filtered
+        by the batcher for idleness and residency). Victims must be of equal
+        or LOWER importance than the requester (priority value >=
+        ``max_priority``); ordering is lowest priority class first, then
+        least-recently-stepped ("lru") or most pages held ("largest")."""
+        if self.policy == "off":
+            return None
+        now = time.monotonic()
+        best, best_key = None, None
+        for lane in candidates:
+            slot = self.lanes.get(lane)
+            if slot is None or slot.suspending or slot.swap is not None:
+                continue
+            if max_priority is not None and slot.priority < max_priority:
+                continue  # never preempt a more important session
+            if now - slot.resumed_at < self.resume_quantum_s:
+                continue  # just resumed: let it run its quantum (anti-thrash)
+            if self.policy == "largest":
+                key = (-slot.priority, -self.pages_fn(lane), slot.last_step)
+            else:  # lru
+                key = (-slot.priority, slot.last_step, -self.pages_fn(lane))
+            if best_key is None or key < best_key:
+                best, best_key = lane, key
+        return best
+
+    # --------------------------------------------------------- observability
+
+    @property
+    def suspended_count(self) -> int:
+        return sum(1 for s in self.lanes.values() if s.swap is not None)
+
+    def summary(self) -> dict:
+        return {
+            "policy": self.policy,
+            "suspended": self.suspended_count,
+            "swap_bytes_in_use": self.swap_pool.bytes_in_use,
+            "swap_bytes_total": self.swap_pool.max_size_bytes,
+            "swap_peak_bytes": self.swap_pool.stats["peak_bytes"],
+            "swap_rejected": self.swap_pool.stats["rejected"],
+            **self.stats,
+        }
+
+
+__all__ = [
+    "PREEMPTION_POLICIES",
+    "SESSION_PRIORITY_NORMAL",
+    "SessionScheduler",
+    "SessionSlot",
+    "SwapEntry",
+]

@@ -89,6 +89,8 @@ class Server:
         page_size: int = 64,  # paged KV: tokens per page; 0 = dense lane pool
         n_pages: Optional[int] = None,  # paged KV pool size; None = lanes * pages-per-lane
         prefill_token_budget: int = 512,  # prefill tokens folded into each mixed batched step
+        swap_host_bytes: int = 0,  # host-RAM KV swap tier (session preemption); 0 disables
+        preemption_policy: str = "lru",  # victim choice on pool exhaustion: lru | largest | off
         prefix_cache_bytes: int = 256 * 2**20,  # host-RAM prompt-prefix cache; 0 disables
         prefix_share_scope: str = "swarm",  # "peer" isolates the prefix cache per client identity
         prefix_device_bytes: int = 256 * 2**20,  # HBM tier of the prefix cache; 0 disables
@@ -191,6 +193,8 @@ class Server:
         self.page_size = page_size
         self.n_pages = n_pages
         self.prefill_token_budget = prefill_token_budget
+        self.swap_host_bytes = swap_host_bytes
+        self.preemption_policy = preemption_policy
         self.prefix_cache_bytes = prefix_cache_bytes
         self.prefix_share_scope = prefix_share_scope
         self.prefix_device_bytes = prefix_device_bytes
@@ -521,6 +525,13 @@ class Server:
                 self.handler.server_gen_params is not None
                 if getattr(self, "handler", None) is not None else None
             ),
+            # lane-pool / scheduler occupancy for load-aware routing and the
+            # health monitor; None on servers without continuous batching
+            pool=(
+                self.handler.batcher.occupancy_info()
+                if getattr(self, "handler", None) is not None
+                and self.handler.batcher is not None else None
+            ),
         )
 
     async def _announce(self, state: ServerState, expiration: Optional[float] = None) -> None:
@@ -630,6 +641,8 @@ class Server:
             page_size=self.page_size or None,
             n_pages=self.n_pages,
             prefill_token_budget=self.prefill_token_budget,
+            swap_host_bytes=self.swap_host_bytes,
+            preemption_policy=self.preemption_policy,
             prefix_cache_bytes=self.prefix_cache_bytes,
             prefix_share_scope=self.prefix_share_scope,
             prefix_device_bytes=self.prefix_device_bytes,

@@ -100,6 +100,10 @@ class HealthMonitor:
                     "quant_type": info.quant_type,
                     "public_name": info.public_name,
                     "relayed": bool(getattr(self._addr_book.get(peer_id), "relayed", False)),
+                    # lane-pool / scheduler occupancy (busy lanes, free pages,
+                    # suspended sessions, swap bytes, preemptions) — lets
+                    # operators and clients spot loaded servers at a glance
+                    "pool": info.pool,
                 }
             snapshot[prefix] = {
                 "public_name": meta.get("public_name"),
@@ -183,13 +187,23 @@ class HealthMonitor:
                 f"<small>({model['num_blocks']} blocks, {html.escape(str(model.get('model_type')))}"
                 f")</small> — {status}</h2><table border=1 cellpadding=4>"
                 "<tr><th>server</th><th>state</th><th>blocks</th><th>throughput</th>"
-                "<th>cache tokens left</th><th>quant</th><th>via relay</th></tr>"
+                "<th>cache tokens left</th><th>load</th><th>quant</th><th>via relay</th></tr>"
             )
             for peer, s in model["servers"].items():
+                pool = s.get("pool")
+                if pool:
+                    load = f"{pool.get('busy_lanes', 0)}/{pool.get('lanes', 0)} lanes"
+                    if pool.get("suspended"):
+                        load += f", {pool['suspended']} swapped"
+                    if pool.get("pages_free") is not None:
+                        load += f", {pool['pages_free']} pages free"
+                else:
+                    load = "—"
                 rows.append(
                     f"<tr><td><code>{peer[:12]}…</code> {html.escape(s.get('public_name') or '')}</td>"
                     f"<td>{s['state']}</td><td>[{s['blocks'][0]}, {s['blocks'][1]})</td>"
                     f"<td>{s['throughput']:.1f}</td><td>{s['cache_tokens_left']}</td>"
+                    f"<td>{html.escape(load)}</td>"
                     f"<td>{html.escape(str(s['quant_type']))}</td><td>{'yes' if s['relayed'] else 'no'}</td></tr>"
                 )
             rows.append("</table>")
