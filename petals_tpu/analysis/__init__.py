@@ -1,0 +1,21 @@
+"""swarmlint: static concurrency/tracer analysis + runtime lock sanitizer.
+
+- Static: ``python -m petals_tpu.analysis petals_tpu/`` (see .rules for the
+  rule set, .findings for the pragma grammar).
+- Runtime: set ``PETALS_TPU_SANITIZE=1`` so the server's locks are built by
+  ``sanitizer.make_thread_lock``/``make_async_lock`` wrappers that record
+  acquisition order and detect AB/BA cycles and await-under-thread-lock.
+"""
+
+from .findings import Finding
+from .engine import check_file, check_paths, check_source, unsuppressed
+from .rules import RULES
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "check_file",
+    "check_paths",
+    "check_source",
+    "unsuppressed",
+]

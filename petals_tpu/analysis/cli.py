@@ -1,0 +1,50 @@
+"""swarmlint CLI: ``python -m petals_tpu.analysis petals_tpu/``.
+
+Exit status 0 iff every finding is suppressed (with a reasoned pragma).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .engine import check_paths, unsuppressed
+from .rules import RULES
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m petals_tpu.analysis",
+        description="swarmlint: concurrency + tracer-safety invariants for petals_tpu",
+    )
+    parser.add_argument("paths", nargs="+", help="files or directories to check")
+    parser.add_argument(
+        "--rule",
+        action="append",
+        choices=sorted(RULES),
+        help="run only these rules (repeatable); default: all",
+    )
+    parser.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also print findings silenced by pragmas (with their reasons)",
+    )
+    args = parser.parse_args(argv)
+
+    findings = check_paths(args.paths, rules=args.rule)
+    failures = unsuppressed(findings)
+    shown = findings if args.show_suppressed else failures
+    for f in shown:
+        print(f.format())
+    n_sup = len(findings) - len(failures)
+    print(
+        f"swarmlint: {len(failures)} finding(s), {n_sup} suppressed "
+        f"({len(list(RULES))} rules)",
+        file=sys.stderr,
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

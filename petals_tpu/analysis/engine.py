@@ -1,0 +1,77 @@
+"""swarmlint engine: run every rule over a file tree, apply pragmas.
+
+Library entry points:
+
+- ``check_source(source, path)`` -> list[Finding] (pragmas applied)
+- ``check_file(path)`` / ``check_paths(paths)`` -> same, reading from disk
+- ``unsuppressed(findings)`` -> the findings that should fail a build
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Iterator, List, Optional, Sequence
+
+from .findings import Finding, apply_pragmas, parse_pragmas
+from .rules import RULES
+
+SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build", "dist"}
+
+
+def check_source(
+    source: str, path: str, rules: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Run the (selected) rules over one source string; apply its pragmas."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="syntax-error",
+                path=path,
+                line=e.lineno or 0,
+                message=f"file does not parse: {e.msg}",
+            )
+        ]
+    lines = source.splitlines()
+    selected = rules if rules is not None else list(RULES)
+    findings: List[Finding] = []
+    for name in selected:
+        for line, message in RULES[name](tree, lines, path):
+            findings.append(Finding(rule=name, path=path, line=line, message=message))
+    pragmas = parse_pragmas(lines)
+    findings = apply_pragmas(findings, pragmas, path, known_rules=list(RULES))
+    findings.sort(key=lambda f: (f.line, f.rule))
+    return findings
+
+
+def check_file(path: str, rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as f:
+        return check_source(f.read(), path, rules=rules)
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for root in paths:
+        if os.path.isfile(root):
+            if root.endswith(".py"):
+                yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def check_paths(
+    paths: Iterable[str], rules: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(check_file(path, rules=rules))
+    return findings
+
+
+def unsuppressed(findings: Iterable[Finding]) -> List[Finding]:
+    return [f for f in findings if not f.suppressed]

@@ -1,0 +1,131 @@
+"""Finding + pragma machinery for swarmlint (petals_tpu.analysis).
+
+A finding is one rule violation at one source line. Findings can be
+suppressed in-source with a pragma comment::
+
+    risky_call()  # swarmlint: disable=no-silent-except — reason why this is OK
+
+Pragma grammar:
+
+- ``# swarmlint: disable=<rule>[,<rule>...]`` followed by a REQUIRED
+  free-text reason (separated by ``—``, ``--``, ``:`` or whitespace).
+  A pragma without a reason is itself reported as a finding
+  (rule ``pragma-needs-reason``) and fails the CLI.
+- A trailing pragma suppresses matching findings on its own line.
+- A pragma on a comment-only line suppresses matching findings on the next
+  line that holds code (so multi-line statements can be annotated above).
+- ``disable=all`` suppresses every rule on the target line.
+
+Unknown rule names in a pragma are reported (rule ``pragma-unknown-rule``)
+so typos cannot silently disable nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+PRAGMA_RE = re.compile(
+    r"#\s*swarmlint:\s*disable=([A-Za-z0-9_,\- ]*?)(?:\s*(?:[—–:]|--)\s*(.*)|\s{2,}(.*))?$"
+)
+
+# pseudo-rules emitted by the pragma machinery itself (never suppressible)
+PRAGMA_NEEDS_REASON = "pragma-needs-reason"
+PRAGMA_UNKNOWN_RULE = "pragma-unknown-rule"
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    def format(self) -> str:
+        tag = " (suppressed: %s)" % self.suppress_reason if self.suppressed else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
+
+
+@dataclasses.dataclass
+class Pragma:
+    line: int  # line the pragma comment lives on (1-based)
+    target_line: int  # line whose findings it suppresses
+    rules: Tuple[str, ...]
+    reason: str
+
+
+def _is_code_line(text: str) -> bool:
+    stripped = text.strip()
+    return bool(stripped) and not stripped.startswith("#")
+
+
+def parse_pragmas(source_lines: Sequence[str]) -> List[Pragma]:
+    """Extract pragmas; comment-only pragmas attach to the next code line."""
+    pragmas: List[Pragma] = []
+    n = len(source_lines)
+    for i, text in enumerate(source_lines):
+        m = PRAGMA_RE.search(text)
+        if m is None:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        reason = (m.group(2) or m.group(3) or "").strip()
+        lineno = i + 1
+        target = lineno
+        if not _is_code_line(text[: m.start()] if m.start() else ""):
+            # comment-only line: attach to the next line holding code
+            j = i + 1
+            while j < n and not _is_code_line(source_lines[j]):
+                j += 1
+            if j < n:
+                target = j + 1
+        pragmas.append(Pragma(line=lineno, target_line=target, rules=rules, reason=reason))
+    return pragmas
+
+
+def apply_pragmas(
+    findings: List[Finding],
+    pragmas: Sequence[Pragma],
+    path: str,
+    known_rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Mark findings suppressed where a pragma covers (line, rule); emit
+    pragma-needs-reason / pragma-unknown-rule findings for malformed ones."""
+    by_line: Dict[int, List[Pragma]] = {}
+    out = list(findings)
+    for p in pragmas:
+        by_line.setdefault(p.target_line, []).append(p)
+        if not p.reason:
+            out.append(
+                Finding(
+                    rule=PRAGMA_NEEDS_REASON,
+                    path=path,
+                    line=p.line,
+                    message=(
+                        "suppression pragma must carry a reason: "
+                        "'# swarmlint: disable=<rule> — <why this is safe>'"
+                    ),
+                )
+            )
+        if known_rules is not None:
+            for r in p.rules:
+                if r != "all" and r not in known_rules:
+                    out.append(
+                        Finding(
+                            rule=PRAGMA_UNKNOWN_RULE,
+                            path=path,
+                            line=p.line,
+                            message=f"pragma disables unknown rule {r!r}",
+                        )
+                    )
+    for f in out:
+        if f.rule in (PRAGMA_NEEDS_REASON, PRAGMA_UNKNOWN_RULE):
+            continue
+        for p in by_line.get(f.line, ()):  # pragmas targeting this line
+            if ("all" in p.rules or f.rule in p.rules) and p.reason:
+                f.suppressed = True
+                f.suppress_reason = p.reason
+                break
+    return out

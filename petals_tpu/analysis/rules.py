@@ -1,0 +1,668 @@
+"""swarmlint rules: this codebase's concurrency + tracer invariants as AST checks.
+
+Each rule is a function ``rule(tree, source_lines, path) -> [(line, message)]``
+registered in ``RULES``. Rules are heuristic but *named*: a finding is either
+fixed or suppressed in-source with a reasoned pragma, so the whole tree stays
+reviewable by ``python -m petals_tpu.analysis petals_tpu/``.
+
+The rule set (motivation in each docstring):
+
+- no-blocking-under-lock    — event-loop stalls: blocking device/host calls
+                              inside ``async with <lock>`` bodies
+- no-await-under-thread-lock — awaiting while a threading.Lock is held wedges
+                              every other task needing that lock
+- lock-order                — declared hierarchy, checked on lexical nesting
+- paired-refcount           — incref/pin/adopt must have a release on exit paths
+- no-orphan-task            — create_task results must be held + observed
+- no-silent-except          — no broad swallow without log/raise in hot paths
+- tracer-safety             — no host branching/impurity inside jit bodies
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+Findings = List[Tuple[int, str]]
+
+# ------------------------------------------------------------------ helpers
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains (None for anything dynamic)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def last_segment(expr: ast.AST) -> Optional[str]:
+    """Final identifier of a with-context expression; calls resolve through
+    their callee (``self._lane_lock(lane)`` -> ``_lane_lock``)."""
+    e = expr
+    if isinstance(e, ast.Call):
+        e = e.func
+    if isinstance(e, ast.Attribute):
+        return e.attr
+    if isinstance(e, ast.Name):
+        return e.id
+    return None
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def walk_no_functions(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into nested function bodies (their code
+    runs at call time, not under the enclosing lock)."""
+    if isinstance(node, _FUNC_NODES):
+        return
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if not isinstance(child, _FUNC_NODES):
+            stack.extend(ast.iter_child_nodes(child))
+
+
+def iter_functions(tree: ast.AST) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+LOCK_TOKEN = re.compile(r"(lock|turnstile|mutex|semaphore)", re.IGNORECASE)
+
+
+def looks_like_lock(expr: ast.AST) -> bool:
+    seg = last_segment(expr)
+    return bool(seg and LOCK_TOKEN.search(seg))
+
+
+# --------------------------------------------------- no-blocking-under-lock
+
+BLOCKING_CALLS = {
+    "time.sleep",
+    "jax.block_until_ready",
+    "jax.device_get",
+    "jax.effects_barrier",
+}
+BLOCKING_METHODS = {"result", "block_until_ready"}  # X.result(), arr.block_until_ready()
+
+
+def rule_no_blocking_under_lock(tree, source_lines, path) -> Findings:
+    """No blocking host/device call inside an ``async with <lock>`` body: the
+    event loop stalls for every session, and on this server a stalled loop
+    also starves the compute queue's result futures."""
+    out: Findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.AsyncWith):
+            continue
+        if not any(looks_like_lock(item.context_expr) for item in node.items):
+            continue
+        for sub in [n for b in node.body for n in [b, *walk_no_functions(b)]]:
+            if not isinstance(sub, ast.Call):
+                continue
+            name = dotted(sub.func)
+            if name in BLOCKING_CALLS:
+                out.append(
+                    (sub.lineno, f"blocking call {name}() inside an async lock body")
+                )
+            elif (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in BLOCKING_METHODS
+                and not sub.args
+                and not sub.keywords
+            ):
+                out.append(
+                    (
+                        sub.lineno,
+                        f".{sub.func.attr}() inside an async lock body can block "
+                        "the event loop (await it or move it off-loop)",
+                    )
+                )
+    return out
+
+
+# ------------------------------------------------ no-await-under-thread-lock
+
+THREAD_LOCK_CTORS = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "make_thread_lock",
+    "sanitizer.make_thread_lock",
+}
+
+
+def collect_thread_lock_names(tree: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        value = None
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, targets = node.value, [node.target]
+        if not isinstance(value, ast.Call):
+            continue
+        callee = dotted(value.func)
+        if callee is None or callee.split(".", 1)[-1] not in {
+            c.split(".", 1)[-1] for c in THREAD_LOCK_CTORS
+        }:
+            if callee not in THREAD_LOCK_CTORS:
+                continue
+        if not (
+            callee in THREAD_LOCK_CTORS
+            or callee.endswith(".Lock")
+            or callee.endswith(".RLock")
+            or callee.endswith(".Condition")
+            or callee.endswith("make_thread_lock")
+        ):
+            continue
+        for t in targets:
+            seg = last_segment(t)
+            if seg:
+                names.add(seg)
+    return names
+
+
+def rule_no_await_under_thread_lock(tree, source_lines, path) -> Findings:
+    """Never ``await`` while holding a ``threading.Lock``/``RLock``: the lock
+    is NOT released at the suspension point, so the compute thread (or any
+    other task running a ``with`` on it via the loop) blocks a kernel thread
+    while the event loop believes it is making progress — the exact stall
+    ``batching._reset_lock`` is one un-reviewed edit away from."""
+    thread_locks = collect_thread_lock_names(tree)
+    if not thread_locks:
+        return []
+    out: Findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.With):
+            continue
+        held = [
+            last_segment(item.context_expr)
+            for item in node.items
+            if last_segment(item.context_expr) in thread_locks
+        ]
+        if not held:
+            continue
+        for sub in [n for b in node.body for n in [b, *walk_no_functions(b)]]:
+            if isinstance(sub, (ast.Await, ast.AsyncWith, ast.AsyncFor)):
+                out.append(
+                    (
+                        sub.lineno,
+                        f"await while holding thread lock {held[0]!r} "
+                        "(event-loop stall; release the lock first)",
+                    )
+                )
+    return out
+
+
+# ------------------------------------------------------------------ lock-order
+
+# Declared hierarchy for this codebase (lower level acquired first). All lane
+# locks share one level: ordering within a level is the sanitizer's job.
+LOCK_HIERARCHY: Dict[str, int] = {
+    "_open_lock": 0,
+    "_lane_lock": 10,
+    "_lane_locks": 10,
+    "_swap_in_turnstile": 20,
+    "_lock": 20,  # MemoryCache's pool lock
+    "_reset_lock": 30,
+    "_cv": 30,
+}
+
+
+def rule_lock_order(tree, source_lines, path) -> Findings:
+    """Locks must be taken in declared order (``_open_lock`` -> lane lock ->
+    pool lock/turnstile -> ``_reset_lock``): checked where statically
+    resolvable, i.e. on lexically nested with-blocks inside one function."""
+    out: Findings = []
+
+    def visit(node: ast.AST, held: List[Tuple[str, int]]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                visit(child, [])  # new call frame: nesting does not carry over
+                continue
+            pushed = 0
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    seg = last_segment(item.context_expr)
+                    if seg in LOCK_HIERARCHY:
+                        level = LOCK_HIERARCHY[seg]
+                        for h_seg, h_level in held:
+                            if h_level > level:
+                                out.append(
+                                    (
+                                        child.lineno,
+                                        f"acquires {seg!r} (level {level}) while "
+                                        f"holding {h_seg!r} (level {h_level}) — "
+                                        "violates the declared lock hierarchy",
+                                    )
+                                )
+                        held.append((seg, level))
+                        pushed += 1
+            visit(child, held)
+            for _ in range(pushed):
+                held.pop()
+
+    visit(tree, [])
+    return out
+
+
+# ------------------------------------------------------------ paired-refcount
+
+INCREF_CALLS = {"incref", "pin_lane_pages", "adopt_pages", "try_reserve"}
+RELEASE_CALLS = {
+    "decref",
+    "unpin_pages",
+    "free",
+    "release",
+    "release_lane",
+    "release_temp",
+}
+
+
+def rule_paired_refcount(tree, source_lines, path) -> Findings:
+    """Every incref/pin/adopt_pages/try_reserve needs a decref/release on ALL
+    exit paths of the taking function (i.e. reachable from a finally/except),
+    or an explicit ownership-transfer pragma — an unpaired reference leaks a
+    page (or swap bytes) forever on the first exception."""
+    out: Findings = []
+    for fn in iter_functions(tree):
+        inc_calls = []
+        rel_anywhere = False
+        rel_protected = False  # in a finally block or except handler
+        has_await = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Await):
+                has_await = True
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr in INCREF_CALLS:
+                    inc_calls.append(node)
+                elif attr in RELEASE_CALLS:
+                    rel_anywhere = True
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Try):
+                for region in [node.finalbody, *[h.body for h in node.handlers]]:
+                    for stmt in region:
+                        for sub in [stmt, *list(ast.walk(stmt))]:
+                            if (
+                                isinstance(sub, ast.Call)
+                                and isinstance(sub.func, ast.Attribute)
+                                and sub.func.attr in RELEASE_CALLS
+                            ):
+                                rel_protected = True
+        if not inc_calls:
+            continue
+        if not rel_anywhere:
+            out.append(
+                (
+                    inc_calls[0].lineno,
+                    f"{inc_calls[0].func.attr}() in {fn.name}() has no matching "
+                    "decref/release in this function (annotate ownership "
+                    "transfer with a pragma if intentional)",
+                )
+            )
+        elif has_await and not rel_protected:
+            out.append(
+                (
+                    inc_calls[0].lineno,
+                    f"{inc_calls[0].func.attr}() in {fn.name}() is not released "
+                    "on all exit paths (no decref/release in a finally/except, "
+                    "but the function can suspend or raise at an await)",
+                )
+            )
+    return out
+
+
+# ------------------------------------------------------------- no-orphan-task
+
+TASK_SPAWN = {"create_task", "ensure_future"}
+
+
+def _is_spawn(call: ast.AST) -> bool:
+    return (
+        isinstance(call, ast.Call)
+        and isinstance(call.func, (ast.Attribute, ast.Name))
+        and last_segment(call.func) in TASK_SPAWN
+    )
+
+
+def _target_key(target: ast.AST) -> Optional[Tuple[str, str]]:
+    """(kind, ident) used to look the stored task back up: Name -> its id,
+    Attribute -> the attr, Subscript -> the base name."""
+    if isinstance(target, ast.Name):
+        return ("name", target.id)
+    if isinstance(target, ast.Attribute):
+        return ("attr", target.attr)
+    if isinstance(target, ast.Subscript):
+        base = target.value
+        seg = last_segment(base)
+        return ("name", seg) if seg else None
+    return None
+
+
+def _matches_key(node: ast.AST, key: Tuple[str, str]) -> bool:
+    kind, ident = key
+    if kind == "name" and isinstance(node, ast.Name):
+        return node.id == ident
+    if kind == "attr" and isinstance(node, ast.Attribute):
+        return node.attr == ident
+    return False
+
+
+def _key_observed(scope: ast.AST, key: Tuple[str, str]) -> bool:
+    """True when the stored task is awaited (incl. via wait/gather/shield —
+    anything inside an Await subtree) or given a done-callback in ``scope``."""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Await):
+            if any(_matches_key(sub, key) for sub in ast.walk(node)):
+                return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_done_callback"
+            and any(_matches_key(sub, key) for sub in ast.walk(node.func.value))
+        ):
+            return True
+    return False
+
+
+def rule_no_orphan_task(tree, source_lines, path) -> Findings:
+    """Every asyncio.create_task/ensure_future result must be stored AND
+    observed (awaited, or given a done-callback): asyncio holds tasks weakly,
+    so an unstored task can be garbage-collected mid-flight, and an
+    unobserved one drops its exception on the floor."""
+    out: Findings = []
+    # map each function to its enclosing chain so attr-targets can fall back
+    # to a module-wide search (self._task assigned here, awaited in close())
+    enclosing: Dict[ast.AST, ast.AST] = {}
+    for fn in iter_functions(tree):
+        for child in ast.walk(fn):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) and child is not fn:
+                enclosing.setdefault(child, fn)
+
+    def scope_of(node_fn: Optional[ast.AST]) -> ast.AST:
+        return node_fn if node_fn is not None else tree
+
+    fn_of: Dict[int, ast.AST] = {}
+    for fn in iter_functions(tree):
+        for child in ast.walk(fn):
+            fn_of.setdefault(id(child), fn)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Expr) and _is_spawn(node.value):
+            out.append(
+                (
+                    node.lineno,
+                    "create_task result discarded: the task can be GC'd "
+                    "mid-flight and its exception is lost — store it and "
+                    "attach an exception-logging done-callback",
+                )
+            )
+            continue
+        if not isinstance(node, ast.Assign) or not _is_spawn(node.value):
+            continue
+        keys = [k for t in node.targets for k in [_target_key(t)] if k]
+        if not keys:
+            out.append((node.lineno, "create_task stored into an unresolvable target"))
+            continue
+        fn = fn_of.get(id(node))
+        observed = False
+        for key in keys:
+            if _key_observed(scope_of(fn), key):
+                observed = True
+                break
+            if key[0] == "attr" and _key_observed(tree, key):
+                observed = True  # attribute task observed elsewhere in module
+                break
+        if not observed:
+            out.append(
+                (
+                    node.lineno,
+                    f"task stored in {ast.unparse(node.targets[0])!r} is never "
+                    "awaited and has no done-callback: its exception would "
+                    "vanish silently",
+                )
+            )
+    return out
+
+
+# ------------------------------------------------------------ no-silent-except
+
+HOT_PATHS = ("/server/", "/ops/")
+LOGGING_BASES = ("logger", "logging", "warnings")
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    for node in [t] if not isinstance(t, ast.Tuple) else t.elts:
+        d = dotted(node)
+        if d:
+            names.append(d.split(".")[-1])
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def rule_no_silent_except(tree, source_lines, path) -> Findings:
+    """In server/ops hot paths, a broad ``except`` must re-raise, log, or use
+    the caught exception — a silent swallow hides the first signal of device
+    failures, refcount bugs, and protocol violations. Intentional best-effort
+    sites stay, but as annotated suppressions with a reason."""
+    norm = "/" + path.replace("\\", "/").lstrip("./")
+    if not any(p in norm for p in HOT_PATHS):
+        return []
+    out: Findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler) or not _handler_is_broad(node):
+            continue
+        has_raise = any(isinstance(n, ast.Raise) for s in node.body for n in ast.walk(s))
+        has_log = False
+        uses_exc = False
+        for s in node.body:
+            for n in ast.walk(s):
+                if isinstance(n, ast.Call):
+                    d = dotted(n.func) or ""
+                    root = d.split(".")[0]
+                    if root in LOGGING_BASES or (
+                        isinstance(n.func, ast.Attribute) and n.func.attr == "exception"
+                    ):
+                        has_log = True
+                if node.name and isinstance(n, ast.Name) and n.id == node.name:
+                    uses_exc = True
+        if not (has_raise or has_log or uses_exc):
+            out.append(
+                (
+                    node.lineno,
+                    "broad except swallows the exception silently (no raise, "
+                    "log, or use of the caught error) in a server/ops hot path",
+                )
+            )
+    return out
+
+
+# -------------------------------------------------------------- tracer-safety
+
+IMPURE_CALLS = {
+    "time.time",
+    "time.monotonic",
+    "time.perf_counter",
+    "time.time_ns",
+    "datetime.now",
+    "datetime.datetime.now",
+}
+IMPURE_PREFIXES = ("np.random.", "numpy.random.", "random.")
+SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+HOST_GUARDS = {"len", "isinstance", "getattr", "hasattr", "range"}
+
+
+def _jit_static_names(dec: ast.AST) -> Optional[Set[str]]:
+    """static_argnames of a jit decorator, or None when ``dec`` is not jit."""
+    target = dec
+    statics: Set[str] = set()
+    if isinstance(dec, ast.Call):
+        callee = dotted(dec.func)
+        if callee in ("functools.partial", "partial"):
+            if not dec.args:
+                return None
+            inner = dotted(dec.args[0])
+            if inner not in ("jax.jit", "jit"):
+                return None
+        elif callee not in ("jax.jit", "jit"):
+            return None
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                        statics.add(n.value)
+        return statics
+    name = dotted(target)
+    if name in ("jax.jit", "jit"):
+        return statics
+    return None
+
+
+def _param_names(fn) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in [*a.posonlyargs, *a.args, *a.kwonlyargs]]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _build_parents(root: ast.AST) -> Dict[int, ast.AST]:
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _guarded(name_node: ast.Name, parents: Dict[int, ast.AST]) -> bool:
+    """A traced-param reference is harmless when only its static metadata is
+    read: ``x.shape``/``x.ndim``/``len(x)``/``x is None`` etc."""
+    node: ast.AST = name_node
+    parent = parents.get(id(node))
+    while parent is not None:
+        if isinstance(parent, ast.Attribute) and parent.attr in SHAPE_ATTRS:
+            return True
+        if isinstance(parent, ast.Call):
+            callee = dotted(parent.func)
+            if callee in HOST_GUARDS:
+                return True
+            if isinstance(parent.func, ast.Attribute) and node is parent.func:
+                # x.astype(...) etc: the call itself is traced, keep climbing
+                pass
+        if isinstance(parent, ast.Compare):
+            comparators = [parent.left, *parent.comparators]
+            others = [c for c in comparators if c is not node]
+            if all(
+                isinstance(c, ast.Constant) and c.value is None for c in others
+            ) and all(isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops):
+                return True
+        if isinstance(parent, (ast.Subscript,)) and parent.value is node:
+            # x[...] stays traced; keep climbing
+            pass
+        node, parent = parent, parents.get(id(parent))
+    return False
+
+
+def _traced_refs(test: ast.AST, traced: Set[str], parents) -> List[ast.Name]:
+    return [
+        n
+        for n in ast.walk(test)
+        if isinstance(n, ast.Name) and n.id in traced and not _guarded(n, parents)
+    ]
+
+
+def rule_tracer_safety(tree, source_lines, path) -> Findings:
+    """Inside ``@jax.jit`` bodies: no Python branching on traced values (each
+    branch bakes ONE outcome into the compiled program or triggers a
+    recompile per distinct value), no ``int()``/``.item()`` forcing a device
+    sync, and no wall-clock/np.random impurity (traced once, then frozen as a
+    constant in every later step)."""
+    out: Findings = []
+    for fn in iter_functions(tree):
+        statics: Optional[Set[str]] = None
+        for dec in fn.decorator_list:
+            s = _jit_static_names(dec)
+            if s is not None:
+                statics = s
+                break
+        if statics is None:
+            continue
+        traced = {p for p in _param_names(fn) if p not in statics and p != "self"}
+        # nested defs (scan/cond bodies) trace their params too
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) and sub is not fn:
+                traced |= {p for p in _param_names(sub) if p not in statics}
+        parents = _build_parents(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func) or ""
+                if d in IMPURE_CALLS or any(d.startswith(p) for p in IMPURE_PREFIXES):
+                    out.append(
+                        (
+                            node.lineno,
+                            f"{d}() inside a jit body is traced ONCE and baked "
+                            "into the compiled program (wrong constants / no "
+                            "fresh randomness per step)",
+                        )
+                    )
+                elif isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+                    out.append(
+                        (
+                            node.lineno,
+                            ".item() inside a jit body forces a host sync / "
+                            "fails on tracers",
+                        )
+                    )
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in ("int", "float", "bool")
+                    and node.args
+                    and _traced_refs(node.args[0], traced, parents)
+                ):
+                    out.append(
+                        (
+                            node.lineno,
+                            f"{node.func.id}() on a traced value inside a jit "
+                            "body (concretization error or silent recompile "
+                            "per distinct value)",
+                        )
+                    )
+            elif isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                refs = _traced_refs(node.test, traced, parents)
+                if refs:
+                    out.append(
+                        (
+                            node.lineno,
+                            f"Python branch on traced value {refs[0].id!r} "
+                            "inside a jit body — use lax.cond/jnp.where, or "
+                            "mark the argument static",
+                        )
+                    )
+    return out
+
+
+# ------------------------------------------------------------------ registry
+
+RULES = {
+    "no-blocking-under-lock": rule_no_blocking_under_lock,
+    "no-await-under-thread-lock": rule_no_await_under_thread_lock,
+    "lock-order": rule_lock_order,
+    "paired-refcount": rule_paired_refcount,
+    "no-orphan-task": rule_no_orphan_task,
+    "no-silent-except": rule_no_silent_except,
+    "tracer-safety": rule_tracer_safety,
+}

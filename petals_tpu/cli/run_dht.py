@@ -11,6 +11,7 @@ import signal
 
 from petals_tpu.dht.node import DHTNode
 from petals_tpu.server.reachability import ReachabilityProtocol
+from petals_tpu.utils.asyncio_utils import log_exception_callback
 from petals_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -79,6 +80,7 @@ def main(argv=None) -> None:
                 logger.debug(f"Alive; routing table size: {len(node.table)}")
 
         task = asyncio.create_task(heartbeat())
+        task.add_done_callback(log_exception_callback(logger, "dht heartbeat"))
         await stop.wait()
         task.cancel()
         if relay is not None:

@@ -10,6 +10,7 @@ from typing import Dict, Optional, Tuple
 from petals_tpu.data_structures import PeerID
 from petals_tpu.rpc.client import RpcClient
 from petals_tpu.rpc.server import RpcError
+from petals_tpu.utils.asyncio_utils import log_exception_callback
 from petals_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -27,6 +28,9 @@ class ConnectionPool:
         self.connect_timeout = connect_timeout
         self._clients: Dict[tuple, RpcClient] = {}
         self._locks: Dict[tuple, asyncio.Lock] = {}
+        # strong refs to in-flight background closes (the loop holds tasks
+        # weakly; an unreferenced close could be GC'd before it runs)
+        self._bg_closes: set = set()
 
     async def get(self, host: str, port: int) -> RpcClient:
         return await self._get((host, port, None))
@@ -76,7 +80,12 @@ class ConnectionPool:
             client = self._clients.pop(key, None)
             if client is not None:
                 # close in the background: invalidate() is called from sync contexts
-                asyncio.ensure_future(self._close_quietly(client))
+                task = asyncio.ensure_future(self._close_quietly(client))
+                self._bg_closes.add(task)
+                task.add_done_callback(self._bg_closes.discard)
+                task.add_done_callback(
+                    log_exception_callback(logger, "connection close")
+                )
 
     @staticmethod
     async def _close_quietly(client: RpcClient) -> None:

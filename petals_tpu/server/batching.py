@@ -43,6 +43,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from petals_tpu.analysis.sanitizer import (
+    lock_try_acquire_nowait,
+    make_async_lock,
+    make_thread_lock,
+)
 from petals_tpu.data_structures import SESSION_PRIORITY_NORMAL
 from petals_tpu.ops.sampling import sampling_vectors
 from petals_tpu.server.memory_cache import (
@@ -53,6 +58,7 @@ from petals_tpu.server.memory_cache import (
 )
 from petals_tpu.server.scheduler import SessionScheduler, SwapEntry
 from petals_tpu.server.task_queue import PRIORITY_INFERENCE, PriorityTaskQueue
+from petals_tpu.utils.asyncio_utils import log_exception_callback
 from petals_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -184,7 +190,7 @@ class DecodeBatcher:
         # makes the compute thread's post-step generation-check + buffer swap
         # atomic w.r.t. the event loop's reset (check-then-update alone is a
         # TOCTOU: a reset landing between them would be overwritten)
-        self._reset_lock = threading.Lock()
+        self._reset_lock = make_thread_lock("batching._reset_lock")
         self._lane_generation: Dict[int, int] = {}
         self._free_lanes: List[int] = []
         self._lane_waiters: List[_LaneWaiter] = []
@@ -207,9 +213,9 @@ class DecodeBatcher:
         # racing _alloc_pages would each grab pages the others need and an
         # unlucky one could starve past its timeout; one-at-a-time, the head
         # gets every freed page and provably drains the queue
-        self._swap_in_turnstile = asyncio.Lock()
+        self._swap_in_turnstile = make_async_lock("batching._swap_in_turnstile")
         self._flush_task: Optional[asyncio.Task] = None
-        self._open_lock = asyncio.Lock()
+        self._open_lock = make_async_lock("batching._open_lock")
         self._closed = False
         # multi-host lockstep (parallel/multihost.py): lane ops broadcast so
         # every process mirrors the pool; extracted lanes live on workers as
@@ -553,6 +559,7 @@ class DecodeBatcher:
                 return None
             pages.append(page)
         for page in pages:
+            # swarmlint: disable=paired-refcount — ownership transfer: the refs belong to the caller (prefix cache), released via unpin_pages; no code below this loop can raise
             self._pages.incref(page)
         return pages
 
@@ -608,7 +615,9 @@ class DecodeBatcher:
     def _lane_lock(self, lane: int) -> asyncio.Lock:
         lock = self._lane_locks.get(lane)
         if lock is None:
-            lock = self._lane_locks[lane] = asyncio.Lock()
+            # one shared sanitizer name: lane locks are an equivalence class
+            # (never nested within each other except via trylock, below)
+            lock = self._lane_locks[lane] = make_async_lock("batching.lane_lock")
         return lock
 
     @contextlib.asynccontextmanager
@@ -693,9 +702,13 @@ class DecodeBatcher:
         if slot is None or slot.swap is not None or slot.suspending:
             return False
         lock = self._lane_lock(lane)
-        if lock.locked():
+        # non-blocking trylock (records no sanitizer order edge): a held lane
+        # lock means the lane is busy, i.e. not preemptable — and a blocking
+        # acquire would invert the lane-lock -> turnstile order, since
+        # _try_preempt can run with the swap-in turnstile held (_swap_in)
+        if not lock_try_acquire_nowait(lock):
             return False
-        async with lock:
+        try:
             if not self._lane_idle(lane, ignore_lock=True):
                 return False
             if sched.lanes.get(lane) is not slot or slot.swap is not None:
@@ -721,10 +734,11 @@ class DecodeBatcher:
                 slot.suspending = False
                 sched.stats["swap_aborted"] += 1
                 raise
-            except Exception:
+            except Exception as e:
                 # the gather is non-donating, so the pool is intact; degrade
                 # to the plain backpressure path rather than failing the
                 # REQUESTER for the victim's trouble
+                logger.warning("Swap-out gather for lane %d failed: %r", lane, e)
                 self.swap_pool.free(nbytes)
                 slot.suspending = False
                 sched.stats["swap_aborted"] += 1
@@ -756,6 +770,8 @@ class DecodeBatcher:
                 f"({self.swap_pool.bytes_in_use}/{self.swap_pool.max_size_bytes} B used)"
             )
             return True
+        finally:
+            lock.release()
 
     def _swap_out_device(self, pages: np.ndarray):
         """Compute-thread body: gather the victim's pages and land them in
@@ -946,9 +962,19 @@ class DecodeBatcher:
                 )
             fut = asyncio.get_running_loop().create_future()
             self._pending.append((lane, hidden, int(position), fut, self._generation))
-            if self._flush_task is None or self._flush_task.done():
-                self._flush_task = asyncio.create_task(self._flush_loop())
+            self._spawn_flush_loop()
             return await fut
+
+    def _spawn_flush_loop(self) -> None:
+        """(Re)start the flush loop if it is not already draining. The strong
+        reference in ``self._flush_task`` keeps the loop alive (asyncio holds
+        tasks weakly) and the done-callback surfaces a crashed drain — a
+        silently dead flush loop would hang every pending step future."""
+        if self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.create_task(self._flush_loop())
+            self._flush_task.add_done_callback(
+                log_exception_callback(logger, "decode flush loop")
+            )
 
     async def _flush_loop(self) -> None:
         while self._pending or self._gen_states or self._prefill_queue:
@@ -1143,8 +1169,7 @@ class DecodeBatcher:
                 outs=[],
             )
             self._prefill_queue.append(st)
-            if self._flush_task is None or self._flush_task.done():
-                self._flush_task = asyncio.create_task(self._flush_loop())
+            self._spawn_flush_loop()
             try:
                 return await st.future
             finally:
@@ -1220,8 +1245,7 @@ class DecodeBatcher:
                         seen[t0] = True
                     st.seen = seen
             self._gen_states[lane] = st
-            if self._flush_task is None or self._flush_task.done():
-                self._flush_task = asyncio.create_task(self._flush_loop())
+            self._spawn_flush_loop()
             try:
                 return await st.future
             finally:
@@ -1239,7 +1263,8 @@ class DecodeBatcher:
         try:
             k_pool, v_pool = self._buffers()
             broken = k_pool.is_deleted() or v_pool.is_deleted()
-        except Exception:
+        except Exception as e:
+            logger.debug("Pool liveness probe raised (treating as consumed): %r", e)
             broken = True
         if not broken:
             return  # routine failures (cancellation, rejects) leave the pool intact
@@ -1502,8 +1527,8 @@ class DecodeBatcher:
             return
         try:
             self.backend.release_temp(temp[0])
-        except Exception:
-            pass  # degraded group: the mirrors died with the workers
+        except Exception:  # swarmlint: disable=no-silent-except — best-effort by contract: a degraded lockstep group already dropped the mirrors with its workers
+            pass
 
     async def run_exclusive(
         self, lane: int, fn, *, size: int = 0, extract: bool = True,

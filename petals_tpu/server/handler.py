@@ -38,6 +38,7 @@ from petals_tpu.server.task_queue import (
     PRIORITY_TRAINING,
     PriorityTaskQueue,
 )
+from petals_tpu.utils.asyncio_utils import log_exception_callback
 from petals_tpu.utils.logging import get_logger
 from petals_tpu.utils.misc import is_dummy
 from petals_tpu.utils.tracing import device_annotation, get_tracer
@@ -533,14 +534,20 @@ class TransformerHandler:
                             )
                         )
                         break
-                    except Exception:
+                    except Exception as e:
                         if attempt == 19:
+                            logger.warning(
+                                "KV snapshot read kept failing after retries "
+                                "(skipping prefix store): %r", e,
+                            )
                             return
                         await asyncio.sleep(0.05)
-        except Exception:
+        except Exception as e:
             if lane_pages:
                 batcher.unpin_pages(lane_pages, lane_pages_epoch)
-            return  # storing is best-effort; the session must never notice
+            # storing is best-effort; the session must never notice
+            logger.debug("Prefix store skipped: %r", e)
+            return
         # device tier: single-device private sessions only — lane snapshots
         # are host-side, lockstep mirrors are per-process shards, and sliced
         # TP-sharded buffers would pin sharded HBM references of unclear
@@ -560,7 +567,7 @@ class TransformerHandler:
                 k_buf, v_buf = self.memory_cache.get_buffers(*handles)
                 k_dev = k_buf[:, :, L:boundary]
                 v_dev = v_buf[:, :, L:boundary]
-            except Exception:
+            except Exception:  # swarmlint: disable=no-silent-except — device-tier pin is opportunistic: a racing free only downgrades this entry to the host tier
                 k_dev = v_dev = None
         self.prefix_cache.put(
             keys, n_hit, k[:, :, L:], v[:, :, L:], out_full[:, L:boundary],
@@ -668,9 +675,14 @@ class TransformerHandler:
         with contextlib.suppress(Exception):
             loop = asyncio.get_event_loop()
             if loop.is_running():
-                loop.create_task(self._push_pool.close())
+                # strong refs: the loop holds tasks weakly, and an unreferenced
+                # close could be GC'd before it finishes tearing down
+                closers = [loop.create_task(self._push_pool.close())]
                 if self.batcher is not None:
-                    loop.create_task(self.batcher.close())
+                    closers.append(loop.create_task(self.batcher.close()))
+                self._shutdown_tasks = closers
+                for t in closers:
+                    t.add_done_callback(log_exception_callback(logger, "shutdown close"))
 
     # ------------------------------------------------------------------ helpers
 
@@ -1085,6 +1097,7 @@ class TransformerHandler:
                                     and len(e["pages"]) == spp
                                     for e in pc_entries
                                 ):
+                                    # swarmlint: disable=paired-refcount — ownership transfer: adopted refs belong to the lane's table row; release_lane / copy-on-write decref them
                                     batcher.adopt_pages(
                                         lane,
                                         [p for e in pc_entries for p in e["pages"]],
@@ -1279,6 +1292,9 @@ class TransformerHandler:
                                 batcher=batcher,
                             )
                         )
+                        pending_store.add_done_callback(
+                            log_exception_callback(logger, "prefix store")
+                        )
                 position += seq
                 gen_token_list = None
                 gen_n = step.get("gen_tokens")
@@ -1384,6 +1400,9 @@ class TransformerHandler:
                     )
                     self._push_tasks.add(task)
                     task.add_done_callback(self._push_tasks.discard)
+                    task.add_done_callback(
+                        log_exception_callback(logger, "output push")
+                    )
                 yield {"tensors": {"hidden": wire_out}, "position": position}
             finally:
                 if pending_store is not None and not pending_store.done():
@@ -1405,10 +1424,11 @@ class TransformerHandler:
                         except asyncio.CancelledError:
                             pending_store.cancel()
                             raise
-                        except Exception:
+                        except Exception as e:
                             # incl. TimeoutError and store-internal failures:
                             # storing is best-effort — an otherwise-successful
                             # stream must not error over a cache hiccup
+                            logger.debug("Prefix store abandoned at stream end: %r", e)
                             pending_store.cancel()
                         except BaseException:
                             # GeneratorExit (transport aclose), KeyboardInterrupt:
@@ -1435,8 +1455,9 @@ class TransformerHandler:
                 return await anext(requests)
             except StopAsyncIteration:
                 return None  # client half-closed
-            except Exception:
-                return None  # transport error: treat as half-close
+            except Exception as e:
+                logger.debug("Client stream error (treating as half-close): %r", e)
+                return None
 
         async def next_step():
             if "client" not in pending:

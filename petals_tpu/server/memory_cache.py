@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from petals_tpu.analysis.sanitizer import make_async_lock
 from petals_tpu.data_structures import Handle
 from petals_tpu.utils.logging import get_logger
 
@@ -182,7 +183,7 @@ class MemoryCache:
         self._handle_counter = 0
         self._allocated: Dict[Handle, TensorDescriptor] = {}
         self._buffers: Dict[Handle, Optional[jax.Array]] = {}
-        self._lock = asyncio.Lock()
+        self._lock = make_async_lock("memory_cache._lock")
         self._freed_event = asyncio.Event()
         self._waiter_queue: list = []  # FIFO fairness for oversubscribed allocs
 

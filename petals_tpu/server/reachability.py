@@ -79,7 +79,9 @@ async def check_direct_reachability(
                 client.call("reach.check", {"addr": own.to_string()}), 10.0
             )
             results.append(bool(reply.get("reachable")))
-        except Exception:
+        except Exception as e:
+            # a peer we cannot even ask is itself a (neutral) data point
+            logger.debug("reachability probe via %s failed: %r", peer, e)
             continue
     if not results:
         return None

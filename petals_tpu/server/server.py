@@ -27,6 +27,7 @@ from petals_tpu.server.from_pretrained import get_block_config, load_block_param
 from petals_tpu.server.handler import TransformerHandler
 from petals_tpu.server.memory_cache import MemoryCache
 from petals_tpu.utils.convert_block import QuantType, block_size_bytes, convert_block_params
+from petals_tpu.utils.asyncio_utils import log_exception_callback
 from petals_tpu.utils.dht_utils import declare_active_modules
 from petals_tpu.utils.logging import get_logger
 
@@ -400,12 +401,21 @@ class Server:
             # keep a strong ref: asyncio holds tasks weakly, and a collected
             # flush task would mean the capture never stops
             self._trace_flush_task = asyncio.create_task(_flush_trace())
+            self._trace_flush_task.add_done_callback(
+                log_exception_callback(logger, "trace flush")
+            )
 
         self._state = ServerState.ONLINE
         await self._announce(ServerState.ONLINE)
         self._announcer_task = asyncio.create_task(self._announce_loop())
+        self._announcer_task.add_done_callback(
+            log_exception_callback(logger, "announce loop")
+        )
         if self.mean_balance_check_period > 0:
             self._balancer_task = asyncio.create_task(self._balance_loop())
+            self._balancer_task.add_done_callback(
+                log_exception_callback(logger, "balance loop")
+            )
         self._ready.set()
         logger.info(f"Server ready: {self.contact_addr.to_string()} serving {self.module_uids}")
 
@@ -445,8 +455,9 @@ class Server:
         self._state = ServerState.OFFLINE
         try:
             await self._announce(ServerState.OFFLINE, expiration=dht_time() + 60)
-        except Exception:
-            pass
+        except Exception as e:
+            # best-effort: the DHT entry expires on its own if we cannot reach it
+            logger.debug("OFFLINE announce during drain failed: %r", e)
         if parked:
             logger.info(f"Draining: parked {parked} session(s) for migration")
         return parked
@@ -466,8 +477,8 @@ class Server:
                 pass
         try:
             await self._announce(ServerState.OFFLINE, expiration=dht_time() + 60)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("OFFLINE announce during shutdown failed: %r", e)
         from petals_tpu.utils.tracing import stop_jax_trace
 
         if self._trace_flush_task is not None:
@@ -773,8 +784,9 @@ class Server:
             await declare_active_modules(
                 self.dht, old_uids, self._server_info(ServerState.OFFLINE), dht_time() + 60
             )
-        except Exception:
-            pass
+        except Exception as e:
+            # best-effort: stale entries expire; the reload must not abort here
+            logger.debug("OFFLINE announce before span reload failed: %r", e)
         self.first_block = new_first_block
         self.module_uids = [
             make_uid(self.dht_prefix, i)
@@ -911,7 +923,7 @@ class Server:
             import jax as _jax
 
             atexit.unregister(_jax.distributed.shutdown)
-        except Exception:
+        except Exception:  # swarmlint: disable=no-silent-except — probing a version-dependent private hook: absence means there is nothing to unregister
             pass
 
         # local compute shape: the sp axis spanned the group, so locally it
@@ -936,7 +948,8 @@ class Server:
                 self.family, self.cfg, quant_type=self.quant_type,
                 attn_cache_bytes=self.attn_cache_bytes or 0,
             ) * local_tp
-        except Exception:
+        except Exception as e:
+            logger.warning("Local capacity estimate failed, keeping span size: %r", e)
             max_local = old_num
         self.num_blocks = max(1, min(old_num, max_local))
         self.module_uids = [

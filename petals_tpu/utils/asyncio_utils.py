@@ -3,9 +3,25 @@
 from __future__ import annotations
 
 import asyncio
-from typing import AsyncIterator, Awaitable, Optional, TypeVar
+from typing import AsyncIterator, Awaitable, Callable, Optional, TypeVar
 
 T = TypeVar("T")
+
+
+def log_exception_callback(logger, what: str) -> Callable[["asyncio.Task"], None]:
+    """Done-callback for fire-and-forget tasks: surface the exception that
+    asyncio would otherwise only mention at GC time (if ever). Attach with
+    ``task.add_done_callback(log_exception_callback(logger, "flush loop"))``
+    and keep a strong reference to the task — the loop holds tasks weakly."""
+
+    def _callback(task: "asyncio.Task") -> None:
+        if task.cancelled():
+            return
+        exc = task.exception()  # also marks the exception as retrieved
+        if exc is not None:
+            logger.warning("background task %s failed: %r", what, exc)
+
+    return _callback
 
 
 async def shield_and_wait(task: Awaitable[T]) -> T:

@@ -77,3 +77,42 @@ if os.environ.get("JAX_COMPILATION_CACHE_DIR") and not _smoke_run:
 
 # NOTE: pytest-asyncio is not installed; async tests must drive their own loop
 # via asyncio.run(...) inside a sync test function.
+
+import asyncio  # noqa: E402
+
+import pytest  # noqa: E402
+
+_SANITIZED_LANES = ("sched", "mixed", "pages")
+
+
+@pytest.fixture(autouse=True)
+def _swarmlint_sanitizer(request):
+    """Run the sched/mixed/pages concurrency lanes under the swarmlint runtime
+    sanitizer (petals_tpu.analysis.sanitizer): PETALS_TPU_SANITIZE=1 makes the
+    batcher/memory-cache locks record acquisition order (AB/BA detection), and
+    the loop policy's task trampoline catches awaits under a thread lock. Any
+    recorded violation fails the test at teardown with both stack traces."""
+    if not any(request.node.get_closest_marker(m) for m in _SANITIZED_LANES):
+        yield
+        return
+    from petals_tpu.analysis import sanitizer
+
+    old_env = os.environ.get("PETALS_TPU_SANITIZE")
+    os.environ["PETALS_TPU_SANITIZE"] = "1"
+    old_policy = asyncio.get_event_loop_policy()
+    asyncio.set_event_loop_policy(sanitizer.SanitizingEventLoopPolicy())
+    san = sanitizer.get_sanitizer()
+    san.reset()
+    try:
+        yield
+        violations = san.violations()
+        assert not violations, (
+            "runtime concurrency sanitizer recorded violation(s):\n\n"
+            + "\n\n".join(violations)
+        )
+    finally:
+        asyncio.set_event_loop_policy(old_policy)
+        if old_env is None:
+            os.environ.pop("PETALS_TPU_SANITIZE", None)
+        else:
+            os.environ["PETALS_TPU_SANITIZE"] = old_env

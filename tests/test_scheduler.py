@@ -448,6 +448,24 @@ def test_e2e_oversubscription_preemption(model_path):
                 ]
                 sessions.append((prefill, steps))
 
+            # per-step cyclic barrier (asyncio.Barrier is 3.11+): every driver
+            # re-syncs before submitting step k, so the four step requests hit
+            # the batcher together and a flush sees >= 2 pending lanes. The
+            # fixed-sleep pacing alone is flaky: once the jit cache is warm a
+            # device step finishes before the next client's request arrives and
+            # the lanes drift into lockstep-of-one (max_batch == 1). Waiting at
+            # the barrier keeps each lane IDLE while holding its pages — the
+            # same pool pressure the sleep was creating.
+            n_drivers = len(sessions)
+            step_waits = [0] * len(sessions[0][1])
+            step_gates = [asyncio.Event() for _ in sessions[0][1]]
+
+            async def step_sync(k):
+                step_waits[k] += 1
+                if step_waits[k] == n_drivers:
+                    step_gates[k].set()
+                await step_gates[k].wait()
+
             async def drive(prefill, steps, barrier):
                 stream = await client.open_stream("ptu.inference")
                 await stream.send({"uids": uids, "max_length": 40, "batch_size": 1})
@@ -457,12 +475,13 @@ def test_e2e_oversubscription_preemption(model_path):
                 await stream.send({"tensors": {"hidden": serialize_array(prefill)}})
                 reply = await stream.recv(timeout=120)
                 outs.append(deserialize_array(reply["tensors"]["hidden"]))
-                for h in steps:
+                for k, h in enumerate(steps):
                     # pace the stream like a real client (sampling between
                     # steps): lanes sit IDLE holding pages, so pool pressure
                     # must be resolved by preemption, not by a session
                     # finishing fast and releasing its pages first
                     await asyncio.sleep(0.05)
+                    await step_sync(k)
                     await stream.send({"tensors": {"hidden": serialize_array(h)}})
                     reply = await stream.recv(timeout=120)
                     outs.append(deserialize_array(reply["tensors"]["hidden"]))

@@ -1,0 +1,472 @@
+"""swarmlint self-tests: every rule gets a true positive, a clean negative,
+and a pragma suppression on fixture snippets; the runtime sanitizer gets an
+AB/BA cycle and an await-under-thread-lock it must detect; and the whole
+petals_tpu tree must lint clean (the same gate CI's lint-invariants lane runs).
+"""
+
+import asyncio
+import os
+import threading
+
+import pytest
+
+from petals_tpu.analysis import check_paths, check_source, unsuppressed
+from petals_tpu.analysis.cli import main as cli_main
+from petals_tpu.analysis.findings import (
+    PRAGMA_NEEDS_REASON,
+    PRAGMA_UNKNOWN_RULE,
+    parse_pragmas,
+)
+from petals_tpu.analysis import sanitizer
+from petals_tpu.analysis.sanitizer import (
+    SanitizedAsyncLock,
+    SanitizedThreadLock,
+    SanitizingEventLoopPolicy,
+    lock_try_acquire_nowait,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_hit(source, path="server/snippet.py"):
+    return {f.rule for f in unsuppressed(check_source(source, path))}
+
+
+def lines_hit(source, rule, path="server/snippet.py"):
+    return [
+        f.line for f in unsuppressed(check_source(source, path)) if f.rule == rule
+    ]
+
+
+# --------------------------------------------------------------- static rules
+
+
+def test_no_blocking_under_lock():
+    bad = (
+        "import time, jax\n"
+        "async def f(self):\n"
+        "    async with self._open_lock:\n"
+        "        time.sleep(1)\n"
+        "        fut.result()\n"
+        "        jax.block_until_ready(x)\n"
+    )
+    assert lines_hit(bad, "no-blocking-under-lock") == [4, 5, 6]
+    ok = (
+        "import time\n"
+        "async def f(self):\n"
+        "    async with self._open_lock:\n"
+        "        await asyncio.sleep(1)\n"
+        "    time.sleep(1)\n"  # outside the lock body: fine
+        "async def g(self):\n"
+        "    async with self._open_lock:\n"
+        "        def helper():\n"
+        "            time.sleep(1)\n"  # runs at call time, not under the lock
+        "        return helper\n"
+    )
+    assert "no-blocking-under-lock" not in rules_hit(ok)
+    suppressed = (
+        "import time\n"
+        "async def f(self):\n"
+        "    async with self._open_lock:\n"
+        "        time.sleep(1)  # swarmlint: disable=no-blocking-under-lock — test fixture\n"
+    )
+    assert "no-blocking-under-lock" not in rules_hit(suppressed)
+
+
+def test_no_await_under_thread_lock():
+    bad = (
+        "import threading, asyncio\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._reset_lock = threading.Lock()\n"
+        "    async def f(self):\n"
+        "        with self._reset_lock:\n"
+        "            await asyncio.sleep(0)\n"
+    )
+    assert lines_hit(bad, "no-await-under-thread-lock") == [7]
+    ok = (
+        "import threading, asyncio\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._reset_lock = threading.Lock()\n"
+        "    async def f(self):\n"
+        "        with self._reset_lock:\n"
+        "            x = 1\n"
+        "        await asyncio.sleep(0)\n"
+    )
+    assert "no-await-under-thread-lock" not in rules_hit(ok)
+    # make_thread_lock counts as a threading.Lock constructor too
+    factory = (
+        "from petals_tpu.analysis.sanitizer import make_thread_lock\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._reset_lock = make_thread_lock('r')\n"
+        "    async def f(self):\n"
+        "        with self._reset_lock:\n"
+        "            await g()  # swarmlint: disable=no-await-under-thread-lock — test fixture\n"
+    )
+    assert "no-await-under-thread-lock" not in rules_hit(factory)
+    assert lines_hit(factory.replace(
+        "  # swarmlint: disable=no-await-under-thread-lock — test fixture", ""
+    ), "no-await-under-thread-lock") == [7]
+
+
+def test_lock_order():
+    bad = (
+        "async def f(self):\n"
+        "    async with self._swap_in_turnstile:\n"
+        "        async with self._open_lock:\n"  # level 20 held, acquiring 0
+        "            pass\n"
+    )
+    assert lines_hit(bad, "lock-order") == [3]
+    ok = (
+        "async def f(self):\n"
+        "    async with self._open_lock:\n"
+        "        async with self._lane_lock(1):\n"
+        "            async with self._swap_in_turnstile:\n"
+        "                pass\n"
+        "    with self._reset_lock:\n"  # after releasing: a fresh chain
+        "        pass\n"
+    )
+    assert "lock-order" not in rules_hit(ok)
+    nested_fn = (
+        "async def f(self):\n"
+        "    async with self._swap_in_turnstile:\n"
+        "        async def later(self):\n"
+        "            async with self._open_lock:\n"  # other call frame: unknowable
+        "                pass\n"
+    )
+    assert "lock-order" not in rules_hit(nested_fn)
+    suppressed = (
+        "async def f(self):\n"
+        "    async with self._swap_in_turnstile:\n"
+        "        # swarmlint: disable=lock-order — test fixture\n"
+        "        async with self._open_lock:\n"
+        "            pass\n"
+    )
+    assert "lock-order" not in rules_hit(suppressed)
+
+
+def test_paired_refcount():
+    bad = (
+        "async def f(self, page):\n"
+        "    self._pages.incref(page)\n"
+        "    await self.work()\n"
+    )
+    assert lines_hit(bad, "paired-refcount") == [2]
+    unprotected = (
+        "async def f(self, page):\n"
+        "    self._pages.incref(page)\n"
+        "    await self.work()\n"
+        "    self._pages.decref(page)\n"  # skipped if work() raises
+    )
+    assert lines_hit(unprotected, "paired-refcount") == [2]
+    ok = (
+        "async def f(self, page):\n"
+        "    self._pages.incref(page)\n"
+        "    try:\n"
+        "        await self.work()\n"
+        "    finally:\n"
+        "        self._pages.decref(page)\n"
+    )
+    assert "paired-refcount" not in rules_hit(ok)
+    transfer = (
+        "def f(self, page):\n"
+        "    # swarmlint: disable=paired-refcount — test fixture\n"
+        "    self._pages.incref(page)\n"
+    )
+    assert "paired-refcount" not in rules_hit(transfer)
+
+
+def test_no_orphan_task():
+    bare = "async def f():\n    asyncio.create_task(work())\n"
+    assert lines_hit(bare, "no-orphan-task") == [2]
+    stored_unobserved = (
+        "async def f(self):\n"
+        "    self._task = asyncio.create_task(work())\n"
+    )
+    assert lines_hit(stored_unobserved, "no-orphan-task") == [2]
+    awaited = (
+        "async def f(self):\n"
+        "    t = asyncio.create_task(work())\n"
+        "    await t\n"
+    )
+    assert "no-orphan-task" not in rules_hit(awaited)
+    callback = (
+        "async def f(self):\n"
+        "    t = asyncio.create_task(work())\n"
+        "    t.add_done_callback(cb)\n"
+    )
+    assert "no-orphan-task" not in rules_hit(callback)
+    # attribute task observed in ANOTHER method of the module (close())
+    attr_elsewhere = (
+        "class S:\n"
+        "    async def f(self):\n"
+        "        self._task = asyncio.create_task(work())\n"
+        "    async def close(self):\n"
+        "        await self._task\n"
+    )
+    assert "no-orphan-task" not in rules_hit(attr_elsewhere)
+    gathered = (
+        "async def f(self):\n"
+        "    t = asyncio.create_task(work())\n"
+        "    await asyncio.gather(t)\n"
+    )
+    assert "no-orphan-task" not in rules_hit(gathered)
+
+
+def test_no_silent_except():
+    bad = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    assert lines_hit(bad, "no-silent-except") == [4]
+    # only server/ops are hot paths: the same snippet elsewhere is exempt
+    assert "no-silent-except" not in rules_hit(bad, path="client/snippet.py")
+    logged = bad.replace("        pass\n", "        logger.warning('g failed')\n")
+    assert "no-silent-except" not in rules_hit(logged)
+    uses_exc = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception as e:\n"
+        "        record(e)\n"
+    )
+    assert "no-silent-except" not in rules_hit(uses_exc)
+    narrow = bad.replace("except Exception:", "except KeyError:")
+    assert "no-silent-except" not in rules_hit(narrow)
+    suppressed = bad.replace(
+        "except Exception:",
+        "except Exception:  # swarmlint: disable=no-silent-except — test fixture",
+    )
+    assert "no-silent-except" not in rules_hit(suppressed)
+
+
+def test_tracer_safety():
+    bad = (
+        "import functools, time, jax\n"
+        "import numpy as np\n"
+        "@functools.partial(jax.jit, static_argnames=('k',))\n"
+        "def f(x, k):\n"
+        "    if x > 0:\n"
+        "        x = x + 1\n"
+        "    t = time.time()\n"
+        "    n = int(x)\n"
+        "    m = x.item()\n"
+        "    r = np.random.rand()\n"
+        "    return x\n"
+    )
+    assert lines_hit(bad, "tracer-safety") == [5, 7, 8, 9, 10]
+    ok = (
+        "import functools, jax\n"
+        "@functools.partial(jax.jit, static_argnames=('k',))\n"
+        "def f(x, k):\n"
+        "    if k > 2:\n"  # static arg: host branch is fine
+        "        x = x * 2\n"
+        "    if x.shape[0] > 4:\n"  # shape is static metadata
+        "        x = x[:4]\n"
+        "    if x is None:\n"  # identity-vs-None is host-decidable
+        "        return x\n"
+        "    return x\n"
+        "def g(z):\n"
+        "    if z > 0:\n"  # not jitted
+        "        return int(z)\n"
+        "    return -z\n"
+    )
+    assert "tracer-safety" not in rules_hit(ok)
+    suppressed = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:  # swarmlint: disable=tracer-safety — test fixture\n"
+        "        x = x + 1\n"
+        "    return x\n"
+    )
+    assert "tracer-safety" not in rules_hit(suppressed)
+
+
+def test_pragma_machinery():
+    # a pragma without a reason is itself a finding and suppresses nothing
+    no_reason = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:  # swarmlint: disable=no-silent-except\n"
+        "        pass\n"
+    )
+    hits = rules_hit(no_reason)
+    assert PRAGMA_NEEDS_REASON in hits and "no-silent-except" in hits
+    # unknown rule names are reported (typos cannot silently disable nothing)
+    typo = "x = 1  # swarmlint: disable=no-silent-excep — oops\n"
+    assert PRAGMA_UNKNOWN_RULE in rules_hit(typo)
+    # comment-only pragma attaches to the next code line
+    pragmas = parse_pragmas(
+        ["# swarmlint: disable=lock-order — why", "", "# plain comment", "code()"]
+    )
+    assert pragmas[0].target_line == 4 and pragmas[0].reason == "why"
+    # disable=all suppresses every rule on the line
+    all_sup = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:  # swarmlint: disable=all — test fixture\n"
+        "        pass\n"
+    )
+    assert "no-silent-except" not in rules_hit(all_sup)
+
+
+def test_cli_and_tree_clean(tmp_path, capsys):
+    # the shipped tree must lint clean: the same invariant CI enforces
+    findings = unsuppressed(check_paths([os.path.join(REPO_ROOT, "petals_tpu")]))
+    assert not findings, "\n".join(f.format() for f in findings)
+
+    bad = tmp_path / "server" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "import asyncio\n"
+        "async def f():\n"
+        "    asyncio.create_task(g())\n"
+    )
+    assert cli_main([str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "no-orphan-task" in out
+    bad.write_text("x = 1\n")
+    assert cli_main([str(tmp_path)]) == 0
+
+
+# ---------------------------------------------------------- runtime sanitizer
+
+
+def test_sanitizer_detects_ab_ba_cycle():
+    san = sanitizer.get_sanitizer()
+    san.reset()
+    a, b = SanitizedThreadLock("lockA"), SanitizedThreadLock("lockB")
+    with a:
+        with b:
+            pass
+    assert not san.violations()  # one order seen: no cycle yet
+    with b:
+        with a:
+            pass
+    violations = san.violations()
+    assert len(violations) == 1 and "lock-order cycle" in violations[0]
+    # both acquire-site stacks are in the report
+    assert violations[0].count("test_sanitizer_detects_ab_ba_cycle") >= 2
+    san.reset()
+    assert not san.violations()
+
+
+def test_sanitizer_async_lock_cycle_and_equivalence_class():
+    san = sanitizer.get_sanitizer()
+    san.reset()
+
+    async def scenario():
+        a, b = SanitizedAsyncLock("asyncA"), SanitizedAsyncLock("asyncB")
+        async with a:
+            async with b:
+                pass
+        async with b:
+            async with a:
+                pass
+        # same-name locks are an equivalence class: no self-edge, no cycle
+        l1, l2 = SanitizedAsyncLock("lane_lock"), SanitizedAsyncLock("lane_lock")
+        async with l1:
+            async with l2:
+                pass
+
+    asyncio.run(scenario())
+    violations = san.violations()
+    assert len(violations) == 1 and "asyncA" in violations[0]
+    san.reset()
+
+
+def test_sanitizer_trylock_records_no_edge():
+    san = sanitizer.get_sanitizer()
+    san.reset()
+
+    async def scenario():
+        turnstile = SanitizedAsyncLock("turnstile")
+        lane = SanitizedAsyncLock("lane")
+        async with lane:
+            async with turnstile:  # lane -> turnstile
+                pass
+        async with turnstile:
+            # the batcher's preemption path: try-acquire of a victim's lane
+            # lock under the turnstile must NOT count as turnstile -> lane
+            assert lock_try_acquire_nowait(lane)
+            lane.release()
+        async with lane:  # and the lane is actually usable again
+            pass
+
+    asyncio.run(scenario())
+    assert not san.violations()
+
+
+def test_sanitizer_trylock_respects_contention():
+    async def scenario():
+        lock = SanitizedAsyncLock("contended")
+        async with lock:
+            assert not lock_try_acquire_nowait(lock)
+        assert lock_try_acquire_nowait(lock)
+        lock.release()
+        # plain asyncio.Lock path of the helper
+        plain = asyncio.Lock()
+        assert lock_try_acquire_nowait(plain)
+        assert plain.locked() and not lock_try_acquire_nowait(plain)
+        plain.release()
+        assert not plain.locked()
+
+    asyncio.run(scenario())
+
+
+def test_sanitizer_detects_await_under_thread_lock():
+    san = sanitizer.get_sanitizer()
+    san.reset()
+    lock = SanitizedThreadLock("shim_reset_lock")
+
+    async def bad():
+        with lock:
+            await asyncio.sleep(0.01)
+
+    old_policy = asyncio.get_event_loop_policy()
+    asyncio.set_event_loop_policy(SanitizingEventLoopPolicy())
+    try:
+        asyncio.run(bad())
+    finally:
+        asyncio.set_event_loop_policy(old_policy)
+    violations = san.violations()
+    assert len(violations) == 1
+    assert "await while holding thread lock 'shim_reset_lock'" in violations[0]
+    san.reset()
+
+
+def test_sanitizer_policy_clean_when_lock_released_before_await():
+    san = sanitizer.get_sanitizer()
+    san.reset()
+    lock = SanitizedThreadLock("clean_lock")
+
+    async def good():
+        with lock:
+            x = sum(range(10))
+        await asyncio.sleep(0)
+        return x
+
+    old_policy = asyncio.get_event_loop_policy()
+    asyncio.set_event_loop_policy(SanitizingEventLoopPolicy())
+    try:
+        assert asyncio.run(good()) == 45
+    finally:
+        asyncio.set_event_loop_policy(old_policy)
+    assert not san.violations()
+
+
+def test_factories_return_plain_locks_when_disabled(monkeypatch):
+    monkeypatch.delenv("PETALS_TPU_SANITIZE", raising=False)
+    assert isinstance(sanitizer.make_thread_lock("x"), type(threading.Lock()))
+    assert isinstance(sanitizer.make_async_lock("x"), asyncio.Lock)
+    monkeypatch.setenv("PETALS_TPU_SANITIZE", "1")
+    assert isinstance(sanitizer.make_thread_lock("x"), SanitizedThreadLock)
+    assert isinstance(sanitizer.make_async_lock("x"), SanitizedAsyncLock)
