@@ -8,9 +8,10 @@ suppressed in-source with a pragma comment::
 Pragma grammar:
 
 - ``# swarmlint: disable=<rule>[,<rule>...]`` followed by a REQUIRED
-  free-text reason (separated by ``—``, ``--``, ``:`` or whitespace).
-  A pragma without a reason is itself reported as a finding
-  (rule ``pragma-needs-reason``) and fails the CLI.
+  free-text reason (separated by ``—``, ``--``, ``:`` or whitespace — a
+  single space after the last rule token is enough). A pragma without a
+  reason is itself reported as a finding (rule ``pragma-needs-reason``)
+  and fails the CLI.
 - A trailing pragma suppresses matching findings on its own line.
 - A pragma on a comment-only line suppresses matching findings on the next
   line that holds code (so multi-line statements can be annotated above).
@@ -26,9 +27,24 @@ import dataclasses
 import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
-PRAGMA_RE = re.compile(
-    r"#\s*swarmlint:\s*disable=([A-Za-z0-9_,\- ]*?)(?:\s*(?:[—–:]|--)\s*(.*)|\s{2,}(.*))?$"
-)
+# the payload must start with a rule character (or be empty) so prose that
+# merely MENTIONS the syntax, e.g. ``disable=<rule>``, is not itself a pragma
+PRAGMA_RE = re.compile(r"#\s*swarmlint:\s*disable=([A-Za-z0-9_\-].*)?$")
+
+# leading comma-joined rule tokens of the pragma payload
+_RULES_PREFIX_RE = re.compile(r"[A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*")
+# one explicit separator between the rule list and the reason; a bare single
+# space also counts, so ``disable=<rule> because ...`` parses cleanly
+_LEADING_SEP_RE = re.compile(r"^\s*(?:[—–:]|--)\s*|^\s+")
+
+
+def _split_rules_reason(rest: str) -> Tuple[str, str]:
+    """Split a pragma payload into (rule-list text, reason text)."""
+    m = _RULES_PREFIX_RE.match(rest)
+    if m is None:
+        return rest, ""  # malformed: surfaces via pragma-unknown-rule
+    rules_part, tail = rest[: m.end()], rest[m.end() :]
+    return rules_part, _LEADING_SEP_RE.sub("", tail, count=1).strip()
 
 # pseudo-rules emitted by the pragma machinery itself (never suppressible)
 PRAGMA_NEEDS_REASON = "pragma-needs-reason"
@@ -70,8 +86,8 @@ def parse_pragmas(source_lines: Sequence[str]) -> List[Pragma]:
         m = PRAGMA_RE.search(text)
         if m is None:
             continue
-        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
-        reason = (m.group(2) or m.group(3) or "").strip()
+        rules_part, reason = _split_rules_reason(m.group(1) or "")
+        rules = tuple(r.strip() for r in rules_part.split(",") if r.strip())
         lineno = i + 1
         target = lineno
         if not _is_code_line(text[: m.start()] if m.start() else ""):

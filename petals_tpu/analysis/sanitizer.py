@@ -1,7 +1,7 @@
 """Runtime concurrency sanitizer (opt-in: ``PETALS_TPU_SANITIZE=1``).
 
-Two detectors, both zero-cost when disabled (the factories hand back plain
-``threading.Lock``/``asyncio.Lock``):
+Two detectors, both zero-cost when disabled (the factories hand back a plain
+``threading.Lock`` / an unwrapped ``AsyncTryLock``):
 
 1. **Lock-order (AB/BA) cycles.** ``make_thread_lock(name)`` /
    ``make_async_lock(name)`` return wrappers that record, per execution
@@ -35,11 +35,13 @@ import asyncio
 import collections.abc
 import contextvars
 import dataclasses
+import itertools
 import os
 import threading
 import traceback
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
+from petals_tpu.utils.locks import AsyncTryLock
 from petals_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -65,11 +67,15 @@ _held: contextvars.ContextVar[Tuple["_HeldLock", ...]] = contextvars.ContextVar(
 )
 
 
+_next_seq = itertools.count(1).__next__  # GIL-atomic unique ids for acquires
+
+
 @dataclasses.dataclass(frozen=True)
 class _HeldLock:
     name: str
     kind: str  # "thread" | "async"
     stack: str  # formatted acquire-site stack
+    seq: int = 0  # unique per acquire: makes membership tests identity-like
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,12 +98,17 @@ class LockOrderSanitizer:
         self._edges: Dict[str, Dict[str, _Edge]] = {}
         self._violations: List[str] = []
         self._reported: set = set()
+        # seqs of entries released from a context other than their acquirer's
+        # (legal for threading.Lock); the acquirer's held-tuple is pruned of
+        # them lazily, since its contextvar can't be written from here
+        self._released_elsewhere: Set[int] = set()
 
     def reset(self) -> None:
         with self._mu:
             self._edges.clear()
             self._violations.clear()
             self._reported.clear()
+            self._released_elsewhere.clear()
 
     def violations(self) -> List[str]:
         with self._mu:
@@ -105,12 +116,31 @@ class LockOrderSanitizer:
 
     # ------------------------------------------------------------- recording
 
+    def _prune_held(self) -> Tuple[_HeldLock, ...]:
+        """Current context's held locks, minus entries whose lock was since
+        released from another context (acquire on loop thread, release in an
+        executor) — those would otherwise read as held-forever here."""
+        held = _held.get()
+        if held:
+            with self._mu:
+                if self._released_elsewhere:
+                    live = tuple(
+                        h for h in held if h.seq not in self._released_elsewhere
+                    )
+                    if len(live) != len(held):
+                        self._released_elsewhere.difference_update(
+                            h.seq for h in held
+                        )
+                        _held.set(live)
+                        return live
+        return held
+
     def note_acquire(self, name: str, kind: str, *, ordered: bool = True) -> _HeldLock:
         """Register a successful acquire in the current context; when
         ``ordered`` (a blocking acquire), add order edges from held locks."""
         stack = _capture_stack()
-        entry = _HeldLock(name=name, kind=kind, stack=stack)
-        held = _held.get()
+        entry = _HeldLock(name=name, kind=kind, stack=stack, seq=_next_seq())
+        held = self._prune_held()
         if ordered:
             for h in held:
                 if h.name != name:  # same name = equivalence class (lane locks)
@@ -119,16 +149,20 @@ class LockOrderSanitizer:
         return entry
 
     def note_release(self, entry: _HeldLock) -> None:
-        held = _held.get()
+        held = self._prune_held()
         if entry in held:
             idx = len(held) - 1 - held[::-1].index(entry)
             _held.set(held[:idx] + held[idx + 1 :])
-        # else: released from a different context (e.g. executor thread);
-        # that context's tuple dies with it, nothing to unwind here
+        else:
+            # released from a different context than it was acquired in; mark
+            # the seq so the acquirer's held-tuple is pruned at its next
+            # note_acquire/note_suspension instead of reading held-forever
+            with self._mu:
+                self._released_elsewhere.add(entry.seq)
 
     def note_suspension(self) -> None:
         """Called by the task trampoline at every coroutine yield."""
-        for h in _held.get():
+        for h in self._prune_held():
             if h.kind != "thread":
                 continue
             key = ("await-under-thread-lock", h.name)
@@ -206,20 +240,23 @@ class SanitizedThreadLock:
     def __init__(self, name: str):
         self._name = name
         self._lock = threading.Lock()
-        self._entries: Dict[int, _HeldLock] = {}  # holder thread id -> entry
+        # single holder at a time (non-reentrant); one slot means a release
+        # from a thread other than the acquirer's (legal for threading.Lock)
+        # still clears the right entry
+        self._entry: Optional[_HeldLock] = None
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         ok = self._lock.acquire(blocking, timeout)
         if ok:
             # timed/non-blocking acquires are trylocks: no incoming edges
             ordered = blocking and timeout == -1
-            self._entries[threading.get_ident()] = _SANITIZER.note_acquire(
+            self._entry = _SANITIZER.note_acquire(
                 self._name, "thread", ordered=ordered
             )
         return ok
 
     def release(self) -> None:
-        entry = self._entries.pop(threading.get_ident(), None)
+        entry, self._entry = self._entry, None
         self._lock.release()
         if entry is not None:
             _SANITIZER.note_release(entry)
@@ -236,11 +273,11 @@ class SanitizedThreadLock:
 
 
 class SanitizedAsyncLock:
-    """asyncio.Lock wrapper feeding the sanitizer."""
+    """AsyncTryLock wrapper feeding the sanitizer."""
 
     def __init__(self, name: str):
         self._name = name
-        self._lock = asyncio.Lock()
+        self._lock = AsyncTryLock()
         self._entry: Optional[_HeldLock] = None  # single holder at a time
 
     async def acquire(self) -> bool:
@@ -249,11 +286,11 @@ class SanitizedAsyncLock:
         return True
 
     def acquire_nowait(self) -> bool:
-        """Try-acquire without suspending (records no order edge). Relies on
-        event-loop atomicity: no await between the check and the take."""
-        if self._lock.locked():
+        """Try-acquire without suspending (records no order edge). The inner
+        AsyncTryLock refuses when held OR when a woken waiter is pending, so
+        this can never co-own the lock with a blocking acquirer."""
+        if not self._lock.acquire_nowait():
             return False
-        self._lock._locked = True  # asyncio.Lock fast path, release() undoes it
         self._entry = _SANITIZER.note_acquire(self._name, "async", ordered=False)
         return True
 
@@ -280,24 +317,30 @@ def make_thread_lock(name: str):
 
 
 def make_async_lock(name: str):
-    """An asyncio.Lock, sanitized when PETALS_TPU_SANITIZE is set."""
-    return SanitizedAsyncLock(name) if enabled() else asyncio.Lock()
+    """An AsyncTryLock (asyncio.Lock-compatible, safely try-lockable),
+    sanitizer-wrapped when PETALS_TPU_SANITIZE is set."""
+    return SanitizedAsyncLock(name) if enabled() else AsyncTryLock()
 
 
 def lock_try_acquire_nowait(lock) -> bool:
-    """Uniform non-blocking try-acquire for asyncio.Lock/SanitizedAsyncLock.
+    """Uniform non-blocking try-acquire for the locks ``make_async_lock``
+    hands out (AsyncTryLock / SanitizedAsyncLock).
 
     Callers must be on the event loop with no await between their own
-    ``locked()`` reasoning and this call (the check-and-take below is atomic
-    there). Sanitized locks route through ``acquire_nowait`` so the trylock
-    records no lock-order edge."""
+    ``locked()`` reasoning and this call (the check-and-take is atomic
+    there). Sanitized locks record no lock-order edge for the trylock.
+
+    A plain ``asyncio.Lock`` is rejected outright: its ``release()`` hands
+    ownership to a woken waiter while ``locked()`` still reads False, so no
+    outside trylock can be made safe without relying on CPython internals.
+    """
     nowait = getattr(lock, "acquire_nowait", None)
-    if nowait is not None:
-        return bool(nowait())
-    if lock.locked():
-        return False
-    lock._locked = True  # asyncio.Lock fast path; release() pairs with it
-    return True
+    if nowait is None:
+        raise TypeError(
+            "lock_try_acquire_nowait needs an acquire_nowait()-capable lock "
+            f"(AsyncTryLock / SanitizedAsyncLock), got {type(lock).__name__}"
+        )
+    return bool(nowait())
 
 
 # --------------------------------------------------------- task trampoline
@@ -373,6 +416,7 @@ class SanitizingEventLoopPolicy(asyncio.DefaultEventLoopPolicy):
 
 
 __all__ = [
+    "AsyncTryLock",
     "LockOrderSanitizer",
     "SanitizedAsyncLock",
     "SanitizedThreadLock",

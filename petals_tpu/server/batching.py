@@ -48,6 +48,7 @@ from petals_tpu.analysis.sanitizer import (
     make_async_lock,
     make_thread_lock,
 )
+from petals_tpu.utils.locks import AsyncTryLock
 from petals_tpu.data_structures import SESSION_PRIORITY_NORMAL
 from petals_tpu.ops.sampling import sampling_vectors
 from petals_tpu.server.memory_cache import (
@@ -207,7 +208,7 @@ class DecodeBatcher:
         )
         # per-lane asyncio locks serializing swap-out against swap-in, and an
         # in-flight op counter making lanes with ANY active work unpreemptable
-        self._lane_locks: Dict[int, asyncio.Lock] = {}
+        self._lane_locks: Dict[int, AsyncTryLock] = {}
         self._inflight: Dict[int, int] = {}
         # swap-ins serialize through this fair (FIFO-wakeup) lock: N resumers
         # racing _alloc_pages would each grab pages the others need and an
@@ -612,7 +613,7 @@ class DecodeBatcher:
     def _page_nbytes(self) -> int:
         return self.backend.cache_bytes_per_token() * self.page_size
 
-    def _lane_lock(self, lane: int) -> asyncio.Lock:
+    def _lane_lock(self, lane: int) -> AsyncTryLock:
         lock = self._lane_locks.get(lane)
         if lock is None:
             # one shared sanitizer name: lane locks are an equivalence class

@@ -24,6 +24,7 @@ from petals_tpu.analysis.sanitizer import (
     SanitizingEventLoopPolicy,
     lock_try_acquire_nowait,
 )
+from petals_tpu.utils.locks import AsyncTryLock
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -316,6 +317,21 @@ def test_pragma_machinery():
         "        pass\n"
     )
     assert "no-silent-except" not in rules_hit(all_sup)
+    # a natural single-space reason parses as a reason, not as extra rules
+    (p,) = parse_pragmas(
+        ["x = 1  # swarmlint: disable=no-silent-except because the caller retries"]
+    )
+    assert p.rules == ("no-silent-except",)
+    assert p.reason == "because the caller retries"
+    single_space = all_sup.replace(
+        "disable=all — test fixture", "disable=all test fixture"
+    )
+    hits = rules_hit(single_space)
+    assert "no-silent-except" not in hits
+    assert PRAGMA_NEEDS_REASON not in hits and PRAGMA_UNKNOWN_RULE not in hits
+    # multi-rule lists with spaces after commas still split on the reason
+    (p,) = parse_pragmas(["# swarmlint: disable=lock-order, no-orphan-task why not"])
+    assert p.rules == ("lock-order", "no-orphan-task") and p.reason == "why not"
 
 
 def test_cli_and_tree_clean(tmp_path, capsys):
@@ -412,12 +428,71 @@ def test_sanitizer_trylock_respects_contention():
             assert not lock_try_acquire_nowait(lock)
         assert lock_try_acquire_nowait(lock)
         lock.release()
-        # plain asyncio.Lock path of the helper
-        plain = asyncio.Lock()
+        # unwrapped AsyncTryLock path of the helper (sanitizer disabled)
+        plain = AsyncTryLock()
         assert lock_try_acquire_nowait(plain)
         assert plain.locked() and not lock_try_acquire_nowait(plain)
         plain.release()
         assert not plain.locked()
+        # a plain asyncio.Lock has no safe trylock: the helper must refuse
+        # loudly instead of poking CPython internals
+        with pytest.raises(TypeError):
+            lock_try_acquire_nowait(asyncio.Lock())
+
+    asyncio.run(scenario())
+
+
+@pytest.mark.parametrize("make", [AsyncTryLock, lambda: SanitizedAsyncLock("steal")])
+def test_trylock_never_steals_from_woken_waiter(make):
+    """release() wakes a blocking waiter; until that waiter's task resumes a
+    trylock must fail rather than co-own the lock with it (asyncio.Lock gets
+    this wrong: locked() reads False in that window)."""
+
+    async def scenario():
+        lock = make()
+        await lock.acquire()
+        inside = []
+
+        async def waiter():
+            await lock.acquire()
+            inside.append("enter")
+            await asyncio.sleep(0)  # hold across a tick: overlap would show
+            inside.append("exit")
+            lock.release()
+
+        t = asyncio.create_task(waiter())
+        await asyncio.sleep(0)  # waiter is now queued on the lock
+        lock.release()  # wakes the waiter; its task has NOT resumed yet
+        assert not lock_try_acquire_nowait(lock), "trylock stole a woken waiter's lock"
+        await t
+        assert inside == ["enter", "exit"]
+        # with no waiters left the trylock takes it normally
+        assert lock_try_acquire_nowait(lock)
+        lock.release()
+
+    asyncio.run(scenario())
+    sanitizer.get_sanitizer().reset()
+
+
+def test_async_try_lock_cancelled_waiter_hands_off_wakeup():
+    async def scenario():
+        lock = AsyncTryLock()
+        await lock.acquire()
+
+        async def waiter():
+            async with lock:
+                return "got it"
+
+        first = asyncio.create_task(waiter())
+        second = asyncio.create_task(waiter())
+        await asyncio.sleep(0)  # both queued, FIFO
+        lock.release()  # wakes `first`...
+        first.cancel()  # ...which is cancelled before it resumes
+        with pytest.raises(asyncio.CancelledError):
+            await first
+        # the wakeup must have been passed on, not lost
+        assert await asyncio.wait_for(second, timeout=1) == "got it"
+        assert not lock.locked()
 
     asyncio.run(scenario())
 
@@ -463,10 +538,37 @@ def test_sanitizer_policy_clean_when_lock_released_before_await():
     assert not san.violations()
 
 
+def test_thread_lock_release_from_other_thread_clears_held_state():
+    """threading.Lock may legally be released by a thread other than the
+    acquirer (acquire on the loop thread, release in an executor). The
+    sanitizer must not keep believing the acquiring context holds the lock —
+    that would fabricate lock-order edges and cycles afterwards."""
+    san = sanitizer.get_sanitizer()
+    san.reset()
+    a, b = SanitizedThreadLock("xthreadA"), SanitizedThreadLock("xthreadB")
+    a.acquire()
+    t = threading.Thread(target=a.release)
+    t.start()
+    t.join()
+    assert not a.locked()
+    with b:
+        pass  # stale state would record a phantom A -> B edge here
+    with b:
+        with a:  # B -> A: closes a false cycle iff the phantom edge exists
+            pass
+    assert san.violations() == []
+    # and the lock itself is fully reusable from this thread
+    with a:
+        pass
+    assert san.violations() == []
+    san.reset()
+
+
 def test_factories_return_plain_locks_when_disabled(monkeypatch):
     monkeypatch.delenv("PETALS_TPU_SANITIZE", raising=False)
     assert isinstance(sanitizer.make_thread_lock("x"), type(threading.Lock()))
-    assert isinstance(sanitizer.make_async_lock("x"), asyncio.Lock)
+    lock = sanitizer.make_async_lock("x")
+    assert isinstance(lock, AsyncTryLock) and not isinstance(lock, SanitizedAsyncLock)
     monkeypatch.setenv("PETALS_TPU_SANITIZE", "1")
     assert isinstance(sanitizer.make_thread_lock("x"), SanitizedThreadLock)
     assert isinstance(sanitizer.make_async_lock("x"), SanitizedAsyncLock)
