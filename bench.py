@@ -1323,11 +1323,57 @@ def _heavy_row_registry():
     }
 
 
+def _telemetry_counters() -> dict:
+    """Monotonic totals of the batcher-mirroring counters
+    (telemetry.instruments); the per-row DELTA of these shows which compiled
+    step variants a row actually exercised and at what volume."""
+    from petals_tpu.telemetry import instruments as tm
+
+    return {
+        "steps_dense": tm.STEPS_DENSE.value,
+        "steps_paged": tm.STEPS_PAGED.value,
+        "steps_mixed": tm.STEPS_MIXED.value,
+        "steps_gen": tm.STEPS_GEN.value,
+        "decode_tokens": tm.DECODE_TOKENS.value,
+        "preemptions": tm.PREEMPTIONS.value,
+        "alloc_failed": tm.ALLOC_FAILED.value,
+        "swap_out_bytes": tm.SWAP_OUT_BYTES.value,
+        "swap_in_bytes": tm.SWAP_IN_BYTES.value,
+    }
+
+
+def _telemetry_blob(before: dict) -> dict:
+    """Per-row telemetry attachment: counter deltas since ``before`` plus a
+    step-duration histogram summary. Histograms are process-cumulative, so
+    heavy rows (fresh subprocess each) see only their own steps; in-process
+    rows see the run so far — the counters_delta is the per-row signal."""
+    from petals_tpu.telemetry import instruments as tm
+
+    after = _telemetry_counters()
+    delta = {k: round(after[k] - before.get(k, 0), 3) for k in after}
+    steps = {}
+    for variant, child in (("dense", tm.STEP_DENSE), ("paged", tm.STEP_PAGED),
+                           ("mixed", tm.STEP_MIXED), ("gen", tm.STEP_GEN)):
+        snap = child.snapshot()
+        if not snap["count"]:
+            continue
+        steps[variant] = {
+            "count": snap["count"],
+            "mean_ms": round(1000.0 * snap["sum"] / snap["count"], 3),
+            "p50_ms": round(1000.0 * child.quantile(0.5), 3),
+            "p99_ms": round(1000.0 * child.quantile(0.99), 3),
+        }
+    return {"counters_delta": delta, "step_duration": steps}
+
+
 def _run_single_row(name: str) -> None:
     """--row child: run ONE registry row and print its JSON on the LAST
     stdout line (stderr streams through for progress)."""
     fn = _heavy_row_registry()[name]
+    before = _telemetry_counters()
     result = fn()
+    if isinstance(result, dict):
+        result["telemetry"] = _telemetry_blob(before)
     print(json.dumps(result), flush=True)
 
 
@@ -1533,7 +1579,10 @@ def main():
         # one failing DETAIL row must never sink the run: the metric line is
         # already out, and the remaining rows still carry this round's data
         try:
+            before = _telemetry_counters()
             details[name] = fn()
+            if isinstance(details[name], dict):
+                details[name]["telemetry"] = _telemetry_blob(before)
             print(f"# {label}: {json.dumps(details[name])}", file=sys.stderr)
         except Exception as e:
             print(f"# {label} failed: {e!r}", file=sys.stderr)
@@ -1578,8 +1627,10 @@ def main():
             print(f"# {label} failed: {e!r}", file=sys.stderr)
         write_details()
 
+    e2e_before = _telemetry_counters()
     e2e = asyncio.run(run_e2e_bench())
     details["e2e_8xllama7b"] = {k: round(v, 3) for k, v in e2e.items()}
+    details["e2e_8xllama7b"]["telemetry"] = _telemetry_blob(e2e_before)
     print(f"# e2e 7B-span: {json.dumps(details['e2e_8xllama7b'])}", file=sys.stderr)
     write_details()
 

@@ -16,6 +16,8 @@ The rule set (motivation in each docstring):
 - no-orphan-task            — create_task results must be held + observed
 - no-silent-except          — no broad swallow without log/raise in hot paths
 - tracer-safety             — no host branching/impurity inside jit bodies
+- no-unbounded-metric-labels — no request-controlled values (session/peer ids)
+                              as metric labels: unbounded series cardinality
 """
 
 from __future__ import annotations
@@ -655,6 +657,74 @@ def rule_tracer_safety(tree, source_lines, path) -> Findings:
     return out
 
 
+# ------------------------------------------- no-unbounded-metric-labels
+
+# Identifier fragments that mark a value as request-controlled: one metric
+# label fed from these on a public swarm means one SERIES PER CLIENT —
+# unbounded memory until the registry's cardinality cap silently routes
+# everything to the overflow series and the metric stops meaning anything.
+TAINTED_LABEL_NAMES = {
+    "session_id",
+    "peer_id",
+    "trace_id",
+    "request_id",
+    "client_id",
+    "uid",
+    "uids",
+    "session",
+    "peer",
+}
+
+
+def _label_value_names(node: ast.AST) -> Iterator[str]:
+    """Identifier-ish names reachable from one labels() argument value:
+    bare names, attribute tails (``slot.peer_id`` -> ``peer_id``), and both
+    of either's appearances inside f-strings / str() / formatting calls."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+
+
+def rule_no_unbounded_metric_labels(tree, source_lines, path) -> Findings:
+    """``.labels(...)`` with a request-controlled value (session/peer/trace
+    ids) creates one time series per client. The telemetry registry caps
+    cardinality, but hitting the cap degrades the whole metric to the
+    ``_overflow`` series — label sets must be STATIC (variant/mode/direction
+    enums), with per-request identity carried in spans and journal events
+    instead (telemetry/instruments.py)."""
+    out: Findings = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "labels"
+        ):
+            continue
+        values = list(node.args) + [kw.value for kw in node.keywords]
+        for value in values:
+            tainted = sorted(
+                {
+                    name
+                    for name in _label_value_names(value)
+                    if name.strip("_").lower() in TAINTED_LABEL_NAMES
+                }
+            )
+            if tainted:
+                out.append(
+                    (
+                        node.lineno,
+                        f"request-controlled value {tainted[0]!r} used as a "
+                        "metric label: one series per client is unbounded "
+                        "cardinality — use a static label set and put the id "
+                        "in a span/journal event instead",
+                    )
+                )
+                break
+    return out
+
+
 # ------------------------------------------------------------------ registry
 
 RULES = {
@@ -665,4 +735,5 @@ RULES = {
     "no-orphan-task": rule_no_orphan_task,
     "no-silent-except": rule_no_silent_except,
     "tracer-safety": rule_tracer_safety,
+    "no-unbounded-metric-labels": rule_no_unbounded_metric_labels,
 }

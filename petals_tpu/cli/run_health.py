@@ -1,6 +1,7 @@
 """Run the swarm health monitor (the health.petals.dev analogue):
 ``python -m petals_tpu.cli.run_health --initial_peers ADDR [--host H] [--port 8799]``
-Serves / (HTML), /api/v1/state (JSON), /api/v1/is_reachable/<peer>.
+Serves / (HTML), /api/v1/state (JSON), /api/v1/metrics (swarm telemetry
+aggregate), /api/v1/is_reachable/<peer>.
 """
 
 from __future__ import annotations
@@ -30,6 +31,7 @@ def main(argv=None) -> None:
         )
         await monitor.start()
         print(f"http://{args.host}:{monitor.port}/", flush=True)
+        print(f"http://{args.host}:{monitor.port}/api/v1/metrics", flush=True)
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):

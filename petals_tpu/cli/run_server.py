@@ -133,6 +133,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="disable the device-side greedy generation loop on full-span servers")
     parser.add_argument("--prefix_device_bytes", type=int, default=256 * 2**20,
                         help="HBM tier of the prefix cache (device-resident hit seeding); 0 disables")
+    parser.add_argument("--metrics_port", type=int, default=None,
+                        help="Serve Prometheus-text /metrics (plus the /journal scheduler "
+                             "event log) on this local HTTP port; 0 = ephemeral, "
+                             "omit to disable")
     parser.add_argument("--prefix_share_scope", choices=["swarm", "peer"], default="swarm",
                         help="'swarm' shares cached prefixes across all clients (fastest; a client "
                              "can time-probe whether a prompt prefix was recently served); 'peer' "
@@ -235,6 +239,7 @@ def main(argv=None) -> None:
         prefix_share_scope=args.prefix_share_scope,
         prefix_device_bytes=args.prefix_device_bytes,
         server_side_generation=not args.no_server_side_generation,
+        metrics_port=args.metrics_port,
     )
 
     async def run():

@@ -68,6 +68,7 @@ class _ServerInferenceSession:
         step_timeout: float = 5 * 60,
         session_id: Optional[str] = None,
         push_to: Optional[dict] = None,
+        trace_id: Optional[str] = None,
     ) -> "_ServerInferenceSession":
         stub: RpcClient = await seq_manager.get_stub(span.peer_id)
         stream = await stub.open_stream("ptu.inference")
@@ -90,6 +91,13 @@ class _ServerInferenceSession:
             open_msg["session_id"] = session_id
         if push_to:
             open_msg["push_to"] = push_to
+        if trace_id:
+            # request-scoped trace id minted by InferenceSession: every server
+            # span of this session tags its telemetry (spans, journal events,
+            # metrics) with it, so one client request reconstructs as a single
+            # causal timeline across the swarm. Unknown to old servers, which
+            # ignore unrecognized open-message keys.
+            open_msg["trace_id"] = trace_id
         # optional scheduling-priority hint; absent -> the server's default
         # ("normal"), so old servers and default configs behave identically
         priority = getattr(seq_manager.config, "session_priority", None)
@@ -224,6 +232,12 @@ class InferenceSession:
         # prompt-prefix routing affinity: same prompt -> same replicas ->
         # server-side prefix-cache hits (sequence_manager._edge_cost)
         self._affinity_seed: Optional[int] = None
+        # one trace id for the whole session, minted at the client: every
+        # server span (including repaired replacements) opens with it, so the
+        # session's full life is one causal timeline in swarm telemetry
+        from petals_tpu.telemetry import new_trace_id
+
+        self.trace_id: str = new_trace_id()
 
     @property
     def position(self) -> int:
@@ -470,6 +484,7 @@ class InferenceSession:
                     batch_size=self.batch_size,
                     session_id=session_ids[i],
                     push_to=push_to,
+                    trace_id=self.trace_id,
                 )
                 sessions.append(session)
             return sessions
@@ -705,6 +720,7 @@ class InferenceSession:
                     self.seq_manager, span, uids,
                     max_length=self.max_length, batch_size=self.batch_size,
                     session_id=uuid.uuid4().hex,
+                    trace_id=self.trace_id,
                 )
                 created.append(session)
                 # gather [span.start, span.end) KV from the covering sessions

@@ -233,6 +233,9 @@ class RemoteSequenceManager:
         _, streak = self._banned.get(peer_id, (0.0, 0))
         duration = min(self.config.ban_timeout * (2**streak), 300.0)
         self._banned[peer_id] = (time.monotonic() + duration, streak + 1)
+        from petals_tpu.telemetry import instruments as tm
+
+        tm.PEER_BANS.inc()
         logger.debug(f"Banned {peer_id} for {duration:.1f}s (streak {streak + 1})")
 
     def on_request_success(self, peer_id: PeerID) -> None:
@@ -390,6 +393,9 @@ class RemoteSequenceManager:
             ]
             raise MissingBlocksError(missing)
 
+        from petals_tpu.telemetry import instruments as tm
+
+        tm.ROUTE_BUILDS.labels(mode=mode).inc()
         if self.config.show_route:
             route = " => ".join(
                 f"{s.peer_id.to_string()[:8]} [{s.start}:{s.end}] ({s.throughput:.1f} rps)"

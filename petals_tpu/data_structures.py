@@ -183,6 +183,11 @@ class ServerInfo:
     # occupancy_info) so clients and the health monitor can route around
     # loaded servers; None on servers without continuous batching
     pool: Optional[Dict[str, Any]] = None
+    # compact telemetry digest (telemetry.exposition.telemetry_digest):
+    # tok/s over the announce window, TTFT/step percentiles, swap bytes,
+    # failure counters — the swarm-aggregation input for run_health's
+    # /api/v1/metrics view. Kept small: it rides every DHT announce.
+    telemetry: Optional[Dict[str, Any]] = None
 
     def to_tuple(self) -> Tuple[int, float, dict]:
         extra_info = dataclasses.asdict(self)

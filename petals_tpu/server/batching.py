@@ -59,6 +59,8 @@ from petals_tpu.server.memory_cache import (
 )
 from petals_tpu.server.scheduler import SessionScheduler, SwapEntry
 from petals_tpu.server.task_queue import PRIORITY_INFERENCE, PriorityTaskQueue
+from petals_tpu.telemetry import get_journal
+from petals_tpu.telemetry import instruments as tm
 from petals_tpu.utils.asyncio_utils import log_exception_callback
 from petals_tpu.utils.logging import get_logger
 
@@ -106,6 +108,8 @@ class _LanePrefillState:
     cap: int  # per-step chunk cap (chunk_plan byte sizing)
     n_total: int  # final sequence length (longrope factor selection)
     outs: List[np.ndarray]
+    enqueued: float = 0.0  # time.perf_counter() at admission (queue-wait metric)
+    wait_observed: bool = False  # first chunk already recorded the queue wait
 
 
 @dataclasses.dataclass
@@ -118,6 +122,9 @@ class _LaneWaiter:
     priority: int
     peer_id: Optional[str]
     seq: int
+    # request trace id (telemetry.trace): pre-admission, so the scheduler
+    # slot doesn't exist yet — the waiter carries it for journal events
+    trace_id: Optional[str] = None
 
 
 class DecodeBatcher:
@@ -234,6 +241,11 @@ class DecodeBatcher:
             "exclusive_chunks": 0, "prefill_tokens": 0, "mixed_steps": 0,
             "max_prefill_tokens_per_step": 0,
         }
+        # swarm telemetry plane: every admission / victim-selection / swap
+        # decision is journaled WITH the occupancy snapshot that justified it
+        # (telemetry.journal), and the pool gauges/counters feed the /metrics
+        # endpoint + the announce digest
+        self._journal = get_journal()
 
     # ------------------------------------------------------------------ pool
 
@@ -328,6 +340,7 @@ class DecodeBatcher:
         *,
         priority: int = SESSION_PRIORITY_NORMAL,
         peer_id: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> int:
         """Borrow a lane; queues when all lanes are taken — the allocation-
         pressure behavior of MemoryCache, at lane granularity. Parked callers
@@ -340,14 +353,24 @@ class DecodeBatcher:
         tokens) — the lane grows page-by-page via prepare_write, and a full
         page pool exerts the same waiter backpressure as a full lane list
         (preempting an idle victim first when the swap tier is enabled)."""
-        lane = await self._acquire_lane(timeout=timeout, priority=priority, peer_id=peer_id)
-        self._scheduler.register(lane, peer_id, int(priority))
+        t_wait = time.perf_counter()
+        lane = await self._acquire_lane(
+            timeout=timeout, priority=priority, peer_id=peer_id, trace_id=trace_id
+        )
+        self._scheduler.register(lane, peer_id, int(priority), trace_id=trace_id)
         if self.page_size is not None:
             try:
                 await self.prepare_write(lane, 0, 1, timeout=timeout)
             except BaseException:
                 self.release_lane(lane)
                 raise
+        self._journal.event(
+            "admission", trace_id=trace_id, lane=lane,
+            occupancy=self.occupancy_info(),
+            priority=int(priority),
+            wait_s=round(time.perf_counter() - t_wait, 6),
+        )
+        self._note_occupancy()
         return lane
 
     async def _acquire_lane(
@@ -355,6 +378,7 @@ class DecodeBatcher:
         timeout: Optional[float] = None,
         priority: int = SESSION_PRIORITY_NORMAL,
         peer_id: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> int:
         await self.ensure_open(timeout=timeout)
         if self._closed:
@@ -370,6 +394,7 @@ class DecodeBatcher:
             priority=int(priority),
             peer_id=peer_id,
             seq=next(self._waiter_seq),
+            trace_id=trace_id,
         )
         fut = waiter.fut
         self._lane_waiters.append(waiter)
@@ -382,6 +407,7 @@ class DecodeBatcher:
                 lane = fut.result()  # resolved in the cancellation race window
                 self._lane_generation[lane] = self._generation
                 return lane
+            tm.ALLOC_FAILED.inc()
             raise AllocationFailed(
                 f"No free decode lane within {timeout} s ({self._occupancy()})"
             )
@@ -442,9 +468,19 @@ class DecodeBatcher:
                 break
             self._lane_waiters.remove(w)
             if not w.fut.done():
+                # the pick_waiter POLICY decision, with its justification:
+                # who was chosen (priority / fair share) over how many others
+                self._journal.event(
+                    "waiter_picked", trace_id=w.trace_id, lane=lane,
+                    occupancy=self.occupancy_info(),
+                    priority=w.priority,
+                    waiters=len(self._lane_waiters) + 1,
+                )
                 w.fut.set_result(lane)
+                self._note_occupancy()
                 return
         self._free_lanes.append(lane)
+        self._note_occupancy()
 
     # ------------------------------------------------------------------ pages
 
@@ -494,6 +530,7 @@ class DecodeBatcher:
                     continue
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
+                    tm.ALLOC_FAILED.inc()
                     raise AllocationFailed(
                         f"No free KV page within {timeout} s ({self._occupancy()})"
                     )
@@ -689,6 +726,18 @@ class DecodeBatcher:
         victim = sched.pick_victim(candidates, max_priority=max_priority)
         if victim is None:
             return False
+        # journal the DECISION (outcome shows as a following swap_out event
+        # or its absence): who was evicted, for whom, under what occupancy
+        self._journal.event(
+            "victim_selected",
+            trace_id=sched.trace_id_of(victim),
+            lane=victim,
+            occupancy=self.occupancy_info(),
+            requester_lane=exclude,
+            requester_trace_id=sched.trace_id_of(exclude),
+            policy=sched.policy,
+            candidates=list(candidates),
+        )
         return await self._swap_out_lane(victim)
 
     async def _swap_out_lane(self, lane: int) -> bool:
@@ -766,6 +815,14 @@ class DecodeBatcher:
             slot.suspending = False
             sched.stats["preemptions"] += 1
             sched.stats["swap_outs"] += 1
+            tm.PREEMPTIONS.inc()
+            tm.SWAP_OUT_BYTES.inc(nbytes)
+            self._journal.event(
+                "swap_out", trace_id=slot.trace_id, lane=lane,
+                occupancy=self.occupancy_info(),
+                pages=int(slots.size), nbytes=nbytes,
+            )
+            self._note_occupancy()
             logger.debug(
                 f"Preempted lane {lane}: {slots.size} pages -> host swap "
                 f"({self.swap_pool.bytes_in_use}/{self.swap_pool.max_size_bytes} B used)"
@@ -830,6 +887,13 @@ class DecodeBatcher:
         slot.resumed_at = time.monotonic()
         self.swap_pool.free(entry.nbytes)
         sched.stats["swap_ins"] += 1
+        tm.SWAP_IN_BYTES.inc(entry.nbytes)
+        self._journal.event(
+            "swap_in", trace_id=slot.trace_id, lane=lane,
+            occupancy=self.occupancy_info(),
+            pages=int(entry.slots.size), nbytes=entry.nbytes,
+        )
+        self._note_occupancy()
         logger.debug(f"Resumed lane {lane}: {entry.slots.size} pages swapped in")
 
     def _swap_in_device(self, lane: int, entry, pages: np.ndarray) -> None:
@@ -879,6 +943,7 @@ class DecodeBatcher:
                 continue
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                tm.ALLOC_FAILED.inc()
                 raise AllocationFailed(
                     f"No free KV page for swap-in within {timeout} s ({self._occupancy()})"
                 )
@@ -893,6 +958,18 @@ class DecodeBatcher:
                 pass  # loop once more to produce the AllocationFailed message
 
     # -------------------------------------------------------- observability
+
+    def _note_occupancy(self) -> None:
+        """Refresh the pool gauges. Called at admission/release/swap
+        boundaries — occupancy only changes there, so the decode tick path
+        pays nothing for these."""
+        busy = (self.n_lanes - len(self._free_lanes)) if self.is_open else 0
+        tm.LANES_BUSY.set(busy)
+        if self.page_size is not None:
+            tm.PAGES_TOTAL.set(self.n_pages)
+            tm.PAGES_FREE.set(
+                self._pages.n_free if self._pages is not None else self.n_pages
+            )
 
     def _occupancy(self) -> str:
         """Human-readable pool occupancy for AllocationFailed messages: lane
@@ -1106,6 +1183,11 @@ class DecodeBatcher:
             aligned = end - end % self.page_size
             if aligned > st.position:
                 take = aligned - st.position
+        if not st.wait_observed:
+            # first chunk entering a step: the admission -> first-compute gap
+            st.wait_observed = True
+            if st.enqueued:
+                tm.PREFILL_QUEUE_WAIT.observe(time.perf_counter() - st.enqueued)
         return st, max(int(take), 1)
 
     def _advance_prefill(self, st: _LanePrefillState, take: int, chunk_out) -> None:
@@ -1168,6 +1250,7 @@ class DecodeBatcher:
                 cap=int(max(plan)),
                 n_total=position + total,
                 outs=[],
+                enqueued=time.perf_counter(),
             )
             self._prefill_queue.append(st)
             self._spawn_flush_loop()
@@ -1318,6 +1401,7 @@ class DecodeBatcher:
         # rematerialized zeros must fail loudly, never resolve futures
         if batch and batch[0][4] != self._generation:
             raise AllocationFailed("Lane pool was reset before this batched step ran")
+        t_step = time.perf_counter()
         hsz = self.backend.hidden_size
         hidden = np.zeros((self.n_lanes, 1, hsz), np.float32)
         positions = np.full((self.n_lanes,), self.max_length, np.int32)  # idle sentinel
@@ -1351,6 +1435,14 @@ class DecodeBatcher:
         self.stats["batched_steps"] += 1
         self.stats["batched_tokens"] += len(batch)
         self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
+        duration = time.perf_counter() - t_step
+        if self.page_size is not None:
+            tm.STEP_PAGED.observe(duration)
+            tm.STEPS_PAGED.inc()
+        else:
+            tm.STEP_DENSE.observe(duration)
+            tm.STEPS_DENSE.inc()
+        tm.DECODE_TOKENS.inc(len(batch))
         return host_out
 
     def _run_batch_mixed(self, batch, pf) -> Tuple[np.ndarray, np.ndarray]:
@@ -1362,6 +1454,7 @@ class DecodeBatcher:
         expected = batch[0][4] if batch else st.generation
         if expected != self._generation or st.generation != self._generation:
             raise AllocationFailed("Lane pool was reset before this batched step ran")
+        t_step = time.perf_counter()
         hsz = self.backend.hidden_size
         hidden = np.zeros((self.n_lanes, 1, hsz), np.float32)
         positions = np.full((self.n_lanes,), self.max_length, np.int32)  # idle sentinel
@@ -1391,6 +1484,9 @@ class DecodeBatcher:
         self.stats["max_prefill_tokens_per_step"] = max(
             self.stats["max_prefill_tokens_per_step"], take
         )
+        tm.STEP_MIXED.observe(time.perf_counter() - t_step)
+        tm.STEPS_MIXED.inc()
+        tm.DECODE_TOKENS.inc(len(batch))
         return host_out, host_chunk
 
     def _run_batch_gen(self, batch, gen_states) -> Tuple[np.ndarray, np.ndarray]:
@@ -1405,6 +1501,7 @@ class DecodeBatcher:
             st.generation != self._generation for st in gen_states.values()
         ):
             raise AllocationFailed("Lane pool was reset before this batched step ran")
+        t_step = time.perf_counter()
         hsz = self.backend.hidden_size
         hidden = np.zeros((self.n_lanes, 1, hsz), np.float32)
         positions = np.full((self.n_lanes,), self.max_length, np.int32)  # idle sentinel
@@ -1457,6 +1554,9 @@ class DecodeBatcher:
         self.stats["max_gen_lanes"] = max(
             self.stats["max_gen_lanes"], len(gen_states)
         )
+        tm.STEP_GEN.observe(time.perf_counter() - t_step)
+        tm.STEPS_GEN.inc()
+        tm.DECODE_TOKENS.inc(len(batch) + len(gen_states))
         return host_out, host_toks
 
     # ------------------------------------------------------- non-batchable ops

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -38,6 +39,14 @@ from petals_tpu.server.task_queue import (
     PRIORITY_TRAINING,
     PriorityTaskQueue,
 )
+from petals_tpu.telemetry import (
+    new_trace_id,
+    normalize_trace_id,
+    reset_trace_id,
+    set_trace_id,
+)
+from petals_tpu.telemetry import instruments as tm
+from petals_tpu.telemetry.exposition import telemetry_digest
 from petals_tpu.utils.asyncio_utils import log_exception_callback
 from petals_tpu.utils.logging import get_logger
 from petals_tpu.utils.misc import is_dummy
@@ -840,6 +849,9 @@ class TransformerHandler:
             n_blocks=self.backend.n_blocks,
             dht_prefix=self.dht_prefix,
             tracing=get_tracer().summary(),
+            # compact metrics digest (tok/s, TTFT/step percentiles, swap
+            # pressure) — same blob that rides ServerInfo on the DHT
+            telemetry=telemetry_digest(),
         )
         if self.batcher is not None:
             info["continuous_batching"] = {
@@ -882,6 +894,15 @@ class TransformerHandler:
         reply_comp = self._reply_compression(open_msg)  # for every step reply
         active_adapter = open_msg.get("active_adapter")
         session_id = open_msg.get("session_id")
+        # Request-scoped trace identity: the client mints it at session open
+        # and sends it in the open message; a missing or malformed id gets a
+        # server-minted one so the causal timeline exists for old clients
+        # too. It tags every span below, rides the scheduler slot, and keys
+        # the admission/preemption journal events.
+        trace_id = normalize_trace_id(open_msg.get("trace_id")) or new_trace_id()
+        _trace_token = set_trace_id(trace_id)
+        t_open = time.perf_counter()
+        ttft_observed = False
         # where to push our outputs: {"addr": "host:port/peer", "session_id": ...}
         push_to = open_msg.get("push_to")
         backend = self._sub_backend(start, end)
@@ -918,6 +939,7 @@ class TransformerHandler:
                     timeout=30.0 if alloc_timeout is None else alloc_timeout,
                     priority=priority,
                     peer_id=peer.to_string() if peer is not None else None,
+                    trace_id=trace_id,
                 )
             except AllocationFailed as e:
                 logger.debug(f"No decode lane ({e}); serving with a private cache")
@@ -949,7 +971,11 @@ class TransformerHandler:
                     "batch_size": batch_size, "max_length": max_length,
                 }
                 self._session_registry[session_id] = reg
-            yield {"session_open": True, "position": 0, "max_length": max_length}
+            # echo the trace id so the client learns a server-minted one
+            yield {
+                "session_open": True, "position": 0, "max_length": max_length,
+                "trace_id": trace_id,
+            }
 
             next_step, cleanup_steps = self._step_source(
                 requests, push_queue, self.session_timeout
@@ -1156,7 +1182,7 @@ class TransformerHandler:
                             pos = hit_len
 
                 with get_tracer().span(
-                    "inference_step", annotate=False,
+                    "inference_step", annotate=False, trace_id=trace_id,
                     blocks=end - start, batch=batch_size, seq=seq,
                 ):
                     if exec_hidden.shape[1] == 0:
@@ -1166,9 +1192,11 @@ class TransformerHandler:
                     elif lane is not None and seq == 1 and prompts is None and hypo_ids is None:
                         # the continuous-batching hot path: one token, coalesced
                         # with whatever other sessions are stepping right now
+                        t_tok = time.perf_counter()
                         out = await asyncio.wait_for(
                             batcher.step(lane, hidden, pos), self.step_timeout
                         )
+                        tm.TOKEN_LATENCY.observe(time.perf_counter() - t_tok)
                     elif (
                         lane is not None and prompts is None and hypo_ids is None
                         and batcher.page_size is not None
@@ -1382,6 +1410,11 @@ class TransformerHandler:
                     gen_token_list = [int(t) for t in gen_arr[0]]
                 if reg is not None:
                     reg["position"] = position
+                if not ttft_observed:
+                    # first content-bearing reply of the session: open ->
+                    # first token out, queue wait and prefill included
+                    ttft_observed = True
+                    tm.TTFT.observe(time.perf_counter() - t_open)
                 if gen_token_list is not None:
                     # the client computes everything it needs from the token
                     # ids; skipping the hidden reply saves the prefill-sized
@@ -1439,6 +1472,9 @@ class TransformerHandler:
                 if session_id:
                     self._push_queues.pop(session_id, None)
                     self._session_registry.pop(session_id, None)
+                # drop the ambient trace id (reset_trace_id tolerates the
+                # generator resuming under a different Context at teardown)
+                reset_trace_id(_trace_token)
 
     @staticmethod
     def _step_source(requests, push_queue, timeout):

@@ -72,6 +72,10 @@ class SessionSlot:
     lane: int
     peer_id: Optional[str]
     priority: int  # SESSION_PRIORITY_*: lower value = more important
+    # request-scoped trace id (telemetry.trace): the contextvar cannot cross
+    # into the flush loop or the compute thread, so the slot carries it —
+    # victim/swap journal events read it from here to tag the right session
+    trace_id: Optional[str] = None
     last_step: int = 0  # scheduler clock tick of the most recent step
     swap: Optional[SwapEntry] = None  # non-None while suspended
     suspending: bool = False  # swap-out in flight (device gather queued)
@@ -123,13 +127,24 @@ class SessionScheduler:
 
     # ------------------------------------------------------------- lifecycle
 
-    def register(self, lane: int, peer_id: Optional[str], priority: int) -> SessionSlot:
+    def register(
+        self,
+        lane: int,
+        peer_id: Optional[str],
+        priority: int,
+        trace_id: Optional[str] = None,
+    ) -> SessionSlot:
         self._clock += 1
         slot = SessionSlot(
-            lane=lane, peer_id=peer_id, priority=int(priority), last_step=self._clock
+            lane=lane, peer_id=peer_id, priority=int(priority),
+            trace_id=trace_id, last_step=self._clock,
         )
         self.lanes[lane] = slot
         return slot
+
+    def trace_id_of(self, lane: int) -> Optional[str]:
+        slot = self.lanes.get(lane)
+        return slot.trace_id if slot is not None else None
 
     def unregister(self, lane: int) -> None:
         slot = self.lanes.pop(lane, None)

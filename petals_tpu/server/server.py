@@ -96,6 +96,7 @@ class Server:
         prefix_share_scope: str = "swarm",  # "peer" isolates the prefix cache per client identity
         prefix_device_bytes: int = 256 * 2**20,  # HBM tier of the prefix cache; 0 disables
         server_side_generation: bool = True,  # device-side greedy loop on full-span servers
+        metrics_port: Optional[int] = None,  # Prometheus /metrics HTTP port; None disables, 0 = ephemeral
     ):
         self.num_hosts = num_hosts or 1
         self.coordinator_address = coordinator_address
@@ -227,6 +228,8 @@ class Server:
         self.network_mbps = network_mbps
         self._relay_registrar = None
         self._contact_addr = None  # non-default announce addr (relay circuit)
+        self.metrics_port = metrics_port
+        self._metrics_server = None  # telemetry.exposition.MetricsServer when enabled
 
     # ------------------------------------------------------------------ lifecycle
 
@@ -405,6 +408,16 @@ class Server:
                 log_exception_callback(logger, "trace flush")
             )
 
+        if self.metrics_port is not None:
+            from petals_tpu.telemetry.exposition import MetricsServer
+
+            try:
+                self._metrics_server = MetricsServer(port=self.metrics_port)
+                logger.info(f"Prometheus /metrics on port {self._metrics_server.port}")
+            except OSError as e:  # port taken: serve without scrape endpoint
+                logger.warning(f"Could not bind metrics port {self.metrics_port}: {e}")
+                self._metrics_server = None
+
         self._state = ServerState.ONLINE
         await self._announce(ServerState.ONLINE)
         self._announcer_task = asyncio.create_task(self._announce_loop())
@@ -484,6 +497,9 @@ class Server:
         if self._trace_flush_task is not None:
             self._trace_flush_task.cancel()
         stop_jax_trace()
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
         if self.num_hosts > 1 and self.backend is not None:
             # release the lockstep workers before the handler dies — they sit
             # in a blocking broadcast wait otherwise
@@ -543,7 +559,19 @@ class Server:
                 if getattr(self, "handler", None) is not None
                 and self.handler.batcher is not None else None
             ),
+            # per-server telemetry digest: the announce loop's cadence makes
+            # the tok/s figure an update_period-window average
+            telemetry=self._telemetry_digest(),
         )
+
+    def _telemetry_digest(self) -> Optional[dict]:
+        from petals_tpu.telemetry.exposition import telemetry_digest
+
+        try:
+            return telemetry_digest()
+        except Exception as e:  # an announce must never fail over metrics
+            logger.debug("telemetry digest failed: %r", e)
+            return None
 
     async def _announce(self, state: ServerState, expiration: Optional[float] = None) -> None:
         expiration = expiration or (dht_time() + max(2 * self.update_period, 60.0))

@@ -1,0 +1,71 @@
+"""Swarm telemetry plane: metrics registry, request-scoped trace context,
+scheduler event journal, and Prometheus-text exposition.
+
+Dependency-free by design (stdlib only), mirroring the zero-dep posture of
+``utils/health.py``: servers in a public swarm cannot assume a Prometheus
+client library is installed, and the decode tick path cannot afford one.
+
+Layering contract: this package imports NOTHING from the rest of
+``petals_tpu`` (``utils/tracing.py`` and the server stack import *us*), so
+any module — client, RPC, batcher, compute thread — can record without
+creating an import cycle.
+
+The pieces:
+
+- :mod:`.registry` — Counter/Gauge/Histogram with bounded label
+  cardinality; exceeding the cap is surfaced AS a metric
+  (``telemetry_label_overflow_total``), never silent growth.
+- :mod:`.trace` — ``trace_id`` minting + contextvar propagation: the
+  client mints one per session, carries it in the RPC open message, and
+  every span/journal event downstream tags it so one session's life
+  reconstructs as a single causal timeline.
+- :mod:`.journal` — bounded structured event log of scheduler decisions
+  (admission, victim selection, swap in/out) WITH the occupancy snapshot
+  that justified each one; replayable as JSONL, assertable in tests.
+- :mod:`.exposition` — Prometheus text rendering + a stdlib
+  ``http.server`` ``/metrics`` endpoint, and the compact digest published
+  in ServerInfo via the DHT announce path.
+- :mod:`.instruments` — the shared named instruments (TTFT, step
+  duration, swap bytes, ...) pre-registered on the global registry.
+"""
+
+from petals_tpu.telemetry.journal import TelemetryJournal, get_journal
+from petals_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from petals_tpu.telemetry.trace import (
+    current_trace_id,
+    new_trace_id,
+    normalize_trace_id,
+    reset_trace_id,
+    set_trace_id,
+    trace_context,
+)
+from petals_tpu.telemetry.exposition import (
+    MetricsServer,
+    render_prometheus,
+    telemetry_digest,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "MetricsServer",
+    "TelemetryJournal",
+    "current_trace_id",
+    "get_journal",
+    "get_registry",
+    "new_trace_id",
+    "normalize_trace_id",
+    "render_prometheus",
+    "reset_trace_id",
+    "set_trace_id",
+    "telemetry_digest",
+    "trace_context",
+]

@@ -1,0 +1,96 @@
+"""The swarm's shared named instruments, pre-registered on the global
+registry. Hot paths import these module-level singletons (or pre-resolve a
+labeled child once) so recording is a direct method call — no registry
+lookup per tick.
+
+Label sets here are STATIC (variant/direction enums) — never session ids,
+peer ids, or anything else a client controls; swarmlint's
+``no-unbounded-metric-labels`` rule enforces that repo-wide.
+"""
+
+from __future__ import annotations
+
+from petals_tpu.telemetry.registry import get_registry
+
+REGISTRY = get_registry()
+
+# --- request latency -------------------------------------------------------
+TTFT = REGISTRY.histogram(
+    "petals_ttft_seconds",
+    "Time from session open to the first reply token leaving the handler",
+)
+TOKEN_LATENCY = REGISTRY.histogram(
+    "petals_token_latency_seconds",
+    "Per-token server-side decode latency (single-token batched step)",
+)
+PREFILL_QUEUE_WAIT = REGISTRY.histogram(
+    "petals_prefill_queue_wait_seconds",
+    "Time a prefill spent queued before its first chunk entered a mixed step",
+)
+
+# --- compiled step ---------------------------------------------------------
+STEP_DURATION = REGISTRY.histogram(
+    "petals_step_duration_seconds",
+    "Compiled batched-step wall time by variant",
+    labels=("variant",),  # dense | paged | mixed | gen
+)
+BATCHED_STEPS = REGISTRY.counter(
+    "petals_batched_steps_total",
+    "Compiled batched steps executed, by variant",
+    labels=("variant",),
+)
+DECODE_TOKENS = REGISTRY.counter(
+    "petals_decode_tokens_total",
+    "Decode tokens produced across all lanes",
+)
+
+# --- pool / scheduler ------------------------------------------------------
+PAGES_FREE = REGISTRY.gauge(
+    "petals_page_pool_free_pages", "Free pages in the paged KV pool"
+)
+PAGES_TOTAL = REGISTRY.gauge(
+    "petals_page_pool_pages", "Total pages in the paged KV pool"
+)
+LANES_BUSY = REGISTRY.gauge(
+    "petals_lanes_busy", "Lanes currently held by sessions"
+)
+SWAP_BYTES = REGISTRY.counter(
+    "petals_swap_bytes_total",
+    "KV bytes moved through the host-RAM swap tier",
+    labels=("direction",),  # out | in
+)
+PREEMPTIONS = REGISTRY.counter(
+    "petals_preemptions_total", "Sessions preempted (swap-out committed)"
+)
+ALLOC_FAILED = REGISTRY.counter(
+    "petals_allocation_failed_total",
+    "AllocationFailed raised to a session (lane or page exhaustion)",
+)
+
+# --- client ----------------------------------------------------------------
+ROUTE_BUILDS = REGISTRY.counter(
+    "petals_client_route_builds_total",
+    "Client routing chains built, by mode",
+    labels=("mode",),
+)
+PEER_BANS = REGISTRY.counter(
+    "petals_client_peer_bans_total", "Peers banned after request failures"
+)
+
+# --- telemetry self-observation -------------------------------------------
+META_TRUNCATED = REGISTRY.counter(
+    "telemetry_meta_truncated_total",
+    "Span metadata entries dropped or clipped by the size cap",
+)
+
+# Pre-resolved children for the per-tick paths (one dict lookup saved).
+STEP_DENSE = STEP_DURATION.labels(variant="dense")
+STEP_PAGED = STEP_DURATION.labels(variant="paged")
+STEP_MIXED = STEP_DURATION.labels(variant="mixed")
+STEP_GEN = STEP_DURATION.labels(variant="gen")
+STEPS_DENSE = BATCHED_STEPS.labels(variant="dense")
+STEPS_PAGED = BATCHED_STEPS.labels(variant="paged")
+STEPS_MIXED = BATCHED_STEPS.labels(variant="mixed")
+STEPS_GEN = BATCHED_STEPS.labels(variant="gen")
+SWAP_OUT_BYTES = SWAP_BYTES.labels(direction="out")
+SWAP_IN_BYTES = SWAP_BYTES.labels(direction="in")

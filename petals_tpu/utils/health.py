@@ -7,6 +7,10 @@ models from the ptu.models registry (utils/dht_utils.declare_model), and
 serves a minimal dependency-free HTTP API:
 
   GET /api/v1/state                    — full swarm snapshot (JSON)
+  GET /api/v1/metrics                  — swarm-wide telemetry aggregate: per-server
+                                         digests (tok/s, TTFT/step percentiles, swap
+                                         pressure from ServerInfo.telemetry) plus
+                                         swarm totals
   GET /api/v1/is_reachable/<peer_hex>  — dial-back probe of a peer's announced
                                          contact address (the reachability API)
   GET /                                — human-readable coverage table
@@ -104,6 +108,10 @@ class HealthMonitor:
                     # suspended sessions, swap bytes, preemptions) — lets
                     # operators and clients spot loaded servers at a glance
                     "pool": info.pool,
+                    # compact telemetry digest (tok/s over the announce window,
+                    # TTFT/step percentiles, swap bytes, failure counters) —
+                    # the per-server input to the /api/v1/metrics aggregate
+                    "telemetry": info.telemetry,
                 }
             snapshot[prefix] = {
                 "public_name": meta.get("public_name"),
@@ -143,6 +151,59 @@ class HealthMonitor:
         except Exception as e:
             return {"ok": False, "addr": addr.to_string(), "error": str(e)}
 
+    def metrics_summary(self) -> dict:
+        """Swarm-wide telemetry rollup over the last refresh snapshot.
+
+        Throughputs (tok/s, tokens, swap bytes, failure counts) SUM across
+        servers; latency percentiles take the worst server (max) — a mean of
+        p99s is statistically meaningless and hides the straggler that is
+        actually hurting tail latency."""
+        per_model: Dict[str, dict] = {}
+        for prefix, model in self._state["models"].items():
+            servers = {}
+            agg = {
+                "tok_s": 0.0,
+                "tokens_total": 0,
+                "ttft_p99_ms_max": None,
+                "step_p99_ms_max": None,
+                "swap_out_bytes": 0,
+                "swap_in_bytes": 0,
+                "preemptions": 0,
+                "alloc_failed": 0,
+                "lanes": 0,
+                "busy_lanes": 0,
+                "servers_reporting": 0,
+            }
+            for peer, s in model["servers"].items():
+                digest = s.get("telemetry")
+                pool = s.get("pool") or {}
+                agg["lanes"] += int(pool.get("lanes") or 0)
+                agg["busy_lanes"] += int(pool.get("busy_lanes") or 0)
+                servers[peer] = {
+                    "public_name": s.get("public_name"),
+                    "blocks": s.get("blocks"),
+                    "telemetry": digest,
+                    "pool": pool or None,
+                }
+                if not isinstance(digest, dict):
+                    continue
+                agg["servers_reporting"] += 1
+                agg["tok_s"] += float(digest.get("tok_s") or 0.0)
+                agg["tokens_total"] += int(digest.get("tokens_total") or 0)
+                agg["swap_out_bytes"] += int(digest.get("swap_out_bytes") or 0)
+                agg["swap_in_bytes"] += int(digest.get("swap_in_bytes") or 0)
+                agg["preemptions"] += int(digest.get("preemptions") or 0)
+                agg["alloc_failed"] += int(digest.get("alloc_failed") or 0)
+                for src, dst in (("ttft_p99_ms", "ttft_p99_ms_max"),
+                                 ("step_p99_ms", "step_p99_ms_max")):
+                    value = digest.get(src)
+                    if isinstance(value, (int, float)):
+                        prev = agg[dst]
+                        agg[dst] = value if prev is None else max(prev, value)
+            agg["occupancy"] = (agg["busy_lanes"] / agg["lanes"]) if agg["lanes"] else None
+            per_model[prefix] = {"aggregate": agg, "servers": servers}
+        return {"updated_at": self._state["updated_at"], "models": per_model}
+
     # ------------------------------------------------------------------ http
 
     async def _serve_http(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
@@ -157,6 +218,9 @@ class HealthMonitor:
             if path == "/api/v1/state":
                 body, ctype = json.dumps(self._state, indent=2).encode(), "application/json"
                 status = "200 OK"
+            elif path == "/api/v1/metrics":
+                body = json.dumps(self.metrics_summary(), indent=2).encode()
+                ctype, status = "application/json", "200 OK"
             elif path.startswith("/api/v1/is_reachable/"):
                 result = await self.is_reachable(path.rsplit("/", 1)[1])
                 body, ctype = json.dumps(result).encode(), "application/json"
@@ -187,7 +251,8 @@ class HealthMonitor:
                 f"<small>({model['num_blocks']} blocks, {html.escape(str(model.get('model_type')))}"
                 f")</small> — {status}</h2><table border=1 cellpadding=4>"
                 "<tr><th>server</th><th>state</th><th>blocks</th><th>throughput</th>"
-                "<th>cache tokens left</th><th>load</th><th>quant</th><th>via relay</th></tr>"
+                "<th>cache tokens left</th><th>load</th><th>tok/s</th><th>p99 TTFT</th>"
+                "<th>swap</th><th>quant</th><th>via relay</th></tr>"
             )
             for peer, s in model["servers"].items():
                 pool = s.get("pool")
@@ -199,11 +264,19 @@ class HealthMonitor:
                         load += f", {pool['pages_free']} pages free"
                 else:
                     load = "—"
+                digest = s.get("telemetry") if isinstance(s.get("telemetry"), dict) else {}
+                tok_s = digest.get("tok_s")
+                tok_s_cell = f"{tok_s:.1f}" if isinstance(tok_s, (int, float)) else "—"
+                ttft = digest.get("ttft_p99_ms")
+                ttft_cell = f"{ttft:.0f} ms" if isinstance(ttft, (int, float)) else "—"
+                swap_bytes = (digest.get("swap_out_bytes") or 0) + (digest.get("swap_in_bytes") or 0)
+                swap_cell = f"{swap_bytes / 2**20:.1f} MiB" if swap_bytes else "—"
                 rows.append(
                     f"<tr><td><code>{peer[:12]}…</code> {html.escape(s.get('public_name') or '')}</td>"
                     f"<td>{s['state']}</td><td>[{s['blocks'][0]}, {s['blocks'][1]})</td>"
                     f"<td>{s['throughput']:.1f}</td><td>{s['cache_tokens_left']}</td>"
                     f"<td>{html.escape(load)}</td>"
+                    f"<td>{tok_s_cell}</td><td>{ttft_cell}</td><td>{swap_cell}</td>"
                     f"<td>{html.escape(str(s['quant_type']))}</td><td>{'yes' if s['relayed'] else 'no'}</td></tr>"
                 )
             rows.append("</table>")
@@ -212,5 +285,6 @@ class HealthMonitor:
             "<!doctype html><title>petals_tpu swarm health</title>"
             "<h1>petals_tpu swarm health</h1>"
             f"<p>updated {time.strftime('%H:%M:%S', time.localtime(updated)) if updated else 'never'}"
-            f" · <a href='/api/v1/state'>JSON</a></p>" + "".join(rows or ["<p>no models announced</p>"])
+            f" · <a href='/api/v1/state'>JSON</a> · <a href='/api/v1/metrics'>metrics</a></p>"
+            + "".join(rows or ["<p>no models announced</p>"])
         )

@@ -33,6 +33,34 @@ TRACE_SECONDS_ENV = "PETALS_TPU_TRACE_SECONDS"
 DEFAULT_TRACE_SECONDS = 60.0  # jax.profiler buffers until stop: bound the window
 _MAX_SPANS = 2048  # ring bound: tracing must never grow server memory
 _MAX_DURATIONS_PER_NAME = 4096
+# span metadata bounds: a hot path passing a growing dict (or a huge repr)
+# must not bloat the span ring; clipped/dropped entries are counted in the
+# telemetry_meta_truncated_total metric
+_MAX_META_ENTRIES = 16
+_MAX_META_VALUE_LEN = 256
+
+
+def _bound_meta(meta: dict) -> dict:
+    """Cap entry count and value sizes; count every clip/drop."""
+    truncated = 0
+    out = {}
+    for i, (key, value) in enumerate(meta.items()):
+        if i >= _MAX_META_ENTRIES:
+            truncated += len(meta) - _MAX_META_ENTRIES
+            break
+        if isinstance(value, (int, float, bool, type(None))):
+            out[key] = value
+            continue
+        text = value if isinstance(value, str) else repr(value)
+        if len(text) > _MAX_META_VALUE_LEN:
+            text = text[:_MAX_META_VALUE_LEN]
+            truncated += 1
+        out[key] = text
+    if truncated:
+        from petals_tpu.telemetry.instruments import META_TRUNCATED
+
+        META_TRUNCATED.inc(truncated)
+    return out
 
 
 @dataclasses.dataclass
@@ -63,6 +91,17 @@ class Tracer:
         non-LIFO there) and put ``device_annotation(name)`` around the actual
         compute on its worker thread instead."""
         annotation = device_annotation(name) if annotate else contextlib.nullcontext()
+        # every span carries the ambient request trace id (telemetry.trace
+        # contextvar) so one session's spans line up into a single timeline
+        if "trace_id" not in meta:
+            from petals_tpu.telemetry.trace import current_trace_id
+
+            tid = current_trace_id()
+            if tid is not None:
+                # first position: the entry cap trims from the END, and the
+                # trace id is the one key the timeline cannot lose
+                meta = {"trace_id": tid, **meta}
+        meta = _bound_meta(meta)
         t_wall = time.time()
         t0 = time.perf_counter()
         try:
@@ -117,6 +156,10 @@ def device_annotation(name: str):
 
 _global_tracer: Optional[Tracer] = None
 _tracing_active = False
+# guards the check-then-set on _tracing_active: two concurrent starts (e.g.
+# server startup racing an operator trigger) would otherwise double-call
+# jax.profiler.start_trace, which raises and can corrupt the capture
+_trace_lock = threading.Lock()
 
 
 def get_tracer() -> Tracer:
@@ -129,27 +172,37 @@ def get_tracer() -> Tracer:
 def start_jax_trace(logdir: Optional[str] = None) -> Optional[str]:
     """Begin capturing a jax device/host trace (TensorBoard/XProf format).
     Uses ``PETALS_TPU_TRACE_DIR`` when ``logdir`` is not given; no-op (None)
-    when neither is set."""
+    when neither is set or a capture is already running."""
     global _tracing_active
     logdir = logdir or os.environ.get(TRACE_DIR_ENV)
-    if not logdir or _tracing_active:
+    if not logdir:
         return None
     import jax
 
-    jax.profiler.start_trace(logdir)
-    _tracing_active = True
+    with _trace_lock:
+        if _tracing_active:
+            return None
+        jax.profiler.start_trace(logdir)
+        _tracing_active = True
     logger.info(f"jax trace capturing to {logdir}")
     return logdir
 
 
 def stop_jax_trace() -> None:
+    """Idempotent under races: concurrent stops (timed flush racing
+    shutdown) resolve to one profiler stop_trace call."""
     global _tracing_active
-    if not _tracing_active:
-        return
     import jax
 
-    jax.profiler.stop_trace()
-    _tracing_active = False
+    with _trace_lock:
+        if not _tracing_active:
+            return
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            # even if the profiler stop raises, the module must not believe a
+            # capture is still running — a retry would double-stop instead
+            _tracing_active = False
     logger.info("jax trace stopped")
 
 

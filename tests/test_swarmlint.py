@@ -289,6 +289,30 @@ def test_tracer_safety():
     assert "tracer-safety" not in rules_hit(suppressed)
 
 
+def test_no_unbounded_metric_labels():
+    bad = (
+        "def f(self, session_id, peer):\n"
+        "    REQS.labels(session_id=session_id).inc()\n"
+        "    LAT.labels(peer=str(peer)).observe(0.1)\n"  # str() doesn't launder taint
+        "    BANS.labels(who=slot.peer_id).inc()\n"  # attribute tail is tainted too
+    )
+    assert lines_hit(bad, "no-unbounded-metric-labels") == [2, 3, 4]
+    ok = (
+        "def f(self, variant, session_id):\n"
+        "    STEPS.labels(variant=variant).inc()\n"  # static enum label: fine
+        "    SWAPS.labels(direction='out').inc()\n"
+        "    journal.event('swap', trace_id=session_id)\n"  # ids go to the journal
+        "    self.labels = [session_id]\n"  # attribute assignment, not a call
+    )
+    assert "no-unbounded-metric-labels" not in rules_hit(ok)
+    suppressed = (
+        "def f(self, peer_id):\n"
+        "    X.labels(peer=peer_id).inc()  "
+        "# swarmlint: disable=no-unbounded-metric-labels — test fixture\n"
+    )
+    assert "no-unbounded-metric-labels" not in rules_hit(suppressed)
+
+
 def test_pragma_machinery():
     # a pragma without a reason is itself a finding and suppresses nothing
     no_reason = (

@@ -1,0 +1,496 @@
+"""Swarm telemetry plane (petals_tpu/telemetry/ + its hooks in the handler,
+batcher, and scheduler): the metrics registry must stay exact under concurrent
+writers and bounded under label abuse, trace ids minted by the client must tag
+every server-side span/journal event of that session, a forced preemption +
+swap cycle must leave a replayable journal whose events all carry the victim's
+trace id and the occupancy snapshot that justified the decision, and the
+/metrics endpoint must expose non-zero TTFT/step histograms in valid
+Prometheus text."""
+
+import asyncio
+import json
+import re
+import threading
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from petals_tpu.data_structures import CHAIN_DELIMITER, make_uid
+from petals_tpu.rpc import RpcClient
+from petals_tpu.rpc.serialization import deserialize_array, serialize_array
+from petals_tpu.server.server import Server, default_dht_prefix
+from petals_tpu.telemetry import (
+    MetricsRegistry,
+    TelemetryJournal,
+    current_trace_id,
+    get_journal,
+    new_trace_id,
+    normalize_trace_id,
+    render_prometheus,
+    set_trace_id,
+    reset_trace_id,
+    telemetry_digest,
+    trace_context,
+)
+from petals_tpu.telemetry import instruments as tm
+from tests.utils import make_tiny_llama
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    return make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _start_server(model_path, **kwargs):
+    server = Server(model_path, compute_dtype=jnp.float32, use_flash=False, **kwargs)
+    await server.start()
+    client = await RpcClient.connect(server.rpc_server.host, server.rpc_server.port)
+    return server, client
+
+
+# ------------------------------------------------------------ registry units
+
+
+def test_counter_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs_total", "requests")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("busy", "busy lanes")
+    g.set(4)
+    g.dec()
+    assert g.value == 3.0
+    # re-registration with identical shape returns the same family...
+    assert reg.counter("reqs_total") is c
+    # ...a conflicting redeclaration is a programming error
+    with pytest.raises(ValueError):
+        reg.gauge("reqs_total")
+    with pytest.raises(ValueError):
+        reg.counter("reqs_total", labels=("mode",))
+
+
+def test_label_cap_routes_to_overflow_series():
+    reg = MetricsRegistry()
+    c = reg.counter("per_thing", labels=("thing",), max_series=4)
+    for i in range(10):
+        c.labels(thing=f"t{i}").inc()
+    snap = reg.snapshot()
+    series = snap["per_thing"]["series"]
+    # memory stays bounded: 4 real children + the shared overflow child
+    assert len(series) == 5
+    assert series["thing=_overflow"] == 6.0
+    # ...and the drop is surfaced AS a metric, never silent
+    overflow = snap["telemetry_label_overflow_total"]["series"]
+    assert overflow["metric=per_thing"] == 6.0
+
+
+def test_concurrent_writers_exact():
+    reg = MetricsRegistry()
+    c = reg.counter("n", "")
+    h = reg.histogram("lat", "", buckets=(0.1, 1.0))
+
+    def work():
+        for _ in range(10_000):
+            c.inc()
+            h.observe(0.05)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 80_000
+    snap = h.snapshot()
+    assert snap["count"] == 80_000 and snap["counts"][0] == 80_000
+
+
+def test_histogram_bucket_math():
+    reg = MetricsRegistry()
+    h = reg.histogram("d", "", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.01, 0.05, 0.5, 5.0):
+        h.observe(v)
+    h.observe(float("nan"))  # guarded: must not poison sum/count
+    h.observe(float("inf"))
+    snap = h.snapshot()
+    # bisect_left: a value equal to a bound lands IN that bound's bucket
+    assert snap["counts"] == [2, 1, 1, 1]
+    assert snap["cumulative"] == [2, 3, 4, 5]
+    assert snap["count"] == 5
+    assert abs(snap["sum"] - 5.565) < 1e-9
+    # quantile: linear interpolation inside the winning bucket
+    assert 0.0 < h.quantile(0.5) <= 0.1
+    assert h.quantile(0.99) == 1.0  # clamped to the last finite bound
+
+
+# ------------------------------------------------------------- trace context
+
+
+def test_trace_id_normalization():
+    assert normalize_trace_id("abc123-XYZ_") == "abc123-XYZ_"
+    assert normalize_trace_id("bad id!") is None  # spaces/punct rejected
+    assert normalize_trace_id("x" * 65) is None  # too long
+    assert normalize_trace_id(42) is None
+    assert normalize_trace_id(None) is None
+    tid = new_trace_id()
+    assert normalize_trace_id(tid) == tid and len(tid) == 16
+
+
+def test_trace_contextvar_roundtrip():
+    assert current_trace_id() is None
+    token = set_trace_id("t-outer")
+    try:
+        assert current_trace_id() == "t-outer"
+        with trace_context("t-inner"):
+            assert current_trace_id() == "t-inner"
+        assert current_trace_id() == "t-outer"
+    finally:
+        reset_trace_id(token)
+    assert current_trace_id() is None
+
+
+# ------------------------------------------------------------------ journal
+
+
+def test_journal_capture_and_bounds():
+    j = TelemetryJournal(maxlen=4)
+    j.event("admission", trace_id="t1", lane=0, occupancy={"pages_free": 3})
+    j.event("swap_out", trace_id="t1", lane=0, pages=2)
+    j.event("admission", trace_id="t2", lane=1)
+    assert len(j) == 3
+    assert [e["kind"] for e in j.events(trace_id="t1")] == ["admission", "swap_out"]
+    assert j.events(kind="admission", trace_id="t2")[0]["lane"] == 1
+    # seq is monotonic and events carry their occupancy snapshot verbatim
+    seqs = [e["seq"] for e in j]
+    assert seqs == sorted(seqs)
+    assert j.events(kind="admission", trace_id="t1")[0]["occupancy"] == {"pages_free": 3}
+    # bounded: old events fall off, the journal never grows past maxlen
+    for i in range(10):
+        j.event("tick", lane=i)
+    assert len(j) == 4
+    # every line of the JSONL export parses back
+    lines = j.to_jsonl().strip().splitlines()
+    assert len(lines) == 4 and all(json.loads(line) for line in lines)
+
+
+def test_journal_file_sink(tmp_path):
+    path = tmp_path / "journal.jsonl"
+    j = TelemetryJournal(maxlen=8, path=str(path))
+    j.event("admission", trace_id="t1", lane=0)
+    j.close()
+    rows = [json.loads(line) for line in path.read_text().strip().splitlines()]
+    assert rows[0]["kind"] == "admission" and rows[0]["trace_id"] == "t1"
+
+
+# ------------------------------------------- tracer meta bounding (satellite)
+
+
+def test_span_meta_bounded_and_trace_tagged():
+    from petals_tpu.utils.tracing import Tracer
+
+    tracer = Tracer(max_spans=64)
+    truncated_before = tm.META_TRUNCATED.value
+    big_meta = {f"k{i:02d}": "v" * 1000 for i in range(40)}
+    with trace_context("span-trace-1"):
+        with tracer.span("unit_test_span", **big_meta):
+            pass
+    meta = [s for s in tracer.recent() if s.name == "unit_test_span"][-1].meta
+    # entries capped, values clipped — a hostile/buggy caller cannot balloon
+    # the tracer ring; the drop is counted, not silent
+    assert len(meta) <= 16
+    assert all(len(v) <= 256 for v in meta.values() if isinstance(v, str))
+    assert tm.META_TRUNCATED.value > truncated_before
+    # the trace id is the one key bounding must never trim
+    assert meta["trace_id"] == "span-trace-1"
+
+
+# ------------------------------------------------- e2e: trace id propagation
+
+
+def test_trace_id_propagation_client_to_scheduler(model_path):
+    """The open-message trace id must reach the session-open reply, the
+    scheduler slot, and the admission journal event; a malformed id is
+    replaced by a server-minted one instead of being trusted."""
+
+    async def main():
+        server, client = await _start_server(
+            model_path, batching=True, batch_lanes=4, batch_max_length=32,
+            page_size=8,
+        )
+        try:
+            cfg = server.cfg
+            prefix = default_dht_prefix(model_path)
+            uids = CHAIN_DELIMITER.join(
+                make_uid(prefix, i) for i in range(cfg.num_hidden_layers)
+            )
+            tid = "cli-trace-0001"
+            stream = await client.open_stream("ptu.inference")
+            await stream.send(
+                {"uids": uids, "max_length": 16, "batch_size": 1, "trace_id": tid}
+            )
+            ack = await stream.recv(timeout=60)
+            assert ack["session_open"] and ack["trace_id"] == tid
+
+            sched = server.handler.batcher._scheduler
+            assert [s.trace_id for s in sched.lanes.values()] == [tid]
+            admissions = get_journal().events(kind="admission", trace_id=tid)
+            assert admissions, "admission event not journaled"
+            assert "occupancy" in admissions[-1] and "wait_s" in admissions[-1]
+
+            # a step's tracer span is tagged with the same id
+            h = np.random.RandomState(0).randn(1, 3, cfg.hidden_size).astype(np.float32)
+            await stream.send({"tensors": {"hidden": serialize_array(h)}})
+            reply = await stream.recv(timeout=120)
+            assert "tensors" in reply
+            from petals_tpu.utils.tracing import get_tracer
+
+            spans = [
+                s for s in get_tracer().recent(500)
+                if s.name == "inference_step" and s.meta.get("trace_id") == tid
+            ]
+            assert spans, "inference_step span not tagged with the trace id"
+            await stream.end()
+
+            # malformed ids are NOT echoed back: the server mints its own
+            stream2 = await client.open_stream("ptu.inference")
+            await stream2.send(
+                {"uids": uids, "max_length": 16, "batch_size": 1,
+                 "trace_id": "bad id! with spaces"}
+            )
+            ack2 = await stream2.recv(timeout=60)
+            assert ack2["trace_id"] != "bad id! with spaces"
+            assert normalize_trace_id(ack2["trace_id"]) is not None
+            await stream2.end()
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(main())
+
+
+# ---------------------------------------- e2e: journaled preemption + swap
+
+
+def test_journal_records_preemption_cycle(model_path):
+    """Acceptance: one forced preemption+swap cycle yields a journal whose
+    events (admission -> victim selection -> swap-out -> swap-in) all carry
+    the victim session's trace id and the occupancy snapshot that justified
+    the decision."""
+
+    async def main():
+        server, client = await _start_server(
+            model_path, batching=True, batch_lanes=2, batch_max_length=32,
+            page_size=8, n_pages=5, swap_host_bytes=1 << 22,
+        )
+        try:
+            batcher = server.handler.batcher
+            victim_tid, req_tid = new_trace_id(), new_trace_id()
+            a = await batcher.acquire_lane(timeout=5, peer_id="victim", trace_id=victim_tid)
+            b = await batcher.acquire_lane(timeout=5, peer_id="req", trace_id=req_tid)
+            await batcher.prepare_write(a, 0, 32)  # victim takes all 4 slots
+            assert batcher._pages.n_free == 0
+            # pool exhausted: this write must preempt a, journaling the choice
+            await batcher.prepare_write(b, 8, 9, timeout=5)
+            assert batcher._scheduler.lanes[a].suspended
+            # touching the victim forces the transparent swap-in
+            await batcher.snapshot_lane(a, 16, 0, batcher.backend.n_blocks)
+            assert not batcher._scheduler.lanes[a].suspended
+
+            journal = get_journal()
+            victim_events = journal.events(trace_id=victim_tid)
+            kinds = [e["kind"] for e in victim_events]
+            # the victim's full life is one causal timeline under ONE id
+            for expected in ("admission", "victim_selected", "swap_out", "swap_in"):
+                assert expected in kinds, (expected, kinds)
+            assert kinds.index("admission") < kinds.index("victim_selected")
+            assert kinds.index("victim_selected") < kinds.index("swap_out")
+            assert kinds.index("swap_out") < kinds.index("swap_in")
+            by_kind = {e["kind"]: e for e in victim_events}
+            for kind in ("admission", "victim_selected", "swap_out", "swap_in"):
+                occ = by_kind[kind]["occupancy"]
+                assert isinstance(occ, dict) and "pages_free" in occ, (kind, occ)
+            # the eviction names who asked and why it was legal
+            picked = by_kind["victim_selected"]
+            assert picked["requester_trace_id"] == req_tid
+            assert picked["policy"] in ("lru", "largest")
+            # the snapshot that justified the preemption: pool was exhausted
+            assert picked["occupancy"]["pages_free"] == 0
+            # swap volume is accounted in bytes on both legs
+            assert by_kind["swap_out"]["nbytes"] > 0
+            assert by_kind["swap_in"]["nbytes"] == by_kind["swap_out"]["nbytes"]
+
+            batcher.release_lane(a)
+            batcher.release_lane(b)
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(main())
+
+
+# ------------------------------------------------- e2e: /metrics exposition
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? (-?[0-9.e+-]+|NaN)$"
+)
+
+
+def _parse_prometheus(text):
+    """Minimal format check + sample extraction: every non-comment line must
+    be `name{labels} value`; returns {full_series_name: float}."""
+    samples = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert line.startswith(("# HELP ", "# TYPE ")), line
+            continue
+        assert _PROM_LINE.match(line), f"malformed exposition line: {line!r}"
+        name, value = line.rsplit(" ", 1)
+        samples[name] = float(value)
+    return samples
+
+
+def test_metrics_scrape_after_inference(model_path):
+    """Run a real session against a server with the metrics endpoint enabled,
+    then scrape /metrics over HTTP: TTFT and step-duration histograms must be
+    non-zero and the exposition text must parse."""
+
+    async def main():
+        server, client = await _start_server(
+            model_path, batching=True, batch_lanes=2, batch_max_length=32,
+            page_size=8, metrics_port=0,
+        )
+        try:
+            assert server._metrics_server is not None
+            cfg = server.cfg
+            prefix = default_dht_prefix(model_path)
+            uids = CHAIN_DELIMITER.join(
+                make_uid(prefix, i) for i in range(cfg.num_hidden_layers)
+            )
+            ttft_before = tm.TTFT.snapshot()["count"]
+            rng = np.random.RandomState(3)
+            stream = await client.open_stream("ptu.inference")
+            await stream.send({"uids": uids, "max_length": 16, "batch_size": 1})
+            await stream.recv(timeout=60)
+            h = rng.randn(1, 3, cfg.hidden_size).astype(np.float32) * 0.1
+            await stream.send({"tensors": {"hidden": serialize_array(h)}})
+            out = deserialize_array((await stream.recv(timeout=120))["tensors"]["hidden"])
+            assert out.shape == (1, 3, cfg.hidden_size)
+            for _ in range(3):
+                step = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.1
+                await stream.send({"tensors": {"hidden": serialize_array(step)}})
+                await stream.recv(timeout=120)
+            await stream.end()
+
+            port = server._metrics_server.port
+            url = f"http://127.0.0.1:{port}/metrics"
+            text = (
+                await asyncio.to_thread(urllib.request.urlopen, url, None, 10)
+            ).read().decode()
+            samples = _parse_prometheus(text)
+            assert samples["petals_ttft_seconds_count"] > ttft_before
+            assert samples["petals_ttft_seconds_sum"] > 0.0
+            # the +Inf bucket equals _count (cumulative histogram invariant)
+            assert (
+                samples['petals_ttft_seconds_bucket{le="+Inf"}']
+                == samples["petals_ttft_seconds_count"]
+            )
+            step_counts = [
+                v for k, v in samples.items()
+                if k.startswith("petals_step_duration_seconds_count")
+            ]
+            assert step_counts and sum(step_counts) > 0
+            assert samples["petals_decode_tokens_total"] > 0
+
+            # the DHT-announced digest mirrors the same state, compactly
+            digest = telemetry_digest()
+            assert digest["tokens_total"] > 0 and digest["ttft_p99_ms"] > 0
+            info = server._server_info(server._state)
+            assert isinstance(info.telemetry, dict)
+            assert info.telemetry["steps_total"] > 0
+
+            # the journal rides the same endpoint for operators
+            jurl = f"http://127.0.0.1:{port}/journal"
+            jtext = (
+                await asyncio.to_thread(urllib.request.urlopen, jurl, None, 10)
+            ).read().decode()
+            assert all(json.loads(line) for line in jtext.strip().splitlines())
+        finally:
+            await client.close()
+            await server.shutdown()
+        # the scrape endpoint dies with the server
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", None, 2)
+
+    run(main())
+
+
+# -------------------------------------------------- exposition render units
+
+
+def test_render_prometheus_escaping_and_types():
+    reg = MetricsRegistry()
+    c = reg.counter("esc_total", 'help with "quotes" and \\slash\nline2', labels=("mode",))
+    c.labels(mode='we"ird\\val\nue').inc(2)
+    reg.gauge("g1", "a gauge").set(1.5)
+    text = render_prometheus(reg)
+    # HELP escapes backslash + newline only; quotes stay literal (0.0.4 spec)
+    assert '# HELP esc_total help with "quotes" and \\\\slash\\nline2' in text
+    assert "# TYPE esc_total counter" in text
+    assert 'esc_total{mode="we\\"ird\\\\val\\nue"} 2' in text
+    assert "g1 1.5" in text
+
+
+def test_health_metrics_summary_aggregation():
+    """run_health's /api/v1/metrics rollup: throughputs sum, p99s take the
+    worst server, occupancy spans the pool columns."""
+    from petals_tpu.utils.health import HealthMonitor
+
+    monitor = HealthMonitor([])
+    monitor._state = {
+        "updated_at": 123.0,
+        "models": {
+            "m": {
+                "servers": {
+                    "peer-a": {
+                        "public_name": None, "blocks": [0, 2],
+                        "pool": {"lanes": 4, "busy_lanes": 2},
+                        "telemetry": {
+                            "tok_s": 10.0, "tokens_total": 100,
+                            "ttft_p99_ms": 50.0, "step_p99_ms": 4.0,
+                            "swap_out_bytes": 8, "swap_in_bytes": 8,
+                            "preemptions": 1, "alloc_failed": 0,
+                        },
+                    },
+                    "peer-b": {
+                        "public_name": None, "blocks": [2, 4],
+                        "pool": {"lanes": 4, "busy_lanes": 4},
+                        "telemetry": {
+                            "tok_s": 5.0, "tokens_total": 40,
+                            "ttft_p99_ms": 200.0, "step_p99_ms": 2.0,
+                            "preemptions": 0, "alloc_failed": 2,
+                        },
+                    },
+                    "peer-c": {  # old server: no digest announced
+                        "public_name": None, "blocks": [4, 6], "pool": None,
+                        "telemetry": None,
+                    },
+                },
+            }
+        },
+    }
+    agg = monitor.metrics_summary()["models"]["m"]["aggregate"]
+    assert agg["tok_s"] == 15.0 and agg["tokens_total"] == 140
+    assert agg["ttft_p99_ms_max"] == 200.0 and agg["step_p99_ms_max"] == 4.0
+    assert agg["swap_out_bytes"] == 8 and agg["alloc_failed"] == 2
+    assert agg["servers_reporting"] == 2
+    assert agg["occupancy"] == 6 / 8
