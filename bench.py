@@ -1323,6 +1323,107 @@ def _heavy_row_registry():
     }
 
 
+def _tiny_gate_cfg():
+    """A deliberately tiny Llama shape: the gate rows measure the BATCHING
+    MACHINERY (queue -> flush loop -> jitted step), not the matmuls, so they
+    must run in seconds on a CI CPU."""
+    from petals_tpu.models.llama.config import LlamaBlockConfig
+
+    return LlamaBlockConfig(
+        hidden_size=64,
+        num_attention_heads=4,
+        num_key_value_heads=4,
+        head_dim=16,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        rms_norm_eps=1e-5,
+        vocab_size=128,
+    )
+
+
+def bench_gate_decode(page_size, label, *, lanes=2, steps=40):
+    """CPU-runnable gate row: drive ``steps`` batched decode ticks through a
+    real DecodeBatcher (dense pool when ``page_size`` is None, paged
+    otherwise) so the STEP_DENSE / STEP_PAGED / STEP_MIXED histograms and the
+    batcher counters carry this build's scheduling cost. The attached
+    telemetry blob is what ``--gate`` diffs against the committed baseline."""
+    import jax.numpy as jnp
+
+    from petals_tpu.models.registry import get_family
+    from petals_tpu.server.backend import TransformerBackend
+    from petals_tpu.server.batching import DecodeBatcher
+    from petals_tpu.server.memory_cache import MemoryCache
+    from petals_tpu.server.task_queue import PriorityTaskQueue
+
+    cfg = _tiny_gate_cfg()
+    n_blocks = cfg.num_hidden_layers
+    params = random_params(cfg, n_blocks, jnp.float32)
+    backend = TransformerBackend(
+        get_family("llama"), cfg, params,
+        first_block=0, n_blocks=n_blocks,
+        memory_cache=MemoryCache(None), compute_dtype=jnp.float32,
+        use_flash=False,
+    )
+    rng = np.random.RandomState(0)
+    prefill = rng.randn(1, 8, cfg.hidden_size).astype(np.float32) * 0.02
+    step_h = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.02
+
+    async def run():
+        queue = PriorityTaskQueue()
+        queue.start()
+        batcher = DecodeBatcher(
+            backend, backend.memory_cache, queue,
+            n_lanes=lanes, max_length=128, page_size=page_size,
+        )
+        try:
+            lane_ids = [await batcher.acquire_lane() for _ in range(lanes)]
+            pos = 0
+            if page_size:  # paged pool: prefill rides the mixed step
+                for lane in lane_ids:
+                    await batcher.prefill_lane(lane, prefill, 0)
+                pos = prefill.shape[1]
+            # a couple of warmup ticks so jit compilation stays out of the
+            # measured histogram tail (the gate compares means, but cheap
+            # insurance against a CI cold-start owning the blob)
+            for _ in range(3):
+                await asyncio.gather(
+                    *(batcher.step(lane, step_h, pos) for lane in lane_ids)
+                )
+                pos += 1
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                await asyncio.gather(
+                    *(batcher.step(lane, step_h, pos) for lane in lane_ids)
+                )
+                pos += 1
+            wall = time.perf_counter() - t0
+            return {
+                "label": label,
+                "lanes": lanes,
+                "steps": steps,
+                "wall_s": round(wall, 3),
+                "step_ms": round(1000.0 * wall / steps, 3),
+            }
+        finally:
+            await batcher.close()
+            queue.shutdown()
+
+    result = asyncio.run(run())
+    del params, backend
+    gc.collect()
+    return result
+
+
+def _gate_row_registry():
+    """Rows cheap enough for the CI perf gate (seconds each on CPU). Run via
+    the same ``--row`` child protocol as the heavy rows so each gets a fresh
+    process and therefore clean per-row histograms."""
+    return {
+        "gate_decode_dense": lambda: bench_gate_decode(None, "gate_decode_dense"),
+        "gate_decode_paged": lambda: bench_gate_decode(16, "gate_decode_paged"),
+    }
+
+
 def _telemetry_counters() -> dict:
     """Monotonic totals of the batcher-mirroring counters
     (telemetry.instruments); the per-row DELTA of these shows which compiled
@@ -1369,12 +1470,111 @@ def _telemetry_blob(before: dict) -> dict:
 def _run_single_row(name: str) -> None:
     """--row child: run ONE registry row and print its JSON on the LAST
     stdout line (stderr streams through for progress)."""
-    fn = _heavy_row_registry()[name]
+    fn = {**_heavy_row_registry(), **_gate_row_registry()}[name]
     before = _telemetry_counters()
     result = fn()
     if isinstance(result, dict):
         result["telemetry"] = _telemetry_blob(before)
     print(json.dumps(result), flush=True)
+
+
+def _run_gate(argv) -> None:
+    """Perf-regression gate (CI lane): ``--gate BENCH_GATE_CPU.json`` re-runs
+    every baseline row in a fresh ``--row`` subprocess (clean per-row
+    histograms), diffs each row's telemetry blob against the committed
+    baseline via telemetry.gate, and exits non-zero on regression.
+    ``--gate_update BENCH_GATE_CPU.json`` rewrites the baseline from this
+    build instead of diffing; ``--gate_tolerance X`` overrides the stored
+    relative tolerance (current may be up to (1+X) times the baseline)."""
+    import subprocess
+
+    from petals_tpu.telemetry.gate import DEFAULT_TOLERANCE, gate_report
+
+    update = "--gate_update" in argv
+    flag = "--gate_update" if update else "--gate"
+    try:
+        path = argv[argv.index(flag) + 1]
+    except IndexError:
+        sys.stderr.write(f"[gate] {flag} requires a baseline path\n")
+        sys.exit(2)
+    tolerance = None
+    if "--gate_tolerance" in argv:
+        tolerance = float(argv[argv.index("--gate_tolerance") + 1])
+
+    if update:
+        row_names = sorted(_gate_row_registry())
+        baseline = None
+    else:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                baseline = json.load(f)
+        except (OSError, ValueError) as e:
+            sys.stderr.write(f"[gate] cannot load baseline {path}: {e}\n")
+            sys.exit(2)
+        row_names = sorted(baseline.get("rows") or {})
+        if not row_names:
+            sys.stderr.write(f"[gate] baseline {path} has no rows\n")
+            sys.exit(2)
+
+    results = {}
+    for name in row_names:
+        sys.stderr.write(f"[gate] running row {name}\n")
+        row = None
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--row", name],
+                stdout=subprocess.PIPE, text=True, timeout=600,
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"[gate] row {name} timed out\n")
+            results[name] = None
+            continue
+        if proc.returncode == 0:
+            for line in reversed((proc.stdout or "").strip().splitlines()):
+                try:
+                    row = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+        if row is None:
+            sys.stderr.write(f"[gate] row {name} failed (rc={proc.returncode})\n")
+        results[name] = row
+
+    if update:
+        missing = [
+            n for n, r in results.items()
+            if not isinstance(r, dict) or not r.get("telemetry")
+        ]
+        if missing:
+            sys.stderr.write(f"[gate] cannot update baseline, rows failed: {missing}\n")
+            sys.exit(1)
+        baseline = {
+            "tolerance": tolerance if tolerance is not None else DEFAULT_TOLERANCE,
+            "rows": {
+                name: {"label": row.get("label", name), "telemetry": row["telemetry"]}
+                for name, row in results.items()
+            },
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(baseline, f, indent=2, sort_keys=True)
+            f.write("\n")
+        sys.stderr.write(f"[gate] baseline updated: {path}\n")
+        print(json.dumps({"gate": "updated", "rows": sorted(results)}), flush=True)
+        return
+
+    failures = gate_report(baseline, results, tolerance=tolerance)
+    for name, problems in sorted(failures.items()):
+        for problem in problems:
+            sys.stderr.write(f"[gate] FAIL {name}: {problem}\n")
+    verdict = {
+        "gate": "fail" if failures else "pass",
+        "rows": sorted(results),
+        "failures": failures,
+    }
+    print(json.dumps(verdict), flush=True)
+    if failures:
+        sys.exit(1)
+    sys.stderr.write(f"[gate] pass: {len(results)} rows within tolerance\n")
 
 
 def main():
@@ -1383,6 +1583,10 @@ def main():
 
     if "--row" in sys.argv:
         _run_single_row(sys.argv[sys.argv.index("--row") + 1])
+        return
+
+    if "--gate" in sys.argv or "--gate_update" in sys.argv:
+        _run_gate(sys.argv)
         return
 
     if "--inner" not in sys.argv:

@@ -18,6 +18,8 @@ The rule set (motivation in each docstring):
 - tracer-safety             — no host branching/impurity inside jit bodies
 - no-unbounded-metric-labels — no request-controlled values (session/peer ids)
                               as metric labels: unbounded series cardinality
+- no-naive-wallclock-in-span — durations/spans must come from a monotonic
+                              clock, not time.time() subtraction (NTP slew)
 """
 
 from __future__ import annotations
@@ -725,6 +727,61 @@ def rule_no_unbounded_metric_labels(tree, source_lines, path) -> Findings:
     return out
 
 
+# ------------------------------------------- no-naive-wallclock-in-span
+
+
+def _is_wallclock_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and not node.args
+        and not node.keywords
+        and dotted(node.func) == "time.time"
+    )
+
+
+def rule_no_naive_wallclock_in_span(tree, source_lines, path) -> Findings:
+    """Durations computed from ``time.time()`` go backwards under NTP slew
+    and stamp negative queue/compute components into spans and trace
+    reports. Latency attribution must use a monotonic clock
+    (``time.perf_counter()`` / ``time.monotonic()``). ``time.time()`` as an
+    absolute TIMESTAMP (journal events, flight-recorder entries) is fine —
+    only arithmetic that turns it into a duration is flagged: a subtraction
+    whose operand is ``time.time()`` itself or a local assigned from it."""
+    out: Findings = []
+    scopes = [tree] + list(iter_functions(tree))
+    for scope in scopes:
+        body = getattr(scope, "body", [])
+        nodes = [n for b in body for n in [b, *walk_no_functions(b)]]
+        wall_names: Set[str] = set()
+        for node in nodes:
+            if isinstance(node, ast.Assign) and _is_wallclock_call(node.value):
+                wall_names.update(
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                )
+
+        def from_wallclock(expr: ast.AST) -> bool:
+            return _is_wallclock_call(expr) or (
+                isinstance(expr, ast.Name) and expr.id in wall_names
+            )
+
+        for node in nodes:
+            if (
+                isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)
+                and (from_wallclock(node.left) or from_wallclock(node.right))
+            ):
+                out.append(
+                    (
+                        node.lineno,
+                        "duration computed from time.time(): the wall clock "
+                        "is not monotonic (NTP slew makes spans negative) — "
+                        "use time.perf_counter() or time.monotonic() for "
+                        "latency attribution",
+                    )
+                )
+    return out
+
+
 # ------------------------------------------------------------------ registry
 
 RULES = {
@@ -736,4 +793,5 @@ RULES = {
     "no-silent-except": rule_no_silent_except,
     "tracer-safety": rule_tracer_safety,
     "no-unbounded-metric-labels": rule_no_unbounded_metric_labels,
+    "no-naive-wallclock-in-span": rule_no_naive_wallclock_in_span,
 }

@@ -2,27 +2,57 @@
 ``python -m petals_tpu.cli.run_health --initial_peers ADDR [--host H] [--port 8799]``
 Serves / (HTML), /api/v1/state (JSON), /api/v1/metrics (swarm telemetry
 aggregate), /api/v1/is_reachable/<peer>.
+
+``--waterfall TRACE.json`` instead renders a saved client trace report
+(``InferenceSession.trace_report()`` dumped as JSON, or a flight-recorder
+entry containing one under ``waterfall``) as an ASCII per-hop latency
+waterfall and exits — no swarm connection needed.
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import signal
 
-from petals_tpu.utils.health import HealthMonitor
 from petals_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
 
+def render_waterfall_file(path: str) -> str:
+    """Load a trace report (or flight-recorder entry wrapping one) and
+    render it with telemetry.spans.format_waterfall."""
+    from petals_tpu.telemetry.spans import format_waterfall
+
+    with open(path, "r", encoding="utf-8") as f:
+        report = json.load(f)
+    if "hops" not in report and isinstance(report.get("waterfall"), dict):
+        report = report["waterfall"]  # a flight-recorder breach entry
+    return format_waterfall(report)
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description="Swarm health monitor")
-    parser.add_argument("--initial_peers", nargs="+", required=True)
+    parser.add_argument(
+        "--waterfall",
+        metavar="TRACE.json",
+        help="render a saved trace report as an ASCII waterfall and exit",
+    )
+    parser.add_argument("--initial_peers", nargs="+")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8799)
     parser.add_argument("--update_period", type=float, default=15.0)
     args = parser.parse_args(argv)
+
+    if args.waterfall:
+        print(render_waterfall_file(args.waterfall), flush=True)
+        return
+    if not args.initial_peers:
+        parser.error("--initial_peers is required (unless using --waterfall)")
+
+    from petals_tpu.utils.health import HealthMonitor
 
     async def run():
         monitor = HealthMonitor(

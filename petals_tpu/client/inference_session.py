@@ -26,6 +26,7 @@ from petals_tpu.client.routing.sequence_manager import RemoteSequenceManager
 from petals_tpu.data_structures import CHAIN_DELIMITER, RemoteSpanInfo
 from petals_tpu.rpc.client import RpcClient, StreamCall
 from petals_tpu.rpc.serialization import CompressionType, deserialize_array, serialize_array
+from petals_tpu.telemetry.spans import MAX_RETIRED_HOPS, HopTrace, build_trace_report
 from petals_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -55,6 +56,12 @@ class _ServerInferenceSession:
         self.session_id: Optional[str] = None
         # set after chain repair: dict = retarget pushes, False = disable them
         self.pending_push_to = None
+        # per-hop critical-path accumulator: every step folds its client wall
+        # + the server's step_meta piggyback into this (telemetry/spans.py)
+        self.hop = HopTrace(span.peer_id.to_string(), span.start, span.end)
+        # trace id the server echoed in its session_open ack (may be
+        # server-normalized/minted; InferenceSession adopts it)
+        self.echoed_trace_id: Optional[str] = None
 
     @classmethod
     async def create(
@@ -109,6 +116,12 @@ class _ServerInferenceSession:
         self = cls(span, uids, stream, max_length=max_length, step_timeout=step_timeout)
         self.session_id = session_id
         self.compression = compression
+        # the server echoes the trace id it actually registered (normalized,
+        # or freshly minted when the client sent none): adopt the server's
+        # view so client- and server-side telemetry key identically
+        echoed = ack.get("trace_id")
+        if isinstance(echoed, str) and echoed:
+            self.echoed_trace_id = echoed
         return self
 
     async def import_kv(self, k: np.ndarray, v: np.ndarray, position: int) -> None:
@@ -149,8 +162,13 @@ class _ServerInferenceSession:
             msg["tensors"]["hypo_ids"] = serialize_array(np.asarray(hypo_ids, np.int64))
         if start_from_position is not None:
             msg["start_from_position"] = int(start_from_position)
+        t_rpc = time.perf_counter()
         await self.stream.send(msg)
         reply = await self.stream.recv(timeout=self.step_timeout)
+        self.hop.record(
+            time.perf_counter() - t_rpc, reply.get("step_meta"),
+            tokens=int(hidden.shape[1]),
+        )
         out = deserialize_array(reply["tensors"]["hidden"])
         self.position = reply["position"]
         self.history.append((np.asarray(hidden), None if hypo_ids is None else np.asarray(hypo_ids)))
@@ -181,9 +199,14 @@ class _ServerInferenceSession:
             msg["step_id"] = step_id
         if start_from_position is not None:
             msg["start_from_position"] = int(start_from_position)
+        t_rpc = time.perf_counter()
         await self.stream.send(msg)
         reply = await self.stream.recv(timeout=self.step_timeout)
         tokens = np.asarray(reply["tokens"], np.int64)[None]  # [1, n]
+        self.hop.record(
+            time.perf_counter() - t_rpc, reply.get("step_meta"),
+            tokens=int(tokens.shape[1]),
+        )
         self.position = reply["position"]
         self.history.append((np.asarray(hidden), None))
         if tokens.shape[1] > 1:  # the returned count governs — servers clamp
@@ -236,8 +259,19 @@ class InferenceSession:
         # server span (including repaired replacements) opens with it, so the
         # session's full life is one causal timeline in swarm telemetry
         from petals_tpu.telemetry import new_trace_id
+        from petals_tpu.telemetry.flight import flight_from_env
 
         self.trace_id: str = new_trace_id()
+        # critical-path profiler state: whole-session wall/steps/tokens plus
+        # the hop traces of failed-over or migrated-away sessions (bounded),
+        # so trace_report() accounts for time spent on dead servers too
+        self._wall_s = 0.0
+        self._steps = 0
+        self._tokens = 0
+        self._retired_hops: List[HopTrace] = []
+        # SLO flight recorder (None unless PETALS_TPU_SLO_*_MS is set; tests
+        # and embedders may assign a FlightRecorder directly)
+        self.flight = flight_from_env()
 
     @property
     def position(self) -> int:
@@ -281,6 +315,7 @@ class InferenceSession:
                 f" exceeds pre-allocated maximum {self.max_length}"
             )
 
+        t_step0 = time.perf_counter()  # route building counts toward TTFT
         await self._ensure_route(hidden)
 
         attempt = 0
@@ -309,6 +344,7 @@ class InferenceSession:
                 inputs = outputs
                 block_idx = span.end
                 self.seq_manager.on_request_success(span.peer_id)
+                self._maybe_blame_hop(session)
             except Exception as e:
                 attempt += 1
                 peer = session.span.peer_id if session is not None else None
@@ -327,8 +363,97 @@ class InferenceSession:
                 block_idx = await self._repair_chain(block_idx)
 
         self._position += n_input_tokens
+        self._account_step(time.perf_counter() - t_step0, n_input_tokens)
         await self._maybe_check_route_upgrade()
         return inputs
+
+    # ------------------------------------------------- critical-path profiler
+
+    def _account_step(self, wall_s: float, n_tokens: int) -> None:
+        """Fold one whole-chain step into the session totals and check it
+        against the flight recorder's SLOs (the first step is the TTFT)."""
+        self._wall_s += wall_s
+        self._steps += 1
+        self._tokens += max(int(n_tokens), 0)
+        if self.flight is None:
+            return
+        self.flight.observe(
+            "ttft" if self._steps == 1 else "token",
+            wall_s,
+            trace_id=self.trace_id,
+            # both resolved lazily, only when the observation breaches
+            waterfall=self.trace_report,
+            journal=self._victim_journal_fetcher(),
+        )
+
+    def _maybe_blame_hop(self, session: "_ServerInferenceSession") -> None:
+        """Hop-level routing blame: a server whose queue-wait dominates its
+        own wall gets a soft (decaying) routing penalty, so the next route
+        build steers load away without the hard hammer of a ban."""
+        hop = session.hop
+        if not hop.meta_steps or hop.steps % 16 != 0:
+            return
+        share = hop.queue_share()
+        if share <= 0.5:
+            return
+        report = getattr(self.seq_manager, "report_congestion", None)
+        if report is not None:
+            report(session.span.peer_id, share)
+
+    def trace_report(self) -> dict:
+        """The session's per-hop latency waterfall so far: wall-clock
+        attributed to network / queue / compute / serialize / other, per hop
+        and in total, with the dominating (hop, component) critical path."""
+        hops = list(self._retired_hops) + [
+            s.hop for s in self._sessions if not s.closed
+        ]
+        return build_trace_report(
+            self.trace_id,
+            [h for h in hops if h.steps > 0],
+            wall_s=self._wall_s,
+            steps=self._steps,
+            tokens=self._tokens,
+            retired_hops=len(self._retired_hops),
+        )
+
+    def _retire_hops(self, sessions) -> None:
+        """Keep closing sessions' hop traces (bounded) so reports after a
+        repair/migration still account for time spent on the old servers."""
+        for s in sessions:
+            if s.hop.steps > 0:
+                self._retired_hops.append(s.hop)
+        if len(self._retired_hops) > MAX_RETIRED_HOPS:
+            del self._retired_hops[: len(self._retired_hops) - MAX_RETIRED_HOPS]
+
+    def _victim_journal_fetcher(self):
+        """Zero-arg callable for the flight recorder: at breach time, pick
+        the critical-path hop as the victim and fetch its server's journal
+        excerpt for this trace from the announced /metrics endpoint."""
+
+        def fetch():
+            from petals_tpu.telemetry.flight import http_journal_fetcher
+
+            crit = self.trace_report().get("critical_path")
+            peer_str = crit["peer"] if crit else None
+            victim = next(
+                (
+                    s for s in self._sessions
+                    if not s.closed and s.hop.peer == peer_str
+                ),
+                None,
+            )
+            if victim is None:
+                return {"error": "victim hop has no live session", "peer": peer_str}
+            port = getattr(victim.span.server_info, "metrics_port", None)
+            if not port:
+                return {"error": "victim server announces no metrics_port", "peer": peer_str}
+            addr = self.seq_manager.addr_of(victim.span.peer_id)
+            host = addr.host if addr is not None else "127.0.0.1"
+            url = f"http://{host}:{port}"
+            events = http_journal_fetcher(url)(self.trace_id)
+            return {"peer": peer_str, "url": url, "events": events}
+
+        return fetch
 
     async def _maybe_check_route_upgrade(self) -> None:
         """Periodic better-chain check, shared by the per-token and
@@ -408,6 +533,7 @@ class InferenceSession:
         n_input = hidden.shape[1]
         if self._position + n_input + n_tokens - 1 > self.max_length:
             return None
+        t_step0 = time.perf_counter()
         await self._ensure_route(hidden)
         if not self.server_gen_available(sampling=sampling is not None):
             return None
@@ -442,10 +568,12 @@ class InferenceSession:
                 ) from repair_err
             return None
         self.seq_manager.on_request_success(session.span.peer_id)
+        self._maybe_blame_hop(session)
         # advance by what the server ACTUALLY generated — it clamps chunk
         # lengths to bound its compile cache, and fed got-1 tokens
         got = tokens.shape[1]
         self._position += n_input + got - 1
+        self._account_step(time.perf_counter() - t_step0, n_input + got - 1)
         await self._maybe_check_route_upgrade()
         return tokens
 
@@ -486,6 +614,16 @@ class InferenceSession:
                     push_to=push_to,
                     trace_id=self.trace_id,
                 )
+                # adopt the server-echoed trace id (normalized or server-
+                # minted) from the FIRST hop, so the spans the rest of the
+                # chain opens with — and all client telemetry — key on the
+                # id the servers actually registered
+                if session.echoed_trace_id and session.echoed_trace_id != self.trace_id:
+                    logger.debug(
+                        f"Adopting server-echoed trace id {session.echoed_trace_id} "
+                        f"(was {self.trace_id})"
+                    )
+                    self.trace_id = session.echoed_trace_id
                 sessions.append(session)
             return sessions
         except Exception:
@@ -526,6 +664,7 @@ class InferenceSession:
                 dead.span.peer_id, dead.session_id, resume, dead_end
             )
 
+        self._retire_hops(drop)
         for session in drop:
             await session.close()
 
@@ -757,9 +896,10 @@ class InferenceSession:
             self._last_route_check = time.monotonic() + 4 * period
             return False
 
-        for session in current:
-            if session not in new_sessions:
-                await session.close()
+        replaced = [s for s in current if s not in new_sessions]
+        self._retire_hops(replaced)
+        for session in replaced:
+            await session.close()
         self._sessions = new_sessions
         self._wire_push_chain(new_sessions)
         return True
@@ -800,6 +940,8 @@ class InferenceSession:
     async def close(self) -> None:
         if not self._closed:
             self._closed = True
+            # retire the hops first so trace_report() still works post-close
+            self._retire_hops(self._sessions)
             for session in self._sessions:
                 await session.close()
             self._sessions = []

@@ -37,6 +37,12 @@ from petals_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 CACHE_MISS_PENALTY = 10.0  # seconds added when a server's KV cache can't fit us
+# Soft routing penalty for a queue-dominated server (report_congestion):
+# scaled by the observed queue share, decaying after CONGESTION_WINDOW_S.
+# Sized like a bad WAN RTT — enough to flip near-ties toward an idle
+# replica, far below CACHE_MISS_PENALTY so it never overrides capacity.
+CONGESTION_PENALTY_S = 0.05
+CONGESTION_WINDOW_S = 30.0
 # Prompt-prefix affinity amplitude (see _edge_cost): must dominate
 # noise-level cost differences between near-equal replicas or identical
 # prompts scatter and never share a prefix cache; must stay below REAL
@@ -137,6 +143,11 @@ class RemoteSequenceManager:
             self.ping_aggregator.noise_s if self.ping_aggregator is not None else (lambda: 0.0)
         )
         self._banned: Dict[PeerID, Tuple[float, int]] = {}  # peer -> (banned_until, streak)
+        # soft congestion blame from the client-side span profiler: a peer
+        # whose queue-wait dominates its hop wall gets a decaying routing
+        # penalty (peer -> (expires_monotonic, queue_share)) — steering, not
+        # the hard hammer of a ban
+        self._congestion: Dict[PeerID, Tuple[float, float]] = {}
         self._update_lock = asyncio.Lock()
         self._update_task = asyncio.create_task(self._update_loop())
         return self
@@ -261,6 +272,40 @@ class RemoteSequenceManager:
             for pid, (until, streak) in self._banned.items()
             if now - until <= grace
         }
+        self._congestion = {
+            pid: (expires, share)
+            for pid, (expires, share) in self._congestion.items()
+            if now < expires
+        }
+
+    # -------------------------------------------------------------- congestion
+
+    def report_congestion(
+        self, peer_id: PeerID, queue_share: float, *, window_s: float = CONGESTION_WINDOW_S
+    ) -> None:
+        """Hop-level blame from the client-side critical-path profiler
+        (InferenceSession): ``queue_share`` of this peer's recent hop wall
+        was spent queue-waiting. The penalty decays after ``window_s`` so a
+        server that drains its backlog is forgiven without any unban step."""
+        share = min(max(float(queue_share), 0.0), 1.0)
+        self._congestion[peer_id] = (time.monotonic() + window_s, share)
+        from petals_tpu.telemetry import instruments as tm
+
+        tm.CONGESTION_PENALTIES.inc()
+        logger.debug(
+            f"Congestion blame on {peer_id}: queue share {share:.0%} "
+            f"for {window_s:.0f}s"
+        )
+
+    def _congestion_penalty(self, peer_id) -> float:
+        entry = self._congestion.get(peer_id)
+        if entry is None:
+            return 0.0
+        expires, share = entry
+        if time.monotonic() >= expires:
+            self._congestion.pop(peer_id, None)
+            return 0.0
+        return CONGESTION_PENALTY_S * share
 
     # ------------------------------------------------------------------ sequences
 
@@ -509,7 +554,7 @@ class RemoteSequenceManager:
             and info.cache_tokens_left < cache_tokens_needed
         ):
             edge += CACHE_MISS_PENALTY
-        return edge + affinity_jitter
+        return edge + self._congestion_penalty(peer_id) + affinity_jitter
 
     def estimate_chain_latency(
         self, chain: List[RemoteSpanInfo], cache_tokens_needed: Optional[int] = None

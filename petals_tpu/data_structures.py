@@ -188,6 +188,10 @@ class ServerInfo:
     # failure counters — the swarm-aggregation input for run_health's
     # /api/v1/metrics view. Kept small: it rides every DHT announce.
     telemetry: Optional[Dict[str, Any]] = None
+    # the /metrics + /journal HTTP port (telemetry.exposition.MetricsServer),
+    # so clients (flight recorder) can fetch a victim server's journal
+    # excerpt by trace_id on an SLO breach; None when exposition is disabled
+    metrics_port: Optional[int] = None
 
     def to_tuple(self) -> Tuple[int, float, dict]:
         extra_info = dataclasses.asdict(self)

@@ -88,6 +88,12 @@ class _LaneGenState:
     seed: int = 0
     draw_idx: int = 0
     seen: Optional[np.ndarray] = None  # [vocab] bool; only when penalty active
+    # per-hop latency attribution (handler step_meta): admission time, first
+    # queue wait, and cumulative compiled-step time across the stream
+    enqueued: float = 0.0  # time.perf_counter() at registration
+    started: bool = False  # first batched step already recorded the wait
+    queue_s: float = 0.0
+    compute_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -110,6 +116,8 @@ class _LanePrefillState:
     outs: List[np.ndarray]
     enqueued: float = 0.0  # time.perf_counter() at admission (queue-wait metric)
     wait_observed: bool = False  # first chunk already recorded the queue wait
+    queue_s: float = 0.0  # admission -> first chunk (handler step_meta)
+    compute_s: float = 0.0  # cumulative mixed-step wall across chunks
 
 
 @dataclasses.dataclass
@@ -204,6 +212,13 @@ class DecodeBatcher:
         self._lane_waiters: List[_LaneWaiter] = []
         self._waiter_seq = itertools.count()
         self._pending: List[tuple] = []  # (lane, hidden, position, future, generation)
+        # per-hop latency attribution (handler step_meta): admission time of
+        # the in-flight step per lane, and the finished step's queue/compute
+        # split for the handler to pop after the future resolves. Plain dict
+        # ops (GIL-atomic) — one step in flight per lane (_lane_busy), so the
+        # event loop and compute thread never race on the same key.
+        self._enq_t: Dict[int, float] = {}
+        self._step_timing: Dict[int, dict] = {}
         # session scheduler: priority + per-peer fair-share admission, and (in
         # paged mode with swap_host_bytes > 0) preemption of idle victim lanes
         # to the host-RAM swap tier on pool exhaustion. With the default
@@ -422,6 +437,10 @@ class DecodeBatcher:
                 self._lane_waiters.remove(waiter)
 
     def release_lane(self, lane: int) -> None:
+        # drop stale latency attributions — they belong to the departing
+        # tenant, not whoever acquires this lane next
+        self._enq_t.pop(lane, None)
+        self._step_timing.pop(lane, None)
         # a timed-out/cancelled session may have left a step queued: purge it,
         # or its stale KV write could land in the next tenant's history
         kept = []
@@ -1013,6 +1032,21 @@ class DecodeBatcher:
         info.update(self._scheduler.summary())
         return info
 
+    def occupancy_hint(self) -> dict:
+        """Two-field load hint riding every step_meta reply (cheaper than the
+        full occupancy_info dict, and small enough for every token)."""
+        return {
+            "busy_lanes": (self.n_lanes - len(self._free_lanes)) if self.is_open else 0,
+            "lane_waiters": len(self._lane_waiters),
+        }
+
+    def pop_step_timing(self, lane: int) -> Optional[dict]:
+        """Consume the finished step's queue/compute attribution for ``lane``
+        (written by the compute thread / flush loop just before the step
+        future resolved). None when no timed step completed — e.g. a
+        cached-prefix fast path that never touched the device."""
+        return self._step_timing.pop(lane, None)
+
     # ------------------------------------------------------------------ stepping
 
     def _check_lane(self, lane: int) -> None:
@@ -1026,6 +1060,7 @@ class DecodeBatcher:
         """One decode token for ``lane`` (hidden [1, 1, hidden]); coalesced
         with whatever other lanes are pending by the time the device is free.
         A preempted (swapped-out) lane transparently swaps back in first."""
+        t_enq = time.perf_counter()  # before _lane_busy: lock + alloc waits count as queue
         async with self._lane_busy(lane):
             self._check_lane(lane)
             if self.page_size is not None:
@@ -1039,6 +1074,7 @@ class DecodeBatcher:
                     timeout=self.alloc_timeout,
                 )
             fut = asyncio.get_running_loop().create_future()
+            self._enq_t[lane] = t_enq  # written under _lane_busy: no overwrite race
             self._pending.append((lane, hidden, int(position), fut, self._generation))
             self._spawn_flush_loop()
             return await fut
@@ -1152,6 +1188,10 @@ class DecodeBatcher:
                 st.remaining -= 1
                 if st.remaining <= 0:
                     del self._gen_states[lane]
+                    self._step_timing[lane] = {
+                        "queue_s": st.queue_s, "compute_s": st.compute_s,
+                        "variant": "gen",
+                    }
                     if not st.future.done():
                         st.future.set_result(
                             np.asarray([st.collected], np.int32)
@@ -1187,7 +1227,8 @@ class DecodeBatcher:
             # first chunk entering a step: the admission -> first-compute gap
             st.wait_observed = True
             if st.enqueued:
-                tm.PREFILL_QUEUE_WAIT.observe(time.perf_counter() - st.enqueued)
+                st.queue_s = max(time.perf_counter() - st.enqueued, 0.0)
+                tm.PREFILL_QUEUE_WAIT.observe(st.queue_s)
         return st, max(int(take), 1)
 
     def _advance_prefill(self, st: _LanePrefillState, take: int, chunk_out) -> None:
@@ -1201,6 +1242,9 @@ class DecodeBatcher:
         st.position += take
         if st.offset >= st.hidden.shape[1]:
             self._prefill_queue.remove(st)
+            self._step_timing[st.lane] = {
+                "queue_s": st.queue_s, "compute_s": st.compute_s, "variant": "mixed",
+            }
             if not st.future.done():
                 out = (
                     st.outs[0] if len(st.outs) == 1
@@ -1307,7 +1351,7 @@ class DecodeBatcher:
                 future=asyncio.get_running_loop().create_future(),
                 generation=self._lane_generation[lane],
                 token=t0, position=int(position), remaining=int(n_tokens) - 1,
-                collected=[t0],
+                collected=[t0], enqueued=time.perf_counter(),
             )
             if sampling is not None:
                 st.do_sample = bool(sampling.get("do_sample", False))
@@ -1443,7 +1487,21 @@ class DecodeBatcher:
             tm.STEP_DENSE.observe(duration)
             tm.STEPS_DENSE.inc()
         tm.DECODE_TOKENS.inc(len(batch))
+        self._record_decode_timing(batch, t_step, duration)
         return host_out
+
+    def _record_decode_timing(self, batch, t_step: float, duration: float) -> None:
+        """Per-lane queue/compute split for the handler's step_meta: queue is
+        enqueue -> compute start, compute is the shared batched-step wall (the
+        lane rode the whole program). Runs on the compute thread; see _enq_t."""
+        variant = "paged" if self.page_size is not None else "dense"
+        for lane, _h, _pos, _fut, _gen in batch:
+            enq = self._enq_t.pop(lane, None)
+            self._step_timing[lane] = {
+                "queue_s": max(t_step - enq, 0.0) if enq is not None else 0.0,
+                "compute_s": duration,
+                "variant": variant,
+            }
 
     def _run_batch_mixed(self, batch, pf) -> Tuple[np.ndarray, np.ndarray]:
         """Compute-thread body: ONE jitted step advancing every pending
@@ -1484,9 +1542,12 @@ class DecodeBatcher:
         self.stats["max_prefill_tokens_per_step"] = max(
             self.stats["max_prefill_tokens_per_step"], take
         )
-        tm.STEP_MIXED.observe(time.perf_counter() - t_step)
+        duration = time.perf_counter() - t_step
+        tm.STEP_MIXED.observe(duration)
         tm.STEPS_MIXED.inc()
         tm.DECODE_TOKENS.inc(len(batch))
+        self._record_decode_timing(batch, t_step, duration)
+        st.compute_s += duration  # whole-prefill compute accumulates per chunk
         return host_out, host_chunk
 
     def _run_batch_gen(self, batch, gen_states) -> Tuple[np.ndarray, np.ndarray]:
@@ -1554,9 +1615,16 @@ class DecodeBatcher:
         self.stats["max_gen_lanes"] = max(
             self.stats["max_gen_lanes"], len(gen_states)
         )
-        tm.STEP_GEN.observe(time.perf_counter() - t_step)
+        duration = time.perf_counter() - t_step
+        tm.STEP_GEN.observe(duration)
         tm.STEPS_GEN.inc()
         tm.DECODE_TOKENS.inc(len(batch) + len(gen_states))
+        self._record_decode_timing(batch, t_step, duration)
+        for st in gen_states.values():
+            if not st.started:
+                st.started = True
+                st.queue_s = max(t_step - st.enqueued, 0.0) if st.enqueued else 0.0
+            st.compute_s += duration
         return host_out, host_toks
 
     # ------------------------------------------------------- non-batchable ops

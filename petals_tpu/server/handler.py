@@ -985,6 +985,9 @@ class TransformerHandler:
             try:
               while True:
                 step = await next_step()
+                # serving clock for this step's step_meta: receipt -> reply
+                # ready (everything the client's wall covers except network)
+                t_step_recv = time.perf_counter()
                 # a later step may mutate the rows being stored (rollback,
                 # overwrite): finish the store first so content stays honest
                 if pending_store is not None:
@@ -1181,6 +1184,12 @@ class TransformerHandler:
                             exec_hidden = hidden[:, hit_len:]
                             pos = hit_len
 
+                # queue/compute attribution for the step_meta piggyback: the
+                # pooled paths get the batcher's per-lane split; the rest
+                # fall back to the execution-block wall (queue folded in)
+                t_exec = time.perf_counter()
+                step_timing = None
+                step_variant = "cached"
                 with get_tracer().span(
                     "inference_step", annotate=False, trace_id=trace_id,
                     blocks=end - start, batch=batch_size, seq=seq,
@@ -1197,6 +1206,8 @@ class TransformerHandler:
                             batcher.step(lane, hidden, pos), self.step_timeout
                         )
                         tm.TOKEN_LATENCY.observe(time.perf_counter() - t_tok)
+                        step_variant = "decode"
+                        step_timing = batcher.pop_step_timing(lane)
                     elif (
                         lane is not None and prompts is None and hypo_ids is None
                         and batcher.page_size is not None
@@ -1210,6 +1221,8 @@ class TransformerHandler:
                             batcher.prefill_lane(lane, exec_hidden, pos),
                             self.step_timeout,
                         )
+                        step_variant = "prefill"
+                        step_timing = batcher.pop_step_timing(lane)
                     elif lane is not None and prompts is None and hypo_ids is None:
                         # pooled long prefill on the DENSE pool (and the
                         # TP/lockstep spans, which gate paged mode off): each
@@ -1217,6 +1230,7 @@ class TransformerHandler:
                         # batched decode steps interleave between chunks
                         # instead of stalling for the whole prefill
                         # (Sarathi-style)
+                        step_variant = "dense_prefill"
                         chunk_fns = []
                         off = 0
                         for clen in backend.chunk_plan(
@@ -1247,6 +1261,8 @@ class TransformerHandler:
                     elif lane is not None:
                         # pooled session with deep prompts or explicit
                         # hypo_ids: one atomic exclusive pass on the lane
+                        step_variant = "exclusive"
+
                         def run_lane(kv_lane, lane_handles, hidden=hidden, prompts=prompts, hypo_ids=hypo_ids):
                             with device_annotation("inference_step"):
                                 out, new_kv = backend.inference_step(
@@ -1264,6 +1280,8 @@ class TransformerHandler:
                             self.step_timeout,
                         )
                     else:
+                        step_variant = "private"
+
                         def run_step(exec_hidden=exec_hidden, kv=kv):
                             with device_annotation("inference_step"):
                                 out, new_kv = backend.inference_step(
@@ -1282,6 +1300,7 @@ class TransformerHandler:
                         # keep the allocator's view coherent (old buffers donated)
                         self.memory_cache.update_cache(handles[0], kv[0])
                         self.memory_cache.update_cache(handles[1], kv[1])
+                fallback_compute_s = time.perf_counter() - t_exec
                 if prefix_out is not None:
                     # cached prefix outputs + the freshly computed tail
                     out = await asyncio.to_thread(
@@ -1371,6 +1390,7 @@ class TransformerHandler:
                             f"exceeds max_length {max_length}"
                         )
 
+                    gen_timing = None
                     if lane is not None:
                         # pooled session: the gen loop runs INSIDE the flush
                         # loop — each of the <=32 decode steps batches this
@@ -1386,6 +1406,7 @@ class TransformerHandler:
                             ),
                             self.step_timeout,
                         )
+                        gen_timing = batcher.pop_step_timing(lane)
                     else:
                         def run_gen(kv=kv, out=out, gen_n=gen_n,
                                     gen_sampling=gen_sampling):
@@ -1398,14 +1419,27 @@ class TransformerHandler:
                                 )
                             return np.asarray(tokens), new_kv
 
+                        t_gen = time.perf_counter()
                         gen_arr, kv = await asyncio.wait_for(
                             self.queue.submit(
                                 run_gen, priority=PRIORITY_INFERENCE, size=gen_n
                             ),
                             self.step_timeout,
                         )
+                        fallback_compute_s += time.perf_counter() - t_gen
                         self.memory_cache.update_cache(handles[0], kv[0])
                         self.memory_cache.update_cache(handles[1], kv[1])
+                    if gen_timing is not None:
+                        # a content op preceded the gen loop on this lane:
+                        # the two device phases sum into one step attribution
+                        if step_timing is None:
+                            step_timing = gen_timing
+                        else:
+                            step_timing = {
+                                "queue_s": step_timing["queue_s"] + gen_timing["queue_s"],
+                                "compute_s": step_timing["compute_s"] + gen_timing["compute_s"],
+                                "variant": step_timing["variant"] + "+gen",
+                            }
                     position += gen_n - 1  # the last token is never fed
                     gen_token_list = [int(t) for t in gen_arr[0]]
                 if reg is not None:
@@ -1415,13 +1449,39 @@ class TransformerHandler:
                     # first token out, queue wait and prefill included
                     ttft_observed = True
                     tm.TTFT.observe(time.perf_counter() - t_open)
+                # per-hop span piggyback: a compact attribution dict rides
+                # every content reply, keyed by the session's trace id on the
+                # client side (telemetry/spans.py). Dict-protocol replies, so
+                # old clients simply ignore the unknown key.
+                if step_timing is not None:
+                    meta_q = step_timing["queue_s"]
+                    meta_c = step_timing["compute_s"]
+                    step_variant = step_timing.get("variant", step_variant)
+                else:
+                    meta_q, meta_c = 0.0, fallback_compute_s
+                step_meta = {
+                    "queue_s": round(meta_q, 6),
+                    "compute_s": round(meta_c, 6),
+                    "variant": step_variant,
+                }
+                if lane is not None:
+                    step_meta.update(batcher.occupancy_hint())
                 if gen_token_list is not None:
                     # the client computes everything it needs from the token
                     # ids; skipping the hidden reply saves the prefill-sized
                     # upload on the wire
-                    yield {"tokens": gen_token_list, "position": position}
+                    step_meta["serialize_s"] = 0.0
+                    step_meta["total_s"] = round(time.perf_counter() - t_step_recv, 6)
+                    yield {
+                        "tokens": gen_token_list, "position": position,
+                        "step_meta": step_meta,
+                    }
                     continue
+                t_ser = time.perf_counter()
                 wire_out = serialize_array(out, reply_comp)
+                ser_s = time.perf_counter() - t_ser
+                tm.REPLY_SERIALIZE.observe(ser_s)
+                step_meta["serialize_s"] = round(ser_s, 6)
                 if push_to is not None and prompts is None:
                     # can_push = no deep prompts (reference block_functions.py:233).
                     # Fire-and-forget: the client's relay of this output remains
@@ -1436,7 +1496,11 @@ class TransformerHandler:
                     task.add_done_callback(
                         log_exception_callback(logger, "output push")
                     )
-                yield {"tensors": {"hidden": wire_out}, "position": position}
+                step_meta["total_s"] = round(time.perf_counter() - t_step_recv, 6)
+                yield {
+                    "tensors": {"hidden": wire_out}, "position": position,
+                    "step_meta": step_meta,
+                }
             finally:
                 if pending_store is not None and not pending_store.done():
                     import sys as _sys

@@ -562,6 +562,12 @@ class Server:
             # per-server telemetry digest: the announce loop's cadence makes
             # the tok/s figure an update_period-window average
             telemetry=self._telemetry_digest(),
+            # where /metrics and /journal live, so a breaching client can
+            # fetch this server's journal excerpt for its trace_id
+            metrics_port=(
+                self._metrics_server.port
+                if getattr(self, "_metrics_server", None) is not None else None
+            ),
         )
 
     def _telemetry_digest(self) -> Optional[dict]:

@@ -27,6 +27,15 @@ The pieces:
   in ServerInfo via the DHT announce path.
 - :mod:`.instruments` — the shared named instruments (TTFT, step
   duration, swap bytes, ...) pre-registered on the global registry.
+- :mod:`.spans` — the client-side critical-path profiler: per-hop
+  waterfalls built from the ``step_meta`` dicts servers piggyback on
+  inference replies (network / queue / compute / serialize / other).
+- :mod:`.flight` — the SLO flight recorder: on a TTFT or token-latency
+  breach, dump the span waterfall plus the victim server's journal
+  excerpt to a bounded JSONL ring.
+- :mod:`.gate` — the perf-regression gate: diff per-row bench telemetry
+  blobs (counter deltas + step-duration histograms) against a committed
+  baseline (``bench.py --gate``).
 """
 
 from petals_tpu.telemetry.journal import TelemetryJournal, get_journal
@@ -50,8 +59,24 @@ from petals_tpu.telemetry.exposition import (
     render_prometheus,
     telemetry_digest,
 )
+from petals_tpu.telemetry.flight import (
+    FlightRecorder,
+    flight_from_env,
+    http_journal_fetcher,
+)
+from petals_tpu.telemetry.spans import (
+    HopTrace,
+    build_trace_report,
+    format_waterfall,
+)
 
 __all__ = [
+    "FlightRecorder",
+    "HopTrace",
+    "build_trace_report",
+    "flight_from_env",
+    "format_waterfall",
+    "http_journal_fetcher",
     "Counter",
     "Gauge",
     "Histogram",

@@ -145,12 +145,31 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     server_version = "petals-tpu-metrics"
 
     def do_GET(self):  # noqa: N802 (http.server API)
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path in ("/metrics", "/"):
             body = render_prometheus().encode()
             ctype = _CONTENT_TYPE
         elif path == "/journal":
-            body = (get_journal().to_jsonl() + "\n").encode()
+            # server-side filters (?kind=, ?trace_id=, ?since_seq=): the
+            # flight recorder asks for one trace's events, and incremental
+            # scrapers poll with the last seq they saw — neither should pay
+            # for (or parse) the full ring
+            import urllib.parse
+
+            params = urllib.parse.parse_qs(query)
+            filters = {}
+            if params.get("kind"):
+                filters["kind"] = params["kind"][0]
+            if params.get("trace_id"):
+                filters["trace_id"] = params["trace_id"][0]
+            if params.get("since_seq"):
+                try:
+                    filters["since_seq"] = int(params["since_seq"][0])
+                except ValueError:
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+            body = (get_journal().to_jsonl(**filters) + "\n").encode()
             ctype = "application/x-ndjson"
         else:
             self.send_response(404)

@@ -27,6 +27,15 @@ PREFILL_QUEUE_WAIT = REGISTRY.histogram(
     "petals_prefill_queue_wait_seconds",
     "Time a prefill spent queued before its first chunk entered a mixed step",
 )
+REPLY_SERIALIZE = REGISTRY.histogram(
+    "petals_reply_serialize_seconds",
+    "Server-side serialization time of one inference reply's tensors",
+)
+SLO_BREACHES = REGISTRY.counter(
+    "petals_slo_breaches_total",
+    "Latency SLO breaches captured by the flight recorder, by kind",
+    labels=("kind",),  # ttft | token
+)
 
 # --- compiled step ---------------------------------------------------------
 STEP_DURATION = REGISTRY.histogram(
@@ -75,6 +84,10 @@ ROUTE_BUILDS = REGISTRY.counter(
 )
 PEER_BANS = REGISTRY.counter(
     "petals_client_peer_bans_total", "Peers banned after request failures"
+)
+CONGESTION_PENALTIES = REGISTRY.counter(
+    "petals_client_congestion_penalties_total",
+    "Soft routing penalties applied to queue-dominated servers (hop blame)",
 )
 
 # --- telemetry self-observation -------------------------------------------

@@ -67,14 +67,22 @@ class TelemetryJournal:
         return ev
 
     def events(
-        self, kind: Optional[str] = None, trace_id: Optional[str] = None
+        self,
+        kind: Optional[str] = None,
+        trace_id: Optional[str] = None,
+        since_seq: Optional[int] = None,
     ) -> List[dict]:
+        """Snapshot of the ring, optionally filtered by event kind, trace id,
+        and/or ``seq > since_seq`` (incremental polling: a scraper remembers
+        the last seq it saw and asks only for what's new)."""
         with self._lock:
             evs = list(self._events)
         if kind is not None:
             evs = [e for e in evs if e["kind"] == kind]
         if trace_id is not None:
             evs = [e for e in evs if e.get("trace_id") == trace_id]
+        if since_seq is not None:
+            evs = [e for e in evs if e["seq"] > since_seq]
         return evs
 
     def __len__(self) -> int:
@@ -84,8 +92,10 @@ class TelemetryJournal:
     def __iter__(self) -> Iterator[dict]:
         return iter(self.events())
 
-    def to_jsonl(self) -> str:
-        return "\n".join(json.dumps(e, default=str) for e in self.events())
+    def to_jsonl(self, **filters) -> str:
+        return "\n".join(
+            json.dumps(e, default=str) for e in self.events(**filters)
+        )
 
     def clear(self) -> None:
         with self._lock:

@@ -88,6 +88,9 @@ def free_disk_space_for(
                 break
             logger.info(
                 f"Evicting {child.name} ({size / 2**20:.0f} MiB, "
+                # swarmlint: disable=no-naive-wallclock-in-span — st_atime is
+                # epoch time; only the wall clock is comparable to it, and the
+                # age here is a log cosmetic, not a latency span
                 f"last used {time.time() - atime:.0f}s ago) to free cache space"
             )
             if child.is_dir():

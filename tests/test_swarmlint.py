@@ -295,12 +295,15 @@ def test_no_unbounded_metric_labels():
         "    REQS.labels(session_id=session_id).inc()\n"
         "    LAT.labels(peer=str(peer)).observe(0.1)\n"  # str() doesn't launder taint
         "    BANS.labels(who=slot.peer_id).inc()\n"  # attribute tail is tainted too
+        "    HOPS.labels(f'{session_id}-x').inc()\n"  # f-strings don't launder taint
+        "    LOAD.labels(uid, 'steps').inc()\n"  # positional args are checked too
     )
-    assert lines_hit(bad, "no-unbounded-metric-labels") == [2, 3, 4]
+    assert lines_hit(bad, "no-unbounded-metric-labels") == [2, 3, 4, 5, 6]
     ok = (
-        "def f(self, variant, session_id):\n"
+        "def f(self, variant, session_id, kind):\n"
         "    STEPS.labels(variant=variant).inc()\n"  # static enum label: fine
         "    SWAPS.labels(direction='out').inc()\n"
+        "    SLO.labels(kind=kind).inc()\n"  # bounded enum ('ttft'/'token'): fine
         "    journal.event('swap', trace_id=session_id)\n"  # ids go to the journal
         "    self.labels = [session_id]\n"  # attribute assignment, not a call
     )
@@ -311,6 +314,38 @@ def test_no_unbounded_metric_labels():
         "# swarmlint: disable=no-unbounded-metric-labels — test fixture\n"
     )
     assert "no-unbounded-metric-labels" not in rules_hit(suppressed)
+
+
+def test_no_naive_wallclock_in_span():
+    bad = (
+        "import time\n"
+        "def f(self, t_enq):\n"
+        "    t0 = time.time()\n"
+        "    work()\n"
+        "    span = time.time() - t0\n"  # duration from the wall clock
+        "    queue_s = time.time() - t_enq\n"  # raw call as an operand
+        "    return span, queue_s\n"
+    )
+    assert lines_hit(bad, "no-naive-wallclock-in-span") == [5, 6]
+    ok = (
+        "import time\n"
+        "def f(self, t0, atime):\n"
+        "    span = time.perf_counter() - t0\n"  # monotonic: fine
+        "    age = time.monotonic() - t0\n"
+        "    journal.event('x', t=time.time())\n"  # absolute timestamp: fine
+        "    entry = {'ts': time.time()}\n"
+        "    def g():\n"
+        "        t1 = time.time()\n"  # other scope's name, no subtraction here
+        "    return span + age\n"
+    )
+    assert "no-naive-wallclock-in-span" not in rules_hit(ok)
+    suppressed = (
+        "import time\n"
+        "def f(self, atime):\n"
+        "    age = time.time() - atime  "
+        "# swarmlint: disable=no-naive-wallclock-in-span — epoch atime\n"
+    )
+    assert "no-naive-wallclock-in-span" not in rules_hit(suppressed)
 
 
 def test_pragma_machinery():

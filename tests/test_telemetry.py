@@ -11,6 +11,7 @@ import asyncio
 import json
 import re
 import threading
+import urllib.error
 import urllib.request
 
 import jax.numpy as jnp
@@ -494,3 +495,220 @@ def test_health_metrics_summary_aggregation():
     assert agg["swap_out_bytes"] == 8 and agg["alloc_failed"] == 2
     assert agg["servers_reporting"] == 2
     assert agg["occupancy"] == 6 / 8
+
+
+# ------------------------------------------------- perf-gate comparison units
+
+
+def test_gate_compare_blobs_and_report():
+    """The perf gate is pure data->data: an identical blob passes, a 2x
+    step-duration regression fails, a compiled path disappearing fails, and
+    a row that failed to run at all fails."""
+    from petals_tpu.telemetry.gate import compare_blobs, gate_report
+
+    lkg = {
+        "counters_delta": {"decode_tokens": 80.0, "alloc_failed": 0.0},
+        "step_duration": {
+            "paged": {"count": 40, "mean_ms": 5.0, "p50_ms": 4.0, "p99_ms": 9.0},
+        },
+    }
+    assert compare_blobs(lkg, lkg) == []
+
+    # 2x regression on mean and p50 (well past the 1 ms absolute floor)
+    slow = json.loads(json.dumps(lkg))
+    slow["step_duration"]["paged"]["mean_ms"] = 10.0
+    slow["step_duration"]["paged"]["p50_ms"] = 8.0
+    problems = compare_blobs(lkg, slow)
+    assert any("mean_ms" in p for p in problems), problems
+    # ...but a wide tolerance (advisory CI mode) lets the same blob through
+    assert compare_blobs(lkg, slow, tolerance=3.0) == []
+
+    # sub-millisecond jitter stays under the absolute floor even at 2x
+    jitter = json.loads(json.dumps(lkg))
+    jitter["step_duration"]["paged"] = {
+        "count": 40, "mean_ms": 0.9, "p50_ms": 0.8, "p99_ms": 2.0,
+    }
+    tiny_base = json.loads(json.dumps(jitter))
+    tiny_base["step_duration"]["paged"]["mean_ms"] = 0.45
+    tiny_base["step_duration"]["paged"]["p50_ms"] = 0.4
+    assert compare_blobs(tiny_base, jitter) == []
+
+    # the compiled path vanishing is itself a regression
+    gone = {"counters_delta": dict(lkg["counters_delta"]), "step_duration": {}}
+    assert any("no longer exercised" in p for p in compare_blobs(lkg, gone))
+
+    # new failures against a clean baseline, and collapsed workload volume
+    failing = json.loads(json.dumps(lkg))
+    failing["counters_delta"]["alloc_failed"] = 3.0
+    assert any("alloc_failed" in p for p in compare_blobs(lkg, failing))
+    shrunk = json.loads(json.dumps(lkg))
+    shrunk["counters_delta"]["decode_tokens"] = 10.0
+    assert any("decode_tokens" in p for p in compare_blobs(lkg, shrunk))
+
+    baseline = {"tolerance": 1.0, "rows": {"r1": {"telemetry": lkg}}}
+    assert gate_report(baseline, {"r1": {"telemetry": lkg}}) == {}
+    assert "r1" in gate_report(baseline, {"r1": {"telemetry": slow}})
+    assert gate_report(baseline, {"r1": None}) == {
+        "r1": ["row failed to run (no result)"]
+    }
+
+
+# ------------------------------------------------ /journal endpoint filters
+
+
+def test_journal_endpoint_filters():
+    """/journal serves the ring as JSONL with ?kind= / ?trace_id= /
+    ?since_seq= filters (the flight recorder's evidence API); a malformed
+    since_seq is a 400, not a crash."""
+    from petals_tpu.telemetry.exposition import MetricsServer
+
+    journal = get_journal()
+    tid_a, tid_b = new_trace_id(), new_trace_id()
+    e1 = journal.event("gate_test_admission", trace_id=tid_a)
+    journal.event("gate_test_admission", trace_id=tid_b)
+    journal.event("gate_test_swap", trace_id=tid_a)
+
+    server = MetricsServer(port=0)
+    try:
+        def fetch(query=""):
+            url = f"http://127.0.0.1:{server.port}/journal{query}"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                body = resp.read().decode()
+            return [json.loads(line) for line in body.splitlines() if line.strip()]
+
+        by_trace = fetch(f"?trace_id={tid_a}")
+        assert {e["trace_id"] for e in by_trace} == {tid_a}
+        assert {e["kind"] for e in by_trace} == {
+            "gate_test_admission", "gate_test_swap"
+        }
+        by_kind = fetch("?kind=gate_test_swap")
+        assert by_kind and all(e["kind"] == "gate_test_swap" for e in by_kind)
+        combined = fetch(f"?kind=gate_test_admission&trace_id={tid_b}")
+        assert len(combined) == 1 and combined[0]["trace_id"] == tid_b
+        since = fetch(f"?since_seq={e1['seq']}&trace_id={tid_a}")
+        assert [e["kind"] for e in since] == ["gate_test_swap"]
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            fetch("?since_seq=notanint")
+        assert err.value.code == 400
+    finally:
+        server.close()
+
+
+# ------------------------- e2e: 2-hop critical path + SLO flight recorder
+
+
+def test_two_hop_chain_trace_and_flight_recorder(model_path):
+    """Acceptance for the critical-path tracer: a 2-server chain yields a
+    trace_report() with one waterfall entry per hop, both servers see the
+    SAME client-minted trace id, and >=95% of the session's wall-clock is
+    attributed to named components. A session with microscopic SLOs then
+    breaches on every step and the flight recorder captures the client
+    waterfall plus the victim server's journal excerpt for that trace id."""
+
+    async def main():
+        from petals_tpu.client.config import ClientConfig
+        from petals_tpu.client.inference_session import InferenceSession
+        from petals_tpu.client.routing.sequence_manager import RemoteSequenceManager
+        from petals_tpu.dht import DHTNode
+        from petals_tpu.telemetry.flight import FlightRecorder
+        from petals_tpu.telemetry.spans import format_waterfall
+
+        bootstrap = await DHTNode.create(maintenance_period=1000)
+        servers = []
+        for first in (0, 2):
+            server = Server(
+                model_path,
+                initial_peers=[bootstrap.own_addr],
+                first_block=first,
+                num_blocks=2,
+                compute_dtype=jnp.float32,
+                use_flash=False,
+                batching=True,
+                batch_lanes=2,
+                batch_max_length=32,
+                page_size=8,
+                metrics_port=0,
+            )
+            await server.start()
+            servers.append(server)
+
+        prefix = servers[0].dht_prefix
+        uids = [make_uid(prefix, i) for i in range(4)]
+        manager = await RemoteSequenceManager.create(
+            ClientConfig(initial_peers=[bootstrap.own_addr.to_string()]), uids
+        )
+        try:
+            rng = np.random.RandomState(7)
+            hidden_size = servers[0].cfg.hidden_size
+            session = InferenceSession(manager, max_length=16)
+            await session.step(rng.randn(1, 4, hidden_size).astype(np.float32) * 0.1)
+            for _ in range(3):
+                await session.step(
+                    rng.randn(1, 1, hidden_size).astype(np.float32) * 0.1
+                )
+
+            # ---- the same client-minted id reached BOTH servers' schedulers
+            tid = session.trace_id
+            for server in servers:
+                lane_tids = [
+                    s.trace_id
+                    for s in server.handler.batcher._scheduler.lanes.values()
+                ]
+                assert tid in lane_tids, (server.first_block, lane_tids)
+            # ...and both hops' admissions are journaled under it (process-
+            # global journal: the excerpt is distinguished by trace_id)
+            assert len(get_journal().events(kind="admission", trace_id=tid)) >= 2
+
+            # ---- per-hop waterfall: one entry per server span, attributed
+            report = session.trace_report()
+            assert report["trace_id"] == tid
+            assert [h["blocks"] for h in report["hops"]] == [[0, 2], [2, 4]]
+            for hop in report["hops"]:
+                assert hop["steps"] == 4
+                assert hop["meta_steps"] == 4, hop  # every reply carried meta
+                assert hop["wall_s"] > 0
+                assert hop["components"]["compute"] > 0, hop
+                assert hop["occupancy"] is not None
+            assert report["steps"] == 4 and report["tokens"] == 7
+            assert report["critical_path"] is not None
+            # the components are exhaustive by construction: ~all wall-clock
+            # is attributed (the acceptance threshold)
+            assert report["attributed_fraction"] >= 0.95, report
+            rendered = format_waterfall(report)
+            assert tid in rendered and "critical path:" in rendered
+            await session.close()
+
+            # ---- flight recorder: microscopic SLOs force a breach per kind
+            session2 = InferenceSession(manager, max_length=16)
+            session2.flight = FlightRecorder(
+                ttft_slo_s=1e-9, token_slo_s=1e-9, cooldown_s=0.0
+            )
+            await session2.step(rng.randn(1, 2, hidden_size).astype(np.float32) * 0.1)
+            await session2.step(rng.randn(1, 1, hidden_size).astype(np.float32) * 0.1)
+            ttft_entries = session2.flight.entries(kind="ttft")
+            token_entries = session2.flight.entries(kind="token")
+            assert len(ttft_entries) == 1 and len(token_entries) == 1
+            for entry in ttft_entries + token_entries:
+                assert entry["trace_id"] == session2.trace_id
+                assert entry["observed_s"] > entry["slo_s"]
+                # evidence 1: the client waterfall at breach time
+                wf = entry["waterfall"]
+                assert wf["trace_id"] == session2.trace_id and wf["hops"]
+                # evidence 2: the victim server's journal excerpt over HTTP,
+                # already filtered to this trace
+                sj = entry["server_journal"]
+                assert "error" not in sj, sj
+                assert sj["events"], sj
+                assert all(
+                    e["trace_id"] == session2.trace_id for e in sj["events"]
+                )
+                assert any(e["kind"] == "admission" for e in sj["events"])
+            await session2.close()
+        finally:
+            await manager.shutdown()
+            for server in servers:
+                await server.shutdown()
+            await bootstrap.shutdown()
+
+    run(asyncio.wait_for(main(), 600))
