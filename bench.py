@@ -1397,12 +1397,21 @@ def bench_gate_decode(page_size, label, *, lanes=2, steps=40):
                 )
                 pos += 1
             wall = time.perf_counter() - t0
+            # achieved-vs-roofline utilization: program flops (XLA
+            # cost_analysis via the observatory) over the measured mean step
+            # time. On CPU these are ESTIMATES — utilization stays null
+            # unless PETALS_TPU_PEAK_TFLOPS declares a real peak (on-chip).
+            from petals_tpu.telemetry.observatory import get_observatory
+
+            step_fn = "paged_decode" if page_size else "batched_decode"
+            roofline = get_observatory().roofline(step_fn, wall / steps)
             return {
                 "label": label,
                 "lanes": lanes,
                 "steps": steps,
                 "wall_s": round(wall, 3),
                 "step_ms": round(1000.0 * wall / steps, 3),
+                "roofline": roofline,
             }
         finally:
             await batcher.close()
@@ -1440,6 +1449,13 @@ def _telemetry_counters() -> dict:
         "alloc_failed": tm.ALLOC_FAILED.value,
         "swap_out_bytes": tm.SWAP_OUT_BYTES.value,
         "swap_in_bytes": tm.SWAP_IN_BYTES.value,
+        # compiled-program observatory: total compilations across tracked
+        # functions (the gate holds rows to the baseline's executable count)
+        # and post-warmup steady-state recompiles (must stay zero)
+        "compiles": sum(c.value for _v, c in tm.COMPILES.children()),
+        "compile_anomalies": sum(
+            c.value for _v, c in tm.COMPILE_ANOMALIES.children()
+        ),
     }
 
 

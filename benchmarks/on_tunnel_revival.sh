@@ -100,4 +100,8 @@ for v in one four real; do
 done
 timeout 600 env QUANT_KIND=int4 python benchmarks/ablate_call_overhead.py one 2>&1 | grep -v WARNING | tail -1
 
+# NOTE: the roofline/utilization numbers in bench.py gate rows are CPU
+# estimates (XLA:CPU cost_analysis flops over wall time, no declared peak) —
+# re-derive on-chip with PETALS_TPU_PEAK_TFLOPS set before quoting them.
+
 echo "== revival queue done =="

@@ -20,6 +20,8 @@ The rule set (motivation in each docstring):
                               as metric labels: unbounded series cardinality
 - no-naive-wallclock-in-span — durations/spans must come from a monotonic
                               clock, not time.time() subtraction (NTP slew)
+- no-untracked-jit          — server hot paths must compile via tracked_jit
+                              (compiled-program observatory), not bare jax.jit
 """
 
 from __future__ import annotations
@@ -508,6 +510,12 @@ SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
 HOST_GUARDS = {"len", "isinstance", "getattr", "hasattr", "range"}
 
 
+_JIT_CALLEES = ("jax.jit", "jit")
+# tracked_jit (telemetry.observatory) is jit with its compilations observed:
+# tracer-safety applies to its wrapped functions exactly as to bare jit
+_TRACKED_JIT_CALLEES = ("tracked_jit", "observatory.tracked_jit")
+
+
 def _jit_static_names(dec: ast.AST) -> Optional[Set[str]]:
     """static_argnames of a jit decorator, or None when ``dec`` is not jit."""
     target = dec
@@ -518,9 +526,9 @@ def _jit_static_names(dec: ast.AST) -> Optional[Set[str]]:
             if not dec.args:
                 return None
             inner = dotted(dec.args[0])
-            if inner not in ("jax.jit", "jit"):
+            if inner not in _JIT_CALLEES:
                 return None
-        elif callee not in ("jax.jit", "jit"):
+        elif callee not in _JIT_CALLEES + _TRACKED_JIT_CALLEES:
             return None
         for kw in dec.keywords:
             if kw.arg == "static_argnames":
@@ -782,6 +790,49 @@ def rule_no_naive_wallclock_in_span(tree, source_lines, path) -> Findings:
     return out
 
 
+# ---------------------------------------------------------- no-untracked-jit
+
+
+def _imports_bare_jit(tree: ast.AST) -> bool:
+    """True when the module does ``from jax import jit`` (so a bare ``jit``
+    name refers to the compiler, not some local helper)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            if any(alias.name == "jit" for alias in node.names):
+                return True
+    return False
+
+
+def rule_no_untracked_jit(tree, source_lines, path) -> Findings:
+    """Server hot paths must compile through ``telemetry.observatory
+    .tracked_jit`` so every executable lands in the compiled-program
+    observatory (recompile sentinel, cost table, compile-count gate). A bare
+    ``jax.jit`` — as a decorator, a call, or inside ``functools.partial(
+    jax.jit, ...)`` — creates programs the observatory cannot see. Scoped to
+    ``petals_tpu/server/``; genuinely cold paths (one-shot load-time
+    compiles) are pragma-exempted with a reason."""
+    if "petals_tpu/server/" not in path.replace("\\", "/"):
+        return []
+    bare_jit = _imports_bare_jit(tree)
+    out: Findings = []
+    for node in ast.walk(tree):
+        hit = (
+            isinstance(node, ast.Attribute) and dotted(node) == "jax.jit"
+        ) or (bare_jit and isinstance(node, ast.Name) and node.id == "jit")
+        if hit:
+            out.append(
+                (
+                    node.lineno,
+                    "bare jax.jit bypasses the compiled-program observatory "
+                    "(no recompile sentinel, no cost attribution, invisible "
+                    "to the bench compile gate) — route through "
+                    "telemetry.observatory.tracked_jit, or pragma-exempt a "
+                    "genuinely cold path with a reason",
+                )
+            )
+    return out
+
+
 # ------------------------------------------------------------------ registry
 
 RULES = {
@@ -794,4 +845,5 @@ RULES = {
     "tracer-safety": rule_tracer_safety,
     "no-unbounded-metric-labels": rule_no_unbounded_metric_labels,
     "no-naive-wallclock-in-span": rule_no_naive_wallclock_in_span,
+    "no-untracked-jit": rule_no_untracked_jit,
 }

@@ -188,9 +188,16 @@ class ServerInfo:
     # failure counters — the swarm-aggregation input for run_health's
     # /api/v1/metrics view. Kept small: it rides every DHT announce.
     telemetry: Optional[Dict[str, Any]] = None
-    # the /metrics + /journal HTTP port (telemetry.exposition.MetricsServer),
-    # so clients (flight recorder) can fetch a victim server's journal
-    # excerpt by trace_id on an SLO breach; None when exposition is disabled
+    # compiled-program observatory digest (telemetry.observatory
+    # compile_stats_digest): program count, total compile seconds, anomaly
+    # count — a nonzero anomaly count means the server is recompiling in
+    # steady state and its latency cannot be trusted. Rides next to
+    # ``telemetry`` on every announce.
+    compile_stats: Optional[Dict[str, Any]] = None
+    # the /metrics + /journal + /compile HTTP port
+    # (telemetry.exposition.MetricsServer), so clients (flight recorder) can
+    # fetch a victim server's journal excerpt by trace_id on an SLO breach;
+    # None when exposition is disabled
     metrics_port: Optional[int] = None
 
     def to_tuple(self) -> Tuple[int, float, dict]:

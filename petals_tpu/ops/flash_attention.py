@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from petals_tpu.telemetry.observatory import tracked_jit
+
 # jax<0.5 names this TPUCompilerParams; alias locally, never patch jax
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
@@ -236,8 +238,8 @@ def flash_supported(q, k, v, *, sliding_window: Optional[int] = None) -> bool:
     return True
 
 
-@functools.partial(
-    jax.jit,
+@tracked_jit(
+    name="flash_attend",
     static_argnames=("scale", "block_q", "block_kv", "interpret", "sliding_window"),
 )
 def flash_attend(

@@ -44,6 +44,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from petals_tpu.telemetry.observatory import tracked_jit
+
 # jax<0.5 names this TPUCompilerParams; alias locally, never patch jax
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
@@ -962,7 +964,7 @@ def _packed4_call(x, kind, data, scales, *, index=None, interpret=None):
     return out[:m] if m_pad else out
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@tracked_jit(name="packed4_matmul", static_argnames=("interpret",))
 def packed4_matmul_pallas(x: jnp.ndarray, w: QuantizedLinear, *, interpret: bool | None = None):
     """x: [M, in] -> [M, out] with fused 4-bit (nf4 | int4) dequantization."""
     return _packed4_call(x, w.kind, w.data, w.scales, interpret=interpret)
@@ -1101,7 +1103,7 @@ def _int8_call(x, data, scales, *, index=None, interpret=None):
     return out[:m] if m_pad else out
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@tracked_jit(name="int8_matmul", static_argnames=("interpret",))
 def int8_matmul_pallas(x: jnp.ndarray, w: QuantizedLinear, *, interpret: bool | None = None):
     """x: [M, in] -> [M, out] with fused int8 dequantization."""
     return _int8_call(x, w.data, w.scales, interpret=interpret)

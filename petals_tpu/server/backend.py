@@ -36,6 +36,7 @@ import numpy as np
 from petals_tpu.models.registry import ModelFamily
 from petals_tpu.ops.sampling import sample_tokens, sampling_vectors
 from petals_tpu.server.memory_cache import MemoryCache, TensorDescriptor
+from petals_tpu.telemetry.observatory import tracked_jit
 from petals_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -266,8 +267,8 @@ class TransformerBackend:
         # length; only families whose block accepts it get the extra operand
         takes_n_total = "n_total" in inspect.signature(family.block_apply).parameters
 
-        @functools.partial(
-            jax.jit,
+        @tracked_jit(
+            name="inference_step",
             static_argnames=("with_prompts", "with_hypo", "padded"),
             donate_argnums=(1, 2),
         )
@@ -359,7 +360,7 @@ class TransformerBackend:
         use_quant_consts = self._use_quant_consts
         reattach = self._reattach_quant
 
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        @tracked_jit(name="batched_decode", steady=True, donate_argnums=(1, 2))
         def step(params, k_pool, v_pool, hidden, positions):
             # hidden: [n_lanes, 1, hidden]; positions: [n_lanes] int32
             hidden = hidden.astype(k_pool.dtype)
@@ -426,8 +427,9 @@ class TransformerBackend:
 
         from petals_tpu.ops.paged_attention import gather_pages, scatter_token_rows
 
-        @functools.partial(
-            jax.jit, static_argnames=("contiguous",), donate_argnums=(1, 2)
+        @tracked_jit(
+            name="paged_decode", steady=True,
+            static_argnames=("contiguous",), donate_argnums=(1, 2),
         )
         def step(params, k_pool, v_pool, hidden, positions, tables, *, contiguous: bool):
             # hidden: [n_lanes, 1, hidden]; positions: [n_lanes] int32;
@@ -516,8 +518,9 @@ class TransformerBackend:
 
         from petals_tpu.ops.paged_attention import gather_pages, scatter_token_rows
 
-        @functools.partial(
-            jax.jit, static_argnames=("contiguous",), donate_argnums=(2, 3)
+        @tracked_jit(
+            name="paged_gen_decode", steady=True,
+            static_argnames=("contiguous",), donate_argnums=(2, 3),
         )
         def step(params, client_params, k_pool, v_pool, hidden, tokens,
                  use_token, positions, do_sample, temperature, top_k, top_p,
@@ -631,8 +634,9 @@ class TransformerBackend:
             scatter_token_rows,
         )
 
-        @functools.partial(
-            jax.jit, static_argnames=("contiguous",), donate_argnums=(1, 2)
+        @tracked_jit(
+            name="paged_mixed_step", steady=True,
+            static_argnames=("contiguous",), donate_argnums=(1, 2),
         )
         def step(params, k_pool, v_pool, hidden, positions, tables,
                  chunk_hidden, chunk_lane, chunk_pos, chunk_n_valid,
@@ -775,7 +779,7 @@ class TransformerBackend:
         Content of unallocated slots is masked garbage, exactly like the
         in-step gather."""
 
-        @jax.jit
+        @tracked_jit(name="paged_lane_gather")
         def f(k_pool, v_pool, table_row):
             n_blocks, n_pages, page_size = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
             max_pages = table_row.shape[0]
@@ -794,7 +798,7 @@ class TransformerBackend:
         the pages; unallocated slots drop)."""
         from petals_tpu.ops.paged_attention import scatter_lane_pages
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        @tracked_jit(name="paged_lane_scatter", donate_argnums=(0, 1))
         def f(k_pool, v_pool, k, v, table_row):
             n_blocks, n_pages, page_size = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
             max_pages = table_row.shape[0]
@@ -816,7 +820,7 @@ class TransformerBackend:
         FREED once the host copy has landed (server/batching.py
         _swap_out_lane validates the lane generation first)."""
 
-        @jax.jit
+        @tracked_jit(name="swap_out_pages")
         def f(k_pool, v_pool, pages):
             return jnp.take(k_pool, pages, axis=1), jnp.take(v_pool, pages, axis=1)
 
@@ -829,7 +833,7 @@ class TransformerBackend:
         ``_swap_out_pages_fn``; negative entries drop, mirroring
         ``_paged_lane_scatter_fn``."""
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        @tracked_jit(name="swap_in_pages", donate_argnums=(0, 1))
         def f(k_pool, v_pool, k_pages, v_pages, pages):
             n_pages = k_pool.shape[1]
             safe = jnp.where(pages >= 0, pages, n_pages)
@@ -844,7 +848,7 @@ class TransformerBackend:
         """Duplicate one page across all blocks of the pool (the copy-on-write
         fork: a shared page must be copied before a lane writes into it)."""
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        @tracked_jit(name="copy_page", donate_argnums=(0, 1))
         def f(k_pool, v_pool, src, dst):
             k_page = jax.lax.dynamic_slice_in_dim(k_pool, src, 1, axis=1)
             v_page = jax.lax.dynamic_slice_in_dim(v_pool, src, 1, axis=1)
@@ -859,7 +863,7 @@ class TransformerBackend:
         """Copy one lane out of the pool as a [n_blocks, 1, max_len, hkv, d]
         session-shaped KV pair (for non-batchable work: prefill, kv export)."""
 
-        @jax.jit
+        @tracked_jit(name="lane_extract")
         def f(k_pool, v_pool, lane):
             k = jax.lax.dynamic_slice_in_dim(k_pool, lane, 1, axis=1)
             v = jax.lax.dynamic_slice_in_dim(v_pool, lane, 1, axis=1)
@@ -871,7 +875,7 @@ class TransformerBackend:
     def _lane_insert_fn(self):
         # only the pool buffers are donatable (the lane tensors cannot alias
         # an output: their shapes differ from both outputs)
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        @tracked_jit(name="lane_insert", donate_argnums=(0, 1))
         def f(k_pool, v_pool, k, v, lane):
             k_pool = jax.lax.dynamic_update_slice_in_dim(
                 k_pool, k.astype(k_pool.dtype), lane, axis=1
@@ -898,7 +902,7 @@ class TransformerBackend:
         # Pallas flash kernel: it has no reverse-mode AD rule, and keeping
         # forward and backward on the same (XLA) attention means the backward
         # recompute linearizes exactly what the client saw.
-        @functools.partial(jax.jit, static_argnames=("with_prompts",))
+        @tracked_jit(name="forward", static_argnames=("with_prompts",))
         def fwd(params, hidden, prompts, *, with_prompts: bool):
             use_ring = supports_ring and hidden.shape[1] % sp_size == 0
             if use_ring:
@@ -932,7 +936,7 @@ class TransformerBackend:
     def _backward_fn(self):
         fwd_raw = self._forward_fn.__wrapped__  # un-jitted closure for vjp
 
-        @functools.partial(jax.jit, static_argnames=("with_prompts",))
+        @tracked_jit(name="backward", static_argnames=("with_prompts",))
         def bwd(params, hidden, prompts, grad_out, *, with_prompts: bool):
             def f(h, p):
                 return fwd_raw(params, h, p, with_prompts=with_prompts)
@@ -964,8 +968,8 @@ class TransformerBackend:
         step_fn = self._inference_step_fn
         client_embed, client_head = family.client_embed, family.client_head
 
-        @functools.partial(
-            jax.jit, static_argnames=("n_tokens",), donate_argnums=(2, 3)
+        @tracked_jit(
+            name="server_gen", static_argnames=("n_tokens",), donate_argnums=(2, 3)
         )
         def gen(span_params, client_params, k_stack, v_stack, last_hidden,
                 position, dummy_prompts, dummy_hypo, *, n_tokens: int):
@@ -1012,8 +1016,9 @@ class TransformerBackend:
         step_fn = self._inference_step_fn
         client_embed, client_head = family.client_embed, family.client_head
 
-        @functools.partial(
-            jax.jit, static_argnames=("n_tokens",), donate_argnums=(2, 3)
+        @tracked_jit(
+            name="server_gen_sampled", static_argnames=("n_tokens",),
+            donate_argnums=(2, 3),
         )
         def gen(span_params, client_params, k_stack, v_stack, last_hidden,
                 position, dummy_prompts, dummy_hypo, do_sample, temperature,
@@ -1109,7 +1114,7 @@ class TransformerBackend:
         family, cfg = self.family, self.cfg
         client_head = family.client_head
 
-        @jax.jit
+        @tracked_jit(name="sample_hidden")
         def f(client_params, last_hidden, do_sample, temperature, top_k,
               top_p, rep_penalty, seen, seeds, draw_idx):
             logits = client_head(client_params, last_hidden[:, -1:], cfg)[:, -1, :]
@@ -1154,7 +1159,7 @@ class TransformerBackend:
         reattach = self._reattach_quant
         client_embed, client_head = family.client_embed, family.client_head
 
-        @functools.partial(jax.jit, donate_argnums=(2, 3))
+        @tracked_jit(name="batched_gen_decode", steady=True, donate_argnums=(2, 3))
         def step(params, client_params, k_pool, v_pool, hidden, tokens,
                  use_token, positions, do_sample, temperature, top_k, top_p,
                  rep_penalty, seeds, draw_idx, seen_mask):

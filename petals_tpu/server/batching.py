@@ -643,6 +643,8 @@ class DecodeBatcher:
             if cur >= 0:
                 self._pages.decref(cur)
             row[slot] = int(page)
+        if pages:
+            tm.PREFIX_ADOPT.inc()
 
     def paged_summary(self) -> Optional[dict]:
         """Observability: pool occupancy + allocator counters (rpc_info)."""
@@ -829,7 +831,8 @@ class DecodeBatcher:
                 alloc.decref(int(page))
             self._tables[lane, slots] = -1
             slot.swap = SwapEntry(
-                k=k_host, v=v_host, slots=slots, nbytes=nbytes, generation=gen
+                k=k_host, v=v_host, slots=slots, nbytes=nbytes, generation=gen,
+                suspended_at=time.monotonic(),
             )
             slot.suspending = False
             sched.stats["preemptions"] += 1
@@ -989,6 +992,21 @@ class DecodeBatcher:
             tm.PAGES_FREE.set(
                 self._pages.n_free if self._pages is not None else self.n_pages
             )
+            if self._pages is not None:
+                # page-pool economics: free-run histogram + fragmentation.
+                # O(free pages) with a sort, but only at admission/release/
+                # swap boundaries — never on the decode tick.
+                info = self._pages.fragmentation_info()
+                tm.PAGE_FRAGMENTATION.set(info["frag"])
+                tm.PAGE_LARGEST_RUN.set(info["largest_run"])
+                for bucket, child in tm.PAGE_FREE_RUN_CHILDREN.items():
+                    child.set(info["run_hist"][bucket])
+        mc = self.memory_cache
+        if mc is not None and mc.max_size_bytes < 2**60:
+            # only meaningful under a real HBM budget (the default cache is
+            # effectively unbounded and would read as 2**64 headroom)
+            tm.HBM_HEADROOM.set(mc.bytes_left)
+        tm.SWAP_RESIDENCY_OLDEST.set(self._scheduler.oldest_swap_age())
 
     def _occupancy(self) -> str:
         """Human-readable pool occupancy for AllocationFailed messages: lane
@@ -1029,6 +1047,10 @@ class DecodeBatcher:
             info["pages_free"] = (
                 self._pages.n_free if self._pages is not None else self.n_pages
             )
+            if self._pages is not None:
+                frag = self._pages.fragmentation_info()
+                info["frag"] = frag["frag"]
+                info["largest_free_run"] = frag["largest_run"]
         info.update(self._scheduler.summary())
         return info
 

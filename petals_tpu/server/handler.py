@@ -47,6 +47,7 @@ from petals_tpu.telemetry import (
 )
 from petals_tpu.telemetry import instruments as tm
 from petals_tpu.telemetry.exposition import telemetry_digest
+from petals_tpu.telemetry.observatory import compile_stats_digest
 from petals_tpu.utils.asyncio_utils import log_exception_callback
 from petals_tpu.utils.logging import get_logger
 from petals_tpu.utils.misc import is_dummy
@@ -852,6 +853,9 @@ class TransformerHandler:
             # compact metrics digest (tok/s, TTFT/step percentiles, swap
             # pressure) — same blob that rides ServerInfo on the DHT
             telemetry=telemetry_digest(),
+            # compiled-program observatory digest (programs, compile seconds,
+            # anomalies) — same blob as ServerInfo.compile_stats
+            compile_stats=compile_stats_digest(),
         )
         if self.batcher is not None:
             info["continuous_batching"] = {

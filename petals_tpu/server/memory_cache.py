@@ -106,6 +106,53 @@ class PageAllocator:
         self.stats["allocated"] += 1
         return page
 
+    def free_runs(self) -> list:
+        """Lengths of the contiguous free-page runs, ascending page order.
+        Contiguity matters because the batcher prefers identity pages: a
+        shattered free list means new lanes land on scattered pages and the
+        dense-table fast path degrades to gathers."""
+        runs = []
+        current = 0
+        prev = -2
+        for page in sorted(self._free_set):
+            if page == prev + 1:
+                current += 1
+            else:
+                if current:
+                    runs.append(current)
+                current = 1
+            prev = page
+        if current:
+            runs.append(current)
+        return runs
+
+    def fragmentation_info(self) -> dict:
+        """Free-space economics snapshot: run-length histogram (static
+        buckets — these become metric labels), largest run, and a scalar
+        fragmentation ratio (1 - largest_run/free; 0 = one hole)."""
+        runs = self.free_runs()
+        free = len(self._free_set)
+        largest = max(runs) if runs else 0
+        hist = {"1": 0, "2_3": 0, "4_7": 0, "8_15": 0, "16_plus": 0}
+        for r in runs:
+            if r == 1:
+                hist["1"] += 1
+            elif r <= 3:
+                hist["2_3"] += 1
+            elif r <= 7:
+                hist["4_7"] += 1
+            elif r <= 15:
+                hist["8_15"] += 1
+            else:
+                hist["16_plus"] += 1
+        return {
+            "free": free,
+            "runs": len(runs),
+            "largest_run": largest,
+            "frag": round(1.0 - largest / free, 4) if free else 0.0,
+            "run_hist": hist,
+        }
+
     def incref(self, page: int) -> None:
         assert self.refs[page] > 0, f"incref of free page {page}"
         self.refs[page] += 1

@@ -38,6 +38,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from petals_tpu.telemetry import instruments as tm
 from petals_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -80,7 +81,10 @@ class PrefixCache:
         self._store: "OrderedDict[str, dict]" = OrderedDict()
         self._bytes = 0
         self._dev_bytes = 0
-        self.stats = {"hits": 0, "misses": 0, "hit_tokens": 0, "stored_segments": 0}
+        self.stats = {
+            "hits": 0, "misses": 0, "hit_tokens": 0, "stored_segments": 0,
+            "evictions": 0,
+        }
 
     @property
     def current_bytes(self) -> int:
@@ -98,8 +102,10 @@ class PrefixCache:
         if n:
             self.stats["hits"] += 1
             self.stats["hit_tokens"] += n * SEGMENT_TOKENS
+            tm.PREFIX_HIT.inc()
         else:
             self.stats["misses"] += 1
+            tm.PREFIX_MISS.inc()
         return n
 
     def get_entries(self, keys: Sequence[str], n: int) -> List[dict]:
@@ -184,6 +190,8 @@ class PrefixCache:
                 self._bytes -= old["bytes"]
                 self._dev_bytes -= old.pop("dev_bytes", 0)
                 self._unpin_entry(old)
+                self.stats["evictions"] += 1
+                tm.PREFIX_EVICT.inc()
             entry["bytes"] = entry_bytes
             self._attach_device(entry, k_dev, v_dev, t0, t1)
             if seg_pages:

@@ -63,6 +63,7 @@ class SwapEntry:
     slots: np.ndarray  # [n_slots] int32 table-slot indices
     nbytes: int  # bytes reserved in the HostSwapPool
     generation: int
+    suspended_at: float = 0.0  # time.monotonic() at swap-out commit
 
 
 @dataclasses.dataclass
@@ -228,10 +229,25 @@ class SessionScheduler:
     def suspended_count(self) -> int:
         return sum(1 for s in self.lanes.values() if s.swap is not None)
 
+    def oldest_swap_age(self, now: Optional[float] = None) -> float:
+        """Seconds the longest-suspended session has been resident in the
+        host swap tier (0.0 when nothing is suspended) — the residency-age
+        half of the swap-tier economics: a large age under load means a
+        session is starving, not merely preempted."""
+        if now is None:
+            now = time.monotonic()
+        ages = [
+            now - s.swap.suspended_at
+            for s in self.lanes.values()
+            if s.swap is not None and s.swap.suspended_at > 0
+        ]
+        return max(ages, default=0.0)
+
     def summary(self) -> dict:
         return {
             "policy": self.policy,
             "suspended": self.suspended_count,
+            "swap_oldest_s": round(self.oldest_swap_age(), 1),
             "swap_bytes_in_use": self.swap_pool.bytes_in_use,
             "swap_bytes_total": self.swap_pool.max_size_bytes,
             "swap_peak_bytes": self.swap_pool.stats["peak_bytes"],

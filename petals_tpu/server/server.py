@@ -509,6 +509,13 @@ class Server:
                 logger.warning(f"multihost worker shutdown broadcast failed: {e!r}")
         if self.handler is not None:
             self.handler.shutdown()
+        # flush + close the journal's JSONL write-through sink AFTER the
+        # handler stops emitting: the last scheduler decisions of this run
+        # must reach disk even if the process dies right after shutdown.
+        # The in-memory ring stays usable (close only detaches the sink).
+        from petals_tpu.telemetry import get_journal
+
+        get_journal().close()
         if self._relay_registrar is not None:
             await self._relay_registrar.stop()
         if self.dht is not None:
@@ -562,6 +569,7 @@ class Server:
             # per-server telemetry digest: the announce loop's cadence makes
             # the tok/s figure an update_period-window average
             telemetry=self._telemetry_digest(),
+            compile_stats=self._compile_stats(),
             # where /metrics and /journal live, so a breaching client can
             # fetch this server's journal excerpt for its trace_id
             metrics_port=(
@@ -577,6 +585,15 @@ class Server:
             return telemetry_digest()
         except Exception as e:  # an announce must never fail over metrics
             logger.debug("telemetry digest failed: %r", e)
+            return None
+
+    def _compile_stats(self) -> Optional[dict]:
+        from petals_tpu.telemetry.observatory import compile_stats_digest
+
+        try:
+            return compile_stats_digest()
+        except Exception as e:  # an announce must never fail over metrics
+            logger.debug("compile stats digest failed: %r", e)
             return None
 
     async def _announce(self, state: ServerState, expiration: Optional[float] = None) -> None:

@@ -36,6 +36,12 @@ The pieces:
 - :mod:`.gate` — the perf-regression gate: diff per-row bench telemetry
   blobs (counter deltas + step-duration histograms) against a committed
   baseline (``bench.py --gate``).
+- :mod:`.observatory` — the compiled-program observatory:
+  ``tracked_jit`` wraps ``jax.jit`` so every compilation is detected,
+  timed, journaled with its avals, and cost-analyzed into the ``/compile``
+  view; steady-state-tagged functions get a post-warmup recompile
+  sentinel that files anomalies with the flight recorder. (jax is
+  imported lazily — the package stays stdlib-only at import time.)
 """
 
 from petals_tpu.telemetry.journal import TelemetryJournal, get_journal
@@ -69,10 +75,20 @@ from petals_tpu.telemetry.spans import (
     build_trace_report,
     format_waterfall,
 )
+from petals_tpu.telemetry.observatory import (
+    Observatory,
+    compile_stats_digest,
+    get_observatory,
+    tracked_jit,
+)
 
 __all__ = [
     "FlightRecorder",
     "HopTrace",
+    "Observatory",
+    "compile_stats_digest",
+    "get_observatory",
+    "tracked_jit",
     "build_trace_report",
     "flight_from_env",
     "format_waterfall",

@@ -135,8 +135,22 @@ def telemetry_digest(registry: Optional[MetricsRegistry] = None) -> dict:
         "label_overflow": int(
             sum(c.value for _v, c in (registry or get_registry()).label_overflow.children())
         ),
+        # page-pool economics (PR 8): fragmentation of the paged KV pool,
+        # HBM headroom, prefix-cache hit rate, oldest swap-tier resident
+        "frag": round(I.PAGE_FRAGMENTATION.value, 4),
+        "hbm_free_bytes": int(I.HBM_HEADROOM.value),
+        "prefix_hit_rate": _prefix_hit_rate(),
+        "swap_oldest_s": round(I.SWAP_RESIDENCY_OLDEST.value, 1),
     }
     return digest
+
+
+def _prefix_hit_rate() -> Optional[float]:
+    from petals_tpu.telemetry import instruments as I
+
+    hits = I.PREFIX_HIT.value
+    total = hits + I.PREFIX_MISS.value
+    return round(hits / total, 4) if total else None
 
 
 # ---------------------------------------------------------------- endpoint
@@ -171,6 +185,32 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                     return
             body = (get_journal().to_jsonl(**filters) + "\n").encode()
             ctype = "application/x-ndjson"
+        elif path == "/compile":
+            # the compiled-program observatory: per-program cost table with
+            # XLA cost_analysis attached (computed lazily on first scrape —
+            # a re-trace, no backend compile). ?analyze=memory additionally
+            # runs memory_analysis(), which AOT-compiles each program again:
+            # explicitly opt-in, never paid on a plain scrape.
+            import json as _json
+            import urllib.parse
+
+            from petals_tpu.telemetry.observatory import get_observatory
+
+            params = urllib.parse.parse_qs(query)
+            want_memory = params.get("analyze", [""])[0] in ("memory", "1")
+            # ?fn= scopes the table: a cold full-table scrape re-lowers every
+            # recorded program, which on a long-lived server can take seconds
+            fn_filter = params.get("fn", [""])[0] or None
+            obs = get_observatory()
+            view = {
+                "warmup_calls": obs.warmup_calls,
+                "stats": obs.compile_stats(),
+                "functions": obs.functions(),
+                "programs": obs.cost_table(memory=want_memory, fn=fn_filter),
+                "dropped_programs": obs.dropped_programs,
+            }
+            body = (_json.dumps(view, default=str) + "\n").encode()
+            ctype = "application/json"
         else:
             self.send_response(404)
             self.end_headers()

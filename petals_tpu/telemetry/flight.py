@@ -96,6 +96,44 @@ class FlightRecorder:
         }
         entry["waterfall"] = self._resolve(waterfall)
         entry["server_journal"] = self._resolve(journal)
+        self._append(entry)
+        from petals_tpu.telemetry import instruments as tm
+
+        tm.SLO_BREACHES.labels(kind=kind).inc()
+        return entry
+
+    def record(
+        self,
+        kind: str,
+        *,
+        trace_id: Optional[str] = None,
+        waterfall=None,
+        journal=None,
+        **fields,
+    ) -> Optional[dict]:
+        """Record a non-latency incident unconditionally (no SLO compare) —
+        e.g. a ``recompile`` anomaly from the compiled-program observatory.
+        The same evidence machinery applies: lazy ``waterfall``/``journal``
+        callables are resolved only when the entry is actually written, and
+        the per-kind cooldown still bounds a storm of identical incidents."""
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_breach.get(kind)
+            if last is not None and now - last < self.cooldown_s:
+                return None
+            self._last_breach[kind] = now
+        entry = {
+            "t": time.time(),  # wall timestamp for the operator, not a span
+            "kind": kind,
+            "trace_id": trace_id,
+            **fields,
+        }
+        entry["waterfall"] = self._resolve(waterfall)
+        entry["server_journal"] = self._resolve(journal)
+        self._append(entry)
+        return entry
+
+    def _append(self, entry: dict) -> None:
         with self._lock:
             self._entries.append(entry)
             sink = self._sink
@@ -105,10 +143,6 @@ class FlightRecorder:
                 sink.flush()
             except (OSError, ValueError):
                 pass  # a full/closed disk must never break the request path
-        from petals_tpu.telemetry import instruments as tm
-
-        tm.SLO_BREACHES.labels(kind=kind).inc()
-        return entry
 
     @staticmethod
     def _resolve(value):

@@ -31,10 +31,18 @@ MIN_ABS_REGRESSION_MS = 1.0
 # hiccup in a 40-step CPU row owns the p99)
 _DURATION_STATS = ("mean_ms", "p50_ms")
 # counters that must not grow when the baseline ran clean
-_FAILURE_COUNTERS = ("alloc_failed", "preemptions")
+# (compile_anomalies: a post-warmup recompile of a steady-state function —
+# the observatory's sentinel firing during a bench row is a perf regression)
+_FAILURE_COUNTERS = ("alloc_failed", "preemptions", "compile_anomalies")
 # work counters that must not silently shrink (same fixed workload producing
 # far fewer steps/tokens means the row no longer measures what it did)
 _VOLUME_COUNTERS = ("decode_tokens",)
+# budget counters: the same fixed workload must not compile MORE programs
+# than the committed baseline (a bucketing bug explodes executable count
+# long before it shows up in wall time). Exact comparison, no tolerance —
+# compile counts are deterministic for a fixed row. Only enforced when the
+# baseline recorded the key (older baselines predate the observatory).
+_BUDGET_COUNTERS = ("compiles",)
 
 
 def compare_step_durations(
@@ -86,6 +94,15 @@ def compare_counters(
             problems.append(
                 f"counters[{key}]: {c:g} vs baseline {b:g} "
                 f"(workload volume collapsed beyond {1.0 + tolerance:.2f}x)"
+            )
+    for key in _BUDGET_COUNTERS:
+        if key not in base:
+            continue  # baseline predates this counter: nothing to hold to
+        b, c = float(base.get(key, 0) or 0), float(cur.get(key, 0) or 0)
+        if c > b:
+            problems.append(
+                f"counters[{key}]: {c:g} compiled programs vs baseline {b:g} "
+                f"(executable count grew — recompile or bucketing regression)"
             )
     return problems
 

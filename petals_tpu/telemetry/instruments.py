@@ -90,6 +90,65 @@ CONGESTION_PENALTIES = REGISTRY.counter(
     "Soft routing penalties applied to queue-dominated servers (hop blame)",
 )
 
+# --- compiled-program observatory ------------------------------------------
+COMPILES = REGISTRY.counter(
+    "petals_compiles_total",
+    "XLA compilations observed by tracked_jit, by function name",
+    labels=("fn",),  # static code-defined names (observatory.tracked_jit)
+)
+COMPILE_SECONDS = REGISTRY.counter(
+    "petals_compile_seconds_total",
+    "Wall seconds spent in calls that triggered a compilation (trace + "
+    "compile + first dispatch), by function name",
+    labels=("fn",),
+)
+COMPILE_ANOMALIES = REGISTRY.counter(
+    "petals_compile_anomalies_total",
+    "Post-warmup compilations of steady-state-tagged functions (the "
+    "recompile sentinel firing), by function name",
+    labels=("fn",),
+)
+COMPILED_FLOPS = REGISTRY.gauge(
+    "petals_compiled_program_flops",
+    "XLA cost_analysis flops of the largest analyzed program, by function",
+    labels=("fn",),
+)
+COMPILED_BYTES = REGISTRY.gauge(
+    "petals_compiled_program_bytes_accessed",
+    "XLA cost_analysis bytes accessed of the largest analyzed program",
+    labels=("fn",),
+)
+
+# --- page-pool economics ----------------------------------------------------
+PAGE_FREE_RUNS = REGISTRY.gauge(
+    "petals_page_pool_free_runs",
+    "Free-run histogram of the paged KV pool (contiguous free-page runs "
+    "bucketed by length)",
+    labels=("bucket",),  # 1 | 2_3 | 4_7 | 8_15 | 16_plus
+)
+PAGE_FRAGMENTATION = REGISTRY.gauge(
+    "petals_page_pool_fragmentation",
+    "1 - largest_free_run / free_pages (0 = one contiguous hole, ->1 = "
+    "shattered free space)",
+)
+PAGE_LARGEST_RUN = REGISTRY.gauge(
+    "petals_page_pool_largest_free_run",
+    "Length of the largest contiguous free-page run",
+)
+HBM_HEADROOM = REGISTRY.gauge(
+    "petals_hbm_headroom_bytes",
+    "MemoryCache budget minus live KV bytes (0 when the cache is unbounded)",
+)
+SWAP_RESIDENCY_OLDEST = REGISTRY.gauge(
+    "petals_swap_residency_oldest_seconds",
+    "Age of the oldest KV entry currently resident in the host swap tier",
+)
+PREFIX_EVENTS = REGISTRY.counter(
+    "petals_prefix_cache_events_total",
+    "Prefix-cache economics: probe hits/misses, page adoptions, evictions",
+    labels=("event",),  # hit | miss | adopt | evict
+)
+
 # --- telemetry self-observation -------------------------------------------
 META_TRUNCATED = REGISTRY.counter(
     "telemetry_meta_truncated_total",
@@ -107,3 +166,11 @@ STEPS_MIXED = BATCHED_STEPS.labels(variant="mixed")
 STEPS_GEN = BATCHED_STEPS.labels(variant="gen")
 SWAP_OUT_BYTES = SWAP_BYTES.labels(direction="out")
 SWAP_IN_BYTES = SWAP_BYTES.labels(direction="in")
+PREFIX_HIT = PREFIX_EVENTS.labels(event="hit")
+PREFIX_MISS = PREFIX_EVENTS.labels(event="miss")
+PREFIX_ADOPT = PREFIX_EVENTS.labels(event="adopt")
+PREFIX_EVICT = PREFIX_EVENTS.labels(event="evict")
+FREE_RUN_BUCKETS = ("1", "2_3", "4_7", "8_15", "16_plus")
+PAGE_FREE_RUN_CHILDREN = {
+    b: PAGE_FREE_RUNS.labels(bucket=b) for b in FREE_RUN_BUCKETS
+}

@@ -57,13 +57,15 @@ class TelemetryJournal:
                 **fields,
             }
             self._events.append(ev)
-            sink = self._sink
-        if sink is not None:
-            try:
-                sink.write(json.dumps(ev, default=str) + "\n")
-                sink.flush()
-            except (OSError, ValueError):
-                pass  # a full/closed disk sink must never break serving
+            # The write-through happens under the ring lock: two concurrent
+            # writers must not interleave file lines out of seq order, or the
+            # sink and the /journal export disagree about the final seq.
+            if self._sink is not None:
+                try:
+                    self._sink.write(json.dumps(ev, default=str) + "\n")
+                    self._sink.flush()
+                except (OSError, ValueError):
+                    pass  # a full/closed disk sink must never break serving
         return ev
 
     def events(
@@ -101,13 +103,30 @@ class TelemetryJournal:
         with self._lock:
             self._events.clear()
 
+    @property
+    def seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                try:
+                    self._sink.flush()
+                except (OSError, ValueError):
+                    pass
+
     def close(self) -> None:
+        """Flush and detach the write-through sink (idempotent). Called from
+        ``Server.shutdown`` so the last events of a run reach disk; the ring
+        itself stays usable for in-memory consumers afterwards."""
         with self._lock:
             sink, self._sink = self._sink, None
         if sink is not None:
             try:
+                sink.flush()
                 sink.close()
-            except OSError:
+            except (OSError, ValueError):
                 pass
 
 

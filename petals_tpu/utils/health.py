@@ -112,6 +112,10 @@ class HealthMonitor:
                     # TTFT/step percentiles, swap bytes, failure counters) —
                     # the per-server input to the /api/v1/metrics aggregate
                     "telemetry": info.telemetry,
+                    # compiled-program observatory digest (programs, compile
+                    # seconds, anomalies): nonzero anomalies = the server is
+                    # recompiling in steady state
+                    "compile_stats": info.compile_stats,
                 }
             snapshot[prefix] = {
                 "public_name": meta.get("public_name"),
@@ -173,17 +177,26 @@ class HealthMonitor:
                 "lanes": 0,
                 "busy_lanes": 0,
                 "servers_reporting": 0,
+                "compiled_programs": 0,
+                "compile_anomalies": 0,
+                "compile_s": 0.0,
             }
             for peer, s in model["servers"].items():
                 digest = s.get("telemetry")
                 pool = s.get("pool") or {}
                 agg["lanes"] += int(pool.get("lanes") or 0)
                 agg["busy_lanes"] += int(pool.get("busy_lanes") or 0)
+                compile_stats = s.get("compile_stats")
+                if isinstance(compile_stats, dict):
+                    agg["compiled_programs"] += int(compile_stats.get("programs") or 0)
+                    agg["compile_anomalies"] += int(compile_stats.get("anomalies") or 0)
+                    agg["compile_s"] += float(compile_stats.get("compile_s") or 0.0)
                 servers[peer] = {
                     "public_name": s.get("public_name"),
                     "blocks": s.get("blocks"),
                     "telemetry": digest,
                     "pool": pool or None,
+                    "compile_stats": compile_stats,
                 }
                 if not isinstance(digest, dict):
                     continue
@@ -252,7 +265,7 @@ class HealthMonitor:
                 f")</small> — {status}</h2><table border=1 cellpadding=4>"
                 "<tr><th>server</th><th>state</th><th>blocks</th><th>throughput</th>"
                 "<th>cache tokens left</th><th>load</th><th>tok/s</th><th>p99 TTFT</th>"
-                "<th>swap</th><th>quant</th><th>via relay</th></tr>"
+                "<th>swap</th><th>frag</th><th>compiled</th><th>quant</th><th>via relay</th></tr>"
             )
             for peer, s in model["servers"].items():
                 pool = s.get("pool")
@@ -271,12 +284,23 @@ class HealthMonitor:
                 ttft_cell = f"{ttft:.0f} ms" if isinstance(ttft, (int, float)) else "—"
                 swap_bytes = (digest.get("swap_out_bytes") or 0) + (digest.get("swap_in_bytes") or 0)
                 swap_cell = f"{swap_bytes / 2**20:.1f} MiB" if swap_bytes else "—"
+                frag = digest.get("frag")
+                frag_cell = f"{frag:.2f}" if isinstance(frag, (int, float)) else "—"
+                cs = s.get("compile_stats") if isinstance(s.get("compile_stats"), dict) else {}
+                if cs:
+                    compiled_cell = f"{cs.get('programs', 0)}p"
+                    anomalies = cs.get("anomalies") or 0
+                    if anomalies:
+                        compiled_cell += f" / ⚠️ {anomalies} anomalies"
+                else:
+                    compiled_cell = "—"
                 rows.append(
                     f"<tr><td><code>{peer[:12]}…</code> {html.escape(s.get('public_name') or '')}</td>"
                     f"<td>{s['state']}</td><td>[{s['blocks'][0]}, {s['blocks'][1]})</td>"
                     f"<td>{s['throughput']:.1f}</td><td>{s['cache_tokens_left']}</td>"
                     f"<td>{html.escape(load)}</td>"
                     f"<td>{tok_s_cell}</td><td>{ttft_cell}</td><td>{swap_cell}</td>"
+                    f"<td>{frag_cell}</td><td>{compiled_cell}</td>"
                     f"<td>{html.escape(str(s['quant_type']))}</td><td>{'yes' if s['relayed'] else 'no'}</td></tr>"
                 )
             rows.append("</table>")

@@ -289,6 +289,59 @@ def test_tracer_safety():
     assert "tracer-safety" not in rules_hit(suppressed)
 
 
+def test_tracer_safety_covers_tracked_jit():
+    # tracked_jit is jit with observed compiles: host branching on a traced
+    # value inside it is just as wrong as under bare jax.jit
+    bad = (
+        "from petals_tpu.telemetry.observatory import tracked_jit\n"
+        "@tracked_jit(name='f', steady=True, static_argnames=('k',))\n"
+        "def f(x, k):\n"
+        "    if x > 0:\n"
+        "        x = x + 1\n"
+        "    if k > 2:\n"  # static arg: host branch is fine
+        "        x = x * 2\n"
+        "    return x\n"
+    )
+    assert lines_hit(bad, "tracer-safety") == [4]
+
+
+def test_no_untracked_jit():
+    server = "petals_tpu/server/snippet.py"
+    bad = (
+        "import functools, jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x\n"
+        "@functools.partial(jax.jit, static_argnames=('k',))\n"
+        "def g(x, k):\n"
+        "    return x\n"
+        "h = jax.jit(lambda x: x)\n"
+    )
+    assert lines_hit(bad, "no-untracked-jit", path=server) == [2, 5, 8]
+    # `from jax import jit` doesn't launder the bypass
+    bare = "from jax import jit\n@jit\ndef f(x):\n    return x\n"
+    assert lines_hit(bare, "no-untracked-jit", path=server) == [2]
+    ok = (
+        "from petals_tpu.telemetry.observatory import tracked_jit\n"
+        "@tracked_jit(name='f', steady=True)\n"
+        "def f(x):\n"
+        "    return x\n"
+        "def jit(x):\n"  # unrelated local name, jax's jit never imported bare
+        "    return x\n"
+        "y = jit(3)\n"
+    )
+    assert "no-untracked-jit" not in rules_hit(ok, path=server)
+    # out of scope: client/, ops/ and tests compile cold or are exempt wholesale
+    assert "no-untracked-jit" not in rules_hit(bad, path="petals_tpu/ops/snippet.py")
+    suppressed = (
+        "import jax\n"
+        "@jax.jit  # swarmlint: disable=no-untracked-jit — one-shot load-time compile\n"
+        "def f(x):\n"
+        "    return x\n"
+    )
+    assert "no-untracked-jit" not in rules_hit(suppressed, path=server)
+
+
 def test_no_unbounded_metric_labels():
     bad = (
         "def f(self, session_id, peer):\n"

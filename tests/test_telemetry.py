@@ -712,3 +712,345 @@ def test_two_hop_chain_trace_and_flight_recorder(model_path):
             await bootstrap.shutdown()
 
     run(asyncio.wait_for(main(), 600))
+
+
+# ------------------------------------------- compiled-program observatory
+
+
+def test_tracked_jit_compile_detection_and_warmup_anomaly():
+    """The recompile sentinel end to end, on a private Observatory: every
+    new (shape, static-arg) signature is one detected compile; once a
+    steady wrapper has run ``warmup_calls`` times, a further compile is an
+    anomaly — journal event with the offending avals + flight entry."""
+    import jax
+
+    from petals_tpu.telemetry.flight import FlightRecorder
+    from petals_tpu.telemetry.observatory import Observatory, tracked_jit
+
+    obs = Observatory(warmup_calls=2)
+    flight = FlightRecorder(cooldown_s=0.0)
+    obs.attach_flight(flight)
+
+    @tracked_jit(name="toy", steady=True, observatory=obs,
+                 static_argnames=("flag",))
+    def toy(x, y, flag=True):
+        return x + y if flag else x - y
+
+    seq0 = get_journal().seq
+    a = jnp.ones((4, 4), jnp.float32)
+    for _ in range(3):
+        toy(a, a)
+    stats = obs.compile_stats()
+    assert stats == {
+        "functions": 1, "programs": 1, "compile_s": stats["compile_s"],
+        "anomalies": 0,
+    }
+    assert stats["compile_s"] > 0
+    # the compile journal event carries the signature that was traced
+    compiles = get_journal().events(kind="compile", since_seq=seq0)
+    assert len(compiles) == 1 and compiles[0]["fn"] == "toy"
+    assert "float32[4,4]" in compiles[0]["avals"]
+
+    # past warmup: a novel shape is exactly one anomaly, with evidence
+    b = jnp.ones((2, 2), jnp.float32)
+    toy(b, b)
+    anomalies = get_journal().events(kind="compile_anomaly", since_seq=seq0)
+    assert len(anomalies) == 1
+    assert anomalies[0]["fn"] == "toy"
+    assert "float32[2,2]" in anomalies[0]["avals"]
+    assert anomalies[0]["warmup_calls"] == 2
+    entries = flight.entries(kind="recompile")
+    assert len(entries) == 1 and entries[0]["fn"] == "toy"
+    assert entries[0]["server_journal"], "flight entry carries the compile tail"
+    assert all(e["kind"] == "compile" for e in entries[0]["server_journal"])
+
+    # a drifting STATIC argument recompiles too — same sentinel
+    toy(b, b, flag=False)
+    assert obs.compile_stats()["anomalies"] == 2
+    assert obs.compile_stats()["programs"] == 3
+    # cache hit on a known signature: no new program, no new anomaly
+    toy(a, a)
+    assert obs.compile_stats() == {
+        "functions": 1, "programs": 3,
+        "compile_s": obs.compile_stats()["compile_s"], "anomalies": 2,
+    }
+    # the wrapper honors the jax.jit contract the backward path relies on
+    assert toy.__wrapped__ is not None and not hasattr(
+        toy.__wrapped__, "__wrapped__"
+    )
+
+
+def test_cost_table_roofline_and_memory_analysis(monkeypatch):
+    """XLA cost attribution: the lazily-filled per-program cost table has
+    real flops/bytes, roofline math divides by the measured step time (and
+    by peak only when a peak is declared), and memory_analysis is opt-in."""
+    from petals_tpu.telemetry.observatory import Observatory, tracked_jit
+
+    obs = Observatory(warmup_calls=8)
+
+    @tracked_jit(name="mm", steady=True, observatory=obs)
+    def mm(x, y):
+        return x @ y
+
+    x = jnp.ones((8, 16), jnp.float32)
+    mm(x, x.T @ x @ jnp.ones((16, 8)))  # nested device math is irrelevant
+    table = obs.cost_table()
+    assert len(table) == 1
+    cost = table[0]["cost"]
+    assert cost["flops"] > 0 and cost["bytes_accessed"] > 0
+    # re-lowering for analysis never records a new program
+    assert obs.compile_stats()["programs"] == 1
+
+    r = obs.roofline("mm", 0.001)
+    assert r["fn"] == "mm" and r["flops_per_step"] == cost["flops"]
+    assert r["step_mean_ms"] == 1.0 and r["achieved_gflops"] >= 0
+    assert r["utilization"] is None  # no declared peak on CPU
+    monkeypatch.setenv("PETALS_TPU_PEAK_TFLOPS", "0.000001")
+    assert obs.roofline("mm", 0.001)["utilization"] > 0
+
+    # memory analysis costs a fresh AOT compile: only on request
+    assert "memory" not in table[0]
+    mem_table = obs.cost_table(memory=True)
+    assert mem_table[0]["memory"]["argument_bytes"] > 0
+
+
+def test_gate_compile_budget_counters():
+    """The bench gate holds compile counts to the committed baseline:
+    growth fails (budget), anomalies fail (failure counter), and a baseline
+    that predates the observatory gates nothing retroactively."""
+    from petals_tpu.telemetry.gate import compare_blobs
+
+    base = {"counters_delta": {
+        "compiles": 3.0, "compile_anomalies": 0.0, "decode_tokens": 40.0,
+    }}
+    same = {"counters_delta": {"compiles": 3.0, "decode_tokens": 40.0}}
+    assert compare_blobs(base, same) == []
+    grew = {"counters_delta": {"compiles": 5.0, "decode_tokens": 40.0}}
+    assert any("compiles" in p for p in compare_blobs(base, grew))
+    anom = {"counters_delta": {
+        "compiles": 3.0, "compile_anomalies": 1.0, "decode_tokens": 40.0,
+    }}
+    assert any("compile_anomalies" in p for p in compare_blobs(base, anom))
+    old = {"counters_delta": {"decode_tokens": 40.0}}  # pre-observatory
+    assert compare_blobs(old, grew) == []
+
+
+def test_journal_sink_close_and_seq_agreement(tmp_path):
+    """The JSONL write-through sink and the in-memory export agree on the
+    final seq: concurrent writers never interleave file lines out of order,
+    close() flushes everything and is idempotent, and the ring stays usable
+    (Server.shutdown closes the sink, not the journal)."""
+    path = tmp_path / "journal.jsonl"
+    j = TelemetryJournal(maxlen=64, path=str(path))
+
+    def work(i):
+        for n in range(50):
+            j.event("spin", worker=i, n=n)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    j.close()
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [l["seq"] for l in lines] == list(range(1, 201))
+    assert j.seq == 200  # file sink and /journal export agree
+    # ring keeps recording after close; the file does not grow
+    j.event("post_close")
+    assert j.seq == 201 and j.events(kind="post_close")
+    j.close()  # idempotent
+    assert len(path.read_text().splitlines()) == 200
+
+
+def test_page_pool_economics_units():
+    """Free-run/fragmentation math on the page allocator across a COW
+    share-and-release cycle, and prefix-cache hit/miss/evict counters."""
+    from petals_tpu.server.memory_cache import PageAllocator
+    from petals_tpu.server.prefix_cache import SEGMENT_TOKENS, PrefixCache
+
+    alloc = PageAllocator(16)
+    pages = [alloc.try_alloc() for _ in range(16)]
+    info = alloc.fragmentation_info()
+    assert info["free"] == 0 and info["frag"] == 0.0 and info["runs"] == 0
+    # COW share: a prefix pin holds pages 0..3 while the lane releases them
+    for p in pages[:4]:
+        alloc.incref(p)
+    for p in pages[:4]:
+        alloc.decref(p)
+    assert alloc.fragmentation_info()["free"] == 0  # shared != free
+    # pin drops -> one contiguous 4-page hole: zero fragmentation
+    for p in pages[:4]:
+        alloc.decref(p)
+    info = alloc.fragmentation_info()
+    assert info["free"] == 4 and info["largest_run"] == 4
+    assert info["frag"] == 0.0 and info["run_hist"]["4_7"] == 1
+    # shatter the upper half into singletons: frag = 1 - 4/10
+    for p in pages[5::2]:
+        alloc.decref(p)
+    info = alloc.fragmentation_info()
+    assert info["free"] == 10 and info["largest_run"] == 4
+    assert info["frag"] == round(1.0 - 4 / 10, 4)
+    assert info["run_hist"] == {
+        "1": 6, "2_3": 0, "4_7": 1, "8_15": 0, "16_plus": 0,
+    }
+
+    rng = np.random.RandomState(2)
+    seg_kv = rng.randn(2, 1, SEGMENT_TOKENS, 2, 4).astype(np.float32)
+    seg_out = rng.randn(1, SEGMENT_TOKENS, 8).astype(np.float32)
+    entry_bytes = 2 * seg_kv.nbytes + seg_out.nbytes
+    h0, m0 = tm.PREFIX_HIT.value, tm.PREFIX_MISS.value
+    e0 = tm.PREFIX_EVICT.value
+    cache = PrefixCache(max_bytes=2 * entry_bytes + 10)
+    cache.put(["a"], 0, seg_kv, seg_kv, seg_out)
+    assert cache.probe(["a"]) == 1 and tm.PREFIX_HIT.value == h0 + 1
+    assert cache.probe(["nope"]) == 0 and tm.PREFIX_MISS.value == m0 + 1
+    cache.put(["b"], 0, seg_kv, seg_kv, seg_out)
+    cache.put(["c"], 0, seg_kv, seg_kv, seg_out)  # over budget: "a" evicted
+    assert cache.stats["evictions"] >= 1
+    assert tm.PREFIX_EVICT.value == e0 + cache.stats["evictions"]
+    assert cache.probe(["a"]) == 0  # ...and the miss after eviction counts
+    assert tm.PREFIX_MISS.value == m0 + 2
+    # the announce digest derives its hit rate from these same counters
+    digest = telemetry_digest()
+    assert digest["prefix_hit_rate"] is not None
+    assert 0.0 <= digest["prefix_hit_rate"] <= 1.0
+
+
+def test_observatory_acceptance_steady_decode_then_forced_recompile(model_path):
+    """Acceptance: >=40 post-warmup decode ticks through the DecodeBatcher
+    produce ZERO compile anomalies (one shape -> one program, frozen); a
+    forced novel shape on the warmed steady program then produces exactly
+    one anomaly event carrying its avals, plus a flight-recorder entry.
+    Along the way: /metrics and /compile expose the cost table, the
+    announce digest carries compile_stats, and the page-pool gauges are
+    live."""
+
+    async def main():
+        from petals_tpu.telemetry.observatory import get_observatory
+
+        server, client = await _start_server(
+            model_path, batching=True, batch_lanes=2, batch_max_length=64,
+            page_size=8, metrics_port=0,
+        )
+        obs = get_observatory()
+        journal = get_journal()
+        seq0 = journal.seq
+        # the observatory is process-global: earlier tests in a full-suite
+        # run may have left anomalies behind — assert DELTAS, not totals
+        anomalies0 = obs.compile_stats()["anomalies"]
+        try:
+            cfg = server.cfg
+            prefix = default_dht_prefix(model_path)
+            uids = CHAIN_DELIMITER.join(
+                make_uid(prefix, i) for i in range(cfg.num_hidden_layers)
+            )
+            rng = np.random.RandomState(11)
+            stream = await client.open_stream("ptu.inference")
+            await stream.send({"uids": uids, "max_length": 60, "batch_size": 1})
+            await stream.recv(timeout=60)
+            h = rng.randn(1, 3, cfg.hidden_size).astype(np.float32) * 0.1
+            await stream.send({"tensors": {"hidden": serialize_array(h)}})
+            await stream.recv(timeout=120)
+            # 44 decode ticks: warmup (8 calls) long past, shape constant
+            for _ in range(44):
+                step = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.1
+                await stream.send({"tensors": {"hidden": serialize_array(step)}})
+                await stream.recv(timeout=120)
+            await stream.end()
+
+            # ---- steady state: the decode program compiled ONCE, no anomaly
+            assert journal.events(kind="compile_anomaly", since_seq=seq0) == []
+            fns = {f["fn"]: f for f in obs.functions()}
+            assert fns["paged_decode"]["steady"]
+            assert fns["paged_decode"]["calls"] >= 44
+            stats = obs.compile_stats()
+            assert stats["programs"] >= 1 and stats["compile_s"] > 0
+
+            # ---- the digest rides the announce path next to PR 6 telemetry
+            info = server._server_info(server._state)
+            assert info.compile_stats is not None
+            assert info.compile_stats["programs"] >= 1
+            assert info.compile_stats["anomalies"] == anomalies0
+
+            # ---- /metrics and the /compile view expose the cost table
+            port = server._metrics_server.port
+            text = (
+                await asyncio.to_thread(
+                    urllib.request.urlopen,
+                    f"http://127.0.0.1:{port}/metrics", None, 10,
+                )
+            ).read().decode()
+            samples = _parse_prometheus(text)
+            assert samples['petals_compiles_total{fn="paged_decode"}'] >= 1
+            assert samples["petals_page_pool_fragmentation"] >= 0.0
+            # ?fn= scopes the analysis: a full-table scrape re-lowers every
+            # program recorded in this (shared, process-global) table
+            view = json.loads(
+                (
+                    await asyncio.to_thread(
+                        urllib.request.urlopen,
+                        f"http://127.0.0.1:{port}/compile?fn=paged_decode",
+                        None, 30,
+                    )
+                ).read().decode()
+            )
+            assert view["stats"]["programs"] >= 1
+            assert view["warmup_calls"] == obs.warmup_calls
+            progs = [p for p in view["programs"] if p["fn"] == "paged_decode"]
+            # newest record = THIS server's steady compile (the program table
+            # is process-global and ordered; earlier suites may precede it)
+            assert progs and progs[-1]["cost"]["flops"] > 0
+            assert progs[-1]["avals"] and not progs[-1]["anomaly"]
+
+            # ---- page-pool economics gauges are wired to the live pool
+            batcher = server.handler.batcher
+            assert tm.PAGES_TOTAL.value == batcher.n_pages
+            assert 0.0 <= tm.PAGE_FRAGMENTATION.value <= 1.0
+            assert tm.PAGE_LARGEST_RUN.value >= 1
+            occ = batcher.occupancy_info()
+            assert "frag" in occ and "largest_free_run" in occ
+            digest = telemetry_digest()
+            for key in ("frag", "prefix_hit_rate", "hbm_free_bytes",
+                        "swap_oldest_s"):
+                assert key in digest, key
+            # a prefix-cache page adoption (zero-copy COW share) is counted
+            lane = await batcher.acquire_lane()
+            page = batcher._pages.try_alloc()
+            a0 = tm.PREFIX_ADOPT.value
+            batcher.adopt_pages(lane, [page])
+            assert tm.PREFIX_ADOPT.value == a0 + 1
+            batcher._pages.decref(page)  # drop the alloc ref; table ref stays
+            batcher.release_lane(lane)  # frees the adopted page with the lane
+
+            # ---- force a novel shape on the FROZEN steady program: one
+            # extra lane row changes every aval -> exactly one anomaly
+            backend = batcher.backend
+            k_pool, v_pool = batcher._buffers()
+            tables = np.asarray(batcher._tables, np.int32)
+            ext = np.vstack([tables, tables[:1]])
+            sentinel = batcher.max_pages * batcher.page_size
+            hidden = np.zeros((ext.shape[0], 1, cfg.hidden_size), np.float32)
+            positions = np.full((ext.shape[0],), sentinel, np.int32)
+            seq1 = journal.seq
+            flight = obs.flight_recorder()
+            before = len(flight.entries(kind="recompile"))
+            backend.paged_decode_step(
+                hidden,
+                (jnp.zeros(k_pool.shape, k_pool.dtype),
+                 jnp.zeros(v_pool.shape, v_pool.dtype)),
+                positions, ext,
+            )
+            anomalies = journal.events(kind="compile_anomaly", since_seq=seq1)
+            assert len(anomalies) == 1, anomalies
+            assert anomalies[0]["fn"] == "paged_decode"
+            assert any("float32" in a or "bfloat16" in a
+                       for a in anomalies[0]["avals"])
+            entries = flight.entries(kind="recompile")
+            assert len(entries) == before + 1
+            assert entries[-1]["fn"] == "paged_decode"
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(asyncio.wait_for(main(), 600))
