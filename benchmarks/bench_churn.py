@@ -1,0 +1,202 @@
+"""Swarm churn benchmark: scripted kill + drain + rebalance over N sessions.
+
+The serving promise under churn is (a) no session is lost, (b) token output
+is identical to an unperturbed run, (c) repair is cheap. This bench scripts
+the three churn events the swarm must absorb — a hard KILL (server process
+death), a graceful DRAIN (drain-to-migrate pushes parked KV to a replica),
+and a REBALANCE (span reload parks + migrates its pooled sessions) — against
+N concurrent inference sessions, and reports:
+
+- sessions survived (out of N),
+- token parity against the HF reference (== the unperturbed swarm output,
+  which the test suite asserts everywhere),
+- repair-step latency p50/p99, comparing ``migrate`` (the p2p redirect +
+  kv_adopt path) against ``replay`` (history recompute, forced by disabling
+  KV export — the reference's only repair).
+
+Optionally arms the chaos plane on top (``--chaos "seed=1;rpc.call:drop:0.05"``)
+so the scripted churn runs under background fault injection.
+
+Self-contained: boots a 4-replica loopback swarm in-process (tiny llama).
+
+Usage: python benchmarks/bench_churn.py [--cpu] [--sessions 4] [--prefix 64]
+       [--chaos SPEC]
+"""
+
+import argparse
+import contextlib
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run_churn(path, n_sessions, prefix, layers, mode, chaos_spec):
+    """One scripted churn pass; returns (survived, parity_ok, repair_times)."""
+    from tests.test_full_model import SwarmHarness, _hf_greedy
+    from petals_tpu import chaos
+    from petals_tpu.client.inference_session import InferenceSession
+    from petals_tpu.client.model import AutoDistributedModelForCausalLM
+
+    # four full-span replicas: routing prefers A; the script kills A, drains
+    # B, rebalances C — D (and whichever replicas survive) absorb everything
+    harness = SwarmHarness(
+        path,
+        [
+            dict(first_block=0, num_blocks=layers, throughput=1000.0),  # A: killed
+            dict(first_block=0, num_blocks=layers, throughput=800.0),  # B: drained
+            dict(first_block=0, num_blocks=layers, throughput=600.0),  # C: rebalanced
+            dict(first_block=0, num_blocks=layers, throughput=1.0),  # D: understudy
+        ],
+    ).start()
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers, min_backoff=0.05,
+    )
+    restore_export = None
+    if mode == "replay":
+        # force the reference's only repair: no KV export, no redirect — every
+        # orphaned span recomputes from the recorded history
+        restore_export = InferenceSession._try_export
+
+        async def _no_export(self, *a, **kw):
+            return None
+
+        InferenceSession._try_export = _no_export
+    if chaos_spec:
+        seed, rules = chaos.parse_spec(chaos_spec)
+        chaos.configure(seed=seed, rules=rules)
+
+    # 4 phases x 2 tokens after the prefill; the HF reference doubles as the
+    # unperturbed swarm output (asserted identical throughout the test suite)
+    rng = np.random.RandomState(7)
+    prompts = [
+        rng.randint(0, 100, (1, prefix)).astype(np.int64) for _ in range(n_sessions)
+    ]
+    expected = [_hf_greedy(path, ids, 8) for ids in prompts]
+
+    repair_times = []
+    survived = 0
+    parity_ok = 0
+    try:
+        with contextlib.ExitStack() as stack:
+            sessions = [
+                stack.enter_context(
+                    model.remote.inference_session(max_length=prefix + 16, batch_size=1)
+                )
+                for _ in range(n_sessions)
+            ]
+            outs = [
+                model.generate(prompts[i], max_new_tokens=2, session=sessions[i])
+                for i in range(n_sessions)
+            ]
+
+            def step_all(label):
+                # the first generate after a churn event pays that session's
+                # repair; time it per session
+                for i in range(n_sessions):
+                    if outs[i] is None:
+                        continue
+                    t0 = time.perf_counter()
+                    try:
+                        outs[i] = model.generate(
+                            outs[i], max_new_tokens=2, session=sessions[i]
+                        )
+                        repair_times.append(time.perf_counter() - t0)
+                    except Exception as e:
+                        print(f"  session {i} LOST at {label}: {e!r}")
+                        outs[i] = None
+
+            print(f"[{mode}] KILL server A (hard death)")
+            harness.run(harness.servers[0].shutdown())
+            dead = harness.servers.pop(0)
+            del dead
+            step_all("kill")
+
+            print(f"[{mode}] DRAIN server B (drain-to-migrate)")
+            harness.run(harness.servers[0].drain(migrate=(mode != "replay")))
+            step_all("drain")
+
+            print(f"[{mode}] REBALANCE server C (span reload parks + migrates)")
+            harness.run(harness.servers[1]._reload_span(0))
+            step_all("rebalance")
+
+            for i in range(n_sessions):
+                if outs[i] is None:
+                    continue
+                survived += 1
+                if np.array_equal(outs[i], expected[i]):
+                    parity_ok += 1
+    finally:
+        chaos.disable()
+        if restore_export is not None:
+            InferenceSession._try_export = restore_export
+        model.close()
+        harness.run(harness.servers[0].shutdown())  # the drained server
+        harness.servers.pop(0)
+        harness.stop()
+    return survived, parity_ok, repair_times
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    parser.add_argument("--sessions", type=int, default=4, help="concurrent sessions (N)")
+    parser.add_argument("--prefix", type=int, default=64, help="prompt tokens per session")
+    parser.add_argument("--layers", type=int, default=4)
+    parser.add_argument(
+        "--chaos", default="", help="PETALS_TPU_CHAOS-style spec armed during the run"
+    )
+    parser.add_argument(
+        "--check", action="store_true",
+        help="fail (exit 1) unless every session survives with token parity "
+        "in migrate mode",
+    )
+    args = parser.parse_args()
+    assert args.sessions >= 4, "the churn script needs N >= 4 concurrent sessions"
+
+    import jax
+
+    if args.cpu or jax.default_backend() != "tpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from tests.utils import make_tiny_llama
+
+    path = make_tiny_llama(tempfile.mkdtemp(), n_layers=args.layers)
+
+    results = {}
+    for mode in ("migrate", "replay"):
+        survived, parity, times = run_churn(
+            path, args.sessions, args.prefix, args.layers, mode, args.chaos
+        )
+        results[mode] = (survived, parity, times)
+
+    print(
+        f"\nchurn: 1 kill + 1 drain + 1 rebalance over {args.sessions} sessions, "
+        f"prefix={args.prefix}, {args.layers} blocks"
+        + (f", chaos={args.chaos!r}" if args.chaos else "")
+    )
+    for mode, (survived, parity, times) in results.items():
+        p50 = np.percentile(times, 50) * 1e3 if times else float("nan")
+        p99 = np.percentile(times, 99) * 1e3 if times else float("nan")
+        print(
+            f"  {mode:>7}: survived {survived}/{args.sessions}, "
+            f"token-parity {parity}/{args.sessions}, "
+            f"repair-step p50 {p50:.0f} ms / p99 {p99:.0f} ms ({len(times)} steps)"
+        )
+
+    if args.check:
+        survived, parity, _ = results["migrate"]
+        if survived != args.sessions or parity != args.sessions:
+            sys.exit(
+                f"CHECK FAILED: migrate mode survived {survived}/{args.sessions}, "
+                f"parity {parity}/{args.sessions}"
+            )
+        print("CHECK OK: zero sessions lost, token output identical under churn")
+
+
+if __name__ == "__main__":
+    main()

@@ -52,7 +52,17 @@ class ClientConfig:
     route_upgrade_period: float = 0.0
     route_upgrade_threshold: float = 0.7
 
+    # deadline for pulling a failed span's KV over the client link during
+    # repair (ptu.session_export). Long-context caches are 100s of MB, so the
+    # default is generous; on expiry the repair falls back to history replay
+    # with a journaled reason (journal kind "export_fallback").
+    kv_export_timeout: float = 120.0
+
     def __post_init__(self):
+        if self.kv_export_timeout <= 0:
+            raise ValueError(
+                f"kv_export_timeout must be positive, got {self.kv_export_timeout}"
+            )
         if self.max_retries is None:
             env = os.environ.get("PETALS_TPU_MAX_RETRIES")
             self.max_retries = int(env) if env else None

@@ -137,6 +137,20 @@ class _ServerInferenceSession:
             raise RuntimeError(f"kv_import rejected: {reply}")
         self.position = position
 
+    async def adopt_kv(self, source_session_id: str, position: int) -> None:
+        """Seed this (fresh) session from KV the SERVER already holds — a
+        migrated-in entry pushed by a draining peer, or its own parked
+        snapshot. Only ids cross the client link; the tensor bytes moved
+        server-to-server, which is the point of p2p migration vs import_kv."""
+        assert self.position == 0 and not self.history, "adopt_kv only on a fresh session"
+        await self.stream.send({
+            "kv_adopt": {"session_id": source_session_id, "position": int(position)},
+        })
+        reply = await self.stream.recv(timeout=self.step_timeout)
+        if not reply.get("kv_adopt") or reply.get("position") != position:
+            raise RuntimeError(f"kv_adopt rejected: {reply}")
+        self.position = position
+
     async def step(
         self,
         hidden: np.ndarray,
@@ -485,12 +499,33 @@ class InferenceSession:
             self._affinity_seed = int.from_bytes(
                 hashlib.blake2b(seg.tobytes(), digest_size=8).digest(), "big"
             )
-        chain = await self.seq_manager.make_sequence(
-            0, self.num_blocks, mode="min_latency",
-            cache_tokens_needed=self.batch_size * self.max_length,
-            affinity_seed=self._affinity_seed,
-        )
-        self._sessions = await self._enter_server_sessions(chain)
+        # opening the first chain must be as churn-tolerant as stepping on an
+        # established one: a refused/dropped session open bans the hop (see
+        # _enter_server_sessions) and we re-route with the same backoff
+        # discipline as step()'s retry loop
+        attempt = 0
+        while True:
+            chain = await self.seq_manager.make_sequence(
+                0, self.num_blocks, mode="min_latency",
+                cache_tokens_needed=self.batch_size * self.max_length,
+                affinity_seed=self._affinity_seed,
+            )
+            try:
+                self._sessions = await self._enter_server_sessions(chain)
+                return
+            except Exception as e:
+                attempt += 1
+                if self._max_retries is not None and attempt > self._max_retries:
+                    raise
+                delay = min(
+                    self.seq_manager.config.min_backoff * (2 ** (attempt - 1)),
+                    self.seq_manager.config.max_backoff,
+                )
+                logger.warning(
+                    f"Failed to open sessions on the chosen chain, "
+                    f"retrying in {delay:.1f}s: {e}"
+                )
+                await asyncio.sleep(delay)
 
     def _spans_support_server_gen(self, spans, sampling: bool = False) -> bool:
         """One span covering every block, announcing the server_gen (or, for
@@ -604,16 +639,22 @@ class InferenceSession:
                     next_addr = self.seq_manager.addr_of(chain[i + 1].peer_id)
                     if next_addr is not None:
                         push_to = {"addr": next_addr.to_string(), "session_id": session_ids[i + 1]}
-                session = await _ServerInferenceSession.create(
-                    self.seq_manager,
-                    span,
-                    uids,
-                    max_length=self.max_length,
-                    batch_size=self.batch_size,
-                    session_id=session_ids[i],
-                    push_to=push_to,
-                    trace_id=self.trace_id,
-                )
+                try:
+                    session = await _ServerInferenceSession.create(
+                        self.seq_manager,
+                        span,
+                        uids,
+                        max_length=self.max_length,
+                        batch_size=self.batch_size,
+                        session_id=session_ids[i],
+                        push_to=push_to,
+                        trace_id=self.trace_id,
+                    )
+                except Exception:
+                    # attribute the open failure to the hop that refused it so
+                    # routing bans/penalizes that peer on the retry
+                    self.seq_manager.on_request_failure(span.peer_id)
+                    raise
                 # adopt the server-echoed trace id (normalized or server-
                 # minted) from the FIRST hop, so the spans the rest of the
                 # chain opens with — and all client telemetry — key on the
@@ -638,7 +679,11 @@ class InferenceSession:
         :364-391). The replacement is seeded by KV migration when the failed
         server is still reachable (a draining/rebalancing peer serving
         ``ptu.session_export`` — beyond reference), falling back to replaying
-        the recorded input history. Returns the block index to resume from."""
+        the recorded input history. A drain-to-migrate server instead answers
+        with a redirect to the replica now holding the KV: routing is biased
+        there (``prefer_peers``) and the replacement seeds by server-side
+        ``kv_adopt`` — no KV bytes on the client link at all. Returns the
+        block index to resume from."""
         dead: Optional[_ServerInferenceSession] = None
         for session in self._sessions:
             if session.span.start <= failed_block < session.span.end:
@@ -657,33 +702,80 @@ class InferenceSession:
         drop = [s for s in self._sessions if s not in keep_up and s not in keep_down]
 
         # try to export the hole's KV from the dying server BEFORE closing
-        # anything (a drained server serves exports after its streams died)
+        # anything (a drained server serves exports after its streams died).
+        # A drain-to-migrate server answers with a REDIRECT instead: its KV
+        # already lives on a replica, and the cheapest repair is to land the
+        # new chain there and adopt it server-side (zero client-link bytes).
         exported = None
+        redirect = None
         if dead is not None and dead.session_id and self._position > 0:
-            exported = await self._try_export(
+            got = await self._try_export(
                 dead.span.peer_id, dead.session_id, resume, dead_end
             )
+            if isinstance(got, dict):
+                redirect = got["migrated_to"]
+            else:
+                exported = got
 
         self._retire_hops(drop)
         for session in drop:
             await session.close()
+
+        prefer_peers = None
+        if redirect is not None and redirect.get("peer_id"):
+            try:
+                from petals_tpu.data_structures import PeerID
+
+                prefer_peers = (PeerID.from_string(redirect["peer_id"]),)
+            except (ValueError, TypeError):
+                prefer_peers = None
 
         await self.seq_manager.update()
         new_chain = await self.seq_manager.make_sequence(
             resume, dead_end, mode="min_latency",
             cache_tokens_needed=self.batch_size * self.max_length,
             affinity_seed=self._affinity_seed,
+            prefer_peers=prefer_peers,
         )
         new_sessions = await self._enter_server_sessions(new_chain, wire_push=False)
         self._sessions = sorted(
             keep_up + new_sessions + keep_down, key=lambda s: s.span.start
         )
 
-        # Seed the replacement: KV import (single-span holes only — a split
-        # hole would leave later spans without input history for future
-        # failovers), else history replay.
+        # Seed the replacement (single-span holes only — a split hole would
+        # leave later spans without input history for future failovers):
+        # 1. server-side adopt when the chain landed on the migrated KV's
+        #    new home (the p2p path: bytes already moved server-to-server);
+        # 2. KV import over the client link (export in hand, or fetched from
+        #    the redirect target when routing went elsewhere);
+        # 3. history replay.
         seeded = False
-        if exported is not None and len(new_sessions) == 1:
+        if (
+            redirect is not None
+            and prefer_peers
+            and len(new_sessions) == 1
+            and new_sessions[0].span.peer_id == prefer_peers[0]
+            and dead is not None
+        ):
+            try:
+                seeded = await self._seed_by_adopt(
+                    new_sessions[0], dead.session_id,
+                    int(redirect["position"]), replay_steps,
+                )
+            except Exception as e:
+                logger.warning(f"KV adopt failed, falling back: {e}")
+                self._journal_export_fallback(str(redirect.get("peer_id")), repr(e))
+                # the session's stream state is unknown after a failed adopt
+                await new_sessions[0].close()
+                new_sessions = await self._enter_server_sessions(new_chain, wire_push=False)
+                self._sessions = sorted(
+                    keep_up + new_sessions + keep_down, key=lambda s: s.span.start
+                )
+        if not seeded and redirect is not None and exported is None and dead is not None:
+            exported = await self._fetch_migrated(
+                redirect, dead.session_id, resume, dead_end
+            )
+        if not seeded and exported is not None and len(new_sessions) == 1:
             try:
                 seeded = await self._seed_by_import(new_sessions[0], exported, replay_steps)
             except Exception as e:
@@ -715,17 +807,33 @@ class InferenceSession:
             chunk, prompts=server_prompts, hypo_ids=hypo_step, step_id=step_id
         )
 
-    async def _try_export(self, peer_id, session_id: str, start: int, end: int):
-        """Fetch the failed span's KV from its (possibly draining) server;
-        None when unreachable/refused — the caller falls back to replay."""
+    def _export_compression(self) -> str:
         # Ride the session's negotiated wire codec, except qint8: blockwise
         # quantization of KV would degrade every subsequent token, while the
         # replay fallback is exact — bfloat16 is lossless for bf16 caches and
-        # half the bytes of an f32 one. Long-context caches are 100s of MB, so
-        # the timeout is generous; a failed export just means a full replay.
+        # half the bytes of an f32 one.
         comp = self.seq_manager.config.compression
         if comp == CompressionType.QINT8.value:
             comp = CompressionType.BFLOAT16.value
+        return comp
+
+    def _journal_export_fallback(self, peer: str, reason: str) -> None:
+        """The repair is about to cost a replay (or a second fetch) instead of
+        a KV transfer — journal why, so churn postmortems can separate dead
+        exporters from deadline misses from budget refusals."""
+        from petals_tpu.telemetry import get_journal
+
+        get_journal().event(
+            "export_fallback", trace_id=self.trace_id, peer=peer, reason=reason,
+        )
+
+    async def _try_export(self, peer_id, session_id: str, start: int, end: int):
+        """Fetch the failed span's KV from its (possibly draining) server.
+        Returns ``(k, v, position)``, a ``{"migrated_to": ...}`` redirect dict
+        when the server already pushed this session's KV to a peer
+        (drain-to-migrate), or None — the caller falls back to replay. The
+        transfer deadline is ``ClientConfig.kv_export_timeout``; long-context
+        caches are 100s of MB, so the default is generous."""
         try:
             stub = await asyncio.wait_for(self.seq_manager.get_stub(peer_id), timeout=5)
             # quick liveness probe first: this peer may be the one that just
@@ -737,11 +845,18 @@ class InferenceSession:
                     "ptu.session_export",
                     {
                         "session_id": session_id, "start": start, "end": end,
-                        "compression": comp,
+                        "compression": self._export_compression(),
                     },
                 ),
-                timeout=120,
+                timeout=self.seq_manager.config.kv_export_timeout,
             )
+            fwd = reply.get("migrated_to")
+            if isinstance(fwd, dict) and fwd.get("addr"):
+                logger.info(
+                    f"Session KV migrated away from {peer_id.to_string()[:8]} "
+                    f"to {str(fwd.get('peer_id'))[:8]}: retargeting"
+                )
+                return {"migrated_to": fwd}
             if int(reply.get("batch_size", -1)) != self.batch_size:
                 return None
             k = deserialize_array(reply["tensors"]["k"])
@@ -749,6 +864,40 @@ class InferenceSession:
             return k, v, int(reply["position"])
         except Exception as e:
             logger.info(f"KV export unavailable from {peer_id.to_string()[:8]}: {e}")
+            self._journal_export_fallback(peer_id.to_string(), repr(e))
+            return None
+
+    async def _fetch_migrated(self, fwd: dict, session_id: str, start: int, end: int):
+        """The dead server pushed this session's KV to a peer, but the new
+        chain did not land there (or the adopt failed): pull the migrated
+        copy from its new home over the client link instead."""
+        from petals_tpu.dht.routing import PeerAddr
+
+        try:
+            stub = await asyncio.wait_for(
+                self.seq_manager.pool.get_addr(PeerAddr.from_string(fwd["addr"])),
+                timeout=5,
+            )
+            reply = await asyncio.wait_for(
+                stub.call(
+                    "ptu.session_export",
+                    {
+                        "session_id": session_id, "start": start, "end": end,
+                        "compression": self._export_compression(),
+                    },
+                ),
+                timeout=self.seq_manager.config.kv_export_timeout,
+            )
+            if "migrated_to" in reply:
+                return None  # no redirect chains: one forwarding hop only
+            if int(reply.get("batch_size", -1)) != self.batch_size:
+                return None
+            k = deserialize_array(reply["tensors"]["k"])
+            v = deserialize_array(reply["tensors"]["v"])
+            return k, v, int(reply["position"])
+        except Exception as e:
+            logger.info(f"Migrated KV unavailable from {fwd.get('addr')}: {e}")
+            self._journal_export_fallback(str(fwd.get("peer_id")), repr(e))
             return None
 
     async def _seed_by_import(self, session, exported, replay_steps) -> bool:
@@ -784,6 +933,40 @@ class InferenceSession:
         logger.info(
             f"Migrated {cut} cached tokens into {session.span.peer_id.to_string()[:8]} "
             f"(+{len(replay_steps) - n_prefix} replayed steps)"
+        )
+        return True
+
+    async def _seed_by_adopt(
+        self, session, source_session_id: str, export_pos: int, replay_steps
+    ) -> bool:
+        """Adopt migrated KV already resident on the replacement server, up to
+        a history step boundary, then replay any remaining recorded steps.
+        Same cut discipline as ``_seed_by_import`` — only the tensors never
+        touch the client link."""
+        if export_pos > self._position:
+            # the migrated snapshot is AHEAD of the client (a step's reply was
+            # lost): a hypo_ids reorder in that step would leave the cache
+            # lane-permuted vs our history — replay is exact (see
+            # _seed_by_import for the full hazard)
+            return False
+        cap = min(export_pos, self._position)
+        cut = 0
+        n_prefix = 0
+        for hidden_step, _ in replay_steps:
+            take = hidden_step.shape[1]
+            if cut + take > cap:
+                break
+            cut += take
+            n_prefix += 1
+        if cut <= 0:
+            return False
+        await session.adopt_kv(source_session_id, cut)
+        session.history = [tuple(step) for step in replay_steps[:n_prefix]]
+        for hidden_step, hypo_step in replay_steps[n_prefix:]:
+            await self._replay_step(session, hidden_step, hypo_step, uuid.uuid4().hex)
+        logger.info(
+            f"Adopted {cut} migrated tokens on {session.span.peer_id.to_string()[:8]} "
+            f"(zero client-link KV bytes, +{len(replay_steps) - n_prefix} replayed steps)"
         )
         return True
 
@@ -870,7 +1053,9 @@ class InferenceSession:
                     if lo >= hi:
                         continue
                     got = await self._try_export(cur.span.peer_id, cur.session_id, lo, hi)
-                    if got is None:
+                    if got is None or isinstance(got, dict):
+                        # a redirect here means the live session moved under
+                        # us mid-upgrade — abandon, the repair path handles it
                         raise RuntimeError(f"export of blocks [{lo}, {hi}) unavailable")
                     k, v, pos = got
                     pieces.append((lo, k, v))

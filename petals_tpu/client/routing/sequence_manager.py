@@ -37,6 +37,12 @@ from petals_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 CACHE_MISS_PENALTY = 10.0  # seconds added when a server's KV cache can't fit us
+# Routing bonus for a peer that already HOLDS this session's migrated KV
+# (repair path: the dying server pushed its pages there). Sized like
+# CACHE_MISS_PENALTY: landing the chain on the KV's new home replaces a
+# 100s-of-MB transfer (or a full prefix replay) with a server-local adopt,
+# so it should win against anything short of a missing block.
+PREFER_PEER_BONUS_S = 10.0
 # Soft routing penalty for a queue-dominated server (report_congestion):
 # scaled by the observed queue share, decaying after CONGESTION_WINDOW_S.
 # Sized like a bad WAN RTT — enough to flip near-ties toward an idle
@@ -243,6 +249,10 @@ class RemoteSequenceManager:
             return
         _, streak = self._banned.get(peer_id, (0.0, 0))
         duration = min(self.config.ban_timeout * (2**streak), 300.0)
+        # ±25% jitter AFTER the cap: a swarm of clients banning the same dead
+        # peer would otherwise all unban (and re-probe it) in lockstep — the
+        # cap would re-synchronize long streaks if jitter came first
+        duration *= random.uniform(0.75, 1.25)
         self._banned[peer_id] = (time.monotonic() + duration, streak + 1)
         from petals_tpu.telemetry import instruments as tm
 
@@ -389,6 +399,7 @@ class RemoteSequenceManager:
         mode: str = "min_latency",
         cache_tokens_needed: Optional[int] = None,
         affinity_seed: Optional[int] = None,
+        prefer_peers: Optional[Sequence[PeerID]] = None,
     ) -> List[RemoteSpanInfo]:
         end_index = end_index if end_index is not None else len(self.block_uids)
         if self.state.last_updated_time is None:
@@ -410,7 +421,8 @@ class RemoteSequenceManager:
 
         if mode == "min_latency":
             sequence = self._make_sequence_min_latency(
-                start_index, end_index, cache_tokens_needed, affinity_seed
+                start_index, end_index, cache_tokens_needed, affinity_seed,
+                prefer_peers=prefer_peers,
             )
         elif mode == "max_throughput":
             sequence = self._make_sequence_max_throughput(start_index, end_index)
@@ -425,7 +437,8 @@ class RemoteSequenceManager:
             await refresh_for_cache()
             sequence = (
                 self._make_sequence_min_latency(
-                    start_index, end_index, cache_tokens_needed, affinity_seed
+                    start_index, end_index, cache_tokens_needed, affinity_seed,
+                    prefer_peers=prefer_peers,
                 )
                 if mode == "min_latency"
                 else self._make_sequence_max_throughput(start_index, end_index)
@@ -477,6 +490,7 @@ class RemoteSequenceManager:
     def _make_sequence_min_latency(
         self, start: int, end: int, cache_tokens_needed: Optional[int],
         affinity_seed: Optional[int] = None,
+        prefer_peers: Optional[Sequence[PeerID]] = None,
     ) -> List[RemoteSpanInfo]:
         """Dijkstra over (block, peer) states; edge = RTT + per-block decode cost
         (+ cache-miss penalty), mirroring reference :177-300."""
@@ -503,6 +517,7 @@ class RemoteSequenceManager:
                 edge = self._edge_cost(
                     peer, span.peer_id, info, next_block - block, cache_tokens_needed,
                     affinity_jitter=jitter(span.peer_id),
+                    prefer_peers=prefer_peers,
                 )
                 nkey = (next_block, span.peer_id)
                 ncost = cost + edge
@@ -533,6 +548,7 @@ class RemoteSequenceManager:
     def _edge_cost(
         self, prev_peer, peer_id, info, n_blocks: int, cache_tokens_needed: Optional[int],
         *, affinity_jitter: float = 0.0,
+        prefer_peers: Optional[Sequence[PeerID]] = None,
     ) -> float:
         """One chain hop's cost: RTT + per-block decode cost + cache-miss
         penalty — THE edge model, shared by the Dijkstra and
@@ -554,7 +570,12 @@ class RemoteSequenceManager:
             and info.cache_tokens_left < cache_tokens_needed
         ):
             edge += CACHE_MISS_PENALTY
-        return edge + self._congestion_penalty(peer_id) + affinity_jitter
+        edge += self._congestion_penalty(peer_id) + affinity_jitter
+        if prefer_peers is not None and peer_id in prefer_peers:
+            # this peer holds the session's migrated KV — discount the hop
+            # (clamped: Dijkstra needs non-negative edges)
+            edge = max(edge - PREFER_PEER_BONUS_S, 0.0)
+        return edge
 
     def estimate_chain_latency(
         self, chain: List[RemoteSpanInfo], cache_tokens_needed: Optional[int] = None

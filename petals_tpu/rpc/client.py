@@ -13,6 +13,7 @@ import asyncio
 import itertools
 from typing import Any, AsyncIterator, Optional
 
+from petals_tpu import chaos
 from petals_tpu.data_structures import PeerID
 from petals_tpu.rpc.protocol import read_frame, write_frame
 from petals_tpu.rpc.server import RpcError
@@ -193,6 +194,8 @@ class RpcClient:
         await write_frame(self._writer, message, self._write_lock)
 
     async def call(self, method: str, payload: Any = None, timeout: Optional[float] = None) -> Any:
+        if chaos.ENABLED:
+            await chaos.inject(chaos.SITE_RPC_CALL, detail=method)
         call_id = next(self._call_ids)
         future: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[call_id] = future
@@ -211,6 +214,8 @@ class RpcClient:
             self._pending.pop(call_id, None)
 
     async def open_stream(self, method: str) -> StreamCall:
+        if chaos.ENABLED:
+            await chaos.inject(chaos.SITE_RPC_STREAM, detail=method)
         call_id = next(self._call_ids)
         stream = StreamCall(self, call_id)
         self._streams[call_id] = stream

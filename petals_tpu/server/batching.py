@@ -1893,3 +1893,52 @@ class DecodeBatcher:
 
         async with self._lane_busy(lane):
             return await self.queue.submit(run, priority=PRIORITY_INFERENCE, size=0)
+
+    async def snapshot_from_swap(self, lane: int, position: int, b0: int, b1: int):
+        """Host KV snapshot of a SUSPENDED lane assembled straight from its
+        SwapEntry — pure numpy, no device work. ``snapshot_lane`` would first
+        swap the lane back IN (``_lane_busy`` -> ``_ensure_resident``),
+        burning pool pages and two device copies just to read bytes that
+        already sit in host RAM; a draining server parking its preempted
+        tenants hits exactly that case. Returns ``(k, v)`` shaped like
+        ``snapshot_lane``'s host pair, or None when the lane isn't suspended,
+        is busy, or its swap entry doesn't cover ``[0, position)`` — the
+        caller falls back to the device path."""
+        if self.page_size is None:
+            return None
+        slot = self._scheduler.lanes.get(lane)
+        if slot is None:
+            return None
+        lock = self._lane_lock(lane)
+        # trylock (no sanitizer order edge): busy means a step or resume is
+        # mid-flight — the device path serializes behind it correctly
+        if not lock_try_acquire_nowait(lock):
+            return None
+        try:
+            entry = slot.swap
+            if entry is None or slot.suspending:
+                return None
+            ps = self.page_size
+            n_slots = -(-position // ps)  # ceil: table slots covering [0, position)
+            index_of = {int(s): i for i, s in enumerate(entry.slots)}
+            if any(s not in index_of for s in range(n_slots)):
+                return None  # partial residency: only the pool knows the rest
+
+            def assemble():
+                hkv, d = entry.k.shape[-2], entry.k.shape[-1]
+                nb = b1 - b0
+                k_out = np.zeros((nb, 1, position, hkv, d), entry.k.dtype)
+                v_out = np.zeros((nb, 1, position, hkv, d), entry.v.dtype)
+                for s in range(n_slots):
+                    i = index_of[s]
+                    t0, t1 = s * ps, min((s + 1) * ps, position)
+                    k_out[:, 0, t0:t1] = entry.k[b0:b1, i, : t1 - t0]
+                    v_out[:, 0, t0:t1] = entry.v[b0:b1, i, : t1 - t0]
+                return k_out, v_out
+
+            # the lane lock stays held across the copy so a racing resume
+            # can't consume the entry mid-assembly; it's a trylock, so the
+            # sanitizer's await-under-lock rule is not in play
+            return await asyncio.to_thread(assemble)
+        finally:
+            lock.release()

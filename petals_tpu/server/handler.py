@@ -13,7 +13,12 @@ Wire payloads (msgpack):
 - inference step:  {tensors: {hidden, prompts?, hypo_ids?}, start_from_position?, step_id?}
 - inference reply: {tensors: {hidden}, position}
 - kv import step:  {kv_import: {position}, tensors: {k, v}} (first step only)
+- kv adopt step:   {kv_adopt: {session_id, position}} (first step only; seeds
+                   from KV this server already holds — migrated in or parked)
 - session export:  {session_id, start, end, compression?} -> {position, tensors: {k, v}, ...}
+                   (or {migrated_to: {peer_id, addr, position}} redirect)
+- session migrate: {session_id, start, end, position, batch_size, max_length,
+                   trace_id?, tensors: {k, v}} -> {ok, position} (server->server)
 - forward:         {uids, tensors: {hidden, prompts?}, active_adapter?}
 - backward:        {uids, tensors: {hidden, grad_out, prompts?}, active_adapter?}
 - info:            {} -> ServerInfo dict + cache stats
@@ -28,6 +33,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from petals_tpu import chaos
 from petals_tpu.data_structures import CHAIN_DELIMITER, ModuleUID, parse_uid
 from petals_tpu.rpc.protocol import validate_gen_sampling
 from petals_tpu.rpc.serialization import deserialize_array, serialize_array, CompressionType
@@ -106,6 +112,17 @@ class TransformerHandler:
         self._parked: Dict[str, dict] = {}
         self.park_ttl = 60.0
         self.draining = False
+        # Peer-to-peer migration (ptu.session_migrate): KV pushed here by a
+        # draining/rebalancing peer, held until the client re-opens and adopts
+        # it (kv_adopt step) or the TTL lapses. Byte-budgeted: a swarm of
+        # draining peers must not be able to OOM this host.
+        self._migrated: Dict[str, dict] = {}
+        # sessions we pushed away: session_id -> forwarding address, served
+        # as a redirect from rpc_session_export so the client finds the KV
+        self._migrated_away: Dict[str, dict] = {}
+        self._migrated_bytes = 0
+        self.migrate_in_budget_bytes = 512 * 2**20
+        self.migrate_ttl = 120.0
         from petals_tpu.rpc.pool import ConnectionPool
 
         self._push_pool = ConnectionPool(identity=identity)
@@ -204,6 +221,7 @@ class TransformerHandler:
         server.add_unary_handler("ptu.info", self.rpc_info)
         server.add_unary_handler("ptu.push", self.rpc_push)
         server.add_unary_handler("ptu.session_export", self.rpc_session_export)
+        server.add_unary_handler("ptu.session_migrate", self.rpc_session_migrate)
         server.add_stream_handler("ptu.inference", self.rpc_inference)
 
     async def rpc_push(self, payload, ctx: RpcContext):
@@ -231,6 +249,14 @@ class TransformerHandler:
         comp = CompressionType(payload.get("compression", "none"))
         self._prune_parked()
 
+        # migrated-away first, even while the drained stream is still open:
+        # the copy at the destination is the authoritative one now, and an
+        # adopt there (plus a replayed tail if a step raced the park) moves
+        # zero KV bytes over the client's link
+        fwd = self._migrated_away.get(session_id)
+        if fwd is not None:
+            return {"migrated_to": dict(fwd)}
+
         # live first: a parked snapshot goes stale if steps kept flowing
         # between drain and shutdown
         live = self._session_registry.get(session_id)
@@ -248,7 +274,11 @@ class TransformerHandler:
             )
             b0, b1 = 0, want_end - want_start
         else:
-            src = self._parked.get(session_id)
+            self._prune_migrated()
+            # parked (we are draining) or migrated-in (a peer drained onto us
+            # but the client's new chain doesn't end here): both are host
+            # snapshots with the same layout
+            src = self._parked.get(session_id) or self._migrated.get(session_id)
             if src is None:
                 raise KeyError(f"No live or parked session {session_id!r}")
             if not (src["start"] <= want_start < want_end <= src["end"]):
@@ -270,6 +300,160 @@ class TransformerHandler:
                 "v": serialize_array(src["v"][b0:b1], comp),
             },
         }
+
+    async def rpc_session_migrate(self, payload, ctx: RpcContext):
+        """Accept a session's KV pushed by a draining/rebalancing peer
+        (server->server, no client in the loop). The entry is held in host
+        RAM under a byte budget until the client re-opens here and adopts it
+        with a ``kv_adopt`` step, exports it onward, or the TTL lapses."""
+        from petals_tpu.telemetry import get_journal
+
+        session_id = payload["session_id"]
+        src_start = int(payload["start"])
+        src_end = int(payload["end"])
+        position = int(payload["position"])
+        batch_size = int(payload["batch_size"])
+        max_length = int(payload["max_length"])
+        trace_id = normalize_trace_id(payload.get("trace_id"))
+        if self.draining:
+            raise RuntimeError("Server is draining: not accepting migrated sessions")
+        first = self.backend.first_block
+        if not (first <= src_start < src_end <= first + self.backend.n_blocks):
+            raise ValueError(
+                f"Migrated span [{src_start}, {src_end}) outside this server's "
+                f"blocks [{first}, {first + self.backend.n_blocks})"
+            )
+        if position <= 0:
+            raise ValueError("Refusing to migrate a session with no cached tokens")
+        tensors = payload.get("tensors") or {}
+        if "k" not in tensors or "v" not in tensors:
+            raise ValueError("session_migrate needs k and v tensors")
+
+        def parse(wire):
+            arr = deserialize_array(wire)
+            want = (src_end - src_start, batch_size, position)
+            if tuple(arr.shape[:3]) != want:
+                raise ValueError(
+                    f"migrated KV shape {arr.shape} != (blocks, batch, position) {want}"
+                )
+            return arr
+
+        k_arr = await asyncio.to_thread(parse, tensors["k"])
+        v_arr = await asyncio.to_thread(parse, tensors["v"])
+        nbytes = k_arr.nbytes + v_arr.nbytes
+        self._prune_migrated()
+        if self._migrated_bytes + nbytes > self.migrate_in_budget_bytes:
+            tm.MIGRATIONS.labels(direction="in", outcome="refused").inc()
+            get_journal().event(
+                "migrate_refused", trace_id=trace_id, session_id=session_id,
+                nbytes=nbytes, in_use=self._migrated_bytes,
+                budget=self.migrate_in_budget_bytes,
+            )
+            raise RuntimeError(
+                f"Migration budget exhausted ({self._migrated_bytes + nbytes} "
+                f"> {self.migrate_in_budget_bytes} bytes)"
+            )
+        old = self._migrated.pop(session_id, None)
+        if old is not None:  # re-push after a failed adopt: replace, re-account
+            self._migrated_bytes -= old["nbytes"]
+        self._migrated[session_id] = {
+            "k": k_arr, "v": v_arr, "position": position,
+            "start": src_start, "end": src_end,
+            "batch_size": batch_size, "max_length": max_length,
+            "trace_id": trace_id, "nbytes": nbytes,
+            "expires": time.monotonic() + self.migrate_ttl,
+        }
+        self._migrated_bytes += nbytes
+        tm.MIGRATIONS.labels(direction="in", outcome="ok").inc()
+        tm.MIGRATION_BYTES.labels(direction="in").inc(nbytes)
+        get_journal().event(
+            "migrate_in", trace_id=trace_id,
+            occupancy=self.batcher.occupancy_info() if self.batcher is not None else None,
+            session_id=session_id, position=position, nbytes=nbytes,
+            start=src_start, end=src_end,
+        )
+        return {"ok": True, "position": position}
+
+    async def migrate_parked_to(
+        self, session_id: str, snap: dict, peer_id: str, addr: str,
+        *, deadline_s: float = 30.0, budget_bytes: Optional[int] = None,
+    ) -> bool:
+        """Push one parked session's KV to a live replica (drain-to-migrate /
+        rebalance path). On success the local parked copy becomes a redirect
+        (``_migrated_away``) so exports forward the client to the new home.
+        Returns False — with flight-recorder evidence — when the push fails;
+        the parked entry stays, and the client falls back to export/replay."""
+        from petals_tpu.dht.routing import PeerAddr
+        from petals_tpu.telemetry import get_journal
+
+        trace_id = snap.get("trace_id")
+        nbytes = int(snap["k"].nbytes + snap["v"].nbytes)
+        t0 = time.perf_counter()
+        try:
+            if budget_bytes is not None and nbytes > budget_bytes:
+                raise RuntimeError(
+                    f"session KV ({nbytes}B) exceeds the migration budget ({budget_bytes}B)"
+                )
+            if chaos.ENABLED:
+                await chaos.inject(chaos.SITE_MIGRATE_PUSH, detail=session_id)
+            wire_k, wire_v = await asyncio.to_thread(
+                lambda: (
+                    serialize_array(snap["k"], self.compression),
+                    serialize_array(snap["v"], self.compression),
+                )
+            )
+            payload = {
+                "session_id": session_id,
+                "start": snap["start"], "end": snap["end"],
+                "position": snap["position"], "batch_size": snap["batch_size"],
+                "max_length": snap["max_length"], "trace_id": trace_id,
+                "tensors": {"k": wire_k, "v": wire_v},
+            }
+            client = await self._push_pool.get_addr(PeerAddr.from_string(addr))
+            await asyncio.wait_for(
+                client.call("ptu.session_migrate", payload), deadline_s
+            )
+        except Exception as e:
+            tm.MIGRATIONS.labels(direction="out", outcome="failed").inc()
+            get_journal().event(
+                "migrate_failed", trace_id=trace_id, session_id=session_id,
+                dest=peer_id, nbytes=nbytes, error=repr(e),
+            )
+            from petals_tpu.telemetry.flight import flight_from_env
+
+            flight_from_env().record(
+                "migrate_failed", trace_id=trace_id,
+                journal=lambda: get_journal().events(trace_id=trace_id)[-50:],
+                session_id=session_id, dest_peer=peer_id, dest_addr=addr,
+                nbytes=nbytes, error=repr(e),
+                elapsed_s=time.perf_counter() - t0,
+            )
+            logger.warning(f"Migration of {session_id!r} to {peer_id} failed: {e}")
+            return False
+        self._migrated_away[session_id] = {
+            "peer_id": peer_id, "addr": addr, "position": snap["position"],
+        }
+        self._parked.pop(session_id, None)
+        tm.MIGRATIONS.labels(direction="out", outcome="ok").inc()
+        tm.MIGRATION_BYTES.labels(direction="out").inc(nbytes)
+        get_journal().event(
+            "migrate_out", trace_id=trace_id,
+            occupancy=self.batcher.occupancy_info() if self.batcher is not None else None,
+            session_id=session_id, dest=peer_id, nbytes=nbytes,
+            position=snap["position"], elapsed_s=time.perf_counter() - t0,
+        )
+        return True
+
+    def _prune_migrated(self) -> None:
+        now = time.monotonic()
+        for sid in [s for s, m in self._migrated.items() if m["expires"] < now]:
+            self._migrated_bytes -= self._migrated[sid]["nbytes"]
+            del self._migrated[sid]
+
+    def _consume_migrated(self, session_id: str) -> None:
+        entry = self._migrated.pop(session_id, None)
+        if entry is not None:
+            self._migrated_bytes -= entry["nbytes"]
 
     async def _install_kv_import(
         self, step, kv, handles, position, *, batch_size: int, n_blocks: int, max_length: int
@@ -358,6 +542,60 @@ class TransformerHandler:
             batch_size=batch_size, n_blocks=n_blocks, batcher=batcher,
         )
         return new_position
+
+    async def _install_kv_adopt(
+        self, step, lane, kv, handles, position, *,
+        abs_start: int, batch_size: int, n_blocks: int, max_length: int, batcher,
+    ) -> int:
+        """Seed a fresh session's cache from KV already ON THIS SERVER — a
+        migrated-in entry (peer drain/rebalance pushed it here) or our own
+        parked snapshot. The client sends only ``{session_id, position}``:
+        the bytes never cross the client link, which is the whole point of
+        peer-to-peer migration vs export/import."""
+        if position != 0:
+            raise ValueError("kv_adopt must be the first step of a session")
+        spec = step["kv_adopt"]
+        src_sid = spec["session_id"]
+        cut = int(spec["position"])
+        self._prune_migrated()
+        self._prune_parked()
+        entry = self._migrated.get(src_sid) or self._parked.get(src_sid)
+        if entry is None:
+            raise KeyError(f"No migrated or parked KV for session {src_sid!r}")
+        if not 0 < cut <= entry["position"]:
+            raise ValueError(
+                f"kv_adopt position {cut} outside (0, {entry['position']}]"
+            )
+        if cut > max_length:
+            raise ValueError(f"kv_adopt position {cut} exceeds max_length {max_length}")
+        if batch_size != entry["batch_size"]:
+            raise ValueError(
+                f"kv_adopt batch_size {batch_size} != source {entry['batch_size']}"
+            )
+        if not (entry["start"] <= abs_start and abs_start + n_blocks <= entry["end"]):
+            raise ValueError(
+                f"Session blocks [{abs_start}, {abs_start + n_blocks}) outside "
+                f"migrated span [{entry['start']}, {entry['end']})"
+            )
+        b0 = abs_start - entry["start"]
+        k_arr = np.ascontiguousarray(entry["k"][b0:b0 + n_blocks, :, :cut])
+        v_arr = np.ascontiguousarray(entry["v"][b0:b0 + n_blocks, :, :cut])
+        await self._seed_session_kv(
+            lane, kv, handles, k_arr, v_arr, cut,
+            batch_size=batch_size, n_blocks=n_blocks, batcher=batcher,
+        )
+        # consume only after the seed landed — a failed adopt leaves the
+        # entry for a retry or an export until its TTL says otherwise
+        self._consume_migrated(src_sid)
+        self._parked.pop(src_sid, None)
+        from petals_tpu.telemetry import get_journal
+
+        get_journal().event(
+            "migrate_adopt", trace_id=entry.get("trace_id"),
+            occupancy=self.batcher.occupancy_info() if self.batcher is not None else None,
+            session_id=src_sid, position=cut, nbytes=k_arr.nbytes + v_arr.nbytes,
+        )
+        return cut
 
     async def _seed_session_kv(
         self, lane, kv, handles, k_arr, v_arr, new_position: int,
@@ -603,10 +841,19 @@ class TransformerHandler:
             # register handles=None, so the private export below would crash.
             n = reg["end"] - reg["start"]
             position = reg["position"]
-            k, v = await (reg.get("batcher") or self.batcher).snapshot_lane(
+            batcher = reg.get("batcher") or self.batcher
+            # suspended lanes: read the swap entry's host copy directly —
+            # snapshot_lane would swap the lane back IN just to re-export it
+            pair = await batcher.snapshot_from_swap(
                 reg["lane"], position, b0 if b0 is not None else 0,
                 b1 if b1 is not None else n,
             )
+            if pair is None:
+                pair = await batcher.snapshot_lane(
+                    reg["lane"], position, b0 if b0 is not None else 0,
+                    b1 if b1 is not None else n,
+                )
+            k, v = pair
             return {
                 "k": k, "v": v, "position": position,
                 "start": reg["start"], "end": reg["end"],
@@ -669,6 +916,7 @@ class TransformerHandler:
                 logger.warning(f"Could not park session {session_id!r}: {e}")
                 continue
             snap["expires"] = time.monotonic() + ttl
+            snap["trace_id"] = reg.get("trace_id")
             self._parked[session_id] = snap
             parked += 1
         return parked
@@ -973,6 +1221,7 @@ class TransformerHandler:
                     "start": self.backend.first_block + start,
                     "end": self.backend.first_block + end,
                     "batch_size": batch_size, "max_length": max_length,
+                    "trace_id": trace_id,  # rides into parked/migrated snapshots
                 }
                 self._session_registry[session_id] = reg
             # echo the trace id so the client learns a server-minted one
@@ -1001,6 +1250,10 @@ class TransformerHandler:
                     pending_store = None
                 if step is None:
                     break
+                if chaos.ENABLED:
+                    # mid-step fault: a raise here kills the stream exactly at
+                    # the step boundary, the worst point for a session's KV
+                    await chaos.inject(chaos.SITE_HANDLER_STEP, detail=session_id)
                 if self.draining:
                     # fail fast so the client repairs its chain NOW, while the
                     # parked KV export is still being served (drain window)
@@ -1024,6 +1277,21 @@ class TransformerHandler:
                     position = int(start_from)  # rollback (speculative decoding)
                     if reg is not None:
                         reg["position"] = position
+
+                if "kv_adopt" in step:
+                    # seed from KV already on this server (migrated or parked)
+                    position = await self._install_kv_adopt(
+                        step, lane, kv, handles, position,
+                        abs_start=self.backend.first_block + start,
+                        batch_size=batch_size, n_blocks=end - start,
+                        max_length=max_length, batcher=batcher,
+                    )
+                    if lane is None:
+                        kv = tuple(self.memory_cache.get_buffers(*handles))
+                    if reg is not None:
+                        reg["position"] = position
+                    yield {"position": position, "kv_adopt": True}
+                    continue
 
                 if "kv_import" in step:
                     if lane is not None:

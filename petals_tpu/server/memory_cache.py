@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from petals_tpu import chaos
 from petals_tpu.analysis.sanitizer import make_async_lock
 from petals_tpu.data_structures import Handle
 from petals_tpu.utils.logging import get_logger
@@ -207,6 +208,10 @@ class HostSwapPool:
         overflow the budget (the entry's victim stays resident)."""
         nbytes = int(nbytes)
         assert nbytes >= 0
+        if chaos.ENABLED and chaos.fire(chaos.SITE_SWAP_RESERVE) is not None:
+            # injected pressure spike: behave exactly like a full budget
+            self.stats["rejected"] += 1
+            return False
         if nbytes > self.bytes_left:
             self.stats["rejected"] += 1
             return False

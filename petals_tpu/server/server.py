@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 import petals_tpu
+from petals_tpu import chaos
 from petals_tpu.data_structures import ServerInfo, ServerState, make_uid, PeerID
 from petals_tpu.dht.node import DHTNode, dht_time
 from petals_tpu.rpc.server import RpcServer
@@ -441,12 +442,16 @@ class Server:
     async def wait_ready(self) -> None:
         await self._ready.wait()
 
-    async def drain(self, park_ttl: float = 60.0) -> int:
+    async def drain(self, park_ttl: float = 60.0, migrate: bool = True) -> int:
         """Graceful-shutdown prelude: stop accepting sessions, announce OFFLINE,
         and park every live session's KV in host RAM so clients can migrate
         their caches to replacement servers (``ptu.session_export``) instead of
-        recomputing prefills. The RPC server stays up — call :meth:`shutdown`
-        after the drain window. Returns the number of parked sessions."""
+        recomputing prefills. With ``migrate=True`` (drain-to-migrate) the
+        parked KV is then proactively PUSHED to live replicas covering each
+        session's span — the client's repair becomes a redirect + server-side
+        ``kv_adopt``, moving zero KV bytes over the client's own link. The RPC
+        server stays up — call :meth:`shutdown` after the drain window.
+        Returns the number of parked sessions."""
         # a rebalance firing mid-drain would reload blocks and re-announce
         # ONLINE, overriding the OFFLINE below — stop considering moves first
         if self._balancer_task is not None:
@@ -473,7 +478,71 @@ class Server:
             logger.debug("OFFLINE announce during drain failed: %r", e)
         if parked:
             logger.info(f"Draining: parked {parked} session(s) for migration")
+        if parked and migrate:
+            pushed = await self._migrate_parked_sessions()
+            if pushed:
+                logger.info(f"Drain-to-migrate: pushed {pushed} session(s) to replicas")
         return parked
+
+    async def _migrate_parked_sessions(self, deadline_s: float = 30.0) -> int:
+        """Push every parked session's KV to a live replica covering its span
+        (drain-to-migrate / rebalance path). Best-effort per session: a
+        session with no covering replica, or whose push fails, simply stays
+        parked — the client falls back to export-over-its-own-link or replay."""
+        handler = self.handler
+        if handler is None or not handler._parked or self.dht is None:
+            return 0
+        from petals_tpu.utils.dht_utils import get_remote_module_infos
+
+        all_uids = [
+            make_uid(self.dht_prefix, i) for i in range(self.cfg.num_hidden_layers)
+        ]
+        try:
+            infos, addr_book = await get_remote_module_infos(self.dht, all_uids)
+        except Exception as e:
+            logger.warning(f"Drain-to-migrate skipped: swarm lookup failed ({e!r})")
+            return 0
+        migrated = 0
+        for session_id, snap in list(handler._parked.items()):
+            dest = self._pick_migration_target(
+                infos, addr_book, snap["start"], snap["end"]
+            )
+            if dest is None:
+                logger.info(
+                    f"No live replica covers blocks [{snap['start']}, {snap['end']}): "
+                    f"session {session_id!r} stays parked for client-side export"
+                )
+                continue
+            peer_id, addr = dest
+            if await handler.migrate_parked_to(
+                session_id, snap, peer_id.to_string(), addr.to_string(),
+                deadline_s=deadline_s,
+            ):
+                migrated += 1
+        return migrated
+
+    def _pick_migration_target(self, infos, addr_book, start: int, end: int):
+        """Highest-throughput ONLINE peer (not us) serving every block of
+        [start, end) with a known contact address, or None."""
+        candidates = None
+        for i in range(start, end):
+            info = infos[i] if i < len(infos) else None
+            if info is None:
+                return None
+            here = {
+                pid for pid, si in info.servers.items()
+                if si.state == ServerState.ONLINE and pid in addr_book
+                and pid != self.dht.peer_id
+            }
+            candidates = here if candidates is None else (candidates & here)
+            if not candidates:
+                return None
+        best, best_rps = None, -1.0
+        for pid in candidates:
+            rps = infos[start].servers[pid].throughput or 0.0
+            if rps > best_rps:
+                best, best_rps = pid, rps
+        return (best, addr_book[best]) if best is not None else None
 
     async def shutdown(self) -> None:
         if self._balancer_task is not None:
@@ -597,6 +666,11 @@ class Server:
             return None
 
     async def _announce(self, state: ServerState, expiration: Optional[float] = None) -> None:
+        if chaos.ENABLED and chaos.fire(chaos.SITE_ANNOUNCE) is not None:
+            # injected announce loss: the DHT record silently ages out, as if
+            # the store never reached the network
+            logger.warning("chaos: dropping DHT announce (%s)", state)
+            return
         expiration = expiration or (dht_time() + max(2 * self.update_period, 60.0))
         await declare_active_modules(
             self.dht, self.module_uids, self._server_info(state), expiration,
@@ -863,6 +937,10 @@ class Server:
                 raise RuntimeError("live span move before the server started serving")
             try:
                 await self.handler.park_sessions(ttl=60.0)
+                # rebalance-migrate: the new span can't serve the old span's
+                # KV, so hand it to replicas that can (best-effort; failures
+                # leave the parked copy for client-side export)
+                await self._migrate_parked_sessions()
                 self.handler.draining = True
                 await self.handler.queue.submit(
                     lambda: None, priority=PRIORITY_BARRIER, size=0
@@ -884,6 +962,16 @@ class Server:
                 # fail through _check_group with a clear error anyway
                 self.handler.draining = False
         else:
+            if self.handler is not None:
+                # park + migrate BEFORE the batcher rebuild kills pooled
+                # sessions: rebalance used to be a session-killer (clients
+                # replayed their whole prefix); now their KV moves to a
+                # replica and the repair is a redirect + kv_adopt
+                try:
+                    if await self.handler.park_sessions(ttl=60.0):
+                        await self._migrate_parked_sessions()
+                except Exception as e:
+                    logger.warning(f"Rebalance-migrate failed (sessions will replay): {e!r}")
             stacked = await asyncio.get_running_loop().run_in_executor(
                 None, self._load_span_params, self.first_block, self.num_blocks
             )

@@ -149,6 +149,23 @@ PREFIX_EVENTS = REGISTRY.counter(
     labels=("event",),  # hit | miss | adopt | evict
 )
 
+# --- migration / chaos ------------------------------------------------------
+MIGRATIONS = REGISTRY.counter(
+    "petals_migrations_total",
+    "Peer-to-peer session migrations, by direction and outcome",
+    labels=("direction", "outcome"),  # out|in x ok|failed|refused
+)
+MIGRATION_BYTES = REGISTRY.counter(
+    "petals_migration_bytes_total",
+    "KV bytes moved server-to-server by session migration",
+    labels=("direction",),  # out | in
+)
+CHAOS_INJECTIONS = REGISTRY.counter(
+    "petals_chaos_injections_total",
+    "Faults injected by the chaos plane, by site and action",
+    labels=("site", "action"),  # sites/actions are static code-defined enums
+)
+
 # --- telemetry self-observation -------------------------------------------
 META_TRUNCATED = REGISTRY.counter(
     "telemetry_meta_truncated_total",

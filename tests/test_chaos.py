@@ -1,0 +1,263 @@
+"""Chaos plane tier (beyond reference): the seeded fault-injection engine
+(petals_tpu/chaos/) and swarm survival under injected faults.
+
+Fast tests exercise the plane itself — spec grammar, rule validation,
+deterministic replay under a fixed seed, action semantics, bounded logs,
+metric attribution — plus the one injection site observable without a
+swarm (the host swap pool's budget refusal). The ``slow``-marked tests
+arm the plane against a live in-process swarm and assert the serving
+promise: sessions finish token-identically through dropped streams and
+mid-step failures.
+
+The plane is process-global, so every test disarms it on the way out
+(autouse fixture) — a leaked rule would poison the rest of the run.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.chaos  # fault-injection tier (CI runs -m chaos)
+
+from petals_tpu import chaos
+from petals_tpu.chaos import ChaosInjected, ChaosPlane, ChaosRule
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    """No chaos rule may outlive its test: the plane is module-global and a
+    leaked drop rule would fail unrelated tests in the same process."""
+    chaos.disable()
+    yield
+    chaos.disable()
+
+
+# --------------------------------------------------------------- plane unit
+
+
+def test_disabled_by_default():
+    assert chaos.ENABLED is False
+    assert chaos.get_plane() is None
+    assert chaos.fire(chaos.SITE_RPC_CALL) is None
+    asyncio.run(chaos.inject(chaos.SITE_HANDLER_STEP))  # no-op, no raise
+
+
+def test_parse_spec_grammar():
+    seed, rules = chaos.parse_spec(
+        "seed=42; rpc.call:drop:0.1 ;handler.step:delay:1.0:0.05;"
+        "migrate.push:refuse:::3"
+    )
+    assert seed == 42
+    assert [(r.site, r.action) for r in rules] == [
+        ("rpc.call", "drop"),
+        ("handler.step", "delay"),
+        ("migrate.push", "refuse"),
+    ]
+    assert rules[0].p == pytest.approx(0.1) and rules[0].delay_s == 0.0
+    assert rules[1].delay_s == pytest.approx(0.05)
+    assert rules[2].p == 1.0 and rules[2].max_count == 3
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "nosuchsite:drop",  # unknown site
+        "rpc.call:explode",  # unknown action
+        "rpc.call",  # missing action
+        "rpc.call:drop:1.5",  # p out of range
+        "rpc.call:drop:0.5:-1",  # negative delay
+        "rpc.call:drop:0.5:0.1:2:extra",  # too many fields
+    ],
+)
+def test_parse_spec_rejects_malformed(spec):
+    with pytest.raises(ValueError):
+        chaos.parse_spec(spec)
+
+
+def test_env_spec_arms_and_disarms(monkeypatch):
+    monkeypatch.setenv("PETALS_TPU_CHAOS", "seed=7;dht.announce:drop:0.5")
+    chaos.plane._arm_from_env()
+    plane = chaos.get_plane()
+    assert chaos.ENABLED and plane is not None and plane.seed == 7
+    assert len(plane.rules) == 1
+    chaos.disable()
+    assert chaos.ENABLED is False and chaos.get_plane() is None
+
+
+def test_seed_reproduces_fault_sequence():
+    """Same seed + same arrival order => identical fault sequence; that is
+    the whole point of a *seeded* chaos plane."""
+
+    def run(seed):
+        plane = ChaosPlane(
+            seed=seed, rules=[ChaosRule(chaos.SITE_RPC_CALL, "drop", p=0.5)]
+        )
+        return [plane.decide(chaos.SITE_RPC_CALL) is not None for _ in range(200)]
+
+    a, b = run(123), run(123)
+    assert a == b
+    assert any(a) and not all(a)  # p=0.5 actually flips both ways
+    assert run(124) != a  # a different seed perturbs the sequence
+
+
+def test_first_matching_rule_wins_and_match_filters():
+    plane = ChaosPlane(
+        rules=[
+            ChaosRule(chaos.SITE_RPC_CALL, "drop", match="ptu.push"),
+            ChaosRule(chaos.SITE_RPC_CALL, "refuse"),
+        ]
+    )
+    assert plane.decide(chaos.SITE_RPC_CALL, detail="ptu.push").action == "drop"
+    assert plane.decide(chaos.SITE_RPC_CALL, detail="ptu.info").action == "refuse"
+    assert plane.decide(chaos.SITE_HANDLER_STEP) is None  # no rule at that site
+
+
+def test_max_count_bounds_firings():
+    plane = ChaosPlane(rules=[ChaosRule(chaos.SITE_ANNOUNCE, "drop", max_count=2)])
+    fired = [plane.decide(chaos.SITE_ANNOUNCE) is not None for _ in range(5)]
+    assert fired == [True, True, False, False, False]
+    assert plane.rules[0].count == 2
+
+
+def test_rule_validation():
+    with pytest.raises(ValueError):
+        ChaosRule("bogus.site", "drop")
+    with pytest.raises(ValueError):
+        ChaosRule(chaos.SITE_RPC_CALL, "bogus")
+    with pytest.raises(ValueError):
+        ChaosRule(chaos.SITE_RPC_CALL, "drop", p=2.0)
+    with pytest.raises(ValueError):
+        ChaosRule(chaos.SITE_RPC_CALL, "delay", delay_s=-0.1)
+
+
+def test_inject_action_semantics():
+    killed = []
+    chaos.configure(
+        rules=[
+            ChaosRule(chaos.SITE_RPC_CALL, "drop", match="doomed"),
+            ChaosRule(chaos.SITE_RPC_CALL, "delay", delay_s=0.05, match="late"),
+            ChaosRule(chaos.SITE_HANDLER_STEP, "kill"),
+        ],
+        kill_callback=lambda site, detail: killed.append((site, detail)),
+    )
+
+    async def scenario():
+        with pytest.raises(ChaosInjected):
+            await chaos.inject(chaos.SITE_RPC_CALL, detail="doomed-call")
+        t0 = time.monotonic()
+        await chaos.inject(chaos.SITE_RPC_CALL, detail="late-call")
+        assert time.monotonic() - t0 >= 0.04
+        await chaos.inject(chaos.SITE_RPC_CALL, detail="untouched")  # no match
+        with pytest.raises(ChaosInjected):
+            await chaos.inject(chaos.SITE_HANDLER_STEP, detail="sess-1")
+
+    asyncio.run(scenario())
+    assert killed == [(chaos.SITE_HANDLER_STEP, "sess-1")]
+
+
+def test_injections_are_logged_metered_and_bounded():
+    from petals_tpu.telemetry import instruments as tm
+
+    child = tm.CHAOS_INJECTIONS.labels(site=chaos.SITE_SWAP_RESERVE, action="refuse")
+    before = child.value
+    plane = chaos.configure(rules=[ChaosRule(chaos.SITE_SWAP_RESERVE, "refuse")])
+    for _ in range(chaos.MAX_LOG + 16):
+        assert chaos.fire(chaos.SITE_SWAP_RESERVE) == "refuse"
+    assert child.value - before == chaos.MAX_LOG + 16  # counting never stops
+    assert len(plane.fired()) == chaos.MAX_LOG  # ... but the log is bounded
+    assert plane.fired(chaos.SITE_RPC_CALL) == []
+
+
+def test_swap_reserve_site_refuses_budget():
+    """An injected pressure spike makes try_reserve behave exactly like a
+    full budget — the victim stays resident and the stats say why."""
+    from petals_tpu.server.memory_cache import HostSwapPool
+
+    pool = HostSwapPool(max_size_bytes=1 << 20)
+    assert pool.try_reserve(1024)  # sanity: fits while chaos is off
+    chaos.configure(rules=[ChaosRule(chaos.SITE_SWAP_RESERVE, "refuse", max_count=1)])
+    assert not pool.try_reserve(1024)
+    assert pool.stats["rejected"] == 1
+    assert pool.try_reserve(1024)  # max_count exhausted: budget is back
+    assert pool.bytes_in_use == 2048
+
+
+# ----------------------------------------------------------- swarm survival
+
+
+@pytest.fixture()
+def chaos_swarm(tmp_path_factory):
+    from tests.test_full_model import SwarmHarness
+    from tests.utils import make_tiny_llama
+
+    path = make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+    harness = SwarmHarness(
+        path,
+        [
+            dict(first_block=0, num_blocks=4, throughput=1000.0),
+            dict(first_block=0, num_blocks=4, throughput=1.0),
+        ],
+    ).start()
+    yield path, harness
+    harness.stop()
+
+
+@pytest.mark.slow
+def test_session_survives_dropped_stream_open(chaos_swarm):
+    """A dropped ptu.inference stream open must cost a retry, not the
+    session: the client bans/retries and the tokens come out identical."""
+    from petals_tpu.client.model import AutoDistributedModelForCausalLM
+    from tests.test_full_model import _hf_greedy
+
+    path, harness = chaos_swarm
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers, min_backoff=0.1
+    )
+    try:
+        rng = np.random.RandomState(3)
+        input_ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+        expected = _hf_greedy(path, input_ids, 4)
+
+        plane = chaos.configure(
+            seed=11,
+            rules=[ChaosRule(chaos.SITE_RPC_STREAM, "drop", max_count=1)],
+        )
+        out = model.generate(input_ids, max_new_tokens=4)
+        np.testing.assert_array_equal(out, expected)
+        assert len(plane.fired(chaos.SITE_RPC_STREAM)) == 1, "the fault must fire"
+    finally:
+        model.close()
+
+
+@pytest.mark.slow
+def test_session_survives_mid_step_failure(chaos_swarm):
+    """An injected failure at the handler's step boundary mid-generation
+    kills the stream; repair (re-route + seed or replay) must finish the
+    session with token output identical to the unperturbed run."""
+    from petals_tpu.client.model import AutoDistributedModelForCausalLM
+    from tests.test_full_model import _hf_greedy
+
+    path, harness = chaos_swarm
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers, min_backoff=0.1
+    )
+    try:
+        rng = np.random.RandomState(4)
+        input_ids = rng.randint(0, 100, (1, 6)).astype(np.int64)
+        expected = _hf_greedy(path, input_ids, 6)
+
+        with model.remote.inference_session(max_length=16, batch_size=1) as session:
+            first = model.generate(input_ids, max_new_tokens=3, session=session)
+            np.testing.assert_array_equal(first, expected[:, : input_ids.shape[1] + 3])
+
+            plane = chaos.configure(
+                seed=12,
+                rules=[ChaosRule(chaos.SITE_HANDLER_STEP, "drop", max_count=1)],
+            )
+            final = model.generate(first, max_new_tokens=3, session=session)
+        np.testing.assert_array_equal(final, expected)
+        assert len(plane.fired(chaos.SITE_HANDLER_STEP)) == 1, "the fault must fire"
+    finally:
+        model.close()

@@ -86,23 +86,25 @@ def redundant_swarm(tmp_path_factory):
     harness.stop()
 
 
-def test_drain_migrates_kv(redundant_swarm, monkeypatch):
-    """A drained server fails further steps but serves its parked KV; the
-    client imports it into the replacement and does NOT replay history."""
-    path, harness = redundant_swarm
-    model = AutoDistributedModelForCausalLM.from_pretrained(
-        path, initial_peers=harness.initial_peers, min_backoff=0.1
-    )
-    migrations = []
-    real_seed = InferenceSession._seed_by_import
+def _spy_repair_paths(monkeypatch):
+    """Instrument every KV-seeding path repair can take; returns the logs."""
+    adopts, imports, replays = [], [], []
+    real_adopt = InferenceSession._seed_by_adopt
 
-    async def spy_seed(self, session, exported, replay_steps):
-        ok = await real_seed(self, session, exported, replay_steps)
-        migrations.append(ok)
+    async def spy_adopt(self, session, source_session_id, export_pos, replay_steps):
+        ok = await real_adopt(self, session, source_session_id, export_pos, replay_steps)
+        adopts.append(ok)
         return ok
 
-    monkeypatch.setattr(InferenceSession, "_seed_by_import", spy_seed)
-    replays = []
+    monkeypatch.setattr(InferenceSession, "_seed_by_adopt", spy_adopt)
+    real_import = InferenceSession._seed_by_import
+
+    async def spy_import(self, session, exported, replay_steps):
+        ok = await real_import(self, session, exported, replay_steps)
+        imports.append(ok)
+        return ok
+
+    monkeypatch.setattr(InferenceSession, "_seed_by_import", spy_import)
     real_replay = InferenceSession._replay_step
 
     async def spy_replay(self, session, chunk, hypo_step, step_id):
@@ -110,6 +112,13 @@ def test_drain_migrates_kv(redundant_swarm, monkeypatch):
         return await real_replay(self, session, chunk, hypo_step, step_id)
 
     monkeypatch.setattr(InferenceSession, "_replay_step", spy_replay)
+    return adopts, imports, replays
+
+
+def _run_drain_scenario(path, harness, adopts, imports, replays, *, migrate):
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers, min_backoff=0.1
+    )
     try:
         rng = np.random.RandomState(1)
         input_ids = rng.randint(0, 100, (1, 6)).astype(np.int64)
@@ -121,17 +130,38 @@ def test_drain_migrates_kv(redundant_swarm, monkeypatch):
 
             fast = harness.servers[0]
             assert session._session._sessions[0].span.peer_id == fast.dht.peer_id
-            parked = harness.run(fast.drain())
+            parked = harness.run(fast.drain(migrate=migrate))
             assert parked == 1
 
             final = model.generate(first, max_new_tokens=3, session=session)
         np.testing.assert_array_equal(final, expected)
-        assert migrations == [True], "repair must seed the replacement by KV import"
-        assert replays == [], "no history replay when the full cache migrated"
+        assert replays == [], "no history replay when the full cache moved"
     finally:
         model.close()
         harness.run(harness.servers[0].shutdown())
         harness.servers.pop(0)  # stop() must not shut the same server twice
+
+
+def test_drain_migrates_kv_p2p(redundant_swarm, monkeypatch):
+    """Default drain pushes parked KV server-to-server; the client follows the
+    redirect and adopts the cache in place — no KV bytes over the client link,
+    no history replay."""
+    path, harness = redundant_swarm
+    adopts, imports, replays = _spy_repair_paths(monkeypatch)
+    _run_drain_scenario(path, harness, adopts, imports, replays, migrate=True)
+    assert adopts == [True], "repair must adopt the migrated KV at the destination"
+    assert imports == [], "no client-link KV import when the server pushed p2p"
+
+
+def test_drain_migrates_kv_export_import(redundant_swarm, monkeypatch):
+    """drain(migrate=False) keeps the pre-p2p behavior: the drained server
+    serves its parked KV over the client link and the client imports it into
+    the replacement without replaying history."""
+    path, harness = redundant_swarm
+    adopts, imports, replays = _spy_repair_paths(monkeypatch)
+    _run_drain_scenario(path, harness, adopts, imports, replays, migrate=False)
+    assert imports == [True], "repair must seed the replacement by KV import"
+    assert adopts == [], "no adopt path without a migration redirect"
 
 
 def test_export_rejects_unknown_and_bad_imports(redundant_swarm):

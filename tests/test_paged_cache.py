@@ -331,6 +331,73 @@ def test_cow_fork_on_shared_pages(model_path):
     run(main())
 
 
+def test_dead_lane_release_keeps_shared_pages(model_path):
+    """Failover hygiene: a dying session's lane release (the server-side
+    teardown a kill/drain triggers) must only drop ITS OWN share of
+    COW-shared prefix pages — survivors adopted onto the same pages keep
+    their content, and the page is not handed back to the pool while any
+    survivor references it."""
+
+    async def main():
+        server, client = await _start_server(
+            model_path, batching=True, batch_lanes=3, batch_max_length=32,
+            page_size=8, n_pages=8,
+        )
+        try:
+            batcher = server.handler.batcher
+            dying = await batcher.acquire_lane(timeout=5)
+            await batcher.prepare_write(dying, 0, 8)
+            page0 = int(batcher._tables[dying, 0])
+            k_pool, v_pool = batcher._buffers()
+            k_pool = k_pool.at[:, page0].set(2.5)  # the shared prefix content
+            batcher._update(k_pool, v_pool)
+
+            # two survivors share the dying session's prefix page (the
+            # prefix-cache pin holds one ref, each adoption one more)
+            epoch = batcher.page_epoch
+            pinned = batcher.pin_lane_pages(dying, 0, 8)
+            assert pinned == [page0]
+            survivors = []
+            for _ in range(2):
+                lane = await batcher.acquire_lane(timeout=5)
+                batcher.adopt_pages(lane, pinned)
+                survivors.append(lane)
+            assert int(batcher._pages.refs[page0]) == 4
+
+            # the session dies: its lane is torn down (failover path)
+            batcher.release_lane(dying)
+            assert int(batcher._pages.refs[page0]) == 3, (
+                "a dead lane must only drop its own share of a COW page"
+            )
+
+            # the page must NOT be allocatable out from under the survivors:
+            # exhaust the pool and verify page0 was never handed out
+            grabbed = []
+            while (p := batcher._pages.try_alloc()) is not None:
+                grabbed.append(p)
+            assert page0 not in grabbed
+            for p in grabbed:
+                batcher._pages.decref(p)
+
+            # survivors still read the shared prefix content intact
+            k_pool, _ = batcher._buffers()
+            for lane in survivors:
+                assert int(batcher._tables[lane, 0]) == page0
+            assert float(np.asarray(k_pool[:, page0]).min()) == 2.5
+
+            # full teardown returns every page: nothing leaked, nothing
+            # double-freed by the dead lane
+            for lane in survivors:
+                batcher.release_lane(lane)
+            batcher.unpin_pages(pinned, epoch)
+            assert batcher._pages.n_free == batcher.n_pages
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    run(main())
+
+
 # ------------------------------------------------- end-to-end paged sessions
 
 
