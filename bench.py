@@ -1376,7 +1376,13 @@ def bench_gate_decode(page_size, label, *, lanes=2, steps=40):
             n_lanes=lanes, max_length=128, page_size=page_size,
         )
         try:
-            lane_ids = [await batcher.acquire_lane() for _ in range(lanes)]
+            # distinct peer ids per lane so the resource ledger attributes
+            # page-seconds per tenant — the conservation check below is what
+            # makes metering regressions fail ``--gate``
+            lane_ids = [
+                await batcher.acquire_lane(peer_id=f"{label}-peer-{i}")
+                for i in range(lanes)
+            ]
             pos = 0
             if page_size:  # paged pool: prefill rides the mixed step
                 for lane in lane_ids:
@@ -1405,6 +1411,32 @@ def bench_gate_decode(page_size, label, *, lanes=2, steps=40):
 
             step_fn = "paged_decode" if page_size else "batched_decode"
             roofline = get_observatory().roofline(step_fn, wall / steps)
+            # attribution conservation: per-session page-seconds (plus the
+            # unattributed remainder) must equal the pool occupancy integral.
+            # A metering regression here fails the row, and therefore --gate.
+            from petals_tpu.telemetry.ledger import get_ledger
+
+            ledger = get_ledger()
+            snap = ledger.snapshot(k=lanes)
+            if page_size:
+                attributed = ledger.attributed_page_seconds()
+                pool_s = snap["pool_page_seconds"]
+                drift = abs(attributed + snap["unattributed_page_seconds"] - pool_s)
+                assert drift <= 0.05 * pool_s + 1e-3, (
+                    f"ledger attribution leak: attributed={attributed:.6f} "
+                    f"unattributed={snap['unattributed_page_seconds']:.6f} "
+                    f"pool={pool_s:.6f}"
+                )
+            # token conservation holds on BOTH pools: every decode tick bills
+            # exactly one token per lane (3 warmup ticks included)
+            billed = sum(
+                t.get("decode_tokens", 0)
+                for peer, t in ledger.peer_totals().items()
+                if peer.startswith(f"{label}-peer-")
+            )
+            assert billed == lanes * (steps + 3), (
+                f"ledger token leak: billed {billed}, ran {lanes * (steps + 3)}"
+            )
             return {
                 "label": label,
                 "lanes": lanes,
@@ -1412,6 +1444,7 @@ def bench_gate_decode(page_size, label, *, lanes=2, steps=40):
                 "wall_s": round(wall, 3),
                 "step_ms": round(1000.0 * wall / steps, 3),
                 "roofline": roofline,
+                "ledger": _ledger_blob(),
             }
         finally:
             await batcher.close()
@@ -1456,6 +1489,33 @@ def _telemetry_counters() -> dict:
         "compile_anomalies": sum(
             c.value for _v, c in tm.COMPILE_ANOMALIES.children()
         ),
+    }
+
+
+def _ledger_blob() -> dict:
+    """Ledger efficiency summary for a bench row: useful work per unit of
+    HBM residency (tokens per page-second) and how evenly the row's tenants
+    split the pool (per-peer share spread). Process-cumulative, like the
+    step histograms — heavy rows run in fresh subprocesses."""
+    from petals_tpu.telemetry.ledger import get_ledger
+
+    ledger = get_ledger()
+    snap = ledger.snapshot(k=5)
+    totals = ledger.peer_totals()
+    tokens = sum(
+        t.get("prefill_tokens", 0) + t.get("decode_tokens", 0)
+        for t in totals.values()
+    )
+    page_s = snap["pool_page_seconds"]
+    shares = [t["share"] for t in snap["top"]]
+    return {
+        "page_s": page_s,
+        "unattributed_page_s": snap["unattributed_page_seconds"],
+        "tokens_billed": int(tokens),
+        "tokens_per_page_s": round(tokens / page_s, 2) if page_s > 1e-9 else None,
+        "share_spread": round(max(shares) - min(shares), 4) if shares else None,
+        "peers": snap["peers"],
+        "noisy_events": snap["noisy_events"],
     }
 
 

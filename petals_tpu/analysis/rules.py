@@ -688,13 +688,21 @@ TAINTED_LABEL_NAMES = {
 
 def _label_value_names(node: ast.AST) -> Iterator[str]:
     """Identifier-ish names reachable from one labels() argument value:
-    bare names, attribute tails (``slot.peer_id`` -> ``peer_id``), and both
-    of either's appearances inside f-strings / str() / formatting calls."""
+    bare names, attribute tails (``slot.peer_id`` -> ``peer_id``), string-
+    constant subscript keys (``entry["peer_id"]`` -> ``peer_id`` — how the
+    ledger's per-peer dicts are keyed), and any of these inside f-strings /
+    str() / formatting calls."""
     for sub in ast.walk(node):
         if isinstance(sub, ast.Name):
             yield sub.id
         elif isinstance(sub, ast.Attribute):
             yield sub.attr
+        elif (
+            isinstance(sub, ast.Subscript)
+            and isinstance(sub.slice, ast.Constant)
+            and isinstance(sub.slice.value, str)
+        ):
+            yield sub.slice.value
 
 
 def rule_no_unbounded_metric_labels(tree, source_lines, path) -> Findings:

@@ -7,6 +7,11 @@ aggregate), /api/v1/is_reachable/<peer>.
 (``InferenceSession.trace_report()`` dumped as JSON, or a flight-recorder
 entry containing one under ``waterfall``) as an ASCII per-hop latency
 waterfall and exits — no swarm connection needed.
+
+``--top`` joins the swarm, takes one snapshot, and renders the swarm-wide
+top resource consumers (per-tenant page-seconds and dominant-resource
+share, merged across every server's announced ledger digest) as an ASCII
+table, then exits — the ledger analogue of ``top(1)``.
 """
 
 from __future__ import annotations
@@ -33,12 +38,43 @@ def render_waterfall_file(path: str) -> str:
     return format_waterfall(report)
 
 
+def render_top(summary: dict) -> str:
+    """Render a ``metrics_summary()`` dict as the swarm-wide top-consumers
+    table: one section per model, tenants ranked by page-seconds."""
+    lines = []
+    for prefix, model in (summary.get("models") or {}).items():
+        agg = model.get("aggregate") or {}
+        lines.append(
+            f"{prefix}: {agg.get('ledger_sessions', 0)} sessions, "
+            f"{agg.get('ledger_page_s', 0.0):.1f} page-s, "
+            f"{agg.get('ledger_compute_s', 0.0):.1f} compute-s, "
+            f"{agg.get('noisy_neighbor_events', 0)} noisy-neighbor events"
+        )
+        rows = agg.get("top_consumers") or []
+        if not rows:
+            lines.append("  (no ledger digests announced yet)")
+            continue
+        lines.append(f"  {'peer':<18} {'page-s':>10} {'share':>7} {'servers':>8}")
+        for row in rows:
+            lines.append(
+                f"  {str(row.get('peer', '?')):<18} {row.get('page_s', 0.0):>10.2f} "
+                f"{row.get('share_max', 0.0):>7.2f} {row.get('servers', 0):>8}"
+            )
+    return "\n".join(lines) if lines else "(no models announced)"
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description="Swarm health monitor")
     parser.add_argument(
         "--waterfall",
         metavar="TRACE.json",
         help="render a saved trace report as an ASCII waterfall and exit",
+    )
+    parser.add_argument(
+        "--top",
+        action="store_true",
+        help="take one swarm snapshot, print the top resource consumers "
+        "(per-tenant page-seconds from the servers' ledger digests), and exit",
     )
     parser.add_argument("--initial_peers", nargs="+")
     parser.add_argument("--host", default="0.0.0.0")
@@ -53,6 +89,26 @@ def main(argv=None) -> None:
         parser.error("--initial_peers is required (unless using --waterfall)")
 
     from petals_tpu.utils.health import HealthMonitor
+
+    if args.top:
+        async def run_top():
+            monitor = HealthMonitor(
+                args.initial_peers, host=args.host, port=0,
+                update_period=args.update_period,
+            )
+            from petals_tpu.dht import DHTNode
+
+            monitor.dht = await DHTNode.create(
+                initial_peers=args.initial_peers, client_mode=True
+            )
+            try:
+                await monitor.refresh()
+                print(render_top(monitor.metrics_summary()), flush=True)
+            finally:
+                await monitor.dht.shutdown()
+
+        asyncio.run(run_top())
+        return
 
     async def run():
         monitor = HealthMonitor(

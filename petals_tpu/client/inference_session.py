@@ -430,6 +430,31 @@ class InferenceSession:
             retired_hops=len(self._retired_hops),
         )
 
+    def usage_report(self) -> dict:
+        """The session's resource bill so far, as metered by the servers'
+        per-tenant ledgers: each hop's ``step_meta["usage"]`` deltas
+        (page-seconds, compute-seconds, prefill/decode tokens, swap and
+        migration bytes) summed per peer and in total. Covers retired hops,
+        so a bill after a repair still includes the dead server's charges."""
+        hops = list(self._retired_hops) + [
+            s.hop for s in self._sessions if not s.closed
+        ]
+        per_peer: dict = {}
+        total: dict = {}
+        for hop in hops:
+            if not hop.usage:
+                continue
+            peer = per_peer.setdefault(str(hop.peer), {})
+            for field, amount in hop.usage.items():
+                peer[field] = round(peer.get(field, 0.0) + amount, 6)
+                total[field] = round(total.get(field, 0.0) + amount, 6)
+        return {
+            "trace_id": self.trace_id,
+            "tokens": self._tokens,
+            "total": total,
+            "peers": per_peer,
+        }
+
     def _retire_hops(self, sessions) -> None:
         """Keep closing sessions' hop traces (bounded) so reports after a
         repair/migration still account for time spent on the old servers."""

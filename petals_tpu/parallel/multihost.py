@@ -342,7 +342,7 @@ class LockstepBackend(_LockstepMixin):
     # ------------------------------------------------------------- compute ops
 
     def inference_step(self, hidden, kv, position, *, prompts=None, hypo_ids=None,
-                       active_adapter=None, handles=None):
+                       active_adapter=None, handles=None, n_total=None):
         self._check_live()
         adapter_code = self._adapter_code(active_adapter)
         batch, seq, _ = hidden.shape
@@ -353,8 +353,11 @@ class LockstepBackend(_LockstepMixin):
         mirror = -1 if handles is None else int(handles[0])
         b0, b1 = self._span
         with _BCAST_LOCK, _degrade_on_failure():
+            # ``n_total`` rides the otherwise-unused n_valid header slot so every
+            # follower picks the same LongRoPE scaling branch as the leader.
             _bcast_header([
-                OP_INFERENCE_STEP, mirror, batch, seq, int(position), -1, flags,
+                OP_INFERENCE_STEP, mirror, batch, seq, int(position),
+                -1 if n_total is None else int(n_total), flags,
                 pre_seq, adapter_code, b0, b1,
                 _adapter_digest(self._backend.adapters) if adapter_code else 0,
             ])
@@ -369,7 +372,7 @@ class LockstepBackend(_LockstepMixin):
                 hypo_ids = _bcast_array(hypo_ids, (batch,), np.int64)
             out, new_kv = self._backend.inference_step(
                 hidden, kv, position, prompts=prompts, hypo_ids=hypo_ids,
-                active_adapter=active_adapter,
+                active_adapter=active_adapter, n_total=n_total,
             )
             return self._replicate(out), new_kv
 
@@ -773,6 +776,7 @@ class LockstepWorker:
                 out, new_kv = backend.inference_step(
                     hidden, kv, position, prompts=prompts, hypo_ids=hypo_ids,
                     active_adapter=adapter,
+                    n_total=None if _n_valid < 0 else int(_n_valid),
                 )
                 self._kv[mirror] = new_kv
                 self._replicate(out)

@@ -1247,8 +1247,16 @@ class TransformerBackend:
         hypo_ids: Optional[np.ndarray] = None,  # [batch]
         active_adapter: Optional[str] = None,
         handles=None,  # session identity for the multi-host lockstep wrapper; unused here
+        n_total: Optional[int] = None,  # final sequence length override (chunked callers)
     ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
-        """One (chunked-as-needed) inference step over the whole span chain."""
+        """One (chunked-as-needed) inference step over the whole span chain.
+
+        ``n_total`` lets a caller that ALREADY chunked the prompt (the
+        batcher's dense-prefill path submits one inference_step per chunk)
+        declare the full final sequence length, so length-dependent rotary
+        variants (LongRoPE short/long factor selection) see the same n_total
+        in every chunk instead of flipping factors mid-prompt. Defaults to
+        position + seq — exact for unchunked callers."""
         k_stack, v_stack = kv
         max_length = k_stack.shape[2]
         batch, total_seq, _ = hidden.shape
@@ -1256,6 +1264,11 @@ class TransformerBackend:
             raise ValueError(
                 f"Step of {total_seq} tokens at position {position} overflows the "
                 f"allocated cache ({max_length} tokens)"
+            )
+        if n_total is not None and n_total < position + total_seq:
+            raise ValueError(
+                f"n_total={n_total} is shorter than this step's own end "
+                f"({position} + {total_seq})"
             )
 
         # keep hidden host-side (numpy): each chunk ships inside its step's ONE
@@ -1270,7 +1283,8 @@ class TransformerBackend:
         # it through so longrope (phi3) selects rotary factors from it in
         # EVERY chunk — a chunked prefill then matches HF's single full
         # forward instead of flipping factors mid-prompt.
-        n_total = position + total_seq
+        if n_total is None:
+            n_total = position + total_seq
         for chunk_len in self.chunk_plan(batch, total_seq, kv_buf_len=max_length):
             chunk = hidden[:, offset : offset + chunk_len]
             out, k_stack, v_stack = self._step_once(

@@ -153,6 +153,7 @@ class DecodeBatcher:
         prefill_token_budget: int = 512,  # max prefill-chunk tokens per mixed step
         swap_host_bytes: int = 0,  # host-RAM KV swap tier; 0 -> no preemption
         preemption_policy: str = "lru",  # lru | largest | off
+        ledger=None,  # telemetry.ledger.ResourceLedger; None -> process singleton
     ):
         self.backend = backend
         self.memory_cache = memory_cache
@@ -225,8 +226,20 @@ class DecodeBatcher:
         # swap_host_bytes=0 no lane ever suspends and a full pool keeps the
         # exact waiter-backpressure/AllocationFailed behavior of PR 2.
         self.swap_pool = HostSwapPool(int(swap_host_bytes or 0))
+        # per-tenant resource ledger (telemetry.ledger): page-seconds with
+        # fractional COW attribution, compute-seconds, tokens, swap bytes —
+        # settled at the same boundaries where _note_occupancy runs. Its
+        # dominant-resource share feeds the scheduler's fair-share admission
+        # and victim tie-breaks in place of the raw lanes-held count.
+        if ledger is None:
+            from petals_tpu.telemetry.ledger import get_ledger
+
+            ledger = get_ledger()
+        self._ledger = ledger
+        self._ledger_keys: Dict[int, str] = {}  # lane -> ledger session key
         self._scheduler = SessionScheduler(
-            self.swap_pool, policy=preemption_policy, pages_fn=self._lane_pages
+            self.swap_pool, policy=preemption_policy, pages_fn=self._lane_pages,
+            usage_fn=ledger.peer_dominant_share,
         )
         # per-lane asyncio locks serializing swap-out against swap-in, and an
         # in-flight op counter making lanes with ANY active work unpreemptable
@@ -373,6 +386,9 @@ class DecodeBatcher:
             timeout=timeout, priority=priority, peer_id=peer_id, trace_id=trace_id
         )
         self._scheduler.register(lane, peer_id, int(priority), trace_id=trace_id)
+        # ledger session opens at admission, before the first page claim, so
+        # every page-second of this lane's residency lands on its bill
+        self._ledger_keys[lane] = self._ledger.open_session(peer_id, trace_id)
         if self.page_size is not None:
             try:
                 await self.prepare_write(lane, 0, 1, timeout=timeout)
@@ -468,6 +484,10 @@ class DecodeBatcher:
         # here, and a swap-out racing this release aborts on its post-gather
         # validation (the slot object it captured is no longer registered)
         self._scheduler.unregister(lane)
+        # settle and close the tenant's bill; totals fold into the peer rollup
+        key = self._ledger_keys.pop(lane, None)
+        if key is not None:
+            self._ledger.close_session(key)
         # paged mode: drop this lane's table references — pages whose refcount
         # hits zero (no prefix-cache pin) return to the pool and wake any
         # prepare_write waiters blocked on an exhausted pool
@@ -528,6 +548,7 @@ class DecodeBatcher:
             if self.n_pages == self.n_lanes * self.max_pages else None
         )
         deadline = None if timeout is None else time.monotonic() + timeout
+        pages_changed = False
         for slot in range(t0 // self.page_size, (t1 - 1) // self.page_size + 1):
             cur = int(self._tables[lane, slot])
             if cur >= 0 and alloc.refs[cur] == 1:
@@ -584,6 +605,12 @@ class DecodeBatcher:
                     alloc.decref(page)  # never reached the table: hand it back
                 raise
             self._tables[lane, slot] = page
+            pages_changed = True
+        if pages_changed:
+            # attribution rates changed (a grow or a COW fork): settle the
+            # ledger here, not on the next admission boundary — page-seconds
+            # accrued under the old rates up to this instant
+            self._ledger_sync()
 
     def _copy_page(self, src: int, dst: int) -> None:
         """Compute-thread body: device copy of one page (all blocks) — the
@@ -618,6 +645,7 @@ class DecodeBatcher:
         for page in pages:
             # swarmlint: disable=paired-refcount — ownership transfer: the refs belong to the caller (prefix cache), released via unpin_pages; no code below this loop can raise
             self._pages.incref(page)
+        self._ledger_sync()  # refcounts moved: the lane's fractional share shrank
         return pages
 
     def unpin_pages(self, pages: Sequence[int], epoch: int) -> None:
@@ -628,6 +656,7 @@ class DecodeBatcher:
             return
         for page in pages:
             self._pages.decref(int(page))
+        self._ledger_sync()  # pins released: surviving holders' shares grew
 
     def adopt_pages(self, lane: int, pages: Sequence[int]) -> None:
         """Point ``lane``'s first len(pages) table slots at already-resident
@@ -645,6 +674,7 @@ class DecodeBatcher:
             row[slot] = int(page)
         if pages:
             tm.PREFIX_ADOPT.inc()
+            self._ledger_sync()  # the lane now shares the prefix pages' refcounts
 
     def paged_summary(self) -> Optional[dict]:
         """Observability: pool occupancy + allocator counters (rpc_info)."""
@@ -839,6 +869,9 @@ class DecodeBatcher:
             sched.stats["swap_outs"] += 1
             tm.PREEMPTIONS.inc()
             tm.SWAP_OUT_BYTES.inc(nbytes)
+            key = self._ledger_keys.get(lane)
+            if key is not None:
+                self._ledger.note_swap(key, out_bytes=nbytes)
             self._journal.event(
                 "swap_out", trace_id=slot.trace_id, lane=lane,
                 occupancy=self.occupancy_info(),
@@ -910,6 +943,9 @@ class DecodeBatcher:
         self.swap_pool.free(entry.nbytes)
         sched.stats["swap_ins"] += 1
         tm.SWAP_IN_BYTES.inc(entry.nbytes)
+        key = self._ledger_keys.get(lane)
+        if key is not None:
+            self._ledger.note_swap(key, in_bytes=entry.nbytes)
         self._journal.event(
             "swap_in", trace_id=slot.trace_id, lane=lane,
             occupancy=self.occupancy_info(),
@@ -1007,6 +1043,59 @@ class DecodeBatcher:
             # effectively unbounded and would read as 2**64 headroom)
             tm.HBM_HEADROOM.set(mc.bytes_left)
         tm.SWAP_RESIDENCY_OLDEST.set(self._scheduler.oldest_swap_age())
+        # the same boundaries are the ledger's settlement points: push a
+        # fresh attribution-rate snapshot, then give the noisy-neighbor
+        # detector a look while the admission queue state is current
+        self._ledger_sync()
+        if self._lane_waiters:
+            self._ledger_check_noisy()
+
+    def _ledger_sync(self) -> None:
+        """Settle the resource ledger and install the new piecewise-constant
+        rates: each session's fractional page holding (1/refcount per
+        referenced page — prefix-cache pins absorb the remainder) plus the
+        pool occupancy whose integral the per-session split must sum to.
+        Called wherever block tables or refcounts change; O(lanes x
+        max_pages) vectorized, never on the per-token decode path."""
+        weights: Dict[str, float] = {}
+        occupied = 0.0
+        if (
+            self.page_size is not None
+            and self._pages is not None
+            and self._tables is not None
+        ):
+            occupied = float(self.n_pages - self._pages.n_free)
+            if self._ledger_keys:
+                lanes = list(self._ledger_keys)
+                shares = self._pages.fractional_shares(self._tables[lanes])
+                weights = {
+                    self._ledger_keys[lane]: float(s)
+                    for lane, s in zip(lanes, shares)
+                }
+        self._ledger.set_rates(weights, occupied)
+
+    def _ledger_check_noisy(self) -> None:
+        """Ask the DRF detector whether one peer's dominant-resource share
+        is starving the admission queue; journal the evidence when it fires
+        (the counter bump + flight-recorder entry happen inside the ledger)."""
+        evidence = self._ledger.check_noisy(
+            [w.peer_id for w in self._lane_waiters if not w.fut.done()]
+        )
+        if evidence is not None:
+            self._journal.event(
+                "noisy_neighbor", occupancy=self.occupancy_info(), **evidence
+            )
+
+    def pop_usage_delta(self, lane: int) -> Optional[dict]:
+        """Per-session resource usage since the last call — the tenant's own
+        bill, piggybacked on step_meta so InferenceSession.usage_report()
+        can aggregate it client-side. None for unmetered (dense/private)
+        lanes or an empty delta."""
+        key = self._ledger_keys.get(lane)
+        if key is None:
+            return None
+        delta = self._ledger.usage_delta(key)
+        return delta or None
 
     def _occupancy(self) -> str:
         """Human-readable pool occupancy for AllocationFailed messages: lane
@@ -1510,6 +1599,9 @@ class DecodeBatcher:
             tm.STEPS_DENSE.inc()
         tm.DECODE_TOKENS.inc(len(batch))
         self._record_decode_timing(batch, t_step, duration)
+        self._ledger_account_step(
+            duration, decode_lanes=[entry[0] for entry in batch]
+        )
         return host_out
 
     def _record_decode_timing(self, batch, t_step: float, duration: float) -> None:
@@ -1524,6 +1616,33 @@ class DecodeBatcher:
                 "compute_s": duration,
                 "variant": variant,
             }
+
+    def _ledger_account_step(
+        self, duration: float, *, decode_lanes=(), gen_lanes=(), prefill=None
+    ) -> None:
+        """Ledger attribution of one batched tick (compute thread): the
+        step's wall time splits EQUALLY across the lanes that rode it — the
+        whole-step wall that step_meta reports per lane would multiply-count
+        shared compute — plus one decode token per decode/gen lane and the
+        prefill chunk's token count. ``prefill`` is (lane, take)."""
+        keys = []
+        for lane in decode_lanes:
+            key = self._ledger_keys.get(lane)
+            if key is not None:
+                keys.append(key)
+                self._ledger.note_tokens(key, decode=1)
+        for lane in gen_lanes:
+            key = self._ledger_keys.get(lane)
+            if key is not None:
+                keys.append(key)
+                self._ledger.note_tokens(key, decode=1)
+        if prefill is not None:
+            lane, take = prefill
+            key = self._ledger_keys.get(lane)
+            if key is not None:
+                keys.append(key)
+                self._ledger.note_tokens(key, prefill=int(take))
+        self._ledger.note_compute(keys, duration)
 
     def _run_batch_mixed(self, batch, pf) -> Tuple[np.ndarray, np.ndarray]:
         """Compute-thread body: ONE jitted step advancing every pending
@@ -1569,6 +1688,11 @@ class DecodeBatcher:
         tm.STEPS_MIXED.inc()
         tm.DECODE_TOKENS.inc(len(batch))
         self._record_decode_timing(batch, t_step, duration)
+        self._ledger_account_step(
+            duration,
+            decode_lanes=[entry[0] for entry in batch],
+            prefill=(st.lane, take),
+        )
         st.compute_s += duration  # whole-prefill compute accumulates per chunk
         return host_out, host_chunk
 
@@ -1642,6 +1766,11 @@ class DecodeBatcher:
         tm.STEPS_GEN.inc()
         tm.DECODE_TOKENS.inc(len(batch) + len(gen_states))
         self._record_decode_timing(batch, t_step, duration)
+        self._ledger_account_step(
+            duration,
+            decode_lanes=[entry[0] for entry in batch],
+            gen_lanes=list(gen_states),
+        )
         for st in gen_states.values():
             if not st.started:
                 st.started = True
@@ -1743,10 +1872,19 @@ class DecodeBatcher:
             self._check_lane(lane)
             if self.page_size is not None and write_range is not None:
                 await self.prepare_write(lane, int(write_range[0]), int(write_range[1]))
+            # exclusive ops run alone on the device: their whole wall bills
+            # to this one tenant, and a declared write range is prompt
+            # tokens landing in its cache (dense-prefill / kv-import path)
+            ledger_key = self._ledger_keys.get(lane)
+            if ledger_key is not None and write_range is not None:
+                self._ledger.note_tokens(
+                    ledger_key, prefill=int(write_range[1]) - int(write_range[0])
+                )
 
             def run():
                 self._check_lane(lane)  # re-check: a reset may have raced the queue
                 temp = self._new_temp()
+                t_run = time.perf_counter()
                 try:
                     kv_lane = self._extract_lane(lane, temp) if extract else None
                     result, kv_lane = fn(kv_lane, temp)
@@ -1754,6 +1892,10 @@ class DecodeBatcher:
                 except BaseException:
                     self._release_temp(temp)
                     raise
+                if ledger_key is not None:
+                    self._ledger.note_compute(
+                        [ledger_key], time.perf_counter() - t_run
+                    )
                 return result
 
             try:
@@ -1791,6 +1933,13 @@ class DecodeBatcher:
         self._check_lane(lane)
         if self.page_size is not None and write_range is not None:
             await self.prepare_write(lane, int(write_range[0]), int(write_range[1]))
+        ledger_key = self._ledger_keys.get(lane)
+        if ledger_key is not None and write_range is not None:
+            # bill the whole declared prompt span once, up front (the chunks
+            # below and the single-chunk delegation never re-declare it)
+            self._ledger.note_tokens(
+                ledger_key, prefill=int(write_range[1]) - int(write_range[0])
+            )
         if len(chunk_fns) == 1:
             # short prefills skip the extract/insert round-trips
             return [await self.run_exclusive(lane, chunk_fns[0], size=size)]
@@ -1817,8 +1966,13 @@ class DecodeBatcher:
             for fn in chunk_fns:
                 def run_chunk(fn=fn):
                     self._check_lane(lane)
+                    t_run = time.perf_counter()
                     res, state["kv"] = fn(state["kv"], state["temp"])
                     self.stats["exclusive_chunks"] += 1
+                    if ledger_key is not None:
+                        self._ledger.note_compute(
+                            [ledger_key], time.perf_counter() - t_run
+                        )
                     return res
 
                 try:

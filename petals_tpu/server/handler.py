@@ -436,6 +436,11 @@ class TransformerHandler:
         self._parked.pop(session_id, None)
         tm.MIGRATIONS.labels(direction="out", outcome="ok").inc()
         tm.MIGRATION_BYTES.labels(direction="out").inc(nbytes)
+        # the session was parked (its lane — and ledger session — already
+        # closed), so the push bills straight to the owning peer's rollup
+        from petals_tpu.telemetry.ledger import get_ledger
+
+        get_ledger().note_migrated(None, nbytes, peer_id=snap.get("peer"))
         get_journal().event(
             "migrate_out", trace_id=trace_id,
             occupancy=self.batcher.occupancy_info() if self.batcher is not None else None,
@@ -588,6 +593,12 @@ class TransformerHandler:
         # entry for a retry or an export until its TTL says otherwise
         self._consume_migrated(src_sid)
         self._parked.pop(src_sid, None)
+        if lane is not None and batcher is not None:
+            # migrated-in KV becomes this tenant's working set: bill the
+            # adopted bytes to the lane's live ledger session
+            key = batcher._ledger_keys.get(lane)
+            if key is not None:
+                batcher._ledger.note_migrated(key, int(k_arr.nbytes + v_arr.nbytes))
         from petals_tpu.telemetry import get_journal
 
         get_journal().event(
@@ -917,6 +928,7 @@ class TransformerHandler:
                 continue
             snap["expires"] = time.monotonic() + ttl
             snap["trace_id"] = reg.get("trace_id")
+            snap["peer"] = reg.get("peer")  # ledger attribution of a later push
             self._parked[session_id] = snap
             parked += 1
         return parked
@@ -1169,6 +1181,18 @@ class TransformerHandler:
         # close() fails it loudly into the failover path).
         lane: Optional[int] = None
         batcher = self.batcher
+        # the peer this session bills to (fair-share admission + the resource
+        # ledger). A PROVEN identity (rpc identity handshake) always wins;
+        # without one, an UNAUTHENTICATED self-declared "peer_hint" from the
+        # open message partitions the accounting view — a liar can only make
+        # itself LOOK like several peers, exactly what an anonymous transport
+        # already allows — and absent both, the session bills anonymously.
+        peer = getattr(ctx, "remote_peer_id", None)
+        if peer is not None:
+            peer_str: Optional[str] = peer.to_string()
+        else:
+            hint = open_msg.get("peer_hint")
+            peer_str = str(hint)[:64] if hint else None
         if (
             batcher is not None
             and batch_size == 1
@@ -1183,14 +1207,13 @@ class TransformerHandler:
             alloc_timeout = open_msg.get("alloc_timeout")
             # optional client priority hint ("high"/"normal"/"low" or an int
             # class); absent -> normal, i.e. exactly the pre-hint behavior.
-            # The authenticated peer id feeds per-peer fair-share admission.
+            # The peer id feeds per-peer fair-share admission and the ledger.
             priority = parse_session_priority(open_msg.get("priority"))
-            peer = getattr(ctx, "remote_peer_id", None)
             try:
                 lane = await batcher.acquire_lane(
                     timeout=30.0 if alloc_timeout is None else alloc_timeout,
                     priority=priority,
-                    peer_id=peer.to_string() if peer is not None else None,
+                    peer_id=peer_str,
                     trace_id=trace_id,
                 )
             except AllocationFailed as e:
@@ -1222,6 +1245,7 @@ class TransformerHandler:
                     "end": self.backend.first_block + end,
                     "batch_size": batch_size, "max_length": max_length,
                     "trace_id": trace_id,  # rides into parked/migrated snapshots
+                    "peer": peer_str,  # ledger attribution for migrate-out pushes
                 }
                 self._session_registry[session_id] = reg
             # echo the trace id so the client learns a server-minted one
@@ -1505,6 +1529,11 @@ class TransformerHandler:
                         step_variant = "dense_prefill"
                         chunk_fns = []
                         off = 0
+                        # the full prompt length is known here: every chunk
+                        # declares it so LongRoPE (phi3) selects short/long
+                        # factors from the FINAL sequence length instead of
+                        # flipping factors between chunks (HF parity)
+                        prefill_n_total = pos + exec_hidden.shape[1]
                         for clen in backend.chunk_plan(
                             batch_size, exec_hidden.shape[1], kv_buf_len=batcher.max_length
                         ):
@@ -1517,6 +1546,7 @@ class TransformerHandler:
                                         chunk, kv_lane, chunk_pos,
                                         active_adapter=active_adapter,
                                         handles=lane_handles,
+                                        n_total=prefill_n_total,
                                     )
                                 return np.asarray(out), new_kv
 
@@ -1738,6 +1768,12 @@ class TransformerHandler:
                 }
                 if lane is not None:
                     step_meta.update(batcher.occupancy_hint())
+                    # the tenant's own bill since the last reply (resource
+                    # ledger delta: page-seconds, compute split, tokens, swap
+                    # bytes) — InferenceSession.usage_report() sums these
+                    usage = batcher.pop_usage_delta(lane)
+                    if usage:
+                        step_meta["usage"] = usage
                 if gen_token_list is not None:
                     # the client computes everything it needs from the token
                     # ids; skipping the hidden reply saves the prefill-sized

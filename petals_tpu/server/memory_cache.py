@@ -154,6 +154,18 @@ class PageAllocator:
             "run_hist": hist,
         }
 
+    def fractional_shares(self, tables: np.ndarray) -> np.ndarray:
+        """Fractional page ownership per block-table row: a page with
+        refcount R contributes 1/R to each row referencing it, so summing a
+        row's shares (plus the prefix cache's pin remainder) reconstructs
+        exactly the allocated page count — the resource ledger's COW
+        attribution rule (telemetry.ledger page-seconds conservation).
+        ``tables`` is [n_rows, max_pages] int32 with -1 for empty slots."""
+        mask = tables >= 0
+        pages = np.where(mask, tables, 0)
+        inv = np.where(mask, 1.0 / np.maximum(self.refs[pages], 1), 0.0)
+        return inv.sum(axis=1)
+
     def incref(self, page: int) -> None:
         assert self.refs[page] > 0, f"incref of free page {page}"
         self.refs[page] += 1

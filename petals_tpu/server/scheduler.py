@@ -9,8 +9,13 @@ scheduling decision, in two layers:
 - **Admission** (acquire_lane): lane waiters are ordered by priority class
   (session-open "priority" hint: high/normal/low, default normal), ties
   broken by per-peer fair share — among equal-priority waiters the peer
-  holding the FEWEST lanes is admitted first, so one chatty client cannot
-  monopolize the pool — then FIFO.
+  consuming the least is admitted first, so one chatty client cannot
+  monopolize the pool — then FIFO. Fair share ranks by the resource
+  ledger's dominant-resource share (``usage_fn``: rolling-window DRF over
+  page-seconds / compute-seconds / tokens / swap bytes) when wired, which
+  sees page and prefill hogging that a raw lane count is blind to; the
+  lanes-held count remains the inner tie-break and the whole rank when no
+  ledger is attached.
 
 - **Preemption** (prepare_write / swap-in on pool exhaustion): instead of
   only waiting for a page to free, the batcher asks the scheduler for a
@@ -97,6 +102,7 @@ class SessionScheduler:
         policy: str = "lru",
         pages_fn: Optional[Callable[[int], int]] = None,
         resume_quantum_s: float = 0.5,
+        usage_fn: Optional[Callable[[Optional[str]], float]] = None,
     ):
         if policy not in PREEMPTION_POLICIES:
             raise ValueError(
@@ -114,6 +120,10 @@ class SessionScheduler:
         # page accounting); the batcher wires its block tables in, unit tests
         # wire a dict — the scheduler never reaches into batcher internals
         self.pages_fn = pages_fn or (lambda lane: 0)
+        # peer -> dominant-resource share in [0, 1] (telemetry.ledger
+        # peer_dominant_share); None keeps the raw lanes-held fair share.
+        # Shares are quantized to avoid float jitter flapping the order.
+        self.usage_fn = usage_fn
         self.lanes: Dict[int, SessionSlot] = {}
         self._clock = 0
         # every key pre-initialized, like DecodeBatcher.stats: rpc_info spreads
@@ -181,16 +191,36 @@ class SessionScheduler:
             self.pages_fn(s.lane) for s in self.lanes.values() if s.peer_id == peer_id
         )
 
+    def peer_usage_share(self, peer_id: Optional[str]) -> float:
+        """Quantized dominant-resource share of ``peer_id`` (0.0 without a
+        ledger — every rank below then degrades to the pre-ledger order)."""
+        if self.usage_fn is None:
+            return 0.0
+        try:
+            return round(float(self.usage_fn(peer_id)), 3)
+        except Exception as e:
+            # an accounting bug must degrade ranking, never block admission
+            logger.warning(f"usage_fn failed for {peer_id!r}: {e}")
+            return 0.0
+
     def pick_waiter(self, waiters: Sequence) -> Optional[object]:
         """Admission order for lane waiters: highest priority class first,
-        then the peer holding the fewest lanes (fair share), then FIFO.
-        ``waiters`` entries expose .priority, .peer_id, .seq (batching.py
-        _LaneWaiter); returns the entry to admit, or None when empty."""
+        then the peer with the smallest dominant-resource share (DRF fair
+        share via the ledger; 0 for everyone without one), then the peer
+        holding the fewest lanes, then FIFO. ``waiters`` entries expose
+        .priority, .peer_id, .seq (batching.py _LaneWaiter); returns the
+        entry to admit, or None when empty."""
         live = [w for w in waiters if not w.fut.done()]
         if not live:
             return None
         return min(
-            live, key=lambda w: (w.priority, self.peer_lanes_held(w.peer_id), w.seq)
+            live,
+            key=lambda w: (
+                w.priority,
+                self.peer_usage_share(w.peer_id),
+                self.peer_lanes_held(w.peer_id),
+                w.seq,
+            ),
         )
 
     # ------------------------------------------------------------ preemption
@@ -201,7 +231,9 @@ class SessionScheduler:
         """Choose the lane to preempt among ``candidates`` (already filtered
         by the batcher for idleness and residency). Victims must be of equal
         or LOWER importance than the requester (priority value >=
-        ``max_priority``); ordering is lowest priority class first, then
+        ``max_priority``); ordering is lowest priority class first, then the
+        owning peer's dominant-resource share (the ledger's DRF view: a
+        noisy peer's lanes go first, 0 for everyone without a ledger), then
         least-recently-stepped ("lru") or most pages held ("largest")."""
         if self.policy == "off":
             return None
@@ -215,10 +247,11 @@ class SessionScheduler:
                 continue  # never preempt a more important session
             if now - slot.resumed_at < self.resume_quantum_s:
                 continue  # just resumed: let it run its quantum (anti-thrash)
+            share = self.peer_usage_share(slot.peer_id)
             if self.policy == "largest":
-                key = (-slot.priority, -self.pages_fn(lane), slot.last_step)
+                key = (-slot.priority, -share, -self.pages_fn(lane), slot.last_step)
             else:  # lru
-                key = (-slot.priority, slot.last_step, -self.pages_fn(lane))
+                key = (-slot.priority, -share, slot.last_step, -self.pages_fn(lane))
             if best_key is None or key < best_key:
                 best, best_key = lane, key
         return best

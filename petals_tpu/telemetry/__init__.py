@@ -36,6 +36,10 @@ The pieces:
 - :mod:`.gate` — the perf-regression gate: diff per-row bench telemetry
   blobs (counter deltas + step-duration histograms) against a committed
   baseline (``bench.py --gate``).
+- :mod:`.ledger` — the per-tenant resource ledger: page-seconds (COW
+  pages attributed fractionally by refcount), compute-seconds, tokens,
+  swap/migrated bytes per session and per peer, with a DRF-style
+  noisy-neighbor detector and the ``/ledger`` top-k view.
 - :mod:`.observatory` — the compiled-program observatory:
   ``tracked_jit`` wraps ``jax.jit`` so every compilation is detected,
   timed, journaled with its avals, and cost-analyzed into the ``/compile``
@@ -81,6 +85,10 @@ from petals_tpu.telemetry.observatory import (
     get_observatory,
     tracked_jit,
 )
+from petals_tpu.telemetry.ledger import (
+    ResourceLedger,
+    get_ledger,
+)
 
 __all__ = [
     "FlightRecorder",
@@ -98,7 +106,9 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "MetricsServer",
+    "ResourceLedger",
     "TelemetryJournal",
+    "get_ledger",
     "current_trace_id",
     "get_journal",
     "get_registry",

@@ -4,7 +4,8 @@ the compact digest announced to the DHT.
 Same zero-dep posture as ``utils/health.py``: the endpoint is a stdlib
 ``http.server.ThreadingHTTPServer`` on a daemon thread (scrapes must not
 touch the serving event loop), rendering exposition format 0.0.4 by hand.
-``/journal`` serves the scheduler event journal as JSONL for post-mortems.
+``/journal`` serves the scheduler event journal as JSONL for post-mortems,
+and ``/ledger`` the per-tenant resource ledger's top-k consumer view.
 
 ``telemetry_digest()`` is the swarm-aggregation half: a tiny dict (tok/s
 over the announce window, TTFT/step p50/p99, swap pressure, failure
@@ -142,6 +143,14 @@ def telemetry_digest(registry: Optional[MetricsRegistry] = None) -> dict:
         "prefix_hit_rate": _prefix_hit_rate(),
         "swap_oldest_s": round(I.SWAP_RESIDENCY_OLDEST.value, 1),
     }
+    # resource ledger (PR 10): a compact per-peer usage digest so run_health
+    # can rank the swarm's top consumers without scraping every /ledger
+    try:
+        from petals_tpu.telemetry.ledger import get_ledger
+
+        digest["ledger"] = get_ledger().digest()
+    except Exception:
+        pass  # the announce must never die on an accounting bug
     return digest
 
 
@@ -210,6 +219,25 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                 "dropped_programs": obs.dropped_programs,
             }
             body = (_json.dumps(view, default=str) + "\n").encode()
+            ctype = "application/json"
+        elif path == "/ledger":
+            # per-tenant resource ledger: top-k consumers with page-second /
+            # compute-second / token / swap attribution. Peer ids appear ONLY
+            # here (bounded dicts), never as metric labels — /metrics stays
+            # aggregate-only per the no-unbounded-metric-labels rule.
+            import json as _json
+            import urllib.parse
+
+            from petals_tpu.telemetry.ledger import get_ledger
+
+            params = urllib.parse.parse_qs(query)
+            try:
+                k = int(params.get("k", ["10"])[0])
+            except ValueError:
+                self.send_response(400)
+                self.end_headers()
+                return
+            body = (_json.dumps(get_ledger().snapshot(k=k)) + "\n").encode()
             ctype = "application/json"
         else:
             self.send_response(404)

@@ -166,6 +166,40 @@ CHAOS_INJECTIONS = REGISTRY.counter(
     labels=("site", "action"),  # sites/actions are static code-defined enums
 )
 
+# --- resource ledger --------------------------------------------------------
+# Aggregate-only: per-peer breakdowns live in the ledger's bounded dicts and
+# its /ledger JSON view, NEVER in metric labels (peer ids are unbounded and
+# request-adjacent; swarmlint's no-unbounded-metric-labels enforces this).
+LEDGER_PAGE_SECONDS = REGISTRY.counter(
+    "petals_ledger_page_seconds_total",
+    "HBM page-seconds attributed to sessions by the resource ledger "
+    "(fractional COW attribution; excludes unattributed prefix-cache pins)",
+)
+LEDGER_UNATTRIBUTED_PAGE_SECONDS = REGISTRY.counter(
+    "petals_ledger_unattributed_page_seconds_total",
+    "HBM page-seconds held by prefix-cache pins with no live lane reference",
+)
+LEDGER_COMPUTE_SECONDS = REGISTRY.counter(
+    "petals_ledger_compute_seconds_total",
+    "Compute-seconds split across lanes per batched tick by the ledger",
+)
+LEDGER_SESSIONS = REGISTRY.gauge(
+    "petals_ledger_live_sessions", "Sessions currently metered by the ledger"
+)
+LEDGER_PEERS = REGISTRY.gauge(
+    "petals_ledger_peers", "Distinct peers the ledger has attributed usage to"
+)
+LEDGER_PEER_OVERFLOW = REGISTRY.counter(
+    "petals_ledger_peer_overflow_total",
+    "Sessions collapsed into the shared _overflow peer after the ledger's "
+    "peer-cardinality cap (the registry's overflow discipline, applied here)",
+)
+LEDGER_NOISY_NEIGHBORS = REGISTRY.counter(
+    "petals_ledger_noisy_neighbor_total",
+    "Noisy-neighbor detections: a peer over its dominant-resource share "
+    "while other peers' admissions queued",
+)
+
 # --- telemetry self-observation -------------------------------------------
 META_TRUNCATED = REGISTRY.counter(
     "telemetry_meta_truncated_total",

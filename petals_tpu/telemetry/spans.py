@@ -41,7 +41,7 @@ class HopTrace:
     __slots__ = (
         "peer", "start_block", "end_block", "steps", "tokens",
         "wall_s", "server_s", "queue_s", "compute_s", "serialize_s",
-        "meta_steps", "last_variant", "last_occupancy",
+        "meta_steps", "last_variant", "last_occupancy", "usage",
     )
 
     def __init__(self, peer: str, start_block: int, end_block: int):
@@ -58,6 +58,9 @@ class HopTrace:
         self.meta_steps = 0  # steps that carried step_meta
         self.last_variant: Optional[str] = None
         self.last_occupancy: Optional[dict] = None
+        # server-billed resource usage (ledger deltas riding step_meta):
+        # page_seconds / compute_seconds / tokens / swap bytes, summed
+        self.usage: dict = {}
 
     def record(self, wall_s: float, meta: Optional[dict], tokens: int = 1) -> None:
         """Fold one step's client wall time and its (optional) server-side
@@ -78,6 +81,13 @@ class HopTrace:
         self.server_s += float(meta.get("total_s") or (q + c + z))
         if meta.get("variant"):
             self.last_variant = str(meta["variant"])
+        usage = meta.get("usage")
+        if isinstance(usage, dict):
+            for field, amount in usage.items():
+                try:
+                    self.usage[field] = self.usage.get(field, 0) + float(amount)
+                except (TypeError, ValueError):
+                    continue  # a malformed server delta must not kill the step
         busy, wait = meta.get("busy_lanes"), meta.get("lane_waiters")
         if busy is not None or wait is not None:
             self.last_occupancy = {"busy_lanes": busy, "lane_waiters": wait}
@@ -119,6 +129,7 @@ class HopTrace:
             "occupancy": self.last_occupancy,
             "components": {k: round(v, 6) for k, v in comps.items()},
             "shares": {k: round(v / wall, 4) for k, v in comps.items()},
+            "usage": {k: round(v, 6) for k, v in self.usage.items()},
         }
 
 

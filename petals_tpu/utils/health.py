@@ -180,7 +180,15 @@ class HealthMonitor:
                 "compiled_programs": 0,
                 "compile_anomalies": 0,
                 "compile_s": 0.0,
+                # resource-ledger rollup (PR 10): swarm totals plus the merged
+                # top-consumer table across every server's announced digest
+                "ledger_page_s": 0.0,
+                "ledger_compute_s": 0.0,
+                "ledger_sessions": 0,
+                "noisy_neighbor_events": 0,
+                "top_consumers": [],
             }
+            consumers: Dict[str, dict] = {}
             for peer, s in model["servers"].items():
                 digest = s.get("telemetry")
                 pool = s.get("pool") or {}
@@ -213,6 +221,27 @@ class HealthMonitor:
                     if isinstance(value, (int, float)):
                         prev = agg[dst]
                         agg[dst] = value if prev is None else max(prev, value)
+                ledger = digest.get("ledger")
+                if isinstance(ledger, dict):
+                    agg["ledger_page_s"] += float(ledger.get("page_s") or 0.0)
+                    agg["ledger_compute_s"] += float(ledger.get("compute_s") or 0.0)
+                    agg["ledger_sessions"] += int(ledger.get("sessions") or 0)
+                    agg["noisy_neighbor_events"] += int(ledger.get("noisy") or 0)
+                    for entry in ledger.get("top") or []:
+                        try:
+                            tenant, share, page_s = entry[0], float(entry[1]), float(entry[2])
+                        except (TypeError, ValueError, IndexError):
+                            continue
+                        row = consumers.setdefault(
+                            str(tenant), {"page_s": 0.0, "share_max": 0.0, "servers": 0}
+                        )
+                        row["page_s"] = round(row["page_s"] + page_s, 3)
+                        row["share_max"] = max(row["share_max"], share)
+                        row["servers"] += 1
+            agg["top_consumers"] = sorted(
+                ({"peer": tenant, **row} for tenant, row in consumers.items()),
+                key=lambda r: -r["page_s"],
+            )[:10]
             agg["occupancy"] = (agg["busy_lanes"] / agg["lanes"]) if agg["lanes"] else None
             per_model[prefix] = {"aggregate": agg, "servers": servers}
         return {"updated_at": self._state["updated_at"], "models": per_model}

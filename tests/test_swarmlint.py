@@ -350,8 +350,10 @@ def test_no_unbounded_metric_labels():
         "    BANS.labels(who=slot.peer_id).inc()\n"  # attribute tail is tainted too
         "    HOPS.labels(f'{session_id}-x').inc()\n"  # f-strings don't launder taint
         "    LOAD.labels(uid, 'steps').inc()\n"  # positional args are checked too
+        "    PAGE.labels(entry['peer_id']).inc()\n"  # ledger-dict subscript key
+        "    COST.labels(tenant=row['peer']).inc()\n"  # per-peer rollup key
     )
-    assert lines_hit(bad, "no-unbounded-metric-labels") == [2, 3, 4, 5, 6]
+    assert lines_hit(bad, "no-unbounded-metric-labels") == [2, 3, 4, 5, 6, 7, 8]
     ok = (
         "def f(self, variant, session_id, kind):\n"
         "    STEPS.labels(variant=variant).inc()\n"  # static enum label: fine
@@ -359,6 +361,7 @@ def test_no_unbounded_metric_labels():
         "    SLO.labels(kind=kind).inc()\n"  # bounded enum ('ttft'/'token'): fine
         "    journal.event('swap', trace_id=session_id)\n"  # ids go to the journal
         "    self.labels = [session_id]\n"  # attribute assignment, not a call
+        "    BYTES.labels(direction=cfg['direction']).inc()\n"  # static key: fine
     )
     assert "no-unbounded-metric-labels" not in rules_hit(ok)
     suppressed = (

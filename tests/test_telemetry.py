@@ -677,6 +677,14 @@ def test_two_hop_chain_trace_and_flight_recorder(model_path):
             assert report["attributed_fraction"] >= 0.95, report
             rendered = format_waterfall(report)
             assert tid in rendered and "critical path:" in rendered
+
+            # ---- resource bill: ledger usage deltas rode step_meta from
+            # BOTH hops, so the client can total its own charges
+            bill = session.usage_report()
+            assert bill["trace_id"] == tid
+            assert bill["total"].get("decode_tokens", 0) >= 3
+            assert bill["total"].get("page_seconds", 0) > 0
+            assert len(bill["peers"]) == 2, bill
             await session.close()
 
             # ---- flight recorder: microscopic SLOs force a breach per kind
