@@ -10,10 +10,12 @@ from petals_tpu.chaos.plane import (
     MAX_LOG,
     SITES,
     SITE_ANNOUNCE,
+    SITE_DHT_LOOKUP,
     SITE_HANDLER_STEP,
     SITE_MIGRATE_PUSH,
     SITE_RPC_CALL,
     SITE_RPC_STREAM,
+    SITE_RPC_STREAM_RECV,
     SITE_SWAP_RESERVE,
     ChaosInjected,
     ChaosPlane,
@@ -42,10 +44,12 @@ __all__ = [
     "MAX_LOG",
     "SITES",
     "SITE_ANNOUNCE",
+    "SITE_DHT_LOOKUP",
     "SITE_HANDLER_STEP",
     "SITE_MIGRATE_PUSH",
     "SITE_RPC_CALL",
     "SITE_RPC_STREAM",
+    "SITE_RPC_STREAM_RECV",
     "SITE_SWAP_RESERVE",
     "ChaosInjected",
     "ChaosPlane",

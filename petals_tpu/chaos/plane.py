@@ -3,8 +3,9 @@
 Petals' promise is serving on an unreliable public swarm; hand-picked
 failure tests only exercise the failure modes someone thought of. This
 plane injects faults at NAMED SITES wired into the production code paths
-— RPC calls, the handler's step boundary, the migration push, DHT
-announces, the swap-pool budget — under a seeded RNG, so a chaos run is
+— RPC calls, mid-stream receives, the handler's step boundary, the
+migration push, DHT announces and lookups, the swap-pool budget — under
+a seeded RNG, so a chaos run is
 reproducible: the same seed and call order yields the same fault
 sequence. It drives the ``-m chaos`` test lane and
 ``benchmarks/bench_churn.py``.
@@ -47,17 +48,21 @@ logger = get_logger(__name__)
 # chaos log, and typos in a rule's site are rejected at parse time.
 SITE_RPC_CALL = "rpc.call"  # client unary call (detail: method name)
 SITE_RPC_STREAM = "rpc.stream_open"  # client stream open (detail: method)
+SITE_RPC_STREAM_RECV = "rpc.stream_recv"  # client mid-stream receive (detail: method)
 SITE_HANDLER_STEP = "handler.step"  # server inference-step boundary
 SITE_MIGRATE_PUSH = "migrate.push"  # server->server session_migrate push
 SITE_ANNOUNCE = "dht.announce"  # server's periodic DHT announce
+SITE_DHT_LOOKUP = "dht.lookup"  # client route discovery (module-info fetch)
 SITE_SWAP_RESERVE = "swap.reserve"  # host swap-pool budget reservation
 
 SITES = (
     SITE_RPC_CALL,
     SITE_RPC_STREAM,
+    SITE_RPC_STREAM_RECV,
     SITE_HANDLER_STEP,
     SITE_MIGRATE_PUSH,
     SITE_ANNOUNCE,
+    SITE_DHT_LOOKUP,
     SITE_SWAP_RESERVE,
 )
 
@@ -261,10 +266,12 @@ __all__ = [
     "MAX_LOG",
     "SITES",
     "SITE_ANNOUNCE",
+    "SITE_DHT_LOOKUP",
     "SITE_HANDLER_STEP",
     "SITE_MIGRATE_PUSH",
     "SITE_RPC_CALL",
     "SITE_RPC_STREAM",
+    "SITE_RPC_STREAM_RECV",
     "SITE_SWAP_RESERVE",
     "ChaosInjected",
     "ChaosPlane",

@@ -27,6 +27,11 @@ class ClientConfig:
     ban_timeout: float = 15.0
 
     max_pinged: int = 3  # servers pinged per routing update
+
+    # client-declared budget (seconds) for the server's lane-admission wait
+    # at session open; the server parks the open at most this long before
+    # falling back to a private KV cache. None = server default (30 s).
+    alloc_timeout: Optional[float] = None
     active_adapter: Optional[str] = None
 
     use_server_to_server: bool = True  # direct server->server activation push

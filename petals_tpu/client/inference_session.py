@@ -31,6 +31,16 @@ from petals_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
+# Minimum server-reported lane-admission wait (seconds) before a session open
+# files congestion blame on its own. Sub-second waits are normal scheduling
+# jitter and stay visible only in the hop waterfall; multi-second waits mean
+# the pool is genuinely oversubscribed and the NEXT route build should know.
+OPEN_WAIT_BLAME_S = 0.5
+# Floor below which the reported wait is not folded into the hop waterfall at
+# all: an UNCONTENDED acquire still measures a few microseconds, and recording
+# it would add a phantom zero-token step to every hop's trace.
+OPEN_WAIT_FOLD_MIN_S = 0.05
+
 
 class _ServerInferenceSession:
     def __init__(
@@ -110,8 +120,17 @@ class _ServerInferenceSession:
         priority = getattr(seq_manager.config, "session_priority", None)
         if priority is not None:
             open_msg["priority"] = priority
+        # bound head-of-line blocking in the server's lane queue: absent, the
+        # server parks the open for its own default (30 s) before falling back
+        # to a private cache — a client that would rather re-route or degrade
+        # sooner declares its own budget
+        alloc_timeout = getattr(seq_manager.config, "alloc_timeout", None)
+        if alloc_timeout is not None:
+            open_msg["alloc_timeout"] = float(alloc_timeout)
+        t_open = time.perf_counter()
         await stream.send(open_msg)
         ack = await stream.recv(timeout=step_timeout)
+        open_wall_s = time.perf_counter() - t_open
         assert ack.get("session_open"), f"Unexpected open reply: {ack}"
         self = cls(span, uids, stream, max_length=max_length, step_timeout=step_timeout)
         self.session_id = session_id
@@ -122,6 +141,33 @@ class _ServerInferenceSession:
         echoed = ack.get("trace_id")
         if isinstance(echoed, str) and echoed:
             self.echoed_trace_id = echoed
+        # fold the server's lane-admission wait (open ack piggyback) into the
+        # hop waterfall as queue time, and blame it IMMEDIATELY when it
+        # dominates the open handshake: short sessions — a few steps, i.e.
+        # most interactive traffic — never reach the periodic step-cadence
+        # blame check in _maybe_blame_hop, so without this a backlogged
+        # server keeps winning route builds and a freshly scaled-out replica
+        # never receives the load it was spawned to absorb
+        try:
+            open_wait_s = float(ack.get("open_wait_s") or 0.0)
+        except (TypeError, ValueError):
+            open_wait_s = 0.0
+        if open_wait_s >= OPEN_WAIT_FOLD_MIN_S:
+            self.hop.record(
+                open_wall_s, {"queue_s": open_wait_s, "total_s": open_wait_s}, tokens=0
+            )
+            share = self.hop.queue_share()
+            if open_wait_s >= OPEN_WAIT_BLAME_S and share > 0.5:
+                report = getattr(seq_manager, "report_congestion", None)
+                if report is not None:
+                    report(span.peer_id, share)
+                # a backlogged open is also evidence the cached swarm view is
+                # stale — kick a (rate-limited) directory refresh so capacity
+                # announced since the last periodic update becomes routable
+                # now, not up to update_period seconds later
+                refresh = getattr(seq_manager, "request_refresh", None)
+                if refresh is not None:
+                    refresh()
         return self
 
     async def import_kv(self, k: np.ndarray, v: np.ndarray, position: int) -> None:
@@ -755,70 +801,106 @@ class InferenceSession:
             except (ValueError, TypeError):
                 prefer_peers = None
 
-        await self.seq_manager.update()
-        new_chain = await self.seq_manager.make_sequence(
-            resume, dead_end, mode="min_latency",
-            cache_tokens_needed=self.batch_size * self.max_length,
-            affinity_seed=self._affinity_seed,
-            prefer_peers=prefer_peers,
-        )
-        new_sessions = await self._enter_server_sessions(new_chain, wire_push=False)
-        self._sessions = sorted(
-            keep_up + new_sessions + keep_down, key=lambda s: s.span.start
-        )
+        # Build-and-seed is itself a chain of RPCs, each as exposed to the
+        # fault that triggered the repair as the step that failed: a transient
+        # drop mid-repair must NOT abandon the session. Retry the whole
+        # attempt with the step loop's backoff discipline — `replay_steps`,
+        # `exported`, and `redirect` were captured ONCE above, so every
+        # attempt reseeds from the full original history; a half-replayed
+        # replacement session is simply closed and rebuilt.
+        attempt = 0
+        while True:
+            new_sessions = []
+            try:
+                await self.seq_manager.update()
+                new_chain = await self.seq_manager.make_sequence(
+                    resume, dead_end, mode="min_latency",
+                    cache_tokens_needed=self.batch_size * self.max_length,
+                    affinity_seed=self._affinity_seed,
+                    prefer_peers=prefer_peers,
+                )
+                new_sessions = await self._enter_server_sessions(new_chain, wire_push=False)
+                self._sessions = sorted(
+                    keep_up + new_sessions + keep_down, key=lambda s: s.span.start
+                )
 
-        # Seed the replacement (single-span holes only — a split hole would
-        # leave later spans without input history for future failovers):
-        # 1. server-side adopt when the chain landed on the migrated KV's
-        #    new home (the p2p path: bytes already moved server-to-server);
-        # 2. KV import over the client link (export in hand, or fetched from
-        #    the redirect target when routing went elsewhere);
-        # 3. history replay.
-        seeded = False
-        if (
-            redirect is not None
-            and prefer_peers
-            and len(new_sessions) == 1
-            and new_sessions[0].span.peer_id == prefer_peers[0]
-            and dead is not None
-        ):
-            try:
-                seeded = await self._seed_by_adopt(
-                    new_sessions[0], dead.session_id,
-                    int(redirect["position"]), replay_steps,
-                )
+                # Seed the replacement (single-span holes only — a split hole
+                # would leave later spans without input history for future
+                # failovers):
+                # 1. server-side adopt when the chain landed on the migrated
+                #    KV's new home (the p2p path: bytes already moved
+                #    server-to-server);
+                # 2. KV import over the client link (export in hand, or
+                #    fetched from the redirect target when routing went
+                #    elsewhere);
+                # 3. history replay.
+                seeded = False
+                if (
+                    redirect is not None
+                    and prefer_peers
+                    and len(new_sessions) == 1
+                    and new_sessions[0].span.peer_id == prefer_peers[0]
+                    and dead is not None
+                ):
+                    try:
+                        seeded = await self._seed_by_adopt(
+                            new_sessions[0], dead.session_id,
+                            int(redirect["position"]), replay_steps,
+                        )
+                    except Exception as e:
+                        logger.warning(f"KV adopt failed, falling back: {e}")
+                        self._journal_export_fallback(str(redirect.get("peer_id")), repr(e))
+                        # the session's stream state is unknown after a failed adopt
+                        await new_sessions[0].close()
+                        new_sessions = await self._enter_server_sessions(new_chain, wire_push=False)
+                        self._sessions = sorted(
+                            keep_up + new_sessions + keep_down, key=lambda s: s.span.start
+                        )
+                if not seeded and redirect is not None and exported is None and dead is not None:
+                    exported = await self._fetch_migrated(
+                        redirect, dead.session_id, resume, dead_end
+                    )
+                if not seeded and exported is not None and len(new_sessions) == 1:
+                    try:
+                        seeded = await self._seed_by_import(new_sessions[0], exported, replay_steps)
+                    except Exception as e:
+                        logger.warning(f"KV import failed, replaying history instead: {e}")
+                        # the session's stream state is unknown after a failed import
+                        await new_sessions[0].close()
+                        new_sessions = await self._enter_server_sessions(new_chain, wire_push=False)
+                        self._sessions = sorted(
+                            keep_up + new_sessions + keep_down, key=lambda s: s.span.start
+                        )
+                if not seeded and replay_steps:
+                    # re-prefill the hole, repeating each recorded step — including its
+                    # beam-lane reorder (hypo_ids) — in original order
+                    for hidden_step, hypo_step in replay_steps:
+                        chunk = hidden_step
+                        step_id = uuid.uuid4().hex
+                        for session in new_sessions:
+                            chunk = await self._replay_step(session, chunk, hypo_step, step_id)
+                break
             except Exception as e:
-                logger.warning(f"KV adopt failed, falling back: {e}")
-                self._journal_export_fallback(str(redirect.get("peer_id")), repr(e))
-                # the session's stream state is unknown after a failed adopt
-                await new_sessions[0].close()
-                new_sessions = await self._enter_server_sessions(new_chain, wire_push=False)
-                self._sessions = sorted(
-                    keep_up + new_sessions + keep_down, key=lambda s: s.span.start
-                )
-        if not seeded and redirect is not None and exported is None and dead is not None:
-            exported = await self._fetch_migrated(
-                redirect, dead.session_id, resume, dead_end
-            )
-        if not seeded and exported is not None and len(new_sessions) == 1:
-            try:
-                seeded = await self._seed_by_import(new_sessions[0], exported, replay_steps)
-            except Exception as e:
-                logger.warning(f"KV import failed, replaying history instead: {e}")
-                # the session's stream state is unknown after a failed import
-                await new_sessions[0].close()
-                new_sessions = await self._enter_server_sessions(new_chain, wire_push=False)
-                self._sessions = sorted(
-                    keep_up + new_sessions + keep_down, key=lambda s: s.span.start
-                )
-        if not seeded and replay_steps:
-            # re-prefill the hole, repeating each recorded step — including its
-            # beam-lane reorder (hypo_ids) — in original order
-            for hidden_step, hypo_step in replay_steps:
-                chunk = hidden_step
-                step_id = uuid.uuid4().hex
+                attempt += 1
                 for session in new_sessions:
-                    chunk = await self._replay_step(session, chunk, hypo_step, step_id)
+                    failed_peer = session.span.peer_id
+                    try:
+                        await session.close()
+                    except Exception:
+                        pass
+                    self.seq_manager.on_request_failure(failed_peer)
+                self._sessions = sorted(keep_up + keep_down, key=lambda s: s.span.start)
+                if self._max_retries is not None and attempt > self._max_retries:
+                    raise
+                delay = min(
+                    self.seq_manager.config.min_backoff * (2 ** (attempt - 1)),
+                    self.seq_manager.config.max_backoff,
+                )
+                logger.warning(
+                    f"Chain repair for blocks [{resume}, {dead_end}) failed "
+                    f"(attempt {attempt}), retrying in {delay:.1f}s: {e}"
+                )
+                await asyncio.sleep(delay)
 
         self._wire_repair_pushes(keep_up, new_sessions, keep_down, dead_end)
         return resume

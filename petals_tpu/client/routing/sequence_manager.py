@@ -31,6 +31,7 @@ from petals_tpu.dht.node import DHTNode
 from petals_tpu.dht.routing import PeerAddr
 from petals_tpu.rpc.client import RpcClient
 from petals_tpu.rpc.pool import ConnectionPool
+from petals_tpu.utils.asyncio_utils import log_exception_callback
 from petals_tpu.utils.dht_utils import ModuleDirectory
 from petals_tpu.utils.logging import get_logger
 
@@ -49,6 +50,11 @@ PREFER_PEER_BONUS_S = 10.0
 # replica, far below CACHE_MISS_PENALTY so it never overrides capacity.
 CONGESTION_PENALTY_S = 0.05
 CONGESTION_WINDOW_S = 30.0
+# Minimum spacing between congestion-triggered routing refreshes
+# (request_refresh): one backlogged open is enough evidence that the cached
+# swarm view is stale, but a burst of them must collapse to a single DHT
+# fetch, not a stampede.
+REFRESH_BACKOFF_S = 2.0
 # Prompt-prefix affinity amplitude (see _edge_cost): must dominate
 # noise-level cost differences between near-equal replicas or identical
 # prompts scatter and never share a prefix cache; must stay below REAL
@@ -154,6 +160,8 @@ class RemoteSequenceManager:
         # penalty (peer -> (expires_monotonic, queue_share)) — steering, not
         # the hard hammer of a ban
         self._congestion: Dict[PeerID, Tuple[float, float]] = {}
+        self._last_refresh_req = 0.0  # monotonic time of last request_refresh
+        self._refresh_task: Optional[asyncio.Task] = None
         self._update_lock = asyncio.Lock()
         self._update_task = asyncio.create_task(self._update_loop())
         return self
@@ -223,6 +231,31 @@ class RemoteSequenceManager:
             info.servers = servers
             out.append(info if servers else None)
         return out
+
+    def request_refresh(self) -> None:
+        """Congestion-triggered routing refresh, rate-limited.
+
+        A session that just waited out a lane backlog has direct evidence the
+        cached swarm view is stale: capacity announced AFTER the last periodic
+        update — an autoscaler scale-out, say — stays invisible for up to
+        ``update_period`` seconds, typically far longer than the backlog it
+        was spawned to absorb.  Fire-and-forget; bursts collapse via
+        REFRESH_BACKOFF_S and the update lock.
+        """
+        now = time.monotonic()
+        if now - self._last_refresh_req < REFRESH_BACKOFF_S:
+            return
+        self._last_refresh_req = now
+        self._refresh_task = asyncio.ensure_future(self._refresh_once())
+        self._refresh_task.add_done_callback(
+            log_exception_callback(logger, "congestion-triggered refresh")
+        )
+
+    async def _refresh_once(self) -> None:
+        try:
+            await self.update()
+        except Exception as e:
+            logger.debug(f"Congestion-triggered refresh failed: {e}")
 
     async def _update_loop(self) -> None:
         while True:
@@ -616,6 +649,12 @@ class RemoteSequenceManager:
             await self._update_task
         except asyncio.CancelledError:
             pass
+        if self._refresh_task is not None:
+            self._refresh_task.cancel()
+            try:
+                await self._refresh_task
+            except asyncio.CancelledError:
+                pass
         await self.pool.close()
         if self._owns_dht:
             await self.dht.shutdown()

@@ -27,9 +27,10 @@ _END = object()
 class StreamCall:
     """A bidirectional stream: ``send``/``end`` feed the server, iterate to read."""
 
-    def __init__(self, client: "RpcClient", call_id: int):
+    def __init__(self, client: "RpcClient", call_id: int, method: Optional[str] = None):
         self._client = client
         self._call_id = call_id
+        self._method = method  # chaos-injection detail for rpc.stream_recv
         self._inbound: asyncio.Queue = asyncio.Queue()
         self._closed = False
 
@@ -44,6 +45,8 @@ class StreamCall:
 
     async def recv(self, timeout: Optional[float] = None) -> Any:
         """Next response item; raises StopAsyncIteration at end of stream."""
+        if chaos.ENABLED:
+            await chaos.inject(chaos.SITE_RPC_STREAM_RECV, detail=self._method)
         item = await asyncio.wait_for(self._inbound.get(), timeout)
         if item is _END:
             self._closed = True
@@ -217,7 +220,7 @@ class RpcClient:
         if chaos.ENABLED:
             await chaos.inject(chaos.SITE_RPC_STREAM, detail=method)
         call_id = next(self._call_ids)
-        stream = StreamCall(self, call_id)
+        stream = StreamCall(self, call_id, method)
         self._streams[call_id] = stream
         await self._send({"t": "sopen", "id": call_id, "method": method})
         return stream

@@ -127,6 +127,10 @@ class TransformerHandler:
 
         self._push_pool = ConnectionPool(identity=identity)
         self._push_tasks: set = set()
+        # set by abort_migrations() (Server.shutdown): in-flight migration
+        # pushes stop waiting on their peer and abort immediately, so a
+        # slow/chaos-delayed destination can never hang teardown
+        self._migrate_abort = asyncio.Event()
 
         # Continuous batching (server/batching.py): concurrent single-stream
         # decode sessions on the full span coalesce into one device step.
@@ -389,7 +393,8 @@ class TransformerHandler:
         trace_id = snap.get("trace_id")
         nbytes = int(snap["k"].nbytes + snap["v"].nbytes)
         t0 = time.perf_counter()
-        try:
+
+        async def _push() -> None:
             if budget_bytes is not None and nbytes > budget_bytes:
                 raise RuntimeError(
                     f"session KV ({nbytes}B) exceeds the migration budget ({budget_bytes}B)"
@@ -410,9 +415,41 @@ class TransformerHandler:
                 "tensors": {"k": wire_k, "v": wire_v},
             }
             client = await self._push_pool.get_addr(PeerAddr.from_string(addr))
-            await asyncio.wait_for(
-                client.call("ptu.session_migrate", payload), deadline_s
+            await client.call("ptu.session_migrate", payload)
+
+        # Race the push against shutdown's abort signal, with the deadline
+        # covering the WHOLE push (chaos delays and serialization included —
+        # previously only the RPC call was deadlined, so a chaos-delayed
+        # serialize phase could hang drain past the deadline).
+        push_task = asyncio.create_task(_push())
+        abort_task = asyncio.create_task(self._migrate_abort.wait())
+        try:
+            await asyncio.wait(
+                {push_task, abort_task},
+                timeout=deadline_s,
+                return_when=asyncio.FIRST_COMPLETED,
             )
+        finally:
+            abort_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await abort_task
+        if not push_task.done():
+            reason = "shutdown" if self._migrate_abort.is_set() else "deadline"
+            push_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await push_task
+            tm.MIGRATIONS.labels(direction="out", outcome="aborted").inc()
+            get_journal().event(
+                "migrate_aborted", trace_id=trace_id, session_id=session_id,
+                dest=peer_id, nbytes=nbytes, reason=reason,
+                elapsed_s=time.perf_counter() - t0,
+            )
+            logger.warning(
+                f"Migration of {session_id!r} to {peer_id} aborted ({reason})"
+            )
+            return False
+        try:
+            push_task.result()
         except Exception as e:
             tm.MIGRATIONS.labels(direction="out", outcome="failed").inc()
             get_journal().event(
@@ -940,7 +977,13 @@ class TransformerHandler:
         for sid in [s for s, p in self._parked.items() if p.get("expires", 0) < now]:
             del self._parked[sid]
 
+    def abort_migrations(self) -> None:
+        """Tell in-flight migration pushes to give up immediately (shutdown
+        path): the parked entries stay, clients fall back to export/replay."""
+        self._migrate_abort.set()
+
     def shutdown(self) -> None:
+        self.abort_migrations()
         self.queue.shutdown()
         with contextlib.suppress(Exception):
             loop = asyncio.get_event_loop()
@@ -1180,6 +1223,7 @@ class TransformerHandler:
         # keep stepping/releasing through the pool it acquired from (whose
         # close() fails it loudly into the failover path).
         lane: Optional[int] = None
+        open_wait_s = 0.0  # lane-admission wait, reported in the open ack
         batcher = self.batcher
         # the peer this session bills to (fair-share admission + the resource
         # ledger). A PROVEN identity (rpc identity handshake) always wins;
@@ -1209,6 +1253,7 @@ class TransformerHandler:
             # class); absent -> normal, i.e. exactly the pre-hint behavior.
             # The peer id feeds per-peer fair-share admission and the ledger.
             priority = parse_session_priority(open_msg.get("priority"))
+            t_open_wait = time.perf_counter()
             try:
                 lane = await batcher.acquire_lane(
                     timeout=30.0 if alloc_timeout is None else alloc_timeout,
@@ -1218,6 +1263,11 @@ class TransformerHandler:
                 )
             except AllocationFailed as e:
                 logger.debug(f"No decode lane ({e}); serving with a private cache")
+            # reported to the client in the open ack: for short sessions
+            # (a handful of steps) this admission wait is the ONLY queue
+            # signal they ever see, and without it a backlogged server
+            # looks identical to an idle one at route-build time
+            open_wait_s = time.perf_counter() - t_open_wait
 
         push_queue: Optional[asyncio.Queue] = None
         if lane is not None:
@@ -1252,6 +1302,7 @@ class TransformerHandler:
             yield {
                 "session_open": True, "position": 0, "max_length": max_length,
                 "trace_id": trace_id,
+                "open_wait_s": round(open_wait_s, 6),
             }
 
             next_step, cleanup_steps = self._step_source(

@@ -545,6 +545,11 @@ class Server:
         return (best, addr_book[best]) if best is not None else None
 
     async def shutdown(self) -> None:
+        # a drain-to-migrate push racing shutdown must not hang teardown on a
+        # slow (or chaos-delayed) destination peer — tell it to abort now;
+        # aborted sessions stay parked and clients repair via export/replay
+        if self.handler is not None:
+            self.handler.abort_migrations()
         if self._balancer_task is not None:
             self._balancer_task.cancel()
             try:
@@ -900,6 +905,23 @@ class Server:
                         await self._reload_span(new_start)
             except Exception as e:
                 logger.warning(f"Balance check failed: {e}")
+
+    async def resize(self, new_first_block: int) -> bool:
+        """Autoscaler actuator: move this server's span to start at
+        ``new_first_block`` (same span length), migrating live sessions to
+        replicas first. A no-op (returns False) when already there; raises
+        ValueError on an out-of-range target so a bad policy decision fails
+        loudly instead of announcing blocks that do not exist."""
+        if not 0 <= new_first_block <= self.cfg.num_hidden_layers - self.num_blocks:
+            raise ValueError(
+                f"resize target {new_first_block} outside "
+                f"[0, {self.cfg.num_hidden_layers - self.num_blocks}]"
+            )
+        if new_first_block == self.first_block:
+            return False
+        logger.info(f"Resize: moving span to start at block {new_first_block}")
+        await self._reload_span(new_first_block)
+        return True
 
     async def _reload_span(self, new_first_block: int) -> None:
         """Move to a new span: announce OFFLINE on the old blocks, reload, and

@@ -1,0 +1,31 @@
+"""Closed-loop swarm elasticity: the autoscaler policy and controller.
+
+:mod:`petals_tpu.swarm.policy` is a PURE deterministic decision function
+over swarm-aggregate snapshots (no I/O, no clocks, no randomness) —
+that's what makes decisions replayable and their journals byte-identical
+across runs. :mod:`petals_tpu.swarm.autoscaler` wraps it in a controller
+that samples a live swarm (via :class:`~petals_tpu.utils.health.HealthMonitor`
+state), journals every decision with its evidence, and hands decisions to
+a pluggable actuator. ``python -m petals_tpu.cli.run_autoscaler`` runs it
+against a real swarm; ``benchmarks/bench_swarm_scale.py`` closes the loop
+in-process and gates it in CI.
+"""
+
+from petals_tpu.swarm.autoscaler import Autoscaler, CallbackActuator
+from petals_tpu.swarm.policy import (
+    AutoscalerPolicy,
+    Decision,
+    PolicyConfig,
+    ServerSample,
+    SwarmSnapshot,
+)
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerPolicy",
+    "CallbackActuator",
+    "Decision",
+    "PolicyConfig",
+    "ServerSample",
+    "SwarmSnapshot",
+]

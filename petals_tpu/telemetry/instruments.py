@@ -153,7 +153,7 @@ PREFIX_EVENTS = REGISTRY.counter(
 MIGRATIONS = REGISTRY.counter(
     "petals_migrations_total",
     "Peer-to-peer session migrations, by direction and outcome",
-    labels=("direction", "outcome"),  # out|in x ok|failed|refused
+    labels=("direction", "outcome"),  # out|in x ok|failed|refused|aborted
 )
 MIGRATION_BYTES = REGISTRY.counter(
     "petals_migration_bytes_total",
@@ -164,6 +164,26 @@ CHAOS_INJECTIONS = REGISTRY.counter(
     "petals_chaos_injections_total",
     "Faults injected by the chaos plane, by site and action",
     labels=("site", "action"),  # sites/actions are static code-defined enums
+)
+
+# --- autoscaler -------------------------------------------------------------
+AUTOSCALE_DECISIONS = REGISTRY.counter(
+    "petals_autoscaler_decisions_total",
+    "Autoscaler decisions issued, by action",
+    labels=("action",),  # scale_out | scale_in | resize
+)
+AUTOSCALE_APPLY_FAILED = REGISTRY.counter(
+    "petals_autoscaler_apply_failed_total",
+    "Autoscaler decisions whose actuator raised (the decision is journaled "
+    "with the error; the controller retries after the cooldown)",
+)
+AUTOSCALE_HOT_STREAK = REGISTRY.gauge(
+    "petals_autoscaler_hot_streak_ticks",
+    "Consecutive controller ticks the swarm has been over its hot threshold",
+)
+AUTOSCALE_REPLICAS = REGISTRY.gauge(
+    "petals_autoscaler_observed_replicas",
+    "ONLINE replicas in the autoscaler's last swarm snapshot",
 )
 
 # --- resource ledger --------------------------------------------------------

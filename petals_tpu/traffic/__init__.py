@@ -1,0 +1,26 @@
+"""Deterministic traffic plane: seeded open-loop load for swarm tests.
+
+:mod:`petals_tpu.traffic.generator` turns a seed into a fixed arrival
+schedule — diurnal load waves (nonhomogeneous Poisson via thinning),
+heavy-tailed session lengths (truncated Pareto), and an N-tenant prompt
+mix with shared per-tenant prefixes (so the prefix cache sees realistic
+reuse). The schedule is pure data: the same seed always yields the same
+sessions, which is what lets ``benchmarks/bench_swarm_scale.py`` demand
+token parity and byte-identical autoscaler journals across runs.
+
+:mod:`petals_tpu.traffic.runner` replays a schedule OPEN-LOOP against
+real client sessions (thread per session, arrivals never wait on
+completions — a slow swarm gets more concurrent load, like real users).
+Compose with ``PETALS_TPU_CHAOS`` to add faults under the wave.
+"""
+
+from petals_tpu.traffic.generator import SessionPlan, TrafficConfig, TrafficGenerator
+from petals_tpu.traffic.runner import SessionResult, run_schedule
+
+__all__ = [
+    "SessionPlan",
+    "SessionResult",
+    "TrafficConfig",
+    "TrafficGenerator",
+    "run_schedule",
+]

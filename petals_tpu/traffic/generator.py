@@ -1,0 +1,116 @@
+"""Seeded traffic generator: seed -> deterministic session schedule.
+
+Models the shape of public-swarm load without owning production traffic:
+
+- **Diurnal wave**: arrivals follow a nonhomogeneous Poisson process with
+  rate ``base_rate * (1 + wave_amplitude * sin(2*pi*t/wave_period_s))``,
+  sampled by Lewis-Shedler thinning (draw from the peak rate, keep each
+  arrival with probability rate(t)/peak) — exact for any bounded rate
+  function and trivially deterministic under a seeded RNG.
+- **Heavy-tailed sessions**: decode lengths draw from a truncated Pareto
+  (most sessions short, a few very long — the distribution that actually
+  stresses lane occupancy and the swap tier).
+- **N-tenant prompt mix**: each tenant owns a fixed prompt prefix (drawn
+  once from the seed) plus a per-session random suffix, so the prefix
+  cache sees realistic reuse and the ledger sees distinct tenants.
+
+Everything derives from one ``random.Random(seed)`` in a fixed draw
+order; the schedule is pure data (no wall clock anywhere).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import List, Tuple
+
+__all__ = ["SessionPlan", "TrafficConfig", "TrafficGenerator"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionPlan:
+    """One scheduled session: arrive at ``t`` (seconds from run start),
+    send ``prompt``, decode ``new_tokens`` greedily."""
+
+    index: int
+    t: float
+    tenant: int
+    prompt: Tuple[int, ...]  # token ids
+    new_tokens: int
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficConfig:
+    seed: int = 0
+    duration_s: float = 60.0
+    base_rate: float = 0.5  # mean arrivals/s at wave midline
+    wave_amplitude: float = 0.8  # 0 = flat, 1 = rate swings to zero at trough
+    wave_period_s: float = 60.0  # one "day" of the diurnal cycle
+    tenants: int = 3
+    prompt_prefix_len: int = 4  # shared per-tenant prefix (prefix-cache reuse)
+    prompt_suffix_len: int = 3  # per-session random tail
+    vocab_size: int = 1000
+    min_new_tokens: int = 2  # Pareto x_m (scale)
+    max_new_tokens: int = 16  # truncation cap (keeps CPU benches bounded)
+    pareto_alpha: float = 1.5  # tail index; <2 = heavy tail, infinite variance
+
+    def __post_init__(self):
+        if not 0.0 <= self.wave_amplitude <= 1.0:
+            raise ValueError("wave_amplitude must be in [0, 1]")
+        if self.base_rate <= 0 or self.duration_s <= 0:
+            raise ValueError("base_rate and duration_s must be positive")
+        if self.tenants < 1:
+            raise ValueError("need at least one tenant")
+        if not 1 <= self.min_new_tokens <= self.max_new_tokens:
+            raise ValueError("need 1 <= min_new_tokens <= max_new_tokens")
+
+
+class TrafficGenerator:
+    def __init__(self, config: TrafficConfig):
+        self.config = config
+
+    def rate_at(self, t: float) -> float:
+        cfg = self.config
+        return cfg.base_rate * (
+            1.0 + cfg.wave_amplitude * math.sin(2.0 * math.pi * t / cfg.wave_period_s)
+        )
+
+    def schedule(self) -> List[SessionPlan]:
+        """The full deterministic schedule for ``duration_s`` seconds."""
+        cfg = self.config
+        rng = random.Random(cfg.seed)
+        prefixes = [
+            tuple(rng.randrange(1, cfg.vocab_size) for _ in range(cfg.prompt_prefix_len))
+            for _ in range(cfg.tenants)
+        ]
+        peak = cfg.base_rate * (1.0 + cfg.wave_amplitude)
+        plans: List[SessionPlan] = []
+        t = 0.0
+        while True:
+            # thinning: homogeneous candidate stream at the peak rate...
+            t += rng.expovariate(peak)
+            if t >= cfg.duration_s:
+                break
+            # ...accepted with probability rate(t)/peak (draw unconditionally
+            # so the RNG stream — and thus the schedule — is reproducible)
+            if rng.random() >= self.rate_at(t) / peak:
+                continue
+            tenant = rng.randrange(cfg.tenants)
+            suffix = tuple(
+                rng.randrange(1, cfg.vocab_size) for _ in range(cfg.prompt_suffix_len)
+            )
+            # truncated Pareto via inverse CDF: x_m * (1-u)^(-1/alpha)
+            u = rng.random()
+            length = int(cfg.min_new_tokens * (1.0 - u) ** (-1.0 / cfg.pareto_alpha))
+            new_tokens = max(cfg.min_new_tokens, min(cfg.max_new_tokens, length))
+            plans.append(
+                SessionPlan(
+                    index=len(plans),
+                    t=t,
+                    tenant=tenant,
+                    prompt=prefixes[tenant] + suffix,
+                    new_tokens=new_tokens,
+                )
+            )
+        return plans

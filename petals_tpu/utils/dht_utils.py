@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 from typing import Dict, List, Optional, Sequence
 
+from petals_tpu import chaos
 from petals_tpu.data_structures import (
     ModuleUID,
     PeerID,
@@ -69,6 +70,10 @@ async def get_remote_module_infos(
     addr_book maps peer ids to their announced contact addresses."""
     from petals_tpu.dht.identity import verify_announcement
 
+    if chaos.ENABLED:
+        await chaos.inject(
+            chaos.SITE_DHT_LOOKUP, detail=str(uids[0]) if uids else None
+        )
     records = await asyncio.gather(*(dht.get(uid) for uid in uids))
     out: List[Optional[RemoteModuleInfo]] = []
     addr_book: Dict[PeerID, PeerAddr] = {}

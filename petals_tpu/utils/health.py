@@ -34,6 +34,27 @@ from petals_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
+def _f(value, default: float = 0.0) -> float:
+    """Best-effort float: announce digests come from OTHER servers (possibly
+    older versions, possibly hostile) — a malformed field must degrade to the
+    default, never poison the whole aggregate row."""
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return default
+
+
+def _i(value, default: int = 0) -> int:
+    try:
+        return int(float(value))
+    except (TypeError, ValueError, OverflowError):
+        return default
+
+
+def _d(value) -> dict:
+    return value if isinstance(value, dict) else {}
+
+
 class HealthMonitor:
     def __init__(
         self,
@@ -190,15 +211,20 @@ class HealthMonitor:
             }
             consumers: Dict[str, dict] = {}
             for peer, s in model["servers"].items():
+                # Per-field tolerant folding: older servers announce digests
+                # missing newer keys (ledger, compile_stats), and a hostile
+                # peer can announce garbage types. Each field degrades to its
+                # zero/None independently — the server's row is ALWAYS kept,
+                # and one bad field never poisons the rest of the aggregate.
                 digest = s.get("telemetry")
-                pool = s.get("pool") or {}
-                agg["lanes"] += int(pool.get("lanes") or 0)
-                agg["busy_lanes"] += int(pool.get("busy_lanes") or 0)
+                pool = _d(s.get("pool"))
+                agg["lanes"] += _i(pool.get("lanes"))
+                agg["busy_lanes"] += _i(pool.get("busy_lanes"))
                 compile_stats = s.get("compile_stats")
                 if isinstance(compile_stats, dict):
-                    agg["compiled_programs"] += int(compile_stats.get("programs") or 0)
-                    agg["compile_anomalies"] += int(compile_stats.get("anomalies") or 0)
-                    agg["compile_s"] += float(compile_stats.get("compile_s") or 0.0)
+                    agg["compiled_programs"] += _i(compile_stats.get("programs"))
+                    agg["compile_anomalies"] += _i(compile_stats.get("anomalies"))
+                    agg["compile_s"] += _f(compile_stats.get("compile_s"))
                 servers[peer] = {
                     "public_name": s.get("public_name"),
                     "blocks": s.get("blocks"),
@@ -209,25 +235,26 @@ class HealthMonitor:
                 if not isinstance(digest, dict):
                     continue
                 agg["servers_reporting"] += 1
-                agg["tok_s"] += float(digest.get("tok_s") or 0.0)
-                agg["tokens_total"] += int(digest.get("tokens_total") or 0)
-                agg["swap_out_bytes"] += int(digest.get("swap_out_bytes") or 0)
-                agg["swap_in_bytes"] += int(digest.get("swap_in_bytes") or 0)
-                agg["preemptions"] += int(digest.get("preemptions") or 0)
-                agg["alloc_failed"] += int(digest.get("alloc_failed") or 0)
+                agg["tok_s"] += _f(digest.get("tok_s"))
+                agg["tokens_total"] += _i(digest.get("tokens_total"))
+                agg["swap_out_bytes"] += _i(digest.get("swap_out_bytes"))
+                agg["swap_in_bytes"] += _i(digest.get("swap_in_bytes"))
+                agg["preemptions"] += _i(digest.get("preemptions"))
+                agg["alloc_failed"] += _i(digest.get("alloc_failed"))
                 for src, dst in (("ttft_p99_ms", "ttft_p99_ms_max"),
                                  ("step_p99_ms", "step_p99_ms_max")):
                     value = digest.get(src)
-                    if isinstance(value, (int, float)):
+                    if isinstance(value, (int, float)) and not isinstance(value, bool):
                         prev = agg[dst]
                         agg[dst] = value if prev is None else max(prev, value)
-                ledger = digest.get("ledger")
-                if isinstance(ledger, dict):
-                    agg["ledger_page_s"] += float(ledger.get("page_s") or 0.0)
-                    agg["ledger_compute_s"] += float(ledger.get("compute_s") or 0.0)
-                    agg["ledger_sessions"] += int(ledger.get("sessions") or 0)
-                    agg["noisy_neighbor_events"] += int(ledger.get("noisy") or 0)
-                    for entry in ledger.get("top") or []:
+                ledger = _d(digest.get("ledger"))
+                if ledger:
+                    agg["ledger_page_s"] += _f(ledger.get("page_s"))
+                    agg["ledger_compute_s"] += _f(ledger.get("compute_s"))
+                    agg["ledger_sessions"] += _i(ledger.get("sessions"))
+                    agg["noisy_neighbor_events"] += _i(ledger.get("noisy"))
+                    top = ledger.get("top")
+                    for entry in top if isinstance(top, (list, tuple)) else []:
                         try:
                             tenant, share, page_s = entry[0], float(entry[1]), float(entry[2])
                         except (TypeError, ValueError, IndexError):
@@ -297,7 +324,7 @@ class HealthMonitor:
                 "<th>swap</th><th>frag</th><th>compiled</th><th>quant</th><th>via relay</th></tr>"
             )
             for peer, s in model["servers"].items():
-                pool = s.get("pool")
+                pool = s.get("pool") if isinstance(s.get("pool"), dict) else None
                 if pool:
                     load = f"{pool.get('busy_lanes', 0)}/{pool.get('lanes', 0)} lanes"
                     if pool.get("suspended"):
@@ -311,26 +338,33 @@ class HealthMonitor:
                 tok_s_cell = f"{tok_s:.1f}" if isinstance(tok_s, (int, float)) else "—"
                 ttft = digest.get("ttft_p99_ms")
                 ttft_cell = f"{ttft:.0f} ms" if isinstance(ttft, (int, float)) else "—"
-                swap_bytes = (digest.get("swap_out_bytes") or 0) + (digest.get("swap_in_bytes") or 0)
+                swap_bytes = _i(digest.get("swap_out_bytes")) + _i(digest.get("swap_in_bytes"))
                 swap_cell = f"{swap_bytes / 2**20:.1f} MiB" if swap_bytes else "—"
                 frag = digest.get("frag")
                 frag_cell = f"{frag:.2f}" if isinstance(frag, (int, float)) else "—"
                 cs = s.get("compile_stats") if isinstance(s.get("compile_stats"), dict) else {}
                 if cs:
-                    compiled_cell = f"{cs.get('programs', 0)}p"
-                    anomalies = cs.get("anomalies") or 0
+                    compiled_cell = f"{_i(cs.get('programs'))}p"
+                    anomalies = _i(cs.get("anomalies"))
                     if anomalies:
                         compiled_cell += f" / ⚠️ {anomalies} anomalies"
                 else:
                     compiled_cell = "—"
+                throughput = s.get("throughput")
+                throughput_cell = (
+                    f"{throughput:.1f}"
+                    if isinstance(throughput, (int, float)) and not isinstance(throughput, bool)
+                    else "—"
+                )
+                blocks = s.get("blocks") or ["?", "?"]
                 rows.append(
                     f"<tr><td><code>{peer[:12]}…</code> {html.escape(s.get('public_name') or '')}</td>"
-                    f"<td>{s['state']}</td><td>[{s['blocks'][0]}, {s['blocks'][1]})</td>"
-                    f"<td>{s['throughput']:.1f}</td><td>{s['cache_tokens_left']}</td>"
+                    f"<td>{html.escape(str(s.get('state')))}</td><td>[{blocks[0]}, {blocks[1]})</td>"
+                    f"<td>{throughput_cell}</td><td>{s.get('cache_tokens_left')}</td>"
                     f"<td>{html.escape(load)}</td>"
                     f"<td>{tok_s_cell}</td><td>{ttft_cell}</td><td>{swap_cell}</td>"
                     f"<td>{frag_cell}</td><td>{compiled_cell}</td>"
-                    f"<td>{html.escape(str(s['quant_type']))}</td><td>{'yes' if s['relayed'] else 'no'}</td></tr>"
+                    f"<td>{html.escape(str(s.get('quant_type')))}</td><td>{'yes' if s.get('relayed') else 'no'}</td></tr>"
                 )
             rows.append("</table>")
         updated = self._state["updated_at"]

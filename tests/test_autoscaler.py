@@ -1,0 +1,449 @@
+"""Autoscaler policy as a pure function: canned snapshots in, decisions
+out — no live servers, no DHT, no clocks. Hysteresis, cooldowns, the
+coverage constraints, and journal byte-determinism are all provable on
+hand-built :class:`SwarmSnapshot` sequences; the live closed loop is
+exercised by ``benchmarks/bench_swarm_scale.py``."""
+
+import asyncio
+
+import pytest
+
+pytestmark = pytest.mark.traffic
+
+from petals_tpu.swarm import (
+    Autoscaler,
+    AutoscalerPolicy,
+    CallbackActuator,
+    PolicyConfig,
+    ServerSample,
+    SwarmSnapshot,
+)
+from petals_tpu.swarm.policy import snapshot_from_health
+
+
+def srv(
+    peer, start=0, end=4, state="online", throughput=100.0,
+    lanes=4, busy=0, waiters=0,
+):
+    return ServerSample(
+        peer=peer, start=start, end=end, state=state, throughput=throughput,
+        lanes=lanes, busy_lanes=busy, lane_waiters=waiters,
+    )
+
+
+def snap(tick, servers, ttft=None, num_blocks=4):
+    return SwarmSnapshot(
+        tick=tick, num_blocks=num_blocks, servers=tuple(servers), ttft_p99_ms=ttft
+    )
+
+
+def cfg(**overrides):
+    defaults = dict(
+        ttft_p99_ms=1000.0, queue_share_high=0.5, queue_share_low=0.1,
+        sustain_out=2, sustain_in=3, cooldown_out=5, cooldown_in=5,
+        cooldown_resize=10, cooldown_global=2, min_replicas=1, max_replicas=8,
+    )
+    defaults.update(overrides)
+    return PolicyConfig(**defaults)
+
+
+HOT = [srv("a", waiters=4)]  # queue_share 1.0
+COOL = [srv("a")]  # queue_share 0.0
+WARM = [srv("a", lanes=4, waiters=1)]  # 0.25: between low and high
+
+
+# ------------------------------------------------------------------ scale out
+
+
+def test_scale_out_fires_after_sustained_hot_signal():
+    policy = AutoscalerPolicy(cfg())
+    assert policy.observe(snap(0, HOT)) == []  # streak 1 < sustain_out
+    decisions = policy.observe(snap(1, HOT))
+    assert len(decisions) == 1
+    d = decisions[0]
+    assert d.action == "scale_out" and d.target is None
+    assert d.span == (0, 4)  # span_blocks=0 -> full model
+    assert d.evidence["queue_share"] == pytest.approx(1.0)
+    # firing resets the streak: new capacity must re-earn the signal
+    assert policy._hot_streak == 0
+
+
+def test_ttft_breach_is_a_hot_signal_even_with_empty_queues():
+    policy = AutoscalerPolicy(cfg())
+    policy.observe(snap(0, COOL, ttft=5000.0))
+    decisions = policy.observe(snap(1, COOL, ttft=5000.0))
+    assert [d.action for d in decisions] == ["scale_out"]
+    assert "sustained hot signal" in decisions[0].reason
+
+
+def test_hysteresis_band_neither_builds_nor_resets_the_streak():
+    policy = AutoscalerPolicy(cfg(sustain_out=2))
+    policy.observe(snap(0, HOT))  # streak 1
+    for t in range(1, 5):  # flicker in the in-between band
+        assert policy.observe(snap(t, WARM)) == []
+    assert policy._hot_streak == 1, "warm ticks must not reset the evidence"
+    decisions = policy.observe(snap(5, HOT))  # streak 2 -> fire
+    assert [d.action for d in decisions] == ["scale_out"]
+
+
+def test_cool_tick_resets_the_hot_streak():
+    policy = AutoscalerPolicy(cfg(sustain_out=2))
+    policy.observe(snap(0, HOT))
+    policy.observe(snap(1, COOL))  # full reset
+    assert policy._hot_streak == 0
+    assert policy.observe(snap(2, HOT)) == []  # streak restarts at 1
+    assert [d.action for d in policy.observe(snap(3, HOT))] == ["scale_out"]
+
+
+def test_scale_out_respects_max_replicas():
+    policy = AutoscalerPolicy(cfg(max_replicas=1))
+    policy.observe(snap(0, HOT))
+    assert policy.observe(snap(1, HOT)) == []
+
+
+def test_scale_out_cooldown_rate_limits():
+    policy = AutoscalerPolicy(cfg(sustain_out=1, cooldown_out=5))
+    assert len(policy.observe(snap(0, HOT))) == 1
+    for t in range(1, 5):  # still hot, still < cooldown_out ticks since
+        assert policy.observe(snap(t, HOT)) == []
+    assert len(policy.observe(snap(5, HOT))) == 1  # cooldown elapsed
+
+
+def test_scale_out_targets_weakest_coverage_window():
+    servers = [
+        srv("front", 0, 2, throughput=1000.0, waiters=8),
+        srv("back", 2, 4, throughput=10.0, waiters=8),
+    ]
+    policy = AutoscalerPolicy(cfg(span_blocks=2))
+    policy.observe(snap(0, servers))
+    (d,) = policy.observe(snap(1, servers))
+    assert d.action == "scale_out"
+    assert d.span == (2, 4), "replica must land on the weak back span"
+    assert d.evidence["window_coverage"] == pytest.approx(20.0)
+
+
+def test_scale_out_span_tie_breaks_on_lowest_start():
+    policy = AutoscalerPolicy(cfg(span_blocks=2, sustain_out=1))
+    uniform = [srv("a", 0, 4, throughput=100.0, waiters=8)]
+    (d,) = policy.observe(snap(0, uniform))
+    assert d.span == (0, 2)
+
+
+# ------------------------------------------------------------------- scale in
+
+
+def test_scale_in_drains_the_sustained_cold_lowest_throughput_replica():
+    servers = [
+        srv("big", throughput=1000.0),
+        srv("small", throughput=10.0),
+    ]
+    policy = AutoscalerPolicy(cfg(sustain_in=3, cooldown_in=0))
+    decisions = []
+    for t in range(4):
+        decisions += policy.observe(snap(t, servers))
+    assert [d.action for d in decisions] == ["scale_in"]
+    d = decisions[0]
+    assert d.tick == 2  # cold streak reaches 3 on the third tick
+    assert d.target == "small", "victim is the lowest-throughput cold replica"
+    assert d.span == (0, 4)
+    assert d.evidence["cold_streak"] == 3
+
+
+def test_scale_in_never_fires_while_hot():
+    # both replicas idle, but a TTFT breach keeps the swarm hot
+    # (max_replicas caps scale_out so the hot signal cannot act either way)
+    servers = [srv("a"), srv("b")]
+    policy = AutoscalerPolicy(cfg(sustain_in=1, max_replicas=2))
+    for t in range(5):
+        assert policy.observe(snap(t, servers, ttft=5000.0)) == []
+
+
+def test_scale_in_waits_out_its_cooldown_at_controller_start():
+    """On tick one every replica looks cold (no history): the first
+    scale_in must still serve a full cooldown from controller start, or a
+    restarted controller would harvest replicas on no evidence."""
+    servers = [srv("a"), srv("b", throughput=1.0)]
+    policy = AutoscalerPolicy(cfg(sustain_in=1, cooldown_in=4))
+    for t in range(4):
+        assert policy.observe(snap(t, servers)) == []
+    (d,) = policy.observe(snap(4, servers))
+    assert d.action == "scale_in" and d.target == "b"
+
+
+def test_scale_out_is_exempt_from_the_startup_grace():
+    # adding capacity early is cheap: a hot swarm scales out immediately
+    policy = AutoscalerPolicy(cfg(sustain_out=1, cooldown_out=100))
+    (d,) = policy.observe(snap(0, HOT))
+    assert d.action == "scale_out"
+
+
+def test_scale_in_respects_min_replicas():
+    policy = AutoscalerPolicy(cfg(sustain_in=1, min_replicas=2))
+    servers = [srv("a"), srv("b")]
+    for t in range(5):
+        assert policy.observe(snap(t, servers)) == []
+
+
+def test_scale_in_never_uncovers_a_block():
+    # "solo" is cold but the ONLY server on blocks [2,4): untouchable.
+    servers = [
+        srv("front", 0, 2, throughput=5.0, busy=1),  # busy: never a candidate
+        srv("solo", 2, 4, throughput=1.0),
+    ]
+    policy = AutoscalerPolicy(cfg(sustain_in=1, cooldown_in=0))
+    for t in range(5):
+        assert policy.observe(snap(t, servers)) == []
+
+
+def test_cold_streak_resets_on_activity_and_drops_with_the_server():
+    policy = AutoscalerPolicy(cfg(sustain_in=3, min_replicas=2))
+    servers = [srv("a"), srv("b", throughput=1.0)]
+    policy.observe(snap(0, servers))
+    policy.observe(snap(1, servers))
+    # b takes traffic on tick 2: its streak resets (while staying cool swarm-wide)
+    busy_b = [srv("a"), srv("b", throughput=1.0, busy=1)]
+    policy.observe(snap(2, busy_b))
+    assert policy._cold_streaks["b"] == 0
+    # b vanishes from the snapshot entirely: streak bookkeeping follows
+    policy.observe(snap(3, [srv("a")]))
+    assert "b" not in policy._cold_streaks
+
+
+def test_global_cooldown_separates_any_two_decisions():
+    policy = AutoscalerPolicy(
+        cfg(sustain_out=1, sustain_in=1, cooldown_out=1, cooldown_in=1,
+            cooldown_global=3)
+    )
+    (d,) = policy.observe(snap(0, [srv("a", waiters=8), srv("b", throughput=1.0)]))
+    assert d.action == "scale_out"
+    # swarm instantly cool + replica cold — but the global cooldown holds
+    cool2 = [srv("a"), srv("b", throughput=1.0)]
+    assert policy.observe(snap(1, cool2)) == []
+    assert policy.observe(snap(2, cool2)) == []
+    (d2,) = policy.observe(snap(3, cool2))
+    assert d2.action == "scale_in" and d2.target == "b"
+
+
+# --------------------------------------------------------------------- resize
+
+
+def _imbalanced_servers():
+    # block 3 is covered only by "mover" at 10 tok/s; blocks 0-1 at 1000.
+    return [
+        srv("anchor", 0, 4, throughput=10.0),  # full span: not movable
+        srv("heavy", 0, 2, throughput=990.0, busy=1),
+        srv("mover", 2, 3, throughput=40.0),  # partial, cold, off the weak block
+    ]
+
+
+def test_resize_moves_a_cold_partial_replica_onto_the_weak_block():
+    policy = AutoscalerPolicy(cfg(resize_imbalance=4.0, cooldown_resize=0))
+    servers = _imbalanced_servers()
+    # cold streaks fold in before the decision, so the first cool tick is
+    # already enough evidence that the mover is safe to yank
+    (d,) = policy.observe(snap(0, servers))
+    assert d.action == "resize" and d.target == "mover"
+    assert d.span == (3, 4)  # 1-block span centered on weakest block 3
+    assert d.evidence["weakest_block"] == 3
+    assert d.evidence["old_span"] == [2, 3]
+
+
+def test_resize_requires_material_imbalance():
+    # sustain_in is pushed out of reach so scale_in stays out of the picture
+    policy = AutoscalerPolicy(
+        cfg(resize_imbalance=1000.0, sustain_in=100, cooldown_resize=0)
+    )
+    servers = _imbalanced_servers()
+    for t in range(5):
+        assert policy.observe(snap(t, servers)) == []
+
+
+def test_resize_never_yanks_the_sole_cover_of_a_block():
+    # mover is partial and cold but uniquely covers block 2
+    servers = [
+        srv("heavy", 0, 2, throughput=1000.0, busy=1),
+        srv("mover", 2, 3, throughput=10.0),
+        srv("tail", 3, 4, throughput=10.0, busy=1),
+    ]
+    policy = AutoscalerPolicy(cfg(cooldown_resize=0))
+    for t in range(5):
+        assert policy.observe(snap(t, servers)) == []
+
+
+# ------------------------------------------------------ determinism + journal
+
+
+def _scripted_sequence():
+    """A day in the life: hot build-up, scale-out, cool-down, cold drain."""
+    seq = []
+    # b works through the hot phase (cold streaks build even while the swarm
+    # is hot — they are only ACTED on once it cools), then goes idle
+    hot = [srv("a", waiters=6), srv("b", throughput=50.0, busy=1)]
+    cool = [srv("a"), srv("b", throughput=50.0)]
+    for t in range(3):
+        seq.append(snap(t, hot, ttft=1500.0))
+    for t in range(3, 10):
+        seq.append(snap(t, cool, ttft=100.0))
+    return seq
+
+
+def test_journal_is_byte_identical_across_replays():
+    runs = []
+    for _ in range(2):
+        policy = AutoscalerPolicy(cfg())
+        for s in _scripted_sequence():
+            policy.observe(s)
+        runs.append(policy.journal_jsonl())
+    assert runs[0] == runs[1]
+    assert runs[0], "the scripted sequence must actually produce decisions"
+    # parse the jsonl back: every line is canonical JSON with sorted keys
+    import json
+
+    lines = [json.loads(line) for line in runs[0].split("\n")]
+    assert [line["action"] for line in lines] == ["scale_out", "scale_in"]
+    assert (
+        json.dumps(lines[0], sort_keys=True, separators=(",", ":"))
+        == runs[0].split("\n")[0]
+    )
+
+
+def test_decision_journal_rounds_floats_for_byte_stability():
+    policy = AutoscalerPolicy(cfg(sustain_out=1))
+    (d,) = policy.observe(snap(0, [srv("a", lanes=3, waiters=2)]))
+    entry = policy.journal[0]
+    # 2/3 is not float-representable: the journal stores the 6-dp rounding
+    assert entry["evidence"]["queue_share"] == round(2.0 / 3.0, 6)
+
+
+def test_policy_config_validation():
+    with pytest.raises(ValueError):
+        PolicyConfig(min_replicas=0)
+    with pytest.raises(ValueError):
+        PolicyConfig(min_replicas=4, max_replicas=2)
+    with pytest.raises(ValueError):
+        PolicyConfig(queue_share_low=0.9, queue_share_high=0.5)
+
+
+# ------------------------------------------------------- snapshot from health
+
+
+def test_snapshot_from_health_tolerates_partial_and_garbage_digests():
+    model_state = {
+        "num_blocks": 4,
+        "servers": {
+            "good": {
+                "state": "ONLINE", "blocks": [0, 4], "throughput": 100.0,
+                "pool": {"lanes": 2, "busy_lanes": 1, "lane_waiters": 3},
+                "telemetry": {"ttft_p99_ms": 250.0},
+            },
+            "bare": {"state": "ONLINE", "blocks": [2, 4]},  # no pool/telemetry
+            "hostile": {
+                "state": "ONLINE", "blocks": [0, 4], "throughput": "fast",
+                "pool": ["not", "a", "dict"],
+                "telemetry": {"ttft_p99_ms": "slow"},
+            },
+            "not-a-dict": "garbage",
+        },
+    }
+    s = snapshot_from_health(model_state, tick=7)
+    assert s.tick == 7 and s.num_blocks == 4
+    assert [x.peer for x in s.servers] == ["bare", "good", "hostile"]  # sorted
+    good = next(x for x in s.servers if x.peer == "good")
+    assert good.lanes == 2 and good.lane_waiters == 3 and good.online
+    hostile = next(x for x in s.servers if x.peer == "hostile")
+    assert hostile.throughput == 0.0 and hostile.lanes == 0
+    assert s.ttft_p99_ms == 250.0  # "slow" never folded
+    assert s.queue_share() == pytest.approx(3 / 2)
+
+
+def test_snapshot_from_health_offline_servers_dont_count():
+    model_state = {
+        "num_blocks": 4,
+        "servers": {
+            "dead": {"state": "OFFLINE", "blocks": [0, 4], "throughput": 100.0,
+                     "pool": {"lanes": 4, "lane_waiters": 4}},
+            "live": {"state": "ONLINE", "blocks": [0, 4], "throughput": 10.0,
+                     "pool": {"lanes": 2}},
+        },
+    }
+    s = snapshot_from_health(model_state, tick=0)
+    assert s.replica_count() == 1
+    assert s.queue_share() == 0.0  # the offline server's waiters are ignored
+    assert s.coverage() == [10.0] * 4
+
+
+# ----------------------------------------------------------------- controller
+
+
+def test_autoscaler_controller_journals_and_survives_actuator_failure():
+    """The impure shell around the policy: decisions reach the telemetry
+    journal with evidence, actuator exceptions are counted but never kill
+    the control loop, and `applied` records what actually happened."""
+    from petals_tpu.telemetry import get_journal
+
+    calls = []
+
+    async def failing_scale_out(span):
+        calls.append(("scale_out", span))
+        raise RuntimeError("spawn quota exceeded")
+
+    def sync_scale_in(peer):
+        calls.append(("scale_in", peer))
+        return True
+
+    actuator = CallbackActuator(scale_out=failing_scale_out, scale_in=sync_scale_in)
+    scaler = Autoscaler(
+        actuator=actuator,
+        config=cfg(sustain_out=1, sustain_in=1, cooldown_global=1, cooldown_out=1,
+                   cooldown_in=1),
+    )
+    baseline = get_journal().event("test_marker")["seq"]
+
+    async def scenario():
+        await scaler.step(snap(0, [srv("a", waiters=8), srv("b", throughput=1.0)]))
+        await scaler.step(snap(1, [srv("a"), srv("b", throughput=1.0)]))
+
+    asyncio.run(scenario())
+
+    assert calls == [("scale_out", (0, 4)), ("scale_in", "b")]
+    assert [(d.action, ok) for d, ok in scaler.applied] == [
+        ("scale_out", False), ("scale_in", True),
+    ]
+    journal = get_journal()
+    decided = journal.events(kind="autoscale_decision", since_seq=baseline)
+    assert [e["action"] for e in decided] == ["scale_out", "scale_in"]
+    assert decided[0]["evidence"]["queue_share"] == 1.0
+    failed = journal.events(kind="autoscale_apply_failed", since_seq=baseline)
+    assert len(failed) == 1 and "spawn quota" in failed[0]["error"]
+    applied = journal.events(kind="autoscale_applied", since_seq=baseline)
+    assert [e["action"] for e in applied] == ["scale_in"]
+
+
+def test_autoscaler_advisory_mode_without_callbacks():
+    scaler = Autoscaler(actuator=CallbackActuator(), config=cfg(sustain_out=1))
+
+    async def scenario():
+        return await scaler.step(snap(0, [srv("a", waiters=8)]))
+
+    decisions = asyncio.run(scenario())
+    assert [d.action for d in decisions] == ["scale_out"]
+    assert scaler.applied == [(decisions[0], False)]  # journaled, not acted on
+
+
+def test_autoscaler_run_loop_skips_failed_ticks():
+    snapshots = {
+        0: snap(0, [srv("a", waiters=8)]),
+        2: snap(2, [srv("a", waiters=8)]),
+    }
+
+    def source(tick):
+        if tick == 1:
+            raise TimeoutError("chaos-dropped DHT lookup")
+        return snapshots.get(tick)
+
+    scaler = Autoscaler(source, config=cfg(sustain_out=2), interval_s=0.0)
+    asyncio.run(scaler.run(max_ticks=3))
+    # tick 1's failed sample is skipped, not fatal; the streak spans the
+    # gap (hot observations at ticks 0 and 2) and fires on the second one
+    assert scaler.tick == 3
+    assert [(d.action, d.tick) for d in scaler.decisions] == [("scale_out", 2)]

@@ -184,6 +184,76 @@ def test_swap_reserve_site_refuses_budget():
     assert pool.bytes_in_use == 2048
 
 
+def test_new_sites_in_grammar():
+    """dht.lookup and rpc.stream_recv are first-class sites: spec-parseable,
+    rule-validatable, and listed for the metric's bounded label set."""
+    assert chaos.SITE_DHT_LOOKUP in chaos.SITES
+    assert chaos.SITE_RPC_STREAM_RECV in chaos.SITES
+    seed, rules = chaos.parse_spec(
+        "seed=5;dht.lookup:drop:0.5;rpc.stream_recv:delay:1.0:0.01:2"
+    )
+    assert seed == 5
+    assert [(r.site, r.action) for r in rules] == [
+        ("dht.lookup", "drop"),
+        ("rpc.stream_recv", "delay"),
+    ]
+    assert rules[1].delay_s == pytest.approx(0.01) and rules[1].max_count == 2
+
+
+def test_dht_lookup_site_fails_route_discovery():
+    """A dropped dht.lookup fails get_remote_module_infos BEFORE any DHT
+    traffic (route discovery is now injectable), with the first uid as the
+    fired detail — and a max_count'd rule lets the retry succeed."""
+    from petals_tpu.utils.dht_utils import get_remote_module_infos
+
+    plane = chaos.configure(
+        rules=[ChaosRule(chaos.SITE_DHT_LOOKUP, "drop", max_count=1)]
+    )
+
+    async def scenario():
+        # dht=None proves the fault fires before the node is ever touched
+        with pytest.raises(ChaosInjected):
+            await get_remote_module_infos(None, ["tiny.0", "tiny.1"])
+
+    asyncio.run(scenario())
+    fired = plane.fired(chaos.SITE_DHT_LOOKUP)
+    assert [e["detail"] for e in fired] == ["tiny.0"]
+
+
+def test_stream_recv_site_injects_mid_stream():
+    """rpc.stream_recv faults the RECEIVE of an already-open stream — the
+    failure mode stream_open can't reach — carrying the stream's method as
+    the match/detail string."""
+    from petals_tpu.rpc.client import StreamCall
+
+    plane = chaos.configure(
+        rules=[
+            ChaosRule(chaos.SITE_RPC_STREAM_RECV, "drop", match="ptu.inference",
+                      max_count=1),
+            ChaosRule(chaos.SITE_RPC_STREAM_RECV, "delay", delay_s=0.05,
+                      match="ptu.other", max_count=1),
+        ]
+    )
+
+    async def scenario():
+        stream = StreamCall(client=None, call_id=1, method="ptu.inference")
+        stream._push({"step": 0})
+        with pytest.raises(ChaosInjected):
+            await stream.recv(timeout=1.0)
+        assert await stream.recv(timeout=1.0) == {"step": 0}  # retry drains it
+
+        other = StreamCall(client=None, call_id=2, method="ptu.other")
+        other._push({"step": 1})
+        t0 = time.monotonic()
+        assert await other.recv(timeout=1.0) == {"step": 1}
+        assert time.monotonic() - t0 >= 0.04  # the delay action slept
+
+    asyncio.run(scenario())
+    assert [e["action"] for e in plane.fired(chaos.SITE_RPC_STREAM_RECV)] == [
+        "drop", "delay",
+    ]
+
+
 # ----------------------------------------------------------- swarm survival
 
 

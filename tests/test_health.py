@@ -60,6 +60,117 @@ def test_bandwidth_probe():
     asyncio.run(asyncio.wait_for(scenario(), 60))
 
 
+def _monitor_with_state(servers: dict) -> HealthMonitor:
+    monitor = HealthMonitor(["127.0.0.1:1/00"])
+    monitor._state = {
+        "updated_at": 1.0,
+        "models": {
+            "tiny-llama-hf": {
+                "public_name": "Tiny",
+                "model_type": "llama",
+                "num_blocks": 4,
+                "blocks_covered": 4,
+                "healthy": True,
+                "servers": servers,
+            }
+        },
+    }
+    return monitor
+
+
+def test_metrics_summary_tolerates_partial_digests():
+    """An older server announcing a digest WITHOUT the newer ledger /
+    compile_stats keys (or without pool/telemetry at all) must still be
+    aggregated field-by-field — never dropped, never poisoning the row."""
+    monitor = _monitor_with_state(
+        {
+            "new-server": {
+                "state": "ONLINE", "blocks": [0, 4], "throughput": 100.0,
+                "pool": {"lanes": 2, "busy_lanes": 1, "lane_waiters": 0},
+                "telemetry": {
+                    "tok_s": 5.0, "tokens_total": 50, "ttft_p99_ms": 120.0,
+                    "ledger": {"page_s": 1.5, "compute_s": 0.5, "sessions": 2,
+                               "noisy": 0, "top": [["tenant-a", 0.9, 1.5]]},
+                },
+                "compile_stats": {"programs": 3, "anomalies": 0, "compile_s": 2.0},
+            },
+            # pre-ledger server: digest has no ledger/compile keys
+            "old-server": {
+                "state": "ONLINE", "blocks": [0, 4], "throughput": 50.0,
+                "pool": {"lanes": 1, "busy_lanes": 1},
+                "telemetry": {"tok_s": 2.0, "tokens_total": 20, "ttft_p99_ms": 300.0},
+                "compile_stats": None,
+            },
+            # ancient server: no pool, no telemetry at all
+            "ancient-server": {
+                "state": "ONLINE", "blocks": [0, 4], "throughput": None,
+                "pool": None, "telemetry": None, "compile_stats": None,
+            },
+        }
+    )
+    summary = monitor.metrics_summary()
+    agg = summary["models"]["tiny-llama-hf"]["aggregate"]
+    servers = summary["models"]["tiny-llama-hf"]["servers"]
+    # every server keeps its row, even the digest-free one
+    assert set(servers) == {"new-server", "old-server", "ancient-server"}
+    assert agg["servers_reporting"] == 2
+    assert agg["tok_s"] == pytest.approx(7.0)
+    assert agg["tokens_total"] == 70
+    assert agg["ttft_p99_ms_max"] == pytest.approx(300.0)
+    assert agg["lanes"] == 3 and agg["busy_lanes"] == 2
+    assert agg["ledger_page_s"] == pytest.approx(1.5)
+    assert agg["compiled_programs"] == 3
+    assert agg["top_consumers"][0]["peer"] == "tenant-a"
+
+
+def test_metrics_summary_tolerates_garbage_digests():
+    """A hostile (or corrupted) announce with WRONG TYPES in every numeric
+    field degrades per-field to zero/None — the endpoint never raises and
+    the honest server's numbers still come through."""
+    monitor = _monitor_with_state(
+        {
+            "honest": {
+                "state": "ONLINE", "blocks": [0, 4], "throughput": 10.0,
+                "pool": {"lanes": 2, "busy_lanes": 0, "lane_waiters": 0},
+                "telemetry": {"tok_s": 4.0, "tokens_total": 8},
+                "compile_stats": None,
+            },
+            "hostile": {
+                "state": "ONLINE", "blocks": [0, 4], "throughput": "fast",
+                "pool": {"lanes": "many", "busy_lanes": ["?"]},
+                "telemetry": {
+                    "tok_s": "NaN-ish", "tokens_total": {}, "ttft_p99_ms": "slow",
+                    "swap_out_bytes": None, "preemptions": "often",
+                    "ledger": {"page_s": "lots", "sessions": [1],
+                               "top": [["t", "x", "y"], "not-a-row", []]},
+                },
+                "compile_stats": {"programs": "best", "anomalies": None,
+                                  "compile_s": "zero"},
+            },
+            "hostile-nondict-pool": {
+                "state": "ONLINE", "blocks": [0, 4], "throughput": 1.0,
+                "pool": ["not", "a", "dict"], "telemetry": "not-a-dict",
+                "compile_stats": "also-not",
+            },
+        }
+    )
+    summary = monitor.metrics_summary()  # must not raise
+    agg = summary["models"]["tiny-llama-hf"]["aggregate"]
+    assert set(summary["models"]["tiny-llama-hf"]["servers"]) == {
+        "honest", "hostile", "hostile-nondict-pool",
+    }
+    assert agg["tok_s"] == pytest.approx(4.0)  # garbage degraded to 0, not lost
+    assert agg["tokens_total"] == 8
+    assert agg["lanes"] == 2  # "many" -> 0
+    assert agg["ttft_p99_ms_max"] is None  # "slow" never folded
+    assert agg["ledger_page_s"] == 0.0
+    assert agg["compiled_programs"] == 0
+    assert agg["top_consumers"] == []  # no parseable rows
+    # the HTML view renders through the same garbage without raising
+    page = monitor._render_html()
+    assert "hostile" in page and "honest" in page
+
+
 def test_health_monitor_e2e(tmp_path):
     """Full loop: server announces modules + registry; the monitor discovers
     the model, reports coverage, and answers the reachability API."""

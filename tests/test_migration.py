@@ -360,3 +360,107 @@ def test_route_upgrade_respects_server_gen_capability(tmp_path_factory):
     finally:
         model.close()
         harness.stop()
+
+
+# --------------------------------------------------------------- migrate abort
+#
+# Drain-to-migrate pushes KV to a peer that may be slow, partitioned, or
+# chaos-delayed. The push must never hang teardown: shutdown() flips an
+# abort signal, and the per-push deadline covers the WHOLE push (chaos
+# delays and serialization included), with `migrate_aborted` journaled as
+# evidence either way. The parked entry stays, so clients still repair by
+# export/replay.
+
+
+def _open_session_on_fast(path, harness):
+    """One live session pinned on the preferred (fast) server."""
+    model = AutoDistributedModelForCausalLM.from_pretrained(
+        path, initial_peers=harness.initial_peers, min_backoff=0.1
+    )
+    rng = np.random.RandomState(3)
+    input_ids = rng.randint(0, 100, (1, 5)).astype(np.int64)
+    session_cm = model.remote.inference_session(max_length=16, batch_size=1)
+    session = session_cm.__enter__()
+    model.generate(input_ids, max_new_tokens=2, session=session)
+    fast = harness.servers[0]
+    assert session._session._sessions[0].span.peer_id == fast.dht.peer_id
+    return model, session_cm, fast
+
+
+def test_shutdown_aborts_inflight_migration(redundant_swarm):
+    """shutdown() during an in-flight (chaos-delayed) migration push must
+    abort the push promptly — journaling ``migrate_aborted`` with reason
+    ``shutdown`` — instead of letting drain wait out the slow peer."""
+    import asyncio
+    import time
+
+    from petals_tpu import chaos
+    from petals_tpu.chaos.plane import ChaosRule
+    from petals_tpu.telemetry import get_journal
+
+    path, harness = redundant_swarm
+    model, session_cm, fast = _open_session_on_fast(path, harness)
+    baseline_seq = get_journal().event("test_marker")["seq"]
+    try:
+        # the push would sleep 60 s at the chaos site; drain must not
+        chaos.configure(
+            seed=0,
+            rules=[ChaosRule(chaos.SITE_MIGRATE_PUSH, "delay", delay_s=60.0)],
+        )
+        drain_future = asyncio.run_coroutine_threadsafe(
+            fast.drain(migrate=True), harness.loop
+        )
+        time.sleep(1.0)  # let the push enter its chaos delay
+        t0 = time.monotonic()
+        harness.run(fast.shutdown())
+        parked = drain_future.result(timeout=30)
+        elapsed = time.monotonic() - t0
+    finally:
+        chaos.disable()
+        session_cm.__exit__(None, None, None)
+        model.close()
+        harness.servers.pop(0)  # stop() must not shut the same server twice
+
+    assert parked == 1
+    assert elapsed < 15.0, f"shutdown waited out the migration push ({elapsed:.1f}s)"
+    aborted = get_journal().events(kind="migrate_aborted", since_seq=baseline_seq)
+    assert len(aborted) == 1
+    assert aborted[0]["reason"] == "shutdown"
+    assert aborted[0]["nbytes"] > 0
+
+
+def test_migration_push_deadline_covers_chaos_delay(redundant_swarm):
+    """The per-push deadline bounds the whole push path: a chaos delay
+    longer than ``deadline_s`` aborts with reason ``deadline`` and the
+    session stays parked for client-side export."""
+    import time
+
+    from petals_tpu import chaos
+    from petals_tpu.chaos.plane import ChaosRule
+    from petals_tpu.telemetry import get_journal
+
+    path, harness = redundant_swarm
+    model, session_cm, fast = _open_session_on_fast(path, harness)
+    baseline_seq = get_journal().event("test_marker")["seq"]
+    try:
+        parked = harness.run(fast.drain(migrate=False))
+        assert parked == 1
+        chaos.configure(
+            seed=0,
+            rules=[ChaosRule(chaos.SITE_MIGRATE_PUSH, "delay", delay_s=30.0)],
+        )
+        t0 = time.monotonic()
+        pushed = harness.run(fast._migrate_parked_sessions(deadline_s=0.5))
+        elapsed = time.monotonic() - t0
+    finally:
+        chaos.disable()
+        session_cm.__exit__(None, None, None)
+        model.close()
+
+    assert pushed == 0, "an aborted push must not count as migrated"
+    assert elapsed < 10.0, f"deadline did not bound the chaos-delayed push ({elapsed:.1f}s)"
+    aborted = get_journal().events(kind="migrate_aborted", since_seq=baseline_seq)
+    assert len(aborted) == 1
+    assert aborted[0]["reason"] == "deadline"
+    # the parked copy survives the abort: clients can still export/replay
+    assert len(fast.handler._parked) == 1

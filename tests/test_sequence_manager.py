@@ -333,3 +333,123 @@ def test_prefix_affinity_under_rtt_noise():
     row = measure(2.0)  # 2 ms raw -> ~0.67 ms smoothed: realistic WAN regime
     assert row["mean_convergence"] >= 0.9, row
     assert row["distinct_modal_replicas"] >= 2, row
+
+
+def test_congestion_refresh_discovers_new_capacity():
+    """request_refresh: a congestion-blamed open must surface capacity
+    announced AFTER the last periodic update without waiting out
+    update_period — an autoscaler's scale-out is useless to clients that
+    stay blind to it — and a burst of requests must collapse to one fetch."""
+
+    async def main():
+        boot, nodes, uids = await _swarm_with_servers(2, [(0, 2, 10.0)])
+        manager = await RemoteSequenceManager.create(
+            ClientConfig(initial_peers=[boot.own_addr.to_string()], update_period=1000), uids
+        )
+        try:
+            await manager.ensure_ready()
+            assert len(manager.state.spans_by_priority) == 1
+            # the scale-out lands AFTER the client built its swarm view
+            node = await DHTNode.create(initial_peers=[boot.own_addr], maintenance_period=1000)
+            nodes.append(node)
+            info = ServerInfo(
+                ServerState.ONLINE, 10.0, start_block=0, end_block=2, inference_rps=10.0
+            )
+            await declare_active_modules(node, uids[0:2], info, time.time() + 60)
+
+            manager.request_refresh()
+            deadline = time.monotonic() + 15
+            while len({s.peer_id for s in manager.state.spans_by_priority}) < 2:
+                assert time.monotonic() < deadline, "refresh never surfaced the new replica"
+                await asyncio.sleep(0.05)
+            # rate limit: an immediate second request is a no-op
+            before = manager._last_refresh_req
+            manager.request_refresh()
+            assert manager._last_refresh_req == before
+        finally:
+            await manager.shutdown()
+            for n in nodes + [boot]:
+                await n.shutdown()
+
+    run(main())
+
+
+def test_open_wait_piggyback_blames_and_refreshes():
+    """A lane-admission wait piggybacked on the session_open ack must fold
+    into the hop's queue component and IMMEDIATELY blame the peer and kick a
+    routing refresh: short sessions (most interactive traffic) never reach
+    the periodic step-cadence blame check. Also pins the alloc_timeout
+    config field onto the open message wire format."""
+    from petals_tpu.client.inference_session import _ServerInferenceSession
+    from petals_tpu.data_structures import RemoteSpanInfo
+
+    class FakeStream:
+        def __init__(self, ack):
+            self.sent = []
+            self._ack = ack
+
+        async def send(self, msg):
+            self.sent.append(msg)
+
+        async def recv(self, timeout=None):
+            return self._ack
+
+    class FakeStub:
+        def __init__(self, stream):
+            self._stream = stream
+
+        async def open_stream(self, route):
+            return self._stream
+
+    class FakeSeqManager:
+        def __init__(self, stream, config):
+            self.config = config
+            self._stream = stream
+            self.blamed = []
+            self.refreshes = 0
+
+        async def get_stub(self, peer_id):
+            return FakeStub(self._stream)
+
+        def report_congestion(self, peer_id, share):
+            self.blamed.append((peer_id, share))
+
+        def request_refresh(self):
+            self.refreshes += 1
+
+    async def main():
+        peer = PeerID.generate()
+        span = RemoteSpanInfo(
+            peer, 0, 2, ServerInfo(ServerState.ONLINE, 1.0, start_block=0, end_block=2)
+        )
+        stream = FakeStream({"session_open": True, "open_wait_s": 1.25})
+        mgr = FakeSeqManager(stream, ClientConfig(initial_peers=(), alloc_timeout=4.0))
+        sess = await _ServerInferenceSession.create(
+            mgr, span, ["m.0", "m.1"], max_length=16
+        )
+        assert stream.sent[0]["alloc_timeout"] == 4.0
+        assert sess.hop.queue_share() > 0.5
+        assert mgr.blamed and mgr.blamed[0][0] == peer and mgr.blamed[0][1] > 0.5
+        assert mgr.refreshes == 1
+
+        # mid-range wait: folded into the waterfall but NOT blamed
+        quiet = FakeStream({"session_open": True, "open_wait_s": 0.2})
+        mgr2 = FakeSeqManager(quiet, ClientConfig(initial_peers=()))
+        sess2 = await _ServerInferenceSession.create(
+            mgr2, span, ["m.0", "m.1"], max_length=16
+        )
+        assert "alloc_timeout" not in quiet.sent[0]
+        assert sess2.hop.queue_s > 0.0
+        assert not mgr2.blamed and mgr2.refreshes == 0
+
+        # an uncontended acquire's microsecond wait must not touch the hop
+        # trace at all — no phantom zero-token step on every session
+        idle = FakeStream({"session_open": True, "open_wait_s": 1e-5})
+        mgr3 = FakeSeqManager(idle, ClientConfig(initial_peers=()))
+        sess3 = await _ServerInferenceSession.create(
+            mgr3, span, ["m.0", "m.1"], max_length=16
+        )
+        assert sess3.hop.steps == 0 and sess3.hop.queue_s == 0.0
+        assert not mgr3.blamed and mgr3.refreshes == 0
+
+    run(main())

@@ -1,0 +1,178 @@
+"""Deterministic traffic plane: seeded generator (diurnal wave, Pareto
+session lengths, tenant prompt mixes) and the open-loop schedule runner.
+All pure-data / local-thread tests — no swarm, no model."""
+
+import collections
+import threading
+import time
+
+import pytest
+
+pytestmark = pytest.mark.traffic
+
+from petals_tpu.traffic import SessionPlan, TrafficConfig, TrafficGenerator, run_schedule
+
+
+def gen(**overrides):
+    defaults = dict(
+        seed=42, duration_s=120.0, base_rate=2.0, wave_amplitude=0.8,
+        wave_period_s=120.0, tenants=3, vocab_size=100,
+        min_new_tokens=2, max_new_tokens=16,
+    )
+    defaults.update(overrides)
+    return TrafficGenerator(TrafficConfig(**defaults))
+
+
+# ------------------------------------------------------------------ generator
+
+
+def test_schedule_is_deterministic_per_seed():
+    a, b = gen().schedule(), gen().schedule()
+    assert a == b
+    assert a, "the canned config must produce traffic"
+    assert a != gen(seed=43).schedule()
+
+
+def test_plans_are_ordered_well_formed_sessions():
+    cfg = gen().config
+    plans = gen().schedule()
+    times = [p.t for p in plans]
+    assert times == sorted(times)
+    assert all(0.0 < p.t < cfg.duration_s for p in plans)
+    assert [p.index for p in plans] == list(range(len(plans)))
+    for p in plans:
+        assert 0 <= p.tenant < cfg.tenants
+        assert len(p.prompt) == cfg.prompt_prefix_len + cfg.prompt_suffix_len
+        assert all(1 <= tok < cfg.vocab_size for tok in p.prompt)
+        assert cfg.min_new_tokens <= p.new_tokens <= cfg.max_new_tokens
+
+
+def test_diurnal_wave_shapes_the_arrivals():
+    """With one full sine period, the first half (wave above the midline)
+    must see materially more arrivals than the second (below)."""
+    g = gen(seed=7, base_rate=4.0)
+    cfg = g.config
+    assert g.rate_at(cfg.wave_period_s / 4) == pytest.approx(
+        cfg.base_rate * (1 + cfg.wave_amplitude)
+    )
+    assert g.rate_at(3 * cfg.wave_period_s / 4) == pytest.approx(
+        cfg.base_rate * (1 - cfg.wave_amplitude)
+    )
+    plans = g.schedule()
+    half = cfg.duration_s / 2
+    first = sum(1 for p in plans if p.t < half)
+    second = len(plans) - first
+    assert first > 1.5 * second, (first, second)
+
+
+def test_tenants_share_a_fixed_prefix_with_random_suffixes():
+    cfg = gen().config
+    plans = gen().schedule()
+    by_tenant = collections.defaultdict(list)
+    for p in plans:
+        by_tenant[p.tenant].append(p.prompt)
+    assert len(by_tenant) == cfg.tenants  # every tenant shows up
+    prefixes = {}
+    for tenant, prompts in by_tenant.items():
+        heads = {p[: cfg.prompt_prefix_len] for p in prompts}
+        assert len(heads) == 1, "tenant prefix must be fixed (prefix-cache reuse)"
+        prefixes[tenant] = heads.pop()
+        tails = {p[cfg.prompt_prefix_len:] for p in prompts}
+        assert len(tails) > 1, "per-session suffixes must vary"
+    assert len(set(prefixes.values())) == cfg.tenants, "tenants are distinct"
+
+
+def test_session_lengths_are_heavy_tailed_but_truncated():
+    plans = gen(duration_s=600.0).schedule()
+    lengths = [p.new_tokens for p in plans]
+    cfg = gen().config
+    assert min(lengths) == cfg.min_new_tokens  # the mode of a Pareto is x_m
+    assert max(lengths) == cfg.max_new_tokens  # the tail hits the truncation
+    # the bulk is short: Pareto(alpha=1.5) has median x_m * 2^(2/3) ~ 3.2
+    short = sum(1 for n in lengths if n <= 4)
+    assert short > len(lengths) / 2
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        TrafficConfig(wave_amplitude=1.5)
+    with pytest.raises(ValueError):
+        TrafficConfig(base_rate=0.0)
+    with pytest.raises(ValueError):
+        TrafficConfig(tenants=0)
+    with pytest.raises(ValueError):
+        TrafficConfig(min_new_tokens=8, max_new_tokens=4)
+
+
+# --------------------------------------------------------------------- runner
+
+
+def _plan(index, t, tenant=0):
+    return SessionPlan(index=index, t=t, tenant=tenant, prompt=(1, 2), new_tokens=2)
+
+
+def test_run_schedule_accounts_for_every_session():
+    plans = [_plan(0, 0.0), _plan(1, 0.01, tenant=1), _plan(2, 0.02)]
+
+    def session_fn(plan):
+        if plan.index == 1:
+            raise RuntimeError("tenant quota")
+        return plan.index * 10
+
+    results = run_schedule(plans, session_fn, join_timeout_s=10.0)
+    assert [r.index for r in results] == [0, 1, 2]
+    assert [r.ok for r in results] == [True, False, True]
+    assert results[0].value == 0 and results[2].value == 20
+    assert "tenant quota" in results[1].error
+    assert results[1].tenant == 1
+    lost = [r for r in results if not r.ok and r.error is None]
+    assert lost == [], "every failure carries its reason — no silent losses"
+
+
+def test_run_schedule_is_open_loop():
+    """A stalled session must not delay later arrivals (closed-loop drivers
+    hide queueing collapse by slowing down with the system under test)."""
+    release = threading.Event()
+    starts = {}
+
+    def session_fn(plan):
+        starts[plan.index] = time.monotonic()
+        if plan.index == 0:
+            release.wait(5.0)
+        return plan.index
+
+    t0 = time.monotonic()
+    results = run_schedule(
+        [_plan(0, 0.0), _plan(1, 0.05)], session_fn, join_timeout_s=10.0
+    )
+    release.set()
+    assert all(r.ok for r in results)
+    # session 1 started while session 0 was still blocked
+    assert starts[1] - t0 < 1.0
+
+
+def test_run_schedule_time_scale_compresses_the_clock():
+    plans = [_plan(0, 0.0), _plan(1, 4.0)]
+    t0 = time.monotonic()
+    results = run_schedule(plans, lambda p: p.index, time_scale=0.01, join_timeout_s=5.0)
+    assert time.monotonic() - t0 < 2.0, "4 s of schedule must replay in ~40 ms"
+    assert [r.ok for r in results] == [True, True]
+
+
+def test_run_schedule_join_deadline_marks_stragglers():
+    hang = threading.Event()
+
+    def session_fn(plan):
+        if plan.index == 1:
+            hang.wait(30.0)
+        return plan.index
+
+    try:
+        results = run_schedule(
+            [_plan(0, 0.0), _plan(1, 0.0, tenant=2)], session_fn, join_timeout_s=0.5
+        )
+    finally:
+        hang.set()  # unblock the daemon thread before the test exits
+    assert results[0].ok
+    assert not results[1].ok and "timeout" in results[1].error
+    assert results[1].tenant == 2
