@@ -1456,6 +1456,113 @@ def bench_gate_decode(page_size, label, *, lanes=2, steps=40):
     return result
 
 
+def bench_gate_fingerprint(label, *, lanes=2, steps=40):
+    """CPU-runnable gate row for the integrity fingerprint plane: the same
+    tiny-config batched decode as gate_decode_dense run fp-OFF then fp-ON
+    (ops/fingerprint.py — one FP_DIM projection fused into the batched step
+    plus a per-tick host copy of the digest), in ONE row so the overhead is
+    a same-process A/B. ``with_fp`` is a static argname, so BOTH compiled
+    variants must warm up inside the observatory's warmup budget — a
+    compile during the measured phases lands in ``compile_anomalies`` and
+    fails ``--gate`` via the baseline's clean failure counters. The <=2%
+    overhead budget is an ON-CHIP bar (re-measure via this row on TPU —
+    see benchmarks/on_tunnel_revival.sh); CPU walls at hidden=64 are
+    scheduler-noise-dominated, so the in-row assertion is a loose
+    structural ceiling, not the 2% bar."""
+    import jax.numpy as jnp
+
+    from petals_tpu.models.registry import get_family
+    from petals_tpu.ops import fingerprint as fp_ops
+    from petals_tpu.server.backend import TransformerBackend
+    from petals_tpu.server.batching import DecodeBatcher
+    from petals_tpu.server.memory_cache import MemoryCache
+    from petals_tpu.server.task_queue import PriorityTaskQueue
+
+    cfg = _tiny_gate_cfg()
+    n_blocks = cfg.num_hidden_layers
+    params = random_params(cfg, n_blocks, jnp.float32)
+    backend = TransformerBackend(
+        get_family("llama"), cfg, params,
+        first_block=0, n_blocks=n_blocks,
+        memory_cache=MemoryCache(None), compute_dtype=jnp.float32,
+        use_flash=False,
+    )
+    rng = np.random.RandomState(0)
+    step_h = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.02
+
+    async def run():
+        queue = PriorityTaskQueue()
+        queue.start()
+        batcher = DecodeBatcher(
+            backend, backend.memory_cache, queue,
+            n_lanes=lanes, max_length=128, page_size=None,
+        )
+        try:
+            lane_ids = [
+                await batcher.acquire_lane(peer_id=f"{label}-peer-{i}")
+                for i in range(lanes)
+            ]
+            pos = 0
+
+            async def tick(n):
+                nonlocal pos
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    await asyncio.gather(
+                        *(batcher.step(lane, step_h, pos) for lane in lane_ids)
+                    )
+                    pos += 1
+                return time.perf_counter() - t0
+
+            # warm BOTH static variants while the steady-state executable
+            # set is still open (observatory warmup budget, default 8
+            # calls): compiling the second variant after the budget would
+            # — correctly — count as a recompile anomaly
+            fp_ops.set_enabled(False)
+            await tick(2)
+            fp_ops.set_enabled(True)
+            await tick(2)
+            fp = batcher.pop_step_fp(lane_ids[0])
+            assert fp is not None and len(fp) == fp_ops.FP_DIM, (
+                f"fp-on step produced no fused fingerprint: {fp!r}"
+            )
+
+            fp_ops.set_enabled(False)
+            wall_off = await tick(steps)
+            fp_ops.set_enabled(True)
+            wall_on = await tick(steps)
+
+            overhead_pct = 100.0 * (wall_on - wall_off) / max(wall_off, 1e-9)
+            # structural ceiling only: catches a per-tick recompile or an
+            # accidentally O(hidden^2) digest, not single-digit CPU jitter
+            assert wall_on <= wall_off * 2.0 + 0.25, (
+                f"fingerprinting doubled the decode step: "
+                f"off={wall_off:.3f}s on={wall_on:.3f}s ({overhead_pct:.1f}%)"
+            )
+            return {
+                "label": label,
+                "lanes": lanes,
+                "steps": steps,
+                "fp_dim": fp_ops.FP_DIM,
+                "off_step_ms": round(1000.0 * wall_off / steps, 3),
+                "on_step_ms": round(1000.0 * wall_on / steps, 3),
+                "overhead_pct": round(overhead_pct, 2),
+                "overhead_budget_pct_onchip": 2.0,
+            }
+        finally:
+            await batcher.close()
+            queue.shutdown()
+
+    prev = fp_ops.enabled()
+    try:
+        result = asyncio.run(run())
+    finally:
+        fp_ops.set_enabled(prev)
+    del params, backend
+    gc.collect()
+    return result
+
+
 def _gate_row_registry():
     """Rows cheap enough for the CI perf gate (seconds each on CPU). Run via
     the same ``--row`` child protocol as the heavy rows so each gets a fresh
@@ -1463,6 +1570,9 @@ def _gate_row_registry():
     return {
         "gate_decode_dense": lambda: bench_gate_decode(None, "gate_decode_dense"),
         "gate_decode_paged": lambda: bench_gate_decode(16, "gate_decode_paged"),
+        "gate_fingerprint_overhead": lambda: bench_gate_fingerprint(
+            "gate_fingerprint_overhead"
+        ),
     }
 
 

@@ -104,4 +104,18 @@ timeout 600 env QUANT_KIND=int4 python benchmarks/ablate_call_overhead.py one 2>
 # estimates (XLA:CPU cost_analysis flops over wall time, no declared peak) —
 # re-derive on-chip with PETALS_TPU_PEAK_TFLOPS set before quoting them.
 
+echo "== 6/6 integrity fingerprint plane (on-chip calibration) =="
+# The fingerprint tolerances in petals_tpu/ops/fingerprint.py
+# (TOL_TRANSPORT / tolerance_for) were calibrated on XLA:CPU. TPU matmuls
+# accumulate in a different order (MXU tiling, bf16 passthrough), so the
+# SAME weights on CPU vs TPU — and even across TPU generations — produce
+# slightly different hidden states and therefore digests. Before trusting
+# cross-backend canary comparisons, re-run the path-invariance suite here
+# and widen the tolerances if healthy replicas diverge:
+timeout 900 python -m pytest tests/ -q -m integrity 2>&1 | tail -3
+# The <=2% fingerprint overhead budget is an ON-CHIP bar: the CPU baseline
+# in BENCH_GATE_CPU.json only pins compile counts / anomaly-freedom. The
+# real number is this row's overhead_pct on the TPU:
+timeout 900 python bench.py --row gate_fingerprint_overhead 2>&1 | tail -4
+
 echo "== revival queue done =="

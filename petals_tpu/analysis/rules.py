@@ -683,6 +683,14 @@ TAINTED_LABEL_NAMES = {
     "uids",
     "session",
     "peer",
+    # activation-fingerprint digests (ops/fingerprint.py): one distinct
+    # value per (session, position) — worse than per-client cardinality.
+    # Divergence evidence belongs in journal/flight events, never labels.
+    "fp",
+    "fingerprint",
+    "digest",
+    "digest_hex",
+    "fp_hex",
 }
 
 

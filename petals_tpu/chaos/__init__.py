@@ -12,6 +12,7 @@ from petals_tpu.chaos.plane import (
     SITE_ANNOUNCE,
     SITE_DHT_LOOKUP,
     SITE_HANDLER_STEP,
+    SITE_INTEGRITY_CORRUPT,
     SITE_MIGRATE_PUSH,
     SITE_RPC_CALL,
     SITE_RPC_STREAM,
@@ -21,6 +22,7 @@ from petals_tpu.chaos.plane import (
     ChaosPlane,
     ChaosRule,
     configure,
+    corrupt_array,
     disable,
     fire,
     get_plane,
@@ -46,6 +48,7 @@ __all__ = [
     "SITE_ANNOUNCE",
     "SITE_DHT_LOOKUP",
     "SITE_HANDLER_STEP",
+    "SITE_INTEGRITY_CORRUPT",
     "SITE_MIGRATE_PUSH",
     "SITE_RPC_CALL",
     "SITE_RPC_STREAM",
@@ -55,6 +58,7 @@ __all__ = [
     "ChaosPlane",
     "ChaosRule",
     "configure",
+    "corrupt_array",
     "disable",
     "fire",
     "get_plane",

@@ -54,6 +54,7 @@ SITE_MIGRATE_PUSH = "migrate.push"  # server->server session_migrate push
 SITE_ANNOUNCE = "dht.announce"  # server's periodic DHT announce
 SITE_DHT_LOOKUP = "dht.lookup"  # client route discovery (module-info fetch)
 SITE_SWAP_RESERVE = "swap.reserve"  # host swap-pool budget reservation
+SITE_INTEGRITY_CORRUPT = "integrity.corrupt"  # server activation corruption (detail: peer/session)
 
 SITES = (
     SITE_RPC_CALL,
@@ -64,9 +65,10 @@ SITES = (
     SITE_ANNOUNCE,
     SITE_DHT_LOOKUP,
     SITE_SWAP_RESERVE,
+    SITE_INTEGRITY_CORRUPT,
 )
 
-ACTIONS = ("drop", "delay", "refuse", "kill")
+ACTIONS = ("drop", "delay", "refuse", "kill", "corrupt")
 
 MAX_LOG = 1024  # bounded injection log (tests assert against it)
 
@@ -222,6 +224,28 @@ async def inject(site: str, detail: Optional[str] = None) -> None:
     raise ChaosInjected(f"chaos[{site}]: {rule.action} ({detail or 'no detail'})")
 
 
+def corrupt_array(arr, site_seed: int, position: int = 0):
+    """Seeded activation corruption for ``integrity.corrupt``: perturb the
+    LAST token row of ``arr [batch, seq, hidden]`` by sign-flipping a
+    deterministic subset of components — the in-process stand-in for a
+    faulty/malicious replica returning plausible-but-wrong activations
+    (magnitudes stay realistic, so nothing downstream NaNs or clips; only
+    the fingerprint plane can tell). Deterministic in ``(plane seed,
+    site_seed, position)`` so a chaos run reproduces bit-for-bit."""
+    import numpy as np
+
+    plane = _plane
+    base = plane.seed if plane is not None else 0
+    rng = random.Random((base << 20) ^ (int(site_seed) & 0xFFFFF) ^ int(position))
+    out = np.array(arr, copy=True)
+    row = out[0, -1, :]
+    n_flip = max(1, row.shape[0] // 8)
+    idx = rng.sample(range(row.shape[0]), n_flip)
+    row[idx] = -row[idx]
+    out[0, -1, :] = row
+    return out
+
+
 def parse_spec(spec: str) -> tuple:
     """Parse a ``PETALS_TPU_CHAOS`` spec into ``(seed, rules)``."""
     seed = 0
@@ -268,6 +292,7 @@ __all__ = [
     "SITE_ANNOUNCE",
     "SITE_DHT_LOOKUP",
     "SITE_HANDLER_STEP",
+    "SITE_INTEGRITY_CORRUPT",
     "SITE_MIGRATE_PUSH",
     "SITE_RPC_CALL",
     "SITE_RPC_STREAM",
@@ -277,6 +302,7 @@ __all__ = [
     "ChaosPlane",
     "ChaosRule",
     "configure",
+    "corrupt_array",
     "disable",
     "fire",
     "get_plane",

@@ -60,6 +60,13 @@ def render_top(summary: dict) -> str:
                 f"  {str(row.get('peer', '?')):<18} {row.get('page_s', 0.0):>10.2f} "
                 f"{row.get('share_max', 0.0):>7.2f} {row.get('servers', 0):>8}"
             )
+    integ = summary.get("integrity") or {}
+    quarantined = integ.get("quarantined") or {}
+    if quarantined:
+        lines.append(
+            "integrity quarantine: "
+            + ", ".join(f"{p} ({why})" for p, why in sorted(quarantined.items()))
+        )
     return "\n".join(lines) if lines else "(no models announced)"
 
 
@@ -80,6 +87,14 @@ def main(argv=None) -> None:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8799)
     parser.add_argument("--update_period", type=float, default=15.0)
+    parser.add_argument(
+        "--canary_period",
+        type=float,
+        default=0.0,
+        help="integrity canary cadence in seconds: replay seeded golden "
+        "probes against every multi-replica span and quarantine fingerprint "
+        "outliers by quorum (0 disables)",
+    )
     args = parser.parse_args(argv)
 
     if args.waterfall:
@@ -114,6 +129,7 @@ def main(argv=None) -> None:
         monitor = HealthMonitor(
             args.initial_peers, host=args.host, port=args.port,
             update_period=args.update_period,
+            canary_period=args.canary_period,
         )
         await monitor.start()
         print(f"http://{args.host}:{monitor.port}/", flush=True)

@@ -72,6 +72,10 @@ class _ServerInferenceSession:
         # trace id the server echoed in its session_open ack (may be
         # server-normalized/minted; InferenceSession adopts it)
         self.echoed_trace_id: Optional[str] = None
+        # integrity cross-check (telemetry/integrity.py), attached by the
+        # owning InferenceSession: every reply carrying a fused fingerprint
+        # is verified against the hidden state actually received
+        self.monitor = None
 
     @classmethod
     async def create(
@@ -231,6 +235,21 @@ class _ServerInferenceSession:
         )
         out = deserialize_array(reply["tensors"]["hidden"])
         self.position = reply["position"]
+        meta = reply.get("step_meta") or {}
+        if self.monitor is not None and meta.get("fp") is not None:
+            # cross-check the reply against the server's FUSED fingerprint:
+            # a mismatch means the activation was corrupted after the
+            # compiled step (wire, serialization, or a lying replica)
+            self.monitor.verify_step(
+                self.span.peer_id,
+                meta["fp"],
+                out,
+                start=self.span.start,
+                end=self.span.end,
+                position=int(reply["position"]),
+                lossy_wire=self.compression != CompressionType.NONE,
+                quant=getattr(self.span.server_info, "quant_type", None) or "none",
+            )
         self.history.append((np.asarray(hidden), None if hypo_ids is None else np.asarray(hypo_ids)))
         return out
 
@@ -332,6 +351,16 @@ class InferenceSession:
         # SLO flight recorder (None unless PETALS_TPU_SLO_*_MS is set; tests
         # and embedders may assign a FlightRecorder directly)
         self.flight = flight_from_env()
+        # fingerprint cross-check: verifies every reply's fused digest and
+        # keeps digest continuity across repairs/migrations; divergence is
+        # journaled/flight-recorded and reported to routing as a hard penalty
+        from petals_tpu.telemetry.integrity import IntegrityMonitor
+
+        self.integrity = IntegrityMonitor(
+            trace_id=self.trace_id,
+            on_divergence=self._on_integrity_divergence,
+            flight=self.flight,
+        )
 
     @property
     def position(self) -> int:
@@ -459,6 +488,14 @@ class InferenceSession:
         report = getattr(self.seq_manager, "report_congestion", None)
         if report is not None:
             report(session.span.peer_id, share)
+
+    def _on_integrity_divergence(self, peer_id) -> None:
+        """A hop's reply diverged from its fused fingerprint: hand routing
+        the hard (decaying) integrity penalty so the next route build — and
+        any repair this session performs — steers off the replica."""
+        report = getattr(self.seq_manager, "report_integrity", None)
+        if report is not None:
+            report(peer_id)
 
     def trace_report(self) -> dict:
         """The session's per-hop latency waterfall so far: wall-clock
@@ -726,6 +763,7 @@ class InferenceSession:
                     # routing bans/penalizes that peer on the retry
                     self.seq_manager.on_request_failure(span.peer_id)
                     raise
+                session.monitor = self.integrity
                 # adopt the server-echoed trace id (normalized or server-
                 # minted) from the FIRST hop, so the spans the rest of the
                 # chain opens with — and all client telemetry — key on the
@@ -736,6 +774,7 @@ class InferenceSession:
                         f"(was {self.trace_id})"
                     )
                     self.trace_id = session.echoed_trace_id
+                    self.integrity.trace_id = self.trace_id
                 sessions.append(session)
             return sessions
         except Exception:
@@ -1151,6 +1190,7 @@ class InferenceSession:
                     session_id=uuid.uuid4().hex,
                     trace_id=self.trace_id,
                 )
+                session.monitor = self.integrity
                 created.append(session)
                 # gather [span.start, span.end) KV from the covering sessions
                 pieces = []

@@ -50,6 +50,16 @@ PREFER_PEER_BONUS_S = 10.0
 # replica, far below CACHE_MISS_PENALTY so it never overrides capacity.
 CONGESTION_PENALTY_S = 0.05
 CONGESTION_WINDOW_S = 30.0
+# Hard routing penalty for an integrity-divergent server (report_integrity):
+# a replica whose replies disagree with their own fused fingerprints is
+# producing WRONG tokens, not slow ones, so the penalty must dominate every
+# latency signal short of a missing block (CACHE_MISS_PENALTY = 100.0) —
+# any healthy replica, however congested, beats a corrupting one. Decaying
+# (not a hard ban) so a transient wire fault heals without an unban step,
+# and long-windowed because correctness evidence does not go stale the way
+# queue depth does.
+INTEGRITY_PENALTY_S = 5.0
+INTEGRITY_WINDOW_S = 120.0
 # Minimum spacing between congestion-triggered routing refreshes
 # (request_refresh): one backlogged open is enough evidence that the cached
 # swarm view is stale, but a burst of them must collapse to a single DHT
@@ -160,6 +170,11 @@ class RemoteSequenceManager:
         # penalty (peer -> (expires_monotonic, queue_share)) — steering, not
         # the hard hammer of a ban
         self._congestion: Dict[PeerID, Tuple[float, float]] = {}
+        # hard integrity blame from the fingerprint cross-check / canary
+        # prober: peer -> expires_monotonic. Stronger than congestion (the
+        # replica is WRONG, not slow) but still decaying — see
+        # INTEGRITY_PENALTY_S for the sizing rationale.
+        self._integrity: Dict[PeerID, float] = {}
         self._last_refresh_req = 0.0  # monotonic time of last request_refresh
         self._refresh_task: Optional[asyncio.Task] = None
         self._update_lock = asyncio.Lock()
@@ -320,6 +335,9 @@ class RemoteSequenceManager:
             for pid, (expires, share) in self._congestion.items()
             if now < expires
         }
+        self._integrity = {
+            pid: expires for pid, expires in self._integrity.items() if now < expires
+        }
 
     # -------------------------------------------------------------- congestion
 
@@ -349,6 +367,33 @@ class RemoteSequenceManager:
             self._congestion.pop(peer_id, None)
             return 0.0
         return CONGESTION_PENALTY_S * share
+
+    # -------------------------------------------------------------- integrity
+
+    def report_integrity(
+        self, peer_id: PeerID, *, window_s: float = INTEGRITY_WINDOW_S
+    ) -> None:
+        """Hard blame from the integrity observatory (client fingerprint
+        cross-check or canary prober): this peer's replies diverged from
+        their own fused activation fingerprints. Route builds avoid it for
+        ``window_s`` unless no healthy replica covers its blocks."""
+        self._integrity[peer_id] = time.monotonic() + window_s
+        from petals_tpu.telemetry import instruments as tm
+
+        tm.INTEGRITY_PENALTIES.inc()
+        logger.warning(
+            f"Integrity blame on {peer_id}: divergent replies, penalized "
+            f"for {window_s:.0f}s"
+        )
+
+    def _integrity_penalty(self, peer_id) -> float:
+        expires = self._integrity.get(peer_id)
+        if expires is None:
+            return 0.0
+        if time.monotonic() >= expires:
+            self._integrity.pop(peer_id, None)
+            return 0.0
+        return INTEGRITY_PENALTY_S
 
     # ------------------------------------------------------------------ sequences
 
@@ -603,7 +648,14 @@ class RemoteSequenceManager:
             and info.cache_tokens_left < cache_tokens_needed
         ):
             edge += CACHE_MISS_PENALTY
-        edge += self._congestion_penalty(peer_id) + affinity_jitter
+        edge += self._congestion_penalty(peer_id) + self._integrity_penalty(peer_id)
+        edge += affinity_jitter
+        # announce-visible quarantine: a server the canary prober (anywhere
+        # in the swarm) flagged publishes it on ServerInfo.integrity, so
+        # even clients that never talked to the replica steer off it
+        integ = getattr(info, "integrity", None)
+        if isinstance(integ, dict) and integ.get("quarantined"):
+            edge += INTEGRITY_PENALTY_S
         if prefer_peers is not None and peer_id in prefer_peers:
             # this peer holds the session's migrated KV — discount the hop
             # (clamped: Dijkstra needs non-negative edges)

@@ -194,6 +194,13 @@ class ServerInfo:
     # steady state and its latency cannot be trusted. Rides next to
     # ``telemetry`` on every announce.
     compile_stats: Optional[Dict[str, Any]] = None
+    # integrity observatory digest (telemetry.integrity): the server's
+    # self-probe fingerprint digest_hex per span plus its quarantine flag —
+    # canary probers compare these across replicas, and routing skips
+    # servers announcing ``quarantined: True``. Size-capped like
+    # ``telemetry`` (cap_announce_payload); raw digest floats never ride
+    # the announce, only the short hex form.
+    integrity: Optional[Dict[str, Any]] = None
     # the /metrics + /journal + /compile HTTP port
     # (telemetry.exposition.MetricsServer), so clients (flight recorder) can
     # fetch a victim server's journal excerpt by trace_id on an SLO breach;

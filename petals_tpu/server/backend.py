@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from petals_tpu.models.registry import ModelFamily
+from petals_tpu.ops import fingerprint as fp_ops
 from petals_tpu.ops.sampling import sample_tokens, sampling_vectors
 from petals_tpu.server.memory_cache import MemoryCache, TensorDescriptor
 from petals_tpu.telemetry.observatory import tracked_jit
@@ -116,6 +117,12 @@ class TransformerBackend:
         # adapter name -> (stacked {leaf: (A, B)}, scaling); see utils/peft.py
         self.adapters: Dict[str, tuple] = {}
         self._dummy_operands: Dict[tuple, jax.Array] = {}
+        # integrity observatory: the last batched step's fused activation
+        # fingerprints (ops/fingerprint.py), stashed here by the step
+        # wrappers — the public step-method return contracts stay unchanged
+        # — and popped by the batcher on its single compute thread
+        self._last_step_fp = None  # [n_lanes, FP_DIM] device array or None
+        self._last_chunk_fp = None  # [FP_DIM] (mixed step's prefill chunk)
 
     # ------------------------------------------------------------- cache descriptors
 
@@ -359,9 +366,13 @@ class TransformerBackend:
         split_quant = self._split_quant
         use_quant_consts = self._use_quant_consts
         reattach = self._reattach_quant
+        fp_proj = fp_ops.projection(cfg.hidden_size)  # baked constant
 
-        @tracked_jit(name="batched_decode", steady=True, donate_argnums=(1, 2))
-        def step(params, k_pool, v_pool, hidden, positions):
+        @tracked_jit(
+            name="batched_decode", steady=True,
+            static_argnames=("with_fp",), donate_argnums=(1, 2),
+        )
+        def step(params, k_pool, v_pool, hidden, positions, *, with_fp: bool):
             # hidden: [n_lanes, 1, hidden]; positions: [n_lanes] int32
             hidden = hidden.astype(k_pool.dtype)
             if use_quant_consts:
@@ -385,6 +396,12 @@ class TransformerBackend:
             hidden, (k_pool, v_pool) = jax.lax.scan(
                 body, hidden, (xs_params, k_pool, v_pool, block_indices)
             )
+            if with_fp:
+                # fused integrity fingerprint: one [n_lanes, hidden] x
+                # [hidden, FP_DIM] matmul on the post-span hidden state —
+                # the digest the client re-derives from its reply
+                fp = fp_ops.fingerprint_rows(hidden[:, -1, :], fp_proj)
+                return hidden, k_pool, v_pool, fp
             return hidden, k_pool, v_pool
 
         return step
@@ -402,10 +419,17 @@ class TransformerBackend:
         k_pool, v_pool = pool_kv
         if not isinstance(hidden, jax.Array):
             hidden = np.ascontiguousarray(hidden)
+        with_fp = fp_ops.enabled()
         with self._quant_ctx():  # mesh: XLA dequant path (Mosaic can't GSPMD)
-            out, k_pool, v_pool = self._batched_decode_fn(
-                self.params, k_pool, v_pool, hidden, np.asarray(positions, np.int32)
+            res = self._batched_decode_fn(
+                self.params, k_pool, v_pool, hidden,
+                np.asarray(positions, np.int32), with_fp=with_fp,
             )
+        if with_fp:
+            out, k_pool, v_pool, self._last_step_fp = res
+        else:
+            out, k_pool, v_pool = res
+            self._last_step_fp = None
         return out, (k_pool, v_pool)
 
     @functools.cached_property
@@ -424,14 +448,16 @@ class TransformerBackend:
         split_quant = self._split_quant
         use_quant_consts = self._use_quant_consts
         reattach = self._reattach_quant
+        fp_proj = fp_ops.projection(cfg.hidden_size)  # baked constant
 
         from petals_tpu.ops.paged_attention import gather_pages, scatter_token_rows
 
         @tracked_jit(
             name="paged_decode", steady=True,
-            static_argnames=("contiguous",), donate_argnums=(1, 2),
+            static_argnames=("contiguous", "with_fp"), donate_argnums=(1, 2),
         )
-        def step(params, k_pool, v_pool, hidden, positions, tables, *, contiguous: bool):
+        def step(params, k_pool, v_pool, hidden, positions, tables,
+                 *, contiguous: bool, with_fp: bool):
             # hidden: [n_lanes, 1, hidden]; positions: [n_lanes] int32;
             # tables: [n_lanes, max_pages] int32 (-1 = unallocated slot)
             n_lanes, max_pages = tables.shape
@@ -473,6 +499,12 @@ class TransformerBackend:
             hidden, (k_pool, v_pool) = jax.lax.scan(
                 body, hidden, (xs_params, k_pool, v_pool, block_indices)
             )
+            if with_fp:
+                # same projection as the dense program: path-invariance —
+                # identical tokens through dense vs paged yield identical
+                # digests (the PR 2/3 bit-exactness contract, observable)
+                fp = fp_ops.fingerprint_rows(hidden[:, -1, :], fp_proj)
+                return hidden, k_pool, v_pool, fp
             return hidden, k_pool, v_pool
 
         return step
@@ -497,12 +529,18 @@ class TransformerBackend:
             contiguous = tables_are_contiguous(tables, k_pool.shape[1])
         if not isinstance(hidden, jax.Array):
             hidden = np.ascontiguousarray(hidden)
+        with_fp = fp_ops.enabled()
         with self._quant_ctx():
-            out, k_pool, v_pool = self._paged_decode_fn(
+            res = self._paged_decode_fn(
                 self.params, k_pool, v_pool, hidden,
                 np.asarray(positions, np.int32), tables,
-                contiguous=bool(contiguous),
+                contiguous=bool(contiguous), with_fp=with_fp,
             )
+        if with_fp:
+            out, k_pool, v_pool, self._last_step_fp = res
+        else:
+            out, k_pool, v_pool = res
+            self._last_step_fp = None
         return out, (k_pool, v_pool)
 
     @functools.cached_property
@@ -515,17 +553,18 @@ class TransformerBackend:
         use_quant_consts = self._use_quant_consts
         reattach = self._reattach_quant
         client_embed, client_head = family.client_embed, family.client_head
+        fp_proj = fp_ops.projection(cfg.hidden_size)  # baked constant
 
         from petals_tpu.ops.paged_attention import gather_pages, scatter_token_rows
 
         @tracked_jit(
             name="paged_gen_decode", steady=True,
-            static_argnames=("contiguous",), donate_argnums=(2, 3),
+            static_argnames=("contiguous", "with_fp"), donate_argnums=(2, 3),
         )
         def step(params, client_params, k_pool, v_pool, hidden, tokens,
                  use_token, positions, do_sample, temperature, top_k, top_p,
                  rep_penalty, seeds, draw_idx, seen_mask, tables,
-                 *, contiguous: bool):
+                 *, contiguous: bool, with_fp: bool):
             n_lanes, max_pages = tables.shape
             page_size = k_pool.shape[2]
             max_len = max_pages * page_size
@@ -576,6 +615,9 @@ class TransformerBackend:
                 top_k=top_k, top_p=top_p, repetition_penalty=rep_penalty,
                 seen_mask=seen_mask, seeds=seeds, draw_idx=draw_idx,
             )
+            if with_fp:
+                fp = fp_ops.fingerprint_rows(hidden[:, -1, :], fp_proj)
+                return hidden, next_tok, k_pool, v_pool, fp
             return hidden, next_tok, k_pool, v_pool
 
         return step
@@ -594,15 +636,22 @@ class TransformerBackend:
         if not isinstance(hidden, jax.Array):
             hidden = np.ascontiguousarray(hidden)
         v = sampling_vecs
+        with_fp = fp_ops.enabled()
         with self._quant_ctx():
-            out, toks, k_pool, v_pool = self._paged_gen_decode_fn(
+            res = self._paged_gen_decode_fn(
                 self.params, client_params, k_pool, v_pool, hidden,
                 np.asarray(tokens, np.int32), np.asarray(use_token, bool),
                 np.asarray(positions, np.int32), v["do_sample"],
                 v["temperature"], v["top_k"], v["top_p"],
                 v["repetition_penalty"], v["seeds"], v["draw_idx"],
                 v["seen_mask"], tables, contiguous=bool(contiguous),
+                with_fp=with_fp,
             )
+        if with_fp:
+            out, toks, k_pool, v_pool, self._last_step_fp = res
+        else:
+            out, toks, k_pool, v_pool = res
+            self._last_step_fp = None
         return out, toks, (k_pool, v_pool)
 
     @functools.cached_property
@@ -627,6 +676,7 @@ class TransformerBackend:
         use_quant_consts = self._use_quant_consts
         reattach = self._reattach_quant
         takes_n_total = "n_total" in inspect.signature(family.block_apply).parameters
+        fp_proj = fp_ops.projection(cfg.hidden_size)  # baked constant
 
         from petals_tpu.ops.paged_attention import (
             gather_pages,
@@ -636,11 +686,11 @@ class TransformerBackend:
 
         @tracked_jit(
             name="paged_mixed_step", steady=True,
-            static_argnames=("contiguous",), donate_argnums=(1, 2),
+            static_argnames=("contiguous", "with_fp"), donate_argnums=(1, 2),
         )
         def step(params, k_pool, v_pool, hidden, positions, tables,
                  chunk_hidden, chunk_lane, chunk_pos, chunk_n_valid,
-                 chunk_n_total, *, contiguous: bool):
+                 chunk_n_total, *, contiguous: bool, with_fp: bool):
             # hidden: [n_lanes, 1, hidden]; positions: [n_lanes] int32 (idle
             # sentinel = max_len); chunk_hidden: [1, B, hidden] (B = static
             # bucket); chunk_lane/chunk_pos/chunk_n_valid/chunk_n_total:
@@ -711,6 +761,16 @@ class TransformerBackend:
                 body, (hidden, chunk_hidden),
                 (xs_params, k_pool, v_pool, block_indices),
             )
+            if with_fp:
+                fp = fp_ops.fingerprint_rows(hidden[:, -1, :], fp_proj)
+                # the chunk's digest is of its LAST VALID row — the last
+                # token the client receives for this prefill chunk, which
+                # is what the client-side twin re-derives
+                last_row = jnp.take(
+                    chunk_out[0], jnp.clip(chunk_n_valid - 1, 0, B - 1), axis=0
+                )
+                chunk_fp = fp_ops.fingerprint_rows(last_row[None, :], fp_proj)[0]
+                return hidden, chunk_out, k_pool, v_pool, fp, chunk_fp
             return hidden, chunk_out, k_pool, v_pool
 
         return step
@@ -760,13 +820,21 @@ class TransformerBackend:
             )
         if n_total is None:
             n_total = int(chunk_pos) + seq
+        with_fp = fp_ops.enabled()
         with self._quant_ctx():
-            out, chunk_out, k_pool, v_pool = self._paged_mixed_step_fn(
+            res = self._paged_mixed_step_fn(
                 self.params, k_pool, v_pool, hidden,
                 np.asarray(positions, np.int32), tables, chunk_hidden,
                 np.int32(chunk_lane), np.int32(chunk_pos), np.int32(seq),
                 np.int32(n_total), contiguous=bool(contiguous),
+                with_fp=with_fp,
             )
+        if with_fp:
+            out, chunk_out, k_pool, v_pool, self._last_step_fp, self._last_chunk_fp = res
+        else:
+            out, chunk_out, k_pool, v_pool = res
+            self._last_step_fp = None
+            self._last_chunk_fp = None
         if chunk_out.shape[1] != seq:
             chunk_out = chunk_out[:, :seq]
         return out, chunk_out, (k_pool, v_pool)
@@ -1158,11 +1226,15 @@ class TransformerBackend:
         use_quant_consts = self._use_quant_consts
         reattach = self._reattach_quant
         client_embed, client_head = family.client_embed, family.client_head
+        fp_proj = fp_ops.projection(cfg.hidden_size)  # baked constant
 
-        @tracked_jit(name="batched_gen_decode", steady=True, donate_argnums=(2, 3))
+        @tracked_jit(
+            name="batched_gen_decode", steady=True,
+            static_argnames=("with_fp",), donate_argnums=(2, 3),
+        )
         def step(params, client_params, k_pool, v_pool, hidden, tokens,
                  use_token, positions, do_sample, temperature, top_k, top_p,
-                 rep_penalty, seeds, draw_idx, seen_mask):
+                 rep_penalty, seeds, draw_idx, seen_mask, *, with_fp: bool):
             # hidden: [n_lanes, 1, hidden]; tokens/use_token/positions: [n_lanes]
             emb = client_embed(client_params, tokens[:, None], cfg)
             hidden = jnp.where(
@@ -1197,6 +1269,9 @@ class TransformerBackend:
                 top_k=top_k, top_p=top_p, repetition_penalty=rep_penalty,
                 seen_mask=seen_mask, seeds=seeds, draw_idx=draw_idx,
             )
+            if with_fp:
+                fp = fp_ops.fingerprint_rows(hidden[:, -1, :], fp_proj)
+                return hidden, next_tok, k_pool, v_pool, fp
             return hidden, next_tok, k_pool, v_pool
 
         return step
@@ -1224,16 +1299,35 @@ class TransformerBackend:
         if not isinstance(hidden, jax.Array):
             hidden = np.ascontiguousarray(hidden)
         v = sampling_vecs
+        with_fp = fp_ops.enabled()
         with self._quant_ctx():
-            out, toks, k_pool, v_pool = self._batched_gen_decode_fn(
+            res = self._batched_gen_decode_fn(
                 self.params, client_params, k_pool, v_pool, hidden,
                 np.asarray(tokens, np.int32), np.asarray(use_token, bool),
                 np.asarray(positions, np.int32), v["do_sample"],
                 v["temperature"], v["top_k"], v["top_p"],
                 v["repetition_penalty"], v["seeds"], v["draw_idx"],
-                v["seen_mask"],
+                v["seen_mask"], with_fp=with_fp,
             )
+        if with_fp:
+            out, toks, k_pool, v_pool, self._last_step_fp = res
+        else:
+            out, toks, k_pool, v_pool = res
+            self._last_step_fp = None
         return out, toks, (k_pool, v_pool)
+
+    def pop_step_fp(self):
+        """Take (and clear) the last batched step's fused fingerprints:
+        ``(lane_fp, chunk_fp)`` device arrays or Nones. Called by the
+        batcher on its single compute thread right after the step's host
+        sync, so the stash never outlives its step. getattr-tolerant so
+        wrapper backends (multihost lockstep) that do not run our
+        ``__init__`` report (None, None) instead of raising."""
+        fp = getattr(self, "_last_step_fp", None)
+        chunk = getattr(self, "_last_chunk_fp", None)
+        self._last_step_fp = None
+        self._last_chunk_fp = None
+        return fp, chunk
 
     # ------------------------------------------------------------- public API
 

@@ -220,6 +220,10 @@ class DecodeBatcher:
         # event loop and compute thread never race on the same key.
         self._enq_t: Dict[int, float] = {}
         self._step_timing: Dict[int, dict] = {}
+        # integrity fingerprints of the in-flight step per lane (ops/
+        # fingerprint.py, fused into the batched programs) — same
+        # single-writer discipline as _step_timing
+        self._step_fp: Dict[int, list] = {}
         # session scheduler: priority + per-peer fair-share admission, and (in
         # paged mode with swap_host_bytes > 0) preemption of idle victim lanes
         # to the host-RAM swap tier on pool exhaustion. With the default
@@ -457,6 +461,7 @@ class DecodeBatcher:
         # tenant, not whoever acquires this lane next
         self._enq_t.pop(lane, None)
         self._step_timing.pop(lane, None)
+        self._step_fp.pop(lane, None)
         # a timed-out/cancelled session may have left a step queued: purge it,
         # or its stale KV write could land in the next tenant's history
         kept = []
@@ -1158,6 +1163,32 @@ class DecodeBatcher:
         cached-prefix fast path that never touched the device."""
         return self._step_timing.pop(lane, None)
 
+    def pop_step_fp(self, lane: int) -> Optional[list]:
+        """Consume the finished step's fused activation fingerprint for
+        ``lane`` (FP_DIM floats; ops/fingerprint.py) — the handler
+        piggybacks it on step_meta next to the timing attribution. None
+        when fingerprinting is disabled or no batched step ran."""
+        return self._step_fp.pop(lane, None)
+
+    def _capture_step_fp(self, lanes, chunk_lane: Optional[int] = None) -> None:
+        """Stash the backend's fused per-lane fingerprints (compute thread,
+        right after the step's host sync — same discipline as
+        _record_decode_timing). ``chunk_lane`` takes the mixed step's
+        prefill-chunk digest: its LAST chunk's digest is what the client
+        re-derives from the assembled prefill reply."""
+        pop = getattr(self.backend, "pop_step_fp", None)
+        if pop is None:
+            return  # wrapper backend without the fingerprint plane
+        fp, chunk_fp = pop()
+        if fp is not None:
+            host = np.asarray(fp)
+            for lane in lanes:
+                self._step_fp[lane] = [float(x) for x in host[lane]]
+        if chunk_fp is not None and chunk_lane is not None:
+            self._step_fp[chunk_lane] = [
+                float(x) for x in np.asarray(chunk_fp).reshape(-1)
+            ]
+
     # ------------------------------------------------------------------ stepping
 
     def _check_lane(self, lane: int) -> None:
@@ -1599,6 +1630,7 @@ class DecodeBatcher:
             tm.STEPS_DENSE.inc()
         tm.DECODE_TOKENS.inc(len(batch))
         self._record_decode_timing(batch, t_step, duration)
+        self._capture_step_fp([entry[0] for entry in batch])
         self._ledger_account_step(
             duration, decode_lanes=[entry[0] for entry in batch]
         )
@@ -1688,6 +1720,9 @@ class DecodeBatcher:
         tm.STEPS_MIXED.inc()
         tm.DECODE_TOKENS.inc(len(batch))
         self._record_decode_timing(batch, t_step, duration)
+        self._capture_step_fp(
+            [entry[0] for entry in batch], chunk_lane=st.lane
+        )
         self._ledger_account_step(
             duration,
             decode_lanes=[entry[0] for entry in batch],
@@ -1766,6 +1801,7 @@ class DecodeBatcher:
         tm.STEPS_GEN.inc()
         tm.DECODE_TOKENS.inc(len(batch) + len(gen_states))
         self._record_decode_timing(batch, t_step, duration)
+        self._capture_step_fp([entry[0] for entry in batch] + list(gen_states))
         self._ledger_account_step(
             duration,
             decode_lanes=[entry[0] for entry in batch],

@@ -101,6 +101,18 @@ class TransformerHandler:
         self.queue = PriorityTaskQueue()
         self.queue.start()
         self._sub_backends: Dict[Tuple[int, int], TransformerBackend] = {}
+        # own peer id string, for integrity chaos targeting (a single-process
+        # test swarm shares ONE chaos plane: rules single out a replica by
+        # matching the detail string, which therefore must carry the peer)
+        self._peer_str = ""
+        try:
+            if identity is not None:
+                self._peer_str = identity.peer_id.to_string()
+        except Exception as e:
+            logger.debug(f"Peer id unavailable for chaos targeting: {e}")
+        import zlib
+
+        self._corrupt_seed = zlib.crc32(self._peer_str.encode("utf-8"))
         # server-to-server activation push (reference handler.py:310-350):
         # session_id -> queue of pushed step payloads
         self._push_queues: Dict[str, asyncio.Queue] = {}
@@ -226,6 +238,7 @@ class TransformerHandler:
         server.add_unary_handler("ptu.push", self.rpc_push)
         server.add_unary_handler("ptu.session_export", self.rpc_session_export)
         server.add_unary_handler("ptu.session_migrate", self.rpc_session_migrate)
+        server.add_unary_handler("ptu.probe", self.rpc_probe)
         server.add_stream_handler("ptu.inference", self.rpc_inference)
 
     async def rpc_push(self, payload, ctx: RpcContext):
@@ -1178,6 +1191,50 @@ class TransformerHandler:
             info["prefix_cache"] = self.prefix_cache.summary()
         return info
 
+    async def rpc_probe(self, payload, ctx: RpcContext):
+        """Integrity canary probe: run a CALLER-seeded golden input through
+        this span's forward pass and return its activation fingerprint
+        (ops/fingerprint.py). The caller picks the seed, so a replica
+        cannot pre-compute or replay an honest digest; the canary prober
+        (telemetry/integrity.py) compares digests across every replica of
+        a span by quorum and quarantines outliers. The probe output runs
+        through the same ``integrity.corrupt`` chaos site as session
+        replies, so an injected corruption is probe-visible."""
+        from petals_tpu.ops import fingerprint as fp_ops
+
+        seed = int(payload.get("seed", fp_ops.fp_seed()))
+        n_tokens = max(1, min(int(payload.get("tokens", 4)), 16))
+        hsz = self.backend.cfg.hidden_size
+        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        # activation-scale golden input: magnitudes typical of embedding
+        # outputs, so the forward pass exercises realistic numerics
+        hidden = (rng.standard_normal((1, n_tokens, hsz)) * 0.02).astype(np.float32)
+        backend = self.backend
+
+        def run_probe():
+            with device_annotation("rpc_probe"):
+                return np.asarray(backend.forward(hidden))
+
+        out = await asyncio.wait_for(
+            self.queue.submit(run_probe, priority=PRIORITY_TRAINING, size=n_tokens),
+            self.request_timeout,
+        )
+        if chaos.ENABLED and chaos.fire(
+            chaos.SITE_INTEGRITY_CORRUPT, detail=f"{self._peer_str}:probe"
+        ) == "corrupt":
+            out = chaos.corrupt_array(
+                out, site_seed=self._corrupt_seed, position=n_tokens
+            )
+        fp = fp_ops.fingerprint_output(out, hsz)
+        return {
+            "fp": fp_ops.fp_list(fp),
+            "seed": seed,
+            "tokens": n_tokens,
+            "fp_seed": fp_ops.fp_seed(),
+            "first_block": backend.first_block,
+            "n_blocks": backend.n_blocks,
+        }
+
     async def rpc_inference(self, requests, ctx: RpcContext):
         """Bidirectional inference stream: open -> step* (reference
         handler.py:132-195 + block_functions.iterate_rpc_inference)."""
@@ -1536,6 +1593,7 @@ class TransformerHandler:
                 # fall back to the execution-block wall (queue folded in)
                 t_exec = time.perf_counter()
                 step_timing = None
+                step_fp = None  # fused activation fingerprint (integrity)
                 step_variant = "cached"
                 with get_tracer().span(
                     "inference_step", annotate=False, trace_id=trace_id,
@@ -1555,6 +1613,7 @@ class TransformerHandler:
                         tm.TOKEN_LATENCY.observe(time.perf_counter() - t_tok)
                         step_variant = "decode"
                         step_timing = batcher.pop_step_timing(lane)
+                        step_fp = batcher.pop_step_fp(lane)
                     elif (
                         lane is not None and prompts is None and hypo_ids is None
                         and batcher.page_size is not None
@@ -1570,6 +1629,7 @@ class TransformerHandler:
                         )
                         step_variant = "prefill"
                         step_timing = batcher.pop_step_timing(lane)
+                        step_fp = batcher.pop_step_fp(lane)
                     elif lane is not None and prompts is None and hypo_ids is None:
                         # pooled long prefill on the DENSE pool (and the
                         # TP/lockstep spans, which gate paged mode off): each
@@ -1760,6 +1820,11 @@ class TransformerHandler:
                             self.step_timeout,
                         )
                         gen_timing = batcher.pop_step_timing(lane)
+                        # token replies carry no hidden state for the client
+                        # to re-digest: drop the gen loop's stale fingerprint
+                        # so it cannot ride a LATER step's meta
+                        batcher.pop_step_fp(lane)
+                        step_fp = None
                     else:
                         def run_gen(kv=kv, out=out, gen_n=gen_n,
                                     gen_sampling=gen_sampling):
@@ -1817,6 +1882,12 @@ class TransformerHandler:
                     "compute_s": round(meta_c, 6),
                     "variant": step_variant,
                 }
+                if step_fp is not None:
+                    # fused activation fingerprint of the reply's last token
+                    # row (ops/fingerprint.py): the client re-derives it from
+                    # the hidden state it receives and cross-checks — unknown
+                    # key, so old clients ignore it
+                    step_meta["fp"] = step_fp
                 if lane is not None:
                     step_meta.update(batcher.occupancy_hint())
                     # the tenant's own bill since the last reply (resource
@@ -1836,6 +1907,17 @@ class TransformerHandler:
                         "step_meta": step_meta,
                     }
                     continue
+                if chaos.ENABLED and chaos.fire(
+                    chaos.SITE_INTEGRITY_CORRUPT,
+                    detail=f"{self._peer_str}:{session_id or 'anon'}",
+                ) == "corrupt":
+                    # seeded activation corruption AT the reply boundary: the
+                    # wire output now diverges from the fused fingerprint in
+                    # its own step_meta — the exact plausible-but-wrong
+                    # failure the client cross-check exists to catch
+                    out = chaos.corrupt_array(
+                        out, site_seed=self._corrupt_seed, position=position
+                    )
                 t_ser = time.perf_counter()
                 wire_out = serialize_array(out, reply_comp)
                 ser_s = time.perf_counter() - t_ser

@@ -644,6 +644,9 @@ class Server:
             # the tok/s figure an update_period-window average
             telemetry=self._telemetry_digest(),
             compile_stats=self._compile_stats(),
+            # integrity observatory: self-probe digest_hex + quarantine flag
+            # (refreshed by the announce loop; None until the first refresh)
+            integrity=getattr(self, "_integrity_info", None),
             # where /metrics and /journal live, so a breaching client can
             # fetch this server's journal excerpt for its trace_id
             metrics_port=(
@@ -654,12 +657,50 @@ class Server:
 
     def _telemetry_digest(self) -> Optional[dict]:
         from petals_tpu.telemetry.exposition import telemetry_digest
+        from petals_tpu.telemetry.integrity import cap_announce_payload
 
         try:
-            return telemetry_digest()
+            # size-capped: the digest rides every widely-replicated DHT
+            # announce, and the ledger sub-dict can grow with tenant count
+            return cap_announce_payload(telemetry_digest())
         except Exception as e:  # an announce must never fail over metrics
             logger.debug("telemetry digest failed: %r", e)
             return None
+
+    async def _refresh_integrity(self) -> None:
+        """Refresh the announce-visible integrity digest: the span's
+        self-probe fingerprint (the SAME ``ptu.probe`` path external canary
+        probers hit, so an injected ``integrity.corrupt`` is visible in the
+        announce too) plus this server's quarantine flag from the
+        process-local registry. Announce-must-never-fail discipline: any
+        error leaves the previous digest in place."""
+        if getattr(self, "handler", None) is None or self.backend is None:
+            return
+        try:
+            import numpy as np
+
+            from petals_tpu.ops import fingerprint as fp_ops
+            from petals_tpu.telemetry.integrity import (
+                cap_announce_payload,
+                get_quarantine,
+            )
+
+            reply = await self.handler.rpc_probe({"tokens": 4}, None)
+            peer_str = ""
+            if self._identity is not None:
+                peer_str = self._identity.peer_id.to_string()
+            self._integrity_info = cap_announce_payload({
+                "self_digest": fp_ops.digest_hex(
+                    np.asarray(reply["fp"], dtype=np.float32)
+                ),
+                "fp_seed": int(reply["fp_seed"]),
+                "span": f"{reply['first_block']}:{reply['first_block'] + reply['n_blocks']}",
+                "quarantined": bool(
+                    peer_str and get_quarantine().is_quarantined(peer_str)
+                ),
+            })
+        except Exception as e:
+            logger.debug("integrity digest refresh failed: %r", e)
 
     def _compile_stats(self) -> Optional[dict]:
         from petals_tpu.telemetry.observatory import compile_stats_digest
@@ -677,6 +718,10 @@ class Server:
             logger.warning("chaos: dropping DHT announce (%s)", state)
             return
         expiration = expiration or (dht_time() + max(2 * self.update_period, 60.0))
+        if state != ServerState.OFFLINE:
+            # refresh the announce-visible self-probe digest first, so the
+            # ServerInfo built below carries this period's integrity view
+            await self._refresh_integrity()
         await declare_active_modules(
             self.dht, self.module_uids, self._server_info(state), expiration,
             contact_addr=self._contact_addr,

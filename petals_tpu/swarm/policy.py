@@ -76,6 +76,11 @@ class ServerSample:
     lane_waiters: int = 0  # sessions queued for a lane
     pages_free: int = 0
     n_pages: int = 0
+    # integrity observatory: the replica announced itself quarantined (its
+    # activation fingerprints diverged from its span-mates'). Quarantined
+    # replicas are drained-and-replaced with top priority — they produce
+    # WRONG tokens, which no amount of idle-harvesting hysteresis excuses.
+    quarantined: bool = False
 
     @property
     def online(self) -> bool:
@@ -133,6 +138,7 @@ def snapshot_from_health(
             continue
         blocks = s.get("blocks") or [0, 0]
         pool = s.get("pool") if isinstance(s.get("pool"), dict) else {}
+        integ = s.get("integrity") if isinstance(s.get("integrity"), dict) else {}
         servers.append(
             ServerSample(
                 peer=str(peer),
@@ -145,6 +151,7 @@ def snapshot_from_health(
                 lane_waiters=_i(pool.get("lane_waiters")),
                 pages_free=_i(pool.get("pages_free")),
                 n_pages=_i(pool.get("n_pages")),
+                quarantined=bool(integ.get("quarantined")),
             )
         )
         digest = s.get("telemetry")
@@ -236,6 +243,9 @@ class AutoscalerPolicy:
         self._last_fire: Dict[str, int] = {}  # action -> tick it last fired
         self._last_any: Optional[int] = None
         self._first_tick: Optional[int] = None  # startup-grace anchor
+        # span of a quarantined replica drained last decision: the NEXT
+        # eligible tick issues the replacement scale_out over the same span
+        self._pending_replace: Optional[Tuple[int, int]] = None
         self._journal: List[dict] = []
 
     # ------------------------------------------------------------- journal
@@ -298,7 +308,11 @@ class AutoscalerPolicy:
         }
 
         decision = (
-            self._maybe_scale_out(snapshot, evidence_base)
+            # integrity first: a quarantined replica produces WRONG tokens —
+            # draining it (and replacing its capacity) outranks every
+            # latency-driven action
+            self._maybe_quarantine_drain(snapshot, evidence_base)
+            or self._maybe_scale_out(snapshot, evidence_base)
             or self._maybe_scale_in(snapshot, hot, evidence_base)
             or self._maybe_resize(snapshot, hot, evidence_base)
         )
@@ -312,6 +326,77 @@ class AutoscalerPolicy:
         return [decision]
 
     # ------------------------------------------------------------- actions
+
+    def _maybe_quarantine_drain(
+        self, snapshot: SwarmSnapshot, evidence: dict
+    ) -> Optional[Decision]:
+        """Drain-and-replace integrity-quarantined replicas.
+
+        Bypasses the cold-streak/hysteresis machinery (the evidence is the
+        canary prober's quorum, not an occupancy signal) and the startup
+        grace, but still honors the global cooldown so a multi-replica
+        quarantine unwinds one decision per ``cooldown_global`` ticks.
+        Coverage-preserving both ways: when draining would uncover blocks,
+        the REPLACEMENT is spawned first and the drain happens on a later
+        tick, once the new replica covers the span."""
+        cfg = self.config
+        if (
+            self._last_any is not None
+            and snapshot.tick - self._last_any < cfg.cooldown_global
+        ):
+            return None
+        quarantined = sorted(
+            (s for s in snapshot.servers if s.online and s.quarantined),
+            key=lambda s: s.peer,
+        )
+        # replacement owed from a previous drain fires before anything else
+        if self._pending_replace is not None:
+            span = self._pending_replace
+            if snapshot.replica_count() >= cfg.max_replicas:
+                self._pending_replace = None  # the swarm is full; drop the IOU
+            else:
+                self._pending_replace = None
+                return Decision(
+                    tick=snapshot.tick,
+                    action="scale_out",
+                    target=None,
+                    span=span,
+                    reason="replace drained quarantined replica",
+                    evidence={**evidence, "quarantined": [s.peer for s in quarantined]},
+                )
+        if not quarantined:
+            return None
+        victim = quarantined[0]
+        ev = {
+            **evidence,
+            "quarantined": [s.peer for s in quarantined],
+            "victim": victim.peer,
+        }
+        if (
+            snapshot.replica_count() > cfg.min_replicas
+            and self._still_covered(snapshot, without=victim.peer)
+        ):
+            self._pending_replace = (victim.start, victim.end)
+            return Decision(
+                tick=snapshot.tick,
+                action="scale_in",
+                target=victim.peer,
+                span=(victim.start, victim.end),
+                reason="integrity quarantine: drain divergent replica",
+                evidence=ev,
+            )
+        # sole coverage of its blocks: spawn the replacement FIRST; the
+        # drain fires on a later tick once the new replica is online
+        if snapshot.replica_count() < cfg.max_replicas:
+            return Decision(
+                tick=snapshot.tick,
+                action="scale_out",
+                target=None,
+                span=(victim.start, victim.end),
+                reason="integrity quarantine: replace sole-coverage replica",
+                evidence=ev,
+            )
+        return None
 
     def _cooled_down(self, action: str, cooldown: int, tick: int) -> bool:
         last = self._last_fire.get(action)

@@ -220,6 +220,32 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             }
             body = (_json.dumps(view, default=str) + "\n").encode()
             ctype = "application/json"
+        elif path == "/integrity":
+            # integrity observatory view: fingerprint config (enabled, seed,
+            # dim, tolerance table) plus the process-local quarantine
+            # registry. Digest hexes and peer ids appear ONLY here and in
+            # the journal, never as metric labels.
+            import json as _json
+
+            from petals_tpu.ops import fingerprint as fp
+            from petals_tpu.telemetry.integrity import get_quarantine
+
+            view = {
+                "enabled": fp.enabled(),
+                "fp_seed": fp.fp_seed(),
+                "fp_dim": fp.FP_DIM,
+                "tolerances": {
+                    "exact": fp.TOL_EXACT,
+                    "transport": fp.TOL_TRANSPORT,
+                    "lossy_wire": fp.TOL_LOSSY_WIRE,
+                    "cross_replica": {
+                        q: fp.tolerance_for(q) for q in ("none", "int8", "nf4")
+                    },
+                },
+                "quarantined": get_quarantine().snapshot(),
+            }
+            body = (_json.dumps(view) + "\n").encode()
+            ctype = "application/json"
         elif path == "/ledger":
             # per-tenant resource ledger: top-k consumers with page-second /
             # compute-second / token / swap attribution. Peer ids appear ONLY

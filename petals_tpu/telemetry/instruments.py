@@ -220,10 +220,37 @@ LEDGER_NOISY_NEIGHBORS = REGISTRY.counter(
     "while other peers' admissions queued",
 )
 
+# --- integrity observatory --------------------------------------------------
+# Digests themselves NEVER label a metric (unbounded cardinality; swarmlint's
+# no-unbounded-metric-labels rejects digest-named label values) — they ride
+# journal/flight evidence and the /integrity JSON view instead.
+INTEGRITY_DIVERGENCE = REGISTRY.counter(
+    "petals_integrity_divergence_total",
+    "Activation-fingerprint divergences detected, by detection source",
+    labels=("source",),  # client | canary | continuity
+)
+INTEGRITY_PROBES = REGISTRY.counter(
+    "petals_integrity_probes_total",
+    "Canary probes issued against span replicas, by outcome",
+    labels=("outcome",),  # ok | divergent | error
+)
+INTEGRITY_QUARANTINED = REGISTRY.gauge(
+    "petals_integrity_quarantined_peers",
+    "Peers currently quarantined by the integrity observatory",
+)
+INTEGRITY_PENALTIES = REGISTRY.counter(
+    "petals_client_integrity_penalties_total",
+    "Hard routing penalties applied to integrity-divergent servers",
+)
+
 # --- telemetry self-observation -------------------------------------------
 META_TRUNCATED = REGISTRY.counter(
     "telemetry_meta_truncated_total",
     "Span metadata entries dropped or clipped by the size cap",
+)
+ANNOUNCE_TRUNCATED = REGISTRY.counter(
+    "telemetry_announce_truncated_total",
+    "DHT announce payloads clipped by the telemetry/integrity size cap",
 )
 
 # Pre-resolved children for the per-tick paths (one dict lookup saved).

@@ -28,6 +28,7 @@ from typing import Dict, Optional
 
 from petals_tpu.data_structures import ServerState, make_uid
 from petals_tpu.dht import DHTNode
+from petals_tpu.utils.asyncio_utils import log_exception_callback
 from petals_tpu.utils.dht_utils import compute_spans, get_remote_module_infos, list_models
 from petals_tpu.utils.logging import get_logger
 
@@ -55,6 +56,40 @@ def _d(value) -> dict:
     return value if isinstance(value, dict) else {}
 
 
+def integrity_quorum(servers: dict) -> list:
+    """Announce-level integrity quorum over one model's server rows: replicas
+    of the SAME span whose self-probe ``digest_hex`` disagrees with a strict
+    majority of their span-mates are suspects.
+
+    Exact hex comparison — same golden seed, same blocks, same weights must
+    digest identically on homogeneous replicas. On heterogeneous fleets
+    (mixed accelerators, mixed quantization) the tolerance-based canary
+    prober is authoritative; this rollup only surfaces candidates, it never
+    quarantines on its own."""
+    by_span: Dict[tuple, Dict[str, str]] = {}
+    for peer, s in servers.items():
+        integ = _d(s.get("integrity"))
+        digest = integ.get("self_digest")
+        blocks = s.get("blocks")
+        if not digest or not isinstance(blocks, (list, tuple)) or len(blocks) != 2:
+            continue
+        key = (tuple(blocks), integ.get("fp_seed"), s.get("quant_type"))
+        by_span.setdefault(key, {})[peer] = str(digest)
+    suspects = []
+    for _span, digests in by_span.items():
+        if len(digests) < 3:
+            continue  # no strict majority possible — nothing attributable
+        counts: Dict[str, int] = {}
+        for d in digests.values():
+            counts[d] = counts.get(d, 0) + 1
+        majority_digest, majority_n = max(counts.items(), key=lambda kv: kv[1])
+        if majority_n * 2 > len(digests):
+            suspects.extend(
+                peer for peer, d in digests.items() if d != majority_digest
+            )
+    return sorted(suspects)
+
+
 class HealthMonitor:
     def __init__(
         self,
@@ -63,13 +98,19 @@ class HealthMonitor:
         host: str = "127.0.0.1",
         port: int = 0,
         update_period: float = 15.0,
+        canary_period: float = 0.0,
     ):
         self.initial_peers = list(initial_peers)
         self.host, self._requested_port = host, port
         self.update_period = update_period
+        # integrity canary cadence; 0 disables the probe loop
+        self.canary_period = canary_period
         self.dht: Optional[DHTNode] = None
         self._http: Optional[asyncio.AbstractServer] = None
         self._refresh_task: Optional[asyncio.Task] = None
+        self._canary_task: Optional[asyncio.Task] = None
+        self._canary_round = 0
+        self._canary_reports: list = []
         self._state: dict = {"updated_at": None, "models": {}}
         self._addr_book: dict = {}
 
@@ -82,16 +123,27 @@ class HealthMonitor:
         self.dht = await DHTNode.create(initial_peers=self.initial_peers, client_mode=True)
         await self.refresh()
         self._refresh_task = asyncio.create_task(self._refresh_loop())
+        self._refresh_task.add_done_callback(
+            log_exception_callback(logger, "health refresh loop")
+        )
+        if self.canary_period > 0:
+            self._canary_task = asyncio.create_task(self._canary_loop())
+            self._canary_task.add_done_callback(
+                log_exception_callback(logger, "canary probe loop")
+            )
         self._http = await asyncio.start_server(self._serve_http, self.host, self._requested_port)
         logger.info(f"Health monitor at http://{self.host}:{self.port}/")
 
     async def stop(self) -> None:
-        if self._refresh_task is not None:
-            self._refresh_task.cancel()
+        for task in (self._refresh_task, self._canary_task):
+            if task is None:
+                continue
+            task.cancel()
             try:
-                await self._refresh_task
+                await task
             except asyncio.CancelledError:
                 pass
+        self._refresh_task = self._canary_task = None
         if self._http is not None:
             self._http.close()
             await self._http.wait_closed()
@@ -137,6 +189,10 @@ class HealthMonitor:
                     # seconds, anomalies): nonzero anomalies = the server is
                     # recompiling in steady state
                     "compile_stats": info.compile_stats,
+                    # integrity observatory digest (self-probe fingerprint hex
+                    # + quarantine flag): replicas of the same span announcing
+                    # different self-digests are quorum suspects
+                    "integrity": getattr(info, "integrity", None),
                 }
             snapshot[prefix] = {
                 "public_name": meta.get("public_name"),
@@ -156,6 +212,86 @@ class HealthMonitor:
                 await self.refresh()
             except Exception as e:
                 logger.warning(f"Health refresh failed: {e}")
+
+    # ------------------------------------------------------------ canary
+
+    async def canary_probe(self, *, tokens: int = 4) -> list:
+        """One integrity canary round: replay a seeded golden input
+        (``ptu.probe``) against every replica of each multi-replica span
+        and quarantine fingerprint outliers by quorum
+        (telemetry.integrity.CanaryProber). The seed varies per round so a
+        corrupting replica cannot replay a previously honest digest.
+        Returns the per-span reports (also kept, bounded, on the monitor)."""
+        from petals_tpu.ops import fingerprint as fp_ops
+        from petals_tpu.telemetry.integrity import CanaryProber, get_quarantine
+
+        self._canary_round += 1
+        seed = (fp_ops.fp_seed() * 1_000_003 + self._canary_round) & 0x7FFFFFFF
+        reports = []
+        for prefix, model in self._state["models"].items():
+            # digests only compare within one (span, quant) group: different
+            # blocks digest differently by construction, and quantization
+            # sets the tolerance regime
+            groups: Dict[tuple, list] = {}
+            for peer, s in (model.get("servers") or {}).items():
+                if str(s.get("state")).upper() != "ONLINE":
+                    continue
+                blocks = s.get("blocks") or []
+                if len(blocks) != 2:
+                    continue
+                groups.setdefault(
+                    (int(blocks[0]), int(blocks[1]), s.get("quant_type")), []
+                ).append(peer)
+            for (start, end, quant), peers in sorted(groups.items()):
+                if len(peers) < 2:
+                    continue  # nothing to compare against
+                digests: Dict[str, list] = {}
+                for peer in peers:
+                    try:
+                        digests[peer] = await self._probe_peer(
+                            peer, seed=seed, tokens=tokens
+                        )
+                    except Exception as e:
+                        logger.debug(f"canary probe failed on {peer}: {e}")
+                        digests[peer] = None
+                from petals_tpu.telemetry.observatory import get_observatory
+
+                prober = CanaryProber(
+                    lambda p, _fb, _nb: digests.get(p),
+                    quarantine=get_quarantine(),
+                    # divergence evidence rides the same flight-recorder ring
+                    # as recompile anomalies and SLO breaches
+                    flight=get_observatory().flight_recorder(),
+                )
+                report = prober.probe_span(
+                    (start, end - start), peers, quant=str(quant or "none")
+                )
+                report["model"] = prefix
+                report["round"] = self._canary_round
+                reports.append(report)
+        self._canary_reports = (self._canary_reports + reports)[-64:]
+        return reports
+
+    async def _probe_peer(self, peer_str: str, *, seed: int, tokens: int) -> list:
+        from petals_tpu.data_structures import PeerID
+
+        peer_id = PeerID.from_string(peer_str)
+        addr = self._addr_book.get(peer_id)
+        if addr is None:
+            raise RuntimeError("no announced address")
+        client = await self.dht.pool.get_addr(addr)
+        reply = await asyncio.wait_for(
+            client.call("ptu.probe", {"seed": seed, "tokens": tokens}), 10.0
+        )
+        return list(reply["fp"])
+
+    async def _canary_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.canary_period)
+            try:
+                await self.canary_probe()
+            except Exception as e:
+                logger.warning(f"Canary round failed: {e}")
 
     async def is_reachable(self, peer_hex: str) -> dict:
         """Dial-back probe: can WE open (and authenticate) a connection to the
@@ -208,6 +344,11 @@ class HealthMonitor:
                 "ledger_sessions": 0,
                 "noisy_neighbor_events": 0,
                 "top_consumers": [],
+                # integrity observatory rollup: servers announcing their own
+                # quarantine, plus announce-level quorum suspects (replicas
+                # of one span whose self-probe digests disagree)
+                "quarantined_servers": 0,
+                "integrity_suspects": [],
             }
             consumers: Dict[str, dict] = {}
             for peer, s in model["servers"].items():
@@ -225,12 +366,16 @@ class HealthMonitor:
                     agg["compiled_programs"] += _i(compile_stats.get("programs"))
                     agg["compile_anomalies"] += _i(compile_stats.get("anomalies"))
                     agg["compile_s"] += _f(compile_stats.get("compile_s"))
+                integ = _d(s.get("integrity"))
+                if integ.get("quarantined"):
+                    agg["quarantined_servers"] += 1
                 servers[peer] = {
                     "public_name": s.get("public_name"),
                     "blocks": s.get("blocks"),
                     "telemetry": digest,
                     "pool": pool or None,
                     "compile_stats": compile_stats,
+                    "integrity": integ or None,
                 }
                 if not isinstance(digest, dict):
                     continue
@@ -265,13 +410,25 @@ class HealthMonitor:
                         row["page_s"] = round(row["page_s"] + page_s, 3)
                         row["share_max"] = max(row["share_max"], share)
                         row["servers"] += 1
+            agg["integrity_suspects"] = integrity_quorum(model["servers"])
             agg["top_consumers"] = sorted(
                 ({"peer": tenant, **row} for tenant, row in consumers.items()),
                 key=lambda r: -r["page_s"],
             )[:10]
             agg["occupancy"] = (agg["busy_lanes"] / agg["lanes"]) if agg["lanes"] else None
             per_model[prefix] = {"aggregate": agg, "servers": servers}
-        return {"updated_at": self._state["updated_at"], "models": per_model}
+        summary = {"updated_at": self._state["updated_at"], "models": per_model}
+        try:
+            from petals_tpu.telemetry.integrity import get_quarantine
+
+            summary["integrity"] = {
+                "canary_rounds": self._canary_round,
+                "reports": self._canary_reports[-10:],
+                "quarantined": get_quarantine().snapshot(),
+            }
+        except Exception:
+            pass  # the rollup must not die on the observatory
+        return summary
 
     # ------------------------------------------------------------------ http
 
@@ -321,8 +478,10 @@ class HealthMonitor:
                 f")</small> — {status}</h2><table border=1 cellpadding=4>"
                 "<tr><th>server</th><th>state</th><th>blocks</th><th>throughput</th>"
                 "<th>cache tokens left</th><th>load</th><th>tok/s</th><th>p99 TTFT</th>"
-                "<th>swap</th><th>frag</th><th>compiled</th><th>quant</th><th>via relay</th></tr>"
+                "<th>swap</th><th>frag</th><th>compiled</th><th>integrity</th>"
+                "<th>quant</th><th>via relay</th></tr>"
             )
+            suspects = set(integrity_quorum(model["servers"]))
             for peer, s in model["servers"].items():
                 pool = s.get("pool") if isinstance(s.get("pool"), dict) else None
                 if pool:
@@ -350,6 +509,15 @@ class HealthMonitor:
                         compiled_cell += f" / ⚠️ {anomalies} anomalies"
                 else:
                     compiled_cell = "—"
+                integ = s.get("integrity") if isinstance(s.get("integrity"), dict) else {}
+                if integ.get("quarantined"):
+                    integrity_cell = "🚫 quarantined"
+                elif peer in suspects:
+                    integrity_cell = "⚠️ digest outlier"
+                elif integ.get("self_digest"):
+                    integrity_cell = f"✅ <code>{html.escape(str(integ['self_digest'])[:8])}</code>"
+                else:
+                    integrity_cell = "—"
                 throughput = s.get("throughput")
                 throughput_cell = (
                     f"{throughput:.1f}"
@@ -363,7 +531,7 @@ class HealthMonitor:
                     f"<td>{throughput_cell}</td><td>{s.get('cache_tokens_left')}</td>"
                     f"<td>{html.escape(load)}</td>"
                     f"<td>{tok_s_cell}</td><td>{ttft_cell}</td><td>{swap_cell}</td>"
-                    f"<td>{frag_cell}</td><td>{compiled_cell}</td>"
+                    f"<td>{frag_cell}</td><td>{compiled_cell}</td><td>{integrity_cell}</td>"
                     f"<td>{html.escape(str(s.get('quant_type')))}</td><td>{'yes' if s.get('relayed') else 'no'}</td></tr>"
                 )
             rows.append("</table>")

@@ -200,6 +200,45 @@ def test_new_sites_in_grammar():
     assert rules[1].delay_s == pytest.approx(0.01) and rules[1].max_count == 2
 
 
+def test_integrity_corrupt_in_grammar():
+    """integrity.corrupt is a first-class site and ``corrupt`` a first-class
+    action: spec-parseable, rule-validatable — and malformed combinations
+    still raise at construction, not at fire time."""
+    assert chaos.SITE_INTEGRITY_CORRUPT in chaos.SITES
+    assert "corrupt" in chaos.ACTIONS
+    seed, rules = chaos.parse_spec("seed=3;integrity.corrupt:corrupt:0.25::2")
+    assert seed == 3
+    assert rules[0].site == "integrity.corrupt" and rules[0].action == "corrupt"
+    assert rules[0].p == pytest.approx(0.25) and rules[0].max_count == 2
+    for spec in (
+        "integrity.corrupt",  # missing action
+        "integrity.corrupt:explode",  # unknown action
+        "integrity.corrupt:corrupt:7",  # p out of range
+        "integrity.corrupt:corrupt:0.5:-1",  # negative delay
+        "integrity.corrupted:corrupt",  # unknown site
+    ):
+        with pytest.raises(ValueError):
+            chaos.parse_spec(spec)
+
+
+def test_integrity_corrupt_fires_per_replica():
+    """The handler's corruption gate: a ``match``'d rule fires only for the
+    targeted replica's detail string (how bench_churn corrupts ONE replica
+    of a three-way quorum), and every firing lands in the bounded log."""
+    plane = chaos.configure(
+        seed=4,
+        rules=[ChaosRule(chaos.SITE_INTEGRITY_CORRUPT, "corrupt", match="peerEvil")],
+    )
+    assert chaos.fire(chaos.SITE_INTEGRITY_CORRUPT, detail="peerGood:sess1") is None
+    assert (
+        chaos.fire(chaos.SITE_INTEGRITY_CORRUPT, detail="peerEvil:sess1") == "corrupt"
+    )
+    assert chaos.fire(chaos.SITE_INTEGRITY_CORRUPT, detail="peerEvil:probe") == "corrupt"
+    fired = plane.fired(chaos.SITE_INTEGRITY_CORRUPT)
+    assert [e["detail"] for e in fired] == ["peerEvil:sess1", "peerEvil:probe"]
+    assert all(e["action"] == "corrupt" for e in fired)
+
+
 def test_dht_lookup_site_fails_route_discovery():
     """A dropped dht.lookup fails get_remote_module_infos BEFORE any DHT
     traffic (route discovery is now injectable), with the first uid as the

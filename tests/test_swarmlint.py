@@ -372,6 +372,30 @@ def test_no_unbounded_metric_labels():
     assert "no-unbounded-metric-labels" not in rules_hit(suppressed)
 
 
+def test_no_unbounded_metric_labels_rejects_fingerprint_digests():
+    """Integrity digests are per-activation values — one metric series per
+    digest would be worse than per-session cardinality. The taint list
+    covers every spelling the fingerprint plane uses; digests belong in
+    journal events and flight records, which the rule leaves alone."""
+    bad = (
+        "def f(self, fp, digest_hex):\n"
+        "    DIV.labels(fp=fp).inc()\n"
+        "    DIV.labels(source=digest).inc()\n"  # value-side taint, any key
+        "    PROBES.labels(digest_hex=digest_hex).inc()\n"
+        "    QUAR.labels(reply.fingerprint).inc()\n"  # attribute tail
+        "    BANS.labels(fp_hex=meta['fp']).inc()\n"  # subscript key
+    )
+    assert lines_hit(bad, "no-unbounded-metric-labels") == [2, 3, 4, 5, 6]
+    ok = (
+        "def f(self, fp, source):\n"
+        "    DIV.labels(source=source).inc()\n"  # bounded enum: client|canary|continuity
+        "    PROBES.labels(outcome='divergent').inc()\n"
+        "    journal.event('integrity_divergence', local_digest=digest_hex(fp))\n"
+        "    flight.record('integrity_divergence', remote_digest=fp)\n"
+    )
+    assert "no-unbounded-metric-labels" not in rules_hit(ok)
+
+
 def test_no_naive_wallclock_in_span():
     bad = (
         "import time\n"
