@@ -1563,6 +1563,120 @@ def bench_gate_fingerprint(label, *, lanes=2, steps=40):
     return result
 
 
+def bench_gate_paged_kernel(label, *, lanes=2, steps=12):
+    """CPU-runnable gate row for the fused paged-attention path: the
+    production ``paged_decode_step`` driven directly (no batcher — this row
+    measures the DISPATCH, not the flush loop) under both forced paths of
+    PETALS_TPU_PAGED_KERNEL on a PERMUTED table layout, in ONE row so the
+    A/B is same-process. ``kernel_path`` rides the step as a static argname,
+    so BOTH compiled variants must warm up inside the observatory's warmup
+    budget — a flip-triggered recompile during the measured phases would
+    land in ``compile_anomalies``, which this row additionally asserts stays
+    ZERO across the measured ticks (the env flip is a retrace to an
+    already-warm executable, never a steady-state recompile). The pallas arm
+    runs in INTERPRET mode on CPU, so the per-arm walls are structural, not
+    decision-grade — the on-chip verdict comes from the autotune +
+    benchmarks/ablate_paged_attention.py step in on_tunnel_revival.sh."""
+    import jax.numpy as jnp
+
+    from petals_tpu.models.registry import get_family
+    from petals_tpu.server.backend import TransformerBackend
+    from petals_tpu.server.memory_cache import MemoryCache
+    from petals_tpu.telemetry import instruments as tm
+
+    cfg = _tiny_gate_cfg()
+    n_blocks = cfg.num_hidden_layers
+    params = random_params(cfg, n_blocks, jnp.float32)
+    backend = TransformerBackend(
+        get_family("llama"), cfg, params,
+        first_block=0, n_blocks=n_blocks,
+        memory_cache=MemoryCache(None), compute_dtype=jnp.float32,
+        use_flash=False,
+    )
+    rng = np.random.RandomState(0)
+    PS, MAX_PAGES = 16, 4
+    n_pages = lanes * MAX_PAGES + 2  # oversubscribed: permutation has slack
+    hkv, hd = cfg.num_key_value_heads, cfg.head_dim
+    # permuted tables: the layout where the XLA arm pays a real page gather
+    tables = rng.permutation(n_pages)[: lanes * MAX_PAGES].astype(np.int32)
+    tables = tables.reshape(lanes, MAX_PAGES)
+    kp = jnp.asarray(rng.randn(n_blocks, n_pages, PS, hkv, hd).astype(np.float32) * 0.02)
+    vp = jnp.asarray(rng.randn(n_blocks, n_pages, PS, hkv, hd).astype(np.float32) * 0.02)
+    kp_host, vp_host = np.asarray(kp), np.asarray(vp)
+    step_h = rng.randn(lanes, 1, cfg.hidden_size).astype(np.float32) * 0.02
+    pos = PS  # one resident page of (random) history per lane
+
+    env_prev = os.environ.get("PETALS_TPU_PAGED_KERNEL")
+
+    def tick(n, pools):
+        nonlocal pos
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out, pools = backend.paged_decode_step(
+                step_h, pools, np.full(lanes, pos, np.int32), tables
+            )
+            pos += 1
+        return time.perf_counter() - t0, out, pools
+
+    try:
+        # warm BOTH static kernel_path variants while the steady-state
+        # executable set is still open (observatory warmup budget)
+        os.environ["PETALS_TPU_PAGED_KERNEL"] = "xla"
+        _, _, pools = tick(1, (kp, vp))
+        os.environ["PETALS_TPU_PAGED_KERNEL"] = "pallas"
+        _, _, pools = tick(1, pools)
+
+        # path parity on identical inputs: the two compiled variants must
+        # agree (the kernel-vs-reference exactness lane proper is -m kernel)
+        parity = {}
+        for mode in ("xla", "pallas"):
+            os.environ["PETALS_TPU_PAGED_KERNEL"] = mode
+            p = pos
+            _, out, _ = tick(1, (jnp.asarray(kp_host), jnp.asarray(vp_host)))
+            pos = p  # same position for both arms
+            parity[mode] = np.asarray(out)
+        pos += 1
+        np.testing.assert_allclose(
+            parity["pallas"], parity["xla"], atol=1e-4, rtol=0,
+            err_msg="paged kernel path diverged from the XLA path",
+        )
+
+        anomalies_before = sum(
+            c.value for _v, c in tm.COMPILE_ANOMALIES.children()
+        )
+        os.environ["PETALS_TPU_PAGED_KERNEL"] = "xla"
+        wall_xla, _, pools = tick(steps, pools)
+        os.environ["PETALS_TPU_PAGED_KERNEL"] = "pallas"
+        wall_pallas, _, pools = tick(steps, pools)
+        anomalies = sum(
+            c.value for _v, c in tm.COMPILE_ANOMALIES.children()
+        ) - anomalies_before
+        assert anomalies == 0, (
+            f"paged kernel A/B caused {anomalies} post-warmup recompile "
+            f"anomalies — the env flip must resolve to already-warm "
+            f"executables"
+        )
+        import jax
+
+        return {
+            "label": label,
+            "lanes": lanes,
+            "steps": steps,
+            "layout": "permuted",
+            "xla_step_ms": round(1000.0 * wall_xla / steps, 3),
+            "pallas_step_ms": round(1000.0 * wall_pallas / steps, 3),
+            "pallas_interpret": jax.default_backend() != "tpu",
+            "post_warmup_compile_anomalies": anomalies,
+        }
+    finally:
+        if env_prev is None:
+            os.environ.pop("PETALS_TPU_PAGED_KERNEL", None)
+        else:
+            os.environ["PETALS_TPU_PAGED_KERNEL"] = env_prev
+        del params, backend
+        gc.collect()
+
+
 def _gate_row_registry():
     """Rows cheap enough for the CI perf gate (seconds each on CPU). Run via
     the same ``--row`` child protocol as the heavy rows so each gets a fresh
@@ -1573,6 +1687,7 @@ def _gate_row_registry():
         "gate_fingerprint_overhead": lambda: bench_gate_fingerprint(
             "gate_fingerprint_overhead"
         ),
+        "gate_paged_kernel": lambda: bench_gate_paged_kernel("gate_paged_kernel"),
     }
 
 

@@ -118,4 +118,21 @@ timeout 900 python -m pytest tests/ -q -m integrity 2>&1 | tail -3
 # real number is this row's overhead_pct on the TPU:
 timeout 900 python bench.py --row gate_fingerprint_overhead 2>&1 | tail -4
 
+echo "== 7/7 fused paged-attention kernel (on-chip re-ablation + autotune) =="
+# Every paged-kernel number committed so far is CPU interpret mode —
+# structural only. On silicon, re-derive the verdict in order:
+#   (a) kernel-vs-XLA ablation across lane counts x layouts x occupancy
+#       (writes paged_attention_ablation into BENCH_DETAILS.json);
+#   (b) the gate row's same-process A/B at the tiny shape (compile
+#       hygiene: zero post-warmup recompile anomalies must hold on-chip
+#       too, where the pallas arm is the REAL kernel, not interpret);
+#   (c) the -m kernel exactness lane ON the chip — Mosaic numerics vs the
+#       XLA reference is the whole point, same rationale as the smoke tier.
+# The autotune (maybe_autotune_paged_attention) runs inside (a)/(b)
+# automatically on TPU under PETALS_TPU_PAGED_KERNEL=auto and logs its
+# per-shape-class decisions; grep for "paged autotune" in the output.
+timeout 1200 python benchmarks/ablate_paged_attention.py 2>&1 | grep -v WARNING | tail -8
+timeout 900 python bench.py --row gate_paged_kernel 2>&1 | tail -3
+timeout 900 python -m pytest tests/ -q -m kernel 2>&1 | tail -3
+
 echo "== revival queue done =="

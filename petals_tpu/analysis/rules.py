@@ -819,15 +819,30 @@ def _imports_bare_jit(tree: ast.AST) -> bool:
     return False
 
 
+# Kernel modules whose entry points sit INSIDE the per-step hot path (called
+# from the jitted step programs or jitted at the top level themselves): an
+# invisible compile here is exactly the recompile-storm class the observatory
+# exists to catch. Generic ops/ modules stay out of scope — most compile cold
+# at load time or only under tests.
+_JIT_HOT_KERNEL_MODULES = (
+    "petals_tpu/ops/flash_attention.py",
+    "petals_tpu/ops/paged_flash_attention.py",
+)
+
+
 def rule_no_untracked_jit(tree, source_lines, path) -> Findings:
     """Server hot paths must compile through ``telemetry.observatory
     .tracked_jit`` so every executable lands in the compiled-program
     observatory (recompile sentinel, cost table, compile-count gate). A bare
     ``jax.jit`` — as a decorator, a call, or inside ``functools.partial(
     jax.jit, ...)`` — creates programs the observatory cannot see. Scoped to
-    ``petals_tpu/server/``; genuinely cold paths (one-shot load-time
-    compiles) are pragma-exempted with a reason."""
-    if "petals_tpu/server/" not in path.replace("\\", "/"):
+    ``petals_tpu/server/`` plus the attention-kernel hot modules; genuinely
+    cold paths (one-shot load-time compiles) are pragma-exempted with a
+    reason."""
+    norm = path.replace("\\", "/")
+    if "petals_tpu/server/" not in norm and not norm.endswith(
+        _JIT_HOT_KERNEL_MODULES
+    ):
         return []
     bare_jit = _imports_bare_jit(tree)
     out: Findings = []

@@ -106,6 +106,14 @@ def update_kv_cache(
         n = seq if n_valid is None else n_valid
         return k_new, v_new, jnp.asarray(n, jnp.int32)
     k_buf, v_buf = kv
+
+    # paged cache: the kv tuple carries (pool, block-table) pairs instead of
+    # dense buffers — scatter the new rows straight into the pages (no dense
+    # detour) and hand the PagedKV pair on to attend()'s fused dispatch
+    from petals_tpu.ops.paged_attention import PagedKV, paged_update_kv
+
+    if isinstance(k_buf, PagedKV):
+        return paged_update_kv(k_buf, v_buf, k_new, v_new, position, n_valid)
     pos = jnp.asarray(position, jnp.int32)
 
     if pos.ndim == 1:  # per-lane write (continuous batching across sessions)

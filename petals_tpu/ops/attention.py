@@ -113,6 +113,21 @@ def attend(
       tp_mesh: tensor-parallel Mesh with a "tp" axis — heads are sharded over
         it, so the Mosaic kernel (no GSPMD rule) runs per-shard via shard_map.
     """
+    # paged KV: the (pool, block-table) pair rides through the family block
+    # as a dense-buffer stand-in; route to the fused ragged kernel or its
+    # XLA-composed fallback (ops/paged_flash_attention.py). Import is local —
+    # paged_attention imports attend_reference from this module at load time.
+    from petals_tpu.ops.paged_attention import PagedKV
+
+    if isinstance(k, PagedKV):
+        from petals_tpu.ops.paged_flash_attention import paged_attend_dispatch
+
+        return paged_attend_dispatch(
+            q, k, v,
+            q_offset=q_offset, kv_length=kv_length,
+            alibi_slopes=alibi_slopes, sliding_window=sliding_window,
+            scale=scale, causal=causal, logit_softcap=logit_softcap,
+        )
     # per-lane positions ([batch] vectors, continuous batching) run the XLA
     # path: decode shapes never route to the flash kernel anyway, and the
     # Mosaic kernel takes scalar offsets only

@@ -16,17 +16,20 @@ Layout contract (per span block):
   is always finite (zero-init, only ever written with computed values), so
   paged decode is numerically IDENTICAL to the dense path.
 
-XLA-first design: no dynamic shapes anywhere. The gather materializes a
-transient dense [n_lanes, max_length, ...] view inside the step program (the
-same tensor the dense path reads), the model family's block code runs
-unchanged on it, and only the written token rows are scattered back into the
-pool. Sessions joining/leaving mutate TABLE VALUES, never shapes — one
-compiled program, no recompiles, which is the whole reason the dense lane
-pool existed (server/batching.py module docstring). When every table row is
-the identity mapping (lane i owns pages [i*max_pages, (i+1)*max_pages)), the
-gather/scatter collapse to reshapes and the step IS the dense program —
-bit-exact, and the allocator prefers identity pages so the fast path is the
-common case at the default (non-oversubscribed) pool size.
+One attention path: the step programs no longer materialize a dense view in
+front of attention. The (pool, tables) pair rides through the model family's
+block code as a ``PagedKV`` pytree standing in for the dense KV buffer;
+``models/common.py update_kv_cache`` scatters the new rows straight into the
+pool and ``ops/attention.py attend`` dispatches to the fused ragged kernel
+(ops/paged_flash_attention.py) — or, on CPU / when autotune prefers it, to
+the XLA-composed gather + attend_reference fallback kept in this module.
+Dense is just the identity block table (lane i owns pages [i*max_pages,
+(i+1)*max_pages)): the identity gather yields byte-identical values to the
+dense reshape, so the XLA fallback stays bit-exact with the dense program,
+and the allocator still prefers identity pages so page reads stay streaming.
+Sessions joining/leaving mutate TABLE VALUES, never shapes — one compiled
+program, no recompiles, which is the whole reason the dense lane pool
+existed (server/batching.py module docstring).
 
 Scatter safety: invalid writes (idle-lane sentinel position, unallocated
 slot) are routed to flat index ``n_pages * page_size`` — one past the pool —
@@ -36,12 +39,43 @@ sentinel convention (models/common.py update_kv_cache).
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import NamedTuple, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
 from petals_tpu.ops.attention import attend_reference
+
+
+class PagedKV(NamedTuple):
+    """One attention side (k or v) of a block's paged cache: the shared page
+    pool plus the per-lane block tables. A NamedTuple, so it is automatically
+    a JAX pytree and rides through ``block_apply``'s kv tuple / lax.scan
+    carries unchanged; ``update_kv_cache`` and ``attend`` recognise it by
+    isinstance and route to the paged scatter / fused-kernel dispatch instead
+    of the dense buffer code."""
+
+    pool: jnp.ndarray  # [n_pages, page_size, hkv, d]
+    tables: jnp.ndarray  # [n_lanes, max_pages] int32; -1 = unallocated slot
+
+    @property
+    def page_size(self) -> int:
+        return self.pool.shape[1]
+
+    @property
+    def max_length(self) -> int:
+        return self.tables.shape[1] * self.pool.shape[1]
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        """Dense-equivalent shape [n_lanes, max_length, hkv, d] — family block
+        code reads ``k_all.shape[1]`` for the buffer length (e.g. gemma2's
+        effective-window computation), so the stand-in must answer it."""
+        return (self.tables.shape[0], self.max_length, *self.pool.shape[2:])
+
+    @property
+    def dtype(self):
+        return self.pool.dtype
 
 
 def max_pages_for(max_length: int, page_size: int) -> int:
@@ -70,13 +104,18 @@ def gather_pages(pool: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
     """Materialize the dense per-lane view of one block's page pool.
 
     pool [n_pages, page_size, hkv, d] + tables [n_lanes, max_pages] ->
-    [n_lanes, max_pages * page_size, hkv, d]. Unallocated slots (-1) clip to
-    page 0: garbage content, but every read of it is masked (ragged
-    kv_length) and every write to it is dropped (scatter)."""
+    [n_lanes, max_pages * page_size, hkv, d]. Unallocated slots (-1) read as
+    ZEROS: they must not surface page 0's live bytes into a lane that does
+    not own that page (attention masks them to 0.0 weight either way, but
+    the dense view escapes attention — kv export, debug dumps — so the
+    fallback path must never alias another tenant's content). The fused
+    kernel skips -1 slots entirely, so both paths agree bit-for-bit."""
     n_pages, page_size = pool.shape[0], pool.shape[1]
     n_lanes, max_pages = tables.shape
-    safe = jnp.clip(tables.reshape(-1), 0, n_pages - 1)
+    flat = tables.reshape(-1)
+    safe = jnp.clip(flat, 0, n_pages - 1)
     pages = jnp.take(pool, safe, axis=0)  # [n_lanes*max_pages, ps, hkv, d]
+    pages = jnp.where((flat >= 0)[:, None, None, None], pages, jnp.zeros((), pool.dtype))
     return pages.reshape(n_lanes, max_pages * page_size, *pool.shape[2:])
 
 
@@ -135,6 +174,52 @@ def scatter_lane_pages(
     n_pages = pool.shape[0]
     safe = jnp.where(table_row >= 0, table_row, n_pages)
     return pool.at[safe].set(lane_pages.astype(pool.dtype), mode="drop")
+
+
+def paged_update_kv(
+    k_kv: "PagedKV",
+    v_kv: "PagedKV",
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    position,
+    n_valid=None,
+):
+    """The PagedKV arm of ``models/common.py update_kv_cache``: scatter the
+    freshly computed rows straight into the page pools (no dense detour) and
+    return the updated PagedKV pair plus the valid kv length.
+
+    Two write shapes, mirroring the dense helper's branches:
+    - per-lane decode: ``position`` is a [n_lanes] vector, k_new/v_new are
+      [n_lanes, 1, hkv, d] — one token row per lane (idle sentinel positions
+      drop inside scatter_token_rows).
+    - chunked prefill: ``position`` is a scalar, k_new/v_new are
+      [1, chunk, hkv, d] with ``n_valid`` real rows — the single lane's
+      table row is ``tables[0]`` (the step builder wraps it as [1, max_pages]).
+    """
+    pos = jnp.asarray(position, jnp.int32)
+    tables = k_kv.tables
+    if pos.ndim == 1:
+        if k_new.shape[1] != 1 or n_valid is not None:
+            raise ValueError(
+                "per-lane paged writes are decode-shaped: one token per lane, "
+                f"no n_valid (got seq={k_new.shape[1]}, n_valid={n_valid})"
+            )
+        k_pool = scatter_token_rows(k_kv.pool, k_new[:, 0], tables, pos)
+        v_pool = scatter_token_rows(v_kv.pool, v_new[:, 0], tables, pos)
+        return PagedKV(k_pool, tables), PagedKV(v_pool, tables), pos + 1
+    if k_new.shape[0] != 1 or tables.shape[0] != 1:
+        raise ValueError(
+            "scalar-position paged writes are single-lane chunks: "
+            f"got batch={k_new.shape[0]}, table rows={tables.shape[0]}"
+        )
+    seq = k_new.shape[1]
+    n = jnp.asarray(seq if n_valid is None else n_valid, jnp.int32)
+    offs = jnp.arange(seq, dtype=jnp.int32)
+    # padded tail rows route to the one-past-the-end sentinel and drop
+    write_pos = jnp.where(offs < n, pos + offs, jnp.int32(k_kv.max_length))
+    k_pool = scatter_chunk_rows(k_kv.pool, k_new[0], tables[0], write_pos)
+    v_pool = scatter_chunk_rows(v_kv.pool, v_new[0], tables[0], write_pos)
+    return PagedKV(k_pool, tables), PagedKV(v_pool, tables), pos + n
 
 
 def paged_attend(

@@ -1,0 +1,769 @@
+"""Fused ragged paged-attention Pallas kernel — ONE attention path for dense,
+paged, and mixed steps ("Ragged Paged Attention", arXiv:2604.15464).
+
+The paged steps used to be composed from XLA as gather -> dense-attend ->
+scatter: every attention call first materialized a transient
+[n_lanes, max_pages*page_size, hkv, d] dense view of the page pool, paying
+HBM bandwidth and memory proportional to max_length instead of the actual
+ragged lengths. This kernel walks the block tables directly: the KV
+BlockSpec index maps read the per-lane table (scalar-prefetched into SMEM)
+and fetch pages straight from the [n_pages, page_size, hkv, d] pool — no
+materialized gather, and pages beyond a lane's ragged frontier
+(kv_length = position + 1), beyond the sliding window, or unallocated (-1)
+are never fetched at all (their DMA is redirected to a repeated block index,
+which Pallas elides). Dense is just the identity block table, so the same
+kernel serves the dense-shaped steps too.
+
+Structure is lifted from ops/flash_attention.py: online-softmax m/l/acc
+scratch carried across the innermost (arbitrary) grid axis, a shared
+"needed" predicate between the kernel's @pl.when skip and the index map's
+DMA-elision redirect, and an interior/edge tile split so fully-visible pages
+skip mask construction. Two entries mirror the reference contracts in
+ops/paged_attention.py: ``paged_flash_attend`` (decode: per-lane positions)
+and ``paged_flash_prefill_attend`` (one lane's chunked-prefill bucket).
+
+Path selection (``paged_attend_dispatch``, reached via ops/attention.py
+attend() on a PagedKV): per (n_lanes, max_pages, page_size, hkv, d, window)
+shape class, an autotune harness on the maybe_autotune_nf4_decode pattern
+times kernel-vs-XLA-composed on the real chip at startup and traces the
+winner into the step program. ``PETALS_TPU_PAGED_KERNEL=pallas|xla|auto``
+overrides; off-TPU the XLA-composed path (gather_pages + attend_reference)
+is the guaranteed fallback, so tier-1 CPU runs never depend on interpret-
+mode Mosaic semantics unless a test asks for the kernel explicitly.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from petals_tpu.telemetry.observatory import tracked_jit
+
+# jax<0.5 names this TPUCompilerParams; alias locally, never patch jax
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+LANES = 128
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+_ENV_VAR = "PETALS_TPU_PAGED_KERNEL"
+_MODES = ("pallas", "xla", "auto")
+
+# (kind, n_lanes, max_pages, page_size, hkv, d, window) -> use_pallas.
+# Populated by maybe_autotune_paged_attention on TPU, or by tests via
+# set_paged_kernel_decision; consulted at TRACE time by the dispatch.
+_AUTOTUNE: dict = {}
+
+
+def kernel_mode() -> str:
+    """The PETALS_TPU_PAGED_KERNEL override, validated. Read per call — the
+    step wrappers pass the resolved path as a STATIC jit argument, so an env
+    flip retraces the step under the new path instead of being ignored."""
+    raw = os.environ.get(_ENV_VAR, "auto").strip().lower()
+    if raw not in _MODES:
+        raise ValueError(f"{_ENV_VAR}={raw!r}: expected one of {_MODES}")
+    return raw
+
+
+def _platform() -> str:
+    # indirection so the autotune decision unit tests can fake a TPU
+    return jax.default_backend()
+
+
+def shape_class(
+    n_lanes: int, max_pages: int, page_size: int, hkv: int, d: int,
+    window: Optional[int],
+) -> Tuple:
+    """The autotune key: every quantity the kernel's tiling/skip behaviour
+    depends on. A traced (non-int) window is keyed as None — such calls are
+    forced to the XLA path anyway (gemma2)."""
+    return (
+        int(n_lanes), int(max_pages), int(page_size), int(hkv), int(d),
+        window if isinstance(window, int) else None,
+    )
+
+
+def decide_paged_kernel(kind: str, key: Tuple) -> bool:
+    """TRACE-time path choice for one shape class. pallas/xla modes force;
+    auto uses the autotuned winner (untuned TPU shapes default to the kernel,
+    untuned prefill shapes inherit the decode decision for the same class),
+    and non-TPU platforms always take the guaranteed XLA fallback."""
+    mode = kernel_mode()
+    if mode == "pallas":
+        return True
+    if mode == "xla":
+        return False
+    if _platform() != "tpu":
+        return False
+    return _AUTOTUNE.get((kind, *key), _AUTOTUNE.get(("decode", *key), True))
+
+
+def resolve_paged_kernel_path(kind: str, key: Tuple) -> str:
+    """Host-side resolution for the step wrappers: the returned string rides
+    as a STATIC argument of the jitted step purely so that a changed decision
+    (env flip, fresh autotune) triggers a retrace that re-consults
+    decide_paged_kernel. Steady state: one value, zero extra compiles."""
+    return "pallas" if decide_paged_kernel(kind, key) else "xla"
+
+
+def set_paged_kernel_decision(kind: str, key: Tuple, use_pallas: bool) -> None:
+    _AUTOTUNE[(kind, *key)] = bool(use_pallas)
+
+
+def reset_paged_autotune() -> None:
+    _AUTOTUNE.clear()
+
+
+# ---------------------------------------------------------------------------
+# decode kernel: grid (n_lanes, hkv, max_pages), one token row per lane
+# ---------------------------------------------------------------------------
+
+
+def _decode_page_needed(page, slot_start, kv_len, page_size, sliding_window):
+    """Does this page hold any kv position the lane's single query row sees?
+    Shared by the kernel's skip predicate and the kv index map's DMA-elision
+    redirect — the two MUST agree, or a skipped-but-fetched page silently
+    computes on page-0 data. The query row sits at kv_len - 1, so causal
+    masking IS the ragged-length mask; the window frontier keeps only pages
+    whose last position >= kv_len - window."""
+    needed = (page >= 0) & (slot_start < kv_len)
+    if sliding_window is not None:
+        needed &= slot_start + page_size > kv_len - sliding_window
+    return needed
+
+
+def _decode_kernel(
+    # scalar prefetch
+    tables_ref,  # int32[n_lanes, max_pages]
+    kv_lens_ref,  # int32[n_lanes]
+    # inputs
+    q_ref,  # [1, 1, group, head_dim]
+    k_ref,  # [1, page_size, 1, head_dim] — one page of the pool
+    v_ref,  # [1, page_size, 1, head_dim]
+    slopes_ref,  # [1, group] f32
+    # outputs
+    o_ref,  # [1, 1, group, head_dim]
+    # scratch
+    m_scratch,  # [group, LANES] f32
+    l_scratch,  # [group, LANES] f32
+    acc_scratch,  # [group, head_dim] f32
+    *,
+    scale: float,
+    page_size: int,
+    max_pages: int,
+    group: int,
+    head_dim: int,
+    use_alibi: bool,
+    sliding_window: Optional[int] = None,
+):
+    i = pl.program_id(0)
+    j = pl.program_id(2)
+
+    kv_len = kv_lens_ref[i]
+    page = tables_ref[i, j]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scratch[...] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[...] = jnp.zeros_like(l_scratch)
+        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    slot_start = j * page_size
+    needed = _decode_page_needed(page, slot_start, kv_len, page_size, sliding_window)
+
+    # interior pages sit fully inside the lane's visible range: every position
+    # is < kv_len and (with a window) >= kv_len - window — no mask work
+    interior = slot_start + page_size <= kv_len
+    if sliding_window is not None:
+        interior &= slot_start >= kv_len - sliding_window
+
+    def _tile(masked: bool):
+        q = q_ref[...].reshape(group, head_dim)
+        k = k_ref[...].reshape(page_size, head_dim)
+        v = v_ref[...].reshape(page_size, head_dim)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [group, page_size] f32
+        s = s * scale
+
+        kv_pos_row = slot_start + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        if use_alibi:
+            slopes_col = slopes_ref[...].reshape(group, 1)
+            s = s + slopes_col * kv_pos_row.astype(jnp.float32)
+
+        if masked:
+            kv_pos = slot_start + jax.lax.broadcasted_iota(
+                jnp.int32, (group, page_size), 1
+            )
+            mask = kv_pos < kv_len  # causal == ragged length for the decode row
+            if sliding_window is not None:
+                mask &= kv_pos > kv_len - 1 - sliding_window
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scratch[...]
+        l_prev = l_scratch[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # [group, 1]
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+
+        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])  # [group, 1]
+        p = jnp.exp(s - m_new[:, :1])  # [group, page_size]
+        if masked:
+            p = jnp.where(mask, p, 0.0)
+
+        l_new = alpha * l_prev[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+
+        acc = acc_scratch[...]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scratch[...] = acc * alpha + pv
+
+        m_scratch[...] = m_new
+        l_scratch[...] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    @pl.when(needed & interior)
+    def _compute_interior():
+        _tile(masked=False)
+
+    @pl.when(needed & jnp.logical_not(interior))
+    def _compute_edge():
+        _tile(masked=True)
+
+    @pl.when(j == max_pages - 1)
+    def _finalize():
+        # idle lanes (no needed page) keep l == 0 and emit exact zeros
+        l = l_scratch[:, :1]
+        out = acc_scratch[...] / jnp.maximum(l, 1e-30)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@tracked_jit(
+    name="paged_flash_attend",
+    static_argnames=("scale", "sliding_window", "interpret"),
+)
+def paged_flash_attend(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    tables: jnp.ndarray,
+    positions: jnp.ndarray,
+    *,
+    alibi_slopes: Optional[jnp.ndarray] = None,
+    sliding_window: Optional[int] = None,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused ragged paged-attention DECODE: same contract as
+    ops/paged_attention.py paged_attend. q [n_lanes, 1, hq, d]; k/v_pool
+    [n_pages, page_size, hkv, d]; tables [n_lanes, max_pages] int32 (-1 =
+    unallocated, skipped — never fetched); positions [n_lanes] int32 (ragged
+    kv_length = position + 1; idle sentinel lanes produce finite garbage that
+    the caller never reads, exactly like the reference)."""
+    n_lanes, q_len, num_q_heads, head_dim = q.shape
+    n_pages, page_size, num_kv_heads, _ = k_pool.shape
+    if q_len != 1:
+        raise ValueError(f"decode kernel takes one token per lane, got q_len={q_len}")
+    assert num_q_heads % num_kv_heads == 0, (num_q_heads, num_kv_heads)
+    group = num_q_heads // num_kv_heads
+    max_pages = tables.shape[1]
+    if scale is None:
+        scale = head_dim**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # fold q heads as (hkv, group) — the same grouping attend_reference uses,
+    # so each kv head's group of query rows shares one page fetch
+    q4 = q[:, 0].reshape(n_lanes, num_kv_heads, group, head_dim)
+    tables_arr = jnp.asarray(tables, jnp.int32)
+    kv_lens = jnp.asarray(positions, jnp.int32) + 1
+    if alibi_slopes is None:
+        slopes = jnp.zeros((num_kv_heads, group), jnp.float32)
+        use_alibi = False
+    else:
+        slopes = alibi_slopes.astype(jnp.float32).reshape(num_kv_heads, group)
+        use_alibi = True
+
+    grid = (n_lanes, num_kv_heads, max_pages)
+
+    kernel = functools.partial(
+        _decode_kernel,
+        scale=scale,
+        page_size=page_size,
+        max_pages=max_pages,
+        group=group,
+        head_dim=head_dim,
+        use_alibi=use_alibi,
+        sliding_window=sliding_window,
+    )
+
+    def kv_index_map(i, h, j, tables_ref, kv_lens_ref):
+        # skipped pages redirect to block 0: the repeated index elides the DMA
+        page = tables_ref[i, j]
+        needed = _decode_page_needed(
+            page, j * page_size, kv_lens_ref[i], page_size, sliding_window
+        )
+        return (jax.lax.select(needed, page, 0), 0, h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, group, head_dim), lambda i, h, j, *pf: (i, h, 0, 0)),
+            pl.BlockSpec((1, page_size, 1, head_dim), kv_index_map),
+            pl.BlockSpec((1, page_size, 1, head_dim), kv_index_map),
+            pl.BlockSpec((1, group), lambda i, h, j, *pf: (h, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group, head_dim), lambda i, h, j, *pf: (i, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group, LANES), jnp.float32),
+            pltpu.VMEM((group, LANES), jnp.float32),
+            pltpu.VMEM((group, head_dim), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q4.shape, q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(tables_arr, kv_lens, q4, k_pool, v_pool, slopes)
+
+    return out.reshape(n_lanes, 1, num_q_heads, head_dim)
+
+
+# ---------------------------------------------------------------------------
+# chunked-prefill kernel: grid (hq, num_q_blocks, max_pages), one lane
+# ---------------------------------------------------------------------------
+
+
+def _prefill_page_needed(
+    page, q_block_start, block_q, slot_start, kv_len, page_size, sliding_window
+):
+    """Does any (q row, kv position) pair of this (q block, page) tile need
+    computing? Shared by the kernel skip and the kv index map redirect."""
+    needed = (
+        (page >= 0)
+        & (slot_start <= q_block_start + block_q - 1)  # causal frontier
+        & (slot_start < kv_len)
+    )
+    if sliding_window is not None:
+        needed &= slot_start + page_size - 1 > q_block_start - sliding_window
+    return needed
+
+
+def _prefill_kernel(
+    # scalar prefetch
+    table_row_ref,  # int32[max_pages]
+    info_ref,  # int32[2] = (chunk_pos, kv_len)
+    slopes_ref,  # float32[num_q_heads]
+    # inputs
+    q_ref,  # [1, block_q, head_dim]
+    k_ref,  # [1, page_size, 1, head_dim]
+    v_ref,  # [1, page_size, 1, head_dim]
+    # outputs
+    o_ref,  # [1, block_q, head_dim]
+    # scratch
+    m_scratch,  # [block_q, LANES] f32
+    l_scratch,  # [block_q, LANES] f32
+    acc_scratch,  # [block_q, head_dim] f32
+    *,
+    scale: float,
+    block_q: int,
+    page_size: int,
+    max_pages: int,
+    head_dim: int,
+    use_alibi: bool,
+    sliding_window: Optional[int] = None,
+):
+    h = pl.program_id(0)
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    chunk_pos = info_ref[0]
+    kv_len = info_ref[1]
+    page = table_row_ref[j]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scratch[...] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[...] = jnp.zeros_like(l_scratch)
+        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    q_block_start = chunk_pos + qi * block_q
+    slot_start = j * page_size
+    needed = _prefill_page_needed(
+        page, q_block_start, block_q, slot_start, kv_len, page_size, sliding_window
+    )
+
+    interior = (slot_start + page_size - 1 <= q_block_start) & (
+        slot_start + page_size <= kv_len
+    )
+    if sliding_window is not None:
+        interior &= slot_start >= q_block_start + block_q - sliding_window
+
+    def _tile(masked: bool):
+        q = q_ref[...].reshape(block_q, head_dim)
+        k = k_ref[...].reshape(page_size, head_dim)
+        v = v_ref[...].reshape(page_size, head_dim)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, page_size]
+        s = s * scale
+
+        kv_pos_row = slot_start + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        if use_alibi:
+            s = s + slopes_ref[h] * kv_pos_row.astype(jnp.float32)
+
+        if masked:
+            kv_pos = slot_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, page_size), 1
+            )
+            q_pos = q_block_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, page_size), 0
+            )
+            mask = (kv_pos <= q_pos) & (kv_pos < kv_len)
+            if sliding_window is not None:
+                mask &= kv_pos > q_pos - sliding_window
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scratch[...]
+        l_prev = l_scratch[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+
+        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])
+        p = jnp.exp(s - m_new[:, :1])
+        if masked:
+            p = jnp.where(mask, p, 0.0)
+
+        l_new = alpha * l_prev[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+
+        acc = acc_scratch[...]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scratch[...] = acc * alpha + pv
+
+        m_scratch[...] = m_new
+        l_scratch[...] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    @pl.when(needed & interior)
+    def _compute_interior():
+        _tile(masked=False)
+
+    @pl.when(needed & jnp.logical_not(interior))
+    def _compute_edge():
+        _tile(masked=True)
+
+    @pl.when(j == max_pages - 1)
+    def _finalize():
+        # a chunk_pos==0, n_valid==0 bucket leaves l == 0 -> exact zeros
+        l = l_scratch[:, :1]
+        out = acc_scratch[...] / jnp.maximum(l, 1e-30)
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+@tracked_jit(
+    name="paged_flash_prefill_attend",
+    static_argnames=("scale", "sliding_window", "block_q", "interpret"),
+)
+def paged_flash_prefill_attend(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    table_row: jnp.ndarray,
+    chunk_pos: jnp.ndarray,
+    n_valid: jnp.ndarray,
+    *,
+    alibi_slopes: Optional[jnp.ndarray] = None,
+    sliding_window: Optional[int] = None,
+    scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused ragged paged-attention CHUNKED PREFILL: same contract as
+    ops/paged_attention.py paged_prefill_attend. q [1, chunk, hq, d] (padded
+    to a bucket); table_row [max_pages] int32; chunk_pos scalar int32
+    (absolute position of the chunk's first token); n_valid scalar int32
+    (padded-tail rows produce garbage-but-unread outputs, as in the
+    reference). The chunk's KV must already be scattered into the pages."""
+    batch, q_len, num_q_heads, head_dim = q.shape
+    n_pages, page_size, num_kv_heads, _ = k_pool.shape
+    if batch != 1:
+        raise ValueError(f"prefill kernel serves one lane's chunk, got batch={batch}")
+    assert num_q_heads % num_kv_heads == 0, (num_q_heads, num_kv_heads)
+    group = num_q_heads // num_kv_heads
+    max_pages = table_row.shape[0]
+    if scale is None:
+        scale = head_dim**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    block_q = min(block_q or 256, _round_up(q_len, 8))
+    q_pad = _round_up(q_len, block_q) - q_len
+    if q_pad:
+        q = jnp.pad(q, ((0, 0), (0, q_pad), (0, 0), (0, 0)))
+    padded_q_len = q.shape[1]
+    num_q_blocks = padded_q_len // block_q
+
+    # kernel layout [heads, seq, head_dim]: blocked (seq, head_dim) trailing
+    qt = q[0].transpose(1, 0, 2)
+
+    table_arr = jnp.asarray(table_row, jnp.int32)
+    pos = jnp.asarray(chunk_pos, jnp.int32).reshape(())
+    info = jnp.stack([pos, pos + jnp.asarray(n_valid, jnp.int32).reshape(())])
+    if alibi_slopes is None:
+        slopes = jnp.zeros((num_q_heads,), jnp.float32)
+        use_alibi = False
+    else:
+        slopes = alibi_slopes.astype(jnp.float32)
+        use_alibi = True
+
+    grid = (num_q_heads, num_q_blocks, max_pages)
+
+    kernel = functools.partial(
+        _prefill_kernel,
+        scale=scale,
+        block_q=block_q,
+        page_size=page_size,
+        max_pages=max_pages,
+        head_dim=head_dim,
+        use_alibi=use_alibi,
+        sliding_window=sliding_window,
+    )
+
+    def kv_index_map(h, qi, j, table_row_ref, info_ref, slopes_ref):
+        page = table_row_ref[j]
+        needed = _prefill_page_needed(
+            page, info_ref[0] + qi * block_q, block_q,
+            j * page_size, info_ref[1], page_size, sliding_window,
+        )
+        return (jax.lax.select(needed, page, 0), 0, h // group, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda h, qi, j, *pf: (h, qi, 0)),
+            pl.BlockSpec((1, page_size, 1, head_dim), kv_index_map),
+            pl.BlockSpec((1, page_size, 1, head_dim), kv_index_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, head_dim), lambda h, qi, j, *pf: (h, qi, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(table_arr, info, slopes, qt, k_pool, v_pool)
+
+    out = out.transpose(1, 0, 2)[None]
+    if q_pad:
+        out = out[:, :q_len]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dispatch: the one attention path for PagedKV (called from attend())
+# ---------------------------------------------------------------------------
+
+
+def paged_attend_dispatch(
+    q: jnp.ndarray,
+    k_kv,
+    v_kv,
+    *,
+    q_offset,
+    kv_length,
+    alibi_slopes: Optional[jnp.ndarray] = None,
+    sliding_window=None,
+    scale: Optional[float] = None,
+    causal: bool = True,
+    logit_softcap: Optional[float] = None,
+) -> jnp.ndarray:
+    """Route a PagedKV attention call (TRACE time, inside the step program)
+    to the fused kernel or the XLA-composed gather + attend_reference.
+
+    Decode vs prefill is distinguished by the position rank: per-lane [n]
+    vectors are the decode contract (ragged kv_length = position + 1), a
+    scalar is one lane's chunked-prefill bucket. Calls the kernel cannot
+    express — gemma2's logit softcap and its TRACED effective window,
+    non-causal — always compose from XLA, with identical math to the old
+    gather/attend sandwich."""
+    from petals_tpu.ops.attention import attend_reference
+    from petals_tpu.ops.paged_attention import gather_pages
+
+    k_pool, tables = k_kv.pool, k_kv.tables
+    v_pool = v_kv.pool
+    pos = jnp.asarray(q_offset, jnp.int32)
+    decode = pos.ndim == 1
+
+    window_static = sliding_window is None or isinstance(sliding_window, int)
+    forced_xla = (
+        logit_softcap is not None
+        or not causal
+        or not window_static
+        or kv_length is None
+    )
+    key = shape_class(
+        tables.shape[0], tables.shape[1], k_pool.shape[1],
+        k_pool.shape[2], k_pool.shape[3],
+        sliding_window if window_static else None,
+    )
+    kind = "decode" if decode else "prefill"
+    if not forced_xla and decide_paged_kernel(kind, key):
+        if decode:
+            return paged_flash_attend(
+                q, k_pool, v_pool, tables, pos,
+                alibi_slopes=alibi_slopes, sliding_window=sliding_window,
+                scale=scale,
+            )
+        kv_len = jnp.asarray(kv_length, jnp.int32).reshape(())
+        return paged_flash_prefill_attend(
+            q, k_pool, v_pool, tables[0], pos.reshape(()), kv_len - pos.reshape(()),
+            alibi_slopes=alibi_slopes, sliding_window=sliding_window,
+            scale=scale,
+        )
+    k = gather_pages(k_pool, tables)
+    v = gather_pages(v_pool, tables)
+    return attend_reference(
+        q, k, v, q_offset=pos, kv_length=kv_length,
+        alibi_slopes=alibi_slopes, sliding_window=sliding_window,
+        scale=scale, causal=causal, logit_softcap=logit_softcap,
+    )
+
+
+# ---------------------------------------------------------------------------
+# autotune: time kernel vs XLA-composed per shape class, once per process
+# ---------------------------------------------------------------------------
+
+
+def maybe_autotune_paged_attention(
+    *,
+    n_lanes: int,
+    max_pages: int,
+    page_size: int,
+    hkv: int,
+    d: int,
+    group: int = 1,
+    window: Optional[int] = None,
+    steps: int = 12,
+) -> bool:
+    """Measure the fused kernel vs the XLA gather+attend at this decode shape
+    class on the real device, once per process per class; returns the chosen
+    use_pallas and records it for decide_paged_kernel (prefill inherits the
+    decode decision). No-op off-TPU or when PETALS_TPU_PAGED_KERNEL forces a
+    path — the maybe_autotune_nf4_decode pattern (ops/quant.py)."""
+    key = shape_class(n_lanes, max_pages, page_size, hkv, d, window)
+    if kernel_mode() != "auto" or _platform() != "tpu":
+        return decide_paged_kernel("decode", key)
+    if ("decode", *key) in _AUTOTUNE:
+        return _AUTOTUNE[("decode", *key)]
+    import time
+
+    import numpy as np
+
+    from petals_tpu.ops.paged_attention import gather_pages, identity_tables
+    from petals_tpu.ops.attention import attend_reference
+
+    hq = hkv * max(int(group), 1)
+    n_pages = n_lanes * max_pages
+    rng = np.random.default_rng(0)
+    # a permuted, ~75%-occupied table: the shape the kernel must win at —
+    # identity tables would let XLA's gather degenerate to a reshape
+    perm = rng.permutation(n_pages).astype(np.int32).reshape(n_lanes, max_pages)
+    occupancy = max(1, (3 * max_pages) // 4)
+    perm[:, occupancy:] = -1
+    tables = jnp.asarray(perm)
+    positions = jnp.full((n_lanes,), occupancy * page_size - 1, jnp.int32)
+    jkey = jax.random.PRNGKey(0)
+    kq, kk, kv_ = jax.random.split(jkey, 3)
+    q = jax.random.normal(kq, (n_lanes, 1, hq, d), jnp.bfloat16) * 0.1
+    k_pool = jax.random.normal(kk, (n_pages, page_size, hkv, d), jnp.bfloat16) * 0.1
+    v_pool = jax.random.normal(kv_, (n_pages, page_size, hkv, d), jnp.bfloat16) * 0.1
+
+    def timed(call):
+        # chained data-dependent calls inside one jit; slope between two chain
+        # lengths cancels dispatch latency and sync cost (the NF4 harness
+        # idiom). Each link perturbs the POOL: the XLA arm's loop-invariant
+        # gather_pages(pool, tables) would otherwise be CSE-hoisted out of the
+        # unrolled chain, excluding exactly the per-call gather cost it pays
+        # in production. Both arms pay the same extra pool pass, so the
+        # comparison stays apples-to-apples.
+        def chain(n):
+            def f(qv, kp, vp, tb, ps_):
+                a = qv
+                for j in range(n):
+                    f_j = 1.0 + j / 128.0  # bf16 eps at 1.0: survives the dtype
+                    a = call(a * 1e-2 + qv, kp * f_j, vp * f_j, tb, ps_)
+                return a
+
+            return tracked_jit(f, name="paged_autotune_chain")
+
+        ts = {}
+        for n in (2, 2 + steps):
+            f = chain(n)
+            f(q, k_pool, v_pool, tables, positions)  # compile
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    out = f(q, k_pool, v_pool, tables, positions)
+                np.asarray(jax.device_get(out[0, 0, 0, :1]))  # hard sync
+                best = min(best, (time.perf_counter() - t0) / 5)
+            ts[n] = best
+        return max((ts[2 + steps] - ts[2]) / steps, 1e-9)
+
+    t_pallas = timed(
+        lambda qv, kp, vp, tb, ps_: paged_flash_attend(
+            qv, kp, vp, tb, ps_, sliding_window=window
+        )
+    )
+
+    def xla_arm(qv, kp, vp, tb, ps_):
+        kd = gather_pages(kp, tb)
+        vd = gather_pages(vp, tb)
+        return attend_reference(
+            qv, kd, vd, q_offset=ps_, kv_length=ps_ + 1, sliding_window=window
+        )
+
+    t_xla = timed(xla_arm)
+    use_pallas = t_pallas <= t_xla
+    set_paged_kernel_decision("decode", key, use_pallas)
+    from petals_tpu.utils.logging import get_logger
+
+    get_logger(__name__).info(
+        f"paged-attention autotune {key}: pallas {t_pallas * 1e3:.2f}ms vs "
+        f"xla-composed {t_xla * 1e3:.2f}ms per step -> "
+        f"{'pallas' if use_pallas else 'xla'}"
+    )
+    return use_pallas
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m

@@ -432,37 +432,63 @@ class TransformerBackend:
             self._last_step_fp = None
         return out, (k_pool, v_pool)
 
+    def _paged_kernel_path(self, k_pool, tables, *, mixed: bool = False) -> str:
+        """Resolve (host-side, O(1) — no table scan) which attention path the
+        paged step traces, running the once-per-process autotune for this
+        shape class first. The returned string rides as a STATIC argument of
+        the jitted step: its only job is to force a retrace when the resolved
+        decision changes (env override flip, fresh autotune result) — in
+        steady state it is one constant and costs zero extra compiles."""
+        from petals_tpu.ops import paged_flash_attention as pfa
+
+        cfg = self.cfg
+        page_size, hkv, d = k_pool.shape[2], k_pool.shape[3], k_pool.shape[4]
+        window = getattr(cfg, "sliding_window", None)
+        window = window if isinstance(window, int) and window > 0 else None
+        key = pfa.shape_class(
+            tables.shape[0], tables.shape[1], page_size, hkv, d, window
+        )
+        if not getattr(self, "_paged_autotuned", False):
+            heads = getattr(cfg, "num_attention_heads", hkv)
+            pfa.maybe_autotune_paged_attention(
+                n_lanes=key[0], max_pages=key[1], page_size=page_size,
+                hkv=hkv, d=d, group=max(1, heads // hkv), window=window,
+            )
+            self._paged_autotuned = True
+        path = pfa.resolve_paged_kernel_path("decode", key)
+        if mixed:
+            path = f"dec:{path},pf:{pfa.resolve_paged_kernel_path('prefill', key)}"
+        return path
+
     @functools.cached_property
     def _paged_decode_fn(self):
         """Paged twin of ``_batched_decode_fn``: the pool is page-granular
-        ([n_blocks, n_pages, page_size, hkv, d]) and each lane's view of it
-        is assembled by a block-table gather INSIDE the step program, so the
-        model family's block code runs unchanged on a dense [n_lanes,
-        max_length, hkv, d] tensor with the same per-lane position vector.
-        After the block writes its new token rows, only those rows are
-        scattered back into the pool (invalid lanes drop — ops/
-        paged_attention.py). ``contiguous`` is a STATIC flag: when every
-        table row is the identity mapping, gather and scatter collapse to
-        reshapes and the compiled program is the dense one — bit-exact."""
+        ([n_blocks, n_pages, page_size, hkv, d]) and the (pool, block-table)
+        pair rides through the model family's block code as a ``PagedKV``
+        stand-in for the dense buffer — ``update_kv_cache`` scatters the new
+        token rows straight into the pages and ``attend`` dispatches to the
+        fused ragged kernel or its XLA-composed fallback
+        (ops/paged_flash_attention.py). ONE attention code path: dense is
+        just the identity block table, with no host-side contiguity special
+        case. ``kernel_path`` is a static pass-through whose only job is to
+        retrace the step when the resolved kernel decision changes."""
         family, cfg = self.family, self.cfg
         split_quant = self._split_quant
         use_quant_consts = self._use_quant_consts
         reattach = self._reattach_quant
         fp_proj = fp_ops.projection(cfg.hidden_size)  # baked constant
 
-        from petals_tpu.ops.paged_attention import gather_pages, scatter_token_rows
+        from petals_tpu.ops.paged_attention import PagedKV
 
         @tracked_jit(
             name="paged_decode", steady=True,
-            static_argnames=("contiguous", "with_fp"), donate_argnums=(1, 2),
+            static_argnames=("kernel_path", "with_fp"), donate_argnums=(1, 2),
         )
         def step(params, k_pool, v_pool, hidden, positions, tables,
-                 *, contiguous: bool, with_fp: bool):
+                 *, kernel_path: str, with_fp: bool):
             # hidden: [n_lanes, 1, hidden]; positions: [n_lanes] int32;
             # tables: [n_lanes, max_pages] int32 (-1 = unallocated slot)
-            n_lanes, max_pages = tables.shape
-            page_size = k_pool.shape[2]
-            max_len = max_pages * page_size
+            del kernel_path  # static retrace trigger; attend() re-resolves
             hidden = hidden.astype(k_pool.dtype)
             if use_quant_consts:
                 dense_params, quant_params, outlier_names = split_quant(params)
@@ -476,25 +502,12 @@ class TransformerBackend:
                 p_block, k_blk, v_blk, block_idx = xs
                 if use_quant_consts:
                     p_block = reattach(p_block, quant_params, outlier_names, block_idx)
-                if contiguous:
-                    k_dense = k_blk.reshape(n_lanes, max_len, *k_blk.shape[2:])
-                    v_dense = v_blk.reshape(n_lanes, max_len, *v_blk.shape[2:])
-                else:
-                    k_dense = gather_pages(k_blk, tables)
-                    v_dense = gather_pages(v_blk, tables)
-                out, (k_new, v_new) = family.block_apply(
-                    p_block, h, (k_dense, v_dense), positions, cfg,
+                kv = (PagedKV(k_blk, tables), PagedKV(v_blk, tables))
+                out, (k_kv, v_kv) = family.block_apply(
+                    p_block, h, kv, positions, cfg,
                     use_flash=False, tp_mesh=None,
                 )
-                if contiguous:
-                    k_blk = k_new.reshape(k_blk.shape)
-                    v_blk = v_new.reshape(v_blk.shape)
-                else:
-                    lanes = jnp.arange(n_lanes, dtype=jnp.int32)
-                    row = jnp.clip(positions, 0, max_len - 1)
-                    k_blk = scatter_token_rows(k_blk, k_new[lanes, row], tables, positions)
-                    v_blk = scatter_token_rows(v_blk, v_new[lanes, row], tables, positions)
-                return out, (k_blk, v_blk)
+                return out, (k_kv.pool, v_kv.pool)
 
             hidden, (k_pool, v_pool) = jax.lax.scan(
                 body, hidden, (xs_params, k_pool, v_pool, block_indices)
@@ -510,7 +523,7 @@ class TransformerBackend:
         return step
 
     def paged_decode_step(self, hidden, pool_kv, positions, tables,
-                          handles=None, contiguous=None):
+                          handles=None):
         """One coalesced decode step over the whole lane pool, PAGED layout.
 
         Args:
@@ -518,15 +531,10 @@ class TransformerBackend:
           pool_kv: (k, v) page pools [n_blocks, n_pages, page_size, hkv, d].
           positions: int32 [n_lanes]; idle sentinel = max_pages * page_size.
           tables: int32 [n_lanes, max_pages] block tables (-1 unallocated).
-          contiguous: identity-layout fast path; detected from the tables
-            when None (host-side, cheap — the tables are a few hundred ints).
         """
-        from petals_tpu.ops.paged_attention import tables_are_contiguous
-
         k_pool, v_pool = pool_kv
         tables = np.asarray(tables, np.int32)
-        if contiguous is None:
-            contiguous = tables_are_contiguous(tables, k_pool.shape[1])
+        kernel_path = self._paged_kernel_path(k_pool, tables)
         if not isinstance(hidden, jax.Array):
             hidden = np.ascontiguousarray(hidden)
         with_fp = fp_ops.enabled()
@@ -534,7 +542,7 @@ class TransformerBackend:
             res = self._paged_decode_fn(
                 self.params, k_pool, v_pool, hidden,
                 np.asarray(positions, np.int32), tables,
-                contiguous=bool(contiguous), with_fp=with_fp,
+                kernel_path=kernel_path, with_fp=with_fp,
             )
         if with_fp:
             out, k_pool, v_pool, self._last_step_fp = res
@@ -547,7 +555,7 @@ class TransformerBackend:
     def _paged_gen_decode_fn(self):
         """Paged twin of ``_batched_gen_decode_fn``: the pooled server-gen
         step (client leaves in the loop) over the page-granular pool. Same
-        gather/scatter sandwich as ``_paged_decode_fn``."""
+        PagedKV single attention path as ``_paged_decode_fn``."""
         family, cfg = self.family, self.cfg
         split_quant = self._split_quant
         use_quant_consts = self._use_quant_consts
@@ -555,19 +563,17 @@ class TransformerBackend:
         client_embed, client_head = family.client_embed, family.client_head
         fp_proj = fp_ops.projection(cfg.hidden_size)  # baked constant
 
-        from petals_tpu.ops.paged_attention import gather_pages, scatter_token_rows
+        from petals_tpu.ops.paged_attention import PagedKV
 
         @tracked_jit(
             name="paged_gen_decode", steady=True,
-            static_argnames=("contiguous", "with_fp"), donate_argnums=(2, 3),
+            static_argnames=("kernel_path", "with_fp"), donate_argnums=(2, 3),
         )
         def step(params, client_params, k_pool, v_pool, hidden, tokens,
                  use_token, positions, do_sample, temperature, top_k, top_p,
                  rep_penalty, seeds, draw_idx, seen_mask, tables,
-                 *, contiguous: bool, with_fp: bool):
-            n_lanes, max_pages = tables.shape
-            page_size = k_pool.shape[2]
-            max_len = max_pages * page_size
+                 *, kernel_path: str, with_fp: bool):
+            del kernel_path  # static retrace trigger; attend() re-resolves
             emb = client_embed(client_params, tokens[:, None], cfg)
             hidden = jnp.where(
                 use_token[:, None, None],
@@ -586,25 +592,12 @@ class TransformerBackend:
                 p_block, k_blk, v_blk, block_idx = xs
                 if use_quant_consts:
                     p_block = reattach(p_block, quant_params, outlier_names, block_idx)
-                if contiguous:
-                    k_dense = k_blk.reshape(n_lanes, max_len, *k_blk.shape[2:])
-                    v_dense = v_blk.reshape(n_lanes, max_len, *v_blk.shape[2:])
-                else:
-                    k_dense = gather_pages(k_blk, tables)
-                    v_dense = gather_pages(v_blk, tables)
-                out, (k_new, v_new) = family.block_apply(
-                    p_block, h, (k_dense, v_dense), positions, cfg,
+                kv = (PagedKV(k_blk, tables), PagedKV(v_blk, tables))
+                out, (k_kv, v_kv) = family.block_apply(
+                    p_block, h, kv, positions, cfg,
                     use_flash=False, tp_mesh=None,
                 )
-                if contiguous:
-                    k_blk = k_new.reshape(k_blk.shape)
-                    v_blk = v_new.reshape(v_blk.shape)
-                else:
-                    lanes = jnp.arange(n_lanes, dtype=jnp.int32)
-                    row = jnp.clip(positions, 0, max_len - 1)
-                    k_blk = scatter_token_rows(k_blk, k_new[lanes, row], tables, positions)
-                    v_blk = scatter_token_rows(v_blk, v_new[lanes, row], tables, positions)
-                return out, (k_blk, v_blk)
+                return out, (k_kv.pool, v_kv.pool)
 
             hidden, (k_pool, v_pool) = jax.lax.scan(
                 body, hidden, (xs_params, k_pool, v_pool, block_indices)
@@ -624,15 +617,12 @@ class TransformerBackend:
 
     def paged_gen_decode_step(self, client_params, hidden, tokens, use_token,
                               pool_kv, positions, tables, *, sampling_vecs,
-                              handles=None, contiguous=None):
+                              handles=None):
         """Paged twin of ``batched_gen_decode_step`` (same argument contract
         plus the block tables)."""
-        from petals_tpu.ops.paged_attention import tables_are_contiguous
-
         k_pool, v_pool = pool_kv
         tables = np.asarray(tables, np.int32)
-        if contiguous is None:
-            contiguous = tables_are_contiguous(tables, k_pool.shape[1])
+        kernel_path = self._paged_kernel_path(k_pool, tables)
         if not isinstance(hidden, jax.Array):
             hidden = np.ascontiguousarray(hidden)
         v = sampling_vecs
@@ -644,7 +634,7 @@ class TransformerBackend:
                 np.asarray(positions, np.int32), v["do_sample"],
                 v["temperature"], v["top_k"], v["top_p"],
                 v["repetition_penalty"], v["seeds"], v["draw_idx"],
-                v["seen_mask"], tables, contiguous=bool(contiguous),
+                v["seen_mask"], tables, kernel_path=kernel_path,
                 with_fp=with_fp,
             )
         if with_fp:
@@ -660,17 +650,17 @@ class TransformerBackend:
         program ("Ragged Paged Attention" folding, PAPERS.md): every decode
         lane advances one token AND one lane runs a bucketed prefill chunk,
         in a single jitted scan over the page pool. The decode half is
-        ``_paged_decode_fn``'s body verbatim; the prefill half gathers the
-        chunk lane's dense view from the pages, runs the SAME block compute
-        as the exclusive path (``_inference_step_fn`` at batch=1: scalar
-        position, bucket-padded chunk with n_valid scatter-drop, n_total for
-        longrope), and scatters only the chunk's freshly written KV rows back
-        — no lane extract/insert round-trip, so concurrent decode never
-        stalls behind a prefill. Decode runs first in each block body because
-        the contiguous fast path rewrites the whole pool by reshape; lanes'
-        pages are disjoint (the prefill lane's decode position is the idle
-        sentinel, so its decode-side write drops), so ordering is otherwise
-        immaterial."""
+        ``_paged_decode_fn``'s body verbatim; the prefill half wraps the
+        chunk lane's table row as a single-lane PagedKV and runs the SAME
+        block compute as the exclusive path (``_inference_step_fn`` at
+        batch=1: scalar position, bucket-padded chunk with n_valid
+        scatter-drop, n_total for longrope) — update_kv_cache scatters only
+        the chunk's freshly written KV rows straight into the pages and
+        attend dispatches to the fused prefill kernel or its XLA fallback.
+        No lane extract/insert round-trip, so concurrent decode never stalls
+        behind a prefill; lanes' pages are disjoint (the prefill lane's
+        decode position is the idle sentinel, so its decode-side write
+        drops), so decode-before-prefill ordering is immaterial."""
         family, cfg = self.family, self.cfg
         split_quant = self._split_quant
         use_quant_consts = self._use_quant_consts
@@ -678,36 +668,24 @@ class TransformerBackend:
         takes_n_total = "n_total" in inspect.signature(family.block_apply).parameters
         fp_proj = fp_ops.projection(cfg.hidden_size)  # baked constant
 
-        from petals_tpu.ops.paged_attention import (
-            gather_pages,
-            scatter_chunk_rows,
-            scatter_token_rows,
-        )
+        from petals_tpu.ops.paged_attention import PagedKV
 
         @tracked_jit(
             name="paged_mixed_step", steady=True,
-            static_argnames=("contiguous", "with_fp"), donate_argnums=(1, 2),
+            static_argnames=("kernel_path", "with_fp"), donate_argnums=(1, 2),
         )
         def step(params, k_pool, v_pool, hidden, positions, tables,
                  chunk_hidden, chunk_lane, chunk_pos, chunk_n_valid,
-                 chunk_n_total, *, contiguous: bool, with_fp: bool):
+                 chunk_n_total, *, kernel_path: str, with_fp: bool):
             # hidden: [n_lanes, 1, hidden]; positions: [n_lanes] int32 (idle
             # sentinel = max_len); chunk_hidden: [1, B, hidden] (B = static
             # bucket); chunk_lane/chunk_pos/chunk_n_valid/chunk_n_total:
             # int32 scalars describing the ONE prefill chunk riding this step
-            n_lanes, max_pages = tables.shape
-            page_size = k_pool.shape[2]
-            max_len = max_pages * page_size
+            del kernel_path  # static retrace trigger; attend() re-resolves
             B = chunk_hidden.shape[1]
             hidden = hidden.astype(k_pool.dtype)
             chunk_hidden = chunk_hidden.astype(k_pool.dtype)
             table_row = jnp.take(tables, chunk_lane, axis=0)  # [max_pages]
-            offs = jnp.arange(B, dtype=jnp.int32)
-            # rows to read back out of the updated lane view (clip keeps the
-            # take in-bounds for the padded tail; those rows drop anyway)
-            chunk_rows = jnp.clip(chunk_pos + offs, 0, max_len - 1)
-            # rows to write into the pages: padded tail -> sentinel -> drop
-            chunk_write = jnp.where(offs < chunk_n_valid, chunk_pos + offs, max_len)
             if use_quant_consts:
                 dense_params, quant_params, outlier_names = split_quant(params)
                 xs_params = dense_params
@@ -722,40 +700,21 @@ class TransformerBackend:
                 if use_quant_consts:
                     p_block = reattach(p_block, quant_params, outlier_names, block_idx)
                 # --- decode half (== _paged_decode_fn body)
-                if contiguous:
-                    k_dense = k_blk.reshape(n_lanes, max_len, *k_blk.shape[2:])
-                    v_dense = v_blk.reshape(n_lanes, max_len, *v_blk.shape[2:])
-                else:
-                    k_dense = gather_pages(k_blk, tables)
-                    v_dense = gather_pages(v_blk, tables)
-                out_dec, (k_new, v_new) = family.block_apply(
-                    p_block, h_dec, (k_dense, v_dense), positions, cfg,
+                kv = (PagedKV(k_blk, tables), PagedKV(v_blk, tables))
+                out_dec, (k_kv, v_kv) = family.block_apply(
+                    p_block, h_dec, kv, positions, cfg,
                     use_flash=False, tp_mesh=None,
                 )
-                if contiguous:
-                    k_blk = k_new.reshape(k_blk.shape)
-                    v_blk = v_new.reshape(v_blk.shape)
-                else:
-                    lanes = jnp.arange(n_lanes, dtype=jnp.int32)
-                    row = jnp.clip(positions, 0, max_len - 1)
-                    k_blk = scatter_token_rows(k_blk, k_new[lanes, row], tables, positions)
-                    v_blk = scatter_token_rows(v_blk, v_new[lanes, row], tables, positions)
-                # --- prefill half: dense lane view -> block compute -> the
-                # chunk's rows scatter back through the lane's table row
-                k_lane = gather_pages(k_blk, table_row[None])
-                v_lane = gather_pages(v_blk, table_row[None])
+                k_blk, v_blk = k_kv.pool, v_kv.pool
+                # --- prefill half: the chunk lane's table row as a
+                # single-lane PagedKV; writes land in the pages directly
+                kv_pf = (PagedKV(k_blk, table_row[None]), PagedKV(v_blk, table_row[None]))
                 extra = {"n_total": chunk_n_total} if takes_n_total else {}
-                out_pf, (k_all, v_all) = family.block_apply(
-                    p_block, h_pf, (k_lane, v_lane), chunk_pos, cfg,
+                out_pf, (k_kv, v_kv) = family.block_apply(
+                    p_block, h_pf, kv_pf, chunk_pos, cfg,
                     use_flash=False, n_valid=chunk_n_valid, tp_mesh=None, **extra,
                 )
-                k_blk = scatter_chunk_rows(
-                    k_blk, jnp.take(k_all[0], chunk_rows, axis=0), table_row, chunk_write
-                )
-                v_blk = scatter_chunk_rows(
-                    v_blk, jnp.take(v_all[0], chunk_rows, axis=0), table_row, chunk_write
-                )
-                return (out_dec, out_pf), (k_blk, v_blk)
+                return (out_dec, out_pf), (k_kv.pool, v_kv.pool)
 
             (hidden, chunk_out), (k_pool, v_pool) = jax.lax.scan(
                 body, (hidden, chunk_hidden),
@@ -777,7 +736,7 @@ class TransformerBackend:
 
     def paged_mixed_step(self, hidden, pool_kv, positions, tables,
                          chunk_hidden, chunk_lane, chunk_pos, *,
-                         n_total=None, handles=None, contiguous=None):
+                         n_total=None, handles=None):
         """One coalesced mixed step: every decode lane (1 token each) plus
         ONE prefill chunk for ``chunk_lane``, in a single jitted program.
 
@@ -798,12 +757,9 @@ class TransformerBackend:
 
         Returns (decode_out [n_lanes, 1, h], chunk_out [1, seq, h], pool_kv).
         """
-        from petals_tpu.ops.paged_attention import tables_are_contiguous
-
         k_pool, v_pool = pool_kv
         tables = np.asarray(tables, np.int32)
-        if contiguous is None:
-            contiguous = tables_are_contiguous(tables, k_pool.shape[1])
+        kernel_path = self._paged_kernel_path(k_pool, tables, mixed=True)
         if not isinstance(hidden, jax.Array):
             hidden = np.ascontiguousarray(hidden)
         seq = chunk_hidden.shape[1]
@@ -826,7 +782,7 @@ class TransformerBackend:
                 self.params, k_pool, v_pool, hidden,
                 np.asarray(positions, np.int32), tables, chunk_hidden,
                 np.int32(chunk_lane), np.int32(chunk_pos), np.int32(seq),
-                np.int32(n_total), contiguous=bool(contiguous),
+                np.int32(n_total), kernel_path=kernel_path,
                 with_fp=with_fp,
             )
         if with_fp:
@@ -844,8 +800,10 @@ class TransformerBackend:
         """Assemble one lane's dense session-shaped view [n_blocks, 1,
         max_len, hkv, d] from its block-table row — the paged stand-in for
         ``_lane_extract_fn`` (exclusive ops: chunked prefill, kv export).
-        Content of unallocated slots is masked garbage, exactly like the
-        in-step gather."""
+        Unallocated slots read as ZEROS: this view escapes attention (kv
+        export crosses the wire), so it must never alias another tenant's
+        page content — same contract as ops/paged_attention.py
+        gather_pages."""
 
         @tracked_jit(name="paged_lane_gather")
         def f(k_pool, v_pool, table_row):
@@ -854,6 +812,9 @@ class TransformerBackend:
             safe = jnp.clip(table_row, 0, n_pages - 1)
             k = jnp.take(k_pool, safe, axis=1)  # [n_blocks, max_pages, ps, hkv, d]
             v = jnp.take(v_pool, safe, axis=1)
+            hole = (table_row >= 0)[None, :, None, None, None]
+            k = jnp.where(hole, k, jnp.zeros((), k_pool.dtype))
+            v = jnp.where(hole, v, jnp.zeros((), v_pool.dtype))
             shape = (n_blocks, 1, max_pages * page_size, *k_pool.shape[3:])
             return k.reshape(shape), v.reshape(shape)
 

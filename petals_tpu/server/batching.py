@@ -184,6 +184,12 @@ class DecodeBatcher:
             self.n_pages = 0
         self._pages: Optional[PageAllocator] = None
         self._tables: Optional[np.ndarray] = None  # [n_lanes, max_pages] int32, -1 = unallocated
+        # cached tables_are_contiguous result for the stats/debug surface
+        # (paged_summary); None = recompute on next read. The STEP path no
+        # longer consults it — the fused kernel serves identity and permuted
+        # tables alike — so the O(n_lanes*max_pages) scan runs only when the
+        # tables actually changed AND someone asks (rpc_info), not per tick.
+        self._tables_contig: Optional[bool] = None
         # bumped on every pool reset: prefix-cache page pins carry the epoch
         # they were taken under so stale pins never decref a rebuilt allocator
         self._page_epoch = 0
@@ -324,6 +330,7 @@ class DecodeBatcher:
             if self.page_size is not None:
                 self._pages = PageAllocator(self.n_pages)
                 self._tables = np.full((self.n_lanes, self.max_pages), -1, np.int32)
+                self._tables_mutated()
                 logger.info(
                     f"Paged-batching pool open: {self.n_pages} pages x "
                     f"{self.page_size} tokens ({self.n_lanes} lanes x "
@@ -502,6 +509,7 @@ class DecodeBatcher:
                 if row[slot] >= 0:
                     self._pages.decref(int(row[slot]))
             row[:] = -1
+            self._tables_mutated()
         # hand straight to the best-placed waiter (priority class, then
         # per-peer fair share, then FIFO), else back to the free list; the
         # new session overwrites the lane from position 0, so no zeroing
@@ -547,7 +555,9 @@ class DecodeBatcher:
             )
         alloc = self._pages
         # identity preference keeps tables contiguous at the default pool
-        # size, so decode stays on the reshape (dense-program) fast path
+        # size: the fused kernel serves any layout, but identity tables read
+        # pages in sequential HBM order (and keep the tables_contiguous
+        # debug flag meaningful)
         identity_base = (
             lane * self.max_pages
             if self.n_pages == self.n_lanes * self.max_pages else None
@@ -610,6 +620,7 @@ class DecodeBatcher:
                     alloc.decref(page)  # never reached the table: hand it back
                 raise
             self._tables[lane, slot] = page
+            self._tables_mutated()
             pages_changed = True
         if pages_changed:
             # attribution rates changed (a grow or a COW fork): settle the
@@ -678,8 +689,28 @@ class DecodeBatcher:
                 self._pages.decref(cur)
             row[slot] = int(page)
         if pages:
+            self._tables_mutated()
             tm.PREFIX_ADOPT.inc()
             self._ledger_sync()  # the lane now shares the prefix pages' refcounts
+
+    def _tables_mutated(self) -> None:
+        """Invalidate the cached contiguity flag — call after ANY table write
+        (alloc, adopt, release, swap, reset)."""
+        self._tables_contig = None
+
+    def tables_contiguous(self) -> Optional[bool]:
+        """Stats/debug surface ONLY: are the block tables currently the
+        identity layout? The step path no longer branches on this (one fused
+        attention path serves both); the flag is kept for observability —
+        identity tables mean page reads stream sequentially through HBM.
+        Cached; recomputed lazily after a table mutation."""
+        if self.page_size is None or self._tables is None:
+            return None
+        if self._tables_contig is None:
+            from petals_tpu.ops.paged_attention import tables_are_contiguous
+
+            self._tables_contig = tables_are_contiguous(self._tables, self.n_pages)
+        return self._tables_contig
 
     def paged_summary(self) -> Optional[dict]:
         """Observability: pool occupancy + allocator counters (rpc_info)."""
@@ -691,6 +722,7 @@ class DecodeBatcher:
             "n_pages": self.n_pages,
             "page_epoch": self._page_epoch,
             "pages_free": alloc.n_free if alloc is not None else self.n_pages,
+            "tables_contiguous": self.tables_contiguous(),
             **({f"pages_{k}": v for k, v in alloc.stats.items()} if alloc else {}),
         }
 
@@ -865,6 +897,7 @@ class DecodeBatcher:
             for page in pages:
                 alloc.decref(int(page))
             self._tables[lane, slots] = -1
+            self._tables_mutated()
             slot.swap = SwapEntry(
                 k=k_host, v=v_host, slots=slots, nbytes=nbytes, generation=gen,
                 suspended_at=time.monotonic(),
@@ -943,6 +976,7 @@ class DecodeBatcher:
             self._maybe_reset_pool()  # the scatter donates the pool buffers
             raise
         self._tables[lane, entry.slots] = pages_arr
+        self._tables_mutated()
         slot.swap = None
         slot.resumed_at = time.monotonic()
         self.swap_pool.free(entry.nbytes)
@@ -1573,6 +1607,7 @@ class DecodeBatcher:
                 self._pages = PageAllocator(self.n_pages)
                 if self._tables is not None:
                     self._tables[:] = -1
+                    self._tables_mutated()
             for handle in self._handles or ():
                 try:
                     self.memory_cache.reset_buffer(handle)

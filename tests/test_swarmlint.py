@@ -331,8 +331,20 @@ def test_no_untracked_jit():
         "y = jit(3)\n"
     )
     assert "no-untracked-jit" not in rules_hit(ok, path=server)
-    # out of scope: client/, ops/ and tests compile cold or are exempt wholesale
+    # out of scope: client/, generic ops/ and tests compile cold or are exempt
     assert "no-untracked-jit" not in rules_hit(bad, path="petals_tpu/ops/snippet.py")
+    # ...but the attention-kernel hot modules ARE in scope: their entry points
+    # run inside the per-step programs, so an invisible compile there is the
+    # recompile-storm class the observatory gates on
+    assert lines_hit(
+        bad, "no-untracked-jit", path="petals_tpu/ops/paged_flash_attention.py"
+    ) == [2, 5, 8]
+    assert lines_hit(
+        bare, "no-untracked-jit", path="petals_tpu/ops/flash_attention.py"
+    ) == [2]
+    assert "no-untracked-jit" not in rules_hit(
+        ok, path="petals_tpu/ops/paged_flash_attention.py"
+    )
     suppressed = (
         "import jax\n"
         "@jax.jit  # swarmlint: disable=no-untracked-jit — one-shot load-time compile\n"
