@@ -1310,6 +1310,9 @@ def _heavy_row_registry():
         "e2e_paged_decode": lambda: __import__(
             "benchmarks.bench_paged_decode", fromlist=["run_bench"]
         ).run_bench(),
+        "e2e_spec_decode": lambda: __import__(
+            "benchmarks.bench_spec_decode", fromlist=["run_bench"]
+        ).run_bench(),
         "e2e_mixed_prefill_decode": lambda: __import__(
             "benchmarks.bench_mixed_prefill_decode", fromlist=["run_bench"]
         ).run_bench(),
@@ -1677,6 +1680,165 @@ def bench_gate_paged_kernel(label, *, lanes=2, steps=12):
         gc.collect()
 
 
+def bench_gate_spec_decode(label, *, lanes=2, tokens=24, spec_k=4):
+    """CPU-runnable gate row for the speculative decode path: a cooperative
+    draft (the span's own tiny fp32 weights, window covering the whole
+    context) drives full pooled generations and the row asserts the three
+    invariants speculation must never lose — (a) the emitted stream is
+    bit-identical to plain decode, greedy AND fixed-seed sampling alike,
+    (b) zero post-warmup compile anomalies across draft propose + verify,
+    (c) the ledger bills exactly one decode token per emitted token. The
+    telemetry blob pins the ``spec`` step_duration variant and the
+    spec_proposed/spec_accepted counters into the committed baseline, so a
+    build that silently stops speculating (or starts recompiling) fails
+    ``--gate``."""
+    import jax
+    import jax.numpy as jnp
+
+    from petals_tpu.models.registry import get_family
+    from petals_tpu.server.backend import TransformerBackend
+    from petals_tpu.server.batching import DecodeBatcher
+    from petals_tpu.server.memory_cache import MemoryCache
+    from petals_tpu.server.spec_decode import DraftModel
+    from petals_tpu.server.task_queue import PriorityTaskQueue
+    from petals_tpu.telemetry import instruments as tm
+    from petals_tpu.telemetry.ledger import get_ledger
+
+    cfg = _tiny_gate_cfg()
+    family = get_family("llama")
+    n_blocks = cfg.num_hidden_layers
+    params = random_params(cfg, n_blocks, jnp.float32)
+    # the draft unrolls per-block (LIST layout); the span scans the stack
+    blocks = [
+        {name: leaf[i] for name, leaf in params.items()} for i in range(n_blocks)
+    ]
+    key = jax.random.PRNGKey(7)
+    client_params = {
+        "embed": jax.random.normal(
+            key, (cfg.vocab_size, cfg.hidden_size), jnp.float32) * 0.02,
+        "norm": jnp.ones((cfg.hidden_size,), jnp.float32),
+        "head": jax.random.normal(
+            key, (cfg.hidden_size, cfg.vocab_size), jnp.float32) * 0.02,
+    }
+    backend = TransformerBackend(
+        family, cfg, params,
+        first_block=0, n_blocks=n_blocks,
+        memory_cache=MemoryCache(None), compute_dtype=jnp.float32,
+        use_flash=False,
+    )
+    draft = DraftModel(
+        family, cfg, blocks, client_params,
+        spec_k=spec_k, window=48, compute_dtype=jnp.float32,
+    )
+    rng = np.random.RandomState(5)
+    contexts = [
+        [int(t) for t in rng.randint(0, cfg.vocab_size, 6)] for _ in range(lanes)
+    ]
+    # lane 0 greedy, lane 1 fixed-seed sampled: parity must hold for both
+    samplings = [{"context": ctx} for ctx in contexts]
+    if lanes > 1:
+        samplings[1] = {
+            "do_sample": True, "temperature": 0.8, "top_k": 10,
+            "seed": 1234, "offset": 0, "context": contexts[1],
+        }
+
+    async def run():
+        queue = PriorityTaskQueue()
+        queue.start()
+        batcher = DecodeBatcher(
+            backend, backend.memory_cache, queue,
+            n_lanes=lanes, max_length=64, page_size=8,
+            gen_params=client_params, draft_model=draft, spec_k=spec_k,
+        )
+
+        async def one(i, peer_prefix):
+            hidden = np.asarray(family.client_embed(
+                client_params, np.asarray([contexts[i]], np.int32), cfg
+            ), np.float32)
+            lane = await batcher.acquire_lane(
+                timeout=120, peer_id=f"{peer_prefix}-{i}"
+            )
+            try:
+                out = await batcher.prefill_lane(lane, hidden, 0)
+                toks = await batcher.generate_lane(
+                    lane, np.asarray(out[:, -1:]), len(contexts[i]),
+                    tokens, samplings[i],
+                )
+            finally:
+                batcher.release_lane(lane)
+            return np.asarray(toks)
+
+        async def gen_all(peer_prefix):
+            return await asyncio.gather(
+                *(one(i, peer_prefix) for i in range(lanes))
+            )
+
+        try:
+            s0 = dict(batcher.stats)
+            spec_streams = await gen_all(f"{label}-warm")  # compiles
+            batcher.draft = None
+            plain_streams = await gen_all(f"{label}-plain")
+            batcher.draft = draft
+            for s, p in zip(spec_streams, plain_streams):
+                np.testing.assert_array_equal(
+                    s, p, err_msg="spec stream diverged from plain decode"
+                )
+            anomalies_before = sum(
+                c.value for _v, c in tm.COMPILE_ANOMALIES.children()
+            )
+            t0 = time.perf_counter()
+            timed_streams = await gen_all(f"{label}-peer")
+            wall = time.perf_counter() - t0
+            for s, p in zip(timed_streams, plain_streams):
+                np.testing.assert_array_equal(
+                    s, p, err_msg="post-warmup spec stream diverged"
+                )
+            anomalies = sum(
+                c.value for _v, c in tm.COMPILE_ANOMALIES.children()
+            ) - anomalies_before
+            assert anomalies == 0, (
+                f"speculative decode caused {anomalies} post-warmup "
+                f"recompile anomalies — draft propose / verify must resolve "
+                f"to already-warm executables"
+            )
+            sd = {k: batcher.stats[k] - s0[k] for k in batcher.stats}
+            assert sd["spec_steps"] > 0 and sd["spec_proposed"] > 0, sd
+            # one decode token billed per emitted token, across all three
+            # generation rounds (spec and plain alike)
+            ledger = get_ledger()
+            billed = sum(
+                t.get("decode_tokens", 0)
+                for peer, t in ledger.peer_totals().items()
+                if peer.startswith(f"{label}-")
+            )
+            assert billed == 3 * lanes * (tokens - 1), (
+                f"ledger token leak: billed {billed}, "
+                f"emitted {3 * lanes * (tokens - 1)}"
+            )
+            return {
+                "label": label,
+                "lanes": lanes,
+                "tokens": tokens,
+                "spec_k": spec_k,
+                "wall_s": round(wall, 3),
+                "tok_s": round(lanes * tokens / wall, 2),
+                "spec_steps": sd["spec_steps"],
+                "acceptance_rate": round(
+                    sd["spec_accepted"] / max(sd["spec_proposed"], 1), 4
+                ),
+                "post_warmup_compile_anomalies": anomalies,
+                "ledger": _ledger_blob(),
+            }
+        finally:
+            await batcher.close()
+            queue.shutdown()
+
+    result = asyncio.run(run())
+    del params, backend, draft
+    gc.collect()
+    return result
+
+
 def _gate_row_registry():
     """Rows cheap enough for the CI perf gate (seconds each on CPU). Run via
     the same ``--row`` child protocol as the heavy rows so each gets a fresh
@@ -1688,6 +1850,7 @@ def _gate_row_registry():
             "gate_fingerprint_overhead"
         ),
         "gate_paged_kernel": lambda: bench_gate_paged_kernel("gate_paged_kernel"),
+        "gate_spec_decode": lambda: bench_gate_spec_decode("gate_spec_decode"),
     }
 
 
@@ -1702,6 +1865,9 @@ def _telemetry_counters() -> dict:
         "steps_paged": tm.STEPS_PAGED.value,
         "steps_mixed": tm.STEPS_MIXED.value,
         "steps_gen": tm.STEPS_GEN.value,
+        "steps_spec": tm.STEPS_SPEC.value,
+        "spec_proposed": tm.SPEC_PROPOSED.value,
+        "spec_accepted": tm.SPEC_ACCEPTED.value,
         "decode_tokens": tm.DECODE_TOKENS.value,
         "preemptions": tm.PREEMPTIONS.value,
         "alloc_failed": tm.ALLOC_FAILED.value,
@@ -1755,7 +1921,8 @@ def _telemetry_blob(before: dict) -> dict:
     delta = {k: round(after[k] - before.get(k, 0), 3) for k in after}
     steps = {}
     for variant, child in (("dense", tm.STEP_DENSE), ("paged", tm.STEP_PAGED),
-                           ("mixed", tm.STEP_MIXED), ("gen", tm.STEP_GEN)):
+                           ("mixed", tm.STEP_MIXED), ("gen", tm.STEP_GEN),
+                           ("spec", tm.STEP_SPEC)):
         snap = child.snapshot()
         if not snap["count"]:
             continue

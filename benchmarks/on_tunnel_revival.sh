@@ -135,4 +135,23 @@ timeout 1200 python benchmarks/ablate_paged_attention.py 2>&1 | grep -v WARNING 
 timeout 900 python bench.py --row gate_paged_kernel 2>&1 | tail -3
 timeout 900 python -m pytest tests/ -q -m kernel 2>&1 | tail -3
 
+echo "== 8/8 speculative decoding (on-chip spec-vs-plain ablation) =="
+# Every spec-decode number committed so far is CPU: the 1.5x single-stream
+# bar in bench_spec_decode.py was met in the overhead-dominated CPU regime
+# with a cooperative (same-weights) draft. On silicon, re-derive in order:
+#   (a) the e2e row — single-stream and 8-lane spec-vs-plain tok/s, the
+#       acceptance rate, and the draft_seconds overhead share, where the
+#       draft's window prefill now rides the MXU (the bucketed propose
+#       shapes matter MORE on-chip: padding to the pool would burn real
+#       matmul time, not just dispatch);
+#   (b) the gate row's parity + zero post-warmup-anomaly asserts on the
+#       real compile path (draft propose buckets + the verify step must
+#       all resolve to warm executables after the first spec tick);
+#   (c) the -m spec lane ON the chip — greedy and seeded-sampling streams
+#       must stay bit-identical to plain decode under TPU numerics, same
+#       rationale as the smoke tier.
+timeout 1200 python bench.py --row e2e_spec_decode 2>&1 | grep -v WARNING | tail -6
+timeout 900 python bench.py --row gate_spec_decode 2>&1 | tail -3
+timeout 900 python -m pytest tests/ -q -m spec 2>&1 | tail -3
+
 echo "== revival queue done =="

@@ -131,6 +131,20 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Host-RAM prompt-prefix cache budget; 0 disables")
     parser.add_argument("--no_server_side_generation", action="store_true",
                         help="disable the device-side greedy generation loop on full-span servers")
+    parser.add_argument("--draft_model", default=None,
+                        help="Local path of a SMALL checkpoint for speculative decoding: "
+                             "it drafts --spec_k tokens per lane per tick and the span "
+                             "verifies them in one paged step (full-span single-host "
+                             "servers with server-side generation and a paged pool; "
+                             "output stays bit-identical to plain decode)")
+    parser.add_argument("--spec_k", type=int, default=4,
+                        help="Draft tokens verified per lane per tick (with --draft_model)")
+    parser.add_argument("--draft_window", type=int, default=None,
+                        help="Draft context window in tokens (default 64): the draft "
+                             "re-prefills the last N tokens each tick")
+    parser.add_argument("--draft_quant_type", default="nf4a",
+                        choices=["none", "int8", "nf4", "nf4a", "int4"],
+                        help="Quantization for the draft model's blocks")
     parser.add_argument("--prefix_device_bytes", type=int, default=256 * 2**20,
                         help="HBM tier of the prefix cache (device-resident hit seeding); 0 disables")
     parser.add_argument("--metrics_port", type=int, default=None,
@@ -239,6 +253,10 @@ def main(argv=None) -> None:
         prefix_share_scope=args.prefix_share_scope,
         prefix_device_bytes=args.prefix_device_bytes,
         server_side_generation=not args.no_server_side_generation,
+        draft_model=args.draft_model,
+        spec_k=args.spec_k,
+        draft_window=args.draft_window,
+        draft_quant_type=args.draft_quant_type,
         metrics_port=args.metrics_port,
     )
 

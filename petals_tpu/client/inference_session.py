@@ -531,6 +531,13 @@ class InferenceSession:
             for field, amount in hop.usage.items():
                 peer[field] = round(peer.get(field, 0.0) + amount, 6)
                 total[field] = round(total.get(field, 0.0) + amount, 6)
+        # speculative efficiency re-derives from the summed counters (rates
+        # must not be summed across steps or peers)
+        from petals_tpu.telemetry.ledger import derive_efficiency
+
+        for usage in (*per_peer.values(), total):
+            if usage.get("spec_proposed"):
+                usage.update(derive_efficiency(usage))
         return {
             "trace_id": self.trace_id,
             "tokens": self._tokens,

@@ -444,11 +444,28 @@ class RemoteGenerationMixin:
 
         remaining = max_new_tokens
         first = True
+        with_context = True
         pending_hidden = hidden  # unfed input for the next request
         while remaining > 0:
             want = min(self._SERVER_GEN_CHUNK, remaining)
             pos_before = session.position
-            tokens = session.generate_remote(pending_hidden, want, embed_fn)
+            # context-only gen_sampling stays exact greedy on the wire (the
+            # validated defaults are argmax no-ops) but gives a spec-enabled
+            # server's draft its conditioning window — without it the draft
+            # sees only the chunk's own tokens and acceptance collapses
+            sampling = (
+                {"context": [int(t) for t in generated[0]]}
+                if with_context else None
+            )
+            tokens = session.generate_remote(
+                pending_hidden, want, embed_fn, sampling=sampling
+            )
+            if tokens is None and first and with_context:
+                # the route announces server_gen without the gen_sampling
+                # wire field (old server on a mixed swarm): retry without a
+                # context — the draft loses its window, greedy is unchanged
+                with_context = False
+                tokens = session.generate_remote(pending_hidden, want, embed_fn)
             if tokens is None:
                 if first:
                     return None
@@ -530,10 +547,11 @@ class RemoteGenerationMixin:
             want = min(self._SERVER_GEN_CHUNK, remaining)
             pos_before = session.position
             sampling = dict(base, offset=draws)
-            if rep != 1.0:
-                # the penalty's seen-set snapshot; mid-chunk updates (tokens
-                # sampled within the chunk) happen server-side
-                sampling["context"] = [int(t) for t in generated[0]]
+            # the penalty's seen-set snapshot (mid-chunk updates — tokens
+            # sampled within the chunk — happen server-side); also the
+            # speculative draft's conditioning window on spec-enabled
+            # servers, so it rides every request, not just penalized ones
+            sampling["context"] = [int(t) for t in generated[0]]
             tokens = session.generate_remote(
                 pending_hidden, want, embed_fn, sampling=sampling
             )

@@ -178,6 +178,11 @@ class ServerInfo:
     # "gen_sampling" request field; see rpc/protocol.validate_gen_sampling).
     # Separate flag so old clients on mixed swarms keep gating correctly.
     server_gen_sampling: Optional[bool] = None
+    # speculative decoding (server/spec_decode.py): the server loaded a draft
+    # model and verifies this many drafts per lane per tick. None/0 = off.
+    # Informational for routing/health — the emitted stream is bit-identical
+    # to plain decode either way, so clients need no gating changes.
+    spec_k: Optional[int] = None
     # lane-pool / scheduler occupancy (busy lanes, free pages, suspended
     # sessions, swap bytes, preemption count — server/batching.py
     # occupancy_info) so clients and the health monitor can route around

@@ -163,6 +163,35 @@ def scatter_chunk_rows(
     return flat.reshape(pool.shape)
 
 
+def scatter_lane_chunk_rows(
+    pool: jnp.ndarray, rows: jnp.ndarray, tables: jnp.ndarray, positions: jnp.ndarray
+) -> jnp.ndarray:
+    """Write a short run of freshly computed rows into EVERY lane's pages at
+    once — the speculative-verify write shape: each lane lands ``seq``
+    candidate rows starting at its own position.
+
+    pool [n_pages, ps, hkv, d]; rows [n_lanes, seq, hkv, d]; tables
+    [n_lanes, max_pages]; positions [n_lanes] int32 (idle sentinel =
+    max_length drops ALL of that lane's rows, since every offset lands past
+    the table). Invalid rows route to the one-past-the-end flat index and
+    drop — scatter_chunk_rows batched over lanes."""
+    n_pages, page_size = pool.shape[0], pool.shape[1]
+    n_lanes, max_pages = tables.shape
+    seq = rows.shape[1]
+    pos = positions[:, None] + jnp.arange(seq, dtype=jnp.int32)[None, :]  # [n_lanes, seq]
+    slot = pos // page_size
+    in_range = (pos >= 0) & (slot < max_pages)
+    slot_c = jnp.clip(slot, 0, max_pages - 1)
+    page = jnp.take_along_axis(tables, slot_c, axis=1)  # [n_lanes, seq]
+    valid = in_range & (page >= 0)
+    flat_idx = jnp.where(valid, page * page_size + pos % page_size, n_pages * page_size)
+    flat = pool.reshape(n_pages * page_size, *pool.shape[2:])
+    flat = flat.at[flat_idx.reshape(-1)].set(
+        rows.reshape(n_lanes * seq, *rows.shape[2:]).astype(pool.dtype), mode="drop"
+    )
+    return flat.reshape(pool.shape)
+
+
 def scatter_lane_pages(
     pool: jnp.ndarray, lane_pages: jnp.ndarray, table_row: jnp.ndarray
 ) -> jnp.ndarray:
@@ -188,10 +217,14 @@ def paged_update_kv(
     freshly computed rows straight into the page pools (no dense detour) and
     return the updated PagedKV pair plus the valid kv length.
 
-    Two write shapes, mirroring the dense helper's branches:
+    Three write shapes, mirroring the dense helper's branches:
     - per-lane decode: ``position`` is a [n_lanes] vector, k_new/v_new are
       [n_lanes, 1, hkv, d] — one token row per lane (idle sentinel positions
       drop inside scatter_token_rows).
+    - per-lane chunk (speculative verify): ``position`` is a [n_lanes]
+      vector, k_new/v_new are [n_lanes, seq, hkv, d] with seq > 1 — every
+      lane lands ``seq`` candidate rows starting at its own position
+      (scatter_lane_chunk_rows; idle sentinel positions drop every row).
     - chunked prefill: ``position`` is a scalar, k_new/v_new are
       [1, chunk, hkv, d] with ``n_valid`` real rows — the single lane's
       table row is ``tables[0]`` (the step builder wraps it as [1, max_pages]).
@@ -199,14 +232,18 @@ def paged_update_kv(
     pos = jnp.asarray(position, jnp.int32)
     tables = k_kv.tables
     if pos.ndim == 1:
-        if k_new.shape[1] != 1 or n_valid is not None:
+        if n_valid is not None:
             raise ValueError(
-                "per-lane paged writes are decode-shaped: one token per lane, "
-                f"no n_valid (got seq={k_new.shape[1]}, n_valid={n_valid})"
+                f"per-lane paged writes take no n_valid (got n_valid={n_valid})"
             )
-        k_pool = scatter_token_rows(k_kv.pool, k_new[:, 0], tables, pos)
-        v_pool = scatter_token_rows(v_kv.pool, v_new[:, 0], tables, pos)
-        return PagedKV(k_pool, tables), PagedKV(v_pool, tables), pos + 1
+        seq = k_new.shape[1]
+        if seq == 1:
+            k_pool = scatter_token_rows(k_kv.pool, k_new[:, 0], tables, pos)
+            v_pool = scatter_token_rows(v_kv.pool, v_new[:, 0], tables, pos)
+        else:
+            k_pool = scatter_lane_chunk_rows(k_kv.pool, k_new, tables, pos)
+            v_pool = scatter_lane_chunk_rows(v_kv.pool, v_new, tables, pos)
+        return PagedKV(k_pool, tables), PagedKV(v_pool, tables), pos + seq
     if k_new.shape[0] != 1 or tables.shape[0] != 1:
         raise ValueError(
             "scalar-position paged writes are single-lane chunks: "

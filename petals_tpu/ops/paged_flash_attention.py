@@ -629,6 +629,11 @@ def paged_attend_dispatch(
         or not causal
         or not window_static
         or kv_length is None
+        # speculative verify: per-lane positions with q_len > 1 (k candidate
+        # rows per lane) — the decode kernel is strictly one-row-per-lane and
+        # the prefill twin is single-lane, so compose from XLA (the reference
+        # handles vector q_offset with q_len > 1 via per-row causal masking).
+        or (decode and q.shape[1] != 1)
     )
     key = shape_class(
         tables.shape[0], tables.shape[1], k_pool.shape[1],

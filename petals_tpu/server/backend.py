@@ -645,6 +645,151 @@ class TransformerBackend:
         return out, toks, (k_pool, v_pool)
 
     @functools.cached_property
+    def _paged_spec_verify_fn(self):
+        """Speculative-decode verify step: every speculating lane feeds its
+        last committed token plus k draft tokens ([n_lanes, k+1] rows) through
+        the span in ONE program — verification IS chunked prefill into the
+        lane's pages (scatter_lane_chunk_rows writes all k+1 candidate KV rows
+        per lane; attend masks per-row causally with vector q_offset).
+
+        Acceptance is deterministic-stream: row j's logits are sampled with
+        the lane's OWN seed+offset contract (draw_idx + j) to produce the
+        target's token ĝ_{j+1} — exactly the token plain decode would have
+        produced at that draw, conditioned on the fed prefix. A draft token
+        d_j is accepted iff it equals ĝ_j AND every earlier draft matched
+        (cumprod of the match vector); the lane emits m = min(a + 1, k + 1)
+        tokens ĝ_1..ĝ_m, so the emitted stream is BIT-IDENTICAL to plain
+        decode by construction, for greedy and sampling lanes alike — the
+        distribution-preservation bar the parity tests pin down.
+
+        Rollback is position truncation: rows past ĝ_m stay in the pages but
+        are masked by kv_length (= position + 1 on every later step) and
+        overwritten as the lane advances through them — no page frees, no
+        refcount edits, which is what keeps the ledger conservation invariant
+        trivially intact. The repetition-penalty seen-mask accumulates the
+        FED token before sampling each row (idempotent for row 0's already-
+        seen committed token), matching plain decode's per-token host update.
+        Non-speculating lanes ride along with the idle sentinel position:
+        their writes drop and their outputs are ignored."""
+        family, cfg = self.family, self.cfg
+        split_quant = self._split_quant
+        use_quant_consts = self._use_quant_consts
+        reattach = self._reattach_quant
+        client_embed, client_head = family.client_embed, family.client_head
+        fp_proj = fp_ops.projection(cfg.hidden_size)  # baked constant
+
+        from petals_tpu.ops.paged_attention import PagedKV
+
+        @tracked_jit(
+            name="paged_spec_verify", steady=True,
+            static_argnames=("kernel_path", "with_fp"), donate_argnums=(1, 2),
+        )
+        def step(params, k_pool, v_pool, client_params, tokens, positions,
+                 do_sample, temperature, top_k, top_p, rep_penalty, seeds,
+                 draw_idx, seen_mask, tables, *, kernel_path: str,
+                 with_fp: bool):
+            # tokens: [n_lanes, S] int32 (S = spec_k + 1): column 0 is the
+            # lane's last committed token, columns 1..S-1 the draft proposals;
+            # positions: [n_lanes] int32, idle sentinel for non-spec lanes
+            del kernel_path  # static retrace trigger; attend() re-resolves
+            S = tokens.shape[1]
+            hidden = client_embed(client_params, tokens, cfg).astype(k_pool.dtype)
+            if use_quant_consts:
+                dense_params, quant_params, outlier_names = split_quant(params)
+                xs_params = dense_params
+                block_indices = jnp.arange(k_pool.shape[0], dtype=jnp.int32)
+            else:
+                xs_params = params
+                block_indices = jnp.zeros((k_pool.shape[0],), jnp.int32)  # unused
+
+            def body(h, xs):
+                p_block, k_blk, v_blk, block_idx = xs
+                if use_quant_consts:
+                    p_block = reattach(p_block, quant_params, outlier_names, block_idx)
+                kv = (PagedKV(k_blk, tables), PagedKV(v_blk, tables))
+                out, (k_kv, v_kv) = family.block_apply(
+                    p_block, h, kv, positions, cfg,
+                    use_flash=False, tp_mesh=None,
+                )
+                return out, (k_kv.pool, v_kv.pool)
+
+            hidden, (k_pool, v_pool) = jax.lax.scan(
+                body, hidden, (xs_params, k_pool, v_pool, block_indices)
+            )
+            logits = client_head(client_params, hidden, cfg)  # [n, S, vocab]
+            vocab_ids = jnp.arange(logits.shape[-1], dtype=jnp.int32)[None, :]
+            emitted = []
+            seen = seen_mask
+            for j in range(S):  # S is static and small (spec_k + 1)
+                # plain decode adds each fed token to the penalty set before
+                # the next draw; row 0's committed token is already in the
+                # host mask, so the OR is idempotent there
+                seen = seen | (vocab_ids == tokens[:, j][:, None])
+                g_j = sample_tokens(
+                    logits[:, j], do_sample=do_sample, temperature=temperature,
+                    top_k=top_k, top_p=top_p, repetition_penalty=rep_penalty,
+                    seen_mask=seen, seeds=seeds, draw_idx=draw_idx + j,
+                )
+                emitted.append(g_j)
+            g_hat = jnp.stack(emitted, axis=1)  # [n, S]
+            # leading-match count: draft d_j (tokens column j) verifies
+            # against ĝ_j (emitted row j-1); a mismatch invalidates every
+            # later row's conditioning, hence the cumprod prefix
+            match = (tokens[:, 1:] == g_hat[:, :-1]).astype(jnp.int32)
+            n_accept = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [n]
+            n_emit = jnp.minimum(n_accept + 1, S).astype(jnp.int32)
+            if with_fp:
+                # per-lane digest of the LAST EMITTED row's hidden state —
+                # the spec twin of decode's hidden[:, -1, :] digest
+                last = jnp.take_along_axis(
+                    hidden, jnp.clip(n_emit - 1, 0, S - 1)[:, None, None], axis=1
+                )[:, 0, :]
+                fp = fp_ops.fingerprint_rows(last, fp_proj)
+                return g_hat, n_emit, k_pool, v_pool, fp
+            return g_hat, n_emit, k_pool, v_pool
+
+        return step
+
+    def paged_spec_verify_step(self, client_params, tokens, pool_kv,
+                               positions, tables, *, sampling_vecs,
+                               handles=None):
+        """One batched draft–verify step over the lane pool (PAGED layout).
+
+        Args:
+          client_params: the span-holder's client leaves (embed/norm/head).
+          tokens: int32 [n_lanes, spec_k + 1] — column 0 the last committed
+            token per lane, columns 1.. the draft proposals (non-spec lanes:
+            anything; their sentinel position drops every write).
+          pool_kv: (k, v) page pools [n_blocks, n_pages, page_size, hkv, d].
+          positions: int32 [n_lanes]; idle sentinel = max_pages * page_size.
+          tables: int32 [n_lanes, max_pages] block tables (-1 unallocated).
+          sampling_vecs: per-lane sampling parameter dict (sampling_vectors).
+
+        Returns (g_hat [n_lanes, spec_k+1] int32, n_emit [n_lanes] int32,
+        pool_kv): lane i must commit exactly g_hat[i, :n_emit[i]].
+        """
+        k_pool, v_pool = pool_kv
+        tables = np.asarray(tables, np.int32)
+        kernel_path = self._paged_kernel_path(k_pool, tables)
+        v = sampling_vecs
+        with_fp = fp_ops.enabled()
+        with self._quant_ctx():
+            res = self._paged_spec_verify_fn(
+                self.params, k_pool, v_pool, client_params,
+                np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
+                v["do_sample"], v["temperature"], v["top_k"], v["top_p"],
+                v["repetition_penalty"], v["seeds"], v["draw_idx"],
+                v["seen_mask"], tables, kernel_path=kernel_path,
+                with_fp=with_fp,
+            )
+        if with_fp:
+            g_hat, n_emit, k_pool, v_pool, self._last_step_fp = res
+        else:
+            g_hat, n_emit, k_pool, v_pool = res
+            self._last_step_fp = None
+        return g_hat, n_emit, (k_pool, v_pool)
+
+    @functools.cached_property
     def _paged_mixed_step_fn(self):
         """Mixed prefill+decode step — the unified continuous-batching
         program ("Ragged Paged Attention" folding, PAPERS.md): every decode

@@ -37,6 +37,7 @@ import asyncio
 import contextlib
 import dataclasses
 import itertools
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -58,6 +59,7 @@ from petals_tpu.server.memory_cache import (
     PageAllocator,
 )
 from petals_tpu.server.scheduler import SessionScheduler, SwapEntry
+from petals_tpu.server.spec_decode import min_accept_floor
 from petals_tpu.server.task_queue import PRIORITY_INFERENCE, PriorityTaskQueue
 from petals_tpu.telemetry import get_journal
 from petals_tpu.telemetry import instruments as tm
@@ -94,6 +96,15 @@ class _LaneGenState:
     started: bool = False  # first batched step already recorded the wait
     queue_s: float = 0.0
     compute_s: float = 0.0
+    # speculative decoding (server/spec_decode.py): prompt context for the
+    # draft's window, the per-lane acceptance-rate EMA driving auto-disable,
+    # the cooldown (plain-decode ticks left after a disable), and lifetime
+    # proposed/accepted counts for the stream's step_meta
+    context: Optional[List[int]] = None
+    spec_ema: float = 1.0  # optimistic start: new lanes get to speculate
+    spec_cooldown: int = 0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
 
 @dataclasses.dataclass
@@ -154,6 +165,8 @@ class DecodeBatcher:
         swap_host_bytes: int = 0,  # host-RAM KV swap tier; 0 -> no preemption
         preemption_policy: str = "lru",  # lru | largest | off
         ledger=None,  # telemetry.ledger.ResourceLedger; None -> process singleton
+        draft_model=None,  # server.spec_decode.DraftModel; enables spec decode
+        spec_k: Optional[int] = None,  # drafts per lane per tick; None -> draft's k
     ):
         self.backend = backend
         self.memory_cache = memory_cache
@@ -202,6 +215,42 @@ class DecodeBatcher:
         # lanes keep stepping while prefills stream in
         self._prefill_queue: List[_LanePrefillState] = []
         self.prefill_token_budget = max(int(prefill_token_budget), 1)
+        # speculative decoding (server/spec_decode.py): with a draft model
+        # loaded, eligible gen lanes move onto the draft-verify path — k
+        # drafts verified in ONE paged step per tick, up to k+1 tokens
+        # committed. Paged pool only (verification rides the chunk-scatter
+        # machinery); requires gen_params (the verify program embeds/samples
+        # with the client leaves). spec_k must match the draft's compiled k.
+        self.draft = draft_model
+        self.spec_k = int(spec_k if spec_k is not None
+                          else getattr(draft_model, "spec_k", 0) or 0)
+        if draft_model is not None:
+            draft_k = int(getattr(draft_model, "spec_k", self.spec_k))
+            if self.spec_k != draft_k:
+                raise ValueError(
+                    f"spec_k={self.spec_k} does not match the draft model's "
+                    f"compiled k={draft_k}"
+                )
+            if gen_params is None:
+                raise ValueError(
+                    "Speculative decoding needs the client leaves loaded "
+                    "(gen_params): the verify step embeds and samples on device"
+                )
+        # the draft instance whose bucket shapes have been pre-compiled via
+        # DraftModel.warmup (first spec tick, on the compute thread); keyed
+        # on the object so a swapped-in draft re-warms
+        self._draft_warmed = None
+        # per-lane acceptance EMA auto-disable: a lane whose EMA drops below
+        # the floor falls back to plain decode for a cooldown window (both
+        # journaled as 'spec_disabled' with the EMA evidence)
+        self._spec_min_accept = min_accept_floor()
+        self._spec_ema_alpha = 0.2
+        try:
+            self._spec_cooldown_ticks = max(
+                int(os.environ.get("PETALS_TPU_SPEC_COOLDOWN", 64)), 1
+            )
+        except ValueError:
+            self._spec_cooldown_ticks = 64
 
         self._pool_stack: Optional[contextlib.AsyncExitStack] = None
         self._handles = None
@@ -278,6 +327,8 @@ class DecodeBatcher:
             "gen_steps": 0, "gen_lane_tokens": 0, "max_gen_lanes": 0,
             "exclusive_chunks": 0, "prefill_tokens": 0, "mixed_steps": 0,
             "max_prefill_tokens_per_step": 0,
+            "spec_steps": 0, "spec_proposed": 0, "spec_accepted": 0,
+            "spec_disabled": 0, "max_spec_lanes": 0,
         }
         # swarm telemetry plane: every admission / victim-selection / swap
         # decision is journaled WITH the occupancy snapshot that justified it
@@ -1134,6 +1185,12 @@ class DecodeBatcher:
         if key is None:
             return None
         delta = self._ledger.usage_delta(key)
+        if delta and delta.get("spec_proposed"):
+            # per-reply speculative efficiency rides the bill (acceptance
+            # rate and tokens per compute-second over this delta window)
+            from petals_tpu.telemetry.ledger import derive_efficiency
+
+            delta.update(derive_efficiency(delta))
         return delta or None
 
     def _occupancy(self) -> str:
@@ -1295,11 +1352,24 @@ class DecodeBatcher:
                         "Lane pool was reset while this step was pending"
                     ))
             gen_states = dict(self._gen_states)
-            pf = self._next_prefill_chunk(len(batch) + len(gen_states))
-            if not batch and not gen_states and pf is None:
+            # speculating lanes leave the plain gen dict for this tick and
+            # ride their own draft-verify step; their verify rows share the
+            # prefill fairness budget (they are chunk writes, like prefill)
+            spec_states = self._pick_spec_lanes(gen_states)
+            pf = self._next_prefill_chunk(
+                len(batch) + len(gen_states) + len(spec_states),
+                spec_tokens=len(spec_states) * (self.spec_k + 1),
+            )
+            if not batch and not gen_states and not spec_states and pf is None:
                 continue
             try:
-                toks = chunk_out = None
+                toks = chunk_out = spec_res = None
+                if spec_states:
+                    spec_res = await self.queue.submit(
+                        self._run_batch_spec, spec_states,
+                        priority=PRIORITY_INFERENCE,
+                        size=len(spec_states) * (self.spec_k + 1),
+                    )
                 if gen_states:
                     out, toks = await self.queue.submit(
                         self._run_batch_gen, batch, gen_states,
@@ -1319,7 +1389,7 @@ class DecodeBatcher:
                         self._run_batch_mixed, batch, pf,
                         priority=PRIORITY_INFERENCE, size=len(batch) + pf[1],
                     )
-                else:
+                elif batch:
                     out = await self.queue.submit(
                         self._run_batch, batch, priority=PRIORITY_INFERENCE,
                         size=len(batch),
@@ -1328,7 +1398,9 @@ class DecodeBatcher:
                 for *_, fut, _gen in batch:
                     if not fut.done():
                         fut.set_exception(e)
-                for lane, st in gen_states.items():
+                for lane, st in itertools.chain(
+                    gen_states.items(), spec_states.items()
+                ):
                     if self._gen_states.get(lane) is st:
                         del self._gen_states[lane]
                     if not st.future.done():
@@ -1346,6 +1418,8 @@ class DecodeBatcher:
                     fut.set_result(out[lane : lane + 1])
             if pf is not None and chunk_out is not None:
                 self._advance_prefill(pf[0], pf[1], chunk_out)
+            if spec_res is not None:
+                self._commit_spec_results(spec_states, *spec_res)
             if toks is None:
                 continue
             # per-lane post-step bookkeeping (event-loop side, no races with
@@ -1364,26 +1438,118 @@ class DecodeBatcher:
                 st.remaining -= 1
                 if st.remaining <= 0:
                     del self._gen_states[lane]
-                    self._step_timing[lane] = {
-                        "queue_s": st.queue_s, "compute_s": st.compute_s,
-                        "variant": "gen",
-                    }
+                    self._step_timing[lane] = self._gen_step_timing(st, "gen")
                     if not st.future.done():
                         st.future.set_result(
                             np.asarray([st.collected], np.int32)
                         )
 
-    def _prefill_budget(self, n_decode: int) -> int:
+    def _pick_spec_lanes(self, gen_states) -> Dict[int, _LaneGenState]:
+        """Partition this tick's generating lanes: lanes eligible to
+        speculate move into the returned dict (and OUT of ``gen_states``);
+        the rest take the plain one-token path. Eligibility: a draft model
+        is loaded, the pool is paged, the lane's auto-disable cooldown has
+        expired, and the lane has room for the best case — the verify step
+        writes spec_k + 1 KV rows at positions p..p+spec_k, which must stay
+        inside generate_lane's up-front page reservation (remaining rows
+        starting at the current position)."""
+        if self.draft is None or self.spec_k < 1 or self.page_size is None:
+            return {}
+        spec: Dict[int, _LaneGenState] = {}
+        for lane, st in list(gen_states.items()):
+            if st.spec_cooldown > 0:
+                st.spec_cooldown -= 1
+                continue
+            if st.remaining < self.spec_k + 1:
+                continue
+            spec[lane] = st
+            del gen_states[lane]
+        return spec
+
+    def _gen_step_timing(self, st: _LaneGenState, variant: str) -> dict:
+        """The finished stream's step_meta timing dict. Streams that ever
+        speculated also report their lifetime acceptance evidence."""
+        timing = {
+            "queue_s": st.queue_s, "compute_s": st.compute_s, "variant": variant,
+        }
+        if st.spec_proposed:
+            timing["spec_proposed"] = st.spec_proposed
+            timing["spec_accepted"] = st.spec_accepted
+            timing["acceptance_rate"] = round(
+                st.spec_accepted / st.spec_proposed, 4
+            )
+        return timing
+
+    def _commit_spec_results(self, spec_states, g_hat, n_emit) -> None:
+        """Post-step bookkeeping for a spec tick (event-loop side): commit
+        each lane's emitted prefix g_hat[lane, :n_emit[lane]] — by the
+        deterministic-stream acceptance rule those are the target's OWN
+        sampled tokens, bit-identical to what plain decode would have
+        emitted — then advance position/draw cursors by the emitted count.
+        Rollback of the rejected suffix is pure position truncation: the
+        stale KV rows past the new position stay in the pages (masked out
+        of every future step by kv_length) and are overwritten in place by
+        the next tick. No pages move, no refcounts change.
+
+        Also the acceptance-EMA auto-disable: a lane whose EMA falls below
+        the PETALS_TPU_SPEC_MIN_ACCEPT floor stops speculating for a
+        cooldown window (draft compute on a hostile stream costs more than
+        it saves), journaled with the EMA evidence."""
+        for lane, st in spec_states.items():
+            if self._gen_states.get(lane) is not st:
+                continue  # released/cancelled while the step ran
+            m = int(n_emit[lane])  # in [1, spec_k + 1] <= st.remaining
+            emitted = [int(t) for t in g_hat[lane, :m]]
+            for tok in emitted:
+                st.collected.append(tok)
+                if st.seen is not None and 0 <= tok < st.seen.shape[0]:
+                    st.seen[tok] = True
+            st.token = emitted[-1]
+            st.position += m
+            st.draw_idx += m
+            st.remaining -= m
+            accepted = m - 1  # of spec_k proposed drafts
+            st.spec_proposed += self.spec_k
+            st.spec_accepted += accepted
+            alpha = self._spec_ema_alpha
+            st.spec_ema = (
+                (1.0 - alpha) * st.spec_ema + alpha * (accepted / self.spec_k)
+            )
+            if st.spec_ema < self._spec_min_accept and st.remaining > 0:
+                ema = st.spec_ema
+                st.spec_cooldown = self._spec_cooldown_ticks
+                st.spec_ema = 1.0  # optimistic restart after the cooldown
+                self.stats["spec_disabled"] += 1
+                tm.SPEC_DISABLED.inc()
+                self._journal.event(
+                    "spec_disabled", lane=lane, ema=round(ema, 4),
+                    floor=self._spec_min_accept,
+                    cooldown_ticks=self._spec_cooldown_ticks,
+                    proposed=st.spec_proposed, accepted=st.spec_accepted,
+                )
+            if st.remaining <= 0:
+                del self._gen_states[lane]
+                self._step_timing[lane] = self._gen_step_timing(st, "spec")
+                if not st.future.done():
+                    st.future.set_result(np.asarray([st.collected], np.int32))
+
+    def _prefill_budget(self, n_decode: int, spec_tokens: int = 0) -> int:
         """Per-tick fairness: the prefill token budget shrinks under decode
         pressure (more than half the lanes actively stepping), but never
         below one page — prefills always make progress, and decode lanes
-        never wait on more than one bounded chunk per tick."""
+        never wait on more than one bounded chunk per tick. Spec-verify rows
+        spend from the same budget (they are chunk writes riding the tick,
+        exactly like prefill tokens), with the same one-page floor."""
         budget = self.prefill_token_budget
         if n_decode > max(1, self.n_lanes // 2):
             budget = max(self.page_size or 1, budget // 2)
+        if spec_tokens:
+            budget = max(self.page_size or 1, budget - int(spec_tokens))
         return budget
 
-    def _next_prefill_chunk(self, n_decode: int) -> Optional[tuple]:
+    def _next_prefill_chunk(
+        self, n_decode: int, spec_tokens: int = 0
+    ) -> Optional[tuple]:
         """Pick the chunk riding this tick: the queue head's next ``take``
         tokens, capped by the byte-sized chunk cap and the fairness budget,
         with the chunk END aligned to an absolute page boundary unless it is
@@ -1393,7 +1559,7 @@ class DecodeBatcher:
             return None
         st = self._prefill_queue[0]
         remaining = st.hidden.shape[1] - st.offset
-        take = min(remaining, st.cap, self._prefill_budget(n_decode))
+        take = min(remaining, st.cap, self._prefill_budget(n_decode, spec_tokens))
         if self.page_size and take < remaining:
             end = st.position + take
             aligned = end - end % self.page_size
@@ -1539,6 +1705,11 @@ class DecodeBatcher:
                 )
                 st.seed = int(sampling.get("seed", 0))
                 st.draw_idx = int(sampling.get("offset", 0)) + 1
+                # the draft model conditions on (context + collected); a
+                # missing context only costs acceptance rate, never parity
+                ctx = sampling.get("context")
+                if ctx:
+                    st.context = [int(t) for t in ctx]
                 if st.repetition_penalty != 1.0:
                     vocab = self.backend.cfg.vocab_size
                     seen = np.zeros((vocab,), bool)
@@ -1848,6 +2019,104 @@ class DecodeBatcher:
                 st.queue_s = max(t_step - st.enqueued, 0.0) if st.enqueued else 0.0
             st.compute_s += duration
         return host_out, host_toks
+
+    def _run_batch_spec(self, spec_states) -> Tuple[np.ndarray, np.ndarray]:
+        """Compute-thread body for one speculative tick: the draft proposes
+        k tokens per speculating lane, then ONE verify step (backend.
+        paged_spec_verify_step) feeds [last committed token, k drafts] at
+        positions p..p+k, samples the target's own token for every row from
+        the lane's seed+offset PRNG stream, and returns the emitted prefix
+        per lane. Non-speculating lanes ride at the idle sentinel. Returns
+        (g_hat [n_lanes, spec_k+1], n_emit [n_lanes]); the event loop
+        commits g_hat[lane, :n_emit[lane]] (_commit_spec_results).
+
+        Ledger honesty: the WHOLE tick wall (draft + verify, both on this
+        thread) splits equally across the speculating lanes via the normal
+        note_compute path — conservation holds unchanged — and the draft's
+        share is additionally recorded per lane as the draft_seconds
+        'of which' annotation, with proposed/accepted counts feeding the
+        per-peer acceptance_rate (/ledger, step_meta usage)."""
+        expected = next(iter(spec_states.values())).generation
+        if expected != self._generation or any(
+            st.generation != self._generation for st in spec_states.values()
+        ):
+            raise AllocationFailed("Lane pool was reset before this batched step ran")
+        if self._draft_warmed is not self.draft:
+            # compile every propose bucket before the first measured tick so
+            # later lane-count mixes never compile (spec_decode.DraftModel)
+            self.draft.warmup(self.n_lanes)
+            self._draft_warmed = self.draft
+        t_step = time.perf_counter()
+        S = self.spec_k + 1
+        contexts: List[Optional[List[int]]] = [None] * self.n_lanes
+        for lane, st in spec_states.items():
+            contexts[lane] = (st.context or []) + st.collected
+        drafts = self.draft.propose(contexts)  # [n_lanes, spec_k] greedy
+        draft_s = time.perf_counter() - t_step
+        tokens = np.zeros((self.n_lanes, S), np.int32)
+        positions = np.full((self.n_lanes,), self.max_length, np.int32)  # idle sentinel
+        vecs = sampling_vectors(self.n_lanes, self.backend.cfg.vocab_size)
+        for lane, st in spec_states.items():
+            tokens[lane, 0] = st.token
+            tokens[lane, 1:] = drafts[lane]
+            positions[lane] = st.position
+            vecs["do_sample"][lane] = st.do_sample
+            vecs["temperature"][lane] = st.temperature
+            vecs["top_k"][lane] = st.top_k
+            vecs["top_p"][lane] = st.top_p
+            vecs["repetition_penalty"][lane] = st.repetition_penalty
+            vecs["seeds"][lane] = st.seed
+            vecs["draw_idx"][lane] = st.draw_idx
+            if st.seen is not None:
+                vecs["seen_mask"][lane] = st.seen
+        k_pool, v_pool = self._buffers()
+        g_hat, n_emit, (k_pool, v_pool) = self.backend.paged_spec_verify_step(
+            self.gen_params, tokens, (k_pool, v_pool), positions,
+            self._tables.copy(), sampling_vecs=vecs, handles=self._handles,
+        )
+        host_g = np.asarray(g_hat)  # device sync: the step has fully executed
+        host_m = np.asarray(n_emit)
+        with self._reset_lock:
+            if expected != self._generation:
+                # see _run_batch: checked atomically with the swap so a reset
+                # landing mid-step leaves the freshly zeroed pool in place
+                raise AllocationFailed("Lane pool was reset while this batched step ran")
+            self._update(k_pool, v_pool)
+        n_spec = len(spec_states)
+        emitted_total = int(sum(int(host_m[lane]) for lane in spec_states))
+        accepted_total = emitted_total - n_spec  # one bonus token per lane
+        proposed_total = n_spec * self.spec_k
+        self.stats["batched_steps"] += 1
+        self.stats["batched_tokens"] += emitted_total
+        self.stats["spec_steps"] += 1
+        self.stats["spec_proposed"] += proposed_total
+        self.stats["spec_accepted"] += accepted_total
+        self.stats["max_spec_lanes"] = max(self.stats["max_spec_lanes"], n_spec)
+        duration = time.perf_counter() - t_step
+        tm.STEP_SPEC.observe(duration)
+        tm.STEPS_SPEC.inc()
+        tm.DECODE_TOKENS.inc(emitted_total)
+        tm.SPEC_PROPOSED.inc(proposed_total)
+        tm.SPEC_ACCEPTED.inc(accepted_total)
+        self._capture_step_fp(list(spec_states))
+        keys = []
+        per_lane_draft = draft_s / n_spec
+        for lane, st in spec_states.items():
+            key = self._ledger_keys.get(lane)
+            if key is not None:
+                keys.append(key)
+                self._ledger.note_tokens(key, decode=int(host_m[lane]))
+                self._ledger.note_spec(
+                    key, draft_seconds=per_lane_draft,
+                    proposed=self.spec_k, accepted=int(host_m[lane]) - 1,
+                )
+        self._ledger.note_compute(keys, duration)
+        for st in spec_states.values():
+            if not st.started:
+                st.started = True
+                st.queue_s = max(t_step - st.enqueued, 0.0) if st.enqueued else 0.0
+            st.compute_s += duration
+        return host_g, host_m
 
     # ------------------------------------------------------- non-batchable ops
 

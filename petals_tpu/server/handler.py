@@ -88,6 +88,8 @@ class TransformerHandler:
         prefix_share_scope: str = "swarm",  # "swarm" shares across clients; "peer" salts per client
         prefix_device_bytes: int = 256 * 2**20,  # HBM tier of the prefix cache; 0 disables
         server_gen_params=None,  # client leaves (embed/norm/head) for device-side generation
+        draft_model=None,  # server.spec_decode.DraftModel: speculative decoding
+        spec_k: Optional[int] = None,  # drafts per lane per tick; None -> draft's k
     ):
         self.backend = backend
         self.dht_prefix = dht_prefix
@@ -165,6 +167,8 @@ class TransformerHandler:
                 prefill_token_budget=prefill_token_budget,
                 swap_host_bytes=swap_host_bytes,
                 preemption_policy=preemption_policy,
+                draft_model=draft_model,
+                spec_k=spec_k,
             )
 
         # Content-addressed prefix cache (server/prefix_cache.py): sessions
@@ -179,6 +183,8 @@ class TransformerHandler:
         # module docstring spells out the tradeoff)
         self.prefix_share_scope = prefix_share_scope
         self.server_gen_params = server_gen_params
+        self.draft_model = draft_model
+        self.spec_k = spec_k
         if prefix_cache_bytes > 0:
             from petals_tpu.server.prefix_cache import PrefixCache
 
@@ -228,6 +234,8 @@ class TransformerHandler:
                 prefill_token_budget=old.prefill_token_budget,
                 swap_host_bytes=old.swap_pool.max_size_bytes,
                 preemption_policy=old._scheduler.policy,
+                draft_model=self.draft_model,
+                spec_k=self.spec_k,
             )
             await old.close()
 
@@ -1853,11 +1861,16 @@ class TransformerHandler:
                         if step_timing is None:
                             step_timing = gen_timing
                         else:
-                            step_timing = {
+                            merged = {
                                 "queue_s": step_timing["queue_s"] + gen_timing["queue_s"],
                                 "compute_s": step_timing["compute_s"] + gen_timing["compute_s"],
                                 "variant": step_timing["variant"] + "+gen",
                             }
+                            # speculative evidence survives the merge
+                            for k in ("spec_proposed", "spec_accepted", "acceptance_rate"):
+                                if k in gen_timing:
+                                    merged[k] = gen_timing[k]
+                            step_timing = merged
                     position += gen_n - 1  # the last token is never fed
                     gen_token_list = [int(t) for t in gen_arr[0]]
                 if reg is not None:
@@ -1882,6 +1895,12 @@ class TransformerHandler:
                     "compute_s": round(meta_c, 6),
                     "variant": step_variant,
                 }
+                if step_timing is not None:
+                    # speculative-decoding evidence for streams that ever
+                    # speculated: lifetime draft counts + acceptance rate
+                    for k in ("spec_proposed", "spec_accepted", "acceptance_rate"):
+                        if k in step_timing:
+                            step_meta[k] = step_timing[k]
                 if step_fp is not None:
                     # fused activation fingerprint of the reply's last token
                     # row (ops/fingerprint.py): the client re-derives it from

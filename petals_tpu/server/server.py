@@ -97,6 +97,10 @@ class Server:
         prefix_share_scope: str = "swarm",  # "peer" isolates the prefix cache per client identity
         prefix_device_bytes: int = 256 * 2**20,  # HBM tier of the prefix cache; 0 disables
         server_side_generation: bool = True,  # device-side greedy loop on full-span servers
+        draft_model: Optional[str] = None,  # small checkpoint for speculative decoding
+        spec_k: int = 4,  # drafts verified per lane per tick when draft_model is set
+        draft_window: Optional[int] = None,  # draft context window (tokens); None = default
+        draft_quant_type: str = "nf4a",  # draft block quantization (4-bit serving default)
         metrics_port: Optional[int] = None,  # Prometheus /metrics HTTP port; None disables, 0 = ephemeral
     ):
         self.num_hosts = num_hosts or 1
@@ -202,6 +206,11 @@ class Server:
         self.prefix_share_scope = prefix_share_scope
         self.prefix_device_bytes = prefix_device_bytes
         self.server_side_generation = server_side_generation
+        self.draft_model_path = draft_model
+        self.spec_k = int(spec_k)
+        self.draft_window = draft_window
+        self.draft_quant_type = draft_quant_type
+        self._draft_model = None  # loaded lazily by _make_handler
         self.request_timeout = request_timeout
         self.session_timeout = session_timeout
         self.step_timeout = step_timeout
@@ -633,6 +642,13 @@ class Server:
                 self.handler.server_gen_params is not None
                 if getattr(self, "handler", None) is not None else None
             ),
+            # speculative decoding capability: k drafts verified per tick
+            # (informational — spec output is bit-identical to plain decode)
+            spec_k=(
+                self.spec_k
+                if getattr(self, "handler", None) is not None
+                and self.handler.draft_model is not None else None
+            ),
             # lane-pool / scheduler occupancy for load-aware routing and the
             # health monitor; None on servers without continuous batching
             pool=(
@@ -833,7 +849,48 @@ class Server:
             prefix_share_scope=self.prefix_share_scope,
             prefix_device_bytes=self.prefix_device_bytes,
             server_gen_params=self._load_server_gen_params(),
+            draft_model=self._load_draft_model(),
+            spec_k=self.spec_k if self.draft_model_path else None,
         )
+
+    def _load_draft_model(self):
+        """Speculative-decoding draft (server/spec_decode.py): a small full
+        model loaded alongside the span. Same eligibility as server-side
+        generation — the verify step embeds/samples with the client leaves —
+        plus a paged pool (verification rides the chunk-scatter machinery).
+        Any load failure degrades to plain decode, never a dead server."""
+        if not self.draft_model_path or self.spec_k < 1:
+            return None
+        if (
+            not self.server_side_generation
+            or self.num_blocks != self.cfg.num_hidden_layers
+            or self.first_block != 0
+            or self.num_hosts > 1
+            or not self.page_size
+        ):
+            logger.warning(
+                "Speculative decoding disabled: --draft_model needs a "
+                "full-span single-host server with server-side generation "
+                "and a paged lane pool"
+            )
+            return None
+        if self._draft_model is not None:
+            return self._draft_model
+        try:
+            from petals_tpu.server.spec_decode import DEFAULT_WINDOW, DraftModel
+
+            self._draft_model = DraftModel.from_pretrained(
+                self.draft_model_path,
+                spec_k=self.spec_k,
+                window=int(self.draft_window or DEFAULT_WINDOW),
+                quant_type=self.draft_quant_type,
+                revision=self.revision,
+                cache_dir=self.cache_dir,
+            )
+        except Exception as e:
+            logger.warning(f"Speculative decoding disabled (draft load failed): {e}")
+            self._draft_model = None
+        return self._draft_model
 
     def _load_server_gen_params(self):
         """Client leaves (embed/norm/head) for the device-side greedy

@@ -53,6 +53,22 @@ DECODE_TOKENS = REGISTRY.counter(
     "Decode tokens produced across all lanes",
 )
 
+# --- speculative decoding ---------------------------------------------------
+SPEC_PROPOSED = REGISTRY.counter(
+    "petals_spec_proposed_tokens_total",
+    "Draft tokens proposed to the verify step across all speculating lanes",
+)
+SPEC_ACCEPTED = REGISTRY.counter(
+    "petals_spec_accepted_tokens_total",
+    "Draft tokens accepted by the verify step (emitted minus the guaranteed "
+    "one-per-tick correction token)",
+)
+SPEC_DISABLED = REGISTRY.counter(
+    "petals_spec_disabled_total",
+    "Lanes auto-disabled from speculation after their acceptance-rate EMA "
+    "fell below PETALS_TPU_SPEC_MIN_ACCEPT (cooldown fallback to plain decode)",
+)
+
 # --- pool / scheduler ------------------------------------------------------
 PAGES_FREE = REGISTRY.gauge(
     "petals_page_pool_free_pages", "Free pages in the paged KV pool"
@@ -258,10 +274,12 @@ STEP_DENSE = STEP_DURATION.labels(variant="dense")
 STEP_PAGED = STEP_DURATION.labels(variant="paged")
 STEP_MIXED = STEP_DURATION.labels(variant="mixed")
 STEP_GEN = STEP_DURATION.labels(variant="gen")
+STEP_SPEC = STEP_DURATION.labels(variant="spec")
 STEPS_DENSE = BATCHED_STEPS.labels(variant="dense")
 STEPS_PAGED = BATCHED_STEPS.labels(variant="paged")
 STEPS_MIXED = BATCHED_STEPS.labels(variant="mixed")
 STEPS_GEN = BATCHED_STEPS.labels(variant="gen")
+STEPS_SPEC = BATCHED_STEPS.labels(variant="spec")
 SWAP_OUT_BYTES = SWAP_BYTES.labels(direction="out")
 SWAP_IN_BYTES = SWAP_BYTES.labels(direction="in")
 PREFIX_HIT = PREFIX_EVENTS.labels(event="hit")

@@ -75,12 +75,40 @@ USAGE_FIELDS = (
     "page_seconds",
     "lane_seconds",
     "compute_seconds",
+    # speculative decoding: draft_seconds is an "of which" annotation INSIDE
+    # compute_seconds (the batcher bills the whole tick wall via note_compute,
+    # so the conservation story is unchanged; draft_seconds records how much
+    # of a session's compute went to the draft model). spec_proposed /
+    # spec_accepted count draft tokens offered to and accepted by the verify
+    # step — their ratio is the peer's acceptance_rate.
+    "draft_seconds",
+    "spec_proposed",
+    "spec_accepted",
     "prefill_tokens",
     "decode_tokens",
     "swap_out_bytes",
     "swap_in_bytes",
     "migrated_bytes",
 )
+
+
+def derive_efficiency(usage: Dict[str, float]) -> Dict[str, float]:
+    """Speculation-efficiency ratios derived from a usage dict: per-peer
+    ``acceptance_rate`` (accepted/proposed draft tokens; 0.0 before any
+    proposal) and ``tokens_per_compute_second`` (all tokens produced per
+    compute-second billed — the "is speculation paying for itself" number
+    clients read off /ledger and step_meta)."""
+    proposed = usage.get("spec_proposed", 0.0)
+    compute_s = usage.get("compute_seconds", 0.0)
+    tokens = usage.get("prefill_tokens", 0.0) + usage.get("decode_tokens", 0.0)
+    return {
+        "acceptance_rate": (
+            round(usage.get("spec_accepted", 0.0) / proposed, 4) if proposed > 0 else 0.0
+        ),
+        "tokens_per_compute_second": (
+            round(tokens / compute_s, 4) if compute_s > 0 else 0.0
+        ),
+    }
 
 
 _TM = None
@@ -256,6 +284,21 @@ class ResourceLedger:
                 if sess is not None:
                     sess.totals["compute_seconds"] += share
         _tm().LEDGER_COMPUTE_SECONDS.inc(float(seconds))
+
+    def note_spec(
+        self, key: str, *, draft_seconds: float = 0.0,
+        proposed: int = 0, accepted: int = 0,
+    ) -> None:
+        """Record one speculating lane's share of a spec tick: its slice of
+        the draft model's wall time (an "of which" annotation inside the
+        compute-seconds already billed by note_compute) plus its proposed /
+        accepted draft-token counts. Called from the compute thread."""
+        with self._lock:
+            sess = self._sessions.get(key)
+            if sess is not None:
+                sess.totals["draft_seconds"] += draft_seconds
+                sess.totals["spec_proposed"] += proposed
+                sess.totals["spec_accepted"] += accepted
 
     def note_tokens(self, key: str, *, prefill: int = 0, decode: int = 0) -> None:
         with self._lock:
@@ -503,9 +546,11 @@ class ResourceLedger:
                 "resource": resource,
                 "page_s": round(usage["page_seconds"], 4),
                 "compute_s": round(usage["compute_seconds"], 4),
+                "draft_s": round(usage["draft_seconds"], 4),
                 "tokens": int(usage["prefill_tokens"] + usage["decode_tokens"]),
                 "swap_bytes": int(usage["swap_out_bytes"] + usage["swap_in_bytes"]),
                 "migrated_bytes": int(usage["migrated_bytes"]),
+                **derive_efficiency(usage),
             })
         rows.sort(key=lambda r: (-r["share"], -r["page_s"], -r["compute_s"], r["peer"]))
         return rows[:k]
@@ -541,6 +586,7 @@ class ResourceLedger:
                     "age_s": round(now - s.opened_t, 3),
                     "page_rate": round(s.page_rate, 4),
                     **{f: round(s.totals[f], 4) for f in USAGE_FIELDS},
+                    **derive_efficiency(s.totals),
                 }
                 for s in list(self._sessions.values())[:k]
             ],
@@ -619,6 +665,7 @@ __all__ = [
     "DRF_RESOURCES",
     "USAGE_FIELDS",
     "ResourceLedger",
+    "derive_efficiency",
     "get_ledger",
     "normalize_peer",
 ]

@@ -84,6 +84,8 @@ class HopTrace:
         usage = meta.get("usage")
         if isinstance(usage, dict):
             for field, amount in usage.items():
+                if field in ("acceptance_rate", "tokens_per_compute_second"):
+                    continue  # rates don't sum; re-derived from the counters
                 try:
                     self.usage[field] = self.usage.get(field, 0) + float(amount)
                 except (TypeError, ValueError):
@@ -129,8 +131,19 @@ class HopTrace:
             "occupancy": self.last_occupancy,
             "components": {k: round(v, 6) for k, v in comps.items()},
             "shares": {k: round(v / wall, 4) for k, v in comps.items()},
-            "usage": {k: round(v, 6) for k, v in self.usage.items()},
+            "usage": self._usage_dict(),
         }
+
+    def _usage_dict(self) -> dict:
+        usage = {k: round(v, 6) for k, v in self.usage.items()}
+        if usage.get("spec_proposed"):
+            # speculative efficiency over the hop's whole stream, derived
+            # from the summed counters (rates riding individual step_meta
+            # deltas would not average correctly)
+            from petals_tpu.telemetry.ledger import derive_efficiency
+
+            usage.update(derive_efficiency(self.usage))
+        return usage
 
 
 def build_trace_report(
