@@ -1319,6 +1319,9 @@ def _heavy_row_registry():
         "e2e_preemption_oversubscription": lambda: __import__(
             "benchmarks.bench_preemption", fromlist=["run_bench"]
         ).run_bench(),
+        "e2e_kv_quant_capacity": lambda: __import__(
+            "benchmarks.bench_kv_quant_capacity", fromlist=["run_bench"]
+        ).run_bench(),
         "quant_quality": lambda: __import__(
             "benchmarks.quant_quality", fromlist=["quality_report"]
         ).quality_report(include_model_tier=False),
@@ -1839,6 +1842,158 @@ def bench_gate_spec_decode(label, *, lanes=2, tokens=24, spec_k=4):
     return result
 
 
+def bench_gate_kv_quant(label, *, lanes=2, steps=24):
+    """CPU-runnable gate row for the quantized paged KV pool: the acceptance
+    geometry (head_dim=128) run fp vs nf4a on real DecodeBatchers. Asserts
+    the two deterministic claims — (a) at a FIXED cache byte budget the nf4a
+    pool admits >=3.5x the sessions of the fp pool (both admission loops run
+    the real 4-descriptor allocator, not arithmetic), and (b) decode over
+    quantized pages causes ZERO post-warmup recompile anomalies. The fp/nf4a
+    step walls ride the blob as structural numbers (CPU timing is not
+    decision-grade; the throughput verdict is the e2e_kv_quant_capacity row
+    on-chip), and the pinned steps_paged/compiles counters make a build that
+    silently stops exercising the quantized path fail ``--gate``."""
+    import jax.numpy as jnp
+
+    from petals_tpu.models.llama.config import LlamaBlockConfig
+    from petals_tpu.models.registry import get_family
+    from petals_tpu.server.backend import TransformerBackend
+    from petals_tpu.server.batching import DecodeBatcher
+    from petals_tpu.server.memory_cache import AllocationFailed, MemoryCache
+    from petals_tpu.server.task_queue import PriorityTaskQueue
+    from petals_tpu.telemetry import instruments as tm
+
+    # head_dim=128 is the geometry the capacity claim is calibrated on: the
+    # nf4a wire row (d/2 codes + 4 scale bytes) clears 3.5x only once the
+    # fp16/bf16 row is 2*d bytes wide
+    cfg = LlamaBlockConfig(
+        hidden_size=256, num_attention_heads=2, num_key_value_heads=2,
+        head_dim=128, intermediate_size=128, num_hidden_layers=2,
+        rms_norm_eps=1e-5, vocab_size=128,
+    )
+    n_blocks = cfg.num_hidden_layers
+    family = get_family("llama")
+    params = random_params(cfg, n_blocks, jnp.float32)
+
+    def make_backend(kind):
+        return TransformerBackend(
+            family, cfg, params,
+            first_block=0, n_blocks=n_blocks,
+            memory_cache=MemoryCache(None), compute_dtype=jnp.float32,
+            use_flash=False, kv_quant_type=kind,
+        )
+
+    backend_fp = make_backend("none")
+    backend_q = make_backend("nf4a")
+    fp_token = backend_fp.cache_bytes_per_token()
+    q_token = backend_q.kv_bytes_per_token()
+    assert fp_token / q_token >= 3.5, (
+        f"nf4a pool must be >=3.5x denser than fp per token: "
+        f"fp={fp_token}B quant={q_token}B"
+    )
+
+    PS = 16  # sessions hold one page each, so pages are the binding budget
+    budget = 48 * fp_token * PS  # what 48 fp pages cost
+    pages = {"fp": budget // (fp_token * PS), "quant": budget // (q_token * PS)}
+
+    rng = np.random.RandomState(0)
+    step_h = rng.randn(1, 1, cfg.hidden_size).astype(np.float32) * 0.02
+
+    async def run():
+        queue = PriorityTaskQueue()
+        queue.start()
+        try:
+            async def admitted(backend, n_pages):
+                # real allocator admission at the shared byte budget: one
+                # page of live context per session, lane pool sized so pages
+                # (not lanes) push back
+                batcher = DecodeBatcher(
+                    backend, backend.memory_cache, queue,
+                    n_lanes=int(n_pages) + 2, max_length=4 * PS,
+                    page_size=PS, n_pages=int(n_pages),
+                )
+                sessions = []
+                try:
+                    while True:
+                        try:
+                            lane = await batcher.acquire_lane(timeout=0.5)
+                        except (AllocationFailed, asyncio.TimeoutError):
+                            break
+                        try:
+                            await batcher.prepare_write(lane, 0, PS, timeout=0.5)
+                        except (AllocationFailed, asyncio.TimeoutError):
+                            batcher.release_lane(lane)
+                            break
+                        sessions.append(lane)
+                    return len(sessions)
+                finally:
+                    for lane in sessions:
+                        batcher.release_lane(lane)
+                    await batcher.close()
+
+            sessions_fp = await admitted(backend_fp, pages["fp"])
+            sessions_q = await admitted(backend_q, pages["quant"])
+            assert sessions_q >= 3.5 * sessions_fp, (
+                f"fixed-budget admission: nf4a admitted {sessions_q} vs fp "
+                f"{sessions_fp} — expected >=3.5x"
+            )
+
+            async def timed_decode(backend):
+                batcher = DecodeBatcher(
+                    backend, backend.memory_cache, queue,
+                    n_lanes=lanes, max_length=128, page_size=PS,
+                )
+                try:
+                    lane = await batcher.acquire_lane(timeout=30)
+                    pos = 0
+                    for _ in range(3):  # warm both compile variants
+                        await batcher.step(lane, step_h, pos)
+                        pos += 1
+                    t0 = time.perf_counter()
+                    for _ in range(steps):
+                        await batcher.step(lane, step_h, pos)
+                        pos += 1
+                    wall = time.perf_counter() - t0
+                    batcher.release_lane(lane)
+                    return wall
+                finally:
+                    await batcher.close()
+
+            wall_fp = await timed_decode(backend_fp)
+            anomalies_before = sum(
+                c.value for _v, c in tm.COMPILE_ANOMALIES.children()
+            )
+            wall_q = await timed_decode(backend_q)
+            anomalies = sum(
+                c.value for _v, c in tm.COMPILE_ANOMALIES.children()
+            ) - anomalies_before
+            assert anomalies == 0, (
+                f"quantized-pool decode caused {anomalies} post-warmup "
+                f"recompile anomalies — dequant rides inside the already-warm "
+                f"paged step"
+            )
+            return {
+                "label": label,
+                "kv_quant": "nf4a",
+                "bytes_per_token_fp": int(fp_token),
+                "bytes_per_token_quant": int(q_token),
+                "capacity_ratio": round(fp_token / q_token, 2),
+                "sessions_fp": sessions_fp,
+                "sessions_quant": sessions_q,
+                "session_ratio": round(sessions_q / max(sessions_fp, 1), 2),
+                "fp_step_ms": round(1000.0 * wall_fp / steps, 3),
+                "quant_step_ms": round(1000.0 * wall_q / steps, 3),
+                "post_warmup_compile_anomalies": anomalies,
+            }
+        finally:
+            queue.shutdown()
+
+    result = asyncio.run(run())
+    del params, backend_fp, backend_q
+    gc.collect()
+    return result
+
+
 def _gate_row_registry():
     """Rows cheap enough for the CI perf gate (seconds each on CPU). Run via
     the same ``--row`` child protocol as the heavy rows so each gets a fresh
@@ -1851,6 +2006,7 @@ def _gate_row_registry():
         ),
         "gate_paged_kernel": lambda: bench_gate_paged_kernel("gate_paged_kernel"),
         "gate_spec_decode": lambda: bench_gate_spec_decode("gate_spec_decode"),
+        "gate_kv_quant": lambda: bench_gate_kv_quant("gate_kv_quant"),
     }
 
 

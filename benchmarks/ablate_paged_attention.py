@@ -37,6 +37,17 @@ def hard_sync(x):
     np.asarray(jax.device_get(jnp.ravel(x)[:1]))
 
 
+def _perturb(pool, f):
+    """Per-link pool perturbation that survives quantized pools: scaling a
+    row scales its absmax, so multiplying the SCALES is the exact quantized
+    counterpart of multiplying a dense pool."""
+    from petals_tpu.ops.paged_attention import PagedPool
+
+    if isinstance(pool, PagedPool):
+        return PagedPool(pool.codes, pool.scales * f)
+    return pool * f
+
+
 def _time_slope(call, q, kp, vp, tables, pos, runs=3, n_lo=2, n_hi=8):
     """Per-call time via the chained-slope method (the axon tunnel has a ~ms
     dispatch floor): jit n chained calls (output feeds the next q, pool
@@ -49,7 +60,8 @@ def _time_slope(call, q, kp, vp, tables, pos, runs=3, n_lo=2, n_hi=8):
             out = q
             for j in range(n):
                 f = 1.0 + j / 128.0
-                out = call(out * 1e-2 + q, kp * f, vp * f, tables, pos)
+                out = call(out * 1e-2 + q, _perturb(kp, f), _perturb(vp, f),
+                           tables, pos)
             return out
 
         fn = tracked_jit(chained, name="paged_ablate_chain")
@@ -95,6 +107,17 @@ def bench_shape(n_lanes, max_pages, page_size, hkv, group, d=128, runs=3):
     kp = jax.random.normal(kk, (n_pages, page_size, hkv, d), dtype) * 0.1
     vp = jax.random.normal(kv_, (n_pages, page_size, hkv, d), dtype) * 0.1
 
+    # PETALS_TPU_KV_QUANT=int8|nf4a: run the same sweep over a QUANTIZED
+    # pool — the pallas arm dequantizes in-tile, the XLA arm pays
+    # gather + dequantize-then-attend (its bit-compatible twin), so the
+    # slope difference is the in-kernel-dequant HBM-vs-ALU trade.
+    kv_quant = os.environ.get("PETALS_TPU_KV_QUANT", "none")
+    if kv_quant != "none":
+        from petals_tpu.ops.paged_attention import PagedPool, quantize_kv_rows
+
+        kp = PagedPool(*quantize_kv_rows(kp.astype(jnp.float32), kv_quant))
+        vp = PagedPool(*quantize_kv_rows(vp.astype(jnp.float32), kv_quant))
+
     def arm_pallas(q, kp, vp, tables, pos):
         return paged_flash_attend(q, kp, vp, tables, pos, interpret=interpret)
 
@@ -123,6 +146,7 @@ def bench_shape(n_lanes, max_pages, page_size, hkv, group, d=128, runs=3):
     return {
         "n_lanes": n_lanes, "max_pages": max_pages, "page_size": page_size,
         "hkv": hkv, "group": group, "d": d, "rows": rows,
+        **({"kv_quant": kv_quant} if kv_quant != "none" else {}),
     }
 
 
@@ -153,7 +177,12 @@ def main():
     try:
         with open("BENCH_DETAILS.json") as f:
             details = json.load(f)
-        details["paged_attention_ablation"] = results
+        detail_key = "paged_attention_ablation"
+        if os.environ.get("PETALS_TPU_KV_QUANT", "none") != "none":
+            # the quantized-pool sweep gets its own artifact slot so it never
+            # clobbers the dense verdict
+            detail_key += "_" + os.environ["PETALS_TPU_KV_QUANT"]
+        details[detail_key] = results
         # atomic replace: a timeout kill mid-write must not corrupt the
         # artifact that holds the revival bench results
         tmp = "BENCH_DETAILS.json.tmp"

@@ -154,4 +154,32 @@ timeout 1200 python bench.py --row e2e_spec_decode 2>&1 | grep -v WARNING | tail
 timeout 900 python bench.py --row gate_spec_decode 2>&1 | tail -3
 timeout 900 python -m pytest tests/ -q -m spec 2>&1 | tail -3
 
+echo "== 9/9 quantized paged KV pool (on-chip recalibration + in-kernel dequant ablation) =="
+# Every kv-quant number committed so far is CPU: the kvquant lane's parity
+# tolerances and the _KV_QUANT_TOL bands in ops/fingerprint.py were
+# calibrated against interpret-mode Pallas and XLA:CPU accumulation order.
+# On silicon, re-derive in order:
+#   (a) the -m kvquant lane ON the chip — codec roundtrip bounds are
+#       backend-independent, but kernel-vs-XLA parity on quantized pages and
+#       the decode drift band (test_backend_step_within_kv_quant_band...)
+#       see real Mosaic dequant numerics; if healthy quantized replicas land
+#       outside the band, widen _KV_QUANT_TOL BEFORE trusting canary quorums
+#       over mixed fp/quantized pools;
+#   (b) the gate row — the >=3.5x fixed-budget admission assert is
+#       arithmetic and must hold anywhere, but zero post-warmup recompile
+#       anomalies and the fp-vs-quant step walls only mean something where
+#       the pallas arm is the REAL kernel (in-kernel dequant trades HBM
+#       bytes for VREG unpack ALU — CPU cannot see that trade);
+#   (c) the e2e capacity row — sessions ratio at a fixed byte budget plus
+#       quant-vs-fp decode tok/s (the ~4x-less-HBM-traffic claim: quantized
+#       decode should be FASTER on-chip once attention reads are
+#       bandwidth-bound, not the ~1.0x dispatch-bound CPU ratio);
+#   (d) the paged-attention ablation under a quantized pool — same
+#       lane-count x layout sweep as step 7/7(a) with the int8/nf4a dequant
+#       fused into the kernel, vs dequantize-then-XLA-attend.
+timeout 900 python -m pytest tests/ -q -m kvquant 2>&1 | tail -3
+timeout 900 python bench.py --row gate_kv_quant 2>&1 | tail -3
+timeout 1200 python bench.py --row e2e_kv_quant_capacity 2>&1 | grep -v WARNING | tail -4
+timeout 1200 env PETALS_TPU_KV_QUANT=nf4a python benchmarks/ablate_paged_attention.py 2>&1 | grep -v WARNING | tail -8
+
 echo "== revival queue done =="

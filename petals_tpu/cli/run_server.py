@@ -113,6 +113,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Paged KV pool size in pages (default: batch_lanes * pages-per-lane, "
                              "i.e. no oversubscription; raise to admit more sessions than lanes "
                              "could hold at full length)")
+    parser.add_argument("--kv_quant_type", choices=["none", "int8", "nf4a"], default="none",
+                        help="Quantize the paged KV pool in place: int8 (per-row absmax) or "
+                             "packed nf4a halves decode HBM traffic ~2-4x and fits ~2-4x more "
+                             "pages in the same cache budget; pages are dequantized inside the "
+                             "fused attention kernel. Requires --page_size > 0")
     parser.add_argument("--prefill_token_budget", type=int, default=512,
                         help="Max prefill-chunk tokens folded into each mixed batched step "
                              "(paged lanes only: prefills share the step with decode lanes "
@@ -246,6 +251,7 @@ def main(argv=None) -> None:
         batch_max_length=args.batch_max_length,
         page_size=args.page_size,
         n_pages=args.n_pages,
+        kv_quant_type=args.kv_quant_type,
         prefill_token_budget=args.prefill_token_budget,
         swap_host_bytes=args.swap_host_bytes,
         preemption_policy=args.preemption_policy,

@@ -66,10 +66,30 @@ _QUANT_TOL: Dict[str, float] = {
     "nf4": 2e-1,
 }
 
+# Quantized paged KV pool (``--kv_quant_type``): the cache itself is lossy,
+# so every decode step past the first page carries KV requantization noise
+# on TOP of whatever the weights contribute. Additive with the weight band
+# (independent error sources); calibrated in tests/test_kv_quant.py against
+# per-row absmax int8 / packed-nf4a roundtrips of real activations.
+_KV_QUANT_TOL: Dict[str, float] = {
+    "none": 0.0,
+    "int8": 8e-2,
+    "nf4a": 1.5e-1,
+}
 
-def tolerance_for(quant: Optional[str]) -> float:
-    """Relative cross-replica tolerance for a span's quantization mode."""
-    return _QUANT_TOL.get((quant or "none").lower(), max(_QUANT_TOL.values()))
+
+def tolerance_for(quant: Optional[str], kv_quant: Optional[str] = None) -> float:
+    """Relative cross-replica tolerance for a span's quantization mode.
+
+    ``quant`` is the WEIGHT quantization of the widest replica in the pair;
+    ``kv_quant`` is the widest paged-KV-pool storage kind. The bands add:
+    weight noise and cache requantization noise are independent."""
+    tol = _QUANT_TOL.get((quant or "none").lower(), max(_QUANT_TOL.values()))
+    if kv_quant is not None and (kv_quant or "none").lower() != "none":
+        tol += _KV_QUANT_TOL.get(
+            (kv_quant or "none").lower(), max(_KV_QUANT_TOL.values())
+        )
+    return tol
 
 
 # ------------------------------------------------------------- enable switch

@@ -35,16 +35,190 @@ Scatter safety: invalid writes (idle-lane sentinel position, unallocated
 slot) are routed to flat index ``n_pages * page_size`` — one past the pool —
 and dropped by ``mode="drop"``, mirroring the dense path's out-of-range
 sentinel convention (models/common.py update_kv_cache).
+
+Quantized pools (``--kv_quant_type int8|nf4a``): the pool may instead be a
+``PagedPool`` — per-row quantized codes plus a sibling f32 absmax-scale
+array, carried together as one pytree that stands in wherever a plain pool
+array rides (scan xs, donation, MemoryCache buffers, swap entries). Every
+write path quantizes rows on the way in (per-(token, kv-head) absmax over
+the head dim) and every read path — the fused kernel's tile loop
+(ops/paged_flash_attention.py) or the XLA ``gather_pages`` twin here —
+dequantizes on the way out, so decode/mixed/spec-verify steps never touch
+an fp pool. int8 stores one byte per element; nf4a packs two 4-bit codes
+per byte in SPLIT-HALF order (byte j holds dims j and j + d/2, so the
+decoded halves concatenate along the head dim with no interleave
+relayout), reusing the NF4A cubic code map of ops/quant.py. Unallocated
+slots gather with ZERO scales, so holes still read as exact zeros.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np
 
 from petals_tpu.ops.attention import attend_reference
+from petals_tpu.ops.quant import NF4A_A, NF4A_B, NF4A_CODE
+
+KV_QUANT_KINDS = ("none", "int8", "nf4a")
+
+
+class PagedPool(NamedTuple):
+    """A quantized page pool: per-row codes plus their absmax scales.
+
+    ``codes`` is int8 ``[..., n_pages, page_size, hkv, d]`` (kind "int8") or
+    uint8 ``[..., n_pages, page_size, hkv, d // 2]`` with two split-half
+    codes per byte (kind "nf4a"); ``scales`` is float32
+    ``[..., n_pages, page_size, hkv]`` — one scale per (token row, kv head).
+    A NamedTuple, so it is a JAX pytree: it rides scan xs / donation /
+    MemoryCache buffers wherever a plain pool array does, and its ``shape``/
+    ``dtype`` properties answer the LOGICAL (dequantized) geometry so shape-
+    reading call sites (step programs, kernel dispatch) stay unchanged."""
+
+    codes: jnp.ndarray
+    scales: jnp.ndarray
+
+    @property
+    def kind(self) -> str:
+        return "int8" if np.dtype(self.codes.dtype) == np.int8 else "nf4a"
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        """Logical (dequantized) shape: the packed nf4a byte axis doubles."""
+        d = self.codes.shape[-1]
+        if np.dtype(self.codes.dtype) == np.uint8:
+            d *= 2
+        return (*self.codes.shape[:-1], d)
+
+    @property
+    def ndim(self) -> int:
+        return self.codes.ndim
+
+    @property
+    def dtype(self):
+        """Logical dtype: rows dequantize to bf16 (the compute dtype of the
+        quantized-pool path; ``hidden.astype(k_pool.dtype)`` in the step
+        programs must see a float type, never the storage int type)."""
+        return jnp.bfloat16
+
+    @property
+    def nbytes(self) -> int:
+        """WIRE bytes — what swap/migration accounting bills."""
+        return int(self.codes.nbytes) + int(self.scales.nbytes)
+
+    def is_deleted(self) -> bool:
+        return self.codes.is_deleted() or self.scales.is_deleted()
+
+
+#: a pool operand: the plain fp array or its quantized stand-in
+PoolLike = Union[jnp.ndarray, PagedPool]
+
+
+def kv_quant_kind_of(pool) -> str:
+    """"none" for a plain array pool, else the PagedPool's quant kind."""
+    return pool.kind if isinstance(pool, PagedPool) else "none"
+
+
+def kv_wire_bytes_per_token(hkv: int, d: int, kind: str, fp_itemsize: int = 2) -> int:
+    """Stored bytes per token row for ONE side (k or v) of ONE block."""
+    if kind == "int8":
+        return hkv * (d + 4)  # 1 byte/elem + f32 scale per (row, head)
+    if kind == "nf4a":
+        return hkv * (d // 2 + 4)  # packed nibbles + f32 scale
+    return hkv * d * fp_itemsize
+
+
+# --------------------------------------------------------------- quant codec
+
+
+def quantize_kv_rows(rows: jnp.ndarray, kind: str):
+    """Encode rows ``[..., d]`` -> ``(codes [..., d_store], scales [...])``
+    with a per-row absmax scale over the last (head-dim) axis.
+
+    int8: symmetric, ``scale = absmax / 127`` (ops/quant.py _encode_int8's
+    convention at row granularity). nf4a: the stored scale IS the absmax and
+    codes index the cubic NF4A code map via midpoint counting — 15 fused
+    compare+adds, the same O(1)-memory encode as ops/quant.py _encode_4bit —
+    then split-half packed (byte j = dims j | (j + d/2) << 4)."""
+    if kind not in ("int8", "nf4a"):
+        raise ValueError(f"kv quant kind must be int8|nf4a, got {kind!r}")
+    rows_f = rows.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(rows_f), axis=-1)
+    if kind == "int8":
+        scale = jnp.maximum(absmax, 1e-8) / 127.0
+        codes = jnp.clip(jnp.round(rows_f / scale[..., None]), -127, 127)
+        return codes.astype(jnp.int8), scale
+    scale = absmax
+    normed = rows_f / jnp.maximum(absmax, 1e-8)[..., None]
+    midpoints = (NF4A_CODE[:-1] + NF4A_CODE[1:]) / 2.0
+    codes = jnp.zeros(normed.shape, jnp.uint8)
+    for m in midpoints.tolist():
+        codes += (normed > m).astype(jnp.uint8)
+    half = rows.shape[-1] // 2
+    return (codes[..., :half] | (codes[..., half:] << 4)).astype(jnp.uint8), scale
+
+
+def dequantize_kv(codes: jnp.ndarray, scales: jnp.ndarray, kind: str,
+                  dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Decode ``(codes [..., d_store], scales [...])`` back to rows
+    ``[..., d]``. nf4a decodes arithmetically (the gather-free cubic map:
+    ``v = scale * (A*dl + B*dl^3)``, ``dl = code - 7.5``) and un-packs the
+    split halves by concatenation along the head dim. A ZERO scale decodes
+    every element to exactly 0.0 — unallocated slots and never-written rows
+    (zero-init pools) stay exact zeros through the round trip."""
+    sf = scales[..., None].astype(jnp.float32)
+    if kind == "int8":
+        return (codes.astype(jnp.float32) * sf).astype(dtype)
+    if kind != "nf4a":
+        raise ValueError(f"kv quant kind must be int8|nf4a, got {kind!r}")
+    c = codes.astype(jnp.int32)
+
+    def poly(p):
+        dl = p.astype(jnp.float32) - 7.5
+        return dl * (NF4A_A + NF4A_B * dl * dl)
+
+    vals = jnp.concatenate([poly(c & 0x0F), poly((c >> 4) & 0x0F)], axis=-1)
+    return (vals * sf).astype(dtype)
+
+
+def quantize_kv_rows_np(rows: np.ndarray, kind: str):
+    """Numpy twin of ``quantize_kv_rows`` for host-side work (migration wire
+    packing). Same math, same bit layout."""
+    rows_f = np.asarray(rows, np.float32)
+    absmax = np.max(np.abs(rows_f), axis=-1)
+    if kind == "int8":
+        scale = np.maximum(absmax, 1e-8) / 127.0
+        codes = np.clip(np.round(rows_f / scale[..., None]), -127, 127)
+        return codes.astype(np.int8), scale.astype(np.float32)
+    if kind != "nf4a":
+        raise ValueError(f"kv quant kind must be int8|nf4a, got {kind!r}")
+    scale = absmax.astype(np.float32)
+    normed = rows_f / np.maximum(absmax, 1e-8)[..., None]
+    midpoints = (NF4A_CODE[:-1] + NF4A_CODE[1:]) / 2.0
+    codes = np.zeros(normed.shape, np.uint8)
+    for m in midpoints:
+        codes += (normed > m).astype(np.uint8)
+    half = rows.shape[-1] // 2
+    return (codes[..., :half] | (codes[..., half:] << 4)).astype(np.uint8), scale
+
+
+def dequantize_kv_np(codes: np.ndarray, scales: np.ndarray, kind: str,
+                     dtype=np.float32) -> np.ndarray:
+    """Numpy twin of ``dequantize_kv`` (swap-entry assembly, kv adopt)."""
+    sf = np.asarray(scales, np.float32)[..., None]
+    if kind == "int8":
+        return (np.asarray(codes, np.float32) * sf).astype(dtype)
+    if kind != "nf4a":
+        raise ValueError(f"kv quant kind must be int8|nf4a, got {kind!r}")
+    c = np.asarray(codes).astype(np.int32)
+
+    def poly(p):
+        dl = p.astype(np.float32) - 7.5
+        return dl * (NF4A_A + NF4A_B * dl * dl)
+
+    vals = np.concatenate([poly(c & 0x0F), poly((c >> 4) & 0x0F)], axis=-1)
+    return (vals * sf).astype(dtype)
 
 
 class PagedKV(NamedTuple):
@@ -55,8 +229,12 @@ class PagedKV(NamedTuple):
     isinstance and route to the paged scatter / fused-kernel dispatch instead
     of the dense buffer code."""
 
-    pool: jnp.ndarray  # [n_pages, page_size, hkv, d]
+    pool: PoolLike  # [n_pages, page_size, hkv, d] array, or a PagedPool
     tables: jnp.ndarray  # [n_lanes, max_pages] int32; -1 = unallocated slot
+
+    @property
+    def quant_kind(self) -> str:
+        return kv_quant_kind_of(self.pool)
 
     @property
     def page_size(self) -> int:
@@ -100,7 +278,21 @@ def tables_are_contiguous(tables: np.ndarray, n_pages: int) -> bool:
     return bool(np.all((tables == ident) | (tables < 0)))
 
 
-def gather_pages(pool: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
+def _gather_pages_arr(pool: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
+    """gather_pages over ONE array (any trailing rank — works for a value
+    pool [n_pages, ps, hkv, d], a codes pool [n_pages, ps, hkv, d_store],
+    and a scales pool [n_pages, ps, hkv])."""
+    n_pages, page_size = pool.shape[0], pool.shape[1]
+    n_lanes, max_pages = tables.shape
+    flat = tables.reshape(-1)
+    safe = jnp.clip(flat, 0, n_pages - 1)
+    pages = jnp.take(pool, safe, axis=0)  # [n_lanes*max_pages, ps, *rest]
+    hole_mask = (flat >= 0).reshape(-1, *([1] * (pool.ndim - 1)))
+    pages = jnp.where(hole_mask, pages, jnp.zeros((), pool.dtype))
+    return pages.reshape(n_lanes, max_pages * page_size, *pool.shape[2:])
+
+
+def gather_pages(pool: PoolLike, tables: jnp.ndarray) -> jnp.ndarray:
     """Materialize the dense per-lane view of one block's page pool.
 
     pool [n_pages, page_size, hkv, d] + tables [n_lanes, max_pages] ->
@@ -109,25 +301,57 @@ def gather_pages(pool: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
     not own that page (attention masks them to 0.0 weight either way, but
     the dense view escapes attention — kv export, debug dumps — so the
     fallback path must never alias another tenant's content). The fused
-    kernel skips -1 slots entirely, so both paths agree bit-for-bit."""
+    kernel skips -1 slots entirely, so both paths agree bit-for-bit.
+
+    A quantized ``PagedPool`` gathers codes AND scales (holes zero both, so
+    a -1 slot dequantizes to exact zeros) and returns the dense bf16 view —
+    the bit-compatible XLA twin of the kernel's in-tile dequant."""
+    if isinstance(pool, PagedPool):
+        codes = _gather_pages_arr(pool.codes, tables)
+        scales = _gather_pages_arr(pool.scales, tables)
+        return dequantize_kv(codes, scales, pool.kind, pool.dtype)
+    return _gather_pages_arr(pool, tables)
+
+
+def _flat_scatter(pool: jnp.ndarray, rows: jnp.ndarray, flat_idx: jnp.ndarray) -> jnp.ndarray:
+    """Scatter ``rows [n, *rest]`` into ``pool [n_pages, ps, *rest]`` at flat
+    (page*ps + slot) indices; index ``n_pages*ps`` is one-past-the-end and
+    drops. Rank-generic: serves value pools, codes pools, and scales pools."""
     n_pages, page_size = pool.shape[0], pool.shape[1]
-    n_lanes, max_pages = tables.shape
-    flat = tables.reshape(-1)
-    safe = jnp.clip(flat, 0, n_pages - 1)
-    pages = jnp.take(pool, safe, axis=0)  # [n_lanes*max_pages, ps, hkv, d]
-    pages = jnp.where((flat >= 0)[:, None, None, None], pages, jnp.zeros((), pool.dtype))
-    return pages.reshape(n_lanes, max_pages * page_size, *pool.shape[2:])
+    flat = pool.reshape(n_pages * page_size, *pool.shape[2:])
+    flat = flat.at[flat_idx].set(rows.astype(pool.dtype), mode="drop")
+    return flat.reshape(pool.shape)
+
+
+def _scatter_rows(pool: PoolLike, rows: jnp.ndarray, flat_idx: jnp.ndarray) -> PoolLike:
+    """Row scatter, quantizing on the way in when the pool is a PagedPool:
+    rows [n, hkv, d] encode to (codes [n, hkv, d_store], scales [n, hkv])
+    and both leaves scatter at the same flat indices."""
+    if isinstance(pool, PagedPool):
+        codes, scales = quantize_kv_rows(rows, pool.kind)
+        return PagedPool(
+            _flat_scatter(pool.codes, codes, flat_idx),
+            _flat_scatter(pool.scales, scales, flat_idx),
+        )
+    return _flat_scatter(pool, rows, flat_idx)
+
+
+def _pool_geometry(pool: PoolLike) -> Tuple[int, int]:
+    """(n_pages, page_size) — identical for plain and quantized pools."""
+    return pool.shape[0], pool.shape[1]
 
 
 def scatter_token_rows(
-    pool: jnp.ndarray, rows: jnp.ndarray, tables: jnp.ndarray, positions: jnp.ndarray
-) -> jnp.ndarray:
+    pool: PoolLike, rows: jnp.ndarray, tables: jnp.ndarray, positions: jnp.ndarray
+) -> PoolLike:
     """Write each lane's freshly computed token row into its page.
 
     pool [n_pages, ps, hkv, d]; rows [n_lanes, hkv, d]; positions [n_lanes]
     (idle sentinel = max_length). Invalid lanes (sentinel position or
-    unallocated slot) route to the one-past-the-end flat index and drop."""
-    n_pages, page_size = pool.shape[0], pool.shape[1]
+    unallocated slot) route to the one-past-the-end flat index and drop.
+    Quantized pools encode each row (per-(lane, head) absmax) before the
+    scatter — the pool never holds fp rows."""
+    n_pages, page_size = _pool_geometry(pool)
     max_pages = tables.shape[1]
     slot = positions // page_size
     in_range = (positions >= 0) & (slot < max_pages)
@@ -135,14 +359,12 @@ def scatter_token_rows(
     page = jnp.take_along_axis(tables, slot_c[:, None], axis=1)[:, 0]
     valid = in_range & (page >= 0)
     flat_idx = jnp.where(valid, page * page_size + positions % page_size, n_pages * page_size)
-    flat = pool.reshape(n_pages * page_size, *pool.shape[2:])
-    flat = flat.at[flat_idx].set(rows.astype(pool.dtype), mode="drop")
-    return flat.reshape(pool.shape)
+    return _scatter_rows(pool, rows, flat_idx)
 
 
 def scatter_chunk_rows(
-    pool: jnp.ndarray, rows: jnp.ndarray, table_row: jnp.ndarray, positions: jnp.ndarray
-) -> jnp.ndarray:
+    pool: PoolLike, rows: jnp.ndarray, table_row: jnp.ndarray, positions: jnp.ndarray
+) -> PoolLike:
     """Write a prefill chunk's freshly computed KV rows into ONE lane's pages.
 
     pool [n_pages, ps, hkv, d]; rows [chunk, hkv, d]; table_row [max_pages];
@@ -150,7 +372,7 @@ def scatter_chunk_rows(
     idle sentinel >= max_pages*ps). Invalid rows (sentinel position or
     unallocated slot) route to the one-past-the-end flat index and drop —
     the same convention as scatter_token_rows, just many rows into one lane."""
-    n_pages, page_size = pool.shape[0], pool.shape[1]
+    n_pages, page_size = _pool_geometry(pool)
     max_pages = table_row.shape[0]
     slot = positions // page_size
     in_range = (positions >= 0) & (slot < max_pages)
@@ -158,14 +380,12 @@ def scatter_chunk_rows(
     page = jnp.take(table_row, slot_c)
     valid = in_range & (page >= 0)
     flat_idx = jnp.where(valid, page * page_size + positions % page_size, n_pages * page_size)
-    flat = pool.reshape(n_pages * page_size, *pool.shape[2:])
-    flat = flat.at[flat_idx].set(rows.astype(pool.dtype), mode="drop")
-    return flat.reshape(pool.shape)
+    return _scatter_rows(pool, rows, flat_idx)
 
 
 def scatter_lane_chunk_rows(
-    pool: jnp.ndarray, rows: jnp.ndarray, tables: jnp.ndarray, positions: jnp.ndarray
-) -> jnp.ndarray:
+    pool: PoolLike, rows: jnp.ndarray, tables: jnp.ndarray, positions: jnp.ndarray
+) -> PoolLike:
     """Write a short run of freshly computed rows into EVERY lane's pages at
     once — the speculative-verify write shape: each lane lands ``seq``
     candidate rows starting at its own position.
@@ -175,7 +395,7 @@ def scatter_lane_chunk_rows(
     max_length drops ALL of that lane's rows, since every offset lands past
     the table). Invalid rows route to the one-past-the-end flat index and
     drop — scatter_chunk_rows batched over lanes."""
-    n_pages, page_size = pool.shape[0], pool.shape[1]
+    n_pages, page_size = _pool_geometry(pool)
     n_lanes, max_pages = tables.shape
     seq = rows.shape[1]
     pos = positions[:, None] + jnp.arange(seq, dtype=jnp.int32)[None, :]  # [n_lanes, seq]
@@ -185,23 +405,30 @@ def scatter_lane_chunk_rows(
     page = jnp.take_along_axis(tables, slot_c, axis=1)  # [n_lanes, seq]
     valid = in_range & (page >= 0)
     flat_idx = jnp.where(valid, page * page_size + pos % page_size, n_pages * page_size)
-    flat = pool.reshape(n_pages * page_size, *pool.shape[2:])
-    flat = flat.at[flat_idx.reshape(-1)].set(
-        rows.reshape(n_lanes * seq, *rows.shape[2:]).astype(pool.dtype), mode="drop"
+    return _scatter_rows(
+        pool, rows.reshape(n_lanes * seq, *rows.shape[2:]), flat_idx.reshape(-1)
     )
-    return flat.reshape(pool.shape)
 
 
 def scatter_lane_pages(
-    pool: jnp.ndarray, lane_pages: jnp.ndarray, table_row: jnp.ndarray
-) -> jnp.ndarray:
+    pool: PoolLike, lane_pages: jnp.ndarray, table_row: jnp.ndarray
+) -> PoolLike:
     """Write a whole lane-shaped buffer back into its pages (the exclusive-op
     check-in: prefill chunks, prefix seeding). lane_pages [max_pages, ps,
     hkv, d]; unallocated slots (-1) drop. Shared (copy-on-write) pages in
     the row receive exactly the bytes that were gathered out of them — the
-    write range itself was made exclusive by prepare_write first."""
+    write range itself was made exclusive by prepare_write first. (On a
+    quantized pool the check-in REQUANTIZES the dequantized buffer; rows the
+    exclusive op didn't touch round-trip within one quant step, which the
+    kv_quant fingerprint band absorbs.)"""
     n_pages = pool.shape[0]
     safe = jnp.where(table_row >= 0, table_row, n_pages)
+    if isinstance(pool, PagedPool):
+        codes, scales = quantize_kv_rows(lane_pages, pool.kind)
+        return PagedPool(
+            pool.codes.at[safe].set(codes.astype(pool.codes.dtype), mode="drop"),
+            pool.scales.at[safe].set(scales.astype(pool.scales.dtype), mode="drop"),
+        )
     return pool.at[safe].set(lane_pages.astype(pool.dtype), mode="drop")
 
 

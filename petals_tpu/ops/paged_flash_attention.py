@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from petals_tpu.ops.quant import NF4A_A, NF4A_B
 from petals_tpu.telemetry.observatory import tracked_jit
 
 # jax<0.5 names this TPUCompilerParams; alias locally, never patch jax
@@ -77,14 +78,16 @@ def _platform() -> str:
 
 def shape_class(
     n_lanes: int, max_pages: int, page_size: int, hkv: int, d: int,
-    window: Optional[int],
+    window: Optional[int], kv_quant: str = "none",
 ) -> Tuple:
     """The autotune key: every quantity the kernel's tiling/skip behaviour
     depends on. A traced (non-int) window is keyed as None — such calls are
-    forced to the XLA path anyway (gemma2)."""
+    forced to the XLA path anyway (gemma2). ``kv_quant`` joins the key: the
+    quantized tile (in-VMEM dequant, f32 dots) has a different cost profile
+    than the bf16 tile, so each pool encoding autotunes separately."""
     return (
         int(n_lanes), int(max_pages), int(page_size), int(hkv), int(d),
-        window if isinstance(window, int) else None,
+        window if isinstance(window, int) else None, str(kv_quant),
     )
 
 
@@ -120,6 +123,83 @@ def reset_paged_autotune() -> None:
 
 
 # ---------------------------------------------------------------------------
+# in-tile dequant: quantized pages expand to f32 in VMEM right after the DMA
+# ---------------------------------------------------------------------------
+#
+# The scale factoring keeps the per-element dequant work near zero: scores
+# are computed against the RAW codes and the per-row kv scale multiplies the
+# [*, page_size] score matrix afterwards (one mul per score, not per
+# element); on the value side the scale folds into the softmax weights
+# BEFORE the pv dot. nf4a pages are split-half packed (byte j = dims j and
+# j + d/2), so K decodes as two half-width dots against the query halves and
+# V as two half-width pv dots concatenated along the head dim — no lane-axis
+# interleave relayout, which Mosaic would refuse. Mosaic constraints honored
+# throughout: uint8 widens to int32 before nibble ops (no 8-bit shifts), and
+# everything runs in f32 — quant.py's decode kernels measured bf16
+# elementwise at ~2x f32 on the VPU, so f32 dots win once dequant is fused.
+
+
+def _nf4a_poly(codes_f32):
+    """codes (0..15, f32) -> UNSCALED cubic code values; the caller folds
+    ``scale * NF4A_B`` in at score/weight granularity."""
+    dl = codes_f32 - 7.5
+    kk = jnp.float32(NF4A_A / NF4A_B)
+    return dl * (kk + dl * dl)
+
+
+def _quant_k_scores(q, k_raw, ks_row, kv_quant, head_dim):
+    """Scores against a quantized K page. q [m, head_dim] (any float dtype),
+    k_raw [page_size, d_store] raw codes, ks_row [1, page_size] f32 per-row
+    scales -> s [m, page_size] f32 with the kv scales folded in (attention
+    scale NOT applied)."""
+    qf = q.astype(jnp.float32)
+    if kv_quant == "int8":
+        kc = k_raw.astype(jnp.int32).astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qf, kc, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return s * ks_row
+    c = k_raw.astype(jnp.int32)
+    p_lo = _nf4a_poly((c & 0x0F).astype(jnp.float32))
+    p_hi = _nf4a_poly(((c >> 4) & 0x0F).astype(jnp.float32))
+    half = head_dim // 2
+    s = jax.lax.dot_general(
+        qf[:, :half], p_lo, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s + jax.lax.dot_general(
+        qf[:, half:], p_hi, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return s * (ks_row * jnp.float32(NF4A_B))
+
+
+def _quant_pv(p, v_raw, vs_row, kv_quant, head_dim):
+    """Weighted-value accumulation against a quantized V page. p
+    [m, page_size] f32 softmax weights, v_raw [page_size, d_store] raw
+    codes, vs_row [1, page_size] f32 -> pv [m, head_dim] f32."""
+    if kv_quant == "int8":
+        vc = v_raw.astype(jnp.int32).astype(jnp.float32)
+        return jax.lax.dot_general(
+            p * vs_row, vc, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+    c = v_raw.astype(jnp.int32)
+    p_lo = _nf4a_poly((c & 0x0F).astype(jnp.float32))
+    p_hi = _nf4a_poly(((c >> 4) & 0x0F).astype(jnp.float32))
+    ps_ = p * (vs_row * jnp.float32(NF4A_B))
+    pv_lo = jax.lax.dot_general(
+        ps_, p_lo, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    pv_hi = jax.lax.dot_general(
+        ps_, p_hi, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return jnp.concatenate([pv_lo, pv_hi], axis=1)
+
+
+def _kv_store_dim(head_dim: int, kv_quant: str) -> int:
+    """Last-axis extent of the stored codes: nf4a packs two dims per byte."""
+    return head_dim // 2 if kv_quant == "nf4a" else head_dim
+
+
+# ---------------------------------------------------------------------------
 # decode kernel: grid (n_lanes, hkv, max_pages), one token row per lane
 # ---------------------------------------------------------------------------
 
@@ -141,18 +221,14 @@ def _decode_kernel(
     # scalar prefetch
     tables_ref,  # int32[n_lanes, max_pages]
     kv_lens_ref,  # int32[n_lanes]
-    # inputs
-    q_ref,  # [1, 1, group, head_dim]
-    k_ref,  # [1, page_size, 1, head_dim] — one page of the pool
-    v_ref,  # [1, page_size, 1, head_dim]
-    slopes_ref,  # [1, group] f32
-    # outputs
-    o_ref,  # [1, 1, group, head_dim]
-    # scratch
-    m_scratch,  # [group, LANES] f32
-    l_scratch,  # [group, LANES] f32
-    acc_scratch,  # [group, head_dim] f32
-    *,
+    # then, positionally: inputs / outputs / scratch —
+    #   q_ref [1, 1, group, head_dim];
+    #   k_ref [1, page_size, 1, d_store] (one page; raw codes if quantized);
+    #   ks_ref [1, page_size, 1] f32 (quantized pools only);
+    #   v_ref / vs_ref likewise; slopes_ref [1, group] f32;
+    #   o_ref [1, 1, group, head_dim];
+    #   m/l_scratch [group, LANES] f32, acc_scratch [group, head_dim] f32
+    *refs,
     scale: float,
     page_size: int,
     max_pages: int,
@@ -160,7 +236,14 @@ def _decode_kernel(
     head_dim: int,
     use_alibi: bool,
     sliding_window: Optional[int] = None,
+    kv_quant: str = "none",
 ):
+    if kv_quant == "none":
+        q_ref, k_ref, v_ref, slopes_ref, o_ref, m_scratch, l_scratch, acc_scratch = refs
+        ks_ref = vs_ref = None
+    else:
+        (q_ref, k_ref, ks_ref, v_ref, vs_ref, slopes_ref, o_ref,
+         m_scratch, l_scratch, acc_scratch) = refs
     i = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -184,12 +267,15 @@ def _decode_kernel(
 
     def _tile(masked: bool):
         q = q_ref[...].reshape(group, head_dim)
-        k = k_ref[...].reshape(page_size, head_dim)
-        v = v_ref[...].reshape(page_size, head_dim)
-
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [group, page_size] f32
+        if kv_quant == "none":
+            k = k_ref[...].reshape(page_size, head_dim)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )  # [group, page_size] f32
+        else:
+            k_raw = k_ref[...].reshape(page_size, -1)
+            ks_row = ks_ref[...].reshape(1, page_size)
+            s = _quant_k_scores(q, k_raw, ks_row, kv_quant, head_dim)
         s = s * scale
 
         kv_pos_row = slot_start + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
@@ -219,10 +305,16 @@ def _decode_kernel(
         l_new = alpha * l_prev[:, :1] + jnp.sum(p, axis=1, keepdims=True)
 
         acc = acc_scratch[...]
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        if kv_quant == "none":
+            v = v_ref[...].reshape(page_size, head_dim)
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            v_raw = v_ref[...].reshape(page_size, -1)
+            vs_row = vs_ref[...].reshape(1, page_size)
+            pv = _quant_pv(p, v_raw, vs_row, kv_quant, head_dim)
         acc_scratch[...] = acc * alpha + pv
 
         m_scratch[...] = m_new
@@ -265,9 +357,20 @@ def paged_flash_attend(
     [n_pages, page_size, hkv, d]; tables [n_lanes, max_pages] int32 (-1 =
     unallocated, skipped — never fetched); positions [n_lanes] int32 (ragged
     kv_length = position + 1; idle sentinel lanes produce finite garbage that
-    the caller never reads, exactly like the reference)."""
+    the caller never reads, exactly like the reference).
+
+    Quantized pools (``PagedPool``) ride as codes + per-row-scale operands;
+    the tile loop dequantizes in VMEM right after the DMA (see the in-tile
+    dequant helpers above) — the HBM side only ever moves wire bytes."""
+    from petals_tpu.ops.paged_attention import PagedPool
+
+    quantized = isinstance(k_pool, PagedPool)
+    kv_quant = k_pool.kind if quantized else "none"
     n_lanes, q_len, num_q_heads, head_dim = q.shape
-    n_pages, page_size, num_kv_heads, _ = k_pool.shape
+    if quantized:
+        n_pages, page_size, num_kv_heads, d_store = k_pool.codes.shape
+    else:
+        n_pages, page_size, num_kv_heads, d_store = k_pool.shape
     if q_len != 1:
         raise ValueError(f"decode kernel takes one token per lane, got q_len={q_len}")
     assert num_q_heads % num_kv_heads == 0, (num_q_heads, num_kv_heads)
@@ -301,6 +404,7 @@ def paged_flash_attend(
         head_dim=head_dim,
         use_alibi=use_alibi,
         sliding_window=sliding_window,
+        kv_quant=kv_quant,
     )
 
     def kv_index_map(i, h, j, tables_ref, kv_lens_ref):
@@ -311,15 +415,33 @@ def paged_flash_attend(
         )
         return (jax.lax.select(needed, page, 0), 0, h, 0)
 
+    def kv_scale_index_map(i, h, j, tables_ref, kv_lens_ref):
+        # scales pool [n_pages, page_size, hkv]: same redirect, one axis fewer
+        page = tables_ref[i, j]
+        needed = _decode_page_needed(
+            page, j * page_size, kv_lens_ref[i], page_size, sliding_window
+        )
+        return (jax.lax.select(needed, page, 0), 0, h)
+
+    kv_spec = pl.BlockSpec((1, page_size, 1, d_store), kv_index_map)
+    in_specs = [
+        pl.BlockSpec((1, 1, group, head_dim), lambda i, h, j, *pf: (i, h, 0, 0)),
+    ]
+    operands = [q4]
+    if quantized:
+        scale_spec = pl.BlockSpec((1, page_size, 1), kv_scale_index_map)
+        in_specs += [kv_spec, scale_spec, kv_spec, scale_spec]
+        operands += [k_pool.codes, k_pool.scales, v_pool.codes, v_pool.scales]
+    else:
+        in_specs += [kv_spec, kv_spec]
+        operands += [k_pool, v_pool]
+    in_specs.append(pl.BlockSpec((1, group), lambda i, h, j, *pf: (h, 0)))
+    operands.append(slopes)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, group, head_dim), lambda i, h, j, *pf: (i, h, 0, 0)),
-            pl.BlockSpec((1, page_size, 1, head_dim), kv_index_map),
-            pl.BlockSpec((1, page_size, 1, head_dim), kv_index_map),
-            pl.BlockSpec((1, group), lambda i, h, j, *pf: (h, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, group, head_dim), lambda i, h, j, *pf: (i, h, 0, 0)
         ),
@@ -338,7 +460,7 @@ def paged_flash_attend(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(tables_arr, kv_lens, q4, k_pool, v_pool, slopes)
+    )(tables_arr, kv_lens, *operands)
 
     return out.reshape(n_lanes, 1, num_q_heads, head_dim)
 
@@ -368,17 +490,13 @@ def _prefill_kernel(
     table_row_ref,  # int32[max_pages]
     info_ref,  # int32[2] = (chunk_pos, kv_len)
     slopes_ref,  # float32[num_q_heads]
-    # inputs
-    q_ref,  # [1, block_q, head_dim]
-    k_ref,  # [1, page_size, 1, head_dim]
-    v_ref,  # [1, page_size, 1, head_dim]
-    # outputs
-    o_ref,  # [1, block_q, head_dim]
-    # scratch
-    m_scratch,  # [block_q, LANES] f32
-    l_scratch,  # [block_q, LANES] f32
-    acc_scratch,  # [block_q, head_dim] f32
-    *,
+    # then, positionally: inputs / outputs / scratch —
+    #   q_ref [1, block_q, head_dim];
+    #   k_ref [1, page_size, 1, d_store] (raw codes if quantized);
+    #   ks_ref [1, page_size, 1] f32 (quantized pools only);
+    #   v_ref / vs_ref likewise; o_ref [1, block_q, head_dim];
+    #   m/l_scratch [block_q, LANES] f32, acc_scratch [block_q, head_dim] f32
+    *refs,
     scale: float,
     block_q: int,
     page_size: int,
@@ -386,7 +504,14 @@ def _prefill_kernel(
     head_dim: int,
     use_alibi: bool,
     sliding_window: Optional[int] = None,
+    kv_quant: str = "none",
 ):
+    if kv_quant == "none":
+        q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch = refs
+        ks_ref = vs_ref = None
+    else:
+        (q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
+         m_scratch, l_scratch, acc_scratch) = refs
     h = pl.program_id(0)
     qi = pl.program_id(1)
     j = pl.program_id(2)
@@ -415,12 +540,15 @@ def _prefill_kernel(
 
     def _tile(masked: bool):
         q = q_ref[...].reshape(block_q, head_dim)
-        k = k_ref[...].reshape(page_size, head_dim)
-        v = v_ref[...].reshape(page_size, head_dim)
-
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [block_q, page_size]
+        if kv_quant == "none":
+            k = k_ref[...].reshape(page_size, head_dim)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )  # [block_q, page_size]
+        else:
+            k_raw = k_ref[...].reshape(page_size, -1)
+            ks_row = ks_ref[...].reshape(1, page_size)
+            s = _quant_k_scores(q, k_raw, ks_row, kv_quant, head_dim)
         s = s * scale
 
         kv_pos_row = slot_start + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
@@ -452,10 +580,16 @@ def _prefill_kernel(
         l_new = alpha * l_prev[:, :1] + jnp.sum(p, axis=1, keepdims=True)
 
         acc = acc_scratch[...]
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        if kv_quant == "none":
+            v = v_ref[...].reshape(page_size, head_dim)
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            v_raw = v_ref[...].reshape(page_size, -1)
+            vs_row = vs_ref[...].reshape(1, page_size)
+            pv = _quant_pv(p, v_raw, vs_row, kv_quant, head_dim)
         acc_scratch[...] = acc * alpha + pv
 
         m_scratch[...] = m_new
@@ -500,9 +634,17 @@ def paged_flash_prefill_attend(
     to a bucket); table_row [max_pages] int32; chunk_pos scalar int32
     (absolute position of the chunk's first token); n_valid scalar int32
     (padded-tail rows produce garbage-but-unread outputs, as in the
-    reference). The chunk's KV must already be scattered into the pages."""
+    reference). The chunk's KV must already be scattered into the pages.
+    Quantized pools ride as codes + scales, exactly as in the decode twin."""
+    from petals_tpu.ops.paged_attention import PagedPool
+
+    quantized = isinstance(k_pool, PagedPool)
+    kv_quant = k_pool.kind if quantized else "none"
     batch, q_len, num_q_heads, head_dim = q.shape
-    n_pages, page_size, num_kv_heads, _ = k_pool.shape
+    if quantized:
+        n_pages, page_size, num_kv_heads, d_store = k_pool.codes.shape
+    else:
+        n_pages, page_size, num_kv_heads, d_store = k_pool.shape
     if batch != 1:
         raise ValueError(f"prefill kernel serves one lane's chunk, got batch={batch}")
     assert num_q_heads % num_kv_heads == 0, (num_q_heads, num_kv_heads)
@@ -544,6 +686,7 @@ def paged_flash_prefill_attend(
         head_dim=head_dim,
         use_alibi=use_alibi,
         sliding_window=sliding_window,
+        kv_quant=kv_quant,
     )
 
     def kv_index_map(h, qi, j, table_row_ref, info_ref, slopes_ref):
@@ -554,14 +697,31 @@ def paged_flash_prefill_attend(
         )
         return (jax.lax.select(needed, page, 0), 0, h // group, 0)
 
+    def kv_scale_index_map(h, qi, j, table_row_ref, info_ref, slopes_ref):
+        page = table_row_ref[j]
+        needed = _prefill_page_needed(
+            page, info_ref[0] + qi * block_q, block_q,
+            j * page_size, info_ref[1], page_size, sliding_window,
+        )
+        return (jax.lax.select(needed, page, 0), 0, h // group)
+
+    kv_spec = pl.BlockSpec((1, page_size, 1, d_store), kv_index_map)
+    in_specs = [
+        pl.BlockSpec((1, block_q, head_dim), lambda h, qi, j, *pf: (h, qi, 0)),
+    ]
+    operands = [qt]
+    if quantized:
+        scale_spec = pl.BlockSpec((1, page_size, 1), kv_scale_index_map)
+        in_specs += [kv_spec, scale_spec, kv_spec, scale_spec]
+        operands += [k_pool.codes, k_pool.scales, v_pool.codes, v_pool.scales]
+    else:
+        in_specs += [kv_spec, kv_spec]
+        operands += [k_pool, v_pool]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda h, qi, j, *pf: (h, qi, 0)),
-            pl.BlockSpec((1, page_size, 1, head_dim), kv_index_map),
-            pl.BlockSpec((1, page_size, 1, head_dim), kv_index_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, block_q, head_dim), lambda h, qi, j, *pf: (h, qi, 0)
         ),
@@ -580,7 +740,7 @@ def paged_flash_prefill_attend(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(table_arr, info, slopes, qt, k_pool, v_pool)
+    )(table_arr, info, slopes, *operands)
 
     out = out.transpose(1, 0, 2)[None]
     if q_pad:
@@ -616,10 +776,11 @@ def paged_attend_dispatch(
     non-causal — always compose from XLA, with identical math to the old
     gather/attend sandwich."""
     from petals_tpu.ops.attention import attend_reference
-    from petals_tpu.ops.paged_attention import gather_pages
+    from petals_tpu.ops.paged_attention import gather_pages, kv_quant_kind_of
 
     k_pool, tables = k_kv.pool, k_kv.tables
     v_pool = v_kv.pool
+    kv_quant = kv_quant_kind_of(k_pool)
     pos = jnp.asarray(q_offset, jnp.int32)
     decode = pos.ndim == 1
 
@@ -635,10 +796,11 @@ def paged_attend_dispatch(
         # handles vector q_offset with q_len > 1 via per-row causal masking).
         or (decode and q.shape[1] != 1)
     )
+    # k_pool.shape is the LOGICAL geometry either way (PagedPool answers it)
     key = shape_class(
         tables.shape[0], tables.shape[1], k_pool.shape[1],
         k_pool.shape[2], k_pool.shape[3],
-        sliding_window if window_static else None,
+        sliding_window if window_static else None, kv_quant,
     )
     kind = "decode" if decode else "prefill"
     if not forced_xla and decide_paged_kernel(kind, key):
@@ -677,14 +839,17 @@ def maybe_autotune_paged_attention(
     d: int,
     group: int = 1,
     window: Optional[int] = None,
+    kv_quant: str = "none",
     steps: int = 12,
 ) -> bool:
     """Measure the fused kernel vs the XLA gather+attend at this decode shape
     class on the real device, once per process per class; returns the chosen
     use_pallas and records it for decide_paged_kernel (prefill inherits the
     decode decision). No-op off-TPU or when PETALS_TPU_PAGED_KERNEL forces a
-    path — the maybe_autotune_nf4_decode pattern (ops/quant.py)."""
-    key = shape_class(n_lanes, max_pages, page_size, hkv, d, window)
+    path — the maybe_autotune_nf4_decode pattern (ops/quant.py). A quantized
+    shape class times against QUANTIZED pools on both arms: the kernel pays
+    in-tile dequant, the XLA arm pays the dequantizing gather."""
+    key = shape_class(n_lanes, max_pages, page_size, hkv, d, window, kv_quant)
     if kernel_mode() != "auto" or _platform() != "tpu":
         return decide_paged_kernel("decode", key)
     if ("decode", *key) in _AUTOTUNE:
@@ -693,7 +858,9 @@ def maybe_autotune_paged_attention(
 
     import numpy as np
 
-    from petals_tpu.ops.paged_attention import gather_pages, identity_tables
+    from petals_tpu.ops.paged_attention import (
+        PagedPool, gather_pages, identity_tables, quantize_kv_rows,
+    )
     from petals_tpu.ops.attention import attend_reference
 
     hq = hkv * max(int(group), 1)
@@ -711,6 +878,16 @@ def maybe_autotune_paged_attention(
     q = jax.random.normal(kq, (n_lanes, 1, hq, d), jnp.bfloat16) * 0.1
     k_pool = jax.random.normal(kk, (n_pages, page_size, hkv, d), jnp.bfloat16) * 0.1
     v_pool = jax.random.normal(kv_, (n_pages, page_size, hkv, d), jnp.bfloat16) * 0.1
+    if kv_quant != "none":
+        k_pool = PagedPool(*quantize_kv_rows(k_pool, kv_quant))
+        v_pool = PagedPool(*quantize_kv_rows(v_pool, kv_quant))
+
+    def _perturb(pool, f):
+        # quantized pools perturb the SCALES leaf — same effect (the chain
+        # stays data-dependent, CSE can't hoist the gather), legal dtypes
+        if isinstance(pool, PagedPool):
+            return PagedPool(pool.codes, pool.scales * f)
+        return pool * f
 
     def timed(call):
         # chained data-dependent calls inside one jit; slope between two chain
@@ -725,7 +902,7 @@ def maybe_autotune_paged_attention(
                 a = qv
                 for j in range(n):
                     f_j = 1.0 + j / 128.0  # bf16 eps at 1.0: survives the dtype
-                    a = call(a * 1e-2 + qv, kp * f_j, vp * f_j, tb, ps_)
+                    a = call(a * 1e-2 + qv, _perturb(kp, f_j), _perturb(vp, f_j), tb, ps_)
                 return a
 
             return tracked_jit(f, name="paged_autotune_chain")

@@ -75,6 +75,7 @@ class TransformerBackend:
         max_chunk_size_bytes: int = 256 * 1024 * 1024,
         use_flash: Optional[bool] = None,
         mesh=None,  # jax.sharding.Mesh with a "tp" axis: intra-server tensor parallelism
+        kv_quant_type: str = "none",  # paged-pool encoding: none | int8 | nf4a
     ):
         self.family = family
         self.cfg = cfg
@@ -85,6 +86,17 @@ class TransformerBackend:
         self.compute_dtype = compute_dtype
         self.cache_dtype = cache_dtype or compute_dtype
         self.max_chunk_size_bytes = max_chunk_size_bytes
+        from petals_tpu.ops.paged_attention import KV_QUANT_KINDS
+
+        if kv_quant_type not in KV_QUANT_KINDS:
+            raise ValueError(
+                f"kv_quant_type must be one of {KV_QUANT_KINDS}, got {kv_quant_type!r}"
+            )
+        if kv_quant_type != "none" and mesh is not None:
+            raise ValueError("kv_quant_type requires a mesh-less server (paged pool only)")
+        if kv_quant_type == "nf4a" and cfg.head_dim % 2:
+            raise ValueError(f"nf4a KV packing needs an even head_dim, got {cfg.head_dim}")
+        self.kv_quant_type = kv_quant_type
         if use_flash is None:
             use_flash = jax.default_backend() == "tpu"
         self.mesh = mesh
@@ -145,24 +157,52 @@ class TransformerBackend:
         )
 
     def paged_cache_descriptors(self, n_pages: int, page_size: int, start: int, end: int):
-        """(k, v) descriptors for the PAGED pool of blocks [start, end):
-        [n, n_pages, page_size, hkv, d] per tensor. The paged path is gated
-        to mesh-less single-host servers (server/batching.py), so no
-        sharding rides these."""
+        """Descriptors for the PAGED pool of blocks [start, end). Unquantized:
+        (k, v), each [n, n_pages, page_size, hkv, d] in cache_dtype. Quantized
+        (kv_quant_type != none): (k_codes, v_codes, k_scales, v_scales) — the
+        codes in the storage dtype (int8, or uint8 with two split-half-packed
+        dims per byte for nf4a) and f32 absmax scales per (page row, kv head).
+        The paged path is gated to mesh-less single-host servers
+        (server/batching.py), so no sharding rides these."""
         n = end - start
         shape = (n, n_pages, page_size, self.num_kv_heads, self.head_dim)
+        if self.kv_quant_type == "none":
+            return (
+                TensorDescriptor(shape, self.cache_dtype),
+                TensorDescriptor(shape, self.cache_dtype),
+            )
+        if self.kv_quant_type == "int8":
+            codes_shape, codes_dtype = shape, jnp.int8
+        else:  # nf4a
+            codes_shape, codes_dtype = (*shape[:-1], self.head_dim // 2), jnp.uint8
+        scales_shape = (n, n_pages, page_size, self.num_kv_heads)
         return (
-            TensorDescriptor(shape, self.cache_dtype),
-            TensorDescriptor(shape, self.cache_dtype),
+            TensorDescriptor(codes_shape, codes_dtype),
+            TensorDescriptor(codes_shape, codes_dtype),
+            TensorDescriptor(scales_shape, jnp.float32),
+            TensorDescriptor(scales_shape, jnp.float32),
         )
 
     def cache_bytes_per_token(self) -> int:
+        """LOGICAL (dense fp) bytes per token across the span — sizes the
+        dense lane cache and stays the fp baseline for capacity ratios."""
         return (
             2
             * self.n_blocks
             * self.num_kv_heads
             * self.head_dim
             * jnp.dtype(self.cache_dtype).itemsize
+        )
+
+    def kv_bytes_per_token(self) -> int:
+        """WIRE bytes per token across the span: what the paged pool, host
+        swap, and migration actually store/ship per token. Equals
+        cache_bytes_per_token when kv_quant_type == none."""
+        from petals_tpu.ops.paged_attention import kv_wire_bytes_per_token
+
+        return 2 * self.n_blocks * kv_wire_bytes_per_token(
+            self.num_kv_heads, self.head_dim, self.kv_quant_type,
+            jnp.dtype(self.cache_dtype).itemsize,
         )
 
     # ------------------------------------------------------------- jitted programs
@@ -368,13 +408,15 @@ class TransformerBackend:
         reattach = self._reattach_quant
         fp_proj = fp_ops.projection(cfg.hidden_size)  # baked constant
 
+        cache_dtype = jnp.dtype(self.cache_dtype)
+
         @tracked_jit(
             name="batched_decode", steady=True,
             static_argnames=("with_fp",), donate_argnums=(1, 2),
         )
         def step(params, k_pool, v_pool, hidden, positions, *, with_fp: bool):
             # hidden: [n_lanes, 1, hidden]; positions: [n_lanes] int32
-            hidden = hidden.astype(k_pool.dtype)
+            hidden = hidden.astype(cache_dtype)
             if use_quant_consts:
                 dense_params, quant_params, outlier_names = split_quant(params)
                 xs_params = dense_params
@@ -442,17 +484,20 @@ class TransformerBackend:
         from petals_tpu.ops import paged_flash_attention as pfa
 
         cfg = self.cfg
+        # k_pool.shape answers the LOGICAL geometry for quantized pools too
         page_size, hkv, d = k_pool.shape[2], k_pool.shape[3], k_pool.shape[4]
         window = getattr(cfg, "sliding_window", None)
         window = window if isinstance(window, int) and window > 0 else None
         key = pfa.shape_class(
-            tables.shape[0], tables.shape[1], page_size, hkv, d, window
+            tables.shape[0], tables.shape[1], page_size, hkv, d, window,
+            self.kv_quant_type,
         )
         if not getattr(self, "_paged_autotuned", False):
             heads = getattr(cfg, "num_attention_heads", hkv)
             pfa.maybe_autotune_paged_attention(
                 n_lanes=key[0], max_pages=key[1], page_size=page_size,
                 hkv=hkv, d=d, group=max(1, heads // hkv), window=window,
+                kv_quant=self.kv_quant_type,
             )
             self._paged_autotuned = True
         path = pfa.resolve_paged_kernel_path("decode", key)
@@ -480,6 +525,8 @@ class TransformerBackend:
 
         from petals_tpu.ops.paged_attention import PagedKV
 
+        cache_dtype = jnp.dtype(self.cache_dtype)
+
         @tracked_jit(
             name="paged_decode", steady=True,
             static_argnames=("kernel_path", "with_fp"), donate_argnums=(1, 2),
@@ -489,7 +536,7 @@ class TransformerBackend:
             # hidden: [n_lanes, 1, hidden]; positions: [n_lanes] int32;
             # tables: [n_lanes, max_pages] int32 (-1 = unallocated slot)
             del kernel_path  # static retrace trigger; attend() re-resolves
-            hidden = hidden.astype(k_pool.dtype)
+            hidden = hidden.astype(cache_dtype)
             if use_quant_consts:
                 dense_params, quant_params, outlier_names = split_quant(params)
                 xs_params = dense_params
@@ -565,6 +612,8 @@ class TransformerBackend:
 
         from petals_tpu.ops.paged_attention import PagedKV
 
+        cache_dtype = jnp.dtype(self.cache_dtype)
+
         @tracked_jit(
             name="paged_gen_decode", steady=True,
             static_argnames=("kernel_path", "with_fp"), donate_argnums=(2, 3),
@@ -577,8 +626,8 @@ class TransformerBackend:
             emb = client_embed(client_params, tokens[:, None], cfg)
             hidden = jnp.where(
                 use_token[:, None, None],
-                emb.astype(k_pool.dtype),
-                hidden.astype(k_pool.dtype),
+                emb.astype(cache_dtype),
+                hidden.astype(cache_dtype),
             )
             if use_quant_consts:
                 dense_params, quant_params, outlier_names = split_quant(params)
@@ -680,6 +729,8 @@ class TransformerBackend:
 
         from petals_tpu.ops.paged_attention import PagedKV
 
+        cache_dtype = jnp.dtype(self.cache_dtype)
+
         @tracked_jit(
             name="paged_spec_verify", steady=True,
             static_argnames=("kernel_path", "with_fp"), donate_argnums=(1, 2),
@@ -693,7 +744,7 @@ class TransformerBackend:
             # positions: [n_lanes] int32, idle sentinel for non-spec lanes
             del kernel_path  # static retrace trigger; attend() re-resolves
             S = tokens.shape[1]
-            hidden = client_embed(client_params, tokens, cfg).astype(k_pool.dtype)
+            hidden = client_embed(client_params, tokens, cfg).astype(cache_dtype)
             if use_quant_consts:
                 dense_params, quant_params, outlier_names = split_quant(params)
                 xs_params = dense_params
@@ -815,6 +866,8 @@ class TransformerBackend:
 
         from petals_tpu.ops.paged_attention import PagedKV
 
+        cache_dtype = jnp.dtype(self.cache_dtype)
+
         @tracked_jit(
             name="paged_mixed_step", steady=True,
             static_argnames=("kernel_path", "with_fp"), donate_argnums=(1, 2),
@@ -828,8 +881,8 @@ class TransformerBackend:
             # int32 scalars describing the ONE prefill chunk riding this step
             del kernel_path  # static retrace trigger; attend() re-resolves
             B = chunk_hidden.shape[1]
-            hidden = hidden.astype(k_pool.dtype)
-            chunk_hidden = chunk_hidden.astype(k_pool.dtype)
+            hidden = hidden.astype(cache_dtype)
+            chunk_hidden = chunk_hidden.astype(cache_dtype)
             table_row = jnp.take(tables, chunk_lane, axis=0)  # [max_pages]
             if use_quant_consts:
                 dense_params, quant_params, outlier_names = split_quant(params)
@@ -950,16 +1003,29 @@ class TransformerBackend:
         page content — same contract as ops/paged_attention.py
         gather_pages."""
 
+        from petals_tpu.ops.paged_attention import PagedPool, dequantize_kv
+
         @tracked_jit(name="paged_lane_gather")
         def f(k_pool, v_pool, table_row):
             n_blocks, n_pages, page_size = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
             max_pages = table_row.shape[0]
             safe = jnp.clip(table_row, 0, n_pages - 1)
-            k = jnp.take(k_pool, safe, axis=1)  # [n_blocks, max_pages, ps, hkv, d]
-            v = jnp.take(v_pool, safe, axis=1)
-            hole = (table_row >= 0)[None, :, None, None, None]
-            k = jnp.where(hole, k, jnp.zeros((), k_pool.dtype))
-            v = jnp.where(hole, v, jnp.zeros((), v_pool.dtype))
+
+            def gather_leaf(arr):
+                g = jnp.take(arr, safe, axis=1)
+                hole = (table_row >= 0).reshape(1, -1, *([1] * (arr.ndim - 2)))
+                return jnp.where(hole, g, jnp.zeros((), arr.dtype))
+
+            def one(pool):
+                # quantized pools dequantize here: the dense lane view is the
+                # fp-facing boundary (prefill compute, kv export, snapshots)
+                if isinstance(pool, PagedPool):
+                    return dequantize_kv(
+                        gather_leaf(pool.codes), gather_leaf(pool.scales), pool.kind
+                    )
+                return gather_leaf(pool)
+
+            k, v = one(k_pool), one(v_pool)
             shape = (n_blocks, 1, max_pages * page_size, *k_pool.shape[3:])
             return k.reshape(shape), v.reshape(shape)
 
@@ -969,20 +1035,32 @@ class TransformerBackend:
     def _paged_lane_scatter_fn(self):
         """Write a session-shaped lane buffer back into its pages — the paged
         stand-in for ``_lane_insert_fn`` (prefill lands its KV directly in
-        the pages; unallocated slots drop)."""
-        from petals_tpu.ops.paged_attention import scatter_lane_pages
+        the pages; unallocated slots drop). Quantized pools REQUANTIZE the
+        checked-in buffer row by row — the write range was freshly computed,
+        untouched rows round-trip within one quant step."""
+        from petals_tpu.ops.paged_attention import PagedPool, quantize_kv_rows
 
         @tracked_jit(name="paged_lane_scatter", donate_argnums=(0, 1))
         def f(k_pool, v_pool, k, v, table_row):
             n_blocks, n_pages, page_size = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
             max_pages = table_row.shape[0]
-            pages_shape = (n_blocks, max_pages, page_size, *k_pool.shape[3:])
-            k_pages = k.reshape(pages_shape)
-            v_pages = v.reshape(pages_shape)
             safe = jnp.where(table_row >= 0, table_row, n_pages)
-            k_pool = k_pool.at[:, safe].set(k_pages.astype(k_pool.dtype), mode="drop")
-            v_pool = v_pool.at[:, safe].set(v_pages.astype(v_pool.dtype), mode="drop")
-            return k_pool, v_pool
+
+            def one(pool, buf):
+                pages = buf.reshape(n_blocks, max_pages, page_size, *pool.shape[3:])
+                if isinstance(pool, PagedPool):
+                    codes, scales = quantize_kv_rows(pages, pool.kind)
+                    return PagedPool(
+                        pool.codes.at[:, safe].set(
+                            codes.astype(pool.codes.dtype), mode="drop"
+                        ),
+                        pool.scales.at[:, safe].set(
+                            scales.astype(pool.scales.dtype), mode="drop"
+                        ),
+                    )
+                return pool.at[:, safe].set(pages.astype(pool.dtype), mode="drop")
+
+            return one(k_pool, k), one(v_pool, v)
 
         return f
 
@@ -992,11 +1070,17 @@ class TransformerBackend:
         page_size, hkv, d] pairs, bound for the host swap tier (scheduler
         preemption). Non-donating: the pool stays live — the pages are only
         FREED once the host copy has landed (server/batching.py
-        _swap_out_lane validates the lane generation first)."""
+        _swap_out_lane validates the lane generation first). Per-leaf, so a
+        quantized pool swaps its PACKED codes + scales — the host tier holds
+        (and the ledger bills) wire bytes, never re-inflated fp pages."""
 
         @tracked_jit(name="swap_out_pages")
         def f(k_pool, v_pool, pages):
-            return jnp.take(k_pool, pages, axis=1), jnp.take(v_pool, pages, axis=1)
+            take = lambda a: jnp.take(a, pages, axis=1)
+            return (
+                jax.tree_util.tree_map(take, k_pool),
+                jax.tree_util.tree_map(take, v_pool),
+            )
 
         return f
 
@@ -1005,30 +1089,40 @@ class TransformerBackend:
         """Scatter swapped-out page contents back into the pool on a FRESH
         page list (block tables make relocation free). The donating twin of
         ``_swap_out_pages_fn``; negative entries drop, mirroring
-        ``_paged_lane_scatter_fn``."""
+        ``_paged_lane_scatter_fn``. Per-leaf: packed pages land back
+        byte-exact — swap round trips lose nothing on a quantized pool."""
 
         @tracked_jit(name="swap_in_pages", donate_argnums=(0, 1))
         def f(k_pool, v_pool, k_pages, v_pages, pages):
             n_pages = k_pool.shape[1]
             safe = jnp.where(pages >= 0, pages, n_pages)
-            k_pool = k_pool.at[:, safe].set(k_pages.astype(k_pool.dtype), mode="drop")
-            v_pool = v_pool.at[:, safe].set(v_pages.astype(v_pool.dtype), mode="drop")
-            return k_pool, v_pool
+
+            def put(pool, pg):
+                return jax.tree_util.tree_map(
+                    lambda a, b: a.at[:, safe].set(b.astype(a.dtype), mode="drop"),
+                    pool, pg,
+                )
+
+            return put(k_pool, k_pages), put(v_pool, v_pages)
 
         return f
 
     @functools.cached_property
     def _copy_page_fn(self):
         """Duplicate one page across all blocks of the pool (the copy-on-write
-        fork: a shared page must be copied before a lane writes into it)."""
+        fork: a shared page must be copied before a lane writes into it).
+        Per-leaf: a quantized fork copies codes + scales bytes verbatim."""
 
         @tracked_jit(name="copy_page", donate_argnums=(0, 1))
         def f(k_pool, v_pool, src, dst):
-            k_page = jax.lax.dynamic_slice_in_dim(k_pool, src, 1, axis=1)
-            v_page = jax.lax.dynamic_slice_in_dim(v_pool, src, 1, axis=1)
-            k_pool = jax.lax.dynamic_update_slice_in_dim(k_pool, k_page, dst, axis=1)
-            v_pool = jax.lax.dynamic_update_slice_in_dim(v_pool, v_page, dst, axis=1)
-            return k_pool, v_pool
+            def cp(a):
+                page = jax.lax.dynamic_slice_in_dim(a, src, 1, axis=1)
+                return jax.lax.dynamic_update_slice_in_dim(a, page, dst, axis=1)
+
+            return (
+                jax.tree_util.tree_map(cp, k_pool),
+                jax.tree_util.tree_map(cp, v_pool),
+            )
 
         return f
 
@@ -1334,6 +1428,8 @@ class TransformerBackend:
         client_embed, client_head = family.client_embed, family.client_head
         fp_proj = fp_ops.projection(cfg.hidden_size)  # baked constant
 
+        cache_dtype = jnp.dtype(self.cache_dtype)
+
         @tracked_jit(
             name="batched_gen_decode", steady=True,
             static_argnames=("with_fp",), donate_argnums=(2, 3),
@@ -1345,8 +1441,8 @@ class TransformerBackend:
             emb = client_embed(client_params, tokens[:, None], cfg)
             hidden = jnp.where(
                 use_token[:, None, None],
-                emb.astype(k_pool.dtype),
-                hidden.astype(k_pool.dtype),
+                emb.astype(cache_dtype),
+                hidden.astype(cache_dtype),
             )
             if use_quant_consts:
                 dense_params, quant_params, outlier_names = split_quant(params)

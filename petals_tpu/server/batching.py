@@ -42,6 +42,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 
 from petals_tpu.analysis.sanitizer import (
@@ -295,6 +296,14 @@ class DecodeBatcher:
 
             ledger = get_ledger()
         self._ledger = ledger
+        # price the pool for /ledger readers: wire bytes per cached token
+        # (quantized pools cost ~4x less) and the storage kind. Guarded by
+        # hasattr because unit-test stub backends/ledgers lack the accessors.
+        if hasattr(backend, "kv_bytes_per_token") and hasattr(ledger, "set_kv_cost"):
+            ledger.set_kv_cost(
+                getattr(backend, "kv_quant_type", "none"),
+                backend.kv_bytes_per_token(),
+            )
         self._ledger_keys: Dict[int, str] = {}  # lane -> ledger session key
         self._scheduler = SessionScheduler(
             self.swap_pool, policy=preemption_policy, pages_fn=self._lane_pages,
@@ -357,18 +366,20 @@ class DecodeBatcher:
             # process must enter with the SAME specs (an unsharded leader
             # pool would deadlock the group at open)
             if self.page_size is not None:
-                kd, vd = self.backend.paged_cache_descriptors(
+                # 2 descriptors (k, v) unquantized; 4 (k/v codes, k/v scales)
+                # when the backend stores the pool quantized
+                descs = self.backend.paged_cache_descriptors(
                     self.n_pages, self.page_size, 0, self.backend.n_blocks
                 )
             else:
-                kd, vd = self.backend.cache_descriptors(
+                descs = self.backend.cache_descriptors(
                     self.n_lanes, self.max_length, 0, self.backend.n_blocks
                 )
             stack = contextlib.AsyncExitStack()
             try:
                 handles = await stack.enter_async_context(
                     self.memory_cache.allocate_cache(
-                        kd, vd,
+                        *descs,
                         timeout=self.alloc_timeout if timeout is None else timeout,
                     )
                 )
@@ -416,9 +427,26 @@ class DecodeBatcher:
             self._handles = None
 
     def _buffers(self):
-        return self.memory_cache.get_buffers(*self._handles)
+        """The (k_pool, v_pool) pair every step/compute path consumes. A
+        quantized pool rides as 4 MemoryCache buffers (codes x2, scales x2)
+        and is re-wrapped into PagedPool pytrees HERE, so every caller —
+        step bodies, swap, COW, snapshots — keeps the 2-tuple shape."""
+        bufs = self.memory_cache.get_buffers(*self._handles)
+        if len(bufs) == 4:
+            from petals_tpu.ops.paged_attention import PagedPool
+
+            return PagedPool(bufs[0], bufs[2]), PagedPool(bufs[1], bufs[3])
+        return bufs
 
     def _update(self, k_pool, v_pool) -> None:
+        from petals_tpu.ops.paged_attention import PagedPool
+
+        if isinstance(k_pool, PagedPool):
+            self.memory_cache.update_cache(self._handles[0], k_pool.codes)
+            self.memory_cache.update_cache(self._handles[1], v_pool.codes)
+            self.memory_cache.update_cache(self._handles[2], k_pool.scales)
+            self.memory_cache.update_cache(self._handles[3], v_pool.scales)
+            return
         self.memory_cache.update_cache(self._handles[0], k_pool)
         self.memory_cache.update_cache(self._handles[1], v_pool)
 
@@ -787,7 +815,11 @@ class DecodeBatcher:
         return int((self._tables[lane] >= 0).sum())
 
     def _page_nbytes(self) -> int:
-        return self.backend.cache_bytes_per_token() * self.page_size
+        # WIRE bytes per page: quantized pools swap/reserve packed bytes, so
+        # the host-swap budget, ledger swap meters, and victim sizing all
+        # bill what actually moves (kv_bytes_per_token == cache_bytes_per_token
+        # for unquantized backends)
+        return self.backend.kv_bytes_per_token() * self.page_size
 
     def _lane_lock(self, lane: int) -> AsyncTryLock:
         lock = self._lane_locks.get(lane)
@@ -982,7 +1014,10 @@ class DecodeBatcher:
         with self._reset_lock:
             k_pool, v_pool = self._buffers()
             k, v = self.backend._swap_out_pages_fn(k_pool, v_pool, pages)
-            return np.asarray(k), np.asarray(v)
+            # per-leaf host copy: a quantized pool's SwapEntry holds a
+            # PagedPool of numpy arrays — packed wire bytes, never fp pages
+            to_host = lambda t: jax.tree_util.tree_map(np.asarray, t)
+            return to_host(k), to_host(v)
 
     async def _ensure_resident(self, lane: int) -> None:
         """Transparent resume: if ``lane`` is suspended (or a suspend is in
@@ -1236,6 +1271,10 @@ class DecodeBatcher:
                 frag = self._pages.fragmentation_info()
                 info["frag"] = frag["frag"]
                 info["largest_free_run"] = frag["largest_run"]
+            # honest capacity math for clients: the pool's encoding and its
+            # WIRE bytes/token (what a page actually costs under kv quant)
+            info["kv_quant"] = getattr(self.backend, "kv_quant_type", "none")
+            info["kv_bytes_per_token"] = int(self.backend.kv_bytes_per_token())
         info.update(self._scheduler.summary())
         return info
 
@@ -2419,15 +2458,37 @@ class DecodeBatcher:
                 return None  # partial residency: only the pool knows the rest
 
             def assemble():
-                hkv, d = entry.k.shape[-2], entry.k.shape[-1]
+                from petals_tpu.ops.paged_attention import PagedPool, dequantize_kv_np
+
+                quantized = isinstance(entry.k, PagedPool)
+                if quantized:
+                    # packed swap entry: dequantize the covered slots to the
+                    # dense fp view the snapshot contract promises
+                    hkv = entry.k.scales.shape[-1]
+                    d = entry.k.shape[-1]  # logical (PagedPool.shape unpacks)
+                    out_dtype = np.float32
+                else:
+                    hkv, d = entry.k.shape[-2], entry.k.shape[-1]
+                    out_dtype = entry.k.dtype
                 nb = b1 - b0
-                k_out = np.zeros((nb, 1, position, hkv, d), entry.k.dtype)
-                v_out = np.zeros((nb, 1, position, hkv, d), entry.v.dtype)
+                k_out = np.zeros((nb, 1, position, hkv, d), out_dtype)
+                v_out = np.zeros((nb, 1, position, hkv, d), out_dtype)
                 for s in range(n_slots):
                     i = index_of[s]
                     t0, t1 = s * ps, min((s + 1) * ps, position)
-                    k_out[:, 0, t0:t1] = entry.k[b0:b1, i, : t1 - t0]
-                    v_out[:, 0, t0:t1] = entry.v[b0:b1, i, : t1 - t0]
+                    if quantized:
+                        kind = entry.k.kind
+                        k_out[:, 0, t0:t1] = dequantize_kv_np(
+                            entry.k.codes[b0:b1, i, : t1 - t0],
+                            entry.k.scales[b0:b1, i, : t1 - t0], kind,
+                        )
+                        v_out[:, 0, t0:t1] = dequantize_kv_np(
+                            entry.v.codes[b0:b1, i, : t1 - t0],
+                            entry.v.scales[b0:b1, i, : t1 - t0], kind,
+                        )
+                    else:
+                        k_out[:, 0, t0:t1] = entry.k[b0:b1, i, : t1 - t0]
+                        v_out[:, 0, t0:t1] = entry.v[b0:b1, i, : t1 - t0]
                 return k_out, v_out
 
             # the lane lock stays held across the copy so a racing resume

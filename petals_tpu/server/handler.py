@@ -315,15 +315,24 @@ class TransformerHandler:
         position = src["position"]
         if position <= 0:
             raise ValueError(f"Session {session_id!r} has no cached tokens yet")
+        # migrated-in entries may hold PACKED codes + scales (quantized wire);
+        # the client-facing export protocol stays dense, so decode the slice
+        kv_quant = src.get("kv_quant") or "none"
+
+        def _dense(name: str):
+            arr = src[name][b0:b1]
+            if kv_quant != "none":
+                from petals_tpu.ops.paged_attention import dequantize_kv_np
+
+                arr = dequantize_kv_np(arr, src[name + "_scales"][b0:b1], kv_quant)
+            return serialize_array(arr, comp)
+
         return {
             "position": position,
             "start": want_start,
             "end": want_end,
             "batch_size": src["batch_size"],
-            "tensors": {
-                "k": serialize_array(src["k"][b0:b1], comp),
-                "v": serialize_array(src["v"][b0:b1], comp),
-            },
+            "tensors": {"k": _dense("k"), "v": _dense("v")},
         }
 
     async def rpc_session_migrate(self, payload, ctx: RpcContext):
@@ -353,6 +362,11 @@ class TransformerHandler:
         tensors = payload.get("tensors") or {}
         if "k" not in tensors or "v" not in tensors:
             raise ValueError("session_migrate needs k and v tensors")
+        from petals_tpu.ops.paged_attention import KV_QUANT_KINDS
+
+        kv_quant = str(payload.get("kv_quant") or "none")
+        if kv_quant not in KV_QUANT_KINDS:
+            raise ValueError(f"Unknown kv_quant {kv_quant!r} in session_migrate")
 
         def parse(wire):
             arr = deserialize_array(wire)
@@ -365,7 +379,20 @@ class TransformerHandler:
 
         k_arr = await asyncio.to_thread(parse, tensors["k"])
         v_arr = await asyncio.to_thread(parse, tensors["v"])
-        nbytes = k_arr.nbytes + v_arr.nbytes
+        k_scales = v_scales = None
+        if kv_quant != "none":
+            # packed wire entry: codes ride in k/v, per-row scales alongside.
+            # Stored as-is (wire bytes against the budget); kv_adopt / export
+            # dequantize on the way out.
+            if "k_scales" not in tensors or "v_scales" not in tensors:
+                raise ValueError(
+                    "quantized session_migrate needs k_scales and v_scales tensors"
+                )
+            k_scales = await asyncio.to_thread(parse, tensors["k_scales"])
+            v_scales = await asyncio.to_thread(parse, tensors["v_scales"])
+        nbytes = k_arr.nbytes + v_arr.nbytes + (
+            k_scales.nbytes + v_scales.nbytes if k_scales is not None else 0
+        )
         self._prune_migrated()
         if self._migrated_bytes + nbytes > self.migrate_in_budget_bytes:
             tm.MIGRATIONS.labels(direction="in", outcome="refused").inc()
@@ -383,6 +410,7 @@ class TransformerHandler:
             self._migrated_bytes -= old["nbytes"]
         self._migrated[session_id] = {
             "k": k_arr, "v": v_arr, "position": position,
+            "k_scales": k_scales, "v_scales": v_scales, "kv_quant": kv_quant,
             "start": src_start, "end": src_end,
             "batch_size": batch_size, "max_length": max_length,
             "trace_id": trace_id, "nbytes": nbytes,
@@ -412,7 +440,25 @@ class TransformerHandler:
         from petals_tpu.telemetry import get_journal
 
         trace_id = snap.get("trace_id")
-        nbytes = int(snap["k"].nbytes + snap["v"].nbytes)
+        kv_quant = getattr(self.backend, "kv_quant_type", "none")
+        if kv_quant != "none":
+            # pack the dense snapshot to per-row codes + scales before it hits
+            # the wire: the push moves ~4x fewer bytes and the receiver banks
+            # the packed entry verbatim against its migration budget
+            from petals_tpu.ops.paged_attention import quantize_kv_rows_np
+
+            def _pack():
+                kc, ks = quantize_kv_rows_np(np.asarray(snap["k"], np.float32), kv_quant)
+                vc, vs = quantize_kv_rows_np(np.asarray(snap["v"], np.float32), kv_quant)
+                return kc, ks, vc, vs
+
+            k_codes, k_scales, v_codes, v_scales = await asyncio.to_thread(_pack)
+            nbytes = int(
+                k_codes.nbytes + k_scales.nbytes + v_codes.nbytes + v_scales.nbytes
+            )
+        else:
+            k_codes = k_scales = v_codes = v_scales = None
+            nbytes = int(snap["k"].nbytes + snap["v"].nbytes)
         t0 = time.perf_counter()
 
         async def _push() -> None:
@@ -422,18 +468,31 @@ class TransformerHandler:
                 )
             if chaos.ENABLED:
                 await chaos.inject(chaos.SITE_MIGRATE_PUSH, detail=session_id)
-            wire_k, wire_v = await asyncio.to_thread(
-                lambda: (
-                    serialize_array(snap["k"], self.compression),
-                    serialize_array(snap["v"], self.compression),
+            if kv_quant != "none":
+                # codes are integer (lossy float codecs pass them through
+                # verbatim); scales go uncompressed so the packed entry
+                # round-trips the wire byte-exactly
+                tensors = await asyncio.to_thread(
+                    lambda: {
+                        "k": serialize_array(k_codes, self.compression),
+                        "v": serialize_array(v_codes, self.compression),
+                        "k_scales": serialize_array(k_scales, CompressionType.NONE),
+                        "v_scales": serialize_array(v_scales, CompressionType.NONE),
+                    }
                 )
-            )
+            else:
+                tensors = await asyncio.to_thread(
+                    lambda: {
+                        "k": serialize_array(snap["k"], self.compression),
+                        "v": serialize_array(snap["v"], self.compression),
+                    }
+                )
             payload = {
                 "session_id": session_id,
                 "start": snap["start"], "end": snap["end"],
                 "position": snap["position"], "batch_size": snap["batch_size"],
                 "max_length": snap["max_length"], "trace_id": trace_id,
-                "tensors": {"k": wire_k, "v": wire_v},
+                "kv_quant": kv_quant, "tensors": tensors,
             }
             client = await self._push_pool.get_addr(PeerAddr.from_string(addr))
             await client.call("ptu.session_migrate", payload)
@@ -641,8 +700,26 @@ class TransformerHandler:
                 f"migrated span [{entry['start']}, {entry['end']})"
             )
         b0 = abs_start - entry["start"]
-        k_arr = np.ascontiguousarray(entry["k"][b0:b0 + n_blocks, :, :cut])
-        v_arr = np.ascontiguousarray(entry["v"][b0:b0 + n_blocks, :, :cut])
+        kv_quant = entry.get("kv_quant") or "none"
+        if kv_quant != "none":
+            # packed wire entry (row-granular codes + scales, position-
+            # sliceable): dequantize the adopted cut to the dense prefix the
+            # seed path expects — the pool write requantizes on insert
+            from petals_tpu.ops.paged_attention import dequantize_kv_np
+
+            k_codes = np.ascontiguousarray(entry["k"][b0:b0 + n_blocks, :, :cut])
+            v_codes = np.ascontiguousarray(entry["v"][b0:b0 + n_blocks, :, :cut])
+            k_sc = np.ascontiguousarray(entry["k_scales"][b0:b0 + n_blocks, :, :cut])
+            v_sc = np.ascontiguousarray(entry["v_scales"][b0:b0 + n_blocks, :, :cut])
+            wire_nbytes = int(
+                k_codes.nbytes + v_codes.nbytes + k_sc.nbytes + v_sc.nbytes
+            )
+            k_arr = await asyncio.to_thread(dequantize_kv_np, k_codes, k_sc, kv_quant)
+            v_arr = await asyncio.to_thread(dequantize_kv_np, v_codes, v_sc, kv_quant)
+        else:
+            k_arr = np.ascontiguousarray(entry["k"][b0:b0 + n_blocks, :, :cut])
+            v_arr = np.ascontiguousarray(entry["v"][b0:b0 + n_blocks, :, :cut])
+            wire_nbytes = int(k_arr.nbytes + v_arr.nbytes)
         await self._seed_session_kv(
             lane, kv, handles, k_arr, v_arr, cut,
             batch_size=batch_size, n_blocks=n_blocks, batcher=batcher,
@@ -653,16 +730,16 @@ class TransformerHandler:
         self._parked.pop(src_sid, None)
         if lane is not None and batcher is not None:
             # migrated-in KV becomes this tenant's working set: bill the
-            # adopted bytes to the lane's live ledger session
+            # adopted bytes to the lane's live ledger session at WIRE size
             key = batcher._ledger_keys.get(lane)
             if key is not None:
-                batcher._ledger.note_migrated(key, int(k_arr.nbytes + v_arr.nbytes))
+                batcher._ledger.note_migrated(key, wire_nbytes)
         from petals_tpu.telemetry import get_journal
 
         get_journal().event(
             "migrate_adopt", trace_id=entry.get("trace_id"),
             occupancy=self.batcher.occupancy_info() if self.batcher is not None else None,
-            session_id=src_sid, position=cut, nbytes=k_arr.nbytes + v_arr.nbytes,
+            session_id=src_sid, position=cut, nbytes=wire_nbytes,
         )
         return cut
 

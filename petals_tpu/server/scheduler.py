@@ -58,15 +58,18 @@ class SwapEntry:
     """One suspended lane's KV, resident in host RAM.
 
     ``k``/``v`` are [n_blocks, n_slots, page_size, hkv, d] host arrays
-    holding exactly the pages that were resident at suspend time; ``slots``
-    records WHICH table slots they back, so swap-in can restore the row onto
-    fresh physical pages. ``generation`` pins the entry to the pool
-    generation it was taken under — a pool reset invalidates it."""
+    holding exactly the pages that were resident at suspend time — or, for
+    a quantized pool (``kv_quant_type != none``), ``PagedPool`` pytrees of
+    host arrays holding the PACKED codes + scales, so the swap tier stores
+    wire bytes and the round trip back to the device is byte-exact.
+    ``slots`` records WHICH table slots they back, so swap-in can restore
+    the row onto fresh physical pages. ``generation`` pins the entry to the
+    pool generation it was taken under — a pool reset invalidates it."""
 
-    k: np.ndarray
-    v: np.ndarray
+    k: "np.ndarray | object"  # host pages, or a PagedPool of host arrays
+    v: "np.ndarray | object"
     slots: np.ndarray  # [n_slots] int32 table-slot indices
-    nbytes: int  # bytes reserved in the HostSwapPool
+    nbytes: int  # WIRE bytes reserved in the HostSwapPool
     generation: int
     suspended_at: float = 0.0  # time.monotonic() at swap-out commit
 

@@ -90,6 +90,7 @@ class Server:
         batch_max_length: Optional[int] = None,  # pool lane length; None: min(inference_max_length, 1024)
         page_size: int = 64,  # paged KV: tokens per page; 0 = dense lane pool
         n_pages: Optional[int] = None,  # paged KV pool size; None = lanes * pages-per-lane
+        kv_quant_type: str = "none",  # paged KV pool storage: "none" | "int8" | "nf4a"
         prefill_token_budget: int = 512,  # prefill tokens folded into each mixed batched step
         swap_host_bytes: int = 0,  # host-RAM KV swap tier (session preemption); 0 disables
         preemption_policy: str = "lru",  # victim choice on pool exhaustion: lru | largest | off
@@ -199,6 +200,18 @@ class Server:
         self.batch_max_length = batch_max_length
         self.page_size = page_size
         self.n_pages = n_pages
+        from petals_tpu.ops.paged_attention import KV_QUANT_KINDS
+
+        if kv_quant_type not in KV_QUANT_KINDS:
+            raise ValueError(
+                f"kv_quant_type must be one of {KV_QUANT_KINDS}, got {kv_quant_type!r}"
+            )
+        if kv_quant_type != "none" and not page_size:
+            raise ValueError(
+                "kv_quant_type requires the paged KV pool (--page_size > 0): the "
+                "dense lane pool has no quantized storage path"
+            )
+        self.kv_quant_type = kv_quant_type
         self.prefill_token_budget = prefill_token_budget
         self.swap_host_bytes = swap_host_bytes
         self.preemption_policy = preemption_policy
@@ -611,9 +624,12 @@ class Server:
     def _server_info(self, state: ServerState) -> ServerInfo:
         cache_tokens_left = None
         if self.memory_cache is not None and self.backend is not None:
-            cache_tokens_left = int(
-                self.memory_cache.bytes_left // max(self.backend.cache_bytes_per_token(), 1)
-            )
+            per_token = self.backend.cache_bytes_per_token()
+            if getattr(self, "kv_quant_type", "none") != "none":
+                # quantized paged pool: a cached token costs wire bytes, so
+                # the same budget advertises ~4x the remaining capacity
+                per_token = self.backend.kv_bytes_per_token()
+            cache_tokens_left = int(self.memory_cache.bytes_left // max(per_token, 1))
         rps = getattr(self, "_rps_info", None) or {}
         return ServerInfo(
             state=state,
@@ -824,6 +840,10 @@ class Server:
         batch_lanes = self.batch_lanes
         if batch_lanes is None:
             lane_bytes = self.backend.cache_bytes_per_token() * batch_max_length
+            if self.kv_quant_type != "none":
+                # quantized pool pages cost wire bytes on device too (packed
+                # codes + f32 scales), so the budget affords ~4x the lanes
+                lane_bytes = self.backend.kv_bytes_per_token() * batch_max_length
             affordable = int(self.memory_cache.max_size_bytes // 2 // max(lane_bytes, 1))
             batch_lanes = max(min(8, affordable), 0)
         return TransformerHandler(
@@ -955,6 +975,7 @@ class Server:
             max_chunk_size_bytes=self.max_chunk_size_bytes,
             use_flash=self.use_flash,
             mesh=mesh,
+            kv_quant_type=self.kv_quant_type,
         )
 
     def _make_backend(self, stacked, first_block: int) -> TransformerBackend:

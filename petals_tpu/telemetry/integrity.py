@@ -163,6 +163,7 @@ class IntegrityMonitor:
         position: int,
         lossy_wire: bool = False,
         quant: str = "none",
+        kv_quant: str = "none",
     ) -> bool:
         """True when the reply's digest stream is consistent; False (after
         recording evidence) on divergence. Hops without a fingerprint (old
@@ -183,7 +184,8 @@ class IntegrityMonitor:
                          detail="reply disagrees with fused fingerprint")
         else:
             ok = self._check_continuity(
-                peer_id, local, start=start, end=end, position=position, quant=quant
+                peer_id, local, start=start, end=end, position=position,
+                quant=quant, kv_quant=kv_quant,
             )
         key = (int(start), int(end), int(position))
         self._ring[key] = local
@@ -194,15 +196,17 @@ class IntegrityMonitor:
 
     def _check_continuity(
         self, peer_id: str, local: np.ndarray, *, start: int, end: int,
-        position: int, quant: str
+        position: int, quant: str, kv_quant: str = "none"
     ) -> bool:
         """A replayed position (repair/migration re-drove the span) must
         reproduce the digest the original replica produced, within the
-        cross-replica quantization tolerance."""
+        cross-replica quantization tolerance (widened by ``kv_quant`` when
+        either replica stores its paged KV pool quantized — an adopted
+        session's cache went through a requantization round trip)."""
         prev = self._ring.get((int(start), int(end), int(position)))
         if prev is None:
             return True
-        tol = fp_ops.tolerance_for(quant)
+        tol = fp_ops.tolerance_for(quant, kv_quant)
         if fp_ops.fp_close(local, prev, rtol=tol):
             return True
         self._record(
@@ -283,10 +287,14 @@ class CanaryProber:
         replicas: Sequence[str],
         *,
         quant: str = "none",
+        kv_quant: str = "none",
     ) -> Dict[str, Any]:
         """Probe every replica of ``span = (first_block, n_blocks)`` once and
-        quarantine quorum outliers. Returns a report dict (also journaled
-        when divergence is found)."""
+        quarantine quorum outliers. ``quant``/``kv_quant`` are the widest
+        weight / paged-KV-pool quantization modes among the replicas — a
+        replica serving from a quantized pool legitimately diverges within
+        the kv_quant band and must not be named an outlier for it. Returns
+        a report dict (also journaled when divergence is found)."""
         self.rounds += 1
         digests: Dict[str, np.ndarray] = {}
         errors: List[str] = []
@@ -301,7 +309,9 @@ class CanaryProber:
                 errors.append(str(peer))
                 continue
             digests[str(peer)] = np.asarray(list(fp), dtype=np.float32)
-        outliers, majority = quorum_outliers(digests, rtol=fp_ops.tolerance_for(quant))
+        outliers, majority = quorum_outliers(
+            digests, rtol=fp_ops.tolerance_for(quant, kv_quant)
+        )
         for peer in digests:
             outcome = "divergent" if peer in outliers else "ok"
             tm.INTEGRITY_PROBES.labels(outcome=outcome).inc()

@@ -206,6 +206,18 @@ class ResourceLedger:
         self._last_sample = -float("inf")
         self._last_check = -float("inf")
         self._last_noisy: Dict[str, float] = {}
+        # KV storage economics (set by the batcher at pool creation): wire
+        # bytes one cached token costs across this server's span, and the
+        # pool's quantization kind — lets /ledger readers convert the
+        # page-second integrals into actual HBM bytes
+        self.kv_quant: str = "none"
+        self.kv_bytes_per_token: Optional[int] = None
+
+    def set_kv_cost(self, kv_quant: str, bytes_per_token: int) -> None:
+        """Record the paged pool's storage kind and per-token wire cost so
+        the /ledger efficiency blobs can price page-seconds in bytes."""
+        self.kv_quant = str(kv_quant or "none")
+        self.kv_bytes_per_token = int(bytes_per_token)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -573,6 +585,8 @@ class ResourceLedger:
             "window_s": self.window_s,
             "peers": len(self._known_peers),
             "sessions": len(self._sessions),
+            "kv_quant": self.kv_quant,
+            "kv_bytes_per_token": self.kv_bytes_per_token,
             "pool_page_seconds": round(self.pool_page_seconds, 4),
             "unattributed_page_seconds": round(self.unattributed_page_seconds, 4),
             "peer_overflows": self.peer_overflows,
