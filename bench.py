@@ -1322,6 +1322,9 @@ def _heavy_row_registry():
         "e2e_kv_quant_capacity": lambda: __import__(
             "benchmarks.bench_kv_quant_capacity", fromlist=["run_bench"]
         ).run_bench(),
+        "e2e_radix_prefix_tree": lambda: __import__(
+            "benchmarks.bench_radix_prefix", fromlist=["run_bench"]
+        ).run_bench(),
         "quant_quality": lambda: __import__(
             "benchmarks.quant_quality", fromlist=["quality_report"]
         ).quality_report(include_model_tier=False),
@@ -2007,6 +2010,9 @@ def _gate_row_registry():
         "gate_paged_kernel": lambda: bench_gate_paged_kernel("gate_paged_kernel"),
         "gate_spec_decode": lambda: bench_gate_spec_decode("gate_spec_decode"),
         "gate_kv_quant": lambda: bench_gate_kv_quant("gate_kv_quant"),
+        "gate_radix_cache": lambda: __import__(
+            "benchmarks.bench_radix_prefix", fromlist=["gate_bench"]
+        ).gate_bench("gate_radix_cache"),
     }
 
 

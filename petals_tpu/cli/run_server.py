@@ -156,6 +156,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Serve Prometheus-text /metrics (plus the /journal scheduler "
                              "event log) on this local HTTP port; 0 = ephemeral, "
                              "omit to disable")
+    parser.add_argument("--prefix_cache_policy", choices=["radix", "lru"], default="radix",
+                        help="'radix' keys prefix-cache entries into a token-segment radix "
+                             "tree with three-tier residency (HBM / host / swap) and "
+                             "tenant-fair eviction; 'lru' is the flat insertion-order "
+                             "baseline (A/B comparisons)")
     parser.add_argument("--prefix_share_scope", choices=["swarm", "peer"], default="swarm",
                         help="'swarm' shares cached prefixes across all clients (fastest; a client "
                              "can time-probe whether a prompt prefix was recently served); 'peer' "
@@ -258,6 +263,7 @@ def main(argv=None) -> None:
         prefix_cache_bytes=args.prefix_cache_bytes,
         prefix_share_scope=args.prefix_share_scope,
         prefix_device_bytes=args.prefix_device_bytes,
+        prefix_cache_policy=args.prefix_cache_policy,
         server_side_generation=not args.no_server_side_generation,
         draft_model=args.draft_model,
         spec_k=args.spec_k,

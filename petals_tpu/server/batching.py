@@ -722,6 +722,15 @@ class DecodeBatcher:
     def page_epoch(self) -> int:
         return self._page_epoch
 
+    @property
+    def page_nbytes(self) -> int:
+        """Wire bytes of one KV page across this span (0 for dense pools) —
+        how the radix prefix cache prices its pinned page runs when billing
+        HBM residency to tenants through the ledger."""
+        if self.page_size is None:
+            return 0
+        return self._page_nbytes()
+
     def pin_lane_pages(self, lane: int, t0: int, t1: int) -> Optional[List[int]]:
         """Take a reference on the pages backing token range [t0, t1) of
         ``lane`` (page-aligned) so the prefix cache can share them after the

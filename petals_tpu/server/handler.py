@@ -87,6 +87,7 @@ class TransformerHandler:
         prefix_cache_bytes: int = 256 * 2**20,  # 0 disables prefix caching
         prefix_share_scope: str = "swarm",  # "swarm" shares across clients; "peer" salts per client
         prefix_device_bytes: int = 256 * 2**20,  # HBM tier of the prefix cache; 0 disables
+        prefix_cache_policy: str = "radix",  # "radix" tree + tiers | "lru" flat baseline
         server_gen_params=None,  # client leaves (embed/norm/head) for device-side generation
         draft_model=None,  # server.spec_decode.DraftModel: speculative decoding
         spec_k: Optional[int] = None,  # drafts per lane per tick; None -> draft's k
@@ -187,9 +188,22 @@ class TransformerHandler:
         self.spec_k = spec_k
         if prefix_cache_bytes > 0:
             from petals_tpu.server.prefix_cache import PrefixCache
+            from petals_tpu.telemetry.ledger import get_ledger
 
+            ledger = get_ledger()
             self.prefix_cache = PrefixCache(
-                prefix_cache_bytes, device_max_bytes=prefix_device_bytes
+                prefix_cache_bytes, device_max_bytes=prefix_device_bytes,
+                policy=prefix_cache_policy,
+                # the radix swap tier rides the batcher's HostSwapPool (one
+                # budget with session preemption); a private-session-only
+                # server has no pool, so demotion degrades to eviction
+                swap_pool=(
+                    self.batcher.swap_pool if self.batcher is not None else None
+                ),
+                # eviction consults the DRF rank: the dominant tenant's cold
+                # nodes go first, and residency bills to the owning tenant
+                usage_fn=ledger.peer_dominant_share,
+                ledger=ledger,
             )
         if (
             self.prefix_cache is not None
@@ -870,7 +884,7 @@ class TransformerHandler:
 
     async def _store_prefix_async(
         self, keys, n_hit: int, boundary: int, lane, handles, out_full, n_blocks: int,
-        batcher=None,
+        batcher=None, tenant: Optional[str] = None,
     ) -> None:
         """Snapshot KV rows [0, boundary) and store the freshly computed
         segments. Runs as a task after the prefill reply; the session loop
@@ -968,6 +982,7 @@ class TransformerHandler:
             k_dev=k_dev, v_dev=v_dev,
             pages=lane_pages, pages_pool=batcher if lane_pages else None,
             pages_epoch=lane_pages_epoch,
+            tenant=tenant,  # residency bills to the storing peer (ledger)
         )
 
     async def _snapshot_session(
@@ -1670,6 +1685,27 @@ class TransformerHandler:
                                     batch_size=batch_size, n_blocks=end - start,
                                     batcher=batcher,
                                 )
+                                # a host-staged hit is the radix promotion
+                                # signal: hot path nodes move up to the HBM
+                                # tier OFF the reply path (multi-MB uploads),
+                                # so the NEXT session with this prefix seeds
+                                # device-resident
+                                if (
+                                    not getattr(seed_backend, "is_lockstep", False)
+                                    and getattr(seed_backend, "mesh", None) is None
+                                    and self.prefix_cache.device_max_bytes > 0
+                                ):
+                                    promo = asyncio.create_task(
+                                        asyncio.to_thread(
+                                            self.prefix_cache.maybe_promote_device,
+                                            pc_keys, pc_hits,
+                                        )
+                                    )
+                                    promo.add_done_callback(
+                                        log_exception_callback(
+                                            logger, "prefix device promotion"
+                                        )
+                                    )
                             exec_hidden = hidden[:, hit_len:]
                             pos = hit_len
 
@@ -1827,14 +1863,34 @@ class TransformerHandler:
                         + SEGMENT_TOKENS * backend0.hidden_size
                         * np.asarray(out).dtype.itemsize
                     )
-                    if self.prefix_cache.worth_storing(pc_keys, pc_hits, seg_bytes):
+                    # mirrors the store path's tier eligibility: a re-store
+                    # of fully-known keys is still worth it when it would
+                    # grant HBM residency (device refs for a host-only hot
+                    # entry, or fresh page pins after a pool reset)
+                    store_backend = batcher.backend if lane is not None else self.backend
+                    device_capable = (
+                        self.prefix_cache.device_max_bytes > 0
+                        and getattr(store_backend, "mesh", None) is None
+                        and not getattr(store_backend, "is_lockstep", False)
+                        and (lane is None or batcher.page_size is None)
+                    )
+                    store_pages_pool = (
+                        batcher
+                        if lane is not None and batcher.page_size is not None
+                        else None
+                    )
+                    if self.prefix_cache.worth_storing(
+                        pc_keys, pc_hits, seg_bytes,
+                        device_capable=device_capable,
+                        pages_pool=store_pages_pool,
+                    ):
                         # store off the reply path; the loop awaits this
                         # before any LATER step of this session
                         pending_store = asyncio.create_task(
                             self._store_prefix_async(
                                 pc_keys, pc_hits, len(pc_keys) * SEGMENT_TOKENS,
                                 lane, handles, np.asarray(out), end - start,
-                                batcher=batcher,
+                                batcher=batcher, tenant=peer_str,
                             )
                         )
                         pending_store.add_done_callback(

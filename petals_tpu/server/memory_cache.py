@@ -196,6 +196,13 @@ class HostSwapPool:
     whose KV does not fit is simply not preemptable, and the caller falls
     back to ordinary waiter backpressure.
 
+    The radix prefix cache's swap tier shares THIS budget: demoted cache
+    nodes reserve with ``kind="cache"``, tracked separately
+    (``cache_bytes_in_use``) so the scheduler summary can show how the one
+    budget splits between preempted sessions and demoted cache nodes. The
+    cache self-limits to a fraction of the budget (prefix_cache.py
+    CACHE_SWAP_FRAC) so session preemption always finds room.
+
     The copies land in ordinary (pageable) numpy memory; on TPU runtimes the
     device->host transfer is staged through the runtime's pinned buffers, and
     a future upgrade can place the pool in the ``pinned_host`` memory space
@@ -206,35 +213,54 @@ class HostSwapPool:
         assert max_size_bytes >= 0
         self.max_size_bytes = int(max_size_bytes)
         self._bytes_in_use = 0
-        self.stats = {"reserved": 0, "rejected": 0, "peak_bytes": 0}
+        self._cache_bytes_in_use = 0  # of which: demoted prefix-cache nodes
+        self.stats = {
+            "reserved": 0, "rejected": 0, "peak_bytes": 0,
+            "cache_reserved": 0, "cache_rejected": 0,
+        }
 
     @property
     def bytes_in_use(self) -> int:
         return self._bytes_in_use
 
     @property
+    def cache_bytes_in_use(self) -> int:
+        return self._cache_bytes_in_use
+
+    @property
     def bytes_left(self) -> int:
         return self.max_size_bytes - self._bytes_in_use
 
-    def try_reserve(self, nbytes: int) -> bool:
+    def try_reserve(self, nbytes: int, kind: str = "session") -> bool:
         """Reserve ``nbytes`` for one swap entry, or False when it would
-        overflow the budget (the entry's victim stays resident)."""
+        overflow the budget (the entry's victim stays resident).
+        ``kind="cache"`` tags a prefix-cache node demotion — same budget,
+        separate accounting."""
         nbytes = int(nbytes)
         assert nbytes >= 0
         if chaos.ENABLED and chaos.fire(chaos.SITE_SWAP_RESERVE) is not None:
             # injected pressure spike: behave exactly like a full budget
-            self.stats["rejected"] += 1
+            self.stats["rejected" if kind == "session" else "cache_rejected"] += 1
             return False
         if nbytes > self.bytes_left:
-            self.stats["rejected"] += 1
+            self.stats["rejected" if kind == "session" else "cache_rejected"] += 1
             return False
         self._bytes_in_use += nbytes
-        self.stats["reserved"] += 1
+        if kind == "cache":
+            self._cache_bytes_in_use += nbytes
+            self.stats["cache_reserved"] += 1
+        else:
+            self.stats["reserved"] += 1
         self.stats["peak_bytes"] = max(self.stats["peak_bytes"], self._bytes_in_use)
         return True
 
-    def free(self, nbytes: int) -> None:
+    def free(self, nbytes: int, kind: str = "session") -> None:
         self._bytes_in_use -= int(nbytes)
+        if kind == "cache":
+            self._cache_bytes_in_use -= int(nbytes)
+            assert self._cache_bytes_in_use >= 0, (
+                "cache swap accounting went negative"
+            )
         assert self._bytes_in_use >= 0, "swap-pool accounting went negative"
 
 

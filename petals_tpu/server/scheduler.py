@@ -280,14 +280,20 @@ class SessionScheduler:
         return max(ages, default=0.0)
 
     def summary(self) -> dict:
+        # the ONE swap budget splits two ways: suspended sessions and the
+        # radix prefix cache's demoted nodes (kind="cache" reservations)
+        cache_bytes = getattr(self.swap_pool, "cache_bytes_in_use", 0)
         return {
             "policy": self.policy,
             "suspended": self.suspended_count,
             "swap_oldest_s": round(self.oldest_swap_age(), 1),
             "swap_bytes_in_use": self.swap_pool.bytes_in_use,
+            "swap_session_bytes": self.swap_pool.bytes_in_use - cache_bytes,
+            "swap_cache_bytes": cache_bytes,
             "swap_bytes_total": self.swap_pool.max_size_bytes,
             "swap_peak_bytes": self.swap_pool.stats["peak_bytes"],
             "swap_rejected": self.swap_pool.stats["rejected"],
+            "swap_cache_rejected": self.swap_pool.stats.get("cache_rejected", 0),
             **self.stats,
         }
 

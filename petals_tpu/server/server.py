@@ -97,6 +97,7 @@ class Server:
         prefix_cache_bytes: int = 256 * 2**20,  # host-RAM prompt-prefix cache; 0 disables
         prefix_share_scope: str = "swarm",  # "peer" isolates the prefix cache per client identity
         prefix_device_bytes: int = 256 * 2**20,  # HBM tier of the prefix cache; 0 disables
+        prefix_cache_policy: str = "radix",  # "radix" tree with tiering | "lru" flat baseline
         server_side_generation: bool = True,  # device-side greedy loop on full-span servers
         draft_model: Optional[str] = None,  # small checkpoint for speculative decoding
         spec_k: int = 4,  # drafts verified per lane per tick when draft_model is set
@@ -218,6 +219,7 @@ class Server:
         self.prefix_cache_bytes = prefix_cache_bytes
         self.prefix_share_scope = prefix_share_scope
         self.prefix_device_bytes = prefix_device_bytes
+        self.prefix_cache_policy = prefix_cache_policy
         self.server_side_generation = server_side_generation
         self.draft_model_path = draft_model
         self.spec_k = int(spec_k)
@@ -868,6 +870,7 @@ class Server:
             prefix_cache_bytes=self.prefix_cache_bytes,
             prefix_share_scope=self.prefix_share_scope,
             prefix_device_bytes=self.prefix_device_bytes,
+            prefix_cache_policy=self.prefix_cache_policy,
             server_gen_params=self._load_server_gen_params(),
             draft_model=self._load_draft_model(),
             spec_k=self.spec_k if self.draft_model_path else None,

@@ -161,8 +161,11 @@ SWAP_RESIDENCY_OLDEST = REGISTRY.gauge(
 )
 PREFIX_EVENTS = REGISTRY.counter(
     "petals_prefix_cache_events_total",
-    "Prefix-cache economics: probe hits/misses, page adoptions, evictions",
-    labels=("event",),  # hit | miss | adopt | evict
+    "Prefix-cache economics: probe hits/misses, page adoptions, and the "
+    "radix tree's tier transitions (demote/promote between host and the "
+    "swap tier, device_evict for dropped HBM refs, swap_evict and evict "
+    "for removed nodes)",
+    labels=("event",),  # hit | miss | adopt | evict | device_evict | demote | promote | swap_evict
 )
 
 # --- migration / chaos ------------------------------------------------------
@@ -235,6 +238,13 @@ LEDGER_NOISY_NEIGHBORS = REGISTRY.counter(
     "Noisy-neighbor detections: a peer over its dominant-resource share "
     "while other peers' admissions queued",
 )
+LEDGER_CACHE_BYTE_SECONDS = REGISTRY.counter(
+    "petals_ledger_cache_byte_seconds_total",
+    "Prefix-cache residency (bytes held across all tiers, integrated over "
+    "wall time) attributed to tenants by the resource ledger — a separate "
+    "channel from page-seconds, so the pool conservation invariant is "
+    "untouched; per-tenant breakdowns live in the /ledger JSON view",
+)
 
 # --- integrity observatory --------------------------------------------------
 # Digests themselves NEVER label a metric (unbounded cardinality; swarmlint's
@@ -286,6 +296,10 @@ PREFIX_HIT = PREFIX_EVENTS.labels(event="hit")
 PREFIX_MISS = PREFIX_EVENTS.labels(event="miss")
 PREFIX_ADOPT = PREFIX_EVENTS.labels(event="adopt")
 PREFIX_EVICT = PREFIX_EVENTS.labels(event="evict")
+PREFIX_DEVICE_EVICT = PREFIX_EVENTS.labels(event="device_evict")
+PREFIX_DEMOTE = PREFIX_EVENTS.labels(event="demote")
+PREFIX_PROMOTE = PREFIX_EVENTS.labels(event="promote")
+PREFIX_SWAP_EVICT = PREFIX_EVENTS.labels(event="swap_evict")
 FREE_RUN_BUCKETS = ("1", "2_3", "4_7", "8_15", "16_plus")
 PAGE_FREE_RUN_CHILDREN = {
     b: PAGE_FREE_RUNS.labels(bucket=b) for b in FREE_RUN_BUCKETS

@@ -212,6 +212,15 @@ class ResourceLedger:
         # page-second integrals into actual HBM bytes
         self.kv_quant: str = "none"
         self.kv_bytes_per_token: Optional[int] = None
+        # prefix-cache residency: per-tenant resident bytes (all tiers)
+        # pushed by the radix cache at every mutation boundary and integrated
+        # piecewise-constant like the page rates. A SEPARATE channel from
+        # page-seconds on purpose: cache residency must bill tenants without
+        # perturbing the pool conservation invariant (attributed +
+        # unattributed == pool_page_seconds) or the DRF resource vector.
+        self._cache_rates: Dict[str, float] = {}
+        self._cache_rollup: Dict[str, float] = {}
+        self.cache_byte_seconds = 0.0
 
     def set_kv_cost(self, kv_quant: str, bytes_per_token: int) -> None:
         """Record the paged pool's storage kind and per-token wire cost so
@@ -283,6 +292,25 @@ class ResourceLedger:
                     1.0 if (lane_set is None or key in lane_set) else 0.0
                 )
             self._pool_rate = max(float(pool_occupied), 0.0)
+
+    def set_cache_rates(self, peer_bytes: Dict[Optional[str], float]) -> None:
+        """Settle the elapsed interval, then install the prefix cache's new
+        per-tenant resident-byte rates (host + device + swap + pinned-page
+        bytes, summed per owning tenant). Tenants respect the same
+        cardinality bound as sessions: past ``max_peers``, new ones collapse
+        into the overflow rollup."""
+        with self._lock:
+            self._settle_locked(self._clock())
+            rates: Dict[str, float] = {}
+            for peer_id, nbytes in peer_bytes.items():
+                peer = normalize_peer(peer_id)
+                if peer not in self._known_peers:
+                    if len(self._known_peers) >= self.max_peers:
+                        peer = OVERFLOW_PEER
+                    else:
+                        self._known_peers.add(peer)
+                rates[peer] = rates.get(peer, 0.0) + max(float(nbytes), 0.0)
+            self._cache_rates = rates
 
     def note_compute(self, keys: Sequence[str], seconds: float) -> None:
         """Split one batched tick's wall time equally across the lanes that
@@ -369,12 +397,22 @@ class ResourceLedger:
         # transiently exceed the pool occupancy it was taken against.
         unattributed_inc = max(pool_inc - attributed, 0.0)
         self.unattributed_page_seconds += unattributed_inc
-        if attributed or unattributed_inc:
+        cache_inc = 0.0
+        if self._cache_rates:
+            for peer, rate in self._cache_rates.items():
+                if rate:
+                    inc = rate * dt
+                    self._cache_rollup[peer] = self._cache_rollup.get(peer, 0.0) + inc
+                    cache_inc += inc
+            self.cache_byte_seconds += cache_inc
+        if attributed or unattributed_inc or cache_inc:
             tm = _tm()
             if attributed:
                 tm.LEDGER_PAGE_SECONDS.inc(attributed)
             if unattributed_inc:
                 tm.LEDGER_UNATTRIBUTED_PAGE_SECONDS.inc(unattributed_inc)
+            if cache_inc:
+                tm.LEDGER_CACHE_BYTE_SECONDS.inc(cache_inc)
 
     # ----------------------------------------------------------------- reads
 
@@ -412,6 +450,13 @@ class ResourceLedger:
         for sess in self._sessions.values():
             _fold(out.setdefault(sess.peer, _zero_usage()), sess.totals)
         return out
+
+    def cache_residency(self) -> Dict[str, float]:
+        """Per-tenant prefix-cache byte-seconds accrued so far (lazy settle
+        up to now, like every other read)."""
+        with self._lock:
+            self._settle_locked(self._clock())
+            return dict(self._cache_rollup)
 
     def attributed_page_seconds(self) -> float:
         """Sum of every session's page-seconds (live + folded). Conservation:
@@ -549,6 +594,11 @@ class ResourceLedger:
     def _top_locked(self, k: int) -> List[dict]:
         shares = self._shares_locked(self._clock())
         totals = self._peer_totals_locked()
+        for peer in self._cache_rollup:
+            # a tenant can hold cache residency with no live/closed session
+            # (its sessions drained but its tree nodes survive them) — it
+            # must still show up in the bill
+            totals.setdefault(peer, _zero_usage())
         rows = []
         for peer, usage in totals.items():
             share, resource = shares.get(peer, (0.0, None))
@@ -562,6 +612,7 @@ class ResourceLedger:
                 "tokens": int(usage["prefill_tokens"] + usage["decode_tokens"]),
                 "swap_bytes": int(usage["swap_out_bytes"] + usage["swap_in_bytes"]),
                 "migrated_bytes": int(usage["migrated_bytes"]),
+                "cache_byte_s": round(self._cache_rollup.get(peer, 0.0), 1),
                 **derive_efficiency(usage),
             })
         rows.sort(key=lambda r: (-r["share"], -r["page_s"], -r["compute_s"], r["peer"]))
@@ -589,6 +640,7 @@ class ResourceLedger:
             "kv_bytes_per_token": self.kv_bytes_per_token,
             "pool_page_seconds": round(self.pool_page_seconds, 4),
             "unattributed_page_seconds": round(self.unattributed_page_seconds, 4),
+            "cache_byte_seconds": round(self.cache_byte_seconds, 1),
             "peer_overflows": self.peer_overflows,
             "noisy_events": self.noisy_events,
             "top": self._top_locked(k),
@@ -620,6 +672,7 @@ class ResourceLedger:
             "sessions": len(self._sessions),
             "page_s": round(page_s, 2),
             "compute_s": round(compute_s, 2),
+            "cache_byte_s": round(self.cache_byte_seconds, 1),
             "noisy": self.noisy_events,
             "top": [
                 [t["peer"][:16], t["share"], round(t["page_s"], 2)] for t in top

@@ -13,9 +13,19 @@ Models the shape of public-swarm load without owning production traffic:
 - **N-tenant prompt mix**: each tenant owns a fixed prompt prefix (drawn
   once from the seed) plus a per-session random suffix, so the prefix
   cache sees realistic reuse and the ledger sees distinct tenants.
+- **Prompt trees** (optional, ``tree_branching``): real multi-tenant
+  prompts nest — a swarm-shared system prompt, a per-tenant tool
+  preamble, then branching few-shot variants, then the random user turn.
+  With ``tree_branching=(b0, b1, ...)`` each session walks one path
+  through a per-tenant tree of content segments (level ``i`` picks among
+  ``b_i`` children), so prompts share progressively shorter prefixes the
+  deeper they diverge — exactly the workload a radix prefix tree exploits
+  and a flat LRU thrashes on. ``tree_hot_bias`` skews path choice toward
+  child 0 at every level, creating one hot lineage and a cold bushy rest.
 
 Everything derives from one ``random.Random(seed)`` in a fixed draw
-order; the schedule is pure data (no wall clock anywhere).
+order; the schedule is pure data (no wall clock anywhere). The tree
+fields draw NOTHING when disabled, so legacy seeds reproduce exactly.
 """
 
 from __future__ import annotations
@@ -38,6 +48,7 @@ class SessionPlan:
     tenant: int
     prompt: Tuple[int, ...]  # token ids
     new_tokens: int
+    path: Tuple[int, ...] = ()  # branch chosen at each tree level (tree mode)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,6 +65,11 @@ class TrafficConfig:
     min_new_tokens: int = 2  # Pareto x_m (scale)
     max_new_tokens: int = 16  # truncation cap (keeps CPU benches bounded)
     pareto_alpha: float = 1.5  # tail index; <2 = heavy tail, infinite variance
+    # prompt trees: () keeps flat prompts (and the legacy RNG stream)
+    shared_prefix_len: int = 0  # swarm-shared system prompt before the tenant prefix
+    tree_branching: Tuple[int, ...] = ()  # children per level of the per-tenant tree
+    tree_segment_len: int = 0  # tokens per tree-node segment
+    tree_hot_bias: float = 0.0  # P(child 0) at each level; rest uniform
 
     def __post_init__(self):
         if not 0.0 <= self.wave_amplitude <= 1.0:
@@ -64,6 +80,15 @@ class TrafficConfig:
             raise ValueError("need at least one tenant")
         if not 1 <= self.min_new_tokens <= self.max_new_tokens:
             raise ValueError("need 1 <= min_new_tokens <= max_new_tokens")
+        if self.tree_branching:
+            if any(b < 1 for b in self.tree_branching):
+                raise ValueError("tree_branching factors must be >= 1")
+            if self.tree_segment_len < 1:
+                raise ValueError("tree_branching requires tree_segment_len >= 1")
+        if not 0.0 <= self.tree_hot_bias <= 1.0:
+            raise ValueError("tree_hot_bias must be in [0, 1]")
+        if self.shared_prefix_len < 0:
+            raise ValueError("shared_prefix_len must be >= 0")
 
 
 class TrafficGenerator:
@@ -80,10 +105,18 @@ class TrafficGenerator:
         """The full deterministic schedule for ``duration_s`` seconds."""
         cfg = self.config
         rng = random.Random(cfg.seed)
+        # draw order is load-bearing: shared root, then tenant prefixes, then
+        # tree segments (tenant-major, depth-first), then the arrival loop —
+        # and the tree draws happen ONLY in tree mode, so flat-config seeds
+        # keep producing the schedules they always have
+        shared = tuple(
+            rng.randrange(1, cfg.vocab_size) for _ in range(cfg.shared_prefix_len)
+        )
         prefixes = [
             tuple(rng.randrange(1, cfg.vocab_size) for _ in range(cfg.prompt_prefix_len))
             for _ in range(cfg.tenants)
         ]
+        trees = [self._draw_tree(rng) for _ in range(cfg.tenants)]
         peak = cfg.base_rate * (1.0 + cfg.wave_amplitude)
         plans: List[SessionPlan] = []
         t = 0.0
@@ -97,6 +130,13 @@ class TrafficGenerator:
             if rng.random() >= self.rate_at(t) / peak:
                 continue
             tenant = rng.randrange(cfg.tenants)
+            path: Tuple[int, ...] = ()
+            tree_tokens: Tuple[int, ...] = ()
+            if cfg.tree_branching:
+                path = self._draw_path(rng)
+                nodes = trees[tenant]
+                for depth in range(1, len(path) + 1):
+                    tree_tokens += nodes[path[:depth]]
             suffix = tuple(
                 rng.randrange(1, cfg.vocab_size) for _ in range(cfg.prompt_suffix_len)
             )
@@ -109,8 +149,49 @@ class TrafficGenerator:
                     index=len(plans),
                     t=t,
                     tenant=tenant,
-                    prompt=prefixes[tenant] + suffix,
+                    prompt=shared + prefixes[tenant] + tree_tokens + suffix,
                     new_tokens=new_tokens,
+                    path=path,
                 )
             )
         return plans
+
+    def _draw_tree(self, rng: random.Random) -> dict:
+        """One tenant's content tree: ``{path: segment_tokens}`` for every
+        node, drawn depth-first child-major so the layout (and thus every
+        prompt) is a pure function of the seed."""
+        cfg = self.config
+        nodes: dict = {}
+
+        def expand(path: Tuple[int, ...]) -> None:
+            level = len(path)
+            if level == len(cfg.tree_branching):
+                return
+            for b in range(cfg.tree_branching[level]):
+                child = path + (b,)
+                nodes[child] = tuple(
+                    rng.randrange(1, cfg.vocab_size)
+                    for _ in range(cfg.tree_segment_len)
+                )
+                expand(child)
+
+        if cfg.tree_branching:
+            expand(())
+        return nodes
+
+    def _draw_path(self, rng: random.Random) -> Tuple[int, ...]:
+        """One root-to-leaf walk. ``tree_hot_bias`` concentrates mass on
+        child 0 at every level: bias 0 is uniform, bias 1 always takes the
+        hot lineage — the knob that turns one subtree hot and the rest
+        into cache-thrashing cold bulk."""
+        cfg = self.config
+        path = []
+        for branching in cfg.tree_branching:
+            if branching == 1:
+                path.append(0)
+                continue
+            if rng.random() < cfg.tree_hot_bias:
+                path.append(0)
+            else:
+                path.append(rng.randrange(branching))
+        return tuple(path)

@@ -82,7 +82,7 @@ import asyncio  # noqa: E402
 
 import pytest  # noqa: E402
 
-_SANITIZED_LANES = ("sched", "mixed", "pages", "telemetry", "chaos", "traffic", "integrity", "kernel", "spec", "kvquant")
+_SANITIZED_LANES = ("sched", "mixed", "pages", "telemetry", "chaos", "traffic", "integrity", "kernel", "spec", "kvquant", "radix")
 
 
 @pytest.fixture(autouse=True)

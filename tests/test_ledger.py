@@ -318,9 +318,57 @@ def test_snapshot_digest_shapes():
     assert all(f in live for f in USAGE_FIELDS)
 
     dig = led.digest(k=2)
-    assert set(dig) == {"peers", "sessions", "page_s", "compute_s", "noisy", "top"}
+    assert set(dig) == {
+        "peers", "sessions", "page_s", "compute_s", "cache_byte_s", "noisy", "top"
+    }
     assert dig["top"][0][0] == "peer-a"  # [peer16, share, page_s] triples
     json.dumps(dig)  # must be announce-serializable
+
+
+def test_cache_residency_channel_is_conservation_neutral():
+    """The prefix cache's set_cache_rates bills per-tenant resident bytes
+    through a SEPARATE channel: byte-seconds integrate piecewise-constant
+    like page-seconds, show up in snapshot/top/digest, and leave both the
+    page-second conservation identity and the DRF vectors untouched."""
+    led, clock = make_ledger()
+    a = led.open_session("peer-a")
+    led.set_rates({a: 2.0}, 2.0)
+    led.set_cache_rates({"peer-a": 1000.0, "peer-b": 3000.0})
+    clock.advance(2.0)
+    resid = led.cache_residency()
+    assert resid["peer-a"] == pytest.approx(2000.0)
+    assert resid["peer-b"] == pytest.approx(6000.0)
+    # rate change settles the old interval first
+    led.set_cache_rates({"peer-a": 500.0})
+    clock.advance(1.0)
+    resid = led.cache_residency()
+    assert resid["peer-a"] == pytest.approx(2500.0)
+    assert resid["peer-b"] == pytest.approx(6000.0)  # rate dropped to 0
+
+    # conservation: cache billing added NOTHING to the page-second books
+    assert led.pool_page_seconds == pytest.approx(6.0)
+    assert led.attributed_page_seconds() == pytest.approx(6.0)
+    # ...and nothing to the DRF vector (peer-b never held a page)
+    assert led.peer_dominant_share("peer-b") == 0.0
+
+    snap = led.snapshot(k=3)
+    assert snap["cache_byte_seconds"] == pytest.approx(8500.0)
+    by_peer = {row["peer"]: row for row in snap["top"]}
+    assert by_peer["peer-a"]["cache_byte_s"] == pytest.approx(2500.0)
+    # a cache-only tenant still gets a top row (zero usage, billed bytes)
+    assert by_peer["peer-b"]["cache_byte_s"] == pytest.approx(6000.0)
+    json.dumps(led.digest(k=2))
+
+
+def test_cache_rates_respect_peer_cardinality_bound():
+    led, clock = make_ledger(max_peers=2)
+    led.set_cache_rates({f"peer-{i}": 100.0 for i in range(5)})
+    clock.advance(1.0)
+    resid = led.cache_residency()
+    # past max_peers the rest collapse into the overflow rollup
+    assert OVERFLOW_PEER in resid
+    assert resid[OVERFLOW_PEER] == pytest.approx(300.0)
+    assert sum(resid.values()) == pytest.approx(500.0)
 
 
 # --------------------------------------------------- scheduler integration
@@ -452,7 +500,7 @@ def test_ledger_endpoint_and_metrics():
         led.close_session(key)
     digest = telemetry_digest()
     assert set(digest["ledger"]) == {
-        "peers", "sessions", "page_s", "compute_s", "noisy", "top"
+        "peers", "sessions", "page_s", "compute_s", "cache_byte_s", "noisy", "top"
     }
 
 

@@ -1,0 +1,326 @@
+"""Radix prefix tree (server/prefix_cache.py RadixPrefixCache): tree links
+over the hash-chain keys, leaf-first eviction that protects hot shared
+interior nodes, host<->swap demote/promote round-trips against the shared
+HostSwapPool budget, the HBM tier's device_evict accounting, the
+worth_storing device-tier fix, greedy-tenant DRF victim ordering, and
+per-tenant cache-residency billing through the resource ledger."""
+
+import numpy as np
+import pytest
+
+from petals_tpu.server.memory_cache import HostSwapPool
+from petals_tpu.server.prefix_cache import (
+    PROMOTE_MIN_HITS,
+    SEGMENT_TOKENS,
+    PrefixCache,
+    RadixPrefixCache,
+)
+from petals_tpu.telemetry import instruments as tm
+from petals_tpu.telemetry.ledger import ResourceLedger
+
+pytestmark = pytest.mark.radix
+
+N_BLOCKS, HKV, HEAD, HIDDEN = 1, 1, 4, 4
+
+
+def chain_arrays(n_segments: int, seed: int = 0):
+    """Span-shaped k/v/out covering ``n_segments`` full segments."""
+    rng = np.random.default_rng(seed)
+    tokens = n_segments * SEGMENT_TOKENS
+    k = rng.standard_normal((N_BLOCKS, 1, tokens, HKV, HEAD)).astype(np.float32)
+    v = rng.standard_normal((N_BLOCKS, 1, tokens, HKV, HEAD)).astype(np.float32)
+    out = rng.standard_normal((1, tokens, HIDDEN)).astype(np.float32)
+    return k, v, out
+
+
+def entry_nbytes() -> int:
+    k, v, out = chain_arrays(1)
+    return k.nbytes + v.nbytes + out.nbytes
+
+
+ENTRY = entry_nbytes()
+
+
+def put_chain(cache, keys, tenant=None, seed=0, first=0):
+    k, v, out = chain_arrays(len(keys) - first, seed=seed)
+    cache.put(keys, first, k, v, out, tenant=tenant)
+
+
+# ---------------------------------------------------------------- tree links
+
+
+def test_tree_links_depth_and_branching():
+    cache = RadixPrefixCache(max_bytes=100 * ENTRY)
+    put_chain(cache, ["a0", "a1", "a2"])
+    put_chain(cache, ["a0", "a1", "b2"], seed=1)
+
+    store = cache._store
+    assert store["a0"]["parent"] is None and store["a0"]["depth"] == 0
+    assert store["a1"]["parent"] == "a0" and store["a1"]["depth"] == 1
+    assert store["a2"]["parent"] == "a1" and store["b2"]["parent"] == "a1"
+    assert store["a1"]["children"] == {"a2", "b2"}
+    # the shared prefix was stored once: the second chain's re-store of
+    # a0/a1 touched the existing nodes instead of duplicating them
+    assert cache.stats["stored_segments"] == 4
+
+    s = cache.summary()
+    assert s["policy"] == "radix"
+    assert s["segments"] == 4 and s["max_depth"] == 2
+    assert s["host_segments"] == 4 and s["swap_segments"] == 0
+
+    assert cache.probe(["a0", "a1", "b2"]) == 3
+    assert cache.probe(["a0", "a1", "zz"]) == 2  # longest cached path
+
+
+def test_leaf_first_eviction_protects_hot_shared_prefix():
+    # no swap pool: demotion impossible, radix eviction must still be
+    # leaf-first and economics-ranked
+    cache = RadixPrefixCache(max_bytes=4 * ENTRY)
+    put_chain(cache, ["s0", "s1"], seed=0)
+    put_chain(cache, ["c0", "c1"], seed=1)
+    for _ in range(3):
+        assert cache.probe(["s0", "s1"]) == 2  # the hot shared prefix
+
+    # one more entry than fits: the cold chain's leaf goes first
+    put_chain(cache, ["s0", "s1", "x2"], seed=2)
+    assert "c1" not in cache._store
+    assert {"s0", "s1", "x2"} <= set(cache._store)
+
+    # keep pushing: c0 (now a cold leaf) is evicted before any hot node
+    put_chain(cache, ["s0", "s1", "x2", "x3"], seed=3)
+    assert "c0" not in cache._store
+    assert {"s0", "s1", "x2", "x3"} <= set(cache._store)
+    assert cache.stats["evictions"] == 2
+    # interior hot node s0 was never removed while s1 survived
+    assert cache._store["s1"]["parent"] == "s0"
+
+
+def test_lru_policy_is_the_flat_baseline():
+    pool = HostSwapPool(100 * ENTRY)
+    cache = RadixPrefixCache(max_bytes=3 * ENTRY, policy="lru", swap_pool=pool)
+    put_chain(cache, ["a0", "a1", "a2"])
+    for _ in range(5):
+        cache.probe(["a0"])  # heat is invisible to the flat policy
+    put_chain(cache, ["b0"], seed=1)
+    put_chain(cache, ["b1"], seed=2)
+    # insertion/touch order: a1, a2 evicted (a0 was touched by the probes);
+    # nothing demotes to swap under the flat baseline
+    assert "a1" not in cache._store and "a2" not in cache._store
+    assert "a0" in cache._store
+    assert cache.stats["demotions"] == 0 and cache.swap_bytes == 0
+    assert pool.cache_bytes_in_use == 0
+    assert cache.summary()["policy"] == "lru"
+
+
+# ------------------------------------------------------------- swap tier
+
+
+def test_demote_promote_roundtrip_against_shared_pool():
+    pool = HostSwapPool(100 * ENTRY)
+    cache = RadixPrefixCache(max_bytes=2 * ENTRY, swap_pool=pool)
+    put_chain(cache, ["a0", "a1"], seed=0)
+    put_chain(cache, ["b0", "b1"], seed=1)
+
+    # the a-chain demoted leaf-first into the swap tier, not evicted
+    assert cache._store["a0"]["swapped"] and cache._store["a1"]["swapped"]
+    assert cache.stats["demotions"] == 2 and cache.stats["evictions"] == 0
+    assert cache.swap_bytes == 2 * ENTRY
+    assert pool.cache_bytes_in_use == 2 * ENTRY
+    assert pool.bytes_in_use == 2 * ENTRY
+    s = cache.summary()
+    assert s["swap_segments"] == 2 and s["host_segments"] == 2
+
+    # a probe of the swapped chain promotes it back to host, displacing
+    # the colder b-chain into swap — the round trip conserves pool bytes
+    assert cache.probe(["a0", "a1"]) == 2
+    assert not cache._store["a0"]["swapped"] and not cache._store["a1"]["swapped"]
+    assert cache._store["b0"]["swapped"] and cache._store["b1"]["swapped"]
+    assert cache.stats["promotions"] >= 2
+    assert pool.cache_bytes_in_use == 2 * ENTRY == cache.swap_bytes
+
+    # eviction from swap / clear() returns every reserved byte
+    cache.clear()
+    assert pool.cache_bytes_in_use == 0 and pool.bytes_in_use == 0
+    assert cache.current_bytes == 0 and cache.swap_bytes == 0
+
+
+def test_swap_cap_and_swap_tier_eviction():
+    # pool budget 4 entries but the cache may only hold swap_frac = 1/4 of
+    # it: one demoted node fits, the second must evict the first
+    pool = HostSwapPool(4 * ENTRY)
+    cache = RadixPrefixCache(max_bytes=1 * ENTRY, swap_pool=pool, swap_frac=0.25)
+    put_chain(cache, ["a0"], seed=0)
+    put_chain(cache, ["b0"], seed=1)  # a0 -> swap
+    assert cache._store["a0"]["swapped"]
+    put_chain(cache, ["c0"], seed=2)  # b0 -> swap, a0 falls off the end
+    assert "a0" not in cache._store
+    assert cache.stats["swap_evictions"] == 1
+    assert cache.swap_bytes == ENTRY == pool.cache_bytes_in_use
+    # the session side of the budget was never touched
+    assert pool.stats["reserved"] == 0 and pool.stats["rejected"] == 0
+
+
+def test_session_swap_and_cache_swap_share_one_budget():
+    pool = HostSwapPool(3 * ENTRY)
+    # a session swap entry eats 2 of the 3 slots
+    assert pool.try_reserve(2 * ENTRY)
+    cache = RadixPrefixCache(max_bytes=1 * ENTRY, swap_pool=pool, swap_frac=1.0)
+    put_chain(cache, ["a0"], seed=0)
+    put_chain(cache, ["b0"], seed=1)  # a0 -> swap: exactly one slot left
+    assert cache._store["a0"]["swapped"]
+    assert pool.bytes_in_use == 3 * ENTRY
+    assert pool.cache_bytes_in_use == ENTRY
+    # pool full: the next demotion can only succeed by evicting a0
+    put_chain(cache, ["c0"], seed=2)
+    assert "a0" not in cache._store
+    assert pool.bytes_in_use == 3 * ENTRY  # conserved: 2 session + 1 cache
+    pool.free(2 * ENTRY)
+    cache.clear()
+    assert pool.bytes_in_use == 0
+
+
+# ------------------------------------------------------------ device tier
+
+
+def test_device_evict_counter_and_per_tier_summary():
+    import jax.numpy as jnp
+
+    k, v, out = chain_arrays(1, seed=0)
+    kd, vd = jnp.asarray(k), jnp.asarray(v)
+    dev_entry = int(kd.nbytes) + int(vd.nbytes)
+
+    cache = RadixPrefixCache(max_bytes=100 * ENTRY, device_max_bytes=dev_entry)
+    e0 = tm.PREFIX_DEVICE_EVICT.value
+    cache.put(["a0"], 0, k, v, out, k_dev=kd, v_dev=vd)
+    assert "kd" in cache._store["a0"]
+    s = cache.summary()
+    assert s["device_segments"] == 1 and s["device_bytes"] == dev_entry
+    assert s["hbm_bytes"] == dev_entry and s["bytes"] == ENTRY
+
+    # the budget holds exactly one device entry: attaching a second drops
+    # the coldest first and the drop is COUNTED (stat + metric child)
+    k2, v2, out2 = chain_arrays(1, seed=1)
+    cache.put(["b0"], 0, k2, v2, out2, k_dev=jnp.asarray(k2), v_dev=jnp.asarray(v2))
+    assert "kd" not in cache._store["a0"] and "kd" in cache._store["b0"]
+    assert cache.stats["device_evictions"] == 1
+    assert tm.PREFIX_DEVICE_EVICT.value == e0 + 1
+    s = cache.summary()
+    assert s["device_segments"] == 1 and s["device_bytes"] == dev_entry
+    # the evicted node kept its host copy: eviction only downgraded the hit
+    assert cache.probe(["a0"]) == 1
+
+
+def test_maybe_promote_device_uploads_hot_path():
+    cache = RadixPrefixCache(max_bytes=100 * ENTRY, device_max_bytes=100 * ENTRY)
+    put_chain(cache, ["a0", "a1"])
+    assert cache.maybe_promote_device(["a0", "a1"], 2) == 0  # cold: no upload
+    for _ in range(PROMOTE_MIN_HITS):
+        cache.probe(["a0", "a1"])
+    assert cache.maybe_promote_device(["a0", "a1"], 2) == 2
+    assert "kd" in cache._store["a0"] and "kd" in cache._store["a1"]
+    assert cache.maybe_promote_device(["a0", "a1"], 2) == 0  # idempotent
+    # the lru policy never uploads (no economics to justify HBM residency)
+    flat = RadixPrefixCache(max_bytes=100 * ENTRY, device_max_bytes=100 * ENTRY,
+                            policy="lru")
+    put_chain(flat, ["f0"])
+    for _ in range(PROMOTE_MIN_HITS + 1):
+        flat.probe(["f0"])
+    assert flat.maybe_promote_device(["f0"], 1) == 0
+
+
+def test_worth_storing_sees_the_device_tier():
+    """A host-resident hot entry must report worth_storing=True for a
+    device-capable store — before the fix it reported 'nothing to add' and
+    was locked out of the HBM tier forever."""
+    cache = RadixPrefixCache(max_bytes=100 * ENTRY, device_max_bytes=100 * ENTRY)
+    put_chain(cache, ["a0", "a1"])  # host-only store (no device arrays)
+    # fully cached, host-capable only: nothing to add
+    assert not cache.worth_storing(["a0", "a1"], 0, ENTRY)
+    # ...but a device-capable pass CAN add HBM residency
+    assert cache.worth_storing(["a0", "a1"], 0, ENTRY, device_capable=True)
+
+    import jax.numpy as jnp
+
+    k, v, out = chain_arrays(2)
+    cache.put(["a0", "a1"], 0, k, v, out, k_dev=jnp.asarray(k), v_dev=jnp.asarray(v))
+    assert "kd" in cache._store["a0"]
+    # device-resident now: a further device-capable store adds nothing
+    assert not cache.worth_storing(["a0", "a1"], 0, ENTRY, device_capable=True)
+    # without a device budget the flag is inert
+    hostonly = RadixPrefixCache(max_bytes=100 * ENTRY)
+    put_chain(hostonly, ["h0"])
+    assert not hostonly.worth_storing(["h0"], 0, ENTRY, device_capable=True)
+
+
+# ------------------------------------------------- tenant-share enforcement
+
+
+def test_greedy_tenant_demotes_and_evicts_first():
+    """The satellite-3 scenario: one greedy tenant fills the cache with a
+    deep cold subtree while light tenants churn a hot shared prefix — under
+    pressure the hog's nodes demote/evict first, the shared prefix keeps its
+    residency, and the ledger bills residency to the right peers."""
+    shares = {"hog": 0.9, "light-a": 0.05, "light-b": 0.05}
+    clock = {"t": 0.0}
+    led = ResourceLedger(clock=lambda: clock["t"], window_s=10.0)
+    pool = HostSwapPool(2 * ENTRY)
+    cache = RadixPrefixCache(
+        max_bytes=6 * ENTRY, swap_pool=pool, swap_frac=1.0,
+        usage_fn=lambda p: shares.get(p, 0.0), ledger=led,
+    )
+
+    shared = ["s0", "s1"]
+    put_chain(cache, shared, tenant="light-a", seed=0)
+    hog_chain = ["s0", "s1", "g2", "g3", "g4", "g5"]
+    put_chain(cache, hog_chain, tenant="hog", seed=1)  # cache now full
+    for _ in range(4):  # light tenants churn the shared prefix
+        assert cache.probe(shared) == 2
+
+    # a light tenant stores a new branch: pressure lands on the hog
+    put_chain(cache, ["s0", "s1", "l2", "l3"], tenant="light-b", seed=2)
+
+    store = cache._store
+    # the hot shared prefix never left the host tier
+    assert not store["s0"]["swapped"] and not store["s1"]["swapped"]
+    # the new branch is resident
+    assert not store["l2"]["swapped"] and not store["l3"]["swapped"]
+    # every byte the pressure displaced came out of the hog's subtree
+    displaced = [k for k in ("g2", "g3", "g4", "g5")
+                 if k not in store or store[k]["swapped"]]
+    assert len(displaced) == 2  # 2 entries had to move for l2+l3
+    assert cache.stats["demotions"] >= 1
+    assert all(not store[k]["swapped"] for k in ("s0", "s1", "l2", "l3"))
+    # demoted hog bytes are charged to the shared pool, tagged as cache
+    assert pool.cache_bytes_in_use == cache.swap_bytes > 0
+
+    # ledger attribution: advance time and read the residency integral —
+    # the hog pays for its subtree, light tenants only for theirs
+    clock["t"] += 10.0
+    resid = led.cache_residency()
+    assert resid["hog"] > 0
+    assert resid["light-a"] > 0 and resid["light-b"] > 0
+    # hog holds 4 entries (host + swap) vs 2 per light tenant
+    assert resid["hog"] > resid["light-b"]
+    # the residency channel must not perturb page-second conservation
+    assert led.pool_page_seconds == 0.0
+    assert led.attributed_page_seconds() == 0.0
+
+
+def test_usage_fn_failure_degrades_to_economics():
+    def broken(peer):
+        raise RuntimeError("ledger offline")
+
+    cache = RadixPrefixCache(max_bytes=2 * ENTRY, usage_fn=broken)
+    put_chain(cache, ["a0"], tenant="x", seed=0)
+    put_chain(cache, ["b0"], tenant="y", seed=1)
+    for _ in range(3):
+        cache.probe(["b0"])
+    put_chain(cache, ["c0"], tenant="z", seed=2)  # must not raise
+    assert "a0" not in cache._store  # coldest-first, shares all 0.0
+    assert "b0" in cache._store
+
+
+def test_flat_alias_and_policy_validation():
+    assert PrefixCache is RadixPrefixCache
+    with pytest.raises(ValueError):
+        RadixPrefixCache(max_bytes=1024, policy="mru")

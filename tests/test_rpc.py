@@ -42,7 +42,9 @@ def test_serialize_bf16_roundtrip():
 
 
 def test_serialize_fp16_compression():
-    arr = np.random.randn(8, 8).astype(np.float32)
+    # seeded: fp16 spacing above |4| is 2^-8, whose rounding error can
+    # exceed the 1e-3 tolerance on an unlucky unseeded tail draw
+    arr = np.random.RandomState(7).randn(8, 8).astype(np.float32)
     wire = serialize_array(arr, CompressionType.FLOAT16)
     assert len(wire["data"]) == arr.size * 2
     out = deserialize_array(wire)

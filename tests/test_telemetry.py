@@ -909,7 +909,9 @@ def test_page_pool_economics_units():
     entry_bytes = 2 * seg_kv.nbytes + seg_out.nbytes
     h0, m0 = tm.PREFIX_HIT.value, tm.PREFIX_MISS.value
     e0 = tm.PREFIX_EVICT.value
-    cache = PrefixCache(max_bytes=2 * entry_bytes + 10)
+    # the flat baseline pins insertion-order eviction; the default radix
+    # policy would protect the probed-hot "a" and evict "b" instead
+    cache = PrefixCache(max_bytes=2 * entry_bytes + 10, policy="lru")
     cache.put(["a"], 0, seg_kv, seg_kv, seg_out)
     assert cache.probe(["a"]) == 1 and tm.PREFIX_HIT.value == h0 + 1
     assert cache.probe(["nope"]) == 0 and tm.PREFIX_MISS.value == m0 + 1
