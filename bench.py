@@ -2013,6 +2013,9 @@ def _gate_row_registry():
         "gate_radix_cache": lambda: __import__(
             "benchmarks.bench_radix_prefix", fromlist=["gate_bench"]
         ).gate_bench("gate_radix_cache"),
+        "gate_disagg_handoff": lambda: __import__(
+            "benchmarks.bench_disagg", fromlist=["gate_bench"]
+        ).gate_bench("gate_disagg_handoff"),
     }
 
 

@@ -12,6 +12,7 @@ from petals_tpu.chaos.plane import (
     SITE_ANNOUNCE,
     SITE_DHT_LOOKUP,
     SITE_HANDLER_STEP,
+    SITE_HANDOFF_PUSH,
     SITE_INTEGRITY_CORRUPT,
     SITE_MIGRATE_PUSH,
     SITE_RPC_CALL,
@@ -48,6 +49,7 @@ __all__ = [
     "SITE_ANNOUNCE",
     "SITE_DHT_LOOKUP",
     "SITE_HANDLER_STEP",
+    "SITE_HANDOFF_PUSH",
     "SITE_INTEGRITY_CORRUPT",
     "SITE_MIGRATE_PUSH",
     "SITE_RPC_CALL",

@@ -51,6 +51,7 @@ SITE_RPC_STREAM = "rpc.stream_open"  # client stream open (detail: method)
 SITE_RPC_STREAM_RECV = "rpc.stream_recv"  # client mid-stream receive (detail: method)
 SITE_HANDLER_STEP = "handler.step"  # server inference-step boundary
 SITE_MIGRATE_PUSH = "migrate.push"  # server->server session_migrate push
+SITE_HANDOFF_PUSH = "handoff.push"  # prefill->decode KV handoff push (disagg)
 SITE_ANNOUNCE = "dht.announce"  # server's periodic DHT announce
 SITE_DHT_LOOKUP = "dht.lookup"  # client route discovery (module-info fetch)
 SITE_SWAP_RESERVE = "swap.reserve"  # host swap-pool budget reservation
@@ -62,6 +63,7 @@ SITES = (
     SITE_RPC_STREAM_RECV,
     SITE_HANDLER_STEP,
     SITE_MIGRATE_PUSH,
+    SITE_HANDOFF_PUSH,
     SITE_ANNOUNCE,
     SITE_DHT_LOOKUP,
     SITE_SWAP_RESERVE,
@@ -292,6 +294,7 @@ __all__ = [
     "SITE_ANNOUNCE",
     "SITE_DHT_LOOKUP",
     "SITE_HANDLER_STEP",
+    "SITE_HANDOFF_PUSH",
     "SITE_INTEGRITY_CORRUPT",
     "SITE_MIGRATE_PUSH",
     "SITE_RPC_CALL",

@@ -50,6 +50,15 @@ def render_top(summary: dict) -> str:
             f"{agg.get('ledger_compute_s', 0.0):.1f} compute-s, "
             f"{agg.get('noisy_neighbor_events', 0)} noisy-neighbor events"
         )
+        tiers = agg.get("tiers") or {}
+        if tiers.get("prefill") or tiers.get("decode"):
+            # disaggregated swarm: per-tier replica split + handoff volume
+            lines.append(
+                f"  tiers: {tiers.get('generalist', 0)} generalist / "
+                f"{tiers.get('prefill', 0)} prefill / {tiers.get('decode', 0)} decode, "
+                f"handoff {agg.get('handoff_bytes', 0) / 2**20:.1f} MiB "
+                f"({agg.get('handoff_bytes_s', 0.0) / 2**10:.1f} KiB/s)"
+            )
         rows = agg.get("top_consumers") or []
         if not rows:
             lines.append("  (no ledger digests announced yet)")

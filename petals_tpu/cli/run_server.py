@@ -161,6 +161,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "tree with three-tier residency (HBM / host / swap) and "
                              "tenant-fair eviction; 'lru' is the flat insertion-order "
                              "baseline (A/B comparisons)")
+    parser.add_argument("--phase_tier", choices=["generalist", "prefill", "decode"],
+                        default="generalist",
+                        help="Disaggregated serving tier announced to the swarm: 'prefill' "
+                             "replicas soak heavy prompt processing and hand the finished KV "
+                             "to a 'decode' replica over the server-to-server page-push path; "
+                             "'generalist' (default) serves both phases")
     parser.add_argument("--prefix_share_scope", choices=["swarm", "peer"], default="swarm",
                         help="'swarm' shares cached prefixes across all clients (fastest; a client "
                              "can time-probe whether a prompt prefix was recently served); 'peer' "
@@ -270,6 +276,7 @@ def main(argv=None) -> None:
         draft_window=args.draft_window,
         draft_quant_type=args.draft_quant_type,
         metrics_port=args.metrics_port,
+        phase_tier=args.phase_tier,
     )
 
     async def run():

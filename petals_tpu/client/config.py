@@ -63,7 +63,29 @@ class ClientConfig:
     # with a journaled reason (journal kind "export_fallback").
     kv_export_timeout: float = 120.0
 
+    # disaggregated serving (phase tiers): a session whose FIRST step feeds
+    # at least `prefill_tier_tokens` tokens routes as a "prefill"-phase
+    # request (preferring prefill-tier replicas), anything lighter routes as
+    # "decode"-phase; swarms with no tiered servers are unaffected either
+    # way. With `disagg_handoff` on, a session that prefilled on a
+    # prefill-tier replica hands its finished KV to a decode-tier replica
+    # over the server-to-server page-push path after the first step (adopt
+    # at the destination, zero KV bytes on the client link); a failed
+    # handoff degrades to colocated decode on the prefill replica.
+    prefill_tier_tokens: int = 256
+    disagg_handoff: bool = True
+    # deadline for the server-to-server handoff push (seconds)
+    handoff_timeout: float = 30.0
+
     def __post_init__(self):
+        if self.prefill_tier_tokens <= 0:
+            raise ValueError(
+                f"prefill_tier_tokens must be positive, got {self.prefill_tier_tokens}"
+            )
+        if self.handoff_timeout <= 0:
+            raise ValueError(
+                f"handoff_timeout must be positive, got {self.handoff_timeout}"
+            )
         if self.kv_export_timeout <= 0:
             raise ValueError(
                 f"kv_export_timeout must be positive, got {self.kv_export_timeout}"

@@ -334,6 +334,12 @@ class InferenceSession:
         # prompt-prefix routing affinity: same prompt -> same replicas ->
         # server-side prefix-cache hits (sequence_manager._edge_cost)
         self._affinity_seed: Optional[int] = None
+        # disaggregated serving: the phase this session routed as ("prefill"
+        # when the first step carries >= config.prefill_tier_tokens tokens,
+        # else "decode"; None until a route exists) plus the handoff tally
+        # the bench gate asserts on (happy path: adopts only, zero fallbacks)
+        self._phase: Optional[str] = None
+        self._handoff_stats = {"adopted": 0, "fallback": 0, "replayed": 0}
         # one trace id for the whole session, minted at the client: every
         # server span (including repaired replacements) opens with it, so the
         # session's full life is one causal timeline in swarm telemetry
@@ -453,6 +459,11 @@ class InferenceSession:
 
         self._position += n_input_tokens
         self._account_step(time.perf_counter() - t_step0, n_input_tokens)
+        if self._steps == 1 and self._phase == "prefill":
+            # prefill done, decode begins: hand the finished KV to a
+            # decode-tier replica over the page-push path (step boundary —
+            # the cut equals the position, so the adopt never replays)
+            await self._maybe_phase_handoff()
         await self._maybe_check_route_upgrade()
         return inputs
 
@@ -618,12 +629,19 @@ class InferenceSession:
         # established one: a refused/dropped session open bans the hop (see
         # _enter_server_sessions) and we re-route with the same backoff
         # discipline as step()'s retry loop
+        # phase-tier routing: a heavy first step is a prefill — prefer
+        # prefill-tier replicas; light first steps route decode-ward. In a
+        # swarm with no tiered servers the phase kwarg scores nothing.
+        if self._phase is None:
+            heavy = hidden.shape[1] >= self.seq_manager.config.prefill_tier_tokens
+            self._phase = "prefill" if heavy else "decode"
         attempt = 0
         while True:
             chain = await self.seq_manager.make_sequence(
                 0, self.num_blocks, mode="min_latency",
                 cache_tokens_needed=self.batch_size * self.max_length,
                 affinity_seed=self._affinity_seed,
+                phase=self._phase,
             )
             try:
                 self._sessions = await self._enter_server_sessions(chain)
@@ -641,6 +659,140 @@ class InferenceSession:
                     f"retrying in {delay:.1f}s: {e}"
                 )
                 await asyncio.sleep(delay)
+
+    async def _maybe_phase_handoff(self) -> None:
+        """Disaggregated prefill->decode handoff: the session just finished
+        its prefill on (at least one) prefill-tier replica — re-route the
+        decode phase onto decode-tier replicas and move the finished KV
+        server-to-server over the page-push path (``ptu.session_handoff`` on
+        the source, ``kv_adopt`` at the destination). The cut lands exactly
+        on the step boundary, so the adopt never replays and zero KV bytes
+        cross the client link. Any failure degrades to colocated decode on
+        the prefill replica — the current chain keeps serving — with the
+        fallback journaled (kind ``handoff_fallback``)."""
+        cfg = self.seq_manager.config
+        self._phase = "decode"  # subsequent routing (repairs) scores decode-ward
+        if not getattr(cfg, "disagg_handoff", True) or self._position == 0:
+            return
+        current = [s for s in self._sessions if not s.closed]
+        if not current or not any(
+            getattr(s.span.server_info, "phase_tier", None) == "prefill"
+            for s in current
+        ):
+            return  # nothing prefill-tiered to hand off from
+        from petals_tpu.telemetry import get_journal
+
+        def fallback(reason: str) -> None:
+            self._handoff_stats["fallback"] += 1
+            get_journal().event(
+                "handoff_fallback", trace_id=self.trace_id, reason=reason,
+            )
+            logger.info(f"Phase handoff skipped, decoding colocated: {reason}")
+
+        try:
+            candidate = await self.seq_manager.make_sequence(
+                0, self.num_blocks, mode="min_latency",
+                cache_tokens_needed=self.batch_size * self.max_length,
+                affinity_seed=self._affinity_seed, phase="decode",
+            )
+        except Exception as e:
+            fallback(f"decode routing failed: {e!r}")
+            return
+        # the handoff moves whole spans: the decode chain must cut at the
+        # same block boundaries as the prefill chain (otherwise the KV on
+        # the source does not map 1:1 onto a destination session)
+        if [(c.start, c.end) for c in candidate] != [
+            (s.span.start, s.span.end) for s in current
+        ]:
+            fallback("decode chain spans misaligned with prefill chain")
+            return
+        moves = [
+            (old, span)
+            for old, span in zip(current, candidate)
+            if span.peer_id != old.span.peer_id
+        ]
+        if not moves:
+            fallback("no better decode-tier replica than the prefill chain")
+            return
+        if not all(
+            getattr(span.server_info, "phase_tier", None) == "decode"
+            for _old, span in moves
+        ):
+            # moving KV to another generalist/prefill replica buys nothing
+            fallback("best decode chain is not decode-tiered")
+            return
+        replaced: List[_ServerInferenceSession] = []
+        created: List[_ServerInferenceSession] = []
+        try:
+            for old, span in moves:
+                addr = self.seq_manager.addr_of(span.peer_id)
+                if addr is None:
+                    raise RuntimeError(
+                        f"no address for decode replica {span.peer_id.to_string()[:8]}"
+                    )
+                # 1) source pushes the parked-at-step-boundary KV to the
+                #    decode replica (server-to-server, billed as migration
+                #    bytes, chaos site handoff.push)
+                stub = await self.seq_manager.get_stub(old.span.peer_id)
+                reply = await asyncio.wait_for(
+                    stub.call(
+                        "ptu.session_handoff",
+                        {
+                            "session_id": old.session_id,
+                            "peer_id": span.peer_id.to_string(),
+                            "addr": addr.to_string(),
+                            "deadline_s": cfg.handoff_timeout,
+                        },
+                    ),
+                    timeout=cfg.handoff_timeout + 5.0,
+                )
+                if not reply.get("ok"):
+                    raise RuntimeError(f"source refused handoff: {reply}")
+                # 2) fresh session on the decode replica adopts the pushed
+                #    KV in place (kv_adopt first step, zero client-link KV)
+                uids = self.seq_manager.block_uids[span.start : span.end]
+                session = await _ServerInferenceSession.create(
+                    self.seq_manager, span, uids,
+                    max_length=self.max_length, batch_size=self.batch_size,
+                    session_id=uuid.uuid4().hex, trace_id=self.trace_id,
+                )
+                session.monitor = self.integrity
+                created.append(session)
+                export_pos = int(reply["position"])
+                if export_pos < self._position:
+                    # the cut missed the step boundary; the adopt will replay
+                    self._handoff_stats["replayed"] += 1
+                if not await self._seed_by_adopt(
+                    session, old.session_id, export_pos, old.history_steps()
+                ):
+                    raise RuntimeError("pushed KV too stale to adopt")
+                replaced.append(old)
+        except Exception as e:
+            for session in created:
+                try:
+                    await session.close()
+                except Exception:
+                    pass  # swarmlint: disable=no-silent-except — best-effort teardown of half-opened handoff sessions; the prefill chain is still live
+            fallback(repr(e))
+            return
+        # all moves landed: splice the decode replicas in, retire the
+        # prefill hops, re-link the server->server push chain
+        by_old = dict(zip(replaced, created))
+        self._sessions = sorted(
+            [by_old.get(s, s) for s in current], key=lambda s: s.span.start
+        )
+        self._retire_hops(replaced)
+        for old in replaced:
+            try:
+                await old.close()
+            except Exception:
+                pass  # swarmlint: disable=no-silent-except — the source may already be tearing the lane down post-handoff
+        self._wire_push_chain(self._sessions)
+        self._handoff_stats["adopted"] += len(replaced)
+        get_journal().event(
+            "handoff_complete", trace_id=self.trace_id,
+            moved=len(replaced), position=self._position,
+        )
 
     def _spans_support_server_gen(self, spans, sampling: bool = False) -> bool:
         """One span covering every block, announcing the server_gen (or, for

@@ -44,6 +44,17 @@ CACHE_MISS_PENALTY = 10.0  # seconds added when a server's KV cache can't fit us
 # 100s-of-MB transfer (or a full prefix replay) with a server-local adopt,
 # so it should win against anything short of a missing block.
 PREFER_PEER_BONUS_S = 10.0
+# Disaggregated serving (phase tiers): when a route is built FOR a phase
+# ("prefill" heavy prompt processing / "decode" token generation), a replica
+# announcing the matching tier gets a discount and a mismatched specialist
+# gets a surcharge, while generalists (and pre-tier servers announcing
+# nothing) score unchanged. Sized between the congestion and integrity
+# penalties: strong enough to pull phase traffic onto its tier against RTT
+# noise, weak enough that a quarantined or capacity-missing specialist still
+# loses to a healthy generalist (INTEGRITY_PENALTY_S / CACHE_MISS_PENALTY
+# dominate).
+PHASE_TIER_BONUS_S = 2.0
+PHASE_TIER_MISMATCH_S = 2.0
 # Soft routing penalty for a queue-dominated server (report_congestion):
 # scaled by the observed queue share, decaying after CONGESTION_WINDOW_S.
 # Sized like a bad WAN RTT — enough to flip near-ties toward an idle
@@ -478,6 +489,7 @@ class RemoteSequenceManager:
         cache_tokens_needed: Optional[int] = None,
         affinity_seed: Optional[int] = None,
         prefer_peers: Optional[Sequence[PeerID]] = None,
+        phase: Optional[str] = None,
     ) -> List[RemoteSpanInfo]:
         end_index = end_index if end_index is not None else len(self.block_uids)
         if self.state.last_updated_time is None:
@@ -500,7 +512,7 @@ class RemoteSequenceManager:
         if mode == "min_latency":
             sequence = self._make_sequence_min_latency(
                 start_index, end_index, cache_tokens_needed, affinity_seed,
-                prefer_peers=prefer_peers,
+                prefer_peers=prefer_peers, phase=phase,
             )
         elif mode == "max_throughput":
             sequence = self._make_sequence_max_throughput(start_index, end_index)
@@ -516,7 +528,7 @@ class RemoteSequenceManager:
             sequence = (
                 self._make_sequence_min_latency(
                     start_index, end_index, cache_tokens_needed, affinity_seed,
-                    prefer_peers=prefer_peers,
+                    prefer_peers=prefer_peers, phase=phase,
                 )
                 if mode == "min_latency"
                 else self._make_sequence_max_throughput(start_index, end_index)
@@ -569,6 +581,7 @@ class RemoteSequenceManager:
         self, start: int, end: int, cache_tokens_needed: Optional[int],
         affinity_seed: Optional[int] = None,
         prefer_peers: Optional[Sequence[PeerID]] = None,
+        phase: Optional[str] = None,
     ) -> List[RemoteSpanInfo]:
         """Dijkstra over (block, peer) states; edge = RTT + per-block decode cost
         (+ cache-miss penalty), mirroring reference :177-300."""
@@ -595,7 +608,7 @@ class RemoteSequenceManager:
                 edge = self._edge_cost(
                     peer, span.peer_id, info, next_block - block, cache_tokens_needed,
                     affinity_jitter=jitter(span.peer_id),
-                    prefer_peers=prefer_peers,
+                    prefer_peers=prefer_peers, phase=phase,
                 )
                 nkey = (next_block, span.peer_id)
                 ncost = cost + edge
@@ -627,6 +640,7 @@ class RemoteSequenceManager:
         self, prev_peer, peer_id, info, n_blocks: int, cache_tokens_needed: Optional[int],
         *, affinity_jitter: float = 0.0,
         prefer_peers: Optional[Sequence[PeerID]] = None,
+        phase: Optional[str] = None,
     ) -> float:
         """One chain hop's cost: RTT + per-block decode cost + cache-miss
         penalty — THE edge model, shared by the Dijkstra and
@@ -656,6 +670,17 @@ class RemoteSequenceManager:
         integ = getattr(info, "integrity", None)
         if isinstance(integ, dict) and integ.get("quarantined"):
             edge += INTEGRITY_PENALTY_S
+        if phase is not None:
+            # disaggregated serving: pull this route onto replicas declaring
+            # the matching tier, push it off mismatched specialists; servers
+            # announcing no tier (or "generalist") score unchanged, so mixed
+            # and legacy swarms route exactly as before
+            tier = getattr(info, "phase_tier", None)
+            if tier in ("prefill", "decode"):
+                if tier == phase:
+                    edge = max(edge - PHASE_TIER_BONUS_S, 0.0)
+                else:
+                    edge += PHASE_TIER_MISMATCH_S
         if prefer_peers is not None and peer_id in prefer_peers:
             # this peer holds the session's migrated KV — discount the hop
             # (clamped: Dijkstra needs non-negative edges)
@@ -663,7 +688,8 @@ class RemoteSequenceManager:
         return edge
 
     def estimate_chain_latency(
-        self, chain: List[RemoteSpanInfo], cache_tokens_needed: Optional[int] = None
+        self, chain: List[RemoteSpanInfo], cache_tokens_needed: Optional[int] = None,
+        phase: Optional[str] = None,
     ) -> float:
         """Estimated per-token latency of a chain under the same cost model the
         min-latency Dijkstra uses (``_edge_cost``), with each span's ServerInfo
@@ -679,7 +705,8 @@ class RemoteSequenceManager:
                         info = cand.server_info
                         break
             cost += self._edge_cost(
-                prev, span.peer_id, info, span.end - span.start, cache_tokens_needed
+                prev, span.peer_id, info, span.end - span.start, cache_tokens_needed,
+                phase=phase,
             )
             prev = span.peer_id
         return cost

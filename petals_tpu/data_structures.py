@@ -211,6 +211,12 @@ class ServerInfo:
     # fetch a victim server's journal excerpt by trace_id on an SLO breach;
     # None when exposition is disabled
     metrics_port: Optional[int] = None
+    # disaggregated serving phase tier ("generalist" | "prefill" | "decode"):
+    # routing prefers prefill-tier replicas for heavy prefills and decode-tier
+    # replicas for token generation, with the prefill server handing the
+    # finished KV to a decode replica over the page-push path. None (old
+    # servers) routes exactly like "generalist".
+    phase_tier: Optional[str] = None
 
     def to_tuple(self) -> Tuple[int, float, dict]:
         extra_info = dataclasses.asdict(self)

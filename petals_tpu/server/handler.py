@@ -260,6 +260,7 @@ class TransformerHandler:
         server.add_unary_handler("ptu.push", self.rpc_push)
         server.add_unary_handler("ptu.session_export", self.rpc_session_export)
         server.add_unary_handler("ptu.session_migrate", self.rpc_session_migrate)
+        server.add_unary_handler("ptu.session_handoff", self.rpc_session_handoff)
         server.add_unary_handler("ptu.probe", self.rpc_probe)
         server.add_stream_handler("ptu.inference", self.rpc_inference)
 
@@ -441,17 +442,67 @@ class TransformerHandler:
         )
         return {"ok": True, "position": position}
 
+    async def rpc_session_handoff(self, payload, ctx: RpcContext):
+        """Disaggregated prefill->decode boundary: the client (between steps,
+        so the cut lands exactly on a step boundary) asks this prefill-tier
+        server to push one LIVE session's finished KV to a decode-tier
+        replica over the page-push path, then adopts it there with
+        ``kv_adopt`` — zero KV bytes ever cross the client link. Unlike
+        drain-to-migrate the session stays live here: no redirect is
+        installed and nothing is torn down, so a failed push (or a failed
+        adopt at the destination) degrades to colocated decode on this
+        replica with no session loss."""
+        session_id = payload["session_id"]
+        peer_id = str(payload["peer_id"])
+        addr = str(payload["addr"])
+        deadline_s = min(max(float(payload.get("deadline_s") or 30.0), 0.1), 120.0)
+        reg = self._session_registry.get(session_id)
+        if reg is None:
+            raise KeyError(f"No live session {session_id!r} to hand off")
+        if reg["position"] <= 0:
+            raise ValueError(f"Session {session_id!r} has no cached tokens yet")
+        snap = await self._snapshot_session(reg)
+        snap["trace_id"] = reg.get("trace_id")
+        snap["peer"] = reg.get("peer")  # ledger attribution of the push bytes
+        ok = await self.migrate_parked_to(
+            session_id, snap, peer_id, addr, deadline_s=deadline_s, kind="handoff",
+        )
+        return {"ok": bool(ok), "position": int(snap["position"])}
+
     async def migrate_parked_to(
         self, session_id: str, snap: dict, peer_id: str, addr: str,
         *, deadline_s: float = 30.0, budget_bytes: Optional[int] = None,
+        kind: str = "migrate",
     ) -> bool:
-        """Push one parked session's KV to a live replica (drain-to-migrate /
-        rebalance path). On success the local parked copy becomes a redirect
-        (``_migrated_away``) so exports forward the client to the new home.
+        """Push one session snapshot's KV to a live replica over the
+        server-to-server page-push path. Two callers share the transport:
+
+        - ``kind="migrate"`` (drain-to-migrate / rebalance): on success the
+          local parked copy becomes a redirect (``_migrated_away``) so
+          exports forward the client to the new home.
+        - ``kind="handoff"`` (disaggregated prefill->decode boundary): the
+          source session stays LIVE and no redirect is installed — the
+          client adopts at the destination, and if that fails it simply
+          keeps decoding here (colocated fallback, no session loss).
+
         Returns False — with flight-recorder evidence — when the push fails;
-        the parked entry stays, and the client falls back to export/replay."""
+        the parked/live entry stays, and the client falls back to
+        export/replay (migrate) or colocated decode (handoff)."""
         from petals_tpu.dht.routing import PeerAddr
         from petals_tpu.telemetry import get_journal
+
+        assert kind in ("migrate", "handoff"), kind
+        handoff = kind == "handoff"
+
+        def note_outcome(outcome: str, nbytes: int = 0) -> None:
+            if handoff:
+                tm.HANDOFFS.labels(outcome=outcome).inc()
+                if outcome == "ok":
+                    tm.HANDOFF_BYTES.inc(nbytes)
+            else:
+                tm.MIGRATIONS.labels(direction="out", outcome=outcome).inc()
+                if outcome == "ok":
+                    tm.MIGRATION_BYTES.labels(direction="out").inc(nbytes)
 
         trace_id = snap.get("trace_id")
         kv_quant = getattr(self.backend, "kv_quant_type", "none")
@@ -481,7 +532,10 @@ class TransformerHandler:
                     f"session KV ({nbytes}B) exceeds the migration budget ({budget_bytes}B)"
                 )
             if chaos.ENABLED:
-                await chaos.inject(chaos.SITE_MIGRATE_PUSH, detail=session_id)
+                await chaos.inject(
+                    chaos.SITE_HANDOFF_PUSH if handoff else chaos.SITE_MIGRATE_PUSH,
+                    detail=session_id,
+                )
             if kv_quant != "none":
                 # codes are integer (lossy float codecs pass them through
                 # verbatim); scales go uncompressed so the packed entry
@@ -532,48 +586,55 @@ class TransformerHandler:
             push_task.cancel()
             with contextlib.suppress(asyncio.CancelledError):
                 await push_task
-            tm.MIGRATIONS.labels(direction="out", outcome="aborted").inc()
+            note_outcome("aborted")
             get_journal().event(
-                "migrate_aborted", trace_id=trace_id, session_id=session_id,
+                "handoff_aborted" if handoff else "migrate_aborted",
+                trace_id=trace_id, session_id=session_id,
                 dest=peer_id, nbytes=nbytes, reason=reason,
                 elapsed_s=time.perf_counter() - t0,
             )
             logger.warning(
-                f"Migration of {session_id!r} to {peer_id} aborted ({reason})"
+                f"{kind.capitalize()} of {session_id!r} to {peer_id} aborted ({reason})"
             )
             return False
         try:
             push_task.result()
         except Exception as e:
-            tm.MIGRATIONS.labels(direction="out", outcome="failed").inc()
+            note_outcome("failed")
             get_journal().event(
-                "migrate_failed", trace_id=trace_id, session_id=session_id,
+                "handoff_failed" if handoff else "migrate_failed",
+                trace_id=trace_id, session_id=session_id,
                 dest=peer_id, nbytes=nbytes, error=repr(e),
             )
             from petals_tpu.telemetry.flight import flight_from_env
 
             flight_from_env().record(
-                "migrate_failed", trace_id=trace_id,
+                "handoff_failed" if handoff else "migrate_failed",
+                trace_id=trace_id,
                 journal=lambda: get_journal().events(trace_id=trace_id)[-50:],
                 session_id=session_id, dest_peer=peer_id, dest_addr=addr,
                 nbytes=nbytes, error=repr(e),
                 elapsed_s=time.perf_counter() - t0,
             )
-            logger.warning(f"Migration of {session_id!r} to {peer_id} failed: {e}")
+            logger.warning(f"{kind.capitalize()} of {session_id!r} to {peer_id} failed: {e}")
             return False
-        self._migrated_away[session_id] = {
-            "peer_id": peer_id, "addr": addr, "position": snap["position"],
-        }
-        self._parked.pop(session_id, None)
-        tm.MIGRATIONS.labels(direction="out", outcome="ok").inc()
-        tm.MIGRATION_BYTES.labels(direction="out").inc(nbytes)
-        # the session was parked (its lane — and ledger session — already
-        # closed), so the push bills straight to the owning peer's rollup
+        if not handoff:
+            # a handoff source stays live (the client may fall back to
+            # colocated decode here); only a drained migration redirects
+            self._migrated_away[session_id] = {
+                "peer_id": peer_id, "addr": addr, "position": snap["position"],
+            }
+            self._parked.pop(session_id, None)
+        note_outcome("ok", nbytes)
+        # the parked session's lane — and ledger session — already closed
+        # (and a handoff source's live session keeps its own bill), so the
+        # push bills straight to the owning peer's rollup as migration bytes
         from petals_tpu.telemetry.ledger import get_ledger
 
         get_ledger().note_migrated(None, nbytes, peer_id=snap.get("peer"))
         get_journal().event(
-            "migrate_out", trace_id=trace_id,
+            "handoff_out" if handoff else "migrate_out",
+            trace_id=trace_id,
             occupancy=self.batcher.occupancy_info() if self.batcher is not None else None,
             session_id=session_id, dest=peer_id, nbytes=nbytes,
             position=snap["position"], elapsed_s=time.perf_counter() - t0,

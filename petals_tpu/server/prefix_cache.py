@@ -60,6 +60,7 @@ cross-tenant channel at the cost of cross-client sharing.
 from __future__ import annotations
 
 import hashlib
+import os
 import threading
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -75,8 +76,27 @@ SEGMENT_TOKENS = 128
 
 # device-tier promotion threshold: a host-resident node must be hit this many
 # times before maybe_promote_device uploads it (a one-off hit does not pay
-# for an HBM slot; the second hit predicts a third)
-PROMOTE_MIN_HITS = 2
+# for an HBM slot; the second hit predicts a third). Env-tunable so revival
+# step 10/10 can retune the silicon crossover without code edits.
+PROMOTE_MIN_HITS = int(os.environ.get("PETALS_TPU_PROMOTE_MIN_HITS", "2"))
+
+
+def resolve_device_bytes(prefix_cache_bytes: int, prefix_device_bytes: int) -> int:
+    """The radix cache's HBM tier size: ``PETALS_TPU_RADIX_DEVICE_FRAC``
+    (a fraction of the host budget, clamped to [0, 1]) overrides the
+    configured byte count, so operators can retune the device/host split per
+    silicon generation from the environment."""
+    frac = os.environ.get("PETALS_TPU_RADIX_DEVICE_FRAC")
+    if frac is None:
+        return prefix_device_bytes
+    try:
+        f = min(max(float(frac), 0.0), 1.0)
+    except ValueError:
+        logger.warning(
+            f"Ignoring malformed PETALS_TPU_RADIX_DEVICE_FRAC={frac!r}"
+        )
+        return prefix_device_bytes
+    return int(f * max(prefix_cache_bytes, 0))
 
 # the cache may reserve at most this fraction of the HostSwapPool for demoted
 # nodes: session preemption and the prefix cache share ONE budget, and a cold

@@ -36,6 +36,11 @@ logger = get_logger(__name__)
 
 DEFAULT_UPDATE_PERIOD = 30.0
 
+# disaggregated serving tiers: generalists serve both phases; prefill-tier
+# replicas soak FLOPs-bound prompt processing and hand the finished KV to a
+# decode-tier replica over the page-push path (handler.rpc_session_handoff)
+PHASE_TIERS = ("generalist", "prefill", "decode")
+
 
 def default_dht_prefix(model_name: str) -> str:
     """Derive the swarm namespace from the model name (reference
@@ -104,6 +109,7 @@ class Server:
         draft_window: Optional[int] = None,  # draft context window (tokens); None = default
         draft_quant_type: str = "nf4a",  # draft block quantization (4-bit serving default)
         metrics_port: Optional[int] = None,  # Prometheus /metrics HTTP port; None disables, 0 = ephemeral
+        phase_tier: str = "generalist",  # disaggregated serving: "generalist" | "prefill" | "decode"
     ):
         self.num_hosts = num_hosts or 1
         self.coordinator_address = coordinator_address
@@ -130,6 +136,14 @@ class Server:
         )
         total = self.cfg.num_hidden_layers
         self.auto_placement = first_block is None
+        # PETALS_TPU_RADIX_DEVICE_FRAC retunes the radix cache's HBM/host
+        # split as a fraction of prefix_cache_bytes without code edits
+        # (revival step 10/10 silicon crossover)
+        from petals_tpu.server.prefix_cache import resolve_device_bytes
+
+        prefix_device_bytes = resolve_device_bytes(
+            prefix_cache_bytes, prefix_device_bytes
+        )
         if attn_cache_bytes is None:
             from petals_tpu.server.block_utils import device_memory_bytes
 
@@ -255,6 +269,11 @@ class Server:
         self._contact_addr = None  # non-default announce addr (relay circuit)
         self.metrics_port = metrics_port
         self._metrics_server = None  # telemetry.exposition.MetricsServer when enabled
+        if phase_tier not in PHASE_TIERS:
+            raise ValueError(
+                f"phase_tier must be one of {PHASE_TIERS}, got {phase_tier!r}"
+            )
+        self.phase_tier = phase_tier
 
     # ------------------------------------------------------------------ lifecycle
 
@@ -547,7 +566,9 @@ class Server:
 
     def _pick_migration_target(self, infos, addr_book, start: int, end: int):
         """Highest-throughput ONLINE peer (not us) serving every block of
-        [start, end) with a known contact address, or None."""
+        [start, end) with a known contact address, or None. Decode-tier
+        replicas win ties-by-class: a migrated session is mid-generation, so
+        its KV belongs on the tier shaped for token-by-token decoding."""
         candidates = None
         for i in range(start, end):
             info = infos[i] if i < len(infos) else None
@@ -561,11 +582,13 @@ class Server:
             candidates = here if candidates is None else (candidates & here)
             if not candidates:
                 return None
-        best, best_rps = None, -1.0
+        best, best_key = None, (-1, -1.0)
         for pid in candidates:
-            rps = infos[start].servers[pid].throughput or 0.0
-            if rps > best_rps:
-                best, best_rps = pid, rps
+            si = infos[start].servers[pid]
+            tier = getattr(si, "phase_tier", None)
+            key = (1 if tier == "decode" else 0, si.throughput or 0.0)
+            if key > best_key:
+                best, best_key = pid, key
         return (best, addr_book[best]) if best is not None else None
 
     async def shutdown(self) -> None:
@@ -687,6 +710,10 @@ class Server:
                 self._metrics_server.port
                 if getattr(self, "_metrics_server", None) is not None else None
             ),
+            # disaggregated serving tier; generalists announce it too so
+            # run_health's tier column distinguishes "old server" from
+            # "explicit generalist"
+            phase_tier=self.phase_tier,
         )
 
     def _telemetry_digest(self) -> Optional[dict]:

@@ -37,6 +37,23 @@ async def _invoke(fn: _MaybeAsync, *args) -> object:
     return result
 
 
+def _accepts_n_args(fn, n: int) -> bool:
+    """Whether ``fn`` can be called with ``n`` positional args — used to
+    pass the decision's phase tier to scale_out callbacks that declare a
+    second parameter, without breaking single-arg legacy callbacks."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    count = 0
+    for p in sig.parameters.values():
+        if p.kind is p.VAR_POSITIONAL:
+            return True
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            count += 1
+    return count >= n
+
+
 class CallbackActuator:
     """Dispatch decisions to per-action callbacks (sync or async).
 
@@ -58,7 +75,12 @@ class CallbackActuator:
         if fn is None:
             return False
         if decision.action == "scale_out":
-            await _invoke(fn, decision.span)
+            tier = getattr(decision, "tier", None)
+            if tier is not None and _accepts_n_args(fn, 2):
+                # tier-aware spawners boot the replica with --phase_tier
+                await _invoke(fn, decision.span, tier)
+            else:
+                await _invoke(fn, decision.span)
         elif decision.action == "scale_in":
             await _invoke(fn, decision.target)
         else:

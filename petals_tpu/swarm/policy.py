@@ -81,6 +81,10 @@ class ServerSample:
     # replicas are drained-and-replaced with top priority — they produce
     # WRONG tokens, which no amount of idle-harvesting hysteresis excuses.
     quarantined: bool = False
+    # disaggregated serving phase tier ("generalist" | "prefill" | "decode");
+    # tiered swarms get per-tier scaling signals, all-generalist swarms are
+    # scored exactly as before this field existed
+    tier: str = "generalist"
 
     @property
     def online(self) -> bool:
@@ -96,17 +100,26 @@ class SwarmSnapshot:
     servers: Tuple[ServerSample, ...] = ()
     ttft_p99_ms: Optional[float] = None  # swarm-wide worst announced p99
 
-    def queue_share(self) -> float:
+    def _tiered(self, tier: Optional[str]):
+        return [
+            s for s in self.servers
+            if s.online and (tier is None or s.tier == tier)
+        ]
+
+    def queue_share(self, tier: Optional[str] = None) -> float:
         """Waiters per admission lane across ONLINE servers — the load
         signal that rises BEFORE latency does (queued sessions have not
-        produced a slow token yet)."""
-        lanes = sum(s.lanes for s in self.servers if s.online)
-        waiters = sum(s.lane_waiters for s in self.servers if s.online)
+        produced a slow token yet). ``tier`` restricts the aggregate to
+        one phase tier (the prefill tier's scaling signal)."""
+        servers = self._tiered(tier)
+        lanes = sum(s.lanes for s in servers)
+        waiters = sum(s.lane_waiters for s in servers)
         return waiters / lanes if lanes > 0 else 0.0
 
-    def occupancy(self) -> float:
-        lanes = sum(s.lanes for s in self.servers if s.online)
-        busy = sum(s.busy_lanes for s in self.servers if s.online)
+    def occupancy(self, tier: Optional[str] = None) -> float:
+        servers = self._tiered(tier)
+        lanes = sum(s.lanes for s in servers)
+        busy = sum(s.busy_lanes for s in servers)
         return busy / lanes if lanes > 0 else 0.0
 
     def coverage(self) -> List[float]:
@@ -120,8 +133,14 @@ class SwarmSnapshot:
                 cov[b] += s.throughput
         return cov
 
-    def replica_count(self) -> int:
-        return sum(1 for s in self.servers if s.online)
+    def replica_count(self, tier: Optional[str] = None) -> int:
+        return len(self._tiered(tier))
+
+    def tiers_present(self) -> Tuple[str, ...]:
+        """Non-generalist tiers with at least one ONLINE replica, in the
+        fixed (prefill, decode) order the per-tier actions evaluate in."""
+        present = {s.tier for s in self.servers if s.online}
+        return tuple(t for t in ("prefill", "decode") if t in present)
 
 
 def snapshot_from_health(
@@ -152,6 +171,11 @@ def snapshot_from_health(
                 pages_free=_i(pool.get("pages_free")),
                 n_pages=_i(pool.get("n_pages")),
                 quarantined=bool(integ.get("quarantined")),
+                tier=(
+                    str(s.get("phase_tier")).lower()
+                    if s.get("phase_tier") in ("prefill", "decode")
+                    else "generalist"
+                ),
             )
         )
         digest = s.get("telemetry")
@@ -186,6 +210,24 @@ class PolicyConfig:
     span_blocks: int = 0  # replica span length; 0 = full model
     resize_imbalance: float = 4.0  # resize when max/min coverage exceeds this
 
+    # ---- disaggregated phase tiers (active only when the snapshot holds
+    # tiered replicas; all-generalist swarms never evaluate these) ----
+    # prefill tier scales on ITS OWN queue share (long prompts queue for
+    # lanes long before swarm TTFT moves), decode tier on lane occupancy
+    # (decode lanes saturate with near-zero queueing — each step is short,
+    # so waiters drain fast while tok/s quietly degrades). Each tier has
+    # an independent floor and scale-out cooldown.
+    prefill_queue_share_high: float = 0.5
+    prefill_queue_share_low: float = 0.1
+    prefill_sustain_out: int = 2
+    prefill_cooldown_out: int = 5
+    prefill_min_replicas: int = 1
+    decode_occupancy_high: float = 0.85
+    decode_occupancy_low: float = 0.5
+    decode_sustain_out: int = 2
+    decode_cooldown_out: int = 5
+    decode_min_replicas: int = 1
+
     def __post_init__(self):
         if self.min_replicas < 1:
             raise ValueError("min_replicas must be >= 1")
@@ -193,6 +235,16 @@ class PolicyConfig:
             raise ValueError("max_replicas must be >= min_replicas")
         if not 0.0 <= self.queue_share_low <= self.queue_share_high:
             raise ValueError("need 0 <= queue_share_low <= queue_share_high")
+        if not 0.0 <= self.prefill_queue_share_low <= self.prefill_queue_share_high:
+            raise ValueError(
+                "need 0 <= prefill_queue_share_low <= prefill_queue_share_high"
+            )
+        if not 0.0 <= self.decode_occupancy_low <= self.decode_occupancy_high:
+            raise ValueError(
+                "need 0 <= decode_occupancy_low <= decode_occupancy_high"
+            )
+        if self.prefill_min_replicas < 0 or self.decode_min_replicas < 0:
+            raise ValueError("per-tier replica floors must be >= 0")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -205,6 +257,9 @@ class Decision:
     span: Optional[Tuple[int, int]]  # blocks for the new/moved replica
     reason: str
     evidence: Dict[str, object]
+    # phase tier the decision applies to ("prefill" | "decode"); None for
+    # the tier-agnostic swarm-wide actions
+    tier: Optional[str] = None
 
     def to_journal(self) -> dict:
         """Deterministic serializable form (floats rounded so replayed
@@ -228,6 +283,7 @@ class Decision:
             "target": self.target,
             "span": list(self.span) if self.span is not None else None,
             "reason": self.reason,
+            "tier": self.tier,
             "evidence": _round(self.evidence),
         }
 
@@ -240,6 +296,9 @@ class AutoscalerPolicy:
         self.config = config or PolicyConfig()
         self._hot_streak = 0
         self._cold_streaks: Dict[str, int] = {}  # peer -> consecutive cold ticks
+        # per-tier hot streaks (prefill: queue share, decode: occupancy);
+        # empty until a snapshot actually contains tiered replicas
+        self._tier_hot_streaks: Dict[str, int] = {}
         self._last_fire: Dict[str, int] = {}  # action -> tick it last fired
         self._last_any: Optional[int] = None
         self._first_tick: Optional[int] = None  # startup-grace anchor
@@ -285,6 +344,21 @@ class AutoscalerPolicy:
             # hysteresis: the in-between band neither builds nor resets
             self._hot_streak = 0
 
+        # per-tier hot streaks, same hysteresis discipline as the swarm-wide
+        # streak: the in-between band neither builds nor resets. A tier that
+        # disappears from the snapshot drops its streak (stale evidence must
+        # not fire the first decision after the tier returns).
+        present = snapshot.tiers_present()
+        self._tier_hot_streaks = {
+            t: n for t, n in self._tier_hot_streaks.items() if t in present
+        }
+        for t in present:
+            t_hot, t_cool = self._tier_signal(snapshot, t)
+            if t_hot:
+                self._tier_hot_streaks[t] = self._tier_hot_streaks.get(t, 0) + 1
+            elif t_cool:
+                self._tier_hot_streaks[t] = 0
+
         # cold streaks per ONLINE replica; a replica that vanished from the
         # snapshot (killed, drained) drops its streak with it
         live = {s.peer for s in snapshot.servers if s.online}
@@ -313,17 +387,45 @@ class AutoscalerPolicy:
             # latency-driven action
             self._maybe_quarantine_drain(snapshot, evidence_base)
             or self._maybe_scale_out(snapshot, evidence_base)
+            or self._maybe_tier_scale_out(snapshot, evidence_base)
             or self._maybe_scale_in(snapshot, hot, evidence_base)
             or self._maybe_resize(snapshot, hot, evidence_base)
         )
         if decision is None:
             return []
-        self._last_fire[decision.action] = snapshot.tick
+        # tiered decisions cool down independently of the swarm-wide action
+        # of the same name (independent per-tier cooldowns); both still share
+        # the global cooldown via _last_any
+        fire_key = (
+            decision.action
+            if decision.tier is None
+            else f"{decision.action}:{decision.tier}"
+        )
+        self._last_fire[fire_key] = snapshot.tick
         self._last_any = snapshot.tick
         if decision.action == "scale_out":
-            self._hot_streak = 0  # the new capacity must re-earn the signal
+            # the new capacity must re-earn the signal
+            if decision.tier is None:
+                self._hot_streak = 0
+            else:
+                self._tier_hot_streaks[decision.tier] = 0
         self._journal.append(decision.to_journal())
         return [decision]
+
+    def _tier_signal(self, snapshot: SwarmSnapshot, tier: str) -> Tuple[bool, bool]:
+        """(hot, cool) for one phase tier: prefill watches its queue share
+        (heavy prompts queue for lanes before latency moves), decode its
+        lane occupancy (decode steps are short, so lanes saturate with
+        near-zero queueing while tok/s quietly degrades)."""
+        cfg = self.config
+        if tier == "prefill":
+            share = snapshot.queue_share(tier="prefill")
+            return (
+                share >= cfg.prefill_queue_share_high,
+                share <= cfg.prefill_queue_share_low,
+            )
+        occ = snapshot.occupancy(tier="decode")
+        return occ >= cfg.decode_occupancy_high, occ <= cfg.decode_occupancy_low
 
     # ------------------------------------------------------------- actions
 
@@ -400,7 +502,7 @@ class AutoscalerPolicy:
 
     def _cooled_down(self, action: str, cooldown: int, tick: int) -> bool:
         last = self._last_fire.get(action)
-        if last is None and action != "scale_out":
+        if last is None and not action.startswith("scale_out"):
             # Startup grace: at controller start EVERY replica looks cold
             # (no streak history says otherwise), so capacity-REMOVING
             # actions must watch the swarm for a full cooldown before
@@ -455,6 +557,63 @@ class AutoscalerPolicy:
             },
         )
 
+    def _maybe_tier_scale_out(
+        self, snapshot: SwarmSnapshot, evidence: dict
+    ) -> Optional[Decision]:
+        """Per-tier scale-out for disaggregated swarms: prefill on its own
+        queue share, decode on its lane occupancy (see ``_tier_signal``),
+        each with an independent sustain and cooldown. Evaluates only tiers
+        actually present in the snapshot — an all-generalist swarm never
+        reaches this code path, so legacy decision streams are unchanged."""
+        cfg = self.config
+        if snapshot.replica_count() >= cfg.max_replicas:
+            return None
+        for tier in snapshot.tiers_present():
+            sustain, cooldown = (
+                (cfg.prefill_sustain_out, cfg.prefill_cooldown_out)
+                if tier == "prefill"
+                else (cfg.decode_sustain_out, cfg.decode_cooldown_out)
+            )
+            if self._tier_hot_streaks.get(tier, 0) < sustain:
+                continue
+            if not self._cooled_down(f"scale_out:{tier}", cooldown, snapshot.tick):
+                continue
+            span = self._span_for_scale_out(snapshot)
+            signal = (
+                {"tier_queue_share": snapshot.queue_share(tier="prefill")}
+                if tier == "prefill"
+                else {"tier_occupancy": snapshot.occupancy(tier="decode")}
+            )
+            return Decision(
+                tick=snapshot.tick,
+                action="scale_out",
+                target=None,
+                span=span,
+                tier=tier,
+                reason=(
+                    f"{tier} tier hot for {self._tier_hot_streaks[tier]} ticks "
+                    f">= sustain={sustain}"
+                ),
+                evidence={
+                    **evidence,
+                    **signal,
+                    "tier_replicas": snapshot.replica_count(tier=tier),
+                    "tier_hot_streak": self._tier_hot_streaks[tier],
+                },
+            )
+        return None
+
+    def _tier_floor_holds(self, snapshot: SwarmSnapshot, victim: ServerSample) -> bool:
+        """Independent per-tier floors: harvesting a tiered replica must not
+        drop its tier below the configured minimum (a decode tier emptied by
+        idle-harvesting would silently re-colocate every handoff)."""
+        cfg = self.config
+        if victim.tier == "prefill":
+            return snapshot.replica_count(tier="prefill") > cfg.prefill_min_replicas
+        if victim.tier == "decode":
+            return snapshot.replica_count(tier="decode") > cfg.decode_min_replicas
+        return True
+
     def _still_covered(self, snapshot: SwarmSnapshot, without: str) -> bool:
         cov = [0] * snapshot.num_blocks
         for s in snapshot.servers:
@@ -480,6 +639,7 @@ class AutoscalerPolicy:
             if s.online
             and self._cold_streaks.get(s.peer, 0) >= cfg.sustain_in
             and self._still_covered(snapshot, without=s.peer)
+            and self._tier_floor_holds(snapshot, s)
         ]
         if not candidates:
             return None
@@ -490,6 +650,7 @@ class AutoscalerPolicy:
             action="scale_in",
             target=victim.peer,
             span=(victim.start, victim.end),
+            tier=victim.tier if victim.tier != "generalist" else None,
             reason=(
                 f"replica cold for {self._cold_streaks[victim.peer]} ticks "
                 f">= sustain_in={cfg.sustain_in}"

@@ -101,6 +101,7 @@ class _RateTracker:
 
 
 _tok_rate = _RateTracker()
+_handoff_rate = _RateTracker()
 
 
 def telemetry_digest(registry: Optional[MetricsRegistry] = None) -> dict:
@@ -142,6 +143,11 @@ def telemetry_digest(registry: Optional[MetricsRegistry] = None) -> dict:
         "hbm_free_bytes": int(I.HBM_HEADROOM.value),
         "prefix_hit_rate": _prefix_hit_rate(),
         "swap_oldest_s": round(I.SWAP_RESIDENCY_OLDEST.value, 1),
+        # disaggregated serving (PR 19): prefill->decode KV handoff volume,
+        # total and as an announce-window rate — run_health aggregates the
+        # swarm's handoff bytes/s from these
+        "handoff_bytes": int(I.HANDOFF_BYTES.value),
+        "handoff_bytes_s": round(_handoff_rate.rate(I.HANDOFF_BYTES.value), 1),
     }
     # resource ledger (PR 10): a compact per-peer usage digest so run_health
     # can rank the swarm's top consumers without scraping every /ledger

@@ -179,6 +179,17 @@ MIGRATION_BYTES = REGISTRY.counter(
     "KV bytes moved server-to-server by session migration",
     labels=("direction",),  # out | in
 )
+HANDOFFS = REGISTRY.counter(
+    "petals_handoffs_total",
+    "Disaggregated prefill->decode KV handoffs over the page-push path, "
+    "by outcome",
+    labels=("outcome",),  # ok | failed | refused | aborted
+)
+HANDOFF_BYTES = REGISTRY.counter(
+    "petals_handoff_bytes_total",
+    "KV bytes pushed prefill->decode by phase-tier handoff (also billed "
+    "as migration bytes in the per-tenant ledger)",
+)
 CHAOS_INJECTIONS = REGISTRY.counter(
     "petals_chaos_injections_total",
     "Faults injected by the chaos plane, by site and action",

@@ -49,6 +49,7 @@ class SessionPlan:
     prompt: Tuple[int, ...]  # token ids
     new_tokens: int
     path: Tuple[int, ...] = ()  # branch chosen at each tree level (tree mode)
+    storm: bool = False  # arrived via the prefill_storm overlay
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,6 +71,19 @@ class TrafficConfig:
     tree_branching: Tuple[int, ...] = ()  # children per level of the per-tenant tree
     tree_segment_len: int = 0  # tokens per tree-node segment
     tree_hot_bias: float = 0.0  # P(child 0) at each level; rest uniform
+    # prefill_storm overlay (disaggregated-serving stress): a second seeded
+    # arrival process of BURSTS of heavy-tailed LONG prompts with short
+    # decodes — the workload that floods prefill lanes while light decode
+    # traffic keeps flowing. ``storm_rate=0`` disables the overlay and
+    # draws NOTHING from the RNG, so legacy seeds reproduce byte-identically.
+    storm_rate: float = 0.0  # mean burst arrivals/s inside the storm window
+    storm_burst: int = 4  # sessions per burst epoch
+    storm_start_frac: float = 0.25  # storm window as fractions of duration_s
+    storm_end_frac: float = 0.75
+    storm_prompt_len: int = 64  # Pareto x_m for the storm prompt length
+    storm_prompt_max: int = 256  # truncation cap
+    storm_prompt_alpha: float = 1.2  # tail index (heavier than the decode tail)
+    storm_new_tokens: int = 4  # short decode: these sessions are prefill-bound
 
     def __post_init__(self):
         if not 0.0 <= self.wave_amplitude <= 1.0:
@@ -89,6 +103,17 @@ class TrafficConfig:
             raise ValueError("tree_hot_bias must be in [0, 1]")
         if self.shared_prefix_len < 0:
             raise ValueError("shared_prefix_len must be >= 0")
+        if self.storm_rate < 0:
+            raise ValueError("storm_rate must be >= 0")
+        if self.storm_rate > 0:
+            if not 0.0 <= self.storm_start_frac < self.storm_end_frac <= 1.0:
+                raise ValueError("need 0 <= storm_start_frac < storm_end_frac <= 1")
+            if self.storm_burst < 1:
+                raise ValueError("storm_burst must be >= 1")
+            if not 1 <= self.storm_prompt_len <= self.storm_prompt_max:
+                raise ValueError("need 1 <= storm_prompt_len <= storm_prompt_max")
+            if self.storm_new_tokens < 1:
+                raise ValueError("storm_new_tokens must be >= 1")
 
 
 class TrafficGenerator:
@@ -154,6 +179,54 @@ class TrafficGenerator:
                     path=path,
                 )
             )
+        # prefill_storm overlay draws strictly AFTER every legacy draw (and
+        # only when enabled), so the legacy portion of the stream — and thus
+        # disabled-storm schedules — never shifts
+        storm_plans = self._storm_overlay(rng)
+        if not storm_plans:
+            return plans
+        # stable merge by arrival time (legacy plan wins a tie), reindexed
+        merged = sorted(plans + storm_plans, key=lambda p: p.t)
+        return [dataclasses.replace(p, index=i) for i, p in enumerate(merged)]
+
+    def _storm_overlay(self, rng: random.Random) -> List[SessionPlan]:
+        """Burst arrivals of heavy-tailed long prompts inside the storm
+        window: burst epochs are a homogeneous Poisson stream at
+        ``storm_rate``; each epoch lands ``storm_burst`` sessions at once
+        (the thundering-herd shape that queues prefill lanes)."""
+        cfg = self.config
+        if cfg.storm_rate <= 0:
+            return []
+        t0 = cfg.storm_start_frac * cfg.duration_s
+        t1 = cfg.storm_end_frac * cfg.duration_s
+        plans: List[SessionPlan] = []
+        t = t0
+        while True:
+            t += rng.expovariate(cfg.storm_rate)
+            if t >= t1:
+                break
+            for _ in range(cfg.storm_burst):
+                tenant = rng.randrange(cfg.tenants)
+                # truncated Pareto prompt length (same inverse-CDF form as
+                # the decode-length draw, scaled to prompt tokens)
+                u = rng.random()
+                length = int(
+                    cfg.storm_prompt_len * (1.0 - u) ** (-1.0 / cfg.storm_prompt_alpha)
+                )
+                plen = max(cfg.storm_prompt_len, min(cfg.storm_prompt_max, length))
+                prompt = tuple(
+                    rng.randrange(1, cfg.vocab_size) for _ in range(plen)
+                )
+                plans.append(
+                    SessionPlan(
+                        index=len(plans),
+                        t=t,
+                        tenant=tenant,
+                        prompt=prompt,
+                        new_tokens=cfg.storm_new_tokens,
+                        storm=True,
+                    )
+                )
         return plans
 
     def _draw_tree(self, rng: random.Random) -> dict:

@@ -176,6 +176,9 @@ class HealthMonitor:
                     "version": info.version,
                     "quant_type": info.quant_type,
                     "public_name": info.public_name,
+                    # disaggregated serving phase tier (None/absent on
+                    # pre-tier servers renders as generalist)
+                    "phase_tier": getattr(info, "phase_tier", None),
                     "relayed": bool(getattr(self._addr_book.get(peer_id), "relayed", False)),
                     # lane-pool / scheduler occupancy (busy lanes, free pages,
                     # suspended sessions, swap bytes, preemptions) — lets
@@ -349,6 +352,12 @@ class HealthMonitor:
                 # of one span whose self-probe digests disagree)
                 "quarantined_servers": 0,
                 "integrity_suspects": [],
+                # disaggregated serving rollup: per-tier replica counts and
+                # the swarm's prefill->decode handoff volume (bytes + the
+                # announce-window bytes/s rate), summed from the digests
+                "tiers": {"generalist": 0, "prefill": 0, "decode": 0},
+                "handoff_bytes": 0,
+                "handoff_bytes_s": 0.0,
             }
             consumers: Dict[str, dict] = {}
             for peer, s in model["servers"].items():
@@ -369,9 +378,13 @@ class HealthMonitor:
                 integ = _d(s.get("integrity"))
                 if integ.get("quarantined"):
                     agg["quarantined_servers"] += 1
+                tier = s.get("phase_tier")
+                tier = tier if tier in ("prefill", "decode") else "generalist"
+                agg["tiers"][tier] += 1
                 servers[peer] = {
                     "public_name": s.get("public_name"),
                     "blocks": s.get("blocks"),
+                    "phase_tier": tier,
                     "telemetry": digest,
                     "pool": pool or None,
                     "compile_stats": compile_stats,
@@ -386,6 +399,10 @@ class HealthMonitor:
                 agg["swap_in_bytes"] += _i(digest.get("swap_in_bytes"))
                 agg["preemptions"] += _i(digest.get("preemptions"))
                 agg["alloc_failed"] += _i(digest.get("alloc_failed"))
+                agg["handoff_bytes"] += _i(digest.get("handoff_bytes"))
+                agg["handoff_bytes_s"] = round(
+                    agg["handoff_bytes_s"] + _f(digest.get("handoff_bytes_s")), 1
+                )
                 for src, dst in (("ttft_p99_ms", "ttft_p99_ms_max"),
                                  ("step_p99_ms", "step_p99_ms_max")):
                     value = digest.get(src)
@@ -476,9 +493,9 @@ class HealthMonitor:
                 f"<h2>{html.escape(model.get('public_name') or prefix)} "
                 f"<small>({model['num_blocks']} blocks, {html.escape(str(model.get('model_type')))}"
                 f")</small> — {status}</h2><table border=1 cellpadding=4>"
-                "<tr><th>server</th><th>state</th><th>blocks</th><th>throughput</th>"
+                "<tr><th>server</th><th>state</th><th>tier</th><th>blocks</th><th>throughput</th>"
                 "<th>cache tokens left</th><th>load</th><th>tok/s</th><th>p99 TTFT</th>"
-                "<th>swap</th><th>frag</th><th>compiled</th><th>integrity</th>"
+                "<th>swap</th><th>handoff</th><th>frag</th><th>compiled</th><th>integrity</th>"
                 "<th>quant</th><th>via relay</th></tr>"
             )
             suspects = set(integrity_quorum(model["servers"]))
@@ -499,6 +516,15 @@ class HealthMonitor:
                 ttft_cell = f"{ttft:.0f} ms" if isinstance(ttft, (int, float)) else "—"
                 swap_bytes = _i(digest.get("swap_out_bytes")) + _i(digest.get("swap_in_bytes"))
                 swap_cell = f"{swap_bytes / 2**20:.1f} MiB" if swap_bytes else "—"
+                tier = s.get("phase_tier")
+                tier_cell = html.escape(str(tier)) if tier in ("prefill", "decode") else "generalist"
+                handoff_bytes = _i(digest.get("handoff_bytes"))
+                handoff_rate = _f(digest.get("handoff_bytes_s"))
+                handoff_cell = (
+                    f"{handoff_bytes / 2**20:.1f} MiB ({handoff_rate / 2**10:.0f} KiB/s)"
+                    if handoff_bytes
+                    else "—"
+                )
                 frag = digest.get("frag")
                 frag_cell = f"{frag:.2f}" if isinstance(frag, (int, float)) else "—"
                 cs = s.get("compile_stats") if isinstance(s.get("compile_stats"), dict) else {}
@@ -527,10 +553,12 @@ class HealthMonitor:
                 blocks = s.get("blocks") or ["?", "?"]
                 rows.append(
                     f"<tr><td><code>{peer[:12]}…</code> {html.escape(s.get('public_name') or '')}</td>"
-                    f"<td>{html.escape(str(s.get('state')))}</td><td>[{blocks[0]}, {blocks[1]})</td>"
+                    f"<td>{html.escape(str(s.get('state')))}</td><td>{tier_cell}</td>"
+                    f"<td>[{blocks[0]}, {blocks[1]})</td>"
                     f"<td>{throughput_cell}</td><td>{s.get('cache_tokens_left')}</td>"
                     f"<td>{html.escape(load)}</td>"
                     f"<td>{tok_s_cell}</td><td>{ttft_cell}</td><td>{swap_cell}</td>"
+                    f"<td>{handoff_cell}</td>"
                     f"<td>{frag_cell}</td><td>{compiled_cell}</td><td>{integrity_cell}</td>"
                     f"<td>{html.escape(str(s.get('quant_type')))}</td><td>{'yes' if s.get('relayed') else 'no'}</td></tr>"
                 )

@@ -1,0 +1,602 @@
+"""Disaggregated prefill/decode serving (phase tiers + KV handoff).
+
+Fast tier (no swarm): phase-aware routing costs, per-tier autoscaler
+signals on canned snapshots, prefill-storm traffic determinism, health
+rollup of the tier/handoff announce fields, and the env tunables.
+
+Slow tier (real-process two-server swarms, run with ``-m disagg`` or
+``-m slow``): token parity of the prefill->decode ``kv_adopt`` handoff
+vs colocated decode (greedy + seeded sampling), ledger handoff-byte
+attribution, page-refcount cleanliness on the source, and the chaos
+``handoff.push`` degrade-to-colocated fallback.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.disagg
+
+from petals_tpu.swarm.policy import (
+    AutoscalerPolicy,
+    PolicyConfig,
+    ServerSample,
+    SwarmSnapshot,
+    snapshot_from_health,
+)
+from petals_tpu.traffic import TrafficConfig, TrafficGenerator
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ------------------------------------------------------------------ routing
+
+
+def test_phase_tier_routing_prefers_matching_tier():
+    """Equal-cost prefill- and decode-tier replicas: a prefill-phase route
+    must land on the prefill server, a decode-phase route on the decode
+    server, and a phase-less route must stay valid either way."""
+    from petals_tpu.client.config import ClientConfig
+    from petals_tpu.client.routing.sequence_manager import RemoteSequenceManager
+    from petals_tpu.data_structures import ServerInfo, ServerState, make_uid
+    from petals_tpu.dht import DHTNode
+    from petals_tpu.utils.dht_utils import declare_active_modules
+
+    async def main():
+        boot = await DHTNode.create(maintenance_period=1000)
+        uids = [make_uid("m", i) for i in range(2)]
+        nodes = []
+        peers = {}
+        for tier in ("prefill", "decode"):
+            node = await DHTNode.create(
+                initial_peers=[boot.own_addr], maintenance_period=1000
+            )
+            info = ServerInfo(
+                ServerState.ONLINE, 10.0, start_block=0, end_block=2,
+                inference_rps=10.0, phase_tier=tier,
+            )
+            await declare_active_modules(node, uids, info, time.time() + 60)
+            nodes.append(node)
+            peers[tier] = node.peer_id
+        manager = await RemoteSequenceManager.create(
+            ClientConfig(initial_peers=[boot.own_addr.to_string()], update_period=1000),
+            uids,
+        )
+        try:
+            await manager.ensure_ready()
+            for phase in ("prefill", "decode"):
+                chain = await manager.make_sequence(mode="min_latency", phase=phase)
+                assert [s.peer_id for s in chain] == [peers[phase]], (
+                    f"{phase}-phase route must pick the {phase}-tier replica"
+                )
+            neutral = await manager.make_sequence(mode="min_latency")
+            assert neutral[0].peer_id in peers.values()
+        finally:
+            await manager.shutdown()
+            for n in nodes + [boot]:
+                await n.shutdown()
+
+    run(main())
+
+
+def test_phase_tier_announce_roundtrip():
+    """phase_tier survives the ServerInfo wire roundtrip and is absent-safe
+    (a pre-tier announce deserializes with phase_tier=None)."""
+    from petals_tpu.data_structures import ServerInfo, ServerState
+
+    info = ServerInfo(ServerState.ONLINE, 1.0, phase_tier="decode")
+    back = ServerInfo.from_tuple(info.to_tuple())
+    assert back.phase_tier == "decode"
+    legacy = ServerInfo(ServerState.ONLINE, 1.0)
+    assert ServerInfo.from_tuple(legacy.to_tuple()).phase_tier is None
+
+
+# ----------------------------------------------------------------- autoscaler
+
+
+def _tiered_server(peer, tier, *, lanes=4, busy=0, waiters=0, throughput=10.0):
+    return ServerSample(
+        peer=peer, start=0, end=4, state="online", throughput=throughput,
+        lanes=lanes, busy_lanes=busy, lane_waiters=waiters, tier=tier,
+    )
+
+
+def test_prefill_tier_scales_on_its_own_queue_share():
+    """Prefill lanes queue while the swarm-wide signal stays cool: the
+    per-tier path must fire a prefill-tier scale_out."""
+    policy = AutoscalerPolicy(PolicyConfig(prefill_sustain_out=2))
+    decisions = []
+    for tick in range(4):
+        # swarm-wide queue share: 4 waiters / 20 lanes = 0.2 (< 0.5 = cool
+        # enough not to trip the generic scale_out), prefill tier: 4/4 = 1.0
+        snap = SwarmSnapshot(
+            tick=tick, num_blocks=4,
+            servers=(
+                _tiered_server("pre", "prefill", lanes=4, busy=4, waiters=4),
+                _tiered_server("dec", "decode", lanes=8, busy=2),
+                _tiered_server("gen", "generalist", lanes=8, busy=1),
+            ),
+        )
+        decisions += policy.observe(snap)
+    assert len(decisions) == 1
+    d = decisions[0]
+    assert d.action == "scale_out" and d.tier == "prefill"
+    assert d.evidence["tier_queue_share"] == pytest.approx(1.0)
+    assert policy.journal[-1]["tier"] == "prefill"
+
+
+def test_decode_tier_scales_on_occupancy_not_queue():
+    """Decode lanes saturate with zero waiters (short steps drain queues):
+    the decode tier must still scale, on occupancy."""
+    policy = AutoscalerPolicy(PolicyConfig(decode_sustain_out=2))
+    decisions = []
+    for tick in range(4):
+        snap = SwarmSnapshot(
+            tick=tick, num_blocks=4,
+            servers=(
+                _tiered_server("pre", "prefill", lanes=4, busy=1),
+                _tiered_server("dec", "decode", lanes=4, busy=4, waiters=0),
+            ),
+        )
+        decisions += policy.observe(snap)
+    assert len(decisions) == 1
+    d = decisions[0]
+    assert d.action == "scale_out" and d.tier == "decode"
+    assert d.evidence["tier_occupancy"] == pytest.approx(1.0)
+
+
+def test_tier_floor_blocks_scale_in():
+    """A cold sole decode replica must not be harvested (independent
+    per-tier floor), while a second decode replica unlocks the harvest."""
+    cfg = PolicyConfig(sustain_in=2, cooldown_in=0, cooldown_global=0,
+                       decode_min_replicas=1)
+    servers = (
+        _tiered_server("gen", "generalist", lanes=4, busy=2, throughput=100.0),
+        _tiered_server("dec", "decode", lanes=4, busy=0, throughput=1.0),
+    )
+    policy = AutoscalerPolicy(cfg)
+    for tick in range(12):
+        decisions = policy.observe(
+            SwarmSnapshot(tick=tick, num_blocks=4, servers=servers)
+        )
+        assert not any(
+            d.action == "scale_in" and d.target == "dec" for d in decisions
+        ), "sole decode replica harvested below its tier floor"
+
+    policy = AutoscalerPolicy(cfg)
+    servers2 = servers + (
+        _tiered_server("dec2", "decode", lanes=4, busy=0, throughput=2.0),
+    )
+    fired = []
+    for tick in range(12):
+        fired += policy.observe(
+            SwarmSnapshot(tick=tick, num_blocks=4, servers=servers2)
+        )
+    harvested = [d for d in fired if d.action == "scale_in"]
+    assert harvested and harvested[0].target == "dec"
+    assert harvested[0].tier == "decode"
+
+
+def test_all_generalist_swarm_never_emits_tier_decisions():
+    policy = AutoscalerPolicy(PolicyConfig())
+    for tick in range(10):
+        snap = SwarmSnapshot(
+            tick=tick, num_blocks=4,
+            servers=(_tiered_server("a", "generalist", lanes=2, busy=2, waiters=4),),
+        )
+        for d in policy.observe(snap):
+            assert d.tier is None
+        assert snap.tiers_present() == ()
+    assert policy._tier_hot_streaks == {}
+
+
+def test_tiered_journal_replays_byte_identically():
+    """The per-tier policy stays a pure byte-replayable function of the
+    snapshot stream, and the journal rows carry the tier."""
+    def snaps():
+        out = []
+        for tick in range(20):
+            waiters = 6 if tick % 3 else 0
+            out.append(SwarmSnapshot(
+                tick=tick, num_blocks=4,
+                servers=(
+                    _tiered_server("pre", "prefill", lanes=4, busy=4, waiters=waiters),
+                    _tiered_server("dec", "decode", lanes=4,
+                                   busy=4 if tick > 10 else 1),
+                    _tiered_server("gen", "generalist", lanes=16, busy=2),
+                ),
+            ))
+        return out
+
+    runs = []
+    for _ in range(2):
+        policy = AutoscalerPolicy(PolicyConfig())
+        for snap in snaps():
+            policy.observe(snap)
+        runs.append(policy.journal_jsonl())
+    assert runs[0] == runs[1]
+    assert '"tier":"prefill"' in runs[0] or '"tier":"decode"' in runs[0]
+
+
+def test_snapshot_from_health_parses_phase_tier():
+    state = {
+        "num_blocks": 4,
+        "servers": {
+            "p1": {"state": "online", "blocks": [0, 4], "phase_tier": "prefill"},
+            "p2": {"state": "online", "blocks": [0, 4], "phase_tier": "decode"},
+            "p3": {"state": "online", "blocks": [0, 4]},
+            "p4": {"state": "online", "blocks": [0, 4], "phase_tier": "bogus"},
+        },
+    }
+    snap = snapshot_from_health(state, tick=0)
+    tiers = {s.peer: s.tier for s in snap.servers}
+    assert tiers == {
+        "p1": "prefill", "p2": "decode", "p3": "generalist", "p4": "generalist"
+    }
+    assert snap.tiers_present() == ("prefill", "decode")
+    assert snap.replica_count(tier="decode") == 1
+
+
+# ------------------------------------------------------------------- traffic
+
+
+def test_storm_disabled_draws_nothing():
+    """storm_rate=0 must reproduce legacy schedules byte-identically, even
+    when the other storm knobs differ (they draw NOTHING when disabled)."""
+    base = dict(seed=42, duration_s=60.0, base_rate=2.0, vocab_size=100)
+    legacy = TrafficGenerator(TrafficConfig(**base)).schedule()
+    off = TrafficGenerator(TrafficConfig(
+        **base, storm_rate=0.0, storm_prompt_len=99, storm_burst=7,
+    )).schedule()
+    assert off == legacy
+
+
+def test_storm_overlay_deterministic_and_additive():
+    base = dict(seed=7, duration_s=60.0, base_rate=1.0, vocab_size=100)
+    storm_cfg = dict(
+        storm_rate=0.5, storm_burst=3, storm_start_frac=0.2,
+        storm_end_frac=0.8, storm_prompt_len=32, storm_prompt_max=64,
+    )
+    a = TrafficGenerator(TrafficConfig(**base, **storm_cfg)).schedule()
+    b = TrafficGenerator(TrafficConfig(**base, **storm_cfg)).schedule()
+    assert a == b, "storm schedules must be seed-deterministic"
+
+    legacy = TrafficGenerator(TrafficConfig(**base)).schedule()
+    storm = [p for p in a if p.storm]
+    calm = [p for p in a if not p.storm]
+    assert storm, "an enabled storm must land sessions"
+    # the legacy sub-stream is untouched: same sessions, same times, same
+    # prompts — only the indices shift to interleave the storm
+    assert [(p.t, p.tenant, p.prompt, p.new_tokens) for p in calm] == [
+        (p.t, p.tenant, p.prompt, p.new_tokens) for p in legacy
+    ]
+    assert [p.index for p in a] == list(range(len(a)))
+    assert [p.t for p in a] == sorted(p.t for p in a)
+    t0, t1 = 0.2 * 60.0, 0.8 * 60.0
+    for p in storm:
+        assert t0 <= p.t < t1, "storm arrivals must stay inside the window"
+        assert len(p.prompt) >= 32, "storm prompts are heavy"
+        assert p.new_tokens == TrafficConfig().storm_new_tokens
+    # bursts: arrival epochs repeat storm_burst times
+    by_t = {}
+    for p in storm:
+        by_t.setdefault(p.t, 0)
+        by_t[p.t] += 1
+    assert set(by_t.values()) == {3}
+
+
+def test_storm_config_validation():
+    with pytest.raises(ValueError):
+        TrafficConfig(storm_rate=-1.0)
+    with pytest.raises(ValueError):
+        TrafficConfig(storm_rate=1.0, storm_start_frac=0.9, storm_end_frac=0.1)
+    with pytest.raises(ValueError):
+        TrafficConfig(storm_rate=1.0, storm_burst=0)
+
+
+# ---------------------------------------------------------------- telemetry
+
+
+def test_digest_and_health_roll_up_handoff_and_tier():
+    from petals_tpu.telemetry import instruments as tm
+    from petals_tpu.telemetry.exposition import telemetry_digest
+    from petals_tpu.utils.health import HealthMonitor
+
+    before = int(tm.HANDOFF_BYTES.value)
+    tm.HANDOFF_BYTES.inc(1024)
+    digest = telemetry_digest()
+    assert digest["handoff_bytes"] == before + 1024
+    assert "handoff_bytes_s" in digest
+
+    monitor = HealthMonitor(["127.0.0.1:1/00"])
+    monitor._state = {
+        "updated_at": 0.0,
+        "models": {
+            "m": {
+                "num_blocks": 4,
+                "healthy": True,
+                "blocks_covered": 4,
+                "model_type": "llama",
+                "servers": {
+                    "p1": {
+                        "state": "ONLINE", "blocks": [0, 4],
+                        "phase_tier": "prefill",
+                        "telemetry": {"handoff_bytes": 2048, "handoff_bytes_s": 17.0},
+                    },
+                    "p2": {
+                        "state": "ONLINE", "blocks": [0, 4],
+                        "phase_tier": "decode",
+                        "telemetry": {"handoff_bytes": 1024, "handoff_bytes_s": 3.0},
+                    },
+                    "p3": {"state": "ONLINE", "blocks": [0, 4]},
+                },
+            }
+        },
+    }
+    agg = monitor.metrics_summary()["models"]["m"]["aggregate"]
+    assert agg["tiers"] == {"generalist": 1, "prefill": 1, "decode": 1}
+    assert agg["handoff_bytes"] == 3072
+    assert agg["handoff_bytes_s"] == pytest.approx(20.0)
+    # the human-readable table grows the tier column
+    html = monitor._render_html()
+    assert "<th>tier</th>" in html and "prefill" in html
+
+
+# ------------------------------------------------------------- env tunables
+
+
+def test_radix_device_frac_env(monkeypatch):
+    from petals_tpu.server.prefix_cache import resolve_device_bytes
+
+    monkeypatch.delenv("PETALS_TPU_RADIX_DEVICE_FRAC", raising=False)
+    assert resolve_device_bytes(1000, 123) == 123  # unset: explicit value wins
+    monkeypatch.setenv("PETALS_TPU_RADIX_DEVICE_FRAC", "0.25")
+    assert resolve_device_bytes(1000, 123) == 250
+    monkeypatch.setenv("PETALS_TPU_RADIX_DEVICE_FRAC", "7.5")  # clamped to 1.0
+    assert resolve_device_bytes(1000, 123) == 1000
+    monkeypatch.setenv("PETALS_TPU_RADIX_DEVICE_FRAC", "banana")
+    assert resolve_device_bytes(1000, 123) == 123  # malformed: ignored
+
+
+def test_promote_min_hits_env(monkeypatch):
+    import importlib
+
+    import petals_tpu.server.prefix_cache as pc
+
+    monkeypatch.setenv("PETALS_TPU_PROMOTE_MIN_HITS", "5")
+    importlib.reload(pc)
+    try:
+        assert pc.PROMOTE_MIN_HITS == 5
+    finally:
+        monkeypatch.delenv("PETALS_TPU_PROMOTE_MIN_HITS")
+        importlib.reload(pc)
+        assert pc.PROMOTE_MIN_HITS == 2
+
+
+# ------------------------------------------------- two-server handoff (slow)
+
+
+@pytest.fixture()
+def tiered_swarm(tmp_path_factory):
+    """One prefill-tier + one decode-tier full-span server. Server-side
+    generation is off so the client drives the per-token path (the phase
+    handoff fires at the first-step boundary of that path; the server-gen
+    path prefills and decodes inside one RPC, so there is no boundary to
+    cut at)."""
+    from tests.test_full_model import SwarmHarness
+    from tests.utils import make_tiny_llama
+
+    path = make_tiny_llama(str(tmp_path_factory.mktemp("models")))
+    harness = SwarmHarness(
+        path,
+        [
+            dict(first_block=0, num_blocks=4, throughput=1000.0,
+                 phase_tier="prefill", server_side_generation=False),
+            dict(first_block=0, num_blocks=4, throughput=1000.0,
+                 phase_tier="decode", server_side_generation=False),
+        ],
+    ).start()
+    yield path, harness
+    harness.stop()
+
+
+def _spy_handoff_paths(monkeypatch):
+    from petals_tpu.client.inference_session import InferenceSession
+
+    adopts, replays = [], []
+    real_adopt = InferenceSession._seed_by_adopt
+
+    async def spy_adopt(self, session, source_session_id, export_pos, replay_steps):
+        ok = await real_adopt(self, session, source_session_id, export_pos, replay_steps)
+        adopts.append(ok)
+        return ok
+
+    monkeypatch.setattr(InferenceSession, "_seed_by_adopt", spy_adopt)
+    real_replay = InferenceSession._replay_step
+
+    async def spy_replay(self, session, chunk, hypo_step, step_id):
+        replays.append(step_id)
+        return await real_replay(self, session, chunk, hypo_step, step_id)
+
+    monkeypatch.setattr(InferenceSession, "_replay_step", spy_replay)
+    return adopts, replays
+
+
+def _disagg_model(path, harness, **overrides):
+    from petals_tpu.client.model import AutoDistributedModelForCausalLM
+
+    kwargs = dict(
+        initial_peers=harness.initial_peers, min_backoff=0.1,
+        prefill_tier_tokens=4,  # the 5-6 token test prompts count as prefills
+    )
+    kwargs.update(overrides)
+    return AutoDistributedModelForCausalLM.from_pretrained(path, **kwargs)
+
+
+@pytest.mark.slow
+def test_handoff_token_parity_greedy(tiered_swarm, monkeypatch):
+    """Greedy decode after a prefill->decode handoff must stay HF-identical,
+    the session must land on the decode-tier server, the adopt must carry
+    the KV (zero replays), and the ledger must bill the handoff bytes."""
+    from petals_tpu.telemetry import instruments as tm
+    from petals_tpu.telemetry.ledger import get_ledger
+    from tests.test_full_model import _hf_greedy
+
+    path, harness = tiered_swarm
+    adopts, replays = _spy_handoff_paths(monkeypatch)
+    handoffs_ok0 = tm.HANDOFFS.labels(outcome="ok").value
+    handoff_bytes0 = int(tm.HANDOFF_BYTES.value)
+    migrated0 = sum(r["migrated_bytes"] for r in get_ledger().top_peers(k=100))
+
+    model = _disagg_model(path, harness)
+    try:
+        rng = np.random.RandomState(0)
+        input_ids = rng.randint(0, 100, (1, 6)).astype(np.int64)
+        expected = _hf_greedy(path, input_ids, 6)
+
+        with model.remote.inference_session(max_length=16, batch_size=1) as session:
+            ours = model.generate(input_ids, max_new_tokens=6, session=session)
+            np.testing.assert_array_equal(ours, expected)
+
+            inner = session._session
+            decode_peer = harness.servers[1].dht.peer_id
+            assert [s.span.peer_id for s in inner._sessions] == [decode_peer], (
+                "session must decode on the decode-tier replica after handoff"
+            )
+            assert inner._handoff_stats["adopted"] == 1
+            assert inner._handoff_stats["fallback"] == 0
+            assert inner._handoff_stats["replayed"] == 0
+        assert adopts == [True]
+        assert replays == [], "a step-boundary handoff must never replay"
+        assert tm.HANDOFFS.labels(outcome="ok").value == handoffs_ok0 + 1
+        pushed = int(tm.HANDOFF_BYTES.value) - handoff_bytes0
+        assert pushed > 0
+        # both servers share the in-process ledger singleton, so the delta is
+        # exactly both directions: the source's rollup (pushed bytes) plus the
+        # destination's live-session attribution of the adopted wire bytes
+        migrated = sum(r["migrated_bytes"] for r in get_ledger().top_peers(k=100))
+        assert migrated - migrated0 == 2 * pushed, (
+            "handoff bytes must be billed as migration bytes in the ledger"
+        )
+    finally:
+        model.close()
+
+
+@pytest.mark.slow
+def test_handoff_token_parity_seeded_sampling(tiered_swarm, monkeypatch):
+    """Seeded sampling through a handed-off session must match the same
+    seed decoded colocated (disagg_handoff=False): the adopted KV is exact."""
+    path, harness = tiered_swarm
+
+    def sample(disagg: bool):
+        model = _disagg_model(path, harness, disagg_handoff=disagg)
+        try:
+            rng = np.random.RandomState(1)
+            input_ids = rng.randint(0, 100, (1, 6)).astype(np.int64)
+            with model.remote.inference_session(max_length=16, batch_size=1) as session:
+                out = model.generate(
+                    input_ids, max_new_tokens=5, session=session,
+                    do_sample=True, top_k=10, temperature=0.8, seed=7,
+                )
+                peers = [s.span.peer_id for s in session._session._sessions]
+            return np.asarray(out), peers
+        finally:
+            model.close()
+
+    with_handoff, handoff_peers = sample(True)
+    colocated, colocated_peers = sample(False)
+    np.testing.assert_array_equal(with_handoff, colocated)
+    assert handoff_peers == [harness.servers[1].dht.peer_id]
+    assert colocated_peers == [harness.servers[0].dht.peer_id], (
+        "with the handoff disabled the session must stay on the prefill tier"
+    )
+
+
+@pytest.mark.slow
+def test_handoff_source_refcount_clean(tiered_swarm, monkeypatch):
+    """After the handoff (and session close) the prefill server must hold
+    zero live sessions and a fully free page pool — the pushed KV must not
+    leak pages or registry entries on the source."""
+    path, harness = tiered_swarm
+    _spy_handoff_paths(monkeypatch)
+    model = _disagg_model(path, harness)
+    try:
+        rng = np.random.RandomState(2)
+        input_ids = rng.randint(0, 100, (1, 6)).astype(np.int64)
+        with model.remote.inference_session(max_length=16, batch_size=1) as session:
+            model.generate(input_ids, max_new_tokens=4, session=session)
+            inner = session._session
+            assert inner._handoff_stats["adopted"] == 1
+    finally:
+        model.close()
+
+    source = harness.servers[0].handler
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        pool = source.batcher.occupancy_info()
+        if (
+            not source._session_registry
+            and not source._parked
+            and pool.get("busy_lanes", 0) == 0
+        ):
+            break
+        time.sleep(0.2)
+    assert not source._session_registry, "live session leaked on the source"
+    assert not source._parked, "parked snapshot leaked on the source"
+    pool = source.batcher.occupancy_info()
+    assert pool.get("busy_lanes", 0) == 0, f"source lanes still busy: {pool}"
+    if pool.get("n_pages"):
+        assert pool["pages_free"] == pool["n_pages"], (
+            f"handed-off KV leaked pages on the source: {pool}"
+        )
+
+
+@pytest.mark.slow
+def test_chaos_handoff_push_degrades_to_colocated(tiered_swarm, monkeypatch):
+    """chaos refusing handoff.push: the push fails server-side, the client
+    journals the fallback and keeps decoding colocated on the prefill
+    replica — HF-identical tokens, no session loss, no replay."""
+    from petals_tpu import chaos
+    from petals_tpu.chaos.plane import ChaosRule
+    from petals_tpu.telemetry import get_journal
+    from petals_tpu.telemetry import instruments as tm
+    from tests.test_full_model import _hf_greedy
+
+    path, harness = tiered_swarm
+    adopts, replays = _spy_handoff_paths(monkeypatch)
+    baseline_seq = get_journal().event("test_marker")["seq"]
+    failed0 = tm.HANDOFFS.labels(outcome="failed").value
+    model = _disagg_model(path, harness)
+    try:
+        chaos.configure(
+            seed=0, rules=[ChaosRule(chaos.SITE_HANDOFF_PUSH, "refuse")]
+        )
+        rng = np.random.RandomState(3)
+        input_ids = rng.randint(0, 100, (1, 6)).astype(np.int64)
+        expected = _hf_greedy(path, input_ids, 6)
+        with model.remote.inference_session(max_length=16, batch_size=1) as session:
+            ours = model.generate(input_ids, max_new_tokens=6, session=session)
+            np.testing.assert_array_equal(ours, expected)
+            inner = session._session
+            prefill_peer = harness.servers[0].dht.peer_id
+            assert [s.span.peer_id for s in inner._sessions] == [prefill_peer], (
+                "failed handoff must leave the session decoding on the source"
+            )
+            assert inner._handoff_stats == {
+                "adopted": 0, "fallback": 1, "replayed": 0
+            }
+    finally:
+        chaos.disable()
+        model.close()
+
+    assert adopts == [], "no adopt can succeed through a refused push"
+    assert replays == [], "the colocated fallback must not replay history"
+    assert tm.HANDOFFS.labels(outcome="failed").value == failed0 + 1
+    fallbacks = get_journal().events(kind="handoff_fallback", since_seq=baseline_seq)
+    assert len(fallbacks) == 1, "the client must journal the degrade-to-colocated"
+    failed = get_journal().events(kind="handoff_failed", since_seq=baseline_seq)
+    assert len(failed) == 1, "the source must journal the failed push"
