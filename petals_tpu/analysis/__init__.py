@@ -8,14 +8,25 @@
 """
 
 from .findings import Finding
-from .engine import check_file, check_paths, check_source, unsuppressed
+from .engine import (
+    ALL_RULE_NAMES,
+    check_file,
+    check_paths,
+    check_project,
+    check_source,
+    check_sources,
+    unsuppressed,
+)
 from .rules import RULES
 
 __all__ = [
+    "ALL_RULE_NAMES",
     "Finding",
     "RULES",
     "check_file",
     "check_paths",
+    "check_project",
     "check_source",
+    "check_sources",
     "unsuppressed",
 ]

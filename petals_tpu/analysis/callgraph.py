@@ -1,0 +1,754 @@
+"""Module-level call graph + per-function fact extraction for swarmlint v2.
+
+The v1 rules are per-function AST walks; the dangerous state transitions in
+this codebase (swap tier, live migration, radix residency, phase handoff)
+moved into helper-call chains those walks are structurally blind to. This
+module builds the project model the interprocedural passes run on:
+
+- :class:`FunctionFacts` — one function's *direct* facts, extracted in a
+  single AST pass: every call site (with the locks lexically held around it
+  and the try/finally protection enclosing it), await points, blocking-call
+  points, page incref/decref sites, lane-typestate mutations, manual
+  ``.acquire()``/``.release()`` pairs, and donation decorators.
+- :class:`ModuleFacts` — a file's functions + classes + imports + pragmas +
+  the names its thread locks and donating jit-callables are bound to.
+- :class:`Project` — the whole-tree index with call resolution:
+
+  1. nested defs in the caller,
+  2. module-level functions in the caller's module,
+  3. ``self.method()`` through the caller's class and its bases found in
+     the tree (method resolution on ``self``),
+  4. ``from x import f`` / ``import x`` aliases,
+  5. otherwise *dynamic dispatch falls back to top*: the join of every
+     function with that name anywhere in the tree (a receiver we cannot
+     type could be any of them, so effect summaries union over all).
+
+Everything here is a plain picklable dataclass so the per-file extraction
+can run in worker processes (``engine.check_project(jobs=N)``) and only the
+cheap fact records cross back — never the ASTs themselves.
+
+A deliberate precision choice, relied on throughout: a function passed as a
+*value* (``queue.submit(self._gather)``, ``asyncio.to_thread(fn)``) creates
+NO call edge. Compute-thread bodies blocking under the reset lock are the
+design, not a bug — only direct calls propagate effects.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .findings import Pragma, parse_pragmas
+from .rules import (
+    BLOCKING_CALLS,
+    BLOCKING_METHODS,
+    INCREF_CALLS,
+    RELEASE_CALLS,
+    collect_thread_lock_names,
+    dotted,
+    last_segment,
+    looks_like_lock,
+)
+
+# Lane/session lifecycle fields (scheduler.SessionSlot): the typestate rule
+# and cancellation-safety's dirty tracking key off mutations to these.
+TYPESTATE_FIELDS = ("suspending", "swap")
+
+# self.<attr> fields whose mutation marks an invariant-critical region dirty
+# for cancellation-safety (lane tables, page pool, migration/handoff parking).
+CRITICAL_FIELDS = {
+    "suspending",
+    "swap",
+    "_tables",
+    "_pages",
+    "_lane_generation",
+    "_generation",
+    "_inflight",
+    "_gen_states",
+    "_prefill_queue",
+    "_pending",
+    "_migrated",
+    "_migrated_away",
+    "_migrated_bytes",
+    "_parked",
+}
+
+_MUTATING_METHODS = {
+    "append",
+    "add",
+    "clear",
+    "extend",
+    "insert",
+    "pop",
+    "popitem",
+    "remove",
+    "setdefault",
+    "update",
+}
+
+_JIT_FAMILY = {"tracked_jit", "jit"}  # final segment of the decorator callee
+_PROPERTY_DECORATORS = {"property", "cached_property"}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+LockCtx = Tuple[str, bool, int]  # (name, is_async, with-statement line)
+TryCtx = Tuple[int, bool, bool]  # (try line, has finally, catches cancellation)
+
+
+def _handler_catches_cancel(h: ast.excepthandler) -> bool:
+    """Bare ``except:`` or a type list naming BaseException/CancelledError —
+    the handlers that still run when the task is cancelled at an await."""
+    if h.type is None:
+        return True
+    nodes = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    for n in nodes:
+        d = dotted(n)
+        if d and d.split(".")[-1] in ("BaseException", "CancelledError"):
+            return True
+    return False
+
+
+@dataclasses.dataclass(frozen=True)
+class CallEvent:
+    """One direct call site, with enough context to resolve and judge it."""
+
+    line: int
+    col: int
+    end_line: int
+    end_col: int
+    kind: str  # 'name' | 'self' | 'attr' | 'dotted'
+    name: str  # final callee segment
+    base: Optional[str]  # dotted receiver ('self', 'self._pages', 'batching', ...)
+    args: Tuple[Tuple[int, Optional[str]], ...]  # positional (index, dotted repr)
+    kwargs: Tuple[Tuple[str, Optional[str]], ...]  # keyword (name, dotted repr)
+    assigns: Tuple[str, ...]  # dotted assignment targets of the call's statement
+    awaited: bool
+    locks: Tuple[LockCtx, ...]
+    trys: Tuple[TryCtx, ...]
+    cleanup: bool  # inside a finally block or except handler
+    # '' | 'except' | 'except_cancel' | 'finally' — which kind of cleanup
+    # region encloses this site. 'except' does NOT run on CancelledError
+    # (BaseException since 3.8), so a refcount release there does not
+    # protect a function that can suspend; 'finally'/'except_cancel' do.
+    cleanup_kind: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One non-call fact: kinds 'await', 'block', 'ref_inc', 'ref_rel',
+    'mutate', 'ts', 'lock_acq', 'lock_rel', 'trylock', 'return'."""
+
+    kind: str
+    line: int
+    col: int
+    detail: str
+    locks: Tuple[LockCtx, ...]
+    trys: Tuple[TryCtx, ...]
+    cleanup: bool
+    cleanup_kind: str = ""  # see CallEvent.cleanup_kind
+
+
+@dataclasses.dataclass(frozen=True)
+class DonationSpec:
+    argnums: Tuple[int, ...]
+    argnames: Tuple[str, ...]
+
+    def __bool__(self) -> bool:
+        return bool(self.argnums or self.argnames)
+
+
+@dataclasses.dataclass
+class FunctionFacts:
+    qualname: str
+    name: str
+    cls: Optional[str]
+    path: str
+    lineno: int
+    is_async: bool
+    params: Tuple[str, ...]
+    calls: List[CallEvent]
+    events: List[Event]
+    nested: Tuple[str, ...]  # qualnames of directly nested defs
+    donation: Optional[DonationSpec]  # jit-with-donation decorator on this def
+    is_property: bool
+    returns_nested: Tuple[str, ...]  # simple names of nested defs it returns
+    # every identifier (Name / dotted Attribute) touched in this function:
+    # dotted name -> ordered ((line, col, 'load'|'store'), ...). Drives the
+    # use-after-donate read scan without shipping ASTs between processes.
+    name_uses: Dict[str, Tuple[Tuple[int, int, str], ...]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+@dataclasses.dataclass
+class ClassFacts:
+    name: str
+    bases: Tuple[str, ...]
+    methods: Dict[str, str]  # method name -> qualname
+
+
+@dataclasses.dataclass
+class ModuleFacts:
+    path: str
+    funcs: List[FunctionFacts]
+    classes: Dict[str, ClassFacts]
+    imports: Dict[str, str]  # alias -> dotted module / "mod.name" for from-imports
+    thread_locks: Tuple[str, ...]
+    donating_names: Dict[str, DonationSpec]  # bound name/attr tail -> spec
+    pragmas: List[Pragma]
+
+
+# ------------------------------------------------------------ decorator parse
+
+
+def _const_ints(node: ast.AST) -> Tuple[int, ...]:
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, int):
+            out.append(sub.value)
+    return tuple(out)
+
+
+def _const_strs(node: ast.AST) -> Tuple[str, ...]:
+    return tuple(
+        sub.value
+        for sub in ast.walk(node)
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+    )
+
+
+def donation_spec(call: ast.AST) -> Optional[DonationSpec]:
+    """DonationSpec for a jit-family call carrying donate_argnums/argnames
+    (``tracked_jit(...)``, ``jax.jit(...)``, ``functools.partial(jax.jit,
+    ...)``); None when ``call`` is not a donating jit call."""
+    if not isinstance(call, ast.Call):
+        return None
+    callee = dotted(call.func) or ""
+    seg = callee.split(".")[-1]
+    if seg == "partial":
+        if not call.args:
+            return None
+        inner = dotted(call.args[0]) or ""
+        if inner.split(".")[-1] not in _JIT_FAMILY:
+            return None
+    elif seg not in _JIT_FAMILY:
+        return None
+    argnums: Tuple[int, ...] = ()
+    argnames: Tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            argnums = _const_ints(kw.value)
+        elif kw.arg == "donate_argnames":
+            argnames = _const_strs(kw.value)
+    spec = DonationSpec(argnums=argnums, argnames=argnames)
+    return spec if spec else None
+
+
+def _param_names(fn: ast.AST) -> Tuple[str, ...]:
+    a = fn.args
+    names = [p.arg for p in [*a.posonlyargs, *a.args, *a.kwonlyargs]]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return tuple(names)
+
+
+# ------------------------------------------------------------- the extractor
+
+
+class _FunctionWalker:
+    """Single in-order pass over one function body: records calls and events
+    with the lock regions and try protection lexically enclosing each one.
+    Does NOT descend into nested defs (their code runs at call time)."""
+
+    def __init__(self, facts: FunctionFacts, thread_locks: Set[str]):
+        self.facts = facts
+        self.thread_locks = thread_locks
+        self.locks: List[LockCtx] = []
+        self.trys: List[TryCtx] = []
+        self.cleanup_stack: List[str] = []  # 'except' | 'except_cancel' | 'finally'
+
+    # -- context helpers
+
+    def _ctx(self) -> Tuple[Tuple[LockCtx, ...], Tuple[TryCtx, ...], bool, str]:
+        kind = self.cleanup_stack[-1] if self.cleanup_stack else ""
+        return tuple(self.locks), tuple(self.trys), bool(self.cleanup_stack), kind
+
+    def event(self, kind: str, node: ast.AST, detail: str) -> None:
+        locks, trys, cleanup, cleanup_kind = self._ctx()
+        self.facts.events.append(
+            Event(
+                kind=kind,
+                line=node.lineno,
+                col=getattr(node, "col_offset", 0),
+                detail=detail,
+                locks=locks,
+                trys=trys,
+                cleanup=cleanup,
+                cleanup_kind=cleanup_kind,
+            )
+        )
+
+    # -- expression scanning (records calls/awaits/refcounts in one walk)
+
+    def scan_expr(self, node: ast.AST, assigns: Tuple[str, ...] = ()) -> None:
+        for sub in self._walk_no_functions(node):
+            if isinstance(sub, ast.Await):
+                self.event("await", sub, "")
+            elif isinstance(sub, ast.Call):
+                self._record_call(sub, node, assigns)
+
+    def _walk_no_functions(self, node: ast.AST):
+        stack = [node]
+        while stack:
+            cur = stack.pop()
+            if isinstance(cur, _FUNC_NODES) and cur is not node:
+                continue
+            yield cur
+            if not (isinstance(cur, _FUNC_NODES) and cur is not node):
+                stack.extend(ast.iter_child_nodes(cur))
+
+    def _record_call(self, call: ast.Call, stmt_expr: ast.AST, assigns) -> None:
+        func = call.func
+        full = dotted(func)
+        name = last_segment(func)
+        if name is None:
+            return  # dynamic callee ((fns[i])(...)): no edge
+        base: Optional[str] = None
+        kind = "name"
+        if isinstance(func, ast.Attribute):
+            base = dotted(func.value)
+            if base == "self":
+                kind = "self"
+            elif base is not None:
+                kind = "dotted"
+            else:
+                kind = "attr"
+        locks, trys, cleanup, cleanup_kind = self._ctx()
+        awaited = False
+        # the await wrapping this call, if any, was already recorded; mark
+        # the call itself so rules can tell `await f()` from bare `f()`
+        parent = getattr(call, "_swarmlint_parent", None)
+        if isinstance(parent, ast.Await):
+            awaited = True
+        args = tuple(
+            (i, dotted(a)) for i, a in enumerate(call.args)
+            if not isinstance(a, ast.Starred)
+        )
+        kwargs = tuple(
+            (kw.arg, dotted(kw.value)) for kw in call.keywords if kw.arg
+        )
+        self.facts.calls.append(
+            CallEvent(
+                line=call.lineno,
+                col=call.col_offset,
+                end_line=getattr(call, "end_lineno", call.lineno) or call.lineno,
+                end_col=getattr(call, "end_col_offset", call.col_offset) or 0,
+                kind=kind,
+                name=name,
+                base=base,
+                args=args,
+                kwargs=kwargs,
+                assigns=assigns,
+                awaited=awaited,
+                locks=locks,
+                trys=trys,
+                cleanup=cleanup,
+                cleanup_kind=cleanup_kind,
+            )
+        )
+        # classify side-effect facts off the same node
+        if full in BLOCKING_CALLS:
+            self.event("block", call, full)
+        elif (
+            isinstance(func, ast.Attribute)
+            and name in BLOCKING_METHODS
+            and not call.args
+            and not call.keywords
+        ):
+            self.event("block", call, f".{name}()")
+        if isinstance(func, ast.Attribute):
+            if name in INCREF_CALLS:
+                self.event("ref_inc", call, name)
+            elif name in RELEASE_CALLS:
+                self.event("ref_rel", call, name)
+            if name in _MUTATING_METHODS and base and base.startswith("self."):
+                attr = base.split(".")[1]
+                self.event("mutate", call, attr)
+            if name == "acquire" and base:
+                self.event("lock_acq", call, base.split(".")[-1])
+            elif name == "release" and base:
+                self.event("lock_rel", call, base.split(".")[-1])
+        if name == "lock_try_acquire_nowait":
+            self.event("trylock", call, dotted(call.args[0]) if call.args else "")
+
+    # -- statement dispatch
+
+    def walk_body(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            self.walk_stmt(stmt)
+
+    def walk_stmt(self, stmt: ast.stmt) -> None:
+        # annotate parents of Await-wrapped calls before scanning
+        for sub in ast.walk(stmt):
+            for child in ast.iter_child_nodes(sub):
+                child._swarmlint_parent = sub  # type: ignore[attr-defined]
+        if isinstance(stmt, _FUNC_NODES) or isinstance(stmt, ast.ClassDef):
+            return  # separate facts / out of scope
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            is_async = isinstance(stmt, ast.AsyncWith)
+            if is_async:
+                self.event("await", stmt, "async with")
+            for item in stmt.items:
+                self.scan_expr(item.context_expr)
+                seg = last_segment(item.context_expr)
+                # lock-looking names, plus anything this module binds a
+                # threading.Lock/RLock/Condition to (e.g. ``self._cv``)
+                if seg and (
+                    looks_like_lock(item.context_expr) or seg in self.thread_locks
+                ):
+                    self.locks.append((seg, is_async, stmt.lineno))
+                    pushed += 1
+            self.walk_body(stmt.body)
+            for _ in range(pushed):
+                self.locks.pop()
+            return
+        if isinstance(stmt, ast.Try):
+            handlers_catch_cancel = any(
+                _handler_catches_cancel(h) for h in stmt.handlers
+            )
+            ctx: TryCtx = (stmt.lineno, bool(stmt.finalbody), handlers_catch_cancel)
+            self.trys.append(ctx)
+            self.walk_body(stmt.body)
+            self.trys.pop()
+            # exceptions raised in handlers/else/finally are NOT caught here
+            for h in stmt.handlers:
+                self.cleanup_stack.append(
+                    "except_cancel" if _handler_catches_cancel(h) else "except"
+                )
+                self.walk_body(h.body)
+                self.cleanup_stack.pop()
+            self.walk_body(stmt.orelse)
+            self.cleanup_stack.append("finally")
+            self.walk_body(stmt.finalbody)
+            self.cleanup_stack.pop()
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self.scan_expr(stmt.test)
+            self.walk_body(stmt.body)
+            self.walk_body(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            if isinstance(stmt, ast.AsyncFor):
+                self.event("await", stmt, "async for")
+            self.scan_expr(stmt.iter)
+            self.walk_body(stmt.body)
+            self.walk_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.scan_expr(stmt.value)
+                d = dotted(stmt.value)
+                self.event("return", stmt, d or "")
+            else:
+                self.event("return", stmt, "")
+            return
+        # plain statements: record stores, then scan all expressions
+        assigns: Tuple[str, ...] = ()
+        if isinstance(stmt, ast.Assign):
+            assigns = self._store_targets(stmt.targets)
+            self._record_stores(stmt, stmt.targets)
+        elif isinstance(stmt, ast.AugAssign):
+            assigns = self._store_targets([stmt.target])
+            self._record_stores(stmt, [stmt.target])
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            assigns = self._store_targets([stmt.target])
+            self._record_stores(stmt, [stmt.target])
+        self.scan_expr(stmt, assigns=assigns)
+
+    def _store_targets(self, targets: Sequence[ast.AST]) -> Tuple[str, ...]:
+        out: List[str] = []
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                out.extend(self._store_targets(t.elts))
+            else:
+                d = dotted(t)
+                if d:
+                    out.append(d)
+        return tuple(out)
+
+    def _record_stores(self, stmt: ast.stmt, targets: Sequence[ast.AST]) -> None:
+        value = getattr(stmt, "value", None)
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                self._record_stores(stmt, t.elts)
+                continue
+            if isinstance(t, ast.Attribute):
+                if t.attr in TYPESTATE_FIELDS:
+                    self.event("ts", stmt, f"{t.attr}={self._value_kind(value)}")
+                base = dotted(t.value)
+                if base == "self":
+                    self.event("mutate", stmt, t.attr)
+            elif isinstance(t, ast.Subscript):
+                d = dotted(t.value)
+                if d and d.startswith("self."):
+                    self.event("mutate", stmt, d.split(".")[1])
+
+    @staticmethod
+    def _value_kind(value: Optional[ast.AST]) -> str:
+        if isinstance(value, ast.Constant):
+            if value.value is True:
+                return "true"
+            if value.value is False:
+                return "false"
+            if value.value is None:
+                return "none"
+        return "value"
+
+
+def _extract_function(
+    node: ast.AST,
+    path: str,
+    cls: Optional[str],
+    qualname: str,
+    thread_locks: Set[str],
+) -> FunctionFacts:
+    spec: Optional[DonationSpec] = None
+    is_property = False
+    for dec in node.decorator_list:
+        s = donation_spec(dec)
+        if s is not None:
+            spec = s
+        d = dotted(dec) or (dotted(dec.func) if isinstance(dec, ast.Call) else None)
+        if d and d.split(".")[-1] in _PROPERTY_DECORATORS:
+            is_property = True
+    facts = FunctionFacts(
+        qualname=qualname,
+        name=node.name,
+        cls=cls,
+        path=path,
+        lineno=node.lineno,
+        is_async=isinstance(node, ast.AsyncFunctionDef),
+        params=_param_names(node),
+        calls=[],
+        events=[],
+        nested=(),
+        donation=spec,
+        is_property=is_property,
+        returns_nested=(),
+    )
+    walker = _FunctionWalker(facts, thread_locks)
+    walker.walk_body(node.body)
+    nested_names = [
+        n.name
+        for n in node.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    facts.returns_nested = tuple(
+        e.detail for e in facts.events if e.kind == "return" and e.detail in nested_names
+    )
+    # identifier use index (use-after-donate read scan)
+    uses: Dict[str, List[Tuple[int, int, str]]] = {}
+
+    def record_uses(sub: ast.AST) -> None:
+        if isinstance(sub, (ast.Name, ast.Attribute)):
+            d = dotted(sub)
+            if d is not None:
+                ctx = getattr(sub, "ctx", None)
+                kind = "store" if isinstance(ctx, (ast.Store, ast.Del)) else "load"
+                uses.setdefault(d, []).append(
+                    (sub.lineno, sub.col_offset, kind)
+                )
+
+    stack: List[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, _FUNC_NODES):
+            continue
+        record_uses(sub)
+        stack.extend(ast.iter_child_nodes(sub))
+    facts.name_uses = {k: tuple(sorted(v)) for k, v in uses.items()}
+    return facts
+
+
+def extract_module(
+    tree: ast.AST, source_lines: Sequence[str], path: str
+) -> ModuleFacts:
+    """One parsed file -> its picklable fact record."""
+    thread_locks = collect_thread_lock_names(tree)
+    mod = ModuleFacts(
+        path=path,
+        funcs=[],
+        classes={},
+        imports={},
+        thread_locks=tuple(sorted(thread_locks)),
+        donating_names={},
+        pragmas=parse_pragmas(source_lines),
+    )
+
+    def add_function(node, cls: Optional[str], prefix: str) -> str:
+        qualname = f"{path}::{prefix}{node.name}"
+        while any(f.qualname == qualname for f in mod.funcs):
+            qualname += "'"
+        facts = _extract_function(node, path, cls, qualname, thread_locks)
+        mod.funcs.append(facts)
+        # directly nested defs get their own facts, scoped to the parent
+        nested = []
+        for sub in node.body:
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.append(add_function(sub, cls, f"{prefix}{node.name}."))
+        facts.nested = tuple(nested)
+        return qualname
+
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mod.imports[alias.asname or alias.name.split(".")[-1]] = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                mod.imports[alias.asname or alias.name] = f"{node.module}.{alias.name}"
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            add_function(node, None, "")
+        elif isinstance(node, ast.ClassDef):
+            bases = tuple(d for b in node.bases for d in [dotted(b)] if d)
+            cf = ClassFacts(name=node.name, bases=bases, methods={})
+            mod.classes[node.name] = cf
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = add_function(sub, node.name, f"{node.name}.")
+                    cf.methods[sub.name] = qn
+
+    # donating callables bound to names/attrs anywhere in the module:
+    # ``step = tracked_jit(..., donate_argnums=...)`` (jax.jit(fn, ...) form)
+    # and ``self._fn = tracked_jit(...)(fn)`` (factory form)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        spec = donation_spec(call)
+        if spec is None and isinstance(call.func, ast.Call):
+            spec = donation_spec(call.func)  # tracked_jit(...)(fn)
+        if spec is None:
+            continue
+        for t in node.targets:
+            seg = last_segment(t)
+            if seg:
+                mod.donating_names[seg] = spec
+    return mod
+
+
+# --------------------------------------------------------------- the project
+
+
+class Project:
+    """Whole-tree index + call resolution over extracted module facts."""
+
+    def __init__(self, modules: Sequence[ModuleFacts]):
+        self.modules: Dict[str, ModuleFacts] = {m.path: m for m in modules}
+        self.functions: Dict[str, FunctionFacts] = {}
+        self.by_name: Dict[str, List[str]] = {}
+        self.classes: Dict[str, List[Tuple[str, ClassFacts]]] = {}
+        self.thread_lock_names: Set[str] = set()
+        self.donating_names: Dict[str, DonationSpec] = {}
+        self._module_level: Dict[Tuple[str, str], str] = {}  # (path, fname) -> qn
+        for m in modules:
+            self.thread_lock_names.update(m.thread_locks)
+            self.donating_names.update(m.donating_names)
+            for cf in m.classes.values():
+                self.classes.setdefault(cf.name, []).append((m.path, cf))
+            for f in m.funcs:
+                self.functions[f.qualname] = f
+                self.by_name.setdefault(f.name, []).append(f.qualname)
+                if "." not in f.qualname.split("::", 1)[1]:
+                    self._module_level[(m.path, f.name)] = f.qualname
+
+    # -- method resolution on self (walks base classes found in the tree)
+
+    def _resolve_method(self, cls_name: str, method: str) -> Optional[str]:
+        seen: Set[str] = set()
+        queue = [cls_name]
+        while queue:
+            cname = queue.pop(0)
+            if cname in seen:
+                continue
+            seen.add(cname)
+            for _path, cf in self.classes.get(cname, []):
+                qn = cf.methods.get(method)
+                if qn is not None:
+                    return qn
+                queue.extend(b.split(".")[-1] for b in cf.bases)
+        return None
+
+    def resolve(
+        self, call: CallEvent, caller: FunctionFacts
+    ) -> Tuple[str, List[str]]:
+        """(kind, qualnames) for a call site. kind: 'nested' | 'module' |
+        'method' | 'import' | 'fallback' | 'none'. 'fallback' is the
+        dynamic-dispatch join over every same-named function in the tree."""
+        if call.kind == "self":
+            if caller.cls is not None:
+                qn = self._resolve_method(caller.cls, call.name)
+                if qn is not None:
+                    return "method", [qn]
+            return self._fallback(call.name)
+        if call.kind == "name":
+            # nested def in the caller
+            for qn in caller.nested:
+                f = self.functions.get(qn)
+                if f is not None and f.name == call.name:
+                    return "nested", [qn]
+            qn = self._module_level.get((caller.path, call.name))
+            if qn is not None:
+                return "module", [qn]
+            target = self.modules[caller.path].imports.get(call.name)
+            if target is not None:
+                qn = self._resolve_import(target)
+                if qn is not None:
+                    return "import", [qn]
+            return self._fallback(call.name)
+        if call.kind == "dotted" and call.base is not None:
+            # module-alias call: batching.foo(...)
+            target = self.modules[caller.path].imports.get(call.base.split(".")[0])
+            if target is not None:
+                qn = self._resolve_import(f"{target}.{call.name}")
+                if qn is not None:
+                    return "import", [qn]
+        return self._fallback(call.name)
+
+    def _fallback(self, name: str) -> Tuple[str, List[str]]:
+        qns = self.by_name.get(name, [])
+        return ("fallback", list(qns)) if qns else ("none", [])
+
+    def _resolve_import(self, target: str) -> Optional[str]:
+        """'pkg.mod.func' -> qualname of a module-level func in a module
+        whose path ends with mod.py (best-effort over the scanned tree)."""
+        parts = target.split(".")
+        if len(parts) < 2:
+            return None
+        fname, mod_tail = parts[-1], parts[-2]
+        for (path, func_name), qn in self._module_level.items():
+            if func_name != fname:
+                continue
+            base = path.replace("\\", "/").rsplit("/", 1)[-1]
+            if base == f"{mod_tail}.py":
+                return qn
+        return None
+
+    def callers_of(self, qualname: str) -> List[Tuple[FunctionFacts, CallEvent]]:
+        """Every (caller, call site) in the tree that may target qualname."""
+        target = self.functions.get(qualname)
+        if target is None:
+            return []
+        out = []
+        for f in self.functions.values():
+            for c in f.calls:
+                if c.name != target.name:
+                    continue
+                _kind, qns = self.resolve(c, f)
+                if qualname in qns:
+                    out.append((f, c))
+        return out

@@ -1,16 +1,139 @@
 """swarmlint CLI: ``python -m petals_tpu.analysis petals_tpu/``.
 
-Exit status 0 iff every finding is suppressed (with a reasoned pragma).
+v2 runs the interprocedural engine (call graph + effect summaries) over the
+whole tree by default; ``--no-interp`` falls back to the per-function rules.
+
+Exit status: 0 when every finding is suppressed (reasoned pragma) or already
+in the committed baseline; 1 on new unsuppressed findings; 2 on operational
+failure (unreadable baseline, ``--max-seconds`` exceeded).
+
+Machine-readable output: ``--json`` (one object per finding, with the
+baseline fingerprint) and ``--sarif`` (SARIF 2.1.0 for code-scanning UIs).
+The committed-baseline gate (``--baseline BASELINE_SWARMLINT.json``) fails
+only on findings whose fingerprint count exceeds the baseline's, so CI
+flags *new* debt while the recorded kind is burned down incrementally;
+``--update-baseline`` rewrites the file from the current tree.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
-from typing import List, Optional
+import time
+from typing import Dict, List, Optional
 
-from .engine import check_paths, unsuppressed
-from .rules import RULES
+from .engine import (
+    ALL_RULE_NAMES,
+    check_paths,
+    check_project,
+    fingerprint,
+    unsuppressed,
+)
+from .findings import Finding
+
+BASELINE_VERSION = 1
+
+
+def _load_baseline(path: str) -> Dict[str, int]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline format in {path}")
+    counts = data.get("counts", {})
+    return {str(k): int(v) for k, v in counts.items()}
+
+
+def _write_baseline(path: str, failures: List[Finding]) -> None:
+    counts: Dict[str, int] = {}
+    for f in failures:
+        fp = fingerprint(f)
+        counts[fp] = counts.get(fp, 0) + 1
+    payload = {"version": BASELINE_VERSION, "counts": dict(sorted(counts.items()))}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def _apply_baseline(
+    failures: List[Finding], baseline: Dict[str, int]
+) -> List[Finding]:
+    """Findings that are NEW relative to the baseline: per fingerprint, only
+    occurrences beyond the recorded count fail the build."""
+    remaining = dict(baseline)
+    new: List[Finding] = []
+    for f in failures:
+        fp = fingerprint(f)
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+        else:
+            new.append(f)
+    return new
+
+
+def _findings_json(findings: List[Finding]) -> List[dict]:
+    return [
+        {
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,
+            "message": f.message,
+            "suppressed": f.suppressed,
+            "suppress_reason": f.suppress_reason,
+            "fingerprint": fingerprint(f),
+        }
+        for f in findings
+    ]
+
+
+def _sarif(findings: List[Finding]) -> dict:
+    rules = sorted({f.rule for f in findings} | set(ALL_RULE_NAMES))
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "swarmlint",
+                        "informationUri": "https://github.com/bigscience-workshop/petals",
+                        "rules": [{"id": r} for r in rules],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "note" if f.suppressed else "error",
+                        "message": {"text": f.message},
+                        "suppressions": (
+                            [{"kind": "inSource", "justification": f.suppress_reason}]
+                            if f.suppressed
+                            else []
+                        ),
+                        "partialFingerprints": {"swarmlint/v1": fingerprint(f)},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.path},
+                                    "region": {"startLine": max(f.line, 1)},
+                                }
+                            }
+                        ],
+                    }
+                    for f in findings
+                ],
+            }
+        ],
+    }
+
+
+def _dump(path: str, payload: object) -> None:
+    text = json.dumps(payload, indent=2, sort_keys=False) + "\n"
+    if path == "-":
+        sys.stdout.write(text)
+    else:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(text)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -22,7 +145,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--rule",
         action="append",
-        choices=sorted(RULES),
+        choices=sorted(ALL_RULE_NAMES),
         help="run only these rules (repeatable); default: all",
     )
     parser.add_argument(
@@ -30,20 +153,101 @@ def main(argv: Optional[List[str]] = None) -> int:
         action="store_true",
         help="also print findings silenced by pragmas (with their reasons)",
     )
+    parser.add_argument(
+        "--no-interp",
+        action="store_true",
+        help="per-function v1 rules only (skip call graph + summaries)",
+    )
+    parser.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        metavar="N",
+        help="parallel fact-extraction workers (0 = one per core)",
+    )
+    parser.add_argument(
+        "--json",
+        metavar="PATH",
+        help="write findings as JSON to PATH ('-' for stdout)",
+    )
+    parser.add_argument(
+        "--sarif",
+        metavar="PATH",
+        help="write findings as SARIF 2.1.0 to PATH ('-' for stdout)",
+    )
+    parser.add_argument(
+        "--baseline",
+        metavar="PATH",
+        help="committed baseline: fail only on findings not already recorded",
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite --baseline from the current tree and exit 0",
+    )
+    parser.add_argument(
+        "--max-seconds",
+        type=float,
+        default=None,
+        metavar="S",
+        help="exit 2 if the whole run takes longer than S seconds (CI budget)",
+    )
     args = parser.parse_args(argv)
+    if args.update_baseline and not args.baseline:
+        parser.error("--update-baseline requires --baseline PATH")
 
-    findings = check_paths(args.paths, rules=args.rule)
+    start = time.monotonic()
+    if args.no_interp:
+        findings = check_paths(args.paths, rules=args.rule)
+    else:
+        findings = check_project(
+            args.paths, rules=args.rule, jobs=args.jobs, interp=True
+        )
     failures = unsuppressed(findings)
-    shown = findings if args.show_suppressed else failures
+
+    if args.json:
+        _dump(args.json, _findings_json(findings))
+    if args.sarif:
+        _dump(args.sarif, _sarif(findings))
+
+    if args.baseline and args.update_baseline:
+        _write_baseline(args.baseline, failures)
+        print(
+            f"swarmlint: baseline {args.baseline} updated "
+            f"({len(failures)} finding(s) recorded)",
+            file=sys.stderr,
+        )
+        return 0
+
+    gated = failures
+    if args.baseline:
+        try:
+            baseline = _load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"swarmlint: cannot read baseline: {e}", file=sys.stderr)
+            return 2
+        gated = _apply_baseline(failures, baseline)
+
+    shown = findings if args.show_suppressed else gated
     for f in shown:
         print(f.format())
     n_sup = len(findings) - len(failures)
+    n_baselined = len(failures) - len(gated)
+    extra = f", {n_baselined} baselined" if args.baseline else ""
+    elapsed = time.monotonic() - start
     print(
-        f"swarmlint: {len(failures)} finding(s), {n_sup} suppressed "
-        f"({len(list(RULES))} rules)",
+        f"swarmlint: {len(gated)} finding(s), {n_sup} suppressed{extra} "
+        f"({len(ALL_RULE_NAMES)} rules, {elapsed:.1f}s)",
         file=sys.stderr,
     )
-    return 1 if failures else 0
+    if args.max_seconds is not None and elapsed > args.max_seconds:
+        print(
+            f"swarmlint: run took {elapsed:.1f}s > --max-seconds "
+            f"{args.max_seconds:.0f} budget",
+            file=sys.stderr,
+        )
+        return 2
+    return 1 if gated else 0
 
 
 if __name__ == "__main__":

@@ -2,27 +2,56 @@
 
 Library entry points:
 
-- ``check_source(source, path)`` -> list[Finding] (pragmas applied)
+- ``check_source(source, path)`` -> list[Finding] — v1 per-function rules
+  over one source string (pragmas applied).
 - ``check_file(path)`` / ``check_paths(paths)`` -> same, reading from disk
-- ``unsuppressed(findings)`` -> the findings that should fail a build
+  through the per-file parse cache.
+- ``check_project(paths, jobs=N)`` -> list[Finding] — the v2 engine: per-file
+  rules AND the interprocedural passes (call graph + summaries + fixpoint)
+  over the whole tree at once. Fact extraction parallelizes across worker
+  processes; only picklable fact records cross back, never ASTs. In project
+  mode the per-function versions of ``paired-refcount`` and
+  ``no-await-under-thread-lock`` are replaced by their interprocedural
+  supersets (same lines for the lexical cases, so pragmas keep working),
+  and stale pragmas — suppressions that suppress nothing — become findings.
+- ``check_sources({path: source})`` -> project mode over in-memory sources
+  (fixture corpora in tests).
+- ``unsuppressed(findings)`` -> the findings that should fail a build.
+- ``fingerprint(finding)`` -> stable id for the committed-baseline gate.
 """
 
 from __future__ import annotations
 
 import ast
+import concurrent.futures
+import hashlib
 import os
-from typing import Iterable, Iterator, List, Optional, Sequence
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
-from .findings import Finding, apply_pragmas, parse_pragmas
+from .callgraph import ModuleFacts, Project, extract_module
+from .findings import (
+    Finding,
+    Pragma,
+    apply_pragmas,
+    parse_pragmas,
+    stale_pragma_findings,
+)
+from .interp import INTERP_RULES, NEW_RULE_NAMES, REPLACES_V1, run_interp_rules
 from .rules import RULES
+from .summaries import Summaries
 
 SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build", "dist"}
+
+# every name a pragma may legally disable: v1 rules + the interp-only
+# families. Used everywhere known_rules is needed so a pragma naming e.g.
+# ``use-after-donate`` is not flagged pragma-unknown-rule by a v1-only run.
+ALL_RULE_NAMES: Tuple[str, ...] = tuple(sorted(set(RULES) | set(INTERP_RULES)))
 
 
 def check_source(
     source: str, path: str, rules: Optional[Sequence[str]] = None
 ) -> List[Finding]:
-    """Run the (selected) rules over one source string; apply its pragmas."""
+    """Run the (selected) v1 rules over one source string; apply its pragmas."""
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -41,14 +70,54 @@ def check_source(
         for line, message in RULES[name](tree, lines, path):
             findings.append(Finding(rule=name, path=path, line=line, message=message))
     pragmas = parse_pragmas(lines)
-    findings = apply_pragmas(findings, pragmas, path, known_rules=list(RULES))
+    findings = apply_pragmas(findings, pragmas, path, known_rules=ALL_RULE_NAMES)
     findings.sort(key=lambda f: (f.line, f.rule))
     return findings
 
 
-def check_file(path: str, rules: Optional[Sequence[str]] = None) -> List[Finding]:
+# ------------------------------------------------------------ parse cache
+
+# path -> ((mtime_ns, size), tree, source_lines). Per process; worker
+# processes build their own. Re-stat on every hit so edits invalidate.
+_PARSE_CACHE: Dict[str, Tuple[Tuple[int, int], ast.AST, List[str]]] = {}
+
+
+def _read_parsed(path: str) -> Tuple[ast.AST, List[str]]:
+    st = os.stat(path)
+    key = (st.st_mtime_ns, st.st_size)
+    hit = _PARSE_CACHE.get(path)
+    if hit is not None and hit[0] == key:
+        return hit[1], hit[2]
     with open(path, "r", encoding="utf-8") as f:
-        return check_source(f.read(), path, rules=rules)
+        source = f.read()
+    tree = ast.parse(source, filename=path)
+    lines = source.splitlines()
+    _PARSE_CACHE[path] = (key, tree, lines)
+    return tree, lines
+
+
+def check_file(path: str, rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    try:
+        tree, lines = _read_parsed(path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="syntax-error",
+                path=path,
+                line=e.lineno or 0,
+                message=f"file does not parse: {e.msg}",
+            )
+        ]
+    selected = rules if rules is not None else list(RULES)
+    findings: List[Finding] = []
+    for name in selected:
+        for line, message in RULES[name](tree, lines, path):
+            findings.append(Finding(rule=name, path=path, line=line, message=message))
+    findings = apply_pragmas(
+        findings, parse_pragmas(lines), path, known_rules=ALL_RULE_NAMES
+    )
+    findings.sort(key=lambda f: (f.line, f.rule))
+    return findings
 
 
 def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
@@ -75,3 +144,160 @@ def check_paths(
 
 def unsuppressed(findings: Iterable[Finding]) -> List[Finding]:
     return [f for f in findings if not f.suppressed]
+
+
+# --------------------------------------------------------------- project mode
+
+
+def _analyze_one(
+    path: str,
+    v1_rules: Sequence[str],
+    source: Optional[str] = None,
+) -> Tuple[List[Finding], Optional[ModuleFacts]]:
+    """Per-file half of project mode: v1 findings (un-pragma'd — pragmas are
+    applied centrally after the interp pass) + extracted module facts.
+    Module-level and picklable so it can run in a worker process."""
+    try:
+        if source is None:
+            tree, lines = _read_parsed(path)
+        else:
+            tree = ast.parse(source, filename=path)
+            lines = source.splitlines()
+    except SyntaxError as e:
+        return (
+            [
+                Finding(
+                    rule="syntax-error",
+                    path=path,
+                    line=e.lineno or 0,
+                    message=f"file does not parse: {e.msg}",
+                )
+            ],
+            None,
+        )
+    findings: List[Finding] = []
+    for name in v1_rules:
+        for line, message in RULES[name](tree, lines, path):
+            findings.append(Finding(rule=name, path=path, line=line, message=message))
+    return findings, extract_module(tree, lines, path)
+
+
+def _resolve_jobs(jobs: int, n_files: int) -> int:
+    if jobs == 0:
+        jobs = min(os.cpu_count() or 1, 8)
+    return max(1, min(jobs, n_files))
+
+
+def check_project(
+    paths: Iterable[str],
+    *,
+    sources: Optional[Dict[str, str]] = None,
+    rules: Optional[Sequence[str]] = None,
+    jobs: int = 1,
+    interp: bool = True,
+) -> List[Finding]:
+    """The v2 engine: v1 per-file rules + the interprocedural passes over the
+    whole tree, pragmas applied once at the end. ``sources`` maps path ->
+    source text for in-memory analysis (tests); otherwise ``paths`` is
+    walked. ``jobs`` parallelizes fact extraction (0 = one per core, capped)."""
+    selected = list(rules) if rules is not None else list(ALL_RULE_NAMES)
+    v1_rules = [r for r in selected if r in RULES]
+    if interp:
+        v1_rules = [r for r in v1_rules if r not in REPLACES_V1]
+        interp_rules = [r for r in selected if r in INTERP_RULES]
+    else:
+        interp_rules = []
+    full_run = rules is None and interp
+
+    if sources is not None:
+        files = list(sources)
+        results = [_analyze_one(p, v1_rules, source=sources[p]) for p in files]
+    else:
+        files = list(iter_python_files(paths))
+        results = _map_files(files, v1_rules, _resolve_jobs(jobs, len(files)))
+
+    findings: List[Finding] = []
+    modules: List[ModuleFacts] = []
+    for per_file, mod in results:
+        findings.extend(per_file)
+        if mod is not None:
+            modules.append(mod)
+
+    if interp_rules and modules:
+        project = Project(modules)
+        summaries = Summaries(project)
+        for rule, path, line, message in run_interp_rules(
+            project, summaries, only=interp_rules
+        ):
+            findings.append(Finding(rule=rule, path=path, line=line, message=message))
+
+    # dedup (a lexical case reported by both layers), then pragmas per module
+    seen = set()
+    deduped: List[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message)):
+        key = (f.rule, f.path, f.line)
+        if key in seen:
+            continue
+        seen.add(key)
+        deduped.append(f)
+
+    by_path: Dict[str, List[Finding]] = {}
+    for f in deduped:
+        by_path.setdefault(f.path, []).append(f)
+    out: List[Finding] = []
+    for mod in modules:
+        per_file = apply_pragmas(
+            by_path.pop(mod.path, []),
+            mod.pragmas,
+            mod.path,
+            known_rules=ALL_RULE_NAMES,
+        )
+        if full_run:
+            per_file.extend(
+                stale_pragma_findings(mod.pragmas, mod.path, ALL_RULE_NAMES)
+            )
+        out.extend(per_file)
+    for leftover in by_path.values():  # files that failed to parse
+        out.extend(leftover)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def check_sources(
+    sources: Dict[str, str],
+    rules: Optional[Sequence[str]] = None,
+    interp: bool = True,
+) -> List[Finding]:
+    """Project mode over an in-memory fixture corpus."""
+    return check_project([], sources=sources, rules=rules, interp=interp)
+
+
+def _map_files(
+    files: Sequence[str], v1_rules: Sequence[str], jobs: int
+) -> List[Tuple[List[Finding], Optional[ModuleFacts]]]:
+    if jobs <= 1 or len(files) <= 1:
+        return [_analyze_one(p, v1_rules) for p in files]
+    try:
+        chunk = max(1, len(files) // (jobs * 4))
+        with concurrent.futures.ProcessPoolExecutor(max_workers=jobs) as pool:
+            return list(
+                pool.map(
+                    _analyze_one, files, [v1_rules] * len(files), chunksize=chunk
+                )
+            )
+    except (OSError, PermissionError, concurrent.futures.process.BrokenProcessPool):
+        # restricted environments (no fork / no semaphores): degrade serially
+        return [_analyze_one(p, v1_rules) for p in files]
+
+
+# ------------------------------------------------------------------ baseline
+
+
+def fingerprint(f: Finding) -> str:
+    """Stable id for the committed-baseline gate: rule + path (as given) +
+    message, NOT the line number, so pure line drift does not churn the
+    baseline while any change to what the rule saw does."""
+    digest = hashlib.sha1(
+        f"{f.rule}|{f.path}|{f.message}".encode("utf-8")
+    ).hexdigest()
+    return digest[:16]

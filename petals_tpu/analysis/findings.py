@@ -3,7 +3,7 @@
 A finding is one rule violation at one source line. Findings can be
 suppressed in-source with a pragma comment::
 
-    risky_call()  # swarmlint: disable=no-silent-except — reason why this is OK
+    risky_call()  # swarmlint: disable=<rule-name> — reason why this is OK
 
 Pragma grammar:
 
@@ -49,6 +49,9 @@ def _split_rules_reason(rest: str) -> Tuple[str, str]:
 # pseudo-rules emitted by the pragma machinery itself (never suppressible)
 PRAGMA_NEEDS_REASON = "pragma-needs-reason"
 PRAGMA_UNKNOWN_RULE = "pragma-unknown-rule"
+STALE_PRAGMA = "stale-pragma"
+
+_PRAGMA_META_RULES = (PRAGMA_NEEDS_REASON, PRAGMA_UNKNOWN_RULE, STALE_PRAGMA)
 
 
 @dataclasses.dataclass
@@ -71,6 +74,7 @@ class Pragma:
     target_line: int  # line whose findings it suppresses
     rules: Tuple[str, ...]
     reason: str
+    used: bool = False  # set by apply_pragmas when it suppresses a finding
 
 
 def _is_code_line(text: str) -> bool:
@@ -137,11 +141,40 @@ def apply_pragmas(
                         )
                     )
     for f in out:
-        if f.rule in (PRAGMA_NEEDS_REASON, PRAGMA_UNKNOWN_RULE):
+        if f.rule in _PRAGMA_META_RULES:
             continue
         for p in by_line.get(f.line, ()):  # pragmas targeting this line
             if ("all" in p.rules or f.rule in p.rules) and p.reason:
                 f.suppressed = True
                 f.suppress_reason = p.reason
+                p.used = True
                 break
+    return out
+
+
+def stale_pragma_findings(
+    pragmas: Sequence[Pragma], path: str, known_rules: Sequence[str]
+) -> List[Finding]:
+    """A well-formed pragma that suppressed zero findings is itself a finding
+    (like an unused ``noqa``): fixed code must shed its suppressions. Only
+    meaningful when the FULL rule set just ran over ``path`` and
+    ``apply_pragmas`` marked the used ones — malformed pragmas are excluded
+    because they already surface as pragma-needs-reason / pragma-unknown-rule."""
+    out: List[Finding] = []
+    for p in pragmas:
+        if p.used or not p.reason:
+            continue
+        if any(r != "all" and r not in known_rules for r in p.rules):
+            continue
+        out.append(
+            Finding(
+                rule=STALE_PRAGMA,
+                path=path,
+                line=p.line,
+                message=(
+                    f"pragma disable={','.join(p.rules)} suppresses no "
+                    "findings — the code it covered was fixed, drop the pragma"
+                ),
+            )
+        )
     return out

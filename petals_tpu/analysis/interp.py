@@ -1,0 +1,636 @@
+"""Interprocedural swarmlint rules over callgraph + summaries.
+
+Three upgraded families replace or extend their v1 per-function versions:
+
+- ``no-blocking-under-lock``  (additive) — v1 flags a *direct* blocking call
+  inside an ``async with <lock>`` body; this pass also flags a call that
+  *resolves to* a project function whose summary says it may block, any
+  number of helpers down, with the witness chain in the message.
+- ``no-await-under-thread-lock`` (replaces v1) — the lexical check (await /
+  async-with / async-for inside ``with <thread lock>``) at the SAME lines as
+  v1 so existing pragmas keep working, plus the hidden-acquire case: a
+  helper that ``.acquire()``s a thread lock and returns holding it
+  (net lock summary), after which the caller awaits.
+- ``paired-refcount`` (replaces v1) — "takes" now include calls to helpers
+  with a net incref effect, releases include calls to net-release helpers,
+  and a release is exit-path-protected when it happens in a finally/except
+  *or* via a helper called there. Kills both v1 blind spots: the leak hidden
+  in a helper, and the false positive on ``finally: self._cleanup(page)``.
+
+Three new families ride on the same summaries:
+
+- ``use-after-donate`` — a call whose resolved target donates an argument
+  buffer to XLA (``donate_argnums``/``donate_argnames`` on tracked_jit /
+  jax.jit, including the property-returns-a-donating-``step`` idiom in
+  backend.py) followed by a read of that same name: the buffer is dead. A
+  rebind of the name (including ``k, v = step(params, k, v)``) cleans it.
+  Reads reached only via a loop back-edge are a documented miss.
+- ``cancellation-safety`` — inside an ``async with <lock>`` region, once an
+  invariant goes dirty (typestate flip, page incref, mutation of a critical
+  field — directly or via a resolved helper), every later ``await`` in the
+  region is a cancellation point that can abandon the half-done transition;
+  it must sit under a ``try`` with a ``finally`` or a handler catching
+  BaseException/CancelledError. Helpers themselves are checked too when any
+  call site holds an async lock.
+- ``lane-typestate`` — the declared lane/session lifecycle
+  (``LANE_TYPESTATE``) enforced at every ``suspending``/``swap`` store in
+  ``server/``: the lane lock must be held (lexically, via an earlier
+  trylock in the same function, or because every caller holds it), a swap
+  entry may only be installed while suspending, and a ``suspending = True``
+  followed by awaits needs a cleanup-path reset.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .callgraph import CRITICAL_FIELDS, CallEvent, Event, FunctionFacts, Project
+from .summaries import _RESOLVED_KINDS, Summaries, render_chain
+
+RawFinding = Tuple[str, str, int, str]  # (rule, path, line, message)
+
+# Declared lane/session state machine (ROADMAP PRs 4/9/16/17). The table is
+# the documentation of record (README renders it); the checks below enforce
+# its mechanizable projection onto the two persisted fields:
+#   suspending=True  : active -> suspending        (lane lock held)
+#   swap=<entry>     : suspending -> swapped        (only while suspending)
+#   suspending=False : suspending -> suspended/active (incl. cleanup paths)
+#   swap=None        : swapped -> active/migrated/handed-off (lane lock held)
+LANE_TYPESTATE: Dict[str, Tuple[str, ...]] = {
+    "active": ("suspending",),
+    "suspending": ("suspended", "swapped", "active"),
+    "suspended": ("swapped", "active"),
+    "swapped": ("active", "migrated", "handed-off"),
+    "migrated": (),
+    "handed-off": (),
+}
+
+
+def _is_lane_lock(name: str) -> bool:
+    n = name.lower()
+    return "lane" in n and "lock" in n
+
+
+def _ordered(f: FunctionFacts) -> List[Tuple[str, object]]:
+    """Events and call sites of one function merged into source order."""
+    items: List[Tuple[int, int, str, object]] = []
+    for e in f.events:
+        items.append((e.line, e.col, "event", e))
+    for c in f.calls:
+        items.append((c.line, c.col, "call", c))
+    items.sort(key=lambda t: (t[0], t[1]))
+    return [(kind, obj) for _l, _c, kind, obj in items]
+
+
+def _try_protected(trys) -> bool:
+    return any(has_finally or catches for _line, has_finally, catches in trys)
+
+
+# ----------------------------------------------------- no-blocking-under-lock
+
+
+def interp_no_blocking_under_lock(
+    project: Project, summaries: Summaries
+) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    for f in project.functions.values():
+        for call in f.calls:
+            if not any(is_async for _n, is_async, _l in call.locks):
+                continue
+            kind, targets = summaries.resolve(call, f)
+            if kind not in _RESOLVED_KINDS:
+                continue
+            for qn in targets:
+                s = summaries.by_qualname.get(qn)
+                if s is None or s.may_block is None:
+                    continue
+                # direct blocking calls are v1's finding; only report the
+                # hidden-in-a-helper chain here
+                if len(s.may_block) == 0:
+                    continue
+                out.append(
+                    (
+                        "no-blocking-under-lock",
+                        f.path,
+                        call.line,
+                        f"{call.name}() called under an async lock can block "
+                        f"the event loop: {render_chain(s.may_block)}",
+                    )
+                )
+                break
+    return out
+
+
+# -------------------------------------------------- no-await-under-thread-lock
+
+
+def interp_no_await_under_thread_lock(
+    project: Project, summaries: Summaries
+) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    for f in project.functions.values():
+        mod_locks = set(project.modules[f.path].thread_locks)
+        # lexical case — identical lines to the v1 rule
+        for e in f.events:
+            if e.kind != "await":
+                continue
+            held = [n for n, is_async, _l in e.locks if not is_async and n in mod_locks]
+            if held:
+                out.append(
+                    (
+                        "no-await-under-thread-lock",
+                        f.path,
+                        e.line,
+                        f"await while holding thread lock {held[0]!r} "
+                        "(event-loop stall; release the lock first)",
+                    )
+                )
+        # hidden-acquire case: a thread lock left held by an earlier
+        # .acquire() or a helper with a net-acquire summary
+        held_manual: Dict[str, str] = {}  # lock -> how it was taken
+        for kind, obj in _ordered(f):
+            if kind == "event":
+                e = obj
+                if e.kind == "lock_acq" and e.detail in project.thread_lock_names:
+                    held_manual.setdefault(e.detail, f"{e.detail}.acquire()")
+                elif e.kind == "lock_rel":
+                    held_manual.pop(e.detail, None)
+                elif e.kind == "await" and held_manual:
+                    lock, how = next(iter(held_manual.items()))
+                    lexical = {n for n, _a, _l in e.locks}
+                    if lock in lexical:
+                        continue  # already reported by the lexical case
+                    out.append(
+                        (
+                            "no-await-under-thread-lock",
+                            f.path,
+                            e.line,
+                            f"await while thread lock {lock!r} is still held "
+                            f"(taken via {how}; release it before suspending)",
+                        )
+                    )
+            else:
+                call = obj
+                rkind, targets = summaries.resolve(call, f)
+                if rkind not in _RESOLVED_KINDS:
+                    continue
+                for qn in targets:
+                    s = summaries.by_qualname.get(qn)
+                    if s is None:
+                        continue
+                    for lock, chain in s.net_lock_acq.items():
+                        held_manual.setdefault(
+                            lock, f"{call.name}() -> {render_chain(chain)}"
+                        )
+                    for lock in s.net_lock_rel:
+                        held_manual.pop(lock, None)
+    return out
+
+
+# ------------------------------------------------------------ paired-refcount
+
+
+def interp_paired_refcount(
+    project: Project, summaries: Summaries
+) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    # a release protects the exit paths of an AWAITING function only when
+    # its cleanup region still runs on cancellation: finally, or a handler
+    # catching BaseException/CancelledError. ``except Exception`` does not —
+    # a task cancelled at an await skips it and the reference leaks.
+    _PROTECTING = ("finally", "except_cancel")
+    for f in project.functions.values():
+        takes: List[Tuple[int, str, str]] = []  # (line, name, via)
+        rel_anywhere = False
+        rel_protected = False
+        rel_cleanup_kinds: Set[str] = set()
+        has_await = any(e.kind == "await" for e in f.events)
+        for e in f.events:
+            if e.kind == "ref_inc":
+                takes.append((e.line, e.detail, "direct"))
+            elif e.kind == "ref_rel":
+                rel_anywhere = True
+                if e.cleanup:
+                    rel_cleanup_kinds.add(e.cleanup_kind)
+                    if e.cleanup_kind in _PROTECTING:
+                        rel_protected = True
+        for call in f.calls:
+            kind, targets = summaries.resolve(call, f)
+            if kind not in _RESOLVED_KINDS:
+                continue
+            for qn in targets:
+                s = summaries.by_qualname.get(qn)
+                if s is None:
+                    continue
+                if s.net_ref_inc is not None:
+                    takes.append(
+                        (call.line, call.name, render_chain(s.net_ref_inc))
+                    )
+                if s.net_ref_rel is not None:
+                    rel_anywhere = True
+                    if call.cleanup:
+                        rel_cleanup_kinds.add(call.cleanup_kind)
+                        if call.cleanup_kind in _PROTECTING:
+                            rel_protected = True
+                break
+        if not takes:
+            continue
+        takes.sort()
+        line, name, via = takes[0]
+        hidden = "" if via == "direct" else f" (takes a reference via {via})"
+        if not rel_anywhere:
+            out.append(
+                (
+                    "paired-refcount",
+                    f.path,
+                    line,
+                    f"{name}() in {f.name}() has no matching decref/release in "
+                    f"this function{hidden} (annotate ownership transfer with "
+                    "a pragma if intentional)",
+                )
+            )
+        elif has_await and not rel_protected:
+            detail = (
+                "the only cleanup-path release is under `except Exception`, "
+                "which a task cancelled at an await skips — use finally or "
+                "catch BaseException"
+                if "except" in rel_cleanup_kinds
+                else "no decref/release reachable from a finally/except, but "
+                "the function can suspend or raise at an await"
+            )
+            out.append(
+                (
+                    "paired-refcount",
+                    f.path,
+                    line,
+                    f"{name}() in {f.name}() is not released on all exit "
+                    f"paths{hidden} ({detail})",
+                )
+            )
+    return out
+
+
+# ------------------------------------------------------------ use-after-donate
+
+
+def interp_use_after_donate(
+    project: Project, summaries: Summaries
+) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    for f in project.functions.values():
+        for call in f.calls:
+            donated = summaries.donated_positions(call, f)
+            if not donated:
+                continue
+            for pos, argname, chain in donated:
+                names: List[str] = []
+                for i, d in call.args:
+                    if i == pos and d is not None:
+                        names.append(d)
+                if argname is not None:
+                    for kw, d in call.kwargs:
+                        if kw == argname and d is not None:
+                            names.append(d)
+                for d in names:
+                    if d in call.assigns:
+                        continue  # k, v = step(params, k, v): rebound, clean
+                    verdict = _first_read_after(f, d, call)
+                    if verdict is not None:
+                        out.append(
+                            (
+                                "use-after-donate",
+                                f.path,
+                                verdict,
+                                f"{d!r} is read after being donated to "
+                                f"{call.name}() at line {call.line} "
+                                f"({render_chain(chain)}); the donated buffer "
+                                "is invalidated by XLA — reload it from the "
+                                "call's result instead",
+                            )
+                        )
+    return out
+
+
+def _first_read_after(
+    f: FunctionFacts, name: str, call: CallEvent
+) -> Optional[int]:
+    """Line of the first load of ``name`` strictly after ``call`` ends, or
+    None if the name is rebound first (or never read again). Prefix reads of
+    a dotted name (``x`` stored cleans ``x.attr``) are handled by also
+    honoring stores to any dotted prefix."""
+    prefixes = {name}
+    parts = name.split(".")
+    for i in range(1, len(parts)):
+        prefixes.add(".".join(parts[:i]))
+    after: List[Tuple[int, int, str, str]] = []
+    for used, uses in f.name_uses.items():
+        if used != name and used not in prefixes:
+            continue
+        for line, col, kind in uses:
+            if (line, col) > (call.end_line, call.end_col):
+                after.append((line, col, kind, used))
+    after.sort()
+    for line, _col, kind, used in after:
+        if kind == "store":
+            return None  # rebound before any read
+        if used == name:
+            return line
+    return None
+
+
+# -------------------------------------------------------- cancellation-safety
+
+
+def interp_cancellation_safety(
+    project: Project, summaries: Summaries
+) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    locked_helpers: Set[str] = set()
+    for f in project.functions.values():
+        out.extend(_scan_regions(f, summaries, locked_helpers))
+    # helpers invoked while an async lock is held: their whole body runs
+    # inside the caller's critical region, so check them the same way
+    for qn in sorted(locked_helpers):
+        t = project.functions.get(qn)
+        if t is None:
+            continue
+        out.extend(_scan_whole_body(t, summaries))
+    return out
+
+
+def _dirties(
+    item_kind: str, obj, summaries: Summaries, f: FunctionFacts
+) -> Optional[str]:
+    """Why this event/call leaves the enclosing critical region half-done
+    (or None). Only effects the CALLER owns unwinding count as dirt: its own
+    typestate/refcount/critical-field writes, a helper that hands back a
+    reference (net incref), and a helper that returns with the transient
+    ``suspending`` flag still set. A resolved call that completes its own
+    transition internally (swap-out restores the flag on every path) is the
+    callee's business — its awaits are checked by the helper-body scan."""
+    if item_kind == "event":
+        e = obj
+        if e.kind == "ref_inc":
+            return f"{e.detail}() at line {e.line}"
+        if e.kind == "ts" and not e.detail.endswith(("=false", "=none")):
+            return f"{e.detail} at line {e.line}"
+        if e.kind == "mutate" and e.detail in CRITICAL_FIELDS:
+            return f"{e.detail} mutated at line {e.line}"
+        return None
+    call = obj
+    kind, targets = summaries.resolve(call, f)
+    if kind not in _RESOLVED_KINDS:
+        return None
+    for qn in targets:
+        s = summaries.by_qualname.get(qn)
+        if s is None:
+            continue
+        if s.net_ref_inc is not None:
+            return f"{call.name}() at line {call.line} -> {render_chain(s.net_ref_inc)}"
+        if s.leaves_dirty is not None:
+            return f"{call.name}() at line {call.line} -> {render_chain(s.leaves_dirty)}"
+    return None
+
+
+def _scan_regions(
+    f: FunctionFacts, summaries: Summaries, locked_helpers: Set[str]
+) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    dirty: Dict[Tuple[str, int], str] = {}  # region -> why
+    reported: Set[Tuple[str, int]] = set()
+    for item_kind, obj in _ordered(f):
+        locks = obj.locks
+        async_regions = [
+            (n, line) for n, is_async, line in locks if is_async and n
+        ]
+        if item_kind == "call" and async_regions:
+            kind, targets = summaries.resolve(obj, f)
+            if kind in _RESOLVED_KINDS:
+                locked_helpers.update(targets)
+        # judge the await against dirt accumulated BEFORE this item: an
+        # awaited call that itself dirties only goes dirty once the await
+        # completes, so it cannot be its own violation
+        is_await = (item_kind == "event" and obj.kind == "await") or (
+            item_kind == "call" and obj.awaited
+        )
+        if is_await:
+            for region in async_regions:
+                if region not in dirty or region in reported:
+                    continue
+                if _try_protected(obj.trys):
+                    continue
+                reported.add(region)
+                out.append(
+                    (
+                        "cancellation-safety",
+                        f.path,
+                        obj.line,
+                        f"await inside `async with {region[0]}` (line "
+                        f"{region[1]}) after the region went dirty "
+                        f"({dirty[region]}): cancellation here abandons the "
+                        "half-done transition — wrap in try/finally that "
+                        "restores the invariant",
+                    )
+                )
+        if async_regions:
+            why = _dirties(item_kind, obj, summaries, f)
+            if why is not None:
+                for region in async_regions:
+                    dirty.setdefault(region, why)
+            elif item_kind == "event" and obj.kind == "ts" and obj.detail.endswith(
+                ("=false", "=none")
+            ):
+                # an explicit restore completes the transition: later awaits
+                # in the region are clean again (unless re-dirtied)
+                for region in async_regions:
+                    dirty.pop(region, None)
+    return out
+
+
+def _scan_whole_body(f: FunctionFacts, summaries: Summaries) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    dirty_why: Optional[str] = None
+    for item_kind, obj in _ordered(f):
+        is_await = (item_kind == "event" and obj.kind == "await") or (
+            item_kind == "call" and obj.awaited
+        )
+        if not (is_await and dirty_why is not None):
+            why = _dirties(item_kind, obj, summaries, f)
+            if why is not None and dirty_why is None:
+                dirty_why = why
+            elif item_kind == "event" and obj.kind == "ts" and obj.detail.endswith(
+                ("=false", "=none")
+            ):
+                dirty_why = None
+            continue
+        if _try_protected(obj.trys):
+            continue
+        out.append(
+            (
+                "cancellation-safety",
+                f.path,
+                obj.line,
+                f"await in {f.name}() after dirtying state ({dirty_why}); "
+                "this helper runs inside a caller's async lock region, so "
+                "cancellation here abandons the half-done transition — wrap "
+                "in try/finally that restores the invariant",
+            )
+        )
+        break  # one finding per helper is enough signal
+    return out
+
+
+# --------------------------------------------------------------- lane-typestate
+
+
+def _lane_locked_only(project: Project) -> Set[str]:
+    """Greatest fixpoint: functions whose EVERY known call site holds the
+    lane lock (lexically or via an earlier trylock in the caller), possibly
+    because the caller is itself lane-locked-only. No call sites -> False."""
+    callers: Dict[str, List[Tuple[FunctionFacts, CallEvent]]] = {}
+    for f in project.functions.values():
+        for c in f.calls:
+            kind, targets = project.resolve(c, f)
+            if kind not in _RESOLVED_KINDS:
+                continue
+            for qn in targets:
+                callers.setdefault(qn, []).append((f, c))
+    locked = {qn for qn, sites in callers.items() if sites}
+    changed = True
+    while changed:
+        changed = False
+        for qn in list(locked):
+            for caller, call in callers.get(qn, []):
+                if _site_holds_lane_lock(caller, call):
+                    continue
+                if caller.qualname in locked and caller.qualname != qn:
+                    continue
+                locked.discard(qn)
+                changed = True
+                break
+    return locked
+
+
+def _site_holds_lane_lock(caller: FunctionFacts, call: CallEvent) -> bool:
+    if any(_is_lane_lock(n) for n, _a, _l in call.locks):
+        return True
+    return _earlier_lane_trylock(caller, call.line)
+
+
+def _earlier_lane_trylock(f: FunctionFacts, line: int) -> bool:
+    return any(
+        e.kind == "trylock" and e.line <= line for e in f.events
+    )
+
+
+def interp_lane_typestate(
+    project: Project, summaries: Summaries
+) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    locked_only = _lane_locked_only(project)
+    for f in project.functions.values():
+        norm = f.path.replace("\\", "/")
+        if "/server/" not in f"/{norm}":
+            continue
+        ts_events = [e for e in f.events if e.kind == "ts"]
+        if not ts_events:
+            continue
+        has_await_after = lambda line: any(  # noqa: E731
+            e.kind == "await" and e.line > line for e in f.events
+        ) or any(c.awaited and c.line > line for c in f.calls)
+        for e in ts_events:
+            field, _, value = e.detail.partition("=")
+            # T1: lane lock must be held at every typestate mutation
+            if not (
+                any(_is_lane_lock(n) for n, _a, _l in e.locks)
+                or _earlier_lane_trylock(f, e.line)
+                or f.qualname in locked_only
+            ):
+                legal = ", ".join(
+                    f"{s} -> {t}" for s, ts in LANE_TYPESTATE.items() for t in ts
+                )
+                out.append(
+                    (
+                        "lane-typestate",
+                        f.path,
+                        e.line,
+                        f"lane typestate field {field!r} mutated in {f.name}() "
+                        "without the lane lock held (not lexically, by an "
+                        "earlier trylock, or by every caller) — transitions "
+                        f"[{legal}] are only atomic under the lane lock",
+                    )
+                )
+            # T2: a swap entry may only be installed while suspending
+            if field == "swap" and value not in ("none",):
+                if not any(
+                    t.kind == "ts"
+                    and t.detail == "suspending=true"
+                    and t.line <= e.line
+                    for t in f.events
+                ):
+                    out.append(
+                        (
+                            "lane-typestate",
+                            f.path,
+                            e.line,
+                            f"swap entry installed in {f.name}() without a "
+                            "prior `suspending = True` in the same function: "
+                            "illegal transition (declared machine: active -> "
+                            "suspending -> swapped)",
+                        )
+                    )
+            # T3: suspending=True followed by suspension points needs a
+            # cleanup-path reset or the lane wedges in 'suspending' forever
+            if e.detail == "suspending=true" and has_await_after(e.line):
+                if not any(
+                    t.kind == "ts"
+                    and t.detail.startswith("suspending=")
+                    and t.detail != "suspending=true"
+                    and t.cleanup
+                    for t in f.events
+                ):
+                    out.append(
+                        (
+                            "lane-typestate",
+                            f.path,
+                            e.line,
+                            f"`suspending = True` in {f.name}() with awaits "
+                            "after it but no cleanup-path reset "
+                            "(finally/except must restore `suspending` or "
+                            "the lane wedges mid-transition on error)",
+                        )
+                    )
+    return out
+
+
+# ---------------------------------------------------------------- the registry
+
+INTERP_RULES = {
+    "no-blocking-under-lock": interp_no_blocking_under_lock,
+    "no-await-under-thread-lock": interp_no_await_under_thread_lock,
+    "paired-refcount": interp_paired_refcount,
+    "use-after-donate": interp_use_after_donate,
+    "cancellation-safety": interp_cancellation_safety,
+    "lane-typestate": interp_lane_typestate,
+}
+
+# v1 rules superseded by the interprocedural versions in project mode (the
+# interp versions report the lexical cases at the same lines, so in-source
+# pragmas keep working; running both would double-report)
+REPLACES_V1 = {"no-await-under-thread-lock", "paired-refcount"}
+
+# new rule families (for pragma known-rule validation and --rule choices)
+NEW_RULE_NAMES = ("use-after-donate", "cancellation-safety", "lane-typestate")
+
+
+def run_interp_rules(
+    project: Project,
+    summaries: Summaries,
+    only: Optional[Iterable[str]] = None,
+) -> List[RawFinding]:
+    names = set(only) if only is not None else set(INTERP_RULES)
+    out: List[RawFinding] = []
+    for name, fn in INTERP_RULES.items():
+        if name in names:
+            out.extend(fn(project, summaries))
+    return out

@@ -1,0 +1,350 @@
+"""Per-function effect summaries + the interprocedural fixpoint.
+
+Each function gets a :class:`Summary` of caller-visible effects, seeded from
+its direct facts (callgraph.FunctionFacts) and propagated over the call
+graph with a monotone worklist until stable — cycles in the graph simply
+converge, no SCC machinery needed because every fact only ever *grows*:
+
+- ``may_block``       — can a call into this function stall the event loop
+                        (time.sleep / .result() / device sync), directly or
+                        any number of helper calls down. Propagates through
+                        resolved edges, and through a dynamic-dispatch
+                        fallback edge only when the join is UNANIMOUS (every
+                        same-named function in the tree blocks): a dict's
+                        ``.get`` must not inherit a blocking ``get`` defined
+                        somewhere else, but if every candidate blocks the
+                        dispatch cannot save the caller.
+- ``mutates_critical``— touches a lane/session invariant field
+                        (callgraph.CRITICAL_FIELDS). Resolved edges only
+                        (self-method / local / import): the fallback join
+                        over common method names would drown the signal.
+- ``has_ref_inc`` / ``has_ref_rel`` — page/swap refcount effects; the *net*
+                        flavors (inc without rel, rel without inc) are
+                        derived AFTER the fixpoint so a balanced helper
+                        (takes and releases internally) stays neutral. Both
+                        has-sets are monotone; net is not, which is exactly
+                        why it is derived, not iterated.
+- ``lock_acq`` / ``lock_rel`` — thread-lock names this function can leave
+                        acquired/released across its return (manual
+                        ``.acquire()`` without ``.release()`` and vice
+                        versa, transitively). Net derived post-fixpoint.
+- ``donates``         — caller arg positions this function hands to XLA
+                        donation (its own jit decorator, a donating callable
+                        it forwards a parameter into, or a property
+                        returning a donating nested def). Flows UP the
+                        graph: a wrapper around a donating step donates.
+- ``leaves_dirty``    — returns with the transient ``suspending`` lifecycle
+                        flag still set (its last write in source order sets
+                        it rather than restoring it): the CALLER owns
+                        completing or unwinding the transition, so a later
+                        await in the caller is a cancellation hazard. A
+                        helper that restores the flag before returning (like
+                        a full swap-out) is clean.
+
+Witness chains (``Chain``: tuples of "site" strings) ride along with each
+propagated fact so findings can say *how* the effect reaches the flagged
+line: ``f() blocks via _helper (batching.py:88) -> time.sleep``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import (
+    CRITICAL_FIELDS,
+    CallEvent,
+    DonationSpec,
+    FunctionFacts,
+    Project,
+)
+
+Chain = Tuple[str, ...]
+
+_RESOLVED_KINDS = ("nested", "module", "method", "import")
+_MAX_CHAIN = 6
+
+
+def _site(f: FunctionFacts, line: int) -> str:
+    return f"{f.path}:{line}"
+
+
+@dataclasses.dataclass
+class Summary:
+    qualname: str
+    may_block: Optional[Chain] = None
+    mutates_critical: Optional[Chain] = None
+    has_ref_inc: Optional[Chain] = None
+    has_ref_rel: Optional[Chain] = None
+    lock_acq: Dict[str, Chain] = dataclasses.field(default_factory=dict)
+    lock_rel: Set[str] = dataclasses.field(default_factory=set)
+    donates: Dict[int, Chain] = dataclasses.field(default_factory=dict)
+    leaves_dirty: Optional[Chain] = None
+
+    # derived after the fixpoint (non-monotone, so never iterated on)
+    @property
+    def net_ref_inc(self) -> Optional[Chain]:
+        return self.has_ref_inc if self.has_ref_rel is None else None
+
+    @property
+    def net_ref_rel(self) -> Optional[Chain]:
+        return self.has_ref_rel if self.has_ref_inc is None else None
+
+    @property
+    def net_lock_acq(self) -> Dict[str, Chain]:
+        return {k: v for k, v in self.lock_acq.items() if k not in self.lock_rel}
+
+    @property
+    def net_lock_rel(self) -> Set[str]:
+        return self.lock_rel - set(self.lock_acq)
+
+
+class Summaries:
+    def __init__(self, project: Project):
+        self.project = project
+        self.by_qualname: Dict[str, Summary] = {
+            qn: Summary(qualname=qn) for qn in project.functions
+        }
+        self._resolution: Dict[Tuple[str, int, int], Tuple[str, List[str]]] = {}
+        self._seed()
+        self._fixpoint()
+
+    def __getitem__(self, qualname: str) -> Summary:
+        return self.by_qualname[qualname]
+
+    def resolve(self, call: CallEvent, caller: FunctionFacts):
+        """Memoized project.resolve — the fixpoint hits each site many times."""
+        key = (caller.qualname, call.line, call.col)
+        hit = self._resolution.get(key)
+        if hit is None:
+            hit = self._resolution[key] = self.project.resolve(call, caller)
+        return hit
+
+    # ------------------------------------------------------------------ seed
+
+    def _seed(self) -> None:
+        for f in self.project.functions.values():
+            s = self.by_qualname[f.qualname]
+            for e in f.events:
+                if e.kind == "block" and s.may_block is None:
+                    s.may_block = (f"{e.detail} at {_site(f, e.line)}",)
+                elif e.kind == "ts" or (
+                    e.kind == "mutate" and e.detail in CRITICAL_FIELDS
+                ):
+                    if s.mutates_critical is None:
+                        s.mutates_critical = (
+                            f"{e.detail} mutated at {_site(f, e.line)}",
+                        )
+                elif e.kind == "ref_inc" and s.has_ref_inc is None:
+                    s.has_ref_inc = (f"{e.detail}() at {_site(f, e.line)}",)
+                elif e.kind == "ref_rel" and s.has_ref_rel is None:
+                    s.has_ref_rel = (f"{e.detail}() at {_site(f, e.line)}",)
+                elif e.kind == "lock_acq":
+                    if e.detail in self.project.thread_lock_names:
+                        s.lock_acq.setdefault(
+                            e.detail, (f"{e.detail}.acquire() at {_site(f, e.line)}",)
+                        )
+                elif e.kind == "lock_rel":
+                    if e.detail in self.project.thread_lock_names:
+                        s.lock_rel.add(e.detail)
+            ts_writes = sorted(
+                (e.line, e.col, e.detail)
+                for e in f.events
+                if e.kind == "ts" and e.detail.startswith("suspending=")
+            )
+            if ts_writes and ts_writes[-1][2] in (
+                "suspending=true",
+                "suspending=value",
+            ):
+                line = ts_writes[-1][0]
+                s.leaves_dirty = (
+                    f"returns with suspending set ({_site(f, line)})",
+                )
+            if f.donation is not None:
+                self._seed_own_donation(f)
+
+    def _seed_own_donation(self, f: FunctionFacts) -> None:
+        s = self.by_qualname[f.qualname]
+        spec = f.donation
+        params = list(f.params)
+        offset = 1 if params[:1] == ["self"] else 0
+        for num in spec.argnums:
+            idx = num - offset
+            if 0 <= idx:
+                s.donates.setdefault(
+                    idx, (f"donate_argnums on {f.name} ({_site(f, f.lineno)})",)
+                )
+        for name in spec.argnames:
+            if name in params:
+                idx = params.index(name) - offset
+                if idx >= 0:
+                    s.donates.setdefault(
+                        idx, (f"donate_argnames on {f.name} ({_site(f, f.lineno)})",)
+                    )
+
+    # ------------------------------------------------------------- fixpoint
+
+    def _fixpoint(self) -> None:
+        funcs = list(self.project.functions.values())
+        changed = True
+        while changed:
+            changed = False
+            for f in funcs:
+                if self._propagate(f):
+                    changed = True
+
+    def _chain_via(
+        self, caller: FunctionFacts, call: CallEvent, tail: Chain
+    ) -> Chain:
+        head = f"{call.name}() at {_site(caller, call.line)}"
+        return ((head,) + tail)[:_MAX_CHAIN]
+
+    def _propagate(self, f: FunctionFacts) -> bool:
+        s = self.by_qualname[f.qualname]
+        changed = False
+        restores_flag = any(
+            e.kind == "ts"
+            and e.detail in ("suspending=false", "suspending=none")
+            for e in f.events
+        )
+        for call in f.calls:
+            kind, targets = self.resolve(call, f)
+            if kind == "none":
+                continue
+            resolved = kind in _RESOLVED_KINDS
+            if (
+                not resolved
+                and s.may_block is None
+                and targets
+                and call.kind in ("self", "name")
+            ):
+                # fallback edge: only for a receiver that genuinely *could*
+                # be a project function (an untypeable self-method or bare
+                # name — not ``writer.drain()`` matching a project ``drain``
+                # by accident), and only on a unanimous join: every
+                # same-named function must block before the dispatch does
+                blockers = [
+                    self.by_qualname[qn].may_block
+                    for qn in targets
+                    if qn != f.qualname and qn in self.by_qualname
+                ]
+                if blockers and all(b is not None for b in blockers):
+                    s.may_block = self._chain_via(f, call, blockers[0])
+                    changed = True
+            for qn in targets:
+                t = self.by_qualname.get(qn)
+                if t is None or qn == f.qualname:
+                    continue
+                if not resolved:
+                    continue
+                if s.may_block is None and t.may_block is not None:
+                    s.may_block = self._chain_via(f, call, t.may_block)
+                    changed = True
+                if (
+                    s.leaves_dirty is None
+                    and t.leaves_dirty is not None
+                    and not restores_flag
+                ):
+                    s.leaves_dirty = self._chain_via(f, call, t.leaves_dirty)
+                    changed = True
+                if s.mutates_critical is None and t.mutates_critical is not None:
+                    s.mutates_critical = self._chain_via(f, call, t.mutates_critical)
+                    changed = True
+                if s.has_ref_inc is None and t.has_ref_inc is not None:
+                    s.has_ref_inc = self._chain_via(f, call, t.has_ref_inc)
+                    changed = True
+                if s.has_ref_rel is None and t.has_ref_rel is not None:
+                    s.has_ref_rel = self._chain_via(f, call, t.has_ref_rel)
+                    changed = True
+                for lock, chain in t.lock_acq.items():
+                    if lock not in s.lock_acq:
+                        s.lock_acq[lock] = self._chain_via(f, call, chain)
+                        changed = True
+                for lock in t.lock_rel:
+                    if lock not in s.lock_rel:
+                        s.lock_rel.add(lock)
+                        changed = True
+            # donation flows up: passing own param into a donated position
+            donated = self.donated_positions(call, f)
+            if donated:
+                params = list(f.params)
+                for pos, _argname, chain in donated:
+                    for i, d in call.args:
+                        if i != pos or d is None:
+                            continue
+                        if d in params:
+                            pidx = params.index(d)
+                            if d == "self":
+                                continue
+                            offset = 1 if params[:1] == ["self"] else 0
+                            key = pidx - offset
+                            if key >= 0 and key not in s.donates:
+                                s.donates[key] = self._chain_via(f, call, chain)
+                                changed = True
+        return changed
+
+    # --------------------------------------------------- donation resolution
+
+    def donated_positions(
+        self, call: CallEvent, caller: FunctionFacts
+    ) -> List[Tuple[int, Optional[str], Chain]]:
+        """Caller-side positional indices whose argument is donated by this
+        call: (position, argname-if-known, witness chain). Sources, in
+        order: the resolved target's own jit decorator / wrapper summary, a
+        property returning a donating nested def, and the module registry of
+        names bound to donating jit callables."""
+        out: List[Tuple[int, Optional[str], Chain]] = []
+        kind, targets = self.resolve(call, caller)
+        if kind in _RESOLVED_KINDS:
+            for qn in targets:
+                t_facts = self.project.functions.get(qn)
+                t_sum = self.by_qualname.get(qn)
+                if t_facts is None or t_sum is None:
+                    continue
+                for idx, chain in t_sum.donates.items():
+                    out.append((idx, None, chain))
+                if t_facts.is_property and t_facts.returns_nested:
+                    for nested_qn in t_facts.nested:
+                        nf = self.project.functions.get(nested_qn)
+                        if (
+                            nf is not None
+                            and nf.name in t_facts.returns_nested
+                            and nf.donation is not None
+                        ):
+                            out.extend(self._spec_positions(nf, nf.donation))
+        if not out:
+            spec = self.project.donating_names.get(call.name)
+            if spec is not None:
+                chain = (f"{call.name} bound to a donating jit callable",)
+                for num in spec.argnums:
+                    out.append((num, None, chain))
+        # dedup by position
+        seen: Set[int] = set()
+        uniq = []
+        for pos, name, chain in out:
+            if pos not in seen:
+                seen.add(pos)
+                uniq.append((pos, name, chain))
+        return uniq
+
+    def _spec_positions(
+        self, fn: FunctionFacts, spec: DonationSpec
+    ) -> List[Tuple[int, Optional[str], Chain]]:
+        params = list(fn.params)
+        offset = 1 if params[:1] == ["self"] else 0
+        chain = (f"donating jit def {fn.name} ({_site(fn, fn.lineno)})",)
+        out = []
+        for num in spec.argnums:
+            idx = num - offset
+            if idx >= 0:
+                out.append((idx, None, chain))
+        for name in spec.argnames:
+            if name in params:
+                idx = params.index(name) - offset
+                if idx >= 0:
+                    out.append((idx, name, chain))
+        return out
+
+
+def render_chain(chain: Optional[Chain]) -> str:
+    return " -> ".join(chain) if chain else ""

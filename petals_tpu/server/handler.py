@@ -1011,9 +1011,16 @@ class TransformerHandler:
                             )
                             return
                         await asyncio.sleep(0.05)
-        except Exception as e:
+        except BaseException as e:
+            # release the pins on EVERY abnormal exit, cancellation included:
+            # this coroutine awaits between the pin and the cache commit, and
+            # an `except Exception` here would skip the unpin when the
+            # session task is cancelled mid-snapshot — the pinned pages'
+            # refcounts would leak until pool reset
             if lane_pages:
                 batcher.unpin_pages(lane_pages, lane_pages_epoch)
+            if not isinstance(e, Exception):
+                raise
             # storing is best-effort; the session must never notice
             logger.debug("Prefix store skipped: %r", e)
             return

@@ -460,7 +460,8 @@ class RadixPrefixCache:
         if nbytes > cap:
             return False
         while True:
-            # swarmlint: disable=paired-refcount — ownership transfer: the reservation belongs to the demoted node; _promote_host / _evict_node free(kind="cache") it
+            # ownership transfer: the reservation belongs to the demoted
+            # node; _promote_host / _evict_node free(kind="cache") it
             if self._swap_bytes + nbytes <= cap and self.swap_pool.try_reserve(
                 nbytes, kind="cache"
             ):

@@ -1,0 +1,200 @@
+"""Unit tests for the swarmlint call graph + summary fixpoint: resolution
+kinds (nested / module / method / import / fallback), method lookup through
+base classes, cycle convergence, and the dynamic-dispatch fallback join.
+"""
+
+import ast
+
+from petals_tpu.analysis.callgraph import Project, extract_module
+from petals_tpu.analysis.summaries import Summaries, render_chain
+
+
+def build(sources):
+    modules = []
+    for path, src in sources.items():
+        tree = ast.parse(src, filename=path)
+        modules.append(extract_module(tree, src.splitlines(), path))
+    project = Project(modules)
+    return project, Summaries(project)
+
+
+def call_named(f, name):
+    return next(c for c in f.calls if c.name == name)
+
+
+def test_resolution_kinds():
+    src = (
+        "from server.util import helper\n"
+        "import server.other as other\n"
+        "def top():\n"
+        "    def inner():\n"
+        "        pass\n"
+        "    inner()\n"
+        "    local()\n"
+        "    helper()\n"
+        "    other.entry()\n"
+        "def local():\n"
+        "    pass\n"
+    )
+    util = "def helper():\n    pass\n"
+    other = "def entry():\n    pass\n"
+    project, _ = build(
+        {"server/m.py": src, "server/util.py": util, "server/other.py": other}
+    )
+    top = project.functions["server/m.py::top"]
+    assert project.resolve(call_named(top, "inner"), top) == (
+        "nested",
+        ["server/m.py::top.inner"],
+    )
+    assert project.resolve(call_named(top, "local"), top) == (
+        "module",
+        ["server/m.py::local"],
+    )
+    assert project.resolve(call_named(top, "helper"), top) == (
+        "import",
+        ["server/util.py::helper"],
+    )
+    assert project.resolve(call_named(top, "entry"), top) == (
+        "import",
+        ["server/other.py::entry"],
+    )
+
+
+def test_method_resolution_walks_bases():
+    src = (
+        "import time\n"
+        "class Base:\n"
+        "    def _flush(self):\n"
+        "        time.sleep(1)\n"
+        "class Mid(Base):\n"
+        "    pass\n"
+        "class Derived(Mid):\n"
+        "    def run(self):\n"
+        "        self._flush()\n"
+    )
+    project, summaries = build({"server/m.py": src})
+    run = project.functions["server/m.py::Derived.run"]
+    kind, targets = project.resolve(call_named(run, "_flush"), run)
+    assert kind == "method" and targets == ["server/m.py::Base._flush"]
+    # and the effect propagates up through the resolved edge
+    assert summaries["server/m.py::Derived.run"].may_block is not None
+
+
+def test_cycles_converge():
+    # mutual recursion with a blocking leaf: the fixpoint must terminate and
+    # both participants end up may_block (facts only grow, cycles are safe)
+    src = (
+        "import time\n"
+        "def ping(n):\n"
+        "    if n:\n"
+        "        pong(n - 1)\n"
+        "def pong(n):\n"
+        "    time.sleep(1)\n"
+        "    ping(n)\n"
+    )
+    _, summaries = build({"server/m.py": src})
+    assert summaries["server/m.py::ping"].may_block is not None
+    assert summaries["server/m.py::pong"].may_block is not None
+    chain = render_chain(summaries["server/m.py::ping"].may_block)
+    assert "pong" in chain and "time.sleep" in chain
+
+
+def test_fallback_requires_unanimity():
+    # two project functions named `get`, only one blocks: an unresolvable
+    # self-call named `get` must NOT inherit may_block (the dispatch might
+    # land on the harmless one — or on dict.get)
+    split = (
+        "import time\n"
+        "class A:\n"
+        "    def get(self):\n"
+        "        time.sleep(1)\n"
+        "class B:\n"
+        "    def get(self):\n"
+        "        return 1\n"
+        "class C:\n"
+        "    def caller(self):\n"
+        "        self.get()\n"
+    )
+    project, summaries = build({"server/m.py": split})
+    caller = project.functions["server/m.py::C.caller"]
+    kind, targets = project.resolve(call_named(caller, "get"), caller)
+    assert kind == "fallback" and len(targets) == 2
+    assert summaries["server/m.py::C.caller"].may_block is None
+    # when EVERY candidate blocks, the join cannot save the caller
+    unanimous = split.replace("        return 1\n", "        time.sleep(2)\n")
+    _, summaries = build({"server/m.py": unanimous})
+    assert summaries["server/m.py::C.caller"].may_block is not None
+
+
+def test_fallback_never_joins_dotted_receivers():
+    # `writer.drain()` on some stream object must not inherit a project
+    # function that happens to be called `drain`, even a blocking one
+    src = (
+        "import time\n"
+        "def drain():\n"
+        "    time.sleep(1)\n"
+        "class S:\n"
+        "    async def send(self, writer):\n"
+        "        await writer.drain()\n"
+    )
+    _, summaries = build({"server/m.py": src})
+    assert summaries["server/m.py::S.send"].may_block is None
+
+
+def test_balanced_helper_has_no_net_effect():
+    src = (
+        "class S:\n"
+        "    def bounce(self, page):\n"
+        "        self._pages.incref(page)\n"
+        "        self._pages.decref(page)\n"
+        "    def take(self, page):\n"
+        "        self._pages.incref(page)\n"
+    )
+    _, summaries = build({"server/m.py": src})
+    bounce = summaries["server/m.py::S.bounce"]
+    assert bounce.net_ref_inc is None and bounce.net_ref_rel is None
+    take = summaries["server/m.py::S.take"]
+    assert take.net_ref_inc is not None
+
+
+def test_donation_flows_up_wrappers():
+    src = (
+        "import functools, jax\n"
+        "@functools.partial(jax.jit, donate_argnums=(1,))\n"
+        "def _step(params, kv):\n"
+        "    return kv\n"
+        "def wrapper(params, kv):\n"
+        "    return _step(params, kv)\n"
+    )
+    _, summaries = build({"server/m.py": src})
+    assert set(summaries["server/m.py::_step"].donates) == {1}
+    assert set(summaries["server/m.py::wrapper"].donates) == {1}
+
+
+def test_leaves_dirty_distinguishes_restoring_helpers():
+    src = (
+        "class S:\n"
+        "    def half(self, slot):\n"
+        "        slot.suspending = True\n"
+        "    def full(self, slot):\n"
+        "        slot.suspending = True\n"
+        "        slot.suspending = False\n"
+    )
+    _, summaries = build({"server/m.py": src})
+    assert summaries["server/m.py::S.half"].leaves_dirty is not None
+    assert summaries["server/m.py::S.full"].leaves_dirty is None
+
+
+def test_callers_of():
+    src = (
+        "class S:\n"
+        "    def helper(self):\n"
+        "        pass\n"
+        "    def a(self):\n"
+        "        self.helper()\n"
+        "    def b(self):\n"
+        "        self.helper()\n"
+    )
+    project, _ = build({"server/m.py": src})
+    callers = project.callers_of("server/m.py::S.helper")
+    assert sorted(f.name for f, _c in callers) == ["a", "b"]

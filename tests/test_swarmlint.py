@@ -10,11 +10,19 @@ import threading
 
 import pytest
 
-from petals_tpu.analysis import check_paths, check_source, unsuppressed
+from petals_tpu.analysis import (
+    check_paths,
+    check_project,
+    check_source,
+    check_sources,
+    unsuppressed,
+)
 from petals_tpu.analysis.cli import main as cli_main
+from petals_tpu.analysis.engine import fingerprint
 from petals_tpu.analysis.findings import (
     PRAGMA_NEEDS_REASON,
     PRAGMA_UNKNOWN_RULE,
+    STALE_PRAGMA,
     parse_pragmas,
 )
 from petals_tpu.analysis import sanitizer
@@ -486,8 +494,11 @@ def test_pragma_machinery():
 
 
 def test_cli_and_tree_clean(tmp_path, capsys):
-    # the shipped tree must lint clean: the same invariant CI enforces
-    findings = unsuppressed(check_paths([os.path.join(REPO_ROOT, "petals_tpu")]))
+    # the shipped tree must lint clean under the full v2 engine (v1 rules +
+    # interprocedural passes + stale-pragma): the same gate CI enforces
+    findings = unsuppressed(
+        check_project([os.path.join(REPO_ROOT, "petals_tpu")])
+    )
     assert not findings, "\n".join(f.format() for f in findings)
 
     bad = tmp_path / "server" / "bad.py"
@@ -502,6 +513,518 @@ def test_cli_and_tree_clean(tmp_path, capsys):
     assert "no-orphan-task" in out
     bad.write_text("x = 1\n")
     assert cli_main([str(tmp_path)]) == 0
+
+
+# ------------------------------------------------- interprocedural rules (v2)
+
+
+def interp_lines(sources, rule):
+    """(path, line) pairs the full project-mode engine reports for ``rule``
+    over an in-memory fixture corpus."""
+    return [
+        (f.path, f.line)
+        for f in unsuppressed(check_sources(sources))
+        if f.rule == rule
+    ]
+
+
+def test_interp_blocking_hidden_in_helpers():
+    src = (
+        "import time\n"
+        "class S:\n"
+        "    def _sync_flush(self):\n"
+        "        time.sleep(0.1)\n"
+        "    def _flush(self):\n"
+        "        self._sync_flush()\n"
+        "    async def f(self):\n"
+        "        async with self._open_lock:\n"
+        "            self._flush()\n"  # blocks two helpers down
+    )
+    hits = interp_lines({"server/m.py": src}, "no-blocking-under-lock")
+    assert hits == [("server/m.py", 9)]
+    # the message carries the witness chain down to the blocking primitive
+    (finding,) = [
+        f
+        for f in unsuppressed(check_sources({"server/m.py": src}))
+        if f.rule == "no-blocking-under-lock"
+    ]
+    assert "_sync_flush" in finding.message and "time.sleep" in finding.message
+    ok = src.replace("time.sleep(0.1)", "x = 1")
+    assert not interp_lines({"server/m.py": ok}, "no-blocking-under-lock")
+    suppressed = src.replace(
+        "self._flush()",
+        "self._flush()  "
+        "# swarmlint: disable=no-blocking-under-lock — test fixture",
+    )
+    assert not interp_lines({"server/m.py": suppressed}, "no-blocking-under-lock")
+
+
+def test_interp_await_under_hidden_thread_lock():
+    src = (
+        "import threading, asyncio\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._reset_lock = threading.Lock()\n"
+        "    def _grab(self):\n"
+        "        self._reset_lock.acquire()\n"
+        "    async def f(self):\n"
+        "        self._grab()\n"  # returns holding the lock
+        "        await asyncio.sleep(0)\n"
+        "        self._reset_lock.release()\n"
+    )
+    hits = interp_lines({"server/m.py": src}, "no-await-under-thread-lock")
+    assert hits == [("server/m.py", 9)]
+    # releasing before the await clears the held set
+    ok = (
+        "import threading, asyncio\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._reset_lock = threading.Lock()\n"
+        "    def _grab(self):\n"
+        "        self._reset_lock.acquire()\n"
+        "    async def f(self):\n"
+        "        self._grab()\n"
+        "        self._reset_lock.release()\n"
+        "        await asyncio.sleep(0)\n"
+    )
+    assert not interp_lines({"server/m.py": ok}, "no-await-under-thread-lock")
+    # a balanced helper (acquire + release inside) has no net effect
+    balanced = src.replace(
+        "        self._reset_lock.acquire()\n",
+        "        self._reset_lock.acquire()\n"
+        "        self._reset_lock.release()\n",
+    )
+    assert not interp_lines({"server/m.py": balanced}, "no-await-under-thread-lock")
+    # the lexical case still reports at the v1 line, so pragmas keep working
+    lexical = (
+        "import threading, asyncio\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._reset_lock = threading.Lock()\n"
+        "    async def f(self):\n"
+        "        with self._reset_lock:\n"
+        "            await asyncio.sleep(0)\n"
+    )
+    assert interp_lines({"server/m.py": lexical}, "no-await-under-thread-lock") == [
+        ("server/m.py", 7)
+    ]
+    pragma = lexical.replace(
+        "await asyncio.sleep(0)",
+        "await asyncio.sleep(0)  "
+        "# swarmlint: disable=no-await-under-thread-lock — test fixture",
+    )
+    assert not interp_lines({"server/m.py": pragma}, "no-await-under-thread-lock")
+
+
+def test_interp_paired_refcount_through_helpers():
+    # the take hidden one call down: f() owns a reference it never releases
+    src = (
+        "class S:\n"
+        "    def _take(self, page):\n"
+        "        self._pages.incref(page)\n"
+        "    async def f(self, page):\n"
+        "        self._take(page)\n"
+        "        await self.work()\n"
+    )
+    # two findings: the helper is an ownership-transfer site (it returns
+    # holding the reference), and the caller owns a reference it never drops
+    hits = interp_lines({"server/m.py": src}, "paired-refcount")
+    assert hits == [("server/m.py", 3), ("server/m.py", 5)]
+    # ...and the v1 false positive is gone: release via a helper in finally
+    ok = (
+        "class S:\n"
+        "    def _cleanup(self, page):\n"
+        "        self._pages.decref(page)\n"
+        "    async def g(self, page):\n"
+        "        self._pages.incref(page)\n"
+        "        try:\n"
+        "            await self.work()\n"
+        "        finally:\n"
+        "            self._cleanup(page)\n"
+    )
+    assert not interp_lines({"server/m.py": ok}, "paired-refcount")
+    # a balanced helper (takes and releases internally) is neutral
+    balanced = (
+        "class S:\n"
+        "    def _bounce(self, page):\n"
+        "        self._pages.incref(page)\n"
+        "        self._pages.decref(page)\n"
+        "    async def f(self, page):\n"
+        "        self._bounce(page)\n"
+        "        await self.work()\n"
+    )
+    assert not interp_lines({"server/m.py": balanced}, "paired-refcount")
+    transfer = src.replace(
+        "        self._pages.incref(page)\n",
+        "        # swarmlint: disable=paired-refcount — hands the ref to callers\n"
+        "        self._pages.incref(page)\n",
+    ).replace(
+        "        self._take(page)\n",
+        "        # swarmlint: disable=paired-refcount — ownership transfer\n"
+        "        self._take(page)\n",
+    )
+    assert not interp_lines({"server/m.py": transfer}, "paired-refcount")
+
+
+def test_interp_paired_refcount_except_exception_misses_cancellation():
+    """Regression for the prefix-store pin leak: a release sitting only under
+    ``except Exception`` does not run when the task is cancelled at one of
+    the awaits between pin and commit, so the pages leak until pool reset.
+    ``finally`` or ``except BaseException`` is required."""
+    leaky = (
+        "class S:\n"
+        "    async def store(self, lane):\n"
+        "        pages = self.batcher.pin_lane_pages(lane)\n"
+        "        try:\n"
+        "            await self._snapshot(pages)\n"
+        "        except Exception:\n"
+        "            self.batcher.unpin_pages(pages)\n"
+        "            return\n"
+        "        self._commit(pages)\n"
+    )
+    findings = [
+        f
+        for f in unsuppressed(check_sources({"server/m.py": leaky}))
+        if f.rule == "paired-refcount"
+    ]
+    assert [f.line for f in findings] == [3]
+    assert "except Exception" in findings[0].message
+    fixed = leaky.replace("except Exception:", "except BaseException:")
+    assert not interp_lines({"server/m.py": fixed}, "paired-refcount")
+    with_finally = (
+        "class S:\n"
+        "    async def store(self, lane):\n"
+        "        pages = self.batcher.pin_lane_pages(lane)\n"
+        "        try:\n"
+        "            await self._snapshot(pages)\n"
+        "        finally:\n"
+        "            self.batcher.unpin_pages(pages)\n"
+    )
+    assert not interp_lines({"server/m.py": with_finally}, "paired-refcount")
+
+
+def test_use_after_donate():
+    # bound donating callable: self.step = jax.jit(..., donate_argnums=(1,))
+    src = (
+        "import jax\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self.step = jax.jit(_step, donate_argnums=(1,))\n"
+        "    def run(self, params, kv):\n"
+        "        out = self.step(params, kv)\n"
+        "        stale = kv.sum()\n"  # kv's buffer belongs to XLA now
+        "        return out, stale\n"
+    )
+    hits = interp_lines({"server/backend_fix.py": src}, "use-after-donate")
+    assert hits == [("server/backend_fix.py", 7)]
+    # rebinding the name from the call's result is the documented fix
+    ok = src.replace(
+        "        out = self.step(params, kv)\n"
+        "        stale = kv.sum()\n"
+        "        return out, stale\n",
+        "        kv = self.step(params, kv)\n"
+        "        return kv.sum()\n",
+    )
+    assert not interp_lines({"server/backend_fix.py": ok}, "use-after-donate")
+    suppressed = src.replace(
+        "        stale = kv.sum()\n",
+        "        stale = kv.sum()  "
+        "# swarmlint: disable=use-after-donate — test fixture\n",
+    )
+    assert not interp_lines({"server/backend_fix.py": suppressed}, "use-after-donate")
+
+
+def test_use_after_donate_through_wrapper():
+    # donation flows UP the call graph: a wrapper that forwards its param
+    # into a donated position donates that param itself, so the read after
+    # the *wrapper* call (one level removed from any jit) is flagged
+    src = (
+        "import functools, jax\n"
+        "@functools.partial(jax.jit, donate_argnums=(1,))\n"
+        "def _step(params, kv):\n"
+        "    return kv\n"
+        "def wrapper(params, kv):\n"
+        "    return _step(params, kv)\n"
+        "def caller(params, kv):\n"
+        "    wrapper(params, kv)\n"
+        "    return kv.mean()\n"
+    )
+    hits = interp_lines({"server/wrap.py": src}, "use-after-donate")
+    assert hits == [("server/wrap.py", 9)]
+    # the non-donated position is not poisoned
+    ok = src.replace("return kv.mean()", "return params")
+    assert not interp_lines({"server/wrap.py": ok}, "use-after-donate")
+
+
+def test_cancellation_safety():
+    # direct: region goes dirty (incref) and a later await is unprotected
+    src = (
+        "class S:\n"
+        "    async def f(self, page):\n"
+        "        async with self._open_lock:\n"
+        "            self._pages.incref(page)\n"
+        "            await self.flush()\n"
+    )
+    hits = interp_lines({"client/c.py": src}, "cancellation-safety")
+    assert hits == [("client/c.py", 5)]
+    # try/finally over the await protects the region
+    ok = (
+        "class S:\n"
+        "    async def g(self, page):\n"
+        "        async with self._open_lock:\n"
+        "            self._pages.incref(page)\n"
+        "            try:\n"
+        "                await self.flush()\n"
+        "            finally:\n"
+        "                self._pages.decref(page)\n"
+    )
+    assert not interp_lines({"client/c.py": ok}, "cancellation-safety")
+    # an await BEFORE the region goes dirty is not a hazard
+    clean_order = (
+        "class S:\n"
+        "    async def h(self, page):\n"
+        "        async with self._open_lock:\n"
+        "            await self.flush()\n"
+        "            self._pages.incref(page)\n"
+        "            self._pages.decref(page)\n"
+    )
+    assert not interp_lines({"client/c.py": clean_order}, "cancellation-safety")
+    # an explicit typestate restore completes the transition mid-region
+    restored = (
+        "class S:\n"
+        "    async def k(self, slot):\n"
+        "        async with self._open_lock:\n"
+        "            slot.suspending = True\n"
+        "            slot.suspending = False\n"
+        "            await self.flush()\n"
+    )
+    assert not interp_lines({"client/c.py": restored}, "cancellation-safety")
+    suppressed = src.replace(
+        "            await self.flush()\n",
+        "            await self.flush()  "
+        "# swarmlint: disable=cancellation-safety — test fixture\n",
+    )
+    assert not interp_lines({"client/c.py": suppressed}, "cancellation-safety")
+
+
+def test_cancellation_safety_sees_through_helpers():
+    # dirt one call down: _mark() has a net incref the caller owns unwinding
+    deep = (
+        "class S:\n"
+        "    def _mark(self, page):\n"
+        "        self._pages.incref(page)\n"
+        "    async def f(self, page):\n"
+        "        async with self._open_lock:\n"
+        "            self._mark(page)\n"
+        "            await self.flush()\n"
+    )
+    hits = interp_lines({"client/c.py": deep}, "cancellation-safety")
+    assert hits == [("client/c.py", 7)]
+    # a helper whose whole body runs under a caller's lock is scanned too
+    helper_body = (
+        "class S:\n"
+        "    async def _inner(self, page):\n"
+        "        self._pages.incref(page)\n"
+        "        await self.flush()\n"
+        "    async def outer(self, page):\n"
+        "        async with self._open_lock:\n"
+        "            await self._inner(page)\n"
+    )
+    hits = interp_lines({"client/c.py": helper_body}, "cancellation-safety")
+    assert hits == [("client/c.py", 4)]
+    # ...but only when some call site actually holds an async lock
+    unlocked = helper_body.replace(
+        "        async with self._open_lock:\n"
+        "            await self._inner(page)\n",
+        "        await self._inner(page)\n",
+    )
+    assert not interp_lines({"client/c.py": unlocked}, "cancellation-safety")
+
+
+def test_lane_typestate():
+    src = (
+        "from petals_tpu.analysis.sanitizer import lock_try_acquire_nowait\n"
+        "class Sched:\n"
+        "    def kill(self, slot):\n"
+        "        slot.suspending = True\n"  # T1: no lane lock anywhere
+        "    async def badswap(self, slot, lane):\n"
+        "        async with self._lane_lock(lane):\n"
+        "            slot.swap = self._mk()\n"  # T2: never suspending
+        "    async def wedge(self, slot, lane):\n"
+        "        async with self._lane_lock(lane):\n"
+        "            slot.suspending = True\n"  # T3: no cleanup-path reset
+        "            await self._drain()\n"
+        "            slot.suspending = False\n"
+    )
+    hits = interp_lines({"server/lanes.py": src}, "lane-typestate")
+    assert hits == [
+        ("server/lanes.py", 4),
+        ("server/lanes.py", 7),
+        ("server/lanes.py", 10),
+    ]
+    # the same mutations are out of scope outside server/
+    assert not interp_lines({"client/lanes.py": src}, "lane-typestate")
+    # the full legal sequence under the lane lock is clean: suspend ->
+    # install swap -> drain under try/finally -> restore on every path
+    ok = (
+        "class Sched:\n"
+        "    async def swap_out(self, slot, lane):\n"
+        "        async with self._lane_lock(lane):\n"
+        "            slot.suspending = True\n"
+        "            slot.swap = self._mk()\n"
+        "            try:\n"
+        "                await self._drain()\n"
+        "            finally:\n"
+        "                slot.suspending = False\n"
+    )
+    assert not interp_lines({"server/lanes.py": ok}, "lane-typestate")
+    # an earlier trylock of the victim's lane lock counts as holding it
+    trylock = (
+        "from petals_tpu.analysis.sanitizer import lock_try_acquire_nowait\n"
+        "class Sched:\n"
+        "    def steal(self, slot, victim_lane_lock):\n"
+        "        if lock_try_acquire_nowait(victim_lane_lock):\n"
+        "            slot.suspending = True\n"
+    )
+    assert not interp_lines({"server/lanes.py": trylock}, "lane-typestate")
+    suppressed = src.replace(
+        "        slot.suspending = True\n"  # T1: no lane lock anywhere
+        "    async def badswap",
+        "        # swarmlint: disable=lane-typestate — test fixture\n"
+        "        slot.suspending = True\n"
+        "    async def badswap",
+    )
+    hits = interp_lines({"server/lanes.py": suppressed}, "lane-typestate")
+    assert ("server/lanes.py", 5) not in hits and len(hits) == 2
+
+
+def test_lane_typestate_every_caller_holds_lock():
+    # a helper whose EVERY call site holds the lane lock may mutate the
+    # typestate: the lock requirement is checked interprocedurally
+    src = (
+        "class Sched:\n"
+        "    def _apply(self, slot):\n"
+        "        slot.suspending = False\n"
+        "    async def release(self, slot, lane):\n"
+        "        async with self._lane_lock(lane):\n"
+        "            self._apply(slot)\n"
+    )
+    assert not interp_lines({"server/lanes.py": src}, "lane-typestate")
+    # one unlocked call site breaks the property for the helper
+    leaky = src + (
+        "    async def sloppy(self, slot):\n"
+        "        self._apply(slot)\n"
+    )
+    assert interp_lines({"server/lanes.py": leaky}, "lane-typestate") == [
+        ("server/lanes.py", 3)
+    ]
+
+
+def test_stale_pragma_detection():
+    # a reasoned pragma that suppresses nothing is itself a finding...
+    stale = "def f():\n    x = 1  # swarmlint: disable=lock-order — obsolete\n"
+    findings = unsuppressed(check_sources({"server/m.py": stale}))
+    assert [(f.rule, f.line) for f in findings] == [(STALE_PRAGMA, 2)]
+    # ...and cannot be silenced by another pragma (meta-rules never can)
+    # while a pragma that actually suppresses a finding is not stale
+    used = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:  # swarmlint: disable=no-silent-except — retried\n"
+        "        pass\n"
+    )
+    assert not unsuppressed(check_sources({"server/m.py": used}))
+    # rule-filtered and v1-only runs skip staleness (partial runs cannot
+    # tell unused from not-checked)
+    assert not unsuppressed(
+        check_sources({"server/m.py": stale}, rules=["lock-order"])
+    )
+    assert not unsuppressed(check_sources({"server/m.py": stale}, interp=False))
+
+
+def test_project_mode_matches_v1_on_lexical_findings():
+    # interp replacements report lexical violations at the SAME lines as v1,
+    # so pragmas written against v1 keep suppressing under the v2 engine
+    src = (
+        "import threading, asyncio\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._reset_lock = threading.Lock()\n"
+        "    async def f(self):\n"
+        "        with self._reset_lock:\n"
+        "            await asyncio.sleep(0)\n"
+    )
+    v1 = {
+        (f.rule, f.line)
+        for f in unsuppressed(check_source(src, "server/m.py"))
+        if f.rule == "no-await-under-thread-lock"
+    }
+    v2 = {
+        (f.rule, f.line)
+        for f in unsuppressed(check_sources({"server/m.py": src}))
+        if f.rule == "no-await-under-thread-lock"
+    }
+    assert v1 == v2 == {("no-await-under-thread-lock", 7)}
+
+
+def test_cli_json_sarif_and_baseline(tmp_path, capsys):
+    bad = tmp_path / "server" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "import asyncio\n"
+        "async def f():\n"
+        "    asyncio.create_task(g())\n"
+    )
+    json_out = tmp_path / "findings.json"
+    sarif_out = tmp_path / "findings.sarif"
+    baseline = tmp_path / "baseline.json"
+
+    import json as jsonlib
+
+    assert cli_main(
+        [str(tmp_path), "--json", str(json_out), "--sarif", str(sarif_out)]
+    ) == 1
+    capsys.readouterr()
+    payload = jsonlib.loads(json_out.read_text())
+    assert [p["rule"] for p in payload] == ["no-orphan-task"]
+    assert all(len(p["fingerprint"]) == 16 for p in payload)
+    sarif = jsonlib.loads(sarif_out.read_text())
+    results = sarif["runs"][0]["results"]
+    assert [r["ruleId"] for r in results] == ["no-orphan-task"]
+    assert results[0]["level"] == "error"
+
+    # record the debt, then the gate passes without touching the source...
+    assert cli_main(
+        [str(tmp_path), "--baseline", str(baseline), "--update-baseline"]
+    ) == 0
+    capsys.readouterr()
+    assert cli_main([str(tmp_path), "--baseline", str(baseline)]) == 0
+    assert "1 baselined" in capsys.readouterr().err
+    # ...but NEW findings (count beyond the recorded one) still fail
+    bad.write_text(bad.read_text() + "async def h():\n    asyncio.create_task(g())\n")
+    assert cli_main([str(tmp_path), "--baseline", str(baseline)]) == 1
+    capsys.readouterr()
+    # unreadable baseline is an operational failure, not a pass
+    baseline.write_text("{not json")
+    assert cli_main([str(tmp_path), "--baseline", str(baseline)]) == 2
+    capsys.readouterr()
+
+    # fingerprints ignore the line number (pure drift must not churn)
+    f1 = check_sources({"server/x.py": "import asyncio\nasync def f():\n    asyncio.create_task(g())\n"})
+    f2 = check_sources({"server/x.py": "import asyncio\n\n\nasync def f():\n    asyncio.create_task(g())\n"})
+    (a,) = unsuppressed(f1)
+    (b,) = unsuppressed(f2)
+    assert a.line != b.line and fingerprint(a) == fingerprint(b)
+
+
+def test_cli_max_seconds_budget(tmp_path, capsys):
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    assert cli_main([str(tmp_path), "--max-seconds", "300"]) == 0
+    capsys.readouterr()
+    assert cli_main([str(tmp_path), "--max-seconds", "0"]) == 2
+    assert "budget" in capsys.readouterr().err
 
 
 # ---------------------------------------------------------- runtime sanitizer
